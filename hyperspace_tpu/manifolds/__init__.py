@@ -1,0 +1,15 @@
+"""L0: pure-JAX manifold math (SURVEY.md §1b)."""
+
+from hyperspace_tpu.manifolds import smath  # noqa: F401
+from hyperspace_tpu.manifolds.base import Manifold  # noqa: F401
+from hyperspace_tpu.manifolds.euclidean import Euclidean  # noqa: F401
+from hyperspace_tpu.manifolds.lorentz import Lorentz, minkowski_dot  # noqa: F401
+from hyperspace_tpu.manifolds.maps import (  # noqa: F401
+    ball_tangent_to_lorentz,
+    ball_to_lorentz,
+    lorentz_tangent_to_ball,
+    lorentz_to_ball,
+)
+from hyperspace_tpu.manifolds.poincare import PoincareBall  # noqa: F401
+from hyperspace_tpu.manifolds.product import Product  # noqa: F401
+from hyperspace_tpu.manifolds.sphere import Sphere  # noqa: F401

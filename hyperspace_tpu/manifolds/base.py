@@ -1,0 +1,109 @@
+"""The Manifold interface every geometry implements.
+
+A manifold object is a *pytree* whose only leaves are its (possibly traced)
+curvature parameters, so a manifold can be passed through ``jax.jit`` /
+``jax.grad`` boundaries and its curvature can be a learned value
+(BASELINE.json configs[4]: product manifolds with learned curvature).
+
+All point/tangent arrays are batched over leading axes; the manifold
+dimension is always the last axis.
+"""
+
+from __future__ import annotations
+
+import abc
+
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import smath
+
+
+class Manifold(abc.ABC):
+    """Abstract Riemannian manifold.
+
+    The method set mirrors the primitive inventory of the reference's CUDA
+    backend (SURVEY.md §0: expmap/logmap, parallel transport, distance,
+    projections, plus Möbius ops on gyrovector manifolds).
+    """
+
+    name: str = "manifold"
+
+    # --- core geometry --------------------------------------------------------
+
+    @abc.abstractmethod
+    def proj(self, x: jax.Array) -> jax.Array:
+        """Project an ambient point onto the manifold (numerical guard)."""
+
+    @abc.abstractmethod
+    def proju(self, x: jax.Array, u: jax.Array) -> jax.Array:
+        """Project an ambient vector onto the tangent space at ``x``."""
+
+    @abc.abstractmethod
+    def expmap(self, x: jax.Array, v: jax.Array) -> jax.Array:
+        """Exponential map of tangent ``v`` at point ``x``."""
+
+    @abc.abstractmethod
+    def logmap(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        """Logarithm map of ``y`` at base point ``x``."""
+
+    @abc.abstractmethod
+    def sqdist(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        """Squared geodesic distance, shape = broadcast batch (no last axis)."""
+
+    @abc.abstractmethod
+    def inner(self, x: jax.Array, u: jax.Array, v: jax.Array, keepdims: bool = False) -> jax.Array:
+        """Riemannian inner product of tangents ``u``, ``v`` at ``x``."""
+
+    @abc.abstractmethod
+    def ptransp(self, x: jax.Array, y: jax.Array, v: jax.Array) -> jax.Array:
+        """Parallel transport of tangent ``v`` from ``x`` to ``y``."""
+
+    @abc.abstractmethod
+    def egrad2rgrad(self, x: jax.Array, g: jax.Array) -> jax.Array:
+        """Convert a Euclidean gradient into a Riemannian gradient at ``x``."""
+
+    @abc.abstractmethod
+    def origin(self, shape, dtype=jnp.float32) -> jax.Array:
+        """The canonical base point ('origin') broadcast to ``shape``."""
+
+    # --- defaults -------------------------------------------------------------
+
+    def dist(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        return smath.safe_sqrt(self.sqdist(x, y))
+
+    def norm_t(self, x: jax.Array, u: jax.Array, keepdims: bool = False) -> jax.Array:
+        return smath.safe_sqrt(self.inner(x, u, u, keepdims=keepdims))
+
+    def expmap0(self, v: jax.Array) -> jax.Array:
+        """Exponential map at the origin."""
+        return self.expmap(self.origin(v.shape, v.dtype), v)
+
+    def logmap0(self, y: jax.Array) -> jax.Array:
+        """Logarithm map at the origin."""
+        return self.logmap(self.origin(y.shape, y.dtype), y)
+
+    def ptransp0(self, y: jax.Array, v: jax.Array) -> jax.Array:
+        """Parallel transport from the origin to ``y``."""
+        return self.ptransp(self.origin(y.shape, y.dtype), y, v)
+
+    def retr(self, x: jax.Array, v: jax.Array) -> jax.Array:
+        """First-order retraction (cheap expmap substitute): proj(x + v)."""
+        return self.proj(x + v)
+
+    def zero_tangent(self, x: jax.Array) -> jax.Array:
+        return jnp.zeros_like(x)
+
+    def random_normal(self, key: jax.Array, shape, dtype=jnp.float32, std: float = 1.0) -> jax.Array:
+        """A wrapped-normal sample: N(0, std) in the origin tangent → expmap0."""
+        v = std * jax.random.normal(key, shape, dtype)
+        v = self.proju(self.origin(v.shape, dtype), v)
+        return self.proj(self.expmap0(v))
+
+    def check_point(self, x: jax.Array) -> jax.Array:
+        """Residual of the manifold constraint (0 for on-manifold points)."""
+        return jnp.zeros(x.shape[:-1], x.dtype)
+
+    # The ambient (storage) dimension for an n-dim manifold; Lorentz uses n+1.
+    def ambient_dim(self, dim: int) -> int:
+        return dim

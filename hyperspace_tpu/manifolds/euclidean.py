@@ -1,0 +1,58 @@
+"""Flat Euclidean factor (curvature 0), for mixed-curvature product spaces."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import smath
+from hyperspace_tpu.manifolds.base import Manifold
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Euclidean(Manifold):
+    name = "euclidean"
+
+    def tree_flatten(self):
+        return (), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls()
+
+    def proj(self, x):
+        return x
+
+    def proju(self, x, u):
+        return u
+
+    def expmap(self, x, v):
+        return x + v
+
+    def logmap(self, x, y):
+        return y - x
+
+    def sqdist(self, x, y):
+        return smath.sq_norm(y - x, keepdims=False)
+
+    def dist(self, x, y):
+        return smath.safe_norm(y - x, keepdims=False)
+
+    def inner(self, x, u, v, keepdims: bool = False):
+        out = jnp.sum(u * v, axis=-1, keepdims=True)
+        return out if keepdims else out[..., 0]
+
+    def ptransp(self, x, y, v):
+        return v
+
+    def egrad2rgrad(self, x, g):
+        return g
+
+    def origin(self, shape, dtype=jnp.float32):
+        return jnp.zeros(shape, dtype)
+
+    def random_normal(self, key, shape, dtype=jnp.float32, std: float = 1.0):
+        return std * jax.random.normal(key, shape, dtype)

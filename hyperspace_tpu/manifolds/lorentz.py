@@ -1,0 +1,147 @@
+"""Lorentz (hyperboloid) model of curvature -c (c > 0).
+
+Math follows Nickel & Kiela 2018 and Law et al. 2019 (SURVEY.md §2).  Points
+live on { x ∈ R^{d+1} : ⟨x,x⟩_L = -1/c, x_0 > 0 } with the Minkowski bilinear
+form ⟨x,y⟩_L = -x_0 y_0 + Σ_{i≥1} x_i y_i.  The hyperboloid is the preferred
+internal representation on TPU: its ops are dominated by dot products (MXU
+friendly) and it avoids the Poincaré boundary, which matters in f32/bf16
+(SURVEY.md §7 "hard parts #1": prefer Lorentz internally where allowed).
+
+Storage convention: the ambient dimension is d+1 for a d-dimensional
+manifold; index 0 is the time coordinate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import smath
+from hyperspace_tpu.manifolds.base import Manifold
+
+
+def minkowski_dot(x: jax.Array, y: jax.Array, keepdims: bool = True) -> jax.Array:
+    """⟨x, y⟩_L over the last axis."""
+    res = jnp.sum(x[..., 1:] * y[..., 1:], axis=-1, keepdims=True) - x[..., :1] * y[..., :1]
+    return res if keepdims else res[..., 0]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Lorentz(Manifold):
+    c: Any = 1.0
+    name = "lorentz"
+
+    def tree_flatten(self):
+        return (self.c,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def _c(self, dtype) -> jax.Array:
+        return jnp.asarray(self.c, dtype)
+
+    def ambient_dim(self, dim: int) -> int:
+        return dim + 1
+
+    # --- constraint / projections --------------------------------------------
+
+    def proj(self, x: jax.Array) -> jax.Array:
+        """Fix the time coordinate from the space coordinates."""
+        c = self._c(x.dtype)
+        sp = x[..., 1:]
+        t = smath.safe_sqrt(1.0 / smath.clamp_min(c, smath.min_norm(x.dtype)) + smath.sq_norm(sp))
+        return jnp.concatenate([t, sp], axis=-1)
+
+    def proju(self, x: jax.Array, u: jax.Array) -> jax.Array:
+        """Tangent projection: u + c ⟨x,u⟩_L x (⟨x,x⟩_L = -1/c)."""
+        c = self._c(x.dtype)
+        return u + c * minkowski_dot(x, u) * x
+
+    def check_point(self, x: jax.Array) -> jax.Array:
+        # Relative residual: hyperboloid coordinates grow like e^dist, so the
+        # raw ⟨x,x⟩_L + 1/c residual scales with ‖x‖² and must be normalized.
+        c = self._c(x.dtype)
+        scale = 1.0 / c + smath.sq_norm(x, keepdims=False)
+        return jnp.abs(minkowski_dot(x, x, keepdims=False) + 1.0 / c) / scale
+
+    # --- distance -------------------------------------------------------------
+
+    def _neg_cdot(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        """u = -c⟨x,y⟩_L - 1 ≥ 0; dist = arcosh(1+u)/√c (stable form)."""
+        c = self._c(x.dtype)
+        return -c * minkowski_dot(x, y) - 1.0
+
+    def dist(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        c = self._c(x.dtype)
+        u = self._neg_cdot(x, y)[..., 0]
+        return smath.arcosh1p(u) / smath.sqrt_c(c)
+
+    def sqdist(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        return self.dist(x, y) ** 2
+
+    # --- exp / log ------------------------------------------------------------
+
+    def expmap(self, x: jax.Array, v: jax.Array) -> jax.Array:
+        c = self._c(x.dtype)
+        sc = smath.sqrt_c(c)
+        vn = smath.safe_sqrt(smath.clamp_min(minkowski_dot(v, v), 0.0))
+        t = sc * vn
+        # sinh(t)/(√c‖v‖_L) = sinh(t)/t = sinhc(t), smooth at v = 0.
+        return self.proj(smath.safe_cosh(t) * x + smath.sinhc(t) * v)
+
+    def logmap(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        c = self._c(x.dtype)
+        # v = d(x,y) * (y + c⟨x,y⟩_L x) / ‖·‖_L ; smooth form via u-parameterization.
+        cxy = minkowski_dot(x, y)
+        w = y + c * cxy * x  # tangent direction, ⟨x,w⟩_L = 0
+        wn = smath.safe_sqrt(smath.clamp_min(minkowski_dot(w, w), 0.0))
+        d = self.dist(x, y)[..., None]
+        return d * w / smath.clamp_min(wn, smath.min_norm(x.dtype))
+
+    def origin(self, shape, dtype=jnp.float32) -> jax.Array:
+        c = self._c(dtype)
+        o = jnp.zeros(shape, dtype)
+        t = jnp.ones(shape[:-1] + (1,), dtype) / smath.sqrt_c(c)
+        return jnp.concatenate([t, o[..., 1:]], axis=-1)
+
+    # --- transport / metric ---------------------------------------------------
+
+    def inner(self, x: jax.Array, u: jax.Array, v: jax.Array, keepdims: bool = False) -> jax.Array:
+        return minkowski_dot(u, v, keepdims=keepdims)
+
+    def ptransp(self, x: jax.Array, y: jax.Array, v: jax.Array) -> jax.Array:
+        """P_{x→y}(v) = v + c⟨y,v⟩_L / (1 - c⟨x,y⟩_L) (x + y)  (kernel N4)."""
+        c = self._c(x.dtype)
+        num = c * minkowski_dot(y, v)
+        den = smath.clamp_min(1.0 - c * minkowski_dot(x, y), smath.eps_for(x.dtype))
+        return v + num / den * (x + y)
+
+    def egrad2rgrad(self, x: jax.Array, g: jax.Array) -> jax.Array:
+        """Flip the time component (Minkowski metric inverse), then proju."""
+        gl = jnp.concatenate([-g[..., :1], g[..., 1:]], axis=-1)
+        return self.proju(x, gl)
+
+    def retr(self, x: jax.Array, v: jax.Array) -> jax.Array:
+        return self.proj(x + v)
+
+    # --- aggregation (used by HGCN / attention on the hyperboloid) ------------
+
+    def centroid(self, x: jax.Array, w: jax.Array | None = None) -> jax.Array:
+        """Lorentz centroid (Law et al. 2019): normalize the weighted sum.
+
+        x: [..., n, d+1]; w: [..., n] (uniform if None).
+        μ = s / (√c · √(-⟨s,s⟩_L)) with s = Σ w_i x_i.
+        """
+        c = self._c(x.dtype)
+        if w is None:
+            s = jnp.sum(x, axis=-2)
+        else:
+            s = jnp.sum(w[..., None] * x, axis=-2)
+        nrm = smath.safe_sqrt(smath.clamp_min(-minkowski_dot(s, s), smath.eps_for(x.dtype)))
+        return s / (smath.sqrt_c(c) * nrm)
+

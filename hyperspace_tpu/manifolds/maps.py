@@ -1,0 +1,48 @@
+"""Diffeomorphisms between the Poincaré ball and the Lorentz hyperboloid.
+
+Both curvature-(-c) models appear in the reference workloads (BASELINE.json:
+Poincaré embeddings on the ball, HGCN/HyboNet on the Lorentz model), so the
+stereographic projection between them is a first-class op.  Distances are
+preserved exactly; tests assert the round trip and the isometry.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import smath
+
+
+def lorentz_to_ball(x: jax.Array, c) -> jax.Array:
+    """Stereographic projection hyperboloid → ball (drops the time coord).
+
+    y = x_space / (1 + √c · x_0).
+    """
+    c = jnp.asarray(c, x.dtype)
+    sc = smath.sqrt_c(c)
+    denom = smath.clamp_min(1.0 + sc * x[..., :1], smath.eps_for(x.dtype))
+    return x[..., 1:] / denom
+
+
+def ball_to_lorentz(y: jax.Array, c) -> jax.Array:
+    """Inverse stereographic projection ball → hyperboloid.
+
+    x_0 = (1/√c)(1 + c‖y‖²)/(1 − c‖y‖²),  x_space = 2y/(1 − c‖y‖²).
+    """
+    c = jnp.asarray(c, y.dtype)
+    sc = smath.sqrt_c(c)
+    y2 = smath.sq_norm(y)
+    denom = smath.clamp_min(1.0 - c * y2, smath.eps_for(y.dtype))
+    x0 = (1.0 + c * y2) / (sc * denom)
+    xs = 2.0 * y / denom
+    return jnp.concatenate([x0, xs], axis=-1)
+
+
+def lorentz_tangent_to_ball(x: jax.Array, v: jax.Array, c) -> jax.Array:
+    """Pushforward of the projection differential at x applied to tangent v."""
+    return jax.jvp(lambda p: lorentz_to_ball(p, c), (x,), (v,))[1]
+
+
+def ball_tangent_to_lorentz(y: jax.Array, u: jax.Array, c) -> jax.Array:
+    return jax.jvp(lambda p: ball_to_lorentz(p, c), (y,), (u,))[1]

@@ -1,0 +1,200 @@
+"""Poincaré ball of curvature -c (c > 0) with Möbius gyrovector operations.
+
+Math follows Ganea et al. 2018 ("Hyperbolic Neural Networks") and Ungar's
+gyrovector calculus; these fix the semantics of the reference's CUDA
+primitives — Möbius add / scalar-mul, expmap/logmap, parallel transport,
+gyro-linear — listed in BASELINE.json's north star (SURVEY.md §0 items 1-5).
+
+The ball of curvature -c is { x ∈ R^d : c‖x‖² < 1 } with conformal factor
+λ_x = 2 / (1 - c‖x‖²).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import smath
+from hyperspace_tpu.manifolds.base import Manifold
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PoincareBall(Manifold):
+    """Curvature is stored as the positive magnitude ``c`` (a pytree leaf)."""
+
+    c: Any = 1.0
+    name = "poincare"
+
+    def tree_flatten(self):
+        return (self.c,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # --- helpers --------------------------------------------------------------
+
+    def _c(self, dtype) -> jax.Array:
+        return jnp.asarray(self.c, dtype)
+
+    def lambda_x(self, x: jax.Array, keepdims: bool = True) -> jax.Array:
+        c = self._c(x.dtype)
+        denom = smath.clamp_min(1.0 - c * smath.sq_norm(x), smath.eps_for(x.dtype))
+        out = 2.0 / denom
+        return out if keepdims else out[..., 0]
+
+    # --- constraint / projections --------------------------------------------
+
+    def proj(self, x: jax.Array) -> jax.Array:
+        c = self._c(x.dtype)
+        sc = smath.sqrt_c(c)
+        norm = smath.clamp_min(smath.safe_norm(x), smath.min_norm(x.dtype))
+        max_norm = (1.0 - smath.ball_eps(x.dtype)) / smath.clamp_min(sc, smath.min_norm(x.dtype))
+        cond = norm > max_norm
+        return jnp.where(cond, x / norm * max_norm, x)
+
+    def proju(self, x: jax.Array, u: jax.Array) -> jax.Array:
+        return u  # tangent space is all of R^d
+
+    def check_point(self, x: jax.Array) -> jax.Array:
+        c = self._c(x.dtype)
+        return smath.clamp_min(c * smath.sq_norm(x, keepdims=False) - 1.0, 0.0)
+
+    # --- Möbius gyrovector ops (reference native kernels N1/N2) ---------------
+
+    def mobius_add(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        """x ⊕_c y (reference CUDA kernel N1; SURVEY.md §2)."""
+        c = self._c(x.dtype)
+        x2 = smath.sq_norm(x)
+        y2 = smath.sq_norm(y)
+        xy = jnp.sum(x * y, axis=-1, keepdims=True)
+        num = (1.0 + 2.0 * c * xy + c * y2) * x + (1.0 - c * x2) * y
+        denom = 1.0 + 2.0 * c * xy + (c ** 2) * x2 * y2
+        return num / smath.clamp_min(denom, smath.eps_for(x.dtype))
+
+    def mobius_neg(self, x: jax.Array) -> jax.Array:
+        return -x
+
+    def mobius_scalar_mul(self, r, x: jax.Array) -> jax.Array:
+        """r ⊗_c x (reference CUDA kernel N2)."""
+        c = self._c(x.dtype)
+        sc = smath.sqrt_c(c)
+        norm = smath.clamp_min(smath.safe_norm(x), smath.min_norm(x.dtype))
+        t = smath.safe_tanh(r * smath.artanh(sc * norm))
+        return t * x / smath.clamp_min(sc * norm, smath.min_norm(x.dtype))
+
+    def mobius_matvec(self, m: jax.Array, x: jax.Array) -> jax.Array:
+        """M ⊗_c x: the linear part of the gyro-linear layer (kernel N5).
+
+        ``m`` has shape [d_in, d_out]; applied on the last axis of ``x``.
+        """
+        c = self._c(x.dtype)
+        sc = smath.sqrt_c(c)
+        x_norm = smath.clamp_min(smath.safe_norm(x), smath.min_norm(x.dtype))
+        mx = x @ m
+        mx_norm = smath.clamp_min(smath.safe_norm(mx), smath.min_norm(x.dtype))
+        sc = smath.clamp_min(sc, smath.min_norm(x.dtype))  # guard learned c → 0
+        res = smath.safe_tanh(mx_norm / x_norm * smath.artanh(sc * x_norm)) * mx / (mx_norm * sc)
+        # M x = 0 maps to the origin (gyro-linearity convention).
+        zero = jnp.all(mx == 0.0, axis=-1, keepdims=True)
+        return jnp.where(zero, jnp.zeros_like(res), res)
+
+    def gyration(self, u: jax.Array, v: jax.Array, w: jax.Array) -> jax.Array:
+        """gyr[u, v] w — closed form (Ungar), avoids three Möbius additions."""
+        c = self._c(u.dtype)
+        u2 = smath.sq_norm(u)
+        v2 = smath.sq_norm(v)
+        uv = jnp.sum(u * v, axis=-1, keepdims=True)
+        uw = jnp.sum(u * w, axis=-1, keepdims=True)
+        vw = jnp.sum(v * w, axis=-1, keepdims=True)
+        c2 = c ** 2
+        a = -c2 * uw * v2 + c * vw + 2.0 * c2 * uv * vw
+        b = -c2 * vw * u2 - c * uw
+        d = 1.0 + 2.0 * c * uv + c2 * u2 * v2
+        return w + 2.0 * (a * u + b * v) / smath.clamp_min(d, smath.eps_for(u.dtype))
+
+    # --- exp / log / distance (reference kernel N3) ---------------------------
+
+    def expmap(self, x: jax.Array, v: jax.Array) -> jax.Array:
+        c = self._c(x.dtype)
+        sc = smath.sqrt_c(c)
+        v_norm = smath.safe_norm(v)
+        lam = self.lambda_x(x)
+        t = sc * lam * v_norm / 2.0
+        second = smath.tanc(t) * lam / 2.0 * v  # tanh(t)/t · (λ/2) v — smooth at v=0
+        return self.proj(self.mobius_add(x, second))
+
+    def logmap(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        c = self._c(x.dtype)
+        sc = smath.sqrt_c(c)
+        sub = self.mobius_add(-x, y)
+        sub_norm = smath.safe_norm(sub)
+        lam = self.lambda_x(x)
+        # (2/(√c λ)) artanh(√c‖sub‖) sub/‖sub‖, smooth at y = x via artanc.
+        return (2.0 / lam) * smath.artanc(sc * sub_norm) * sub
+
+    def expmap0(self, v: jax.Array) -> jax.Array:
+        c = self._c(v.dtype)
+        sc = smath.sqrt_c(c)
+        v_norm = smath.safe_norm(v)
+        return self.proj(smath.tanc(sc * v_norm) * v)
+
+    def logmap0(self, y: jax.Array) -> jax.Array:
+        c = self._c(y.dtype)
+        sc = smath.sqrt_c(c)
+        y_norm = smath.safe_norm(y)
+        return smath.artanc(sc * y_norm) * y
+
+    def sqdist(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        return self.dist(x, y) ** 2
+
+    def dist(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        c = self._c(x.dtype)
+        sc = smath.sqrt_c(c)
+        diff_norm = smath.safe_norm(self.mobius_add(-x, y), keepdims=False)
+        return 2.0 / smath.clamp_min(sc, smath.min_norm(x.dtype)) * smath.artanh(sc * diff_norm)
+
+    def dist0(self, x: jax.Array, keepdims: bool = False) -> jax.Array:
+        c = self._c(x.dtype)
+        sc = smath.clamp_min(smath.sqrt_c(c), smath.min_norm(x.dtype))
+        return 2.0 / sc * smath.artanh(sc * smath.safe_norm(x, keepdims=keepdims))
+
+    # --- transport / metric ---------------------------------------------------
+
+    def inner(self, x: jax.Array, u: jax.Array, v: jax.Array, keepdims: bool = False) -> jax.Array:
+        lam = self.lambda_x(x)
+        out = lam ** 2 * jnp.sum(u * v, axis=-1, keepdims=True)
+        return out if keepdims else out[..., 0]
+
+    def ptransp(self, x: jax.Array, y: jax.Array, v: jax.Array) -> jax.Array:
+        """P_{x→y}(v) = (λ_x / λ_y) gyr[y, -x] v (reference kernel N4)."""
+        return self.gyration(y, -x, v) * self.lambda_x(x) / self.lambda_x(y)
+
+    def egrad2rgrad(self, x: jax.Array, g: jax.Array) -> jax.Array:
+        return g / self.lambda_x(x) ** 2
+
+    def origin(self, shape, dtype=jnp.float32) -> jax.Array:
+        return jnp.zeros(shape, dtype)
+
+    # --- gyro extras used by models ------------------------------------------
+
+    def gyromidpoint(self, x: jax.Array, w: jax.Array | None = None) -> jax.Array:
+        """Möbius gyromidpoint over the second-to-last axis with weights ``w``.
+
+        x: [..., n, d]; w: [..., n] (defaults to uniform). Used by hyperbolic
+        attention aggregation (reference kernel N7 semantics, Gulcehre 2019).
+        """
+        c = self._c(x.dtype)
+        lam = self.lambda_x(x)  # [..., n, 1]
+        if w is None:
+            w = jnp.ones(x.shape[:-1], x.dtype)
+        w = w[..., None]
+        num = jnp.sum(w * lam * x, axis=-2)
+        den = smath.clamp_min(
+            jnp.abs(jnp.sum(w * (lam - 1.0), axis=-2)), smath.eps_for(x.dtype)
+        )
+        return self.proj(self.mobius_scalar_mul(0.5, num / den))

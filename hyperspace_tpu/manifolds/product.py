@@ -1,0 +1,124 @@
+"""Cartesian product of manifolds with per-factor (learnable) curvature.
+
+Semantics per Gu et al. 2019 ("Learning mixed-curvature representations in
+products of model spaces") — the geometry behind reference workload 5
+(BASELINE.json configs[4]: hyperbolic × spherical × Euclidean embeddings with
+learned curvature, multi-host).
+
+Points are stored concatenated along the last axis; factor i occupies the
+slice ``[offset_i, offset_i + ambient_dim_i)``.  The factor manifolds are
+pytree children, so their curvature leaves are traced — a product manifold
+rebuilt each step from softplus-parameterized curvatures is differentiable
+end-to-end.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import smath
+from hyperspace_tpu.manifolds.base import Manifold
+
+
+@jax.tree_util.register_pytree_node_class
+class Product(Manifold):
+    name = "product"
+
+    def __init__(self, factors, dims):
+        """``factors``: manifold instances; ``dims``: ambient dim of each slice."""
+        if len(factors) != len(dims):
+            raise ValueError("factors and dims must have equal length")
+        self.factors = tuple(factors)
+        self.dims = tuple(int(d) for d in dims)
+        # plain-int prefix sums: __init__ re-runs on every tree_unflatten
+        # (i.e. inside every jit trace), so no device work allowed here
+        offs, acc = [], 0
+        for d in self.dims:
+            offs.append(acc)
+            acc += d
+        self.offsets = tuple(offs)
+        self.total_dim = acc
+
+    def tree_flatten(self):
+        return self.factors, self.dims
+
+    @classmethod
+    def tree_unflatten(cls, dims, factors):
+        return cls(factors, dims)
+
+    # --- slicing --------------------------------------------------------------
+
+    def split(self, x: jax.Array):
+        return [
+            jax.lax.slice_in_dim(x, o, o + d, axis=-1)
+            for o, d in zip(self.offsets, self.dims)
+        ]
+
+    def _join(self, parts):
+        return jnp.concatenate(parts, axis=-1)
+
+    def _map(self, fn_name: str, *arrays):
+        parts = [self.split(a) for a in arrays]
+        out = [
+            getattr(m, fn_name)(*[p[i] for p in parts])
+            for i, m in enumerate(self.factors)
+        ]
+        return self._join(out)
+
+    # --- Manifold interface ---------------------------------------------------
+
+    def proj(self, x):
+        return self._map("proj", x)
+
+    def proju(self, x, u):
+        return self._map("proju", x, u)
+
+    def expmap(self, x, v):
+        return self._map("expmap", x, v)
+
+    def logmap(self, x, y):
+        return self._map("logmap", x, y)
+
+    def ptransp(self, x, y, v):
+        return self._map("ptransp", x, y, v)
+
+    def egrad2rgrad(self, x, g):
+        return self._map("egrad2rgrad", x, g)
+
+    def sqdist(self, x, y):
+        xs, ys = self.split(x), self.split(y)
+        return sum(m.sqdist(xi, yi) for m, xi, yi in zip(self.factors, xs, ys))
+
+    def dist(self, x, y):
+        return smath.safe_sqrt(self.sqdist(x, y))
+
+    def inner(self, x, u, v, keepdims: bool = False):
+        xs, us, vs = self.split(x), self.split(u), self.split(v)
+        out = sum(
+            m.inner(xi, ui, vi, keepdims=True)
+            for m, xi, ui, vi in zip(self.factors, xs, us, vs)
+        )
+        return out if keepdims else out[..., 0]
+
+    def origin(self, shape, dtype=jnp.float32):
+        assert shape[-1] == self.total_dim, (shape, self.total_dim)
+        return self._join(
+            [
+                m.origin(shape[:-1] + (d,), dtype)
+                for m, d in zip(self.factors, self.dims)
+            ]
+        )
+
+    def check_point(self, x):
+        return sum(m.check_point(xi) for m, xi in zip(self.factors, self.split(x)))
+
+    def random_normal(self, key, shape, dtype=jnp.float32, std: float = 1.0):
+        assert shape[-1] == self.total_dim
+        keys = jax.random.split(key, len(self.factors))
+        return self._join(
+            [
+                m.random_normal(k, shape[:-1] + (d,), dtype, std)
+                for m, d, k in zip(self.factors, self.dims, keys)
+            ]
+        )

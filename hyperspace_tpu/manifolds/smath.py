@@ -1,0 +1,184 @@
+"""Numerically-stable scalar math shared by every manifold.
+
+TPUs have no float64, so the boundary behaviour of the hyperbolic functions
+(artanh near ±1, arcosh near 1, x/‖x‖ near 0) must be handled explicitly:
+every potentially-singular scalar op here has a clamped primal and a bounded
+gradient, so a jitted train step never emits NaN/Inf even in bf16
+(SURVEY.md §7 "hard parts #1").
+
+Conventions:
+- Hyperbolic manifolds carry a positive scalar ``c`` (curvature magnitude;
+  sectional curvature is ``-c``). Spherical manifolds also carry positive
+  ``c`` (sectional curvature ``+c``). ``c`` may be a traced JAX scalar, so
+  curvature can be learned (reference workload 5, BASELINE.json configs[4]).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# --- dtype-dependent epsilons -------------------------------------------------
+
+_MIN_NORM = 1e-15
+
+
+def eps_for(dtype) -> float:
+    """A general-purpose small epsilon for the given float dtype."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.float64:
+        return 1e-12
+    if dt == jnp.float32:
+        return 1e-7
+    return 1e-4  # bfloat16 / float16
+
+
+def ball_eps(dtype) -> float:
+    """Distance kept between a projected point and the ball boundary."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.float64:
+        return 1e-5
+    if dt == jnp.float32:
+        return 4e-3
+    return 1e-2
+
+
+def min_norm(dtype) -> float:
+    """Smallest norm used as a division guard."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.float64:
+        return _MIN_NORM
+    if dt == jnp.float32:
+        return 1e-12
+    return 1e-7
+
+
+# --- guarded elementary functions --------------------------------------------
+
+
+def clamp_min(x: jax.Array, m) -> jax.Array:
+    return jnp.maximum(x, m)
+
+
+@jax.custom_jvp
+def safe_sqrt(x: jax.Array) -> jax.Array:
+    """sqrt with a zero-clamped primal and a bounded gradient at 0."""
+    return jnp.sqrt(jnp.maximum(x, 0.0))
+
+
+@safe_sqrt.defjvp
+def _safe_sqrt_jvp(primals, tangents):
+    (x,), (t,) = primals, tangents
+    y = safe_sqrt(x)
+    denom = jnp.maximum(2.0 * y, 2.0 * jnp.sqrt(jnp.asarray(eps_for(y.dtype), y.dtype)))
+    return y, t / denom
+
+
+def sq_norm(x: jax.Array, keepdims: bool = True) -> jax.Array:
+    return jnp.sum(x * x, axis=-1, keepdims=keepdims)
+
+
+def safe_norm(x: jax.Array, keepdims: bool = True) -> jax.Array:
+    """L2 norm over the last axis; gradient is finite at x = 0."""
+    return safe_sqrt(sq_norm(x, keepdims=keepdims))
+
+
+def _artanh_eps(dtype) -> float:
+    # A few ulps below 1: tight enough not to distort representable
+    # distances, loose enough to bound the gradient at the boundary.
+    dt = jnp.dtype(dtype)
+    if dt == jnp.float64:
+        return 1e-12
+    if dt == jnp.float32:
+        return 3e-7
+    return 1e-2
+
+
+def artanh(x: jax.Array) -> jax.Array:
+    """arctanh with the argument clamped into the open interval (-1, 1).
+
+    The clamp bounds the gradient instead of letting it diverge at the
+    boundary — the dominant failure mode of Poincaré math in float32.
+    """
+    e = _artanh_eps(x.dtype)
+    return jnp.arctanh(jnp.clip(x, -1.0 + e, 1.0 - e))
+
+
+def arcosh1p(u: jax.Array) -> jax.Array:
+    """arcosh(1 + u) for u >= 0, numerically stable near u = 0.
+
+    arcosh(1+u) = log1p(u + sqrt(u (u + 2))).  Using ``safe_sqrt`` keeps the
+    gradient finite at u = 0 (coincident points in the Lorentz distance).
+    """
+    u = jnp.maximum(u, 0.0)
+    return jnp.log1p(u + safe_sqrt(u * (u + 2.0)))
+
+
+def arccos_safe(x: jax.Array) -> jax.Array:
+    """arccos clamped into the open interval so the gradient stays bounded."""
+    e = _artanh_eps(x.dtype)
+    return jnp.arccos(jnp.clip(x, -1.0 + e, 1.0 - e))
+
+
+def arcsin_safe(x: jax.Array) -> jax.Array:
+    """arcsin clamped into the open interval so the gradient stays bounded."""
+    e = _artanh_eps(x.dtype)
+    return jnp.arcsin(jnp.clip(x, -1.0 + e, 1.0 - e))
+
+
+def exp_arg_max(dtype) -> float:
+    """Largest |t| fed to cosh/sinh (results must survive a later square)."""
+    return 350.0 if jnp.dtype(dtype) == jnp.float64 else 40.0
+
+
+def safe_tanh(x: jax.Array) -> jax.Array:
+    """tanh with the argument clipped to ±20.
+
+    tanh saturates to 1 within 4e-18 by |x|=20, and this XLA build's f64 tanh
+    returns NaN for large arguments (observed: tanh(124.)→nan), so the clip is
+    both an accuracy no-op and a hard NaN guard.
+    """
+    return jnp.tanh(jnp.clip(x, -20.0, 20.0))
+
+
+def safe_cosh(x: jax.Array) -> jax.Array:
+    m = exp_arg_max(x.dtype)
+    return jnp.cosh(jnp.clip(x, -m, m))
+
+
+def safe_sinh(x: jax.Array) -> jax.Array:
+    m = exp_arg_max(x.dtype)
+    return jnp.sinh(jnp.clip(x, -m, m))
+
+
+def sinhc(x: jax.Array) -> jax.Array:
+    """sinh(x)/x, smooth at x = 0 (Taylor branch below a dtype threshold)."""
+    small = jnp.abs(x) < 1e-3
+    xs = jnp.where(small, jnp.ones_like(x), x)  # double-where: keep grads NaN-free
+    return jnp.where(small, 1.0 + x * x / 6.0, safe_sinh(xs) / jnp.clip(xs, -exp_arg_max(x.dtype), exp_arg_max(x.dtype)))
+
+
+def sinc_(x: jax.Array) -> jax.Array:
+    """sin(x)/x, smooth at x = 0."""
+    small = jnp.abs(x) < 1e-3
+    xs = jnp.where(small, jnp.ones_like(x), x)
+    return jnp.where(small, 1.0 - x * x / 6.0, jnp.sin(xs) / xs)
+
+
+def tanc(x: jax.Array) -> jax.Array:
+    """tanh(x)/x, smooth at x = 0."""
+    small = jnp.abs(x) < 1e-3
+    xs = jnp.where(small, jnp.ones_like(x), x)
+    return jnp.where(small, 1.0 - x * x / 3.0, safe_tanh(xs) / xs)
+
+
+def artanc(x: jax.Array) -> jax.Array:
+    """artanh(x)/x, smooth at x = 0 (x clamped inside (-1, 1))."""
+    small = jnp.abs(x) < 1e-3
+    xs = jnp.where(small, jnp.ones_like(x), x)
+    return jnp.where(small, 1.0 + x * x / 3.0, artanh(xs) / xs)
+
+
+def sqrt_c(c) -> jax.Array:
+    """sqrt of a (possibly traced) positive curvature magnitude."""
+    return safe_sqrt(jnp.asarray(c))

@@ -1,0 +1,95 @@
+"""Sphere of curvature +c (c > 0): radius-1/√c sphere embedded in R^d.
+
+Needed for the mixed-curvature product spaces of reference workload 5
+(Gu et al. 2019; BASELINE.json configs[4]).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import smath
+from hyperspace_tpu.manifolds.base import Manifold
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Sphere(Manifold):
+    c: Any = 1.0
+    name = "sphere"
+
+    def tree_flatten(self):
+        return (self.c,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def _c(self, dtype) -> jax.Array:
+        return jnp.asarray(self.c, dtype)
+
+    def proj(self, x: jax.Array) -> jax.Array:
+        c = self._c(x.dtype)
+        r = 1.0 / smath.clamp_min(smath.sqrt_c(c), smath.min_norm(x.dtype))
+        n = smath.clamp_min(smath.safe_norm(x), smath.min_norm(x.dtype))
+        return x / n * r
+
+    def proju(self, x: jax.Array, u: jax.Array) -> jax.Array:
+        c = self._c(x.dtype)
+        return u - c * jnp.sum(x * u, axis=-1, keepdims=True) * x
+
+    def check_point(self, x: jax.Array) -> jax.Array:
+        c = self._c(x.dtype)
+        return jnp.abs(c * smath.sq_norm(x, keepdims=False) - 1.0)
+
+    def dist(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        # Chord form 2/√c·arcsin(√c‖x−y‖/2): exact at coincident points,
+        # unlike arccos(c⟨x,y⟩) whose clamp floors the distance at ~1e-3.
+        c = self._c(x.dtype)
+        sc = smath.sqrt_c(c)
+        chord = smath.safe_norm(x - y, keepdims=False)
+        return 2.0 / sc * smath.arcsin_safe(sc * chord / 2.0)
+
+    def sqdist(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        return self.dist(x, y) ** 2
+
+    def expmap(self, x: jax.Array, v: jax.Array) -> jax.Array:
+        c = self._c(x.dtype)
+        sc = smath.sqrt_c(c)
+        vn = smath.safe_norm(v)
+        t = sc * vn
+        return self.proj(jnp.cos(t) * x + smath.sinc_(t) * v)
+
+    def logmap(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        w = self.proju(x, y - x)
+        wn = smath.clamp_min(smath.safe_norm(w), smath.min_norm(x.dtype))
+        d = self.dist(x, y)[..., None]
+        return d * w / wn
+
+    def inner(self, x: jax.Array, u: jax.Array, v: jax.Array, keepdims: bool = False) -> jax.Array:
+        out = jnp.sum(u * v, axis=-1, keepdims=True)
+        return out if keepdims else out[..., 0]
+
+    def ptransp(self, x: jax.Array, y: jax.Array, v: jax.Array) -> jax.Array:
+        """Transport along the geodesic x→y (Gram-Schmidt form)."""
+        logxy = self.logmap(x, y)
+        logyx = self.logmap(y, x)
+        d2 = smath.clamp_min(self.sqdist(x, y)[..., None], smath.eps_for(x.dtype))
+        return v - jnp.sum(logxy * v, axis=-1, keepdims=True) / d2 * (logxy + logyx)
+
+    def egrad2rgrad(self, x: jax.Array, g: jax.Array) -> jax.Array:
+        return self.proju(x, g)
+
+    def origin(self, shape, dtype=jnp.float32) -> jax.Array:
+        c = self._c(dtype)
+        o = jnp.zeros(shape, dtype)
+        return o.at[..., 0].set(1.0 / smath.sqrt_c(c))
+
+    def random_normal(self, key: jax.Array, shape, dtype=jnp.float32, std: float = 1.0) -> jax.Array:
+        v = std * jax.random.normal(key, shape, dtype)
+        o = self.origin(v.shape, dtype)
+        return self.proj(self.expmap(o, self.proju(o, v)))

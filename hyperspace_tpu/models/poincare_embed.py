@@ -1,0 +1,177 @@
+"""Poincaré embeddings (Nickel & Kiela 2017) — reference workload 1.
+
+An embedding table on the curvature-c ball, trained so that ancestors are
+close to their descendants: for a positive pair (u, v) and k sampled
+negatives n₁..n_k,
+
+    loss = -log [ exp(-d(u,v)) / (exp(-d(u,v)) + Σ exp(-d(u,nᵢ))) ].
+
+Everything per-step — negative sampling, gather, distance matrix, loss,
+gradient, Riemannian update — is one XLA program (the BASELINE.json single
+compiled-train-step requirement).  Negatives are drawn on device with
+``jax.random`` so the host feeds only the static closure array once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from hyperspace_tpu.manifolds import PoincareBall
+from hyperspace_tpu.optim.rsgd import riemannian_sgd
+
+
+@dataclasses.dataclass(frozen=True)
+class PoincareEmbedConfig:
+    num_nodes: int = 0
+    dim: int = 10  # BASELINE.json configs[0]: 10-dim ball
+    c: float = 1.0
+    lr: float = 0.3
+    neg_samples: int = 10
+    batch_size: int = 512
+    burnin_steps: int = 100
+    burnin_factor: float = 0.01
+    init_scale: float = 1e-3
+    dtype: Any = jnp.float32
+
+
+class TrainState(NamedTuple):
+    table: jax.Array  # [N, d] points on the ball
+    opt_state: Any
+    key: jax.Array
+    step: jax.Array
+
+
+def init_table(cfg: PoincareEmbedConfig, key: jax.Array) -> jax.Array:
+    """Uniform init in a tiny ball around the origin (N&K 2017 init)."""
+    u = jax.random.uniform(
+        key, (cfg.num_nodes, cfg.dim), cfg.dtype, -cfg.init_scale, cfg.init_scale
+    )
+    return u
+
+
+def make_optimizer(cfg: PoincareEmbedConfig):
+    ball = PoincareBall(cfg.c)
+    return riemannian_sgd(
+        cfg.lr,
+        tags=ball,  # single-leaf param tree: the whole table is on the ball
+        burnin_steps=cfg.burnin_steps,
+        burnin_factor=cfg.burnin_factor,
+    )
+
+
+def loss_fn(
+    table: jax.Array,
+    u_idx: jax.Array,
+    v_idx: jax.Array,
+    neg_idx: jax.Array,
+    c,
+) -> jax.Array:
+    """Batch loss. u_idx, v_idx: [B]; neg_idx: [B, K]."""
+    ball = PoincareBall(c)
+    u = table[u_idx]  # [B, d]
+    cand = jnp.concatenate([v_idx[:, None], neg_idx], axis=1)  # [B, 1+K]
+    cv = table[cand]  # [B, 1+K, d]
+    d = ball.dist(u[:, None, :], cv)  # [B, 1+K]
+    logits = -d
+    # Mask sampled negatives that collide with the positive v or the query u
+    # itself — otherwise ~K/N of rows get a log(2) loss floor and a gradient
+    # pushing the true ancestor away. (Collisions with *other* ancestors of u
+    # remain, as in standard on-the-fly sampled-softmax training.)
+    collide = (neg_idx == v_idx[:, None]) | (neg_idx == u_idx[:, None])
+    mask = jnp.concatenate([jnp.zeros_like(v_idx[:, None], bool), collide], axis=1)
+    logits = jnp.where(mask, -jnp.inf, logits)
+    # -log softmax(-d)[0]
+    return jnp.mean(jax.nn.logsumexp(logits, axis=1) - logits[:, 0])
+
+
+@partial(jax.jit, static_argnames=("cfg", "opt"), donate_argnames=("state",))
+def train_step(
+    cfg: PoincareEmbedConfig,
+    opt,
+    state: TrainState,
+    pairs: jax.Array,  # [P, 2] the full closure, resident on device
+) -> tuple[TrainState, jax.Array]:
+    key, k_batch, k_neg = jax.random.split(state.key, 3)
+    num_pairs = pairs.shape[0]
+    rows = jax.random.randint(k_batch, (cfg.batch_size,), 0, num_pairs)
+    batch = pairs[rows]  # [B, 2]
+    u_idx, v_idx = batch[:, 0], batch[:, 1]
+    neg_idx = jax.random.randint(
+        k_neg, (cfg.batch_size, cfg.neg_samples), 0, cfg.num_nodes
+    )
+    loss, grads = jax.value_and_grad(loss_fn)(state.table, u_idx, v_idx, neg_idx, cfg.c)
+    updates, opt_state = opt.update(grads, state.opt_state, state.table)
+    table = optax.apply_updates(state.table, updates)
+    return TrainState(table, opt_state, key, state.step + 1), loss
+
+
+def init_state(cfg: PoincareEmbedConfig, seed: int = 0) -> tuple[TrainState, optax.GradientTransformation]:
+    """Build the initial state *and* its matching optimizer.
+
+    Returned together so opt_state and the transformation can never be
+    constructed from diverging configs.
+    """
+    key = jax.random.PRNGKey(seed)
+    k_init, key = jax.random.split(key)
+    table = init_table(cfg, k_init)
+    opt = make_optimizer(cfg)
+    return TrainState(table, opt.init(table), key, jnp.zeros((), jnp.int32)), opt
+
+
+# --- evaluation: MAP and mean rank over the closure (SURVEY.md §3.5) ----------
+
+
+@jax.jit
+def _rank_chunk(table: jax.Array, u_idx: jax.Array, v_idx: jax.Array, c):
+    """For each pair (u, v): rank of v among all nodes by distance from u."""
+    ball = PoincareBall(c)
+    u = table[u_idx]  # [B, d]
+    d_all = ball.dist(u[:, None, :], table[None, :, :])  # [B, N]
+    d_pos = jnp.take_along_axis(d_all, v_idx[:, None], axis=1)  # [B, 1]
+    # rank = #nodes strictly closer than v (excluding u itself and v)
+    closer = (d_all < d_pos).astype(jnp.int32)
+    closer = closer.at[jnp.arange(u_idx.shape[0]), u_idx].set(0)
+    closer = closer.at[jnp.arange(u_idx.shape[0]), v_idx].set(0)
+    return jnp.sum(closer, axis=1) + 1  # 1-based rank
+
+
+def evaluate(table: jax.Array, pairs, c, batch: int = 1024) -> dict:
+    """Mean rank and MAP of ground-truth ancestors, ranking all N nodes.
+
+    Chunked distance matrix (SURVEY.md §3.5) — N×B blocks stream through the
+    device; nothing materializes N×N.
+    """
+    import numpy as np
+
+    pairs = np.asarray(pairs)
+    ranks = []
+    for s in range(0, len(pairs), batch):
+        chunk_pairs = pairs[s : s + batch]
+        r = _rank_chunk(
+            table, jnp.asarray(chunk_pairs[:, 0]), jnp.asarray(chunk_pairs[:, 1]), c
+        )
+        ranks.append(np.asarray(r))
+    ranks = np.concatenate(ranks)
+
+    # N&K protocol: rank each ancestor v against *non-ancestor* nodes only
+    # ("filtered"): sorting u's unfiltered ranks, the i-th has exactly i other
+    # positives above it, so its filtered rank is r_i - i and the precision at
+    # its position is (i+1)/r_i.
+    by_u: dict[int, list[int]] = {}
+    for (u, v), r in zip(pairs, ranks):
+        by_u.setdefault(int(u), []).append(int(r))
+    aps, filtered_ranks = [], []
+    for u, rs in by_u.items():
+        rs = sorted(rs)
+        aps.append(np.mean([(i + 1) / max(r, i + 1) for i, r in enumerate(rs)]))
+        filtered_ranks.extend(max(r - i, 1) for i, r in enumerate(rs))
+    return {
+        "mean_rank": float(np.mean(filtered_ranks)),
+        "map": float(np.mean(aps)),
+    }

@@ -1,0 +1,89 @@
+"""Riemannian SGD as an optax-compatible gradient transformation.
+
+Semantics per Bonnabel 2013 / Nickel & Kiela 2017 (SURVEY.md §2): the
+Euclidean gradient is rescaled by the inverse metric (``egrad2rgrad``), the
+step is taken with the exponential map (or a cheap first-order retraction),
+and the point is re-projected.  This runs entirely inside one jitted train
+step — the BASELINE.json requirement "Riemannian SGD ... runnable as a
+single XLA-compiled train step".
+
+optax compatibility trick: the transform computes the *new point on the
+manifold* internally and emits ``new_point - old_point`` as the update, so
+``optax.apply_updates`` (a plain add) reconstructs it exactly.  Chaining with
+schedules works via the ``learning_rate`` schedule argument.
+
+Sparse embedding batches (SURVEY.md §7 hard-part #2): JAX autodiff of a
+gather produces a scatter-add cotangent — rows outside the batch carry a zero
+Euclidean gradient, get a zero tangent, and ``expmap(x, 0) = x`` leaves them
+bit-identical.  Duplicate rows in a batch sum their cotangents *before* the
+metric rescale, i.e. tangents combine at the same base point, which is the
+correct Riemannian accumulation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from hyperspace_tpu.optim.tags import map_tagged
+
+ScalarOrSchedule = Union[float, Callable[[jax.Array], jax.Array]]
+
+
+class RSGDState(NamedTuple):
+    count: jax.Array
+
+
+def _lr_at(learning_rate: ScalarOrSchedule, count: jax.Array) -> jax.Array:
+    if callable(learning_rate):
+        return learning_rate(count)
+    return jnp.asarray(learning_rate)
+
+
+def riemannian_sgd(
+    learning_rate: ScalarOrSchedule,
+    tags: Any,
+    *,
+    use_expmap: bool = True,
+    burnin_steps: int = 0,
+    burnin_factor: float = 0.1,
+) -> optax.GradientTransformation:
+    """Riemannian SGD.
+
+    Args:
+      learning_rate: scalar or optax schedule.
+      tags: pytree matching the params; leaves are Manifold or None.
+      use_expmap: exact exponential-map update if True, else retraction.
+      burnin_steps / burnin_factor: Nickel & Kiela 2017 burn-in — the first
+        ``burnin_steps`` use ``lr * burnin_factor`` (angular layout settles
+        before radii grow).
+    """
+
+    def init_fn(params):
+        del params
+        return RSGDState(count=jnp.zeros((), jnp.int32))
+
+    def update_fn(grads, state, params):
+        if params is None:
+            raise ValueError("riemannian_sgd requires params")
+        lr = _lr_at(learning_rate, state.count)
+        if burnin_steps > 0:
+            lr = jnp.where(state.count < burnin_steps, lr * burnin_factor, lr)
+
+        def one(tag, g, p):
+            if tag is None:
+                return -lr * g
+            rg = tag.egrad2rgrad(p, g)
+            step = -lr * rg
+            # expmap/retr already end in proj() on every manifold — one
+            # projection site, no re-projection here.
+            new_p = tag.expmap(p, step) if use_expmap else tag.retr(p, step)
+            return new_p - p
+
+        updates = map_tagged(one, tags, grads, params)
+        return updates, RSGDState(count=state.count + 1)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,49 @@
+"""Manifold tagging for parameter pytrees.
+
+The reference framework marks manifold-valued tensors so one optimizer can
+handle mixed Euclidean/manifold parameter sets (geoopt's ManifoldParameter
+pattern; SURVEY.md §2 "ManifoldParam tagging").  Here a *tag tree* is a
+pytree with the same structure as the params whose leaves are either a
+``Manifold`` instance or ``None`` (= Euclidean).  Tag trees ride through
+``jax.jit`` because manifolds are pytrees themselves.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+from hyperspace_tpu.manifolds.base import Manifold
+
+
+def is_tag(x: Any) -> bool:
+    return x is None or isinstance(x, Manifold)
+
+
+def map_tagged(fn: Callable, tags, *trees):
+    """tree_map over (tag, *leaves) treating each manifold tag as one leaf.
+
+    ``fn(tag, *leaves)`` is called per parameter leaf; ``tag`` is a Manifold
+    or None.
+    """
+    return jax.tree_util.tree_map(fn, tags, *trees, is_leaf=is_tag)
+
+
+def tags_from_paths(params, rule: Callable[[tuple], Any]):
+    """Build a tag tree from a path-based rule.
+
+    ``rule`` receives the jax key-path tuple of each leaf and returns a
+    Manifold or None.  This is how flax models declare which of their params
+    live on a manifold (path/name-based, no special parameter class needed).
+    """
+    return jax.tree_util.tree_map_with_path(lambda p, _: rule(p), params)
+
+
+def path_contains(path, name: str) -> bool:
+    """True if any path entry (DictKey/GetAttrKey/...) matches ``name``."""
+    for entry in path:
+        key = getattr(entry, "key", getattr(entry, "name", None))
+        if key == name:
+            return True
+    return False

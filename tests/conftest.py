@@ -1,0 +1,25 @@
+"""Test configuration: CPU backend with 8 virtual devices (SURVEY.md §4.6).
+
+Tests never require TPU hardware: manifold math runs in float64 on CPU,
+Pallas kernels run in interpret mode, and distributed code runs on the
+8 fake CPU devices created here.  Must run before the first jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,136 @@
+"""Manifold axiom tests (SURVEY.md §4.1): property checks in float64.
+
+Each geometry must satisfy, on random batches of points/tangents:
+exp∘log = id, symmetry of distance, triangle inequality, metric preservation
+under parallel transport, and the on-manifold constraint after every op.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.manifolds import (
+    Euclidean,
+    Lorentz,
+    PoincareBall,
+    Product,
+    Sphere,
+)
+
+B, D = 64, 8
+CURVS = [0.5, 1.0, 2.3]
+
+
+def make_points(man, key, n=B, d=D, std=0.7):
+    dim = man.ambient_dim(d) if man.name == "lorentz" else d
+    if man.name == "product":
+        dim = man.total_dim
+    return man.random_normal(key, (n, dim), jnp.float64, std=std)
+
+
+def make_tangent(man, key, x, scale=0.5):
+    # logmap to a second random point gives a tangent whose *Riemannian* norm
+    # is a typical inter-point distance — bounded on every geometry, unlike a
+    # raw ambient Gaussian (whose metric norm explodes near the ball boundary).
+    y = make_points(man, key, n=x.shape[0])
+    return scale * man.logmap(x, y)
+
+
+def manifolds():
+    out = []
+    for c in CURVS:
+        out.append(PoincareBall(c))
+        out.append(Lorentz(c))
+        out.append(Sphere(c))
+    out.append(Euclidean())
+    out.append(
+        Product([PoincareBall(1.0), Sphere(1.0), Euclidean()], [4, 4, 4])
+    )
+    return out
+
+
+@pytest.mark.parametrize("man", manifolds(), ids=lambda m: f"{m.name}-{getattr(m, 'c', '')}")
+class TestAxioms:
+    def _xyv(self, man):
+        k = jax.random.split(jax.random.PRNGKey(7), 4)
+        x = make_points(man, k[0])
+        y = make_points(man, k[1])
+        v = make_tangent(man, k[2], x)
+        return x, y, v
+
+    def test_on_manifold(self, man):
+        x, y, v = self._xyv(man)
+        np.testing.assert_allclose(man.check_point(x), 0.0, atol=1e-8)
+        np.testing.assert_allclose(man.check_point(man.expmap(x, v)), 0.0, atol=1e-7)
+
+    def test_exp_log_inverse(self, man):
+        x, y, _ = self._xyv(man)
+        y2 = man.expmap(x, man.logmap(x, y))
+        # atol 2e-5: near-boundary ball points lose ~2 digits to artanh's
+        # conditioning even in f64; this is inherent, not an implementation bug.
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y), atol=2e-5)
+
+    def test_log_exp_inverse(self, man):
+        x, _, v = self._xyv(man)
+        v2 = man.logmap(x, man.expmap(x, v))
+        np.testing.assert_allclose(np.asarray(v2), np.asarray(v), atol=1e-6)
+
+    def test_dist_symmetric_and_zero(self, man):
+        x, y, _ = self._xyv(man)
+        np.testing.assert_allclose(
+            np.asarray(man.dist(x, y)), np.asarray(man.dist(y, x)), atol=1e-8
+        )
+        assert np.all(np.asarray(man.dist(x, x)) < 1e-6)
+        assert np.all(np.asarray(man.dist(x, y)) >= 0.0)
+
+    def test_triangle_inequality(self, man):
+        k = jax.random.split(jax.random.PRNGKey(11), 3)
+        x, y, z = (make_points(man, kk) for kk in k)
+        dxz = np.asarray(man.dist(x, z))
+        dxy = np.asarray(man.dist(x, y))
+        dyz = np.asarray(man.dist(y, z))
+        assert np.all(dxz <= dxy + dyz + 1e-7)
+
+    def test_dist_matches_norm_of_log(self, man):
+        x, y, _ = self._xyv(man)
+        d = np.asarray(man.dist(x, y))
+        nl = np.asarray(man.norm_t(x, man.logmap(x, y)))
+        np.testing.assert_allclose(nl, d, atol=1e-6)
+
+    def test_ptransp_preserves_inner(self, man):
+        x, y, v = self._xyv(man)
+        k = jax.random.PRNGKey(13)
+        w = make_tangent(man, k, x)
+        ip_x = np.asarray(man.inner(x, v, w))
+        vt = man.ptransp(x, y, v)
+        wt = man.ptransp(x, y, w)
+        ip_y = np.asarray(man.inner(y, vt, wt))
+        np.testing.assert_allclose(ip_y, ip_x, rtol=1e-5, atol=1e-7)
+
+    def test_ptransp_lands_in_tangent(self, man):
+        if man.name in ("poincare", "euclidean", "product"):
+            pytest.skip("tangent space is the full ambient space")
+        x, y, v = self._xyv(man)
+        vt = man.ptransp(x, y, v)
+        # residual of the tangency constraint at y
+        res = np.asarray(man.inner(y, vt, vt) - man.inner(y, man.proju(y, vt), man.proju(y, vt)))
+        np.testing.assert_allclose(res, 0.0, atol=1e-7)
+
+    def test_expmap0_logmap0_roundtrip(self, man):
+        _, y, _ = self._xyv(man)
+        y2 = man.expmap0(man.logmap0(y))
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y), atol=1e-6)
+
+    def test_jit_and_grad_clean(self, man):
+        x, y, _ = self._xyv(man)
+
+        @jax.jit
+        def loss(x, y):
+            return jnp.sum(man.sqdist(x, y))
+
+        g = jax.grad(loss)(x, y)
+        assert np.all(np.isfinite(np.asarray(g)))
+        # gradient at coincident points must be finite (degenerate case §4.2)
+        g2 = jax.grad(loss)(x, x)
+        assert np.all(np.isfinite(np.asarray(g2)))

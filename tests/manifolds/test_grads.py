@@ -1,0 +1,107 @@
+"""Autodiff checks vs float64 finite differences (SURVEY.md §4.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.manifolds import Lorentz, PoincareBall, Sphere
+
+
+def fd_grad(f, x, eps=1e-6):
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp, xm = x.copy(), x.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        g[i] = (f(jnp.asarray(xp)) - f(jnp.asarray(xm))) / (2 * eps)
+        it.iternext()
+    return g
+
+
+@pytest.mark.parametrize("man", [PoincareBall(1.3), Lorentz(0.8), Sphere(1.0)], ids=lambda m: m.name)
+def test_dist_grad_matches_fd(man):
+    k = jax.random.split(jax.random.PRNGKey(0), 2)
+    x = man.random_normal(k[0], (3, 4), jnp.float64, std=0.5)
+    y = man.random_normal(k[1], (3, 4), jnp.float64, std=0.5)
+
+    def f(x_):
+        if man.name in ("lorentz", "sphere"):
+            x_ = man.proj(x_)  # constrain FD perturbations back to the manifold
+        return float(jnp.sum(man.sqdist(x_, y)))
+
+    def f_jax(x_):
+        if man.name in ("lorentz", "sphere"):
+            x_ = man.proj(x_)
+        return jnp.sum(man.sqdist(x_, y))
+
+    g = np.asarray(jax.grad(f_jax)(x))
+    g_fd = fd_grad(f, x)
+    np.testing.assert_allclose(g, g_fd, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("man", [PoincareBall(1.0), Lorentz(1.0)], ids=lambda m: m.name)
+def test_expmap_grad_matches_fd(man):
+    k = jax.random.split(jax.random.PRNGKey(1), 2)
+    x = man.random_normal(k[0], (2, 3), jnp.float64, std=0.4)
+    v = man.proju(x, 0.3 * jax.random.normal(k[1], x.shape, x.dtype))
+    w = jax.random.normal(jax.random.PRNGKey(2), x.shape, x.dtype)
+
+    def f(v_):
+        if man.name == "lorentz":
+            v_ = man.proju(x, v_)
+        return float(jnp.sum(w * man.expmap(x, v_)))
+
+    def f_jax(v_):
+        if man.name == "lorentz":
+            v_ = man.proju(x, v_)
+        return jnp.sum(w * man.expmap(x, v_))
+
+    g = np.asarray(jax.grad(f_jax)(v))
+    g_fd = fd_grad(f, v)
+    np.testing.assert_allclose(g, g_fd, rtol=1e-4, atol=1e-6)
+
+
+def test_no_nan_at_degenerate_points():
+    """Gradients at the origin / coincident points / near boundary are finite."""
+    ball = PoincareBall(1.0)
+    zero = jnp.zeros((2, 3), jnp.float64)
+
+    for fn in (
+        lambda x: jnp.sum(ball.expmap0(x)),
+        lambda x: jnp.sum(ball.logmap0(x)),
+        lambda x: jnp.sum(ball.dist0(x)),
+        lambda x: jnp.sum(ball.mobius_scalar_mul(2.0, x)),
+    ):
+        g = jax.grad(fn)(zero)
+        assert np.all(np.isfinite(np.asarray(g))), fn
+
+    lor = Lorentz(1.0)
+    o = lor.origin((2, 4), jnp.float64)
+    g = jax.grad(lambda x: jnp.sum(lor.sqdist(lor.proj(x), o)))(o)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_curvature_is_differentiable():
+    """d/dc of a distance must exist and be finite (learned curvature)."""
+
+    def loss(c):
+        ball = PoincareBall(c)
+        x = jnp.array([[0.1, 0.2]], jnp.float64)
+        y = jnp.array([[-0.3, 0.05]], jnp.float64)
+        return jnp.sum(ball.dist(x, y))
+
+    g = jax.grad(loss)(jnp.asarray(1.0, jnp.float64))
+    assert np.isfinite(float(g)) and abs(float(g)) > 0
+
+    def loss_l(c):
+        lor = Lorentz(c)
+        o = lor.origin((1, 3), jnp.float64)
+        y = lor.expmap(o, jnp.array([[0.0, 0.5, 0.1]], jnp.float64))
+        return jnp.sum(lor.dist(o, y))
+
+    g = jax.grad(loss_l)(jnp.asarray(1.0, jnp.float64))
+    assert np.isfinite(float(g))

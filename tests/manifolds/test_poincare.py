@@ -1,0 +1,141 @@
+"""Poincaré-ball specifics: gyro identities, golden values, Möbius ops
+(SURVEY.md §4.1, §4.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.manifolds import PoincareBall
+
+
+@pytest.fixture(params=[0.7, 1.0, 1.8])
+def ball(request):
+    return PoincareBall(request.param)
+
+
+def pts(ball, key, n=32, d=6, std=0.8):
+    return ball.random_normal(key, (n, d), jnp.float64, std=std)
+
+
+def test_mobius_left_identity(ball):
+    x = pts(ball, jax.random.PRNGKey(0))
+    z = jnp.zeros_like(x)
+    np.testing.assert_allclose(np.asarray(ball.mobius_add(z, x)), np.asarray(x), atol=1e-10)
+    np.testing.assert_allclose(np.asarray(ball.mobius_add(x, z)), np.asarray(x), atol=1e-10)
+
+
+def test_mobius_left_inverse(ball):
+    x = pts(ball, jax.random.PRNGKey(1))
+    np.testing.assert_allclose(
+        np.asarray(ball.mobius_add(-x, x)), 0.0, atol=1e-8
+    )
+
+
+def test_gyration_closed_form_matches_definition(ball):
+    """gyr[u,v]w == -(u⊕v) ⊕ (u ⊕ (v ⊕ w))."""
+    k = jax.random.split(jax.random.PRNGKey(2), 3)
+    u, v, w = (pts(ball, kk, std=0.5) for kk in k)
+    lhs = ball.gyration(u, v, w)
+    rhs = ball.mobius_add(
+        -ball.mobius_add(u, v), ball.mobius_add(u, ball.mobius_add(v, w))
+    )
+    np.testing.assert_allclose(np.asarray(lhs), np.asarray(rhs), atol=1e-7)
+
+
+def test_gyro_associative_law(ball):
+    """u ⊕ (v ⊕ w) == (u ⊕ v) ⊕ gyr[u,v]w (left gyroassociativity)."""
+    k = jax.random.split(jax.random.PRNGKey(3), 3)
+    u, v, w = (pts(ball, kk, std=0.5) for kk in k)
+    lhs = ball.mobius_add(u, ball.mobius_add(v, w))
+    rhs = ball.mobius_add(ball.mobius_add(u, v), ball.gyration(u, v, w))
+    np.testing.assert_allclose(np.asarray(lhs), np.asarray(rhs), atol=1e-7)
+
+
+def test_scalar_mul_distributes(ball):
+    x = pts(ball, jax.random.PRNGKey(4))
+    lhs = ball.mobius_scalar_mul(3.0, x)
+    rhs = ball.mobius_add(x, ball.mobius_add(x, x))
+    np.testing.assert_allclose(np.asarray(lhs), np.asarray(rhs), atol=1e-7)
+    # (r1 r2) ⊗ x = r1 ⊗ (r2 ⊗ x)
+    lhs = ball.mobius_scalar_mul(0.75, x)
+    rhs = ball.mobius_scalar_mul(1.5, ball.mobius_scalar_mul(0.5, x))
+    np.testing.assert_allclose(np.asarray(lhs), np.asarray(rhs), atol=1e-9)
+
+
+def test_matvec_identity_and_compose(ball):
+    x = pts(ball, jax.random.PRNGKey(5))
+    d = x.shape[-1]
+    eye = jnp.eye(d, dtype=x.dtype)
+    np.testing.assert_allclose(
+        np.asarray(ball.mobius_matvec(eye, x)), np.asarray(x), atol=1e-9
+    )
+    # r·I as matvec == scalar mul
+    np.testing.assert_allclose(
+        np.asarray(ball.mobius_matvec(0.3 * eye, x)),
+        np.asarray(ball.mobius_scalar_mul(0.3, x)),
+        atol=1e-9,
+    )
+
+
+def test_dist_golden_1d():
+    """Golden value: c=1, x=0, y=0.5 ⇒ d = 2·artanh(0.5) = 1.0986122886681098."""
+    ball = PoincareBall(1.0)
+    x = jnp.zeros((1, 1), jnp.float64)
+    y = jnp.full((1, 1), 0.5, jnp.float64)
+    np.testing.assert_allclose(
+        np.asarray(ball.dist(x, y))[0], 2.0 * np.arctanh(0.5), rtol=1e-12
+    )
+
+
+def test_dist_golden_curvature_scaling():
+    """d_c(x,y) = d_1(√c x, √c y)/√c (homothety invariance)."""
+    c = 2.3
+    b1, bc = PoincareBall(1.0), PoincareBall(c)
+    k = jax.random.split(jax.random.PRNGKey(6), 2)
+    x = b1.random_normal(k[0], (16, 5), jnp.float64, std=0.6) / np.sqrt(c)
+    y = b1.random_normal(k[1], (16, 5), jnp.float64, std=0.6) / np.sqrt(c)
+    np.testing.assert_allclose(
+        np.asarray(bc.dist(x, y)),
+        np.asarray(b1.dist(np.sqrt(c) * x, np.sqrt(c) * y)) / np.sqrt(c),
+        rtol=1e-9,
+    )
+
+
+def test_expmap_golden_radial():
+    """c=1: exp_0(v) = tanh(‖v‖) v/‖v‖."""
+    ball = PoincareBall(1.0)
+    v = jnp.array([[0.3, 0.4]], jnp.float64)
+    out = np.asarray(ball.expmap0(v))
+    n = 0.5
+    expect = np.tanh(n) * np.array([[0.3, 0.4]]) / n
+    np.testing.assert_allclose(out, expect, rtol=1e-10)
+
+
+def test_project_keeps_interior(ball):
+    x = jnp.full((4, 3), 10.0, jnp.float64)
+    p = np.asarray(ball.proj(x))
+    c = float(ball.c)
+    assert np.all(np.sum(p * p, -1) * c < 1.0)
+
+
+def test_grad_near_boundary_finite(ball):
+    c = float(ball.c)
+    r = (1.0 - 1e-9) / np.sqrt(c)
+    x = jnp.array([[r / np.sqrt(3.0)] * 3], jnp.float64)
+
+    def f(x):
+        return jnp.sum(ball.dist0(x))
+
+    g = jax.grad(f)(x)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_gyromidpoint_of_pair_is_on_geodesic_midpoint(ball):
+    k = jax.random.split(jax.random.PRNGKey(8), 2)
+    x = pts(ball, k[0], n=8)
+    y = pts(ball, k[1], n=8)
+    mid = ball.gyromidpoint(jnp.stack([x, y], axis=-2))
+    # geodesic midpoint via expmap of half the log
+    mid2 = ball.expmap(x, 0.5 * ball.logmap(x, y))
+    np.testing.assert_allclose(np.asarray(mid), np.asarray(mid2), atol=1e-6)
