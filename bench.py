@@ -1,0 +1,99 @@
+"""Benchmark entry point — prints ONE JSON line for the driver.
+
+Metrics tracked (BASELINE.json "metric"): HGCN samples/sec/chip on
+ogbn-arxiv-scale graphs, and Poincaré-embedding epoch time.  The primary
+reported metric is selected by ``--metric`` (default: the first available in
+priority order hgcn > poincare).  ``vs_baseline`` is null because
+BASELINE.json ``published`` is empty — no reference number exists in this
+environment (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def bench_poincare(repeats: int = 3) -> dict:
+    """Epoch time for Poincaré embeddings on a WordNet-noun-scale tree."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.data.wordnet import synthetic_tree
+    from hyperspace_tpu.models import poincare_embed as pe
+
+    # WordNet nouns ≈ 82k nodes / ~750k closure pairs; the synthetic stand-in
+    # (depth 5, branching 9) gives 66k nodes and a comparable closure size.
+    ds = synthetic_tree(depth=5, branching=9)
+    cfg = pe.PoincareEmbedConfig(
+        num_nodes=ds.num_nodes, dim=10, batch_size=1024, neg_samples=10
+    )
+    state, opt = pe.init_state(cfg)
+    pairs = jnp.asarray(ds.pairs)
+    steps_per_epoch = max(1, ds.num_pairs // cfg.batch_size)
+
+    # compile + warmup
+    state, loss = pe.train_step(cfg, opt, state, pairs)
+    jax.block_until_ready(loss)
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps_per_epoch):
+            state, loss = pe.train_step(cfg, opt, state, pairs)
+        jax.block_until_ready(loss)
+        times.append(time.perf_counter() - t0)
+    epoch_s = min(times)
+    return {
+        "metric": "poincare_embed_epoch_time",
+        "value": round(epoch_s, 4),
+        "unit": "s",
+        "vs_baseline": None,
+        "detail": {
+            "num_nodes": ds.num_nodes,
+            "num_pairs": ds.num_pairs,
+            "steps_per_epoch": steps_per_epoch,
+            "batch_size": cfg.batch_size,
+            "backend": jax.default_backend(),
+        },
+    }
+
+
+def bench_hgcn(repeats: int = 3) -> dict:
+    """HGCN training throughput (samples/sec/chip) on an arxiv-scale graph."""
+    import jax
+
+    from hyperspace_tpu.benchmarks.hgcn_bench import run_hgcn_bench
+
+    return run_hgcn_bench(repeats=repeats, backend=jax.default_backend())
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--metric", choices=["auto", "hgcn", "poincare"], default="auto")
+    p.add_argument("--repeats", type=int, default=3)
+    args = p.parse_args()
+
+    order = {
+        "auto": [bench_hgcn, bench_poincare],
+        "hgcn": [bench_hgcn],
+        "poincare": [bench_poincare],
+    }[args.metric]
+
+    last_err = None
+    for fn in order:
+        try:
+            result = fn(repeats=args.repeats)
+            print(json.dumps(result))
+            return
+        except Exception as e:  # fall through to the next available benchmark
+            last_err = e
+    print(json.dumps({"metric": "error", "value": 0, "unit": "", "vs_baseline": None,
+                      "detail": {"error": repr(last_err)}}))
+    sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

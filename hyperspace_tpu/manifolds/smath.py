@@ -114,12 +114,6 @@ def arcosh1p(u: jax.Array) -> jax.Array:
     return jnp.log1p(u + safe_sqrt(u * (u + 2.0)))
 
 
-def arccos_safe(x: jax.Array) -> jax.Array:
-    """arccos clamped into the open interval so the gradient stays bounded."""
-    e = _artanh_eps(x.dtype)
-    return jnp.arccos(jnp.clip(x, -1.0 + e, 1.0 - e))
-
-
 def arcsin_safe(x: jax.Array) -> jax.Array:
     """arcsin clamped into the open interval so the gradient stays bounded."""
     e = _artanh_eps(x.dtype)

@@ -88,8 +88,3 @@ class Sphere(Manifold):
         c = self._c(dtype)
         o = jnp.zeros(shape, dtype)
         return o.at[..., 0].set(1.0 / smath.sqrt_c(c))
-
-    def random_normal(self, key: jax.Array, shape, dtype=jnp.float32, std: float = 1.0) -> jax.Array:
-        v = std * jax.random.normal(key, shape, dtype)
-        o = self.origin(v.shape, dtype)
-        return self.proj(self.expmap(o, self.proju(o, v)))

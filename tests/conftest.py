@@ -14,6 +14,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# The environment's sitecustomize may have imported jax already (registering a
+# remote TPU backend), in which case the env var above is read too late — the
+# config update is authoritative either way.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
