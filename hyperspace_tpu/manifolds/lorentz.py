@@ -129,6 +129,16 @@ class Lorentz(Manifold):
     def retr(self, x: jax.Array, v: jax.Array) -> jax.Array:
         return self.proj(x + v)
 
+    def logdetexp(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        """log |det d exp_x| at log_x(y) (orthonormal coords → Riemannian
+        volume): (d−1)·log(sinh(√c r)/(√c r)), r = dist (Nagano et al. 2019).
+        """
+        c = self._c(x.dtype)
+        d = x.shape[-1] - 1  # manifold dim; ambient is d+1
+        r = self.dist(x, y)
+        return (d - 1) * jnp.log(smath.clamp_min(
+            smath.sinhc(smath.sqrt_c(c) * r), smath.eps_for(x.dtype)))
+
     # --- aggregation (used by HGCN / attention on the hyperboloid) ------------
 
     def centroid(self, x: jax.Array, w: jax.Array | None = None) -> jax.Array:

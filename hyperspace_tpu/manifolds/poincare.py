@@ -180,6 +180,19 @@ class PoincareBall(Manifold):
     def origin(self, shape, dtype=jnp.float32) -> jax.Array:
         return jnp.zeros(shape, dtype)
 
+    def logdetexp(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        """log |det d exp_x| at log_x(y), w.r.t. orthonormal tangent coords
+        and the Riemannian volume: (d−1)·log( sinh(√c r)/(√c r) ), r=dist.
+
+        The Jacobian correction of the wrapped-normal density (Nagano 2019 /
+        Mathieu 2019; SURVEY.md §2 "WrappedNormal").
+        """
+        c = self._c(x.dtype)
+        d = x.shape[-1]
+        r = self.dist(x, y)
+        return (d - 1) * jnp.log(smath.clamp_min(
+            smath.sinhc(smath.sqrt_c(c) * r), smath.eps_for(x.dtype)))
+
     # --- gyro extras used by models ------------------------------------------
 
     def gyromidpoint(self, x: jax.Array, w: jax.Array | None = None) -> jax.Array:

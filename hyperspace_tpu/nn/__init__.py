@@ -1,0 +1,13 @@
+from hyperspace_tpu.nn.layers import HypAct, HypLinear, LorentzLinear
+from hyperspace_tpu.nn.mlr import HypMLR, LorentzMLR, hyp_mlr_logits
+from hyperspace_tpu.nn.wrapped_normal import WrappedNormal
+
+__all__ = [
+    "HypAct",
+    "HypLinear",
+    "LorentzLinear",
+    "HypMLR",
+    "LorentzMLR",
+    "hyp_mlr_logits",
+    "WrappedNormal",
+]

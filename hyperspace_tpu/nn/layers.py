@@ -1,0 +1,117 @@
+"""Hyperbolic NN layers (flax.linen).
+
+Implements the layer inventory of SURVEY.md §2: the gyro-linear layer
+(reference CUDA kernel N5), the fully-hyperbolic Lorentz linear layer
+(HyboNet), and the tangent-space activation with curvature transfer (HGCN).
+
+Parameterization convention [PLAN]: layer-internal manifold-valued
+parameters (biases, hyperplane base points) are stored as **tangent vectors
+at the origin** and mapped with ``expmap0`` in the forward pass.  The stored
+parameter is Euclidean, so these layers train under any optax optimizer and
+need no manifold-tag plumbing through flax; the *unconstrained-storage +
+constrained-forward* pattern is the TPU-friendly equivalent of the
+reference's ManifoldParameter class.  Embedding tables, by contrast, are
+true on-manifold parameters driven by :mod:`hyperspace_tpu.optim` with
+manifold tags.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import Lorentz, PoincareBall
+from hyperspace_tpu.manifolds import smath
+
+
+class HypLinear(nn.Module):
+    """Gyro-linear layer on the Poincaré ball: y = (M ⊗_c x) ⊕_c b.
+
+    Semantics per Ganea et al. 2018 (reference kernel N5, SURVEY.md §2
+    "HypLinear / gyro-linear").  Input/output are points on the ball of the
+    layer's manifold.
+    """
+
+    features: int
+    manifold: PoincareBall
+    use_bias: bool = True
+    kernel_init: Callable = nn.initializers.glorot_uniform()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        d_in = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init, (d_in, self.features), x.dtype)
+        y = self.manifold.mobius_matvec(kernel, x)
+        if self.use_bias:
+            # bias is a tangent vector at the origin; exp0 makes it a point
+            bias_t = self.param("bias", nn.initializers.zeros, (self.features,), x.dtype)
+            b = self.manifold.expmap0(bias_t)  # once; mobius_add broadcasts
+            y = self.manifold.mobius_add(y, b)
+        return self.manifold.proj(y)
+
+
+class LorentzLinear(nn.Module):
+    """Fully-hyperbolic linear layer on the hyperboloid (HyboNet).
+
+    Semantics per Chen et al. ACL 2022 (SURVEY.md §2 "LorentzLinear"): the
+    full ambient input (time + space coordinates) feeds an ordinary matmul
+    producing the output *space* coordinates, and the output time coordinate
+    is reconstructed from the hyperboloid constraint
+
+        t = sqrt(1/c + ‖space‖²).
+
+    No tangent-space detour — one MXU matmul plus a norm, and the output is
+    on-manifold by construction (the TPU-native win of the Lorentz model).
+    ``dim`` is the *manifold* dimension: output ambient shape is dim+1.
+    """
+
+    dim: int
+    manifold: Lorentz
+    use_bias: bool = True
+    activation: Optional[Callable] = None
+    kernel_init: Callable = nn.initializers.glorot_uniform()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        d_in = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init, (d_in, self.dim), x.dtype)
+        h = x
+        if self.activation is not None:
+            h = self.activation(h)
+        space = h @ kernel
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.dim,), x.dtype)
+            space = space + bias
+        c = jnp.asarray(self.manifold.c, x.dtype)
+        t = smath.safe_sqrt(
+            1.0 / smath.clamp_min(c, smath.min_norm(x.dtype)) + smath.sq_norm(space)
+        )
+        return jnp.concatenate([t, space], axis=-1)
+
+
+class HypAct(nn.Module):
+    """Tangent-space activation with curvature transfer (HGCN).
+
+    y = exp0^{c_out}( act( log0^{c_in}(x) ) ) — Chami et al. 2019 use this
+    between layers whose curvatures differ (SURVEY.md §3.2 "curvature_{l+1}
+    transfer").  Works for any pair of manifolds that share a tangent space
+    at the origin of the same width (ball→ball, lorentz→lorentz).
+    """
+
+    manifold_in: Any
+    manifold_out: Any
+    activation: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        v = self.manifold_in.logmap0(x)
+        if isinstance(self.manifold_in, Lorentz):
+            # origin-tangent vectors on the hyperboloid have time coord 0;
+            # activate only the space part so the vector stays tangent.
+            v = jnp.concatenate([v[..., :1] * 0.0, self.activation(v[..., 1:])], axis=-1)
+        else:
+            v = self.activation(v)
+        return self.manifold_out.expmap0(v)  # expmap0 ends in proj

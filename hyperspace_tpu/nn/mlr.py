@@ -1,0 +1,93 @@
+"""Hyperbolic multinomial logistic regression (the hyperbolic softmax head).
+
+Semantics per Ganea et al. 2018 eq. (25) (SURVEY.md §2 "Hyperbolic MLR /
+softmax head"; reference CUDA kernel N6): each class k owns a hyperbolic
+hyperplane through point p_k with normal a_k ∈ T_{p_k}, and
+
+    logit_k(x) = (λ_{p_k} ‖a_k‖ / √c) · asinh( 2√c ⟨z_k, a_k⟩
+                                               / ((1 − c‖z_k‖²) ‖a_k‖) ),
+    z_k = (−p_k) ⊕_c x .
+
+The logit is a smooth signed multiple of the distance from x to the
+hyperplane, so ``softmax(logits)`` is the hyperbolic softmax.
+
+Also provides ``lorentz_mlr`` for hyperboloid inputs: points are mapped to
+the ball stereographically first (SURVEY.md §2 "Ball↔hyperboloid maps") —
+distance-preserving, so the decision geometry is unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import PoincareBall, smath
+from hyperspace_tpu.manifolds.maps import lorentz_to_ball
+
+
+def hyp_mlr_logits(
+    x: jax.Array, p: jax.Array, a: jax.Array, c
+) -> jax.Array:
+    """Hyperbolic MLR logits.
+
+    x: [..., d] points on the ball; p: [K, d] hyperplane points (on the
+    ball); a: [K, d] normals (tangent at p_k). Returns [..., K].
+    """
+    ball = PoincareBall(c)
+    cc = jnp.asarray(c, x.dtype)
+    sc = smath.clamp_min(smath.sqrt_c(cc), smath.min_norm(x.dtype))
+    z = ball.mobius_add(-p, x[..., None, :])  # [..., K, d]
+    z2 = smath.sq_norm(z)[..., 0]  # [..., K]
+    za = jnp.sum(z * a, axis=-1)  # [..., K]
+    a_norm = smath.clamp_min(
+        smath.safe_norm(a, keepdims=False), smath.min_norm(x.dtype)
+    )  # [K]
+    lam_p = ball.lambda_x(p, keepdims=False)  # [K]
+    denom = smath.clamp_min(1.0 - cc * z2, smath.eps_for(x.dtype)) * a_norm
+    arg = 2.0 * sc * za / denom
+    return (lam_p * a_norm / sc) * jnp.arcsinh(arg)
+
+
+class HypMLR(nn.Module):
+    """Hyperbolic softmax head for ball-valued features.
+
+    Hyperplane points p_k are stored as origin-tangent vectors (exp0 in the
+    forward pass — see hyperspace_tpu/nn/layers.py parameterization note).
+    """
+
+    num_classes: int
+    manifold: PoincareBall
+    p_init: Callable = nn.initializers.zeros
+    a_init: Callable = nn.initializers.glorot_uniform()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        d = x.shape[-1]
+        p_t = self.param("p_tangent", self.p_init, (self.num_classes, d), x.dtype)
+        a = self.param("a", self.a_init, (self.num_classes, d), x.dtype)
+        p = self.manifold.proj(self.manifold.expmap0(p_t))
+        return hyp_mlr_logits(x, p, a, self.manifold.c)
+
+
+class LorentzMLR(nn.Module):
+    """Hyperbolic softmax head for hyperboloid-valued features.
+
+    Maps points to the isometric Poincaré ball, then applies ball MLR.
+    """
+
+    num_classes: int
+    manifold: object  # Lorentz
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = self.manifold.c
+        xb = lorentz_to_ball(x, c)
+        ball = PoincareBall(c)
+        d = xb.shape[-1]
+        p_t = self.param("p_tangent", nn.initializers.zeros, (self.num_classes, d), xb.dtype)
+        a = self.param("a", nn.initializers.glorot_uniform(), (self.num_classes, d), xb.dtype)
+        p = ball.proj(ball.expmap0(p_t))
+        return hyp_mlr_logits(xb, p, a, c)

@@ -1,0 +1,110 @@
+"""Wrapped normal distribution on hyperbolic manifolds.
+
+Semantics per Nagano et al. 2019 (Lorentz) and Mathieu et al. 2019 (ball) —
+SURVEY.md §2 "WrappedNormal", required by the HVAE workload (BASELINE.json
+configs[3]: "Hyperbolic VAE on MNIST with wrapped-normal prior").
+
+Sampling (reparameterized, fully differentiable):
+    v ~ N(0, scale)        in orthonormal coordinates of T_origin
+    u = PT_{origin→μ}(v)   (parallel transport)
+    z = exp_μ(u)
+
+Density (w.r.t. the Riemannian volume measure):
+    log p(z) = log N(v; 0, scale) − logdetexp(μ, z)
+
+where v recovers from z by the inverse path and logdetexp is the Jacobian
+of the exponential map, (d−1)·log(sinh(√c r)/(√c r)).
+
+Orthonormal-coordinate conventions at the origin:
+- Lorentz: tangent = (0, v); Minkowski metric restricted to T_origin is the
+  identity on space coords, so coords are the space part as-is.
+- Poincaré ball: metric at 0 is λ₀²·I with λ₀ = 2, so an orthonormal
+  coordinate vector v corresponds to the ambient vector v/2.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import Lorentz, PoincareBall
+
+
+def _log_normal(v: jax.Array, scale: jax.Array) -> jax.Array:
+    """Diagonal-Gaussian log density, summed over the last axis."""
+    var = scale**2
+    return jnp.sum(
+        -0.5 * (v**2 / var + jnp.log(2.0 * jnp.pi * var)), axis=-1
+    )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class WrappedNormal:
+    """WrappedNormal(manifold, loc, scale).
+
+    loc: [..., D] point on the manifold (D = ambient dim).
+    scale: [..., d] positive std-devs in origin-tangent coords (d = manifold
+    dim; for Lorentz D = d+1, for the ball D = d).
+
+    Registered as a pytree (like the manifolds) so a jitted encoder can
+    return a WrappedNormal posterior directly (HVAE, BASELINE configs[3]).
+    """
+
+    manifold: Any
+    loc: jax.Array
+    scale: jax.Array
+
+    def tree_flatten(self):
+        return (self.manifold, self.loc, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def dim(self) -> int:
+        return self.scale.shape[-1]
+
+    # --- coordinate helpers ---------------------------------------------------
+
+    def _tangent_from_coords(self, v: jax.Array) -> jax.Array:
+        """Orthonormal coords at the origin → ambient tangent vector."""
+        if isinstance(self.manifold, Lorentz):
+            return jnp.concatenate([jnp.zeros_like(v[..., :1]), v], axis=-1)
+        if isinstance(self.manifold, PoincareBall):
+            return v / 2.0  # λ₀ = 2
+        raise TypeError(f"WrappedNormal: unsupported manifold {self.manifold!r}")
+
+    def _coords_from_tangent(self, u: jax.Array) -> jax.Array:
+        if isinstance(self.manifold, Lorentz):
+            return u[..., 1:]
+        if isinstance(self.manifold, PoincareBall):
+            return u * 2.0
+        raise TypeError(f"WrappedNormal: unsupported manifold {self.manifold!r}")
+
+    # --- distribution API -----------------------------------------------------
+
+    def rsample(self, key: jax.Array, sample_shape: tuple = ()) -> jax.Array:
+        m = self.manifold
+        shape = sample_shape + self.scale.shape
+        v = self.scale * jax.random.normal(key, shape, self.scale.dtype)
+        u0 = self._tangent_from_coords(v)
+        loc = jnp.broadcast_to(self.loc, sample_shape + self.loc.shape)
+        u = m.ptransp0(loc, u0)
+        return m.expmap(loc, u)  # expmap ends in proj on every manifold
+
+    def log_prob(self, z: jax.Array) -> jax.Array:
+        """Log density w.r.t. the Riemannian volume measure; shape [...]."""
+        m = self.manifold
+        u = m.logmap(self.loc, z)
+        u0 = m.ptransp(self.loc, m.origin(u.shape, u.dtype), u)
+        v = self._coords_from_tangent(u0)
+        return _log_normal(v, self.scale) - m.logdetexp(self.loc, z)
+
+    def sample_and_log_prob(self, key: jax.Array, sample_shape: tuple = ()):
+        z = self.rsample(key, sample_shape)
+        return z, self.log_prob(z)

@@ -1,0 +1,11 @@
+from hyperspace_tpu.optim.radam import riemannian_adam
+from hyperspace_tpu.optim.rsgd import riemannian_sgd
+from hyperspace_tpu.optim.tags import map_tagged, path_contains, tags_from_paths
+
+__all__ = [
+    "riemannian_adam",
+    "riemannian_sgd",
+    "map_tagged",
+    "path_contains",
+    "tags_from_paths",
+]

@@ -1,0 +1,115 @@
+"""Riemannian Adam (Bécigneul & Ganea 2019) as an optax transformation.
+
+BASELINE.json north star: "Riemannian SGD/Adam with its tangent-space
+retraction runs as a single XLA-compiled train step".  Semantics
+(SURVEY.md §2 "Riemannian Adam"):
+
+- the Euclidean gradient is converted to a Riemannian gradient;
+- the first moment is a *tangent vector* at the current point and is
+  **parallel-transported** to the new point after every update, so it stays
+  a valid tangent vector as the parameter moves (SURVEY.md §7 hard-part #4:
+  moments live in tangent spaces of moving points);
+- the second moment is the scalar Riemannian squared norm per parameter row
+  (geoopt's default for manifolds without component structure), kept
+  elementwise for Euclidean leaves so they reduce to standard Adam;
+- the update point is ``expmap`` (or the cheap retraction), which already
+  re-projects.
+
+Like :mod:`hyperspace_tpu.optim.rsgd`, the transform emits
+``new_point - old_point`` so ``optax.apply_updates`` reconstructs the
+on-manifold point exactly, and the whole thing jits into one XLA program.
+
+GSPMD note: all state tensors are elementwise-shaped like their parameter
+(or a last-axis reduction of it), so any sharding rule that shards a param
+shards its moments identically — moment shards stay co-located with their
+parameter shards by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from hyperspace_tpu.optim.tags import map_tagged
+
+ScalarOrSchedule = Union[float, Callable[[jax.Array], jax.Array]]
+
+
+class RAdamState(NamedTuple):
+    count: jax.Array
+    mu: Any  # first moment: tangent vectors (manifold) / elementwise (None)
+    nu: Any  # second moment: [..., 1] row-scalars (manifold) / elementwise
+
+
+def _lr_at(learning_rate: ScalarOrSchedule, count: jax.Array) -> jax.Array:
+    if callable(learning_rate):
+        return learning_rate(count)
+    return jnp.asarray(learning_rate)
+
+
+def riemannian_adam(
+    learning_rate: ScalarOrSchedule,
+    tags: Any,
+    *,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    use_expmap: bool = True,
+) -> optax.GradientTransformation:
+    """Riemannian Adam.
+
+    Args:
+      learning_rate: scalar or optax schedule.
+      tags: pytree matching the params; leaves are Manifold or None.
+      b1, b2, eps: Adam constants.
+      use_expmap: exact exponential-map update if True, else retraction
+        (``proj(x + v)``) — the reference's "tangent-space retraction" mode.
+    """
+
+    def init_fn(params):
+        def one(tag, p):
+            if tag is None:
+                return jnp.zeros_like(p), jnp.zeros_like(p)
+            return jnp.zeros_like(p), jnp.zeros(p.shape[:-1] + (1,), p.dtype)
+
+        mn = map_tagged(one, tags, params)
+        mu = map_tagged(lambda t, x: x[0], tags, mn)
+        nu = map_tagged(lambda t, x: x[1], tags, mn)
+        return RAdamState(count=jnp.zeros((), jnp.int32), mu=mu, nu=nu)
+
+    def update_fn(grads, state, params):
+        if params is None:
+            raise ValueError("riemannian_adam requires params")
+        count = state.count + 1
+        lr = _lr_at(learning_rate, state.count)
+        ftype = jnp.result_type(float)  # f64 under x64, f32 on TPU
+        c1 = 1.0 - b1 ** count.astype(ftype)
+        c2 = 1.0 - b2 ** count.astype(ftype)
+
+        def one(tag, g, p, mu, nu):
+            if tag is None:
+                mu_n = b1 * mu + (1.0 - b1) * g
+                nu_n = b2 * nu + (1.0 - b2) * g * g
+                step = -lr * (mu_n / c1) / (jnp.sqrt(nu_n / c2) + eps)
+                return step, mu_n, nu_n
+            rg = tag.egrad2rgrad(p, g)
+            mu_n = b1 * mu + (1.0 - b1) * rg
+            nu_n = b2 * nu + (1.0 - b2) * tag.inner(p, rg, rg, keepdims=True)
+            nu_n = jnp.maximum(nu_n, 0.0)  # Lorentz inner can go −0.0-ish
+            direction = (mu_n / c1) / (jnp.sqrt(nu_n / c2) + eps)
+            step = -lr * direction
+            new_p = tag.expmap(p, step) if use_expmap else tag.retr(p, step)
+            # transport the first moment to the new point's tangent space
+            mu_t = tag.ptransp(p, new_p, mu_n)
+            return new_p - p, mu_t, nu_n
+
+        out = map_tagged(one, tags, grads, params, state.mu, state.nu)
+        updates = map_tagged(lambda t, x: x[0], tags, out)
+        mu = map_tagged(lambda t, x: x[1], tags, out)
+        nu = map_tagged(lambda t, x: x[2], tags, out)
+        return updates, RAdamState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,114 @@
+"""Layer tests: outputs on-manifold, gradients finite, known reductions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hyperspace_tpu.manifolds import Lorentz, PoincareBall
+from hyperspace_tpu.nn import HypAct, HypLinear, HypMLR, LorentzLinear, LorentzMLR
+from hyperspace_tpu.nn.mlr import hyp_mlr_logits
+
+
+def test_hyp_linear_on_ball():
+    ball = PoincareBall(1.0)
+    layer = HypLinear(features=6, manifold=ball)
+    x = ball.random_normal(jax.random.PRNGKey(0), (4, 3), jnp.float64, std=0.5)
+    params = layer.init(jax.random.PRNGKey(1), x)
+    y = layer.apply(params, x)
+    assert y.shape == (4, 6)
+    assert float(jnp.max(ball.check_point(y))) == 0.0
+    # zero weights + zero bias → origin
+    z = layer.apply(jax.tree_util.tree_map(jnp.zeros_like, params), x)
+    np.testing.assert_allclose(np.asarray(z), 0.0, atol=1e-12)
+
+
+def test_lorentz_linear_on_hyperboloid():
+    lor = Lorentz(0.7)
+    layer = LorentzLinear(dim=5, manifold=lor)
+    x = lor.random_normal(jax.random.PRNGKey(0), (8, 4), jnp.float64, std=0.5)
+    params = layer.init(jax.random.PRNGKey(1), x)
+    y = layer.apply(params, x)
+    assert y.shape == (8, 6)  # ambient dim+1
+    assert float(jnp.max(lor.check_point(y))) < 1e-10
+    # gradients finite
+    g = jax.grad(lambda p: jnp.sum(layer.apply(p, x) ** 2))(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_hyp_act_curvature_transfer():
+    b1, b2 = PoincareBall(1.0), PoincareBall(0.5)
+    layer = HypAct(manifold_in=b1, manifold_out=b2, activation=jax.nn.relu)
+    x = b1.random_normal(jax.random.PRNGKey(0), (5, 3), jnp.float64, std=0.5)
+    y = layer.apply({}, x)
+    assert float(jnp.max(b2.check_point(y))) == 0.0
+
+
+def test_hyp_act_lorentz_keeps_manifold():
+    l1, l2 = Lorentz(1.0), Lorentz(2.0)
+    layer = HypAct(manifold_in=l1, manifold_out=l2, activation=jax.nn.relu)
+    x = l1.random_normal(jax.random.PRNGKey(0), (5, 4), jnp.float64, std=0.5)
+    y = layer.apply({}, x)
+    assert float(jnp.max(l2.check_point(y))) < 1e-10
+
+
+def test_mlr_sign_symmetry_and_origin():
+    """At p = 0 the logit must be odd in x along a, and 0 at the origin."""
+    c = 1.0
+    d = 4
+    a = jnp.zeros((1, d), jnp.float64).at[0, 0].set(1.5)
+    p = jnp.zeros((1, d), jnp.float64)
+    x = jnp.zeros((d,), jnp.float64).at[0].set(0.3)
+    lp = hyp_mlr_logits(x, p, a, c)
+    lm = hyp_mlr_logits(-x, p, a, c)
+    np.testing.assert_allclose(np.asarray(lp), -np.asarray(lm), rtol=1e-12)
+    l0 = hyp_mlr_logits(jnp.zeros((d,), jnp.float64), p, a, c)
+    np.testing.assert_allclose(np.asarray(l0), 0.0, atol=1e-12)
+    # positive side of the hyperplane → positive logit
+    assert float(lp[0]) > 0.0
+
+
+def test_mlr_flat_limit_matches_euclidean_logit():
+    """As c → 0 the hyperbolic MLR approaches 4⟨x−p, a⟩ (Ganea 2018 §3.1:
+    lim logit = 4⟨−p+x, a⟩ accounting for λ→2 and asinh(z)≈z)."""
+    c = 1e-8
+    d = 3
+    key = jax.random.PRNGKey(2)
+    a = jax.random.normal(key, (2, d), jnp.float64)
+    p = 0.01 * jax.random.normal(jax.random.PRNGKey(3), (2, d), jnp.float64)
+    x = 0.01 * jax.random.normal(jax.random.PRNGKey(4), (d,), jnp.float64)
+    got = hyp_mlr_logits(x, p, a, c)
+    want = 4.0 * jnp.sum((x - p) * a, axis=-1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-3)
+
+
+def test_hyp_mlr_module_and_grads():
+    ball = PoincareBall(1.0)
+    head = HypMLR(num_classes=7, manifold=ball)
+    x = ball.random_normal(jax.random.PRNGKey(0), (6, 4), jnp.float64, std=0.5)
+    params = head.init(jax.random.PRNGKey(1), x)
+    logits = head.apply(params, x)
+    assert logits.shape == (6, 7)
+    labels = jnp.arange(6) % 7
+    loss = lambda p: jnp.mean(
+        -jax.nn.log_softmax(head.apply(p, x))[jnp.arange(6), labels]
+    )
+    g = jax.grad(loss)(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_lorentz_mlr_matches_ball_mlr_through_isometry():
+    """LorentzMLR on mapped points == HypMLR on ball points (same params)."""
+    from hyperspace_tpu.manifolds.maps import ball_to_lorentz
+
+    c = 0.8
+    ball, lor = PoincareBall(c), Lorentz(c)
+    xb = ball.random_normal(jax.random.PRNGKey(0), (5, 3), jnp.float64, std=0.5)
+    xl = ball_to_lorentz(xb, c)
+    head_b = HypMLR(num_classes=4, manifold=ball)
+    params = head_b.init(jax.random.PRNGKey(1), xb)
+    head_l = LorentzMLR(num_classes=4, manifold=lor)
+    lb = head_b.apply(params, xb)
+    ll = head_l.apply(params, xl)
+    np.testing.assert_allclose(np.asarray(lb), np.asarray(ll), rtol=1e-8, atol=1e-10)

@@ -1,0 +1,105 @@
+"""WrappedNormal: normalization, consistency, reparameterized gradients."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.manifolds import Lorentz, PoincareBall
+from hyperspace_tpu.manifolds.maps import ball_to_lorentz
+from hyperspace_tpu.nn import WrappedNormal
+
+
+def test_ball_density_integrates_to_one_2d():
+    """∫ p(z) √|g(z)| dz over the 2-D ball must be 1 (Riemannian density)."""
+    c = 1.0
+    ball = PoincareBall(c)
+    loc = ball.proj(jnp.asarray([0.25, -0.1], jnp.float64))
+    scale = jnp.asarray([0.6, 0.6], jnp.float64)
+    dist = WrappedNormal(ball, loc, scale)
+
+    n = 400
+    lim = 1.0 / np.sqrt(c) * (1 - 1e-4)
+    xs = np.linspace(-lim, lim, n)
+    X, Y = np.meshgrid(xs, xs)
+    pts = jnp.asarray(np.stack([X.ravel(), Y.ravel()], -1))
+    r2 = np.sum(np.asarray(pts) ** 2, -1)
+    inside = r2 < lim**2
+    logp = np.asarray(dist.log_prob(pts))
+    lam = np.asarray(ball.lambda_x(pts, keepdims=False))
+    dens = np.where(inside, np.exp(logp) * lam**2, 0.0)  # √|g| = λ^d, d=2
+    integral = dens.sum() * (xs[1] - xs[0]) ** 2
+    assert abs(integral - 1.0) < 2e-2
+
+
+def test_lorentz_density_integrates_to_one_2d():
+    """Same check on the hyperboloid, integrating in ball coordinates.
+
+    Under the isometry the Riemannian densities agree pointwise, so
+    ∫ p_L(φ(z)) λ² dz = 1 with φ = ball→Lorentz."""
+    c = 0.8
+    lor = Lorentz(c)
+    ball = PoincareBall(c)
+    loc_b = jnp.asarray([0.1, 0.2], jnp.float64)
+    loc = ball_to_lorentz(loc_b, c)
+    scale = jnp.asarray([0.7, 0.5], jnp.float64)
+    dist = WrappedNormal(lor, loc, scale)
+
+    n = 400
+    lim = 1.0 / np.sqrt(c) * (1 - 1e-4)
+    xs = np.linspace(-lim, lim, n)
+    X, Y = np.meshgrid(xs, xs)
+    pts = jnp.asarray(np.stack([X.ravel(), Y.ravel()], -1))
+    inside = np.sum(np.asarray(pts) ** 2, -1) < lim**2
+    zl = ball_to_lorentz(pts, c)
+    logp = np.asarray(dist.log_prob(zl))
+    lam = np.asarray(ball.lambda_x(pts, keepdims=False))
+    dens = np.where(inside, np.exp(logp) * lam**2, 0.0)
+    integral = dens.sum() * (xs[1] - xs[0]) ** 2
+    assert abs(integral - 1.0) < 2e-2
+
+
+@pytest.mark.parametrize("mk", [lambda: PoincareBall(1.0), lambda: Lorentz(1.0)])
+def test_rsample_on_manifold_and_logprob_finite(mk):
+    m = mk()
+    d = 6
+    D = m.ambient_dim(d)
+    loc = m.random_normal(jax.random.PRNGKey(0), (D,), jnp.float64, std=0.4)
+    scale = 0.3 * jnp.ones((d,), jnp.float64)
+    dist = WrappedNormal(m, loc, scale)
+    z = dist.rsample(jax.random.PRNGKey(1), (128,))
+    assert z.shape == (128, D)
+    assert float(jnp.max(m.check_point(z))) < 1e-9
+    lp = dist.log_prob(z)
+    assert np.isfinite(np.asarray(lp)).all()
+
+
+def test_logprob_highest_at_loc_for_isotropic():
+    m = PoincareBall(1.0)
+    loc = jnp.asarray([0.3, 0.0, -0.2], jnp.float64)
+    dist = WrappedNormal(m, m.proj(loc), 0.4 * jnp.ones((3,), jnp.float64))
+    z = dist.rsample(jax.random.PRNGKey(2), (64,))
+    lp_loc = dist.log_prob(m.proj(loc))
+    assert float(lp_loc) >= float(jnp.max(dist.log_prob(z))) - 1e-9
+
+
+def test_reparameterized_gradients_flow_to_loc_and_scale():
+    """∂/∂(loc,scale) of an expectation estimated with rsample is finite."""
+    m = Lorentz(1.0)
+    target = m.random_normal(jax.random.PRNGKey(3), (5,), jnp.float64)
+
+    def objective(params):
+        loc = m.proj(params["loc"])
+        scale = jax.nn.softplus(params["raw_scale"])
+        dist = WrappedNormal(m, loc, scale)
+        z = dist.rsample(jax.random.PRNGKey(4), (32,))
+        return jnp.mean(m.sqdist(z, target))
+
+    params = {
+        "loc": m.random_normal(jax.random.PRNGKey(5), (5,), jnp.float64),
+        "raw_scale": jnp.zeros((4,), jnp.float64),
+    }
+    g = jax.grad(objective)(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
+    assert float(jnp.linalg.norm(g["loc"])) > 0.0

@@ -1,0 +1,114 @@
+"""Riemannian Adam tests (SURVEY.md §4.5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from hyperspace_tpu.manifolds import Lorentz, PoincareBall, Sphere
+from hyperspace_tpu.optim.radam import riemannian_adam
+
+
+def test_euclidean_leaf_matches_optax_adam():
+    """With tag None, riemannian_adam must reduce to standard Adam."""
+    params = {"w": jnp.asarray([1.0, -2.0, 3.0], jnp.float64)}
+    tags = {"w": None}
+    opt_r = riemannian_adam(0.05, tags)
+    opt_e = optax.adam(0.05)
+    sr, se = opt_r.init(params), opt_e.init(params)
+    pr, pe_ = params, params
+    key = jax.random.PRNGKey(0)
+    for _ in range(25):
+        key, k = jax.random.split(key)
+        g = {"w": jax.random.normal(k, (3,), jnp.float64)}
+        ur, sr = opt_r.update(g, sr, pr)
+        ue, se = opt_e.update(g, se, pe_)
+        pr = optax.apply_updates(pr, ur)
+        pe_ = optax.apply_updates(pe_, ue)
+    np.testing.assert_allclose(pr["w"], pe_["w"], rtol=1e-9)
+
+
+@pytest.mark.parametrize("manifold", [PoincareBall(1.0), Lorentz(1.0), Sphere(1.0)])
+def test_converges_to_target_on_manifold(manifold):
+    """Minimize d(x, target)²: RAdam must converge and stay on-manifold."""
+    key = jax.random.PRNGKey(1)
+    d = 5
+    D = manifold.ambient_dim(d)
+    target = manifold.random_normal(key, (D,), jnp.float64, std=0.5)
+    x = manifold.random_normal(jax.random.PRNGKey(2), (D,), jnp.float64, std=0.5)
+
+    opt = riemannian_adam(0.05, tags=manifold)
+    state = opt.init(x)
+
+    @jax.jit
+    def step(x, state):
+        loss, g = jax.value_and_grad(lambda p: manifold.sqdist(p, target))(x)
+        upd, state = opt.update(g, state, x)
+        return optax.apply_updates(x, upd), state, loss
+
+    for _ in range(400):
+        x, state, loss = step(x, state)
+    assert float(manifold.dist(x, target)) < 1e-2
+    assert float(manifold.check_point(x)) < 1e-6
+
+
+def test_moments_are_transported_tangent_vectors():
+    """After updates the first moment must lie in the tangent space at x."""
+    m = Lorentz(1.0)
+    x = m.random_normal(jax.random.PRNGKey(3), (4,), jnp.float64)
+    target = m.random_normal(jax.random.PRNGKey(4), (4,), jnp.float64)
+    opt = riemannian_adam(0.1, tags=m)
+    state = opt.init(x)
+    for _ in range(10):
+        g = jax.grad(lambda p: m.sqdist(p, target))(x)
+        upd, state = opt.update(g, state, x)
+        x = optax.apply_updates(x, upd)
+    from hyperspace_tpu.manifolds.lorentz import minkowski_dot
+
+    # ⟨x, mu⟩_L == 0 for tangent vectors at x
+    assert abs(float(minkowski_dot(x, state[1], keepdims=False))) < 1e-8
+
+
+def test_mixed_tree_and_jit():
+    """Manifold + Euclidean leaves in one tree, under one jitted step."""
+    ball = PoincareBall(1.0)
+    params = {
+        "emb": ball.random_normal(jax.random.PRNGKey(5), (7, 3), jnp.float64, std=0.3),
+        "w": jnp.ones((3, 2), jnp.float64),
+    }
+    tags = {"emb": ball, "w": None}
+    opt = riemannian_adam(0.02, tags)
+    state = opt.init(params)
+
+    def loss_fn(p):
+        h = ball.logmap0(p["emb"]) @ p["w"]
+        return jnp.sum(h**2) + jnp.sum(ball.dist0(p["emb"]) ** 2)
+
+    @jax.jit
+    def step(params, state):
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        upd, state = opt.update(g, state, params)
+        return optax.apply_updates(params, upd), state, loss
+
+    l0 = None
+    for i in range(100):
+        params, state, loss = step(params, state)
+        if i == 0:
+            l0 = float(loss)
+    assert float(loss) < l0
+    assert np.isfinite(np.asarray(params["emb"])).all()
+    assert float(jnp.max(ball.check_point(params["emb"]))) == 0.0
+
+
+def test_retraction_mode():
+    m = PoincareBall(1.0)
+    x = m.random_normal(jax.random.PRNGKey(6), (3,), jnp.float64, std=0.3)
+    target = m.random_normal(jax.random.PRNGKey(7), (3,), jnp.float64, std=0.3)
+    opt = riemannian_adam(0.05, tags=m, use_expmap=False)
+    state = opt.init(x)
+    for _ in range(300):
+        g = jax.grad(lambda p: m.sqdist(p, target))(x)
+        upd, state = opt.update(g, state, x)
+        x = optax.apply_updates(x, upd)
+    assert float(m.dist(x, target)) < 5e-2
