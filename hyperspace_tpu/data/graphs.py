@@ -1,0 +1,316 @@
+"""Graph datasets for HGCN: Cora / ogbn-arxiv loaders + synthetic fallbacks.
+
+Reference workload 2 (BASELINE.json configs[1]): hyperbolic GCN on
+Cora / ogbn-arxiv in the Lorentz model — the north-star benchmark
+(SURVEY.md §0, §3.2).
+
+TPU constraint (SURVEY.md §7 hard-part #3): XLA wants static shapes, so the
+edge list is **padded to a bucket size** and carried with a boolean mask;
+aggregation is masked ``segment_sum`` over receivers, never ragged ops.
+
+This environment has no network access, so the loaders read standard
+on-disk formats when present (Planetoid ``cora.content``/``cora.cites``;
+OGB's extracted csv layout) and otherwise synthesize structurally similar
+graphs: a noisy hierarchy (trees embed well in hyperbolic space, so link
+prediction ROC-AUC is a meaningful quality signal — the same reason the
+reference's workloads are hierarchy-shaped) with community-correlated
+features for node classification.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Graph:
+    """A static-shape graph: padded edge list + masks.
+
+    ``senders``/``receivers`` hold one direction per stored edge; callers
+    that need symmetric message passing should build the graph through
+    :func:`prepare` which symmetrizes and adds self-loops before padding.
+    """
+
+    x: np.ndarray  # [N, F] float32 node features
+    senders: np.ndarray  # [E_pad] int32
+    receivers: np.ndarray  # [E_pad] int32
+    edge_mask: np.ndarray  # [E_pad] bool (False = padding)
+    num_nodes: int
+    labels: np.ndarray | None = None  # [N] int32
+    num_classes: int = 0
+    train_mask: np.ndarray | None = None  # [N] bool (node tasks)
+    val_mask: np.ndarray | None = None
+    test_mask: np.ndarray | None = None
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.edge_mask.sum())
+
+
+@dataclasses.dataclass
+class LinkSplit:
+    """Edge split for link prediction (SURVEY.md §3.2 LP head).
+
+    ``graph`` contains only the *training* edges (message passing must not
+    see held-out edges).  val/test arrays are [K, 2] (u, v) pairs.
+    """
+
+    graph: Graph
+    train_pos: np.ndarray
+    val_pos: np.ndarray
+    val_neg: np.ndarray
+    test_pos: np.ndarray
+    test_neg: np.ndarray
+
+
+def _pad_to(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def prepare(
+    edges: np.ndarray,
+    num_nodes: int,
+    x: np.ndarray,
+    *,
+    symmetrize: bool = True,
+    self_loops: bool = True,
+    pad_multiple: int = 1024,
+    **node_fields,
+) -> Graph:
+    """Symmetrize, add self-loops, dedupe, and pad the edge list.
+
+    Padding edges are (0, 0) with ``edge_mask`` False — masked out of every
+    aggregation, they only keep the shape static across graphs of similar
+    size (bucketing; SURVEY.md §2 "padding/bucketing needed on TPU").
+    """
+    e = np.asarray(edges, np.int64)
+    if symmetrize and len(e):
+        e = np.concatenate([e, e[:, ::-1]], axis=0)
+    if self_loops:
+        loops = np.stack([np.arange(num_nodes)] * 2, axis=1)
+        e = np.concatenate([e, loops], axis=0) if len(e) else loops
+    # dedupe via flat ids
+    flat = e[:, 0] * num_nodes + e[:, 1]
+    e = e[np.unique(flat, return_index=True)[1]]
+    e_pad = _pad_to(max(len(e), 1), pad_multiple)
+    senders = np.zeros(e_pad, np.int32)
+    receivers = np.zeros(e_pad, np.int32)
+    mask = np.zeros(e_pad, bool)
+    senders[: len(e)] = e[:, 0]
+    receivers[: len(e)] = e[:, 1]
+    mask[: len(e)] = True
+    return Graph(
+        x=np.asarray(x, np.float32),
+        senders=senders,
+        receivers=receivers,
+        edge_mask=mask,
+        num_nodes=num_nodes,
+        **node_fields,
+    )
+
+
+# --- link-prediction split ----------------------------------------------------
+
+
+def split_edges(
+    edges: np.ndarray,
+    num_nodes: int,
+    x: np.ndarray,
+    *,
+    val_frac: float = 0.05,
+    test_frac: float = 0.10,
+    seed: int = 0,
+    pad_multiple: int = 1024,
+    **node_fields,
+) -> LinkSplit:
+    """Hold out edges for LP eval; message passing uses only train edges.
+
+    Negatives are uniform non-edges, the Chami et al. 2019 protocol whose
+    ROC-AUC is the [B] quality target.
+    """
+    rng = np.random.default_rng(seed)
+    e = np.asarray(edges, np.int64)
+    # undirected canonical form for splitting
+    canon = np.sort(e, axis=1)
+    canon = canon[np.unique(canon[:, 0] * num_nodes + canon[:, 1], return_index=True)[1]]
+    perm = rng.permutation(len(canon))
+    n_val = int(len(canon) * val_frac)
+    n_test = int(len(canon) * test_frac)
+    val_pos = canon[perm[:n_val]]
+    test_pos = canon[perm[n_val : n_val + n_test]]
+    train_pos = canon[perm[n_val + n_test :]]
+
+    def sample_neg(k: int) -> np.ndarray:
+        try:  # native rejection sampler (arxiv-scale edge sets)
+            from hyperspace_tpu.data import native
+
+            neg = native.sample_negative_edges(
+                canon, num_nodes, k, seed=int(rng.integers(2**31)))
+            if len(neg) == k:
+                return neg.astype(np.int64)
+        except (ImportError, OSError):
+            pass
+        edge_set = {(int(u), int(v)) for u, v in canon}
+        out = []
+        while len(out) < k:
+            cand = rng.integers(0, num_nodes, size=(2 * (k - len(out)) + 16, 2))
+            for u, v in cand:
+                if u == v:
+                    continue
+                a, b = (int(u), int(v)) if u < v else (int(v), int(u))
+                if (a, b) in edge_set:
+                    continue
+                out.append((a, b))
+                if len(out) == k:
+                    break
+        return np.asarray(out, np.int64)
+
+    g = prepare(
+        train_pos, num_nodes, x, pad_multiple=pad_multiple, **node_fields
+    )
+    return LinkSplit(
+        graph=g,
+        train_pos=train_pos.astype(np.int32),
+        val_pos=val_pos.astype(np.int32),
+        val_neg=sample_neg(len(val_pos)).astype(np.int32),
+        test_pos=test_pos.astype(np.int32),
+        test_neg=sample_neg(len(test_pos)).astype(np.int32),
+    )
+
+
+# --- on-disk loaders ----------------------------------------------------------
+
+
+def load_cora(root: str):
+    """Planetoid raw format: ``cora.content`` + ``cora.cites``.
+
+    Returns (edges [E,2], x [N,F], labels [N], num_classes).
+    """
+    content = os.path.join(root, "cora.content")
+    cites = os.path.join(root, "cora.cites")
+    ids, feats, labels, label_ids = {}, [], [], {}
+    with open(content) as f:
+        for line in f:
+            parts = line.strip().split()
+            ids[parts[0]] = len(ids)
+            feats.append([float(t) for t in parts[1:-1]])
+            lab = parts[-1]
+            label_ids.setdefault(lab, len(label_ids))
+            labels.append(label_ids[lab])
+    edges = []
+    with open(cites) as f:
+        for line in f:
+            a, b = line.strip().split()
+            if a in ids and b in ids:
+                edges.append((ids[a], ids[b]))
+    return (
+        np.asarray(edges, np.int64),
+        np.asarray(feats, np.float32),
+        np.asarray(labels, np.int32),
+        len(label_ids),
+    )
+
+
+def load_ogbn_arxiv(root: str):
+    """OGB extracted-csv layout (``raw/edge.csv``, ``raw/node-feat.csv``...)."""
+    raw = os.path.join(root, "raw")
+    edges = np.loadtxt(os.path.join(raw, "edge.csv"), delimiter=",", dtype=np.int64)
+    x = np.loadtxt(os.path.join(raw, "node-feat.csv"), delimiter=",", dtype=np.float32)
+    labels = np.loadtxt(os.path.join(raw, "node-label.csv"), delimiter=",", dtype=np.int64)
+    return edges, x, labels.astype(np.int32).reshape(-1), int(labels.max()) + 1
+
+
+# --- synthetic fallbacks ------------------------------------------------------
+
+
+def synthetic_hierarchy(
+    num_nodes: int = 1024,
+    branching: int = 3,
+    feat_dim: int = 32,
+    ancestor_hops: int = 3,
+    extra_edge_frac: float = 0.02,
+    num_classes: int = 4,
+    seed: int = 0,
+):
+    """A noisy hierarchy with community-correlated features.
+
+    Structure: a ``branching``-ary tree over all nodes, **plus ancestor
+    edges up to ``ancestor_hops`` levels** (a truncated transitive closure)
+    and a few random cross edges.  The ancestor edges make the graph
+    structurally redundant: every held-out link has parallel 2-hop paths
+    (child—grandparent—parent), so link prediction from message passing is
+    well-posed — a pure tree would disconnect under edge removal and cap
+    ROC-AUC near chance.  Hierarchies have strong negative curvature, so
+    hyperbolic models fit them well — the signal the integration tests
+    assert (SURVEY.md §4.7).
+
+    Class = top-level subtree; features = class prototype + noise + a depth
+    coordinate.  Returns (edges [E,2], x [N,F], labels [N], num_classes).
+    """
+    rng = np.random.default_rng(seed)
+    parent = np.zeros(num_nodes, np.int64)
+    parent[1:] = (np.arange(1, num_nodes) - 1) // branching
+    edges = []
+    for i in range(1, num_nodes):
+        anc = i
+        for _ in range(max(1, ancestor_hops)):
+            anc = int(parent[anc])
+            edges.append((i, anc))
+            if anc == 0:
+                break
+    n_extra = int(num_nodes * extra_edge_frac)
+    for _ in range(n_extra):
+        u, v = rng.integers(0, num_nodes, 2)
+        if u != v:
+            edges.append((int(u), int(v)))
+    edges = np.asarray(edges, np.int64)
+
+    # class of a node = which depth-1 subtree it falls under
+    depth = np.zeros(num_nodes, np.int64)
+    top = np.zeros(num_nodes, np.int64)
+    for i in range(1, num_nodes):
+        depth[i] = depth[parent[i]] + 1
+        top[i] = i if depth[i] == 1 else top[parent[i]]
+    labels = (top % num_classes).astype(np.int32)
+    labels[0] = 0
+
+    protos = rng.normal(size=(num_classes, feat_dim)).astype(np.float32)
+    x = protos[labels] + 0.4 * rng.normal(size=(num_nodes, feat_dim)).astype(np.float32)
+    x[:, 0] = depth / max(depth.max(), 1)
+    return edges, x, labels, num_classes
+
+
+def node_split_masks(num_nodes: int, train_frac=0.6, val_frac=0.2, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(num_nodes)
+    n_tr = int(num_nodes * train_frac)
+    n_va = int(num_nodes * val_frac)
+    tr = np.zeros(num_nodes, bool)
+    va = np.zeros(num_nodes, bool)
+    te = np.zeros(num_nodes, bool)
+    tr[perm[:n_tr]] = True
+    va[perm[n_tr : n_tr + n_va]] = True
+    te[perm[n_tr + n_va :]] = True
+    return tr, va, te
+
+
+def load_graph(name: str, root: str | None = None, **synth_kw):
+    """Dispatch: real dataset if its files exist under ``root``, else synthetic.
+
+    Returns (edges, x, labels, num_classes, source) where source is
+    "disk" or "synthetic".
+    """
+    if root is not None:
+        if name == "cora" and os.path.exists(os.path.join(root, "cora.content")):
+            return (*load_cora(root), "disk")
+        if name == "ogbn-arxiv" and os.path.exists(
+            os.path.join(root, "raw", "edge.csv")
+        ):
+            return (*load_ogbn_arxiv(root), "disk")
+    defaults = {"cora": dict(num_nodes=2048, feat_dim=64, num_classes=7),
+                "ogbn-arxiv": dict(num_nodes=16384, feat_dim=128, num_classes=40)}
+    kw = {**defaults.get(name, {}), **synth_kw}
+    return (*synthetic_hierarchy(**kw), "synthetic")

@@ -1,0 +1,284 @@
+"""HGCN — hyperbolic graph convolutional network (reference workload 2).
+
+BASELINE.json configs[1]: HGCN on Cora / ogbn-arxiv, **Lorentz model**; the
+north-star metric is samples/sec/chip and matching test ROC-AUC
+(SURVEY.md §0, §3.2, §6).
+
+Model shape (Chami et al. NeurIPS 2019):
+
+    features --exp0--> manifold --[HGCConv × L]--> embeddings z
+    LP head: FermiDirac(d²(z_u, z_v)) → BCE → ROC-AUC
+    NC head: hyperbolic MLR → CE → accuracy/F1
+
+The whole step — forward over the full padded graph, loss, grad, Adam
+update — is one jitted XLA program.  Full-graph training is the natural
+TPU formulation for graphs of Cora/arxiv scale: the [N, d] node tensor and
+the padded edge list are static shapes resident in HBM, and every layer is
+one MXU matmul plus masked segment ops (SURVEY.md §7 hard-part #3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, NamedTuple, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from hyperspace_tpu.data import graphs as graph_data
+from hyperspace_tpu.nn.decoders import FermiDiracDecoder
+from hyperspace_tpu.nn.gcn import HGCConv, from_tangent0_coords, make_manifold
+from hyperspace_tpu.nn.mlr import LorentzMLR, HypMLR
+from hyperspace_tpu.utils import metrics as metrics_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class HGCNConfig:
+    feat_dim: int = 32
+    hidden_dims: Sequence[int] = (64, 16)
+    kind: str = "lorentz"  # BASELINE.json: Lorentz model for workload 2
+    c: float = 1.0
+    learn_c: bool = False
+    use_att: bool = False
+    dropout: float = 0.0
+    num_classes: int = 0  # NC head only when > 0
+    lr: float = 1e-2
+    weight_decay: float = 5e-4
+    neg_per_pos: int = 1  # LP negatives sampled per positive per step
+    dtype: Any = jnp.float32
+
+
+class HGCNEncoder(nn.Module):
+    """Feature lift (exp0) + stacked HGCConv layers."""
+
+    cfg: HGCNConfig
+
+    @nn.compact
+    def __call__(self, x, senders, receivers, edge_mask, *, deterministic=True):
+        cfg = self.cfg
+        m0 = make_manifold(cfg.kind, cfg.c)
+        # Euclidean features are origin-tangent coordinates; lift to the
+        # manifold (SURVEY.md §3.2 "embed: expmap₀(features)").
+        h = from_tangent0_coords(m0, x.astype(cfg.dtype))
+        c_prev = cfg.c
+        for i, d in enumerate(cfg.hidden_dims):
+            is_last = i == len(cfg.hidden_dims) - 1
+            h, m = HGCConv(
+                features=d,
+                kind=cfg.kind,
+                c_in=c_prev,
+                c_out=cfg.c,
+                learn_c=cfg.learn_c,
+                use_att=cfg.use_att,
+                dropout_rate=cfg.dropout,
+                activation=(lambda v: v) if is_last else nn.relu,
+                name=f"conv{i}",
+            )(h, senders, receivers, edge_mask, deterministic=deterministic)
+            c_prev = m.c
+        return h, m  # points on the final layer's manifold
+
+
+class HGCNLinkPred(nn.Module):
+    """Encoder + Fermi–Dirac decoder; returns edge logits."""
+
+    cfg: HGCNConfig
+
+    @nn.compact
+    def __call__(self, x, senders, receivers, edge_mask, pairs, *, deterministic=True):
+        z, m = HGCNEncoder(self.cfg, name="encoder")(
+            x, senders, receivers, edge_mask, deterministic=deterministic
+        )
+        sq = m.sqdist(z[pairs[:, 0]], z[pairs[:, 1]])
+        return FermiDiracDecoder(name="decoder")(sq)
+
+
+class HGCNNodeClf(nn.Module):
+    """Encoder + hyperbolic MLR head; returns per-node class logits."""
+
+    cfg: HGCNConfig
+
+    @nn.compact
+    def __call__(self, x, senders, receivers, edge_mask, *, deterministic=True):
+        z, m = HGCNEncoder(self.cfg, name="encoder")(
+            x, senders, receivers, edge_mask, deterministic=deterministic
+        )
+        head = LorentzMLR if self.cfg.kind == "lorentz" else HypMLR
+        return head(self.cfg.num_classes, m, name="head")(z)
+
+
+# --- training ----------------------------------------------------------------
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    key: jax.Array
+    step: jax.Array
+
+
+def make_optimizer(cfg: HGCNConfig) -> optax.GradientTransformation:
+    return optax.adamw(cfg.lr, weight_decay=cfg.weight_decay)
+
+
+def _device_graph(g: graph_data.Graph):
+    return (
+        jnp.asarray(g.x),
+        jnp.asarray(g.senders),
+        jnp.asarray(g.receivers),
+        jnp.asarray(g.edge_mask),
+    )
+
+
+# ---- link prediction ----
+
+
+def init_lp(cfg: HGCNConfig, g: graph_data.Graph, seed: int = 0):
+    model = HGCNLinkPred(cfg)
+    key = jax.random.PRNGKey(seed)
+    k_init, key = jax.random.split(key)
+    x, s, r, m = _device_graph(g)
+    dummy_pairs = jnp.zeros((2, 2), jnp.int32)
+    params = model.init({"params": k_init}, x, s, r, m, dummy_pairs)["params"]
+    opt = make_optimizer(cfg)
+    state = TrainState(params, opt.init(params), key, jnp.zeros((), jnp.int32))
+    return model, opt, state
+
+
+@partial(jax.jit, static_argnames=("model", "opt", "num_nodes"), donate_argnames=("state",))
+def train_step_lp(
+    model: HGCNLinkPred,
+    opt,
+    num_nodes: int,
+    state: TrainState,
+    graph_arrays,
+    train_pos: jax.Array,  # [P, 2]
+):
+    """One LP step: sample negatives on device, BCE on pos+neg logits."""
+    x, senders, receivers, edge_mask = graph_arrays
+    key, k_neg, k_drop = jax.random.split(state.key, 3)
+    n_neg = train_pos.shape[0] * model.cfg.neg_per_pos
+    neg = jax.random.randint(k_neg, (n_neg, 2), 0, num_nodes)
+
+    def loss_fn(params):
+        pairs = jnp.concatenate([train_pos, neg], axis=0)
+        logits = model.apply(
+            {"params": params}, x, senders, receivers, edge_mask, pairs,
+            deterministic=False, rngs={"dropout": k_drop},
+        )
+        labels = jnp.concatenate(
+            [jnp.ones(train_pos.shape[0]), jnp.zeros(n_neg)]
+        ).astype(logits.dtype)
+        return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    updates, opt_state = opt.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, key, state.step + 1), loss
+
+
+@partial(jax.jit, static_argnames=("model",))
+def eval_scores_lp(model: HGCNLinkPred, params, graph_arrays, pairs):
+    x, s, r, m = graph_arrays
+    return model.apply({"params": params}, x, s, r, m, pairs)
+
+
+def evaluate_lp(model, params, split: graph_data.LinkSplit, which: str = "test") -> dict:
+    ga = _device_graph(split.graph)
+    pos = jnp.asarray(getattr(split, f"{which}_pos"))
+    neg = jnp.asarray(getattr(split, f"{which}_neg"))
+    s_pos = np.asarray(eval_scores_lp(model, params, ga, pos))
+    s_neg = np.asarray(eval_scores_lp(model, params, ga, neg))
+    return {"roc_auc": metrics_lib.roc_auc(s_pos, s_neg)}
+
+
+def train_lp(
+    cfg: HGCNConfig,
+    split: graph_data.LinkSplit,
+    steps: int = 200,
+    seed: int = 0,
+    log_every: int = 0,
+) -> tuple[Any, Any, list]:
+    """Full LP training loop; returns (model, params, history)."""
+    model, opt, state = init_lp(cfg, split.graph, seed)
+    ga = _device_graph(split.graph)
+    train_pos = jnp.asarray(split.train_pos)
+    history = []
+    for i in range(steps):
+        state, loss = train_step_lp(model, opt, split.graph.num_nodes, state, ga, train_pos)
+        if log_every and (i + 1) % log_every == 0:
+            ev = evaluate_lp(model, state.params, split, "val")
+            history.append({"step": i + 1, "loss": float(loss), **ev})
+    return model, state.params, history
+
+
+# ---- node classification ----
+
+
+def init_nc(cfg: HGCNConfig, g: graph_data.Graph, seed: int = 0):
+    model = HGCNNodeClf(cfg)
+    key = jax.random.PRNGKey(seed)
+    k_init, key = jax.random.split(key)
+    x, s, r, m = _device_graph(g)
+    params = model.init({"params": k_init}, x, s, r, m)["params"]
+    opt = make_optimizer(cfg)
+    state = TrainState(params, opt.init(params), key, jnp.zeros((), jnp.int32))
+    return model, opt, state
+
+
+@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
+def train_step_nc(
+    model: HGCNNodeClf,
+    opt,
+    state: TrainState,
+    graph_arrays,
+    labels: jax.Array,  # [N] int32
+    train_mask: jax.Array,  # [N] bool
+):
+    x, senders, receivers, edge_mask = graph_arrays
+    key, k_drop = jax.random.split(state.key)
+
+    def loss_fn(params):
+        logits = model.apply(
+            {"params": params}, x, senders, receivers, edge_mask,
+            deterministic=False, rngs={"dropout": k_drop},
+        )
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        w = train_mask.astype(ce.dtype)
+        return jnp.sum(ce * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    updates, opt_state = opt.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, key, state.step + 1), loss
+
+
+@partial(jax.jit, static_argnames=("model",))
+def eval_logits_nc(model: HGCNNodeClf, params, graph_arrays):
+    x, s, r, m = graph_arrays
+    return model.apply({"params": params}, x, s, r, m)
+
+
+def train_nc(
+    cfg: HGCNConfig,
+    g: graph_data.Graph,
+    steps: int = 200,
+    seed: int = 0,
+) -> tuple[Any, Any, dict]:
+    model, opt, state = init_nc(cfg, g, seed)
+    ga = _device_graph(g)
+    labels = jnp.asarray(g.labels)
+    tr = jnp.asarray(g.train_mask)
+    for _ in range(steps):
+        state, loss = train_step_nc(model, opt, state, ga, labels, tr)
+    logits = np.asarray(eval_logits_nc(model, state.params, ga))
+    res = {
+        "loss": float(loss),
+        "val_acc": metrics_lib.accuracy(logits, g.labels, g.val_mask),
+        "test_acc": metrics_lib.accuracy(logits, g.labels, g.test_mask),
+        "test_f1": metrics_lib.f1_macro(logits, g.labels, cfg.num_classes, g.test_mask),
+    }
+    return model, state.params, res

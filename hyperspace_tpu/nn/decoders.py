@@ -1,0 +1,34 @@
+"""Link-prediction decoders (SURVEY.md §2 "Fermi–Dirac LP decoder").
+
+Chami et al. 2019: edge probability from the geodesic distance,
+
+    p(u ~ v) = 1 / ( exp( (d(u,v)² − r) / t ) + 1 ),
+
+with learnable radius ``r`` and temperature ``t``.  ROC-AUC of this score on
+held-out edges is the [B] north-star quality metric.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+
+
+class FermiDiracDecoder(nn.Module):
+    """Edge logits from squared distances; sigmoid(logit) = the F-D prob."""
+
+    r_init: float = 2.0
+    t_init: float = 1.0
+
+    @nn.compact
+    def __call__(self, sqdist: jax.Array) -> jax.Array:
+        r = self.param("r", nn.initializers.constant(self.r_init), ())
+        # inverse-softplus so softplus(t_raw) inits at t_init (python math:
+        # a jnp constant here would be staged under jit and unconcretizable)
+        t_raw = self.param(
+            "t_raw", nn.initializers.constant(math.log(math.expm1(self.t_init))), ()
+        )
+        t = nn.softplus(t_raw) + 1e-4
+        return (r - sqdist) / t  # logit; 1/(e^{(d²-r)/t}+1) = sigmoid(logit)

@@ -1,0 +1,156 @@
+"""Hyperbolic graph convolution (HGCN, Chami et al. NeurIPS 2019).
+
+SURVEY.md §2 "HGC conv layer" / §3.2: each layer is
+
+    linear in the tangent space at the origin  →  attention-weighted
+    neighbor aggregation (masked segment ops)  →  activation  →
+    expmap back at the *next* layer's curvature.
+
+TPU-first design decisions [PLAN]:
+
+- All dense work happens in **origin-tangent coordinates**: one big [N, d]
+  matmul on the MXU, no per-node exp/log in the inner loop.  (The reference
+  family computes aggregation in the tangent space at each node x_i; at the
+  origin the math is identical up to parallel transport and is one fused
+  matmul instead of N small ones — the standard TPU/XLA formulation.)
+- Aggregation over the padded edge list is masked ``segment_sum`` /
+  segment-softmax with a static ``num_segments`` — no ragged ops
+  (SURVEY.md §7 hard-part #3).
+- Per-layer curvature can be **learned** (softplus-parameterized), matching
+  the trainable-curvature option of the reference family.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import Lorentz, PoincareBall
+
+
+# --- segment ops (shared with any graph aggregation) --------------------------
+
+
+def segment_softmax(
+    logits: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Softmax of ``logits`` within each segment; masked entries get 0.
+
+    Max-subtracted for stability; safe for empty segments.
+    """
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    seg_max = jax.ops.segment_max(logits, segment_ids, num_segments)
+    seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
+    ex = jnp.exp(logits - seg_max[segment_ids])
+    if mask is not None:
+        ex = jnp.where(mask, ex, 0.0)
+    denom = jax.ops.segment_sum(ex, segment_ids, num_segments)
+    return ex / jnp.maximum(denom[segment_ids], 1e-15)
+
+
+# --- tangent coordinate helpers ----------------------------------------------
+
+
+def tangent0_coords(manifold, x: jax.Array) -> jax.Array:
+    """Origin-tangent coordinates of logmap0(x) as a d-vector.
+
+    On the hyperboloid, origin-tangent vectors have time coordinate 0, so
+    the space part is a faithful coordinate chart; on the ball the tangent
+    space at 0 is just R^d.
+    """
+    v = manifold.logmap0(x)
+    if isinstance(manifold, Lorentz):
+        return v[..., 1:]
+    return v
+
+
+def from_tangent0_coords(manifold, v: jax.Array) -> jax.Array:
+    """Inverse of :func:`tangent0_coords` followed by expmap0."""
+    if isinstance(manifold, Lorentz):
+        v = jnp.concatenate([jnp.zeros_like(v[..., :1]), v], axis=-1)
+    return manifold.expmap0(v)
+
+
+def make_manifold(kind: str, c) -> Any:
+    if kind == "lorentz":
+        return Lorentz(c)
+    if kind == "poincare":
+        return PoincareBall(c)
+    raise ValueError(f"unknown manifold kind {kind!r}")
+
+
+class HGCConv(nn.Module):
+    """One hyperbolic graph-conv layer.
+
+    Input: points on ``(kind, c_in)``; output: points on ``(kind, c_out)``
+    — the curvature transfer happens by activating in the shared origin
+    tangent chart and exp-mapping at the output curvature (SURVEY.md §3.2
+    "curvature_{l+1} transfer").  When ``learn_c`` is set, ``c_out`` is a
+    per-layer learned parameter (softplus of a free scalar, init at the
+    given value).
+    """
+
+    features: int  # manifold dimension of the output
+    kind: str = "lorentz"
+    c_in: float = 1.0
+    c_out: float = 1.0
+    learn_c: bool = False
+    use_att: bool = False
+    use_bias: bool = True
+    activation: Callable = nn.relu
+    dropout_rate: float = 0.0
+    kernel_init: Callable = nn.initializers.glorot_uniform()
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,  # [N, ambient_in] points
+        senders: jax.Array,  # [E] int32
+        receivers: jax.Array,  # [E] int32
+        edge_mask: jax.Array,  # [E] bool
+        *,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, Any]:
+        m_in = make_manifold(self.kind, self.c_in)
+        if self.learn_c:
+            import numpy as np
+
+            init = float(np.log(np.expm1(self.c_out)))
+            c_raw = self.param("c_raw", nn.initializers.constant(init), ())
+            c_out = nn.softplus(c_raw)
+        else:
+            c_out = self.c_out
+        m_out = make_manifold(self.kind, c_out)
+
+        n = x.shape[0]
+        v = tangent0_coords(m_in, x)  # [N, d_in]
+        kernel = self.param("kernel", self.kernel_init, (v.shape[-1], self.features), v.dtype)
+        h = v @ kernel  # the MXU matmul
+        if self.use_bias:
+            h = h + self.param("bias", nn.initializers.zeros, (self.features,), v.dtype)
+        if self.dropout_rate > 0.0:
+            h = nn.Dropout(self.dropout_rate)(h, deterministic=deterministic)
+
+        hs = h[senders]  # [E, d]
+        if self.use_att:
+            # GAT-style additive attention in the tangent chart.
+            a_s = self.param("att_src", self.kernel_init, (self.features, 1), h.dtype)
+            a_r = self.param("att_dst", self.kernel_init, (self.features, 1), h.dtype)
+            logits = nn.leaky_relu((hs @ a_s)[:, 0] + (h[receivers] @ a_r)[:, 0], 0.2)
+            w = segment_softmax(logits, receivers, n, mask=edge_mask)
+        else:
+            # mean aggregation: 1/deg with masked degree count
+            ones = edge_mask.astype(h.dtype)
+            deg = jax.ops.segment_sum(ones, receivers, n)
+            w = ones / jnp.maximum(deg[receivers], 1.0)
+        agg = jax.ops.segment_sum(w[:, None] * hs, receivers, n)  # [N, d]
+
+        out = from_tangent0_coords(m_out, self.activation(agg))
+        return out, m_out

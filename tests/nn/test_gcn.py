@@ -1,0 +1,101 @@
+"""HGCConv unit tests (SURVEY.md §4.1/§4.4 style): segment ops, on-manifold
+outputs, masked-padding invariance."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.manifolds import Lorentz, PoincareBall
+from hyperspace_tpu.nn.gcn import (
+    HGCConv,
+    from_tangent0_coords,
+    segment_softmax,
+    tangent0_coords,
+)
+
+
+def test_segment_softmax_matches_dense():
+    logits = jnp.asarray([0.1, 1.0, -0.5, 2.0, 0.0])
+    seg = jnp.asarray([0, 0, 1, 1, 1])
+    w = segment_softmax(logits, seg, 2)
+    w0 = jax.nn.softmax(logits[:2])
+    w1 = jax.nn.softmax(logits[2:])
+    np.testing.assert_allclose(np.asarray(w), np.concatenate([w0, w1]), rtol=1e-6)
+
+
+def test_segment_softmax_mask_and_empty_segment():
+    logits = jnp.asarray([1.0, 2.0, 3.0])
+    seg = jnp.asarray([0, 0, 2])
+    mask = jnp.asarray([True, False, False])
+    w = segment_softmax(logits, seg, 3, mask=mask)
+    np.testing.assert_allclose(np.asarray(w), [1.0, 0.0, 0.0], atol=1e-12)
+
+
+@pytest.mark.parametrize("kind", ["lorentz", "poincare"])
+def test_tangent0_roundtrip(kind, rng):
+    m = Lorentz(0.7) if kind == "lorentz" else PoincareBall(0.7)
+    v = jnp.asarray(rng.normal(size=(5, 4)) * 0.3)
+    x = from_tangent0_coords(m, v)
+    assert float(jnp.max(m.check_point(x))) < 1e-8
+    back = tangent0_coords(m, x)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(v), rtol=1e-6, atol=1e-8)
+
+
+def _tiny_graph(n=6, e=10, seed=0):
+    rng = np.random.default_rng(seed)
+    senders = rng.integers(0, n, e).astype(np.int32)
+    receivers = rng.integers(0, n, e).astype(np.int32)
+    mask = np.ones(e, bool)
+    return jnp.asarray(senders), jnp.asarray(receivers), jnp.asarray(mask)
+
+
+@pytest.mark.parametrize("kind", ["lorentz", "poincare"])
+@pytest.mark.parametrize("use_att", [False, True])
+def test_hgcconv_on_manifold(kind, use_att, rng):
+    n, d_out = 6, 8
+    m_in = Lorentz(1.0) if kind == "lorentz" else PoincareBall(1.0)
+    x = m_in.random_normal(jax.random.PRNGKey(0), (n, m_in.ambient_dim(4)), jnp.float64)
+    s, r, mask = _tiny_graph(n)
+    conv = HGCConv(features=d_out, kind=kind, c_in=1.0, c_out=0.5, use_att=use_att)
+    params = conv.init(jax.random.PRNGKey(1), x, s, r, mask)
+    y, m_out = conv.apply(params, x, s, r, mask)
+    assert y.shape == (n, m_out.ambient_dim(d_out))
+    assert float(jnp.max(m_out.check_point(y))) < 1e-6
+    assert abs(float(m_out.c) - 0.5) < 1e-12
+
+
+def test_hgcconv_padding_invariance(rng):
+    """Extra masked edges must not change the output at all."""
+    n = 5
+    m = Lorentz(1.0)
+    x = m.random_normal(jax.random.PRNGKey(2), (n, 5), jnp.float64)
+    s, r, mask = _tiny_graph(n, e=8, seed=3)
+    conv = HGCConv(features=4, kind="lorentz", use_att=True)
+    params = conv.init(jax.random.PRNGKey(3), x, s, r, mask)
+    y1, _ = conv.apply(params, x, s, r, mask)
+    # pad with junk edges, masked out
+    pad = jnp.asarray(np.full(7, 2, np.int32))
+    s2 = jnp.concatenate([s, pad])
+    r2 = jnp.concatenate([r, pad])
+    mask2 = jnp.concatenate([mask, jnp.zeros(7, bool)])
+    y2, _ = conv.apply(params, x, s2, r2, mask2)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-12, atol=1e-12)
+
+
+def test_hgcconv_learned_curvature_grad():
+    """learn_c exposes a c_raw param that receives a gradient."""
+    n = 4
+    m = Lorentz(1.0)
+    x = m.random_normal(jax.random.PRNGKey(4), (n, 5), jnp.float64)
+    s, r, mask = _tiny_graph(n, e=6, seed=5)
+    conv = HGCConv(features=4, kind="lorentz", learn_c=True)
+    params = conv.init(jax.random.PRNGKey(5), x, s, r, mask)
+    assert "c_raw" in params["params"]
+
+    def loss(p):
+        y, m_out = conv.apply(p, x, s, r, mask)
+        return jnp.sum(m_out.sqdist(y[:1], y[1:2]))
+
+    g = jax.grad(loss)(params)
+    assert np.isfinite(float(g["params"]["c_raw"]))
