@@ -107,3 +107,39 @@ class Manifold(abc.ABC):
     # The ambient (storage) dimension for an n-dim manifold; Lorentz uses n+1.
     def ambient_dim(self, dim: int) -> int:
         return dim
+
+    # --- origin coordinate chart ---------------------------------------------
+    # Orthonormal coordinates on the tangent space at the origin, used by
+    # distributions (WrappedNormal) and any code that needs an isometry
+    # T_origin ≅ R^n.  Defaults are correct for manifolds whose origin
+    # tangent space is R^n with the standard metric (Euclidean).
+
+    def coord_dim(self, ambient_dim: int) -> int:
+        """Intrinsic dimension of the origin tangent space for a given
+        ambient (storage) width."""
+        return ambient_dim
+
+    def tangent_from_origin_coords(self, v: jax.Array) -> jax.Array:
+        """Orthonormal origin coordinates → ambient tangent vector at the
+        origin (an isometry onto T_origin)."""
+        return v
+
+    def origin_coords_from_tangent(self, u: jax.Array) -> jax.Array:
+        """Inverse of :meth:`tangent_from_origin_coords`."""
+        return u
+
+    # --- expmap Jacobian (wrapped-normal density correction) ------------------
+    # log |det d exp_x| in orthonormal tangent coordinates w.r.t. the
+    # Riemannian volume.  Flat default (0) is exact for Euclidean space;
+    # curved manifolds override both forms.
+
+    def logdetexp(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        """log-Jacobian of exp_x evaluated at log_x(y); shape [...]."""
+        return jnp.zeros(jnp.broadcast_shapes(x.shape[:-1], y.shape[:-1]),
+                         x.dtype)
+
+    def logdetexp_from_coords(self, v: jax.Array) -> jax.Array:
+        """Same quantity from origin-chart coordinates of the tangent whose
+        norm is the geodesic radius (‖v‖ = dist(x, exp_x(transport(v)))) —
+        lets samplers that already hold v skip the exp/log round-trip."""
+        return jnp.zeros(v.shape[:-1], v.dtype)

@@ -139,6 +139,25 @@ class Lorentz(Manifold):
         return (d - 1) * jnp.log(smath.clamp_min(
             smath.sinhc(smath.sqrt_c(c) * r), smath.eps_for(x.dtype)))
 
+    def logdetexp_from_coords(self, v: jax.Array) -> jax.Array:
+        c = self._c(v.dtype)
+        r = smath.safe_norm(v, keepdims=False)  # coords are the space part
+        return (v.shape[-1] - 1) * jnp.log(smath.clamp_min(
+            smath.sinhc(smath.sqrt_c(c) * r), smath.eps_for(v.dtype)))
+
+    # --- origin coordinate chart ---------------------------------------------
+    # Tangents at the origin have time coordinate 0 and carry the standard
+    # Euclidean metric on the space part, so the chart is pad/strip time.
+
+    def coord_dim(self, ambient_dim: int) -> int:
+        return ambient_dim - 1
+
+    def tangent_from_origin_coords(self, v: jax.Array) -> jax.Array:
+        return jnp.concatenate([jnp.zeros_like(v[..., :1]), v], axis=-1)
+
+    def origin_coords_from_tangent(self, u: jax.Array) -> jax.Array:
+        return u[..., 1:]
+
     # --- aggregation (used by HGCN / attention on the hyperboloid) ------------
 
     def centroid(self, x: jax.Array, w: jax.Array | None = None) -> jax.Array:

@@ -180,6 +180,16 @@ class PoincareBall(Manifold):
     def origin(self, shape, dtype=jnp.float32) -> jax.Array:
         return jnp.zeros(shape, dtype)
 
+    # --- origin coordinate chart ---------------------------------------------
+    # The metric at 0 is λ₀² δ = 4 δ (independent of c), so orthonormal
+    # coordinates differ from ambient tangents by the factor λ₀ = 2.
+
+    def tangent_from_origin_coords(self, v: jax.Array) -> jax.Array:
+        return v / 2.0
+
+    def origin_coords_from_tangent(self, u: jax.Array) -> jax.Array:
+        return u * 2.0
+
     def logdetexp(self, x: jax.Array, y: jax.Array) -> jax.Array:
         """log |det d exp_x| at log_x(y), w.r.t. orthonormal tangent coords
         and the Riemannian volume: (d−1)·log( sinh(√c r)/(√c r) ), r=dist.
@@ -192,6 +202,12 @@ class PoincareBall(Manifold):
         r = self.dist(x, y)
         return (d - 1) * jnp.log(smath.clamp_min(
             smath.sinhc(smath.sqrt_c(c) * r), smath.eps_for(x.dtype)))
+
+    def logdetexp_from_coords(self, v: jax.Array) -> jax.Array:
+        c = self._c(v.dtype)
+        r = smath.safe_norm(v, keepdims=False)
+        return (v.shape[-1] - 1) * jnp.log(smath.clamp_min(
+            smath.sinhc(smath.sqrt_c(c) * r), smath.eps_for(v.dtype)))
 
     # --- gyro extras used by models ------------------------------------------
 

@@ -113,6 +113,40 @@ class Product(Manifold):
     def check_point(self, x):
         return sum(m.check_point(xi) for m, xi in zip(self.factors, self.split(x)))
 
+    def logdetexp(self, x, y):
+        """exp on a product is the product of factor exps, so the Jacobian
+        determinant factorizes: Σ factor logdetexp."""
+        xs, ys = self.split(x), self.split(y)
+        return sum(m.logdetexp(xi, yi) for m, xi, yi in zip(self.factors, xs, ys))
+
+    def logdetexp_from_coords(self, v: jax.Array) -> jax.Array:
+        out, o = 0, 0
+        for m, d in zip(self.factors, self.dims):
+            cd = m.coord_dim(d)
+            out = out + m.logdetexp_from_coords(
+                jax.lax.slice_in_dim(v, o, o + cd, axis=-1))
+            o += cd
+        return out
+
+    def coord_dim(self, ambient_dim: int) -> int:
+        assert ambient_dim == self.total_dim
+        return sum(m.coord_dim(d) for m, d in zip(self.factors, self.dims))
+
+    def tangent_from_origin_coords(self, v: jax.Array) -> jax.Array:
+        parts, o = [], 0
+        for m, d in zip(self.factors, self.dims):
+            cd = m.coord_dim(d)
+            parts.append(m.tangent_from_origin_coords(
+                jax.lax.slice_in_dim(v, o, o + cd, axis=-1)))
+            o += cd
+        return self._join(parts)
+
+    def origin_coords_from_tangent(self, u: jax.Array) -> jax.Array:
+        return self._join([
+            m.origin_coords_from_tangent(ui)
+            for m, ui in zip(self.factors, self.split(u))
+        ])
+
     def random_normal(self, key, shape, dtype=jnp.float32, std: float = 1.0):
         assert shape[-1] == self.total_dim
         keys = jax.random.split(key, len(self.factors))

@@ -88,3 +88,31 @@ class Sphere(Manifold):
         c = self._c(dtype)
         o = jnp.zeros(shape, dtype)
         return o.at[..., 0].set(1.0 / smath.sqrt_c(c))
+
+    def logdetexp(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        """log |det d exp_x|: (d−1)·log( sin(√c r)/(√c r) ), r = dist —
+        the positive-curvature twin of the hyperbolic sinhc form."""
+        d = x.shape[-1] - 1  # manifold dim; ambient is d+1
+        r = self.dist(x, y)
+        c = self._c(x.dtype)
+        return (d - 1) * jnp.log(smath.clamp_min(
+            smath.sinc_(smath.sqrt_c(c) * r), smath.eps_for(x.dtype)))
+
+    def logdetexp_from_coords(self, v: jax.Array) -> jax.Array:
+        c = self._c(v.dtype)
+        r = smath.safe_norm(v, keepdims=False)
+        return (v.shape[-1] - 1) * jnp.log(smath.clamp_min(
+            smath.sinc_(smath.sqrt_c(c) * r), smath.eps_for(v.dtype)))
+
+    # --- origin coordinate chart ---------------------------------------------
+    # Tangents at the origin (1/√c, 0, …) have first coordinate 0 and the
+    # standard Euclidean metric on the rest: pad/strip the first coordinate.
+
+    def coord_dim(self, ambient_dim: int) -> int:
+        return ambient_dim - 1
+
+    def tangent_from_origin_coords(self, v: jax.Array) -> jax.Array:
+        return jnp.concatenate([jnp.zeros_like(v[..., :1]), v], axis=-1)
+
+    def origin_coords_from_tangent(self, u: jax.Array) -> jax.Array:
+        return u[..., 1:]

@@ -107,11 +107,9 @@ class HypAct(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        v = self.manifold_in.logmap0(x)
-        if isinstance(self.manifold_in, Lorentz):
-            # origin-tangent vectors on the hyperboloid have time coord 0;
-            # activate only the space part so the vector stays tangent.
-            v = jnp.concatenate([v[..., :1] * 0.0, self.activation(v[..., 1:])], axis=-1)
-        else:
-            v = self.activation(v)
-        return self.manifold_out.expmap0(v)  # expmap0 ends in proj
+        # activate in the origin chart: unconstrained coordinates, so any
+        # elementwise nonlinearity keeps the result a valid tangent vector
+        m_in, m_out = self.manifold_in, self.manifold_out
+        v = m_in.origin_coords_from_tangent(m_in.logmap0(x))
+        v = self.activation(v)
+        return m_out.expmap0(m_out.tangent_from_origin_coords(v))

@@ -51,12 +51,23 @@ def hyp_mlr_logits(
     return (lam_p * a_norm / sc) * jnp.arcsinh(arg)
 
 
-class HypMLR(nn.Module):
-    """Hyperbolic softmax head for ball-valued features.
+def _mlr_apply(module: nn.Module, xb: jax.Array, ball: PoincareBall,
+               num_classes: int, p_init: Callable, a_init: Callable) -> jax.Array:
+    """Shared param declaration + logits for ball-coordinate inputs.
 
     Hyperplane points p_k are stored as origin-tangent vectors (exp0 in the
-    forward pass — see hyperspace_tpu/nn/layers.py parameterization note).
+    forward pass — see hyperspace_tpu/nn/layers.py parameterization note;
+    expmap0 already ends in proj).
     """
+    d = xb.shape[-1]
+    p_t = module.param("p_tangent", p_init, (num_classes, d), xb.dtype)
+    a = module.param("a", a_init, (num_classes, d), xb.dtype)
+    p = ball.expmap0(p_t)
+    return hyp_mlr_logits(xb, p, a, ball.c)
+
+
+class HypMLR(nn.Module):
+    """Hyperbolic softmax head for ball-valued features."""
 
     num_classes: int
     manifold: PoincareBall
@@ -65,11 +76,7 @@ class HypMLR(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        d = x.shape[-1]
-        p_t = self.param("p_tangent", self.p_init, (self.num_classes, d), x.dtype)
-        a = self.param("a", self.a_init, (self.num_classes, d), x.dtype)
-        p = self.manifold.proj(self.manifold.expmap0(p_t))
-        return hyp_mlr_logits(x, p, a, self.manifold.c)
+        return _mlr_apply(self, x, self.manifold, self.num_classes, self.p_init, self.a_init)
 
 
 class LorentzMLR(nn.Module):
@@ -80,14 +87,11 @@ class LorentzMLR(nn.Module):
 
     num_classes: int
     manifold: object  # Lorentz
+    p_init: Callable = nn.initializers.zeros
+    a_init: Callable = nn.initializers.glorot_uniform()
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         c = self.manifold.c
         xb = lorentz_to_ball(x, c)
-        ball = PoincareBall(c)
-        d = xb.shape[-1]
-        p_t = self.param("p_tangent", nn.initializers.zeros, (self.num_classes, d), xb.dtype)
-        a = self.param("a", nn.initializers.glorot_uniform(), (self.num_classes, d), xb.dtype)
-        p = ball.proj(ball.expmap0(p_t))
-        return hyp_mlr_logits(xb, p, a, c)
+        return _mlr_apply(self, xb, PoincareBall(c), self.num_classes, self.p_init, self.a_init)

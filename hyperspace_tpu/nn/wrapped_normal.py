@@ -30,7 +30,6 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from hyperspace_tpu.manifolds import Lorentz, PoincareBall
 
 
 def _log_normal(v: jax.Array, scale: jax.Array) -> jax.Array:
@@ -69,42 +68,35 @@ class WrappedNormal:
     def dim(self) -> int:
         return self.scale.shape[-1]
 
-    # --- coordinate helpers ---------------------------------------------------
-
-    def _tangent_from_coords(self, v: jax.Array) -> jax.Array:
-        """Orthonormal coords at the origin → ambient tangent vector."""
-        if isinstance(self.manifold, Lorentz):
-            return jnp.concatenate([jnp.zeros_like(v[..., :1]), v], axis=-1)
-        if isinstance(self.manifold, PoincareBall):
-            return v / 2.0  # λ₀ = 2
-        raise TypeError(f"WrappedNormal: unsupported manifold {self.manifold!r}")
-
-    def _coords_from_tangent(self, u: jax.Array) -> jax.Array:
-        if isinstance(self.manifold, Lorentz):
-            return u[..., 1:]
-        if isinstance(self.manifold, PoincareBall):
-            return u * 2.0
-        raise TypeError(f"WrappedNormal: unsupported manifold {self.manifold!r}")
-
     # --- distribution API -----------------------------------------------------
 
     def rsample(self, key: jax.Array, sample_shape: tuple = ()) -> jax.Array:
+        return self._rsample_with_coords(key, sample_shape)[0]
+
+    def _rsample_with_coords(self, key: jax.Array, sample_shape: tuple = ()):
+        """(z, v): the sample and its origin-chart coordinates — callers
+        holding v can evaluate the density without the exp/log round-trip."""
         m = self.manifold
         shape = sample_shape + self.scale.shape
         v = self.scale * jax.random.normal(key, shape, self.scale.dtype)
-        u0 = self._tangent_from_coords(v)
+        u0 = m.tangent_from_origin_coords(v)
         loc = jnp.broadcast_to(self.loc, sample_shape + self.loc.shape)
         u = m.ptransp0(loc, u0)
-        return m.expmap(loc, u)  # expmap ends in proj on every manifold
+        return m.expmap(loc, u), v  # expmap ends in proj on every manifold
 
     def log_prob(self, z: jax.Array) -> jax.Array:
         """Log density w.r.t. the Riemannian volume measure; shape [...]."""
         m = self.manifold
         u = m.logmap(self.loc, z)
         u0 = m.ptransp(self.loc, m.origin(u.shape, u.dtype), u)
-        v = self._coords_from_tangent(u0)
+        v = m.origin_coords_from_tangent(u0)
         return _log_normal(v, self.scale) - m.logdetexp(self.loc, z)
 
     def sample_and_log_prob(self, key: jax.Array, sample_shape: tuple = ()):
-        z = self.rsample(key, sample_shape)
-        return z, self.log_prob(z)
+        """Sample + density in one pass: the freshly-drawn coordinates v give
+        the density directly (‖v‖ is the geodesic radius, transport is an
+        isometry), skipping log_prob's logmap/ptransp/arcosh inverse chain —
+        cheaper and boundary-stable on the VAE hot path."""
+        z, v = self._rsample_with_coords(key, sample_shape)
+        lp = _log_normal(v, self.scale) - self.manifold.logdetexp_from_coords(v)
+        return z, lp

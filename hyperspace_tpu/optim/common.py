@@ -1,0 +1,17 @@
+"""Shared optimizer plumbing (schedule resolution)."""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+ScalarOrSchedule = Union[float, Callable[[jax.Array], jax.Array]]
+
+
+def lr_at(learning_rate: ScalarOrSchedule, count: jax.Array) -> jax.Array:
+    """Resolve a constant-or-schedule learning rate at a step count."""
+    if callable(learning_rate):
+        return learning_rate(count)
+    return jnp.asarray(learning_rate)

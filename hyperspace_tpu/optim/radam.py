@@ -27,27 +27,20 @@ parameter shards by construction.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Union
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
+from hyperspace_tpu.optim.common import ScalarOrSchedule, lr_at
 from hyperspace_tpu.optim.tags import map_tagged
-
-ScalarOrSchedule = Union[float, Callable[[jax.Array], jax.Array]]
 
 
 class RAdamState(NamedTuple):
     count: jax.Array
     mu: Any  # first moment: tangent vectors (manifold) / elementwise (None)
     nu: Any  # second moment: [..., 1] row-scalars (manifold) / elementwise
-
-
-def _lr_at(learning_rate: ScalarOrSchedule, count: jax.Array) -> jax.Array:
-    if callable(learning_rate):
-        return learning_rate(count)
-    return jnp.asarray(learning_rate)
 
 
 def riemannian_adam(
@@ -70,21 +63,19 @@ def riemannian_adam(
     """
 
     def init_fn(params):
-        def one(tag, p):
-            if tag is None:
-                return jnp.zeros_like(p), jnp.zeros_like(p)
-            return jnp.zeros_like(p), jnp.zeros(p.shape[:-1] + (1,), p.dtype)
-
-        mn = map_tagged(one, tags, params)
-        mu = map_tagged(lambda t, x: x[0], tags, mn)
-        nu = map_tagged(lambda t, x: x[1], tags, mn)
+        mu = map_tagged(lambda t, p: jnp.zeros_like(p), tags, params)
+        nu = map_tagged(
+            lambda t, p: jnp.zeros_like(p) if t is None
+            else jnp.zeros(p.shape[:-1] + (1,), p.dtype),
+            tags, params,
+        )
         return RAdamState(count=jnp.zeros((), jnp.int32), mu=mu, nu=nu)
 
     def update_fn(grads, state, params):
         if params is None:
             raise ValueError("riemannian_adam requires params")
         count = state.count + 1
-        lr = _lr_at(learning_rate, state.count)
+        lr = lr_at(learning_rate, state.count)
         ftype = jnp.result_type(float)  # f64 under x64, f32 on TPU
         c1 = 1.0 - b1 ** count.astype(ftype)
         c2 = 1.0 - b2 ** count.astype(ftype)

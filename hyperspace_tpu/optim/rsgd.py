@@ -22,25 +22,18 @@ correct Riemannian accumulation.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional, Union
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
+from hyperspace_tpu.optim.common import ScalarOrSchedule, lr_at
 from hyperspace_tpu.optim.tags import map_tagged
-
-ScalarOrSchedule = Union[float, Callable[[jax.Array], jax.Array]]
 
 
 class RSGDState(NamedTuple):
     count: jax.Array
-
-
-def _lr_at(learning_rate: ScalarOrSchedule, count: jax.Array) -> jax.Array:
-    if callable(learning_rate):
-        return learning_rate(count)
-    return jnp.asarray(learning_rate)
 
 
 def riemannian_sgd(
@@ -69,7 +62,7 @@ def riemannian_sgd(
     def update_fn(grads, state, params):
         if params is None:
             raise ValueError("riemannian_sgd requires params")
-        lr = _lr_at(learning_rate, state.count)
+        lr = lr_at(learning_rate, state.count)
         if burnin_steps > 0:
             lr = jnp.where(state.count < burnin_steps, lr * burnin_factor, lr)
 

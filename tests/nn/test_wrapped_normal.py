@@ -103,3 +103,47 @@ def test_reparameterized_gradients_flow_to_loc_and_scale():
     for leaf in jax.tree_util.tree_leaves(g):
         assert np.isfinite(np.asarray(leaf)).all()
     assert float(jnp.linalg.norm(g["loc"])) > 0.0
+
+
+def test_sample_and_log_prob_matches_log_prob():
+    """The v-direct density path must equal the inverse-chain log_prob."""
+    import jax
+
+    from hyperspace_tpu.manifolds import Lorentz, PoincareBall, Product, Euclidean, Sphere
+    from hyperspace_tpu.nn.wrapped_normal import WrappedNormal
+
+    for m, d_amb, d_coord in [
+        (PoincareBall(1.3), 3, 3),
+        (Lorentz(0.7), 4, 3),
+        (Product([Lorentz(1.0), PoincareBall(0.5), Euclidean()], [3, 2, 2]), 7, 6),
+    ]:
+        loc = m.random_normal(jax.random.PRNGKey(0), (5, d_amb), jnp.float64, std=0.3)
+        scale = 0.5 * jnp.ones((5, d_coord), jnp.float64)
+        dist = WrappedNormal(m, loc, scale)
+        z, lp_fast = dist.sample_and_log_prob(jax.random.PRNGKey(1))
+        lp_ref = dist.log_prob(z)
+        np.testing.assert_allclose(
+            np.asarray(lp_fast), np.asarray(lp_ref), rtol=1e-8, atol=1e-9)
+
+
+def test_product_log_prob_factorizes():
+    """Independent factors: product log-density = sum of factor densities."""
+    import jax
+
+    from hyperspace_tpu.manifolds import Lorentz, PoincareBall, Product
+    from hyperspace_tpu.nn.wrapped_normal import WrappedNormal
+
+    mL, mB = Lorentz(1.0), PoincareBall(1.0)
+    mP = Product([mL, mB], [4, 3])
+    locL = mL.random_normal(jax.random.PRNGKey(2), (6, 4), jnp.float64, std=0.2)
+    locB = mB.random_normal(jax.random.PRNGKey(3), (6, 3), jnp.float64, std=0.2)
+    loc = jnp.concatenate([locL, locB], axis=-1)
+    sL = 0.4 * jnp.ones((6, 3), jnp.float64)
+    sB = 0.6 * jnp.ones((6, 3), jnp.float64)
+    dist = WrappedNormal(mP, loc, jnp.concatenate([sL, sB], axis=-1))
+    z = dist.rsample(jax.random.PRNGKey(4))
+    zL, zB = z[..., :4], z[..., 4:]
+    lp = dist.log_prob(z)
+    lp_sum = (WrappedNormal(mL, locL, sL).log_prob(zL)
+              + WrappedNormal(mB, locB, sB).log_prob(zB))
+    np.testing.assert_allclose(np.asarray(lp), np.asarray(lp_sum), rtol=1e-8)
