@@ -1,0 +1,175 @@
+"""HyboNet — fully-hyperbolic Lorentz transformer (reference workload 3).
+
+BASELINE.json configs[2]: hyperbolic transformer for text classification,
+semantics per Chen et al. ACL 2022 (SURVEY.md §2 "HyboNet model").
+
+Architecture [PLAN], everything on the hyperboloid:
+
+    tokens ──(tangent embed + positional tangent)── exp₀ ──► points
+    × L blocks:   x ← midpoint(x, MHA(x))          (hyperbolic residual)
+                  x ← midpoint(x, FFN(x))          (2 × LorentzLinear)
+    pool: masked Lorentz centroid over the sequence
+    head: Lorentz MLR → class logits
+
+The hyperbolic residual is the Lorentz midpoint (centroid of the pair) —
+the standard fully-hyperbolic replacement for ``x + f(x)``; LorentzLinear
+and the attention aggregation keep every intermediate exactly on-manifold,
+so no tangent round-trips appear anywhere in a block (the HyboNet design
+point, and the reason this maps well onto the MXU: blocks are matmuls +
+row-wise time-coordinate reconstructions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from hyperspace_tpu.manifolds import Lorentz
+from hyperspace_tpu.nn.attention import HypMultiHeadAttention
+from hyperspace_tpu.nn.gcn import from_tangent0_coords
+from hyperspace_tpu.nn.layers import LorentzLinear
+from hyperspace_tpu.nn.mlr import LorentzMLR
+
+
+@dataclasses.dataclass(frozen=True)
+class HyboNetConfig:
+    vocab_size: int = 512
+    num_classes: int = 4
+    max_len: int = 32
+    dim: int = 64  # manifold dim (ambient dim+1)
+    num_heads: int = 4
+    num_layers: int = 2
+    ffn_mult: int = 2
+    c: float = 1.0
+    lr: float = 1e-3
+    weight_decay: float = 1e-4
+    dropout: float = 0.0
+    batch_size: int = 64
+    use_tiled_attention: bool = False
+    dtype: Any = jnp.float32
+
+
+class HyboNetBlock(nn.Module):
+    cfg: HyboNetConfig
+    manifold: Lorentz
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array, *, deterministic=True):
+        cfg, m = self.cfg, self.manifold
+        # self-attention sublayer with padding mask
+        att_mask = mask[..., None, :] & mask[..., :, None]  # [B, L, L]
+        a = HypMultiHeadAttention(
+            dim=cfg.dim, num_heads=cfg.num_heads, manifold=m,
+            use_tiled=cfg.use_tiled_attention, name="mha",
+        )(x, mask=att_mask)
+        x = m.centroid(jnp.stack([x, a], axis=-2))  # hyperbolic residual
+        # FFN sublayer: expand (with tangent ReLU on ambient input) → project
+        f = LorentzLinear(cfg.dim * cfg.ffn_mult, m, activation=nn.relu, name="ffn_in")(x)
+        f = LorentzLinear(cfg.dim, m, name="ffn_out")(f)
+        x = m.centroid(jnp.stack([x, f], axis=-2))
+        return x
+
+
+class HyboNetClassifier(nn.Module):
+    cfg: HyboNetConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array, mask: jax.Array, *, deterministic=True):
+        cfg = self.cfg
+        m = Lorentz(cfg.c)
+        emb = self.param(
+            "tok_embed", nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.dim), cfg.dtype)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (cfg.max_len, cfg.dim), cfg.dtype)
+        v = emb[tokens] + pos[None, : tokens.shape[-1]]  # origin-tangent coords
+        if cfg.dropout > 0:
+            v = nn.Dropout(cfg.dropout)(v, deterministic=deterministic)
+        x = from_tangent0_coords(m, v)  # [B, L, dim+1] on the hyperboloid
+        for i in range(cfg.num_layers):
+            x = HyboNetBlock(cfg, m, name=f"block{i}")(
+                x, mask, deterministic=deterministic)
+        # masked centroid pooling over the sequence
+        pooled = m.centroid(x, mask.astype(x.dtype))  # [B, dim+1]
+        return LorentzMLR(cfg.num_classes, m, name="head")(pooled)
+
+
+# --- training ----------------------------------------------------------------
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    key: jax.Array
+    step: jax.Array
+
+
+def init_model(cfg: HyboNetConfig, seed: int = 0):
+    model = HyboNetClassifier(cfg)
+    key = jax.random.PRNGKey(seed)
+    k_init, key = jax.random.split(key)
+    dummy_t = jnp.zeros((2, cfg.max_len), jnp.int32)
+    dummy_m = jnp.ones((2, cfg.max_len), bool)
+    params = model.init({"params": k_init}, dummy_t, dummy_m)["params"]
+    opt = optax.adamw(cfg.lr, weight_decay=cfg.weight_decay)
+    state = TrainState(params, opt.init(params), key, jnp.zeros((), jnp.int32))
+    return model, opt, state
+
+
+@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
+def train_step(model, opt, state: TrainState, tokens, mask, labels):
+    """One step over a [B, L] batch — a single XLA program."""
+    key, k_drop = jax.random.split(state.key)
+
+    def loss_fn(params):
+        logits = model.apply(
+            {"params": params}, tokens, mask,
+            deterministic=False, rngs={"dropout": k_drop})
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    updates, opt_state = opt.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, key, state.step + 1), loss
+
+
+@partial(jax.jit, static_argnames=("model",))
+def eval_logits(model, params, tokens, mask):
+    return model.apply({"params": params}, tokens, mask)
+
+
+def train(cfg: HyboNetConfig, ds, steps: int = 200, seed: int = 0):
+    """Minibatch training loop over a TextDataset; returns (model, params)."""
+    model, opt, state = init_model(cfg, seed)
+    toks = jnp.asarray(ds.tokens)
+    mask = jnp.asarray(ds.mask)
+    labels = jnp.asarray(ds.labels)
+    n = toks.shape[0]
+    rng = np.random.default_rng(seed)
+    loss = jnp.nan
+    for _ in range(steps):
+        idx = jnp.asarray(rng.integers(0, n, cfg.batch_size))
+        state, loss = train_step(model, opt, state, toks[idx], mask[idx], labels[idx])
+    return model, state.params, float(loss)
+
+
+def evaluate(model, params, ds, batch: int = 256) -> dict:
+    from hyperspace_tpu.utils import metrics as metrics_lib
+
+    outs = []
+    for s in range(0, len(ds.labels), batch):
+        outs.append(np.asarray(eval_logits(
+            model, params,
+            jnp.asarray(ds.tokens[s : s + batch]),
+            jnp.asarray(ds.mask[s : s + batch]))))
+    logits = np.concatenate(outs)
+    return {"accuracy": metrics_lib.accuracy(logits, ds.labels)}

@@ -1,13 +1,26 @@
+from hyperspace_tpu.nn.attention import (
+    HypMultiHeadAttention,
+    lorentz_attention,
+    lorentz_attention_tiled,
+)
+from hyperspace_tpu.nn.decoders import FermiDiracDecoder
+from hyperspace_tpu.nn.gcn import HGCConv, segment_softmax
 from hyperspace_tpu.nn.layers import HypAct, HypLinear, LorentzLinear
 from hyperspace_tpu.nn.mlr import HypMLR, LorentzMLR, hyp_mlr_logits
 from hyperspace_tpu.nn.wrapped_normal import WrappedNormal
 
 __all__ = [
+    "FermiDiracDecoder",
+    "HGCConv",
     "HypAct",
     "HypLinear",
-    "LorentzLinear",
     "HypMLR",
+    "HypMultiHeadAttention",
+    "LorentzLinear",
     "LorentzMLR",
-    "hyp_mlr_logits",
     "WrappedNormal",
+    "hyp_mlr_logits",
+    "lorentz_attention",
+    "lorentz_attention_tiled",
+    "segment_softmax",
 ]

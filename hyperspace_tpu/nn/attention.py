@@ -1,0 +1,210 @@
+"""Hyperbolic attention (reference CUDA kernel N7; SURVEY.md §2).
+
+Semantics per Gulcehre et al. 2019 / HyboNet (Chen et al. ACL 2022): the
+attention score of query q against key k is an affine function of their
+**squared Lorentz distance**,
+
+    s(q, k) = (−d²_L(q, k) + β) / τ ,
+
+with learnable bias β and temperature τ, and the aggregation of the values
+is the **Lorentz centroid** (Law et al. 2019) of the value points under the
+softmax weights — output points stay on the hyperboloid by construction.
+
+TPU-first structure [PLAN]:
+
+- d²_L(q,k) = −2/c − 2⟨q,k⟩_L expands the whole score matrix into ONE
+  Minkowski Gram matrix q @ diag(−1,1,…,1) @ kᵀ — a single MXU matmul.
+- The centroid numerator Σ w_j v_j is another matmul; the normalization is
+  a row-wise rescale.  So hyperbolic attention = 2 matmuls + softmax, the
+  same cost shape as Euclidean attention.
+- ``lorentz_attention_tiled`` computes the same thing scanning over KV
+  blocks with an online softmax — the pure-JAX twin of the flash-style
+  Pallas kernel and the building block ring/Ulysses sequence parallelism
+  wraps (SURVEY.md §5 "Long-context / sequence parallelism").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import Lorentz
+from hyperspace_tpu.manifolds import smath
+from hyperspace_tpu.nn.layers import LorentzLinear
+
+
+def minkowski_gram(q: jax.Array, k: jax.Array) -> jax.Array:
+    """[..., Nq, D] × [..., Nk, D] → ⟨q_i, k_j⟩_L as one matmul."""
+    k_flip = k.at[..., 0].multiply(-1.0)
+    return q @ jnp.swapaxes(k_flip, -1, -2)
+
+
+def lorentz_attention(
+    q: jax.Array,  # [..., Nq, D] points on the hyperboloid
+    k: jax.Array,  # [..., Nk, D]
+    v: jax.Array,  # [..., Nk, D]
+    manifold: Lorentz,
+    *,
+    beta: jax.Array | float = 0.0,
+    tau: jax.Array | float = 1.0,
+    mask: Optional[jax.Array] = None,  # [..., Nq, Nk] True = attend
+) -> jax.Array:
+    """Dense hyperbolic attention; returns hyperboloid points [..., Nq, D]."""
+    c = jnp.asarray(manifold.c, q.dtype)
+    gram = minkowski_gram(q, k)  # [..., Nq, Nk]
+    sqd = -2.0 / c - 2.0 * gram  # squared Lorentz distance
+    logits = (-sqd + beta) / tau
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    w = jax.nn.softmax(logits, axis=-1)
+    w = jnp.where(jnp.isnan(w), 0.0, w)  # fully-masked rows
+    s = w @ v  # centroid numerator: second matmul
+    nrm = smath.safe_sqrt(smath.clamp_min(
+        -_mdot_self(s), smath.eps_for(q.dtype)))
+    return s / (smath.sqrt_c(c) * nrm)
+
+
+def _mdot_self(s: jax.Array) -> jax.Array:
+    return (jnp.sum(s[..., 1:] * s[..., 1:], axis=-1, keepdims=True)
+            - s[..., :1] * s[..., :1])
+
+
+def lorentz_attention_tiled(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    manifold: Lorentz,
+    *,
+    beta: jax.Array | float = 0.0,
+    tau: jax.Array | float = 1.0,
+    mask: Optional[jax.Array] = None,
+    block_size: int = 128,
+) -> jax.Array:
+    """KV-tiled hyperbolic attention with an online softmax.
+
+    Mathematically identical to :func:`lorentz_attention`; scans KV blocks
+    carrying (running max, running denominator, running numerator) — the
+    flash-attention recurrence.  The Lorentz centroid's normalizer-free
+    numerator makes the value accumulation a plain weighted sum, so the
+    recurrence is unchanged from Euclidean flash attention; only the final
+    row-rescale differs.  This is the oracle twin of the Pallas kernel and
+    the per-device body of ring attention.
+    """
+    c = jnp.asarray(manifold.c, q.dtype)
+    nk = k.shape[-2]
+    pad = (-nk) % block_size
+    if pad:
+        padder = lambda a: jnp.concatenate(
+            [a, jnp.zeros(a.shape[:-2] + (pad, a.shape[-1]), a.dtype)], axis=-2)
+        k, v = padder(k), padder(v)
+        block_mask = jnp.arange(nk + pad) < nk
+        if mask is None:
+            mask = jnp.broadcast_to(block_mask, q.shape[:-1] + (nk + pad,))
+        else:
+            mask = jnp.concatenate([
+                mask, jnp.zeros(mask.shape[:-1] + (pad,), bool)], axis=-1)
+    n_blocks = k.shape[-2] // block_size
+
+    kb = jnp.moveaxis(k.reshape(k.shape[:-2] + (n_blocks, block_size, k.shape[-1])), -3, 0)
+    vb = jnp.moveaxis(v.reshape(v.shape[:-2] + (n_blocks, block_size, v.shape[-1])), -3, 0)
+    if mask is not None:
+        mb = jnp.moveaxis(mask.reshape(mask.shape[:-1] + (n_blocks, block_size)), -2, 0)
+    else:
+        mb = None
+
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, q.dtype)  # running max
+    l0 = jnp.zeros(q.shape[:-1], q.dtype)  # running denom
+    s0 = jnp.zeros_like(q)  # running numerator
+
+    def body(carry, blk):
+        m_run, l_run, s_run = carry
+        if mb is None:
+            kj, vj = blk
+            maskj = None
+        else:
+            kj, vj, maskj = blk
+        gram = minkowski_gram(q, kj)
+        logits = (2.0 / c + 2.0 * gram + beta) / tau
+        if maskj is not None:
+            logits = jnp.where(maskj, logits, -jnp.inf)
+        m_new = jnp.maximum(m_run, jnp.max(logits, axis=-1))
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m_run), m_run - m_safe, -jnp.inf))
+        p = jnp.exp(logits - m_safe[..., None])
+        if maskj is not None:
+            p = jnp.where(maskj, p, 0.0)
+        l_new = alpha * l_run + jnp.sum(p, axis=-1)
+        s_new = alpha[..., None] * s_run + p @ vj
+        return (m_new, l_new, s_new), None
+
+    blks = (kb, vb) if mb is None else (kb, vb, mb)
+    (m_f, l_f, s_f), _ = jax.lax.scan(body, (m0, l0, s0), blks)
+    s = s_f / smath.clamp_min(l_f, smath.min_norm(q.dtype))[..., None]
+    nrm = smath.safe_sqrt(smath.clamp_min(-_mdot_self(s), smath.eps_for(q.dtype)))
+    return s / (smath.sqrt_c(c) * nrm)
+
+
+class HypMultiHeadAttention(nn.Module):
+    """Multi-head hyperbolic self/cross attention on the hyperboloid.
+
+    Q/K/V projections are :class:`LorentzLinear` maps into per-head
+    hyperboloids of dimension ``dim // num_heads``; heads are concatenated
+    in space coordinates and fused by an output LorentzLinear — every
+    intermediate stays on-manifold.
+    """
+
+    dim: int  # total manifold dim across heads
+    num_heads: int = 4
+    manifold: Lorentz = None  # type: ignore[assignment]
+    tau_init: float = 1.0
+    use_tiled: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        x_q: jax.Array,  # [..., Nq, D]
+        x_kv: Optional[jax.Array] = None,
+        mask: Optional[jax.Array] = None,  # [..., Nq, Nk]
+    ) -> jax.Array:
+        import math
+
+        if x_kv is None:
+            x_kv = x_q
+        h = self.num_heads
+        dh = self.dim // h
+        assert dh * h == self.dim, "dim must divide num_heads"
+        m = self.manifold
+
+        def proj(name, x):
+            # one LorentzLinear into h stacked head-hyperboloids
+            space = x @ self.param(
+                f"{name}_kernel", nn.initializers.glorot_uniform(),
+                (x.shape[-1], h * dh), x.dtype)
+            space = space.reshape(space.shape[:-1] + (h, dh))
+            space = jnp.swapaxes(space, -3, -2)  # [..., h, N, dh]
+            c = jnp.asarray(m.c, x.dtype)
+            t = smath.safe_sqrt(1.0 / smath.clamp_min(c, smath.min_norm(x.dtype))
+                                + smath.sq_norm(space))
+            return jnp.concatenate([t, space], axis=-1)  # [..., h, N, dh+1]
+
+        q, k, v = proj("q", x_q), proj("k", x_kv), proj("v", x_kv)
+        # per-head score bias/temperature, shaped to broadcast over [h, Nq, Nk]
+        beta = self.param("beta", nn.initializers.zeros, (h, 1, 1), x_q.dtype)
+        tau = nn.softplus(self.param(
+            "tau_raw", nn.initializers.constant(math.log(math.expm1(self.tau_init))),
+            (h, 1, 1), x_q.dtype)) + 1e-4
+        if mask is not None:
+            mask = mask[..., None, :, :]  # broadcast over heads
+        attn = lorentz_attention_tiled if self.use_tiled else lorentz_attention
+        o = attn(q, k, v, m, beta=beta, tau=tau, mask=mask)
+        # concat head space-coords, reconstruct time on the joint hyperboloid
+        o_sp = jnp.swapaxes(o[..., 1:], -3, -2)  # [..., N, h, dh]
+        o_sp = o_sp.reshape(o_sp.shape[:-2] + (h * dh,))
+        c = jnp.asarray(m.c, x_q.dtype)
+        t = smath.safe_sqrt(1.0 / smath.clamp_min(c, smath.min_norm(x_q.dtype))
+                            + smath.sq_norm(o_sp))
+        merged = jnp.concatenate([t, o_sp], axis=-1)
+        return LorentzLinear(self.dim, m, name="out")(merged)

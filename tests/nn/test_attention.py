@@ -1,0 +1,108 @@
+"""Hyperbolic attention tests (SURVEY.md §4.4): tiled == dense (the kernel
+oracle relation), outputs on-manifold, masking semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.manifolds import Lorentz
+from hyperspace_tpu.nn.attention import (
+    HypMultiHeadAttention,
+    lorentz_attention,
+    lorentz_attention_tiled,
+    minkowski_gram,
+)
+from hyperspace_tpu.manifolds.lorentz import minkowski_dot
+
+
+def _pts(key, m, shape):
+    return m.random_normal(key, shape, jnp.float64)
+
+
+def test_minkowski_gram_matches_pairwise():
+    m = Lorentz(1.0)
+    q = _pts(jax.random.PRNGKey(0), m, (3, 5))
+    k = _pts(jax.random.PRNGKey(1), m, (4, 5))
+    g = minkowski_gram(q, k)
+    want = minkowski_dot(q[:, None, :], k[None, :, :], keepdims=False)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(want), rtol=1e-10)
+
+
+def test_attention_output_on_manifold():
+    m = Lorentz(0.8)
+    q = _pts(jax.random.PRNGKey(2), m, (2, 6, 5))
+    o = lorentz_attention(q, q, q, m)
+    assert float(jnp.max(m.check_point(o))) < 1e-8
+
+
+def test_attention_uniform_weights_is_centroid():
+    """With tau→∞ the scores are flat and attention = Lorentz centroid."""
+    m = Lorentz(1.0)
+    x = _pts(jax.random.PRNGKey(3), m, (7, 5))
+    o = lorentz_attention(x, x, x, m, tau=1e9)
+    want = m.centroid(x)
+    np.testing.assert_allclose(
+        np.asarray(o[0]), np.asarray(want), rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("nk", [8, 13, 128])
+def test_tiled_matches_dense(nk):
+    m = Lorentz(1.0)
+    q = _pts(jax.random.PRNGKey(4), m, (2, 5, 7))
+    k = _pts(jax.random.PRNGKey(5), m, (2, nk, 7))
+    v = _pts(jax.random.PRNGKey(6), m, (2, nk, 7))
+    dense = lorentz_attention(q, k, v, m, beta=0.3, tau=0.7)
+    tiled = lorentz_attention_tiled(q, k, v, m, beta=0.3, tau=0.7, block_size=8)
+    np.testing.assert_allclose(np.asarray(tiled), np.asarray(dense), rtol=1e-8, atol=1e-10)
+
+
+def test_tiled_matches_dense_masked():
+    m = Lorentz(1.0)
+    rng = np.random.default_rng(0)
+    q = _pts(jax.random.PRNGKey(7), m, (2, 5, 7))
+    k = _pts(jax.random.PRNGKey(8), m, (2, 11, 7))
+    v = _pts(jax.random.PRNGKey(9), m, (2, 11, 7))
+    mask = jnp.asarray(rng.random((2, 5, 11)) > 0.3)
+    mask = mask.at[:, :, 0].set(True)  # no fully-masked rows
+    dense = lorentz_attention(q, k, v, m, mask=mask)
+    tiled = lorentz_attention_tiled(q, k, v, m, mask=mask, block_size=4)
+    np.testing.assert_allclose(np.asarray(tiled), np.asarray(dense), rtol=1e-8, atol=1e-10)
+
+
+def test_attention_mask_equals_dropped_keys():
+    """Masking the tail keys == running attention on the truncated KV."""
+    m = Lorentz(1.0)
+    q = _pts(jax.random.PRNGKey(10), m, (3, 6))
+    k = _pts(jax.random.PRNGKey(11), m, (9, 6))
+    v = _pts(jax.random.PRNGKey(12), m, (9, 6))
+    mask = jnp.asarray(np.arange(9) < 5)[None, :].repeat(3, 0)
+    full = lorentz_attention(q, k, v, m, mask=mask)
+    trunc = lorentz_attention(q, k[:5], v[:5], m)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(trunc), rtol=1e-10)
+
+
+@pytest.mark.parametrize("use_tiled", [False, True])
+def test_mha_module_shapes_and_manifold(use_tiled):
+    m = Lorentz(1.0)
+    x = _pts(jax.random.PRNGKey(13), m, (2, 6, 9))  # dim 8 manifold
+    mha = HypMultiHeadAttention(dim=8, num_heads=2, manifold=m, use_tiled=use_tiled)
+    params = mha.init(jax.random.PRNGKey(14), x)
+    y = mha.apply(params, x)
+    assert y.shape == (2, 6, 9)
+    assert float(jnp.max(m.check_point(y))) < 1e-8
+
+
+def test_mha_grads_finite():
+    m = Lorentz(1.0)
+    x = _pts(jax.random.PRNGKey(15), m, (1, 4, 9))
+    mha = HypMultiHeadAttention(dim=8, num_heads=2, manifold=m)
+    params = mha.init(jax.random.PRNGKey(16), x)
+
+    def loss(p):
+        y = mha.apply(p, x)
+        return jnp.sum(m.dist(y[:, :1], y[:, 1:]))
+
+    g = jax.grad(loss)(params)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert all(bool(jnp.isfinite(l).all()) for l in leaves)
