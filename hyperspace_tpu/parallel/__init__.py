@@ -1,0 +1,14 @@
+"""Parallelism: device meshes, sharding rules, ring sequence parallelism.
+
+The TPU-native replacement for the reference's NCCL backend (SURVEY.md §2
+N8, §5 "Distributed comms backend"): XLA collectives over ICI/DCN under
+GSPMD or shard_map — no hand-written transport.
+"""
+
+from hyperspace_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    make_mesh,
+    replicated,
+    shard_batch,
+)
+from hyperspace_tpu.parallel.ring import ring_lorentz_attention  # noqa: F401
