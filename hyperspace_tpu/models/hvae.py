@@ -1,0 +1,178 @@
+"""Hyperbolic VAE on MNIST (reference workload 4).
+
+BASELINE.json configs[3]: "Hyperbolic VAE on MNIST — wrapped-normal prior";
+semantics per Mathieu et al. 2019 / Nagano et al. 2019 (SURVEY.md §2
+"HVAE model", §3.3 call stack):
+
+    encoder (Euclidean conv) ─► (μ ∈ manifold via exp₀, σ)
+    posterior  q(z|x) = WrappedNormal(μ, σ)   — reparameterized rsample
+    prior      p(z)   = WrappedNormal(origin, 1)
+    decoder    log₀(z) ─► deconv ─► Bernoulli logits
+    ELBO       E_q[log p(x|z)] − MC-KL,  KL ≈ log q(z|x) − log p(z)
+
+Monte-Carlo KL (no closed form on the manifold) with the reparameterized
+sample keeps the whole step differentiable; eval offers the K-sample IWAE
+bound (SURVEY.md §3.5).  Works on the ball or the hyperboloid — the
+latent geometry is a config choice, both [B] requirements.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from hyperspace_tpu.nn.gcn import make_manifold
+from hyperspace_tpu.nn.wrapped_normal import WrappedNormal
+
+
+@dataclasses.dataclass(frozen=True)
+class HVAEConfig:
+    image_size: int = 28
+    latent_dim: int = 2  # manifold dim of the latent space
+    hidden: int = 256
+    conv_features: tuple = (32, 64)
+    kind: str = "poincare"  # or "lorentz"
+    c: float = 1.0
+    lr: float = 1e-3
+    batch_size: int = 128
+    kl_weight: float = 1.0
+    dtype: Any = jnp.float32
+
+
+class Encoder(nn.Module):
+    cfg: HVAEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> WrappedNormal:
+        cfg = self.cfg
+        m = make_manifold(cfg.kind, cfg.c)
+        h = x[..., None]  # [B, H, W, 1]
+        for f in cfg.conv_features:
+            h = nn.relu(nn.Conv(f, (3, 3), strides=(2, 2))(h))
+        h = h.reshape(h.shape[0], -1)
+        h = nn.relu(nn.Dense(cfg.hidden)(h))
+        # μ as origin-tangent coords → tangent chart → expmap0
+        mu_t = nn.Dense(cfg.latent_dim, name="mu")(h)
+        mu = m.expmap0(m.tangent_from_origin_coords(mu_t))
+        log_sigma = nn.Dense(cfg.latent_dim, name="log_sigma")(h)
+        sigma = jnp.exp(jnp.clip(log_sigma, -6.0, 2.0))
+        return WrappedNormal(m, mu, sigma)
+
+
+class Decoder(nn.Module):
+    cfg: HVAEConfig
+
+    @nn.compact
+    def __call__(self, z: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        m = make_manifold(cfg.kind, cfg.c)
+        # leave the manifold once, at the decoder input
+        v = m.origin_coords_from_tangent(m.logmap0(z))
+        s0 = cfg.image_size // (2 ** len(cfg.conv_features))
+        f_top = cfg.conv_features[-1]
+        h = nn.relu(nn.Dense(cfg.hidden)(v))
+        h = nn.relu(nn.Dense(s0 * s0 * f_top)(h))
+        h = h.reshape(h.shape[:-1] + (s0, s0, f_top))
+        for f in reversed(cfg.conv_features[:-1]):
+            h = nn.relu(nn.ConvTranspose(f, (3, 3), strides=(2, 2))(h))
+        h = nn.ConvTranspose(1, (3, 3), strides=(2, 2))(h)
+        h = h[..., 0]
+        # crop in case strides overshoot the odd image size
+        return h[..., : cfg.image_size, : cfg.image_size]
+
+
+class HVAE(nn.Module):
+    cfg: HVAEConfig
+
+    def setup(self):
+        self.encoder = Encoder(self.cfg)
+        self.decoder = Decoder(self.cfg)
+
+    def __call__(self, x: jax.Array, key: jax.Array):
+        q = self.encoder(x)
+        z = q.rsample(key)
+        logits = self.decoder(z)
+        return q, z, logits
+
+    def prior(self, dtype=jnp.float32) -> WrappedNormal:
+        cfg = self.cfg
+        m = make_manifold(cfg.kind, cfg.c)
+        loc = m.origin((m.ambient_dim(cfg.latent_dim),), dtype)
+        return WrappedNormal(m, loc, jnp.ones((cfg.latent_dim,), dtype))
+
+
+def elbo_terms(model_out, prior: WrappedNormal, x: jax.Array):
+    q, z, logits = model_out
+    recon = -jnp.sum(
+        optax.sigmoid_binary_cross_entropy(logits, x), axis=(-2, -1))
+    kl = q.log_prob(z) - prior.log_prob(z)
+    return recon, kl
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    key: jax.Array
+    step: jax.Array
+
+
+def init_model(cfg: HVAEConfig, seed: int = 0):
+    model = HVAE(cfg)
+    key = jax.random.PRNGKey(seed)
+    k_init, k_s, key = jax.random.split(key, 3)
+    dummy = jnp.zeros((2, cfg.image_size, cfg.image_size), cfg.dtype)
+    params = model.init({"params": k_init}, dummy, k_s)["params"]
+    opt = optax.adam(cfg.lr)
+    return model, opt, TrainState(params, opt.init(params), key, jnp.zeros((), jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
+def train_step(model: HVAE, opt, state: TrainState, x: jax.Array):
+    key, k_sample = jax.random.split(state.key)
+    prior = model.prior(x.dtype)
+
+    def loss_fn(params):
+        out = model.apply({"params": params}, x, k_sample)
+        recon, kl = elbo_terms(out, prior, x)
+        elbo = recon - model.cfg.kl_weight * kl
+        return -jnp.mean(elbo), (jnp.mean(recon), jnp.mean(kl))
+
+    (loss, (recon, kl)), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+    updates, opt_state = opt.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, key, state.step + 1), loss, recon, kl
+
+
+@partial(jax.jit, static_argnames=("model", "k"))
+def iwae_bound(model: HVAE, params, x: jax.Array, key: jax.Array, k: int = 16):
+    """K-sample importance-weighted bound (SURVEY.md §3.5 HVAE eval)."""
+    prior = model.prior(x.dtype)
+
+    def one(key):
+        out = model.apply({"params": params}, x, key)
+        recon, kl = elbo_terms(out, prior, x)
+        return recon - kl  # log w (unnormalized)
+
+    logw = jax.vmap(one)(jax.random.split(key, k))  # [K, B]
+    return jnp.mean(jax.nn.logsumexp(logw, axis=0) - jnp.log(float(k)))
+
+
+def train(cfg: HVAEConfig, images: np.ndarray, steps: int = 200, seed: int = 0):
+    """Minibatch loop; returns (model, state, last-metrics)."""
+    model, opt, state = init_model(cfg, seed)
+    x_all = jnp.asarray(images, cfg.dtype)
+    n = x_all.shape[0]
+    rng = np.random.default_rng(seed)
+    metrics = {}
+    for _ in range(steps):
+        idx = jnp.asarray(rng.integers(0, n, cfg.batch_size))
+        state, loss, recon, kl = train_step(model, opt, state, x_all[idx])
+        metrics = {"loss": float(loss), "recon": float(recon), "kl": float(kl)}
+    return model, state, metrics
