@@ -1,0 +1,256 @@
+"""Single training entry point: ``python -m hyperspace_tpu.cli.train``.
+
+SURVEY.md §5 "Config/flag system": typed dataclass configs, one per
+workload (the five BASELINE.json configs), overridable from YAML and
+``key=value`` CLI args; a config fully determines mesh, model, data and
+optimizer — no hidden globals.
+
+    python -m hyperspace_tpu.cli.train poincare steps=500 dim=10
+    python -m hyperspace_tpu.cli.train hgcn task=lp dataset=cora
+    python -m hyperspace_tpu.cli.train hybonet --yaml exp.yaml
+    python -m hyperspace_tpu.cli.train hvae steps=200
+    python -m hyperspace_tpu.cli.train product multihost=true
+
+Each run writes JSONL metrics (``--log``), optional orbax checkpoints
+(``--ckpt-dir``), and prints one final JSON line of results.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# --- run-level options (shared across workloads) ------------------------------
+
+
+@dataclasses.dataclass
+class RunConfig:
+    steps: int = 500
+    seed: int = 0
+    eval_every: int = 0  # 0 = eval only at the end
+    log: str | None = None  # JSONL path
+    ckpt_dir: str | None = None
+    ckpt_every: int = 100
+    resume: bool = False
+    data_root: str | None = None  # on-disk dataset directory
+    multihost: bool = False  # jax.distributed.initialize + host mesh axis
+    coordinator: str = "127.0.0.1:9357"
+    num_processes: int = 1
+    process_id: int = 0
+
+
+def _coerce(old: Any, s: str) -> Any:
+    if old is None:
+        return s
+    t = type(old)
+    if t is bool:
+        return s.lower() in ("1", "true", "yes")
+    if dataclasses.is_dataclass(old):
+        raise ValueError("cannot override nested config directly")
+    if t is tuple:
+        return tuple(json.loads(s))
+    try:
+        return t(s)
+    except (TypeError, ValueError):
+        return s
+
+
+def apply_overrides(cfg, overrides: dict[str, str]):
+    """Apply {field: str} overrides to a (frozen) dataclass, coercing types."""
+    updates = {}
+    names = {f.name: f for f in dataclasses.fields(cfg)}
+    for k, v in overrides.items():
+        if k not in names:
+            raise SystemExit(
+                f"unknown option {k!r} for {type(cfg).__name__}; "
+                f"known: {sorted(names)}")
+        updates[k] = _coerce(getattr(cfg, k), v)
+    return dataclasses.replace(cfg, **updates)
+
+
+def split_overrides(pairs: list[str], run: RunConfig):
+    """Partition key=value args into (run-config updates, workload updates)."""
+    run_names = {f.name for f in dataclasses.fields(RunConfig)}
+    run_kv, wl_kv = {}, {}
+    for p in pairs:
+        if "=" not in p:
+            raise SystemExit(f"expected key=value, got {p!r}")
+        k, v = p.split("=", 1)
+        (run_kv if k in run_names else wl_kv)[k] = v
+    return apply_overrides(run, run_kv), wl_kv
+
+
+# --- workload runners ---------------------------------------------------------
+
+
+def run_poincare(run: RunConfig, overrides: dict):
+    from hyperspace_tpu.data import wordnet
+    from hyperspace_tpu.models import poincare_embed as pe
+
+    if run.data_root:
+        ds = wordnet.load_closure_tsv(run.data_root)
+    else:
+        ds = wordnet.synthetic_tree(depth=5, branching=4)
+    cfg = apply_overrides(
+        pe.PoincareEmbedConfig(num_nodes=ds.num_nodes), overrides)
+    state, opt = pe.init_state(cfg, run.seed)
+    pairs = jnp.asarray(ds.pairs)
+    with _logger(run) as log:
+        for i in range(run.steps):
+            state, loss = pe.train_step(cfg, opt, state, pairs)
+            _maybe_log(log, run, i, loss)
+    res = pe.evaluate(state.table, ds.pairs, cfg.c)
+    return {"workload": "poincare", "steps": run.steps, **res}
+
+
+def run_hgcn(run: RunConfig, overrides: dict):
+    from hyperspace_tpu.data import graphs as G
+    from hyperspace_tpu.models import hgcn
+
+    task = overrides.pop("task", "lp")
+    dataset = overrides.pop("dataset", "cora")
+    edges, x, labels, ncls, source = G.load_graph(dataset, run.data_root)
+    cfg = apply_overrides(
+        hgcn.HGCNConfig(feat_dim=x.shape[1],
+                        num_classes=ncls if task == "nc" else 0),
+        overrides)
+    if task == "lp":
+        split = G.split_edges(edges, x.shape[0], x, seed=run.seed)
+        model, params, _ = hgcn.train_lp(cfg, split, steps=run.steps, seed=run.seed)
+        res = hgcn.evaluate_lp(model, params, split, "test")
+    else:
+        tr, va, te = G.node_split_masks(x.shape[0], seed=run.seed)
+        g = G.prepare(edges, x.shape[0], x, labels=labels, num_classes=ncls,
+                      train_mask=tr, val_mask=va, test_mask=te)
+        model, params, res = hgcn.train_nc(cfg, g, steps=run.steps, seed=run.seed)
+    return {"workload": "hgcn", "task": task, "dataset": dataset,
+            "source": source, **res}
+
+
+def run_hybonet(run: RunConfig, overrides: dict):
+    from hyperspace_tpu.data import text as T
+    from hyperspace_tpu.models import hybonet
+
+    dataset = overrides.pop("dataset", "text")
+    ds, source = T.load_text(dataset, run.data_root)
+    tr, te = ds.split(0.8, seed=run.seed)
+    cfg = apply_overrides(
+        hybonet.HyboNetConfig(vocab_size=ds.vocab_size,
+                              num_classes=ds.num_classes,
+                              max_len=ds.tokens.shape[1]),
+        overrides)
+    model, params, loss = hybonet.train(cfg, tr, steps=run.steps, seed=run.seed)
+    res = hybonet.evaluate(model, params, te)
+    return {"workload": "hybonet", "source": source, "loss": loss, **res}
+
+
+def run_hvae(run: RunConfig, overrides: dict):
+    from hyperspace_tpu.data import mnist as M
+    from hyperspace_tpu.models import hvae
+
+    ds, source = M.load_mnist(run.data_root)
+    cfg = apply_overrides(hvae.HVAEConfig(image_size=ds.images.shape[1]), overrides)
+    model, state, metrics = hvae.train(cfg, ds.images, steps=run.steps, seed=run.seed)
+    x = jnp.asarray(ds.images[:256], cfg.dtype)
+    iwae = float(hvae.iwae_bound(model, state.params, x, jax.random.PRNGKey(1), k=16))
+    return {"workload": "hvae", "source": source, **metrics, "iwae": iwae}
+
+
+def run_product(run: RunConfig, overrides: dict):
+    from hyperspace_tpu.data import wordnet
+    from hyperspace_tpu.models import product_embed as pme
+    from hyperspace_tpu.parallel.mesh import make_mesh, multihost_mesh
+
+    if run.data_root:
+        ds = wordnet.load_closure_tsv(run.data_root)
+    else:
+        ds = wordnet.synthetic_tree(depth=5, branching=3)
+    cfg = apply_overrides(
+        pme.ProductEmbedConfig(num_nodes=ds.num_nodes), overrides)
+    state, curv_opt = pme.init_state(cfg, run.seed)
+    pairs = jnp.asarray(ds.pairs)
+    if run.multihost:
+        mesh = multihost_mesh()
+        step = pme.make_sharded_step(cfg, curv_opt, mesh)
+        stepper = lambda st: step(st, pairs)
+    elif len(jax.devices()) > 1:
+        mesh = make_mesh({"data": len(jax.devices())})
+        step = pme.make_sharded_step(cfg, curv_opt, mesh)
+        stepper = lambda st: step(st, pairs)
+    else:
+        stepper = lambda st: pme.train_step(cfg, curv_opt, state=st, pairs=pairs)
+    with _logger(run) as log:
+        for i in range(run.steps):
+            state, loss = stepper(state)
+            _maybe_log(log, run, i, loss)
+    res = pme.evaluate(cfg, state.params, ds.pairs)
+    return {"workload": "product", **res,
+            "curvatures": pme.curvatures(cfg, state.params)}
+
+
+WORKLOADS = {
+    "poincare": run_poincare,
+    "hgcn": run_hgcn,
+    "hybonet": run_hybonet,
+    "hvae": run_hvae,
+    "product": run_product,
+}
+
+
+# --- helpers ------------------------------------------------------------------
+
+
+def _logger(run: RunConfig):
+    from hyperspace_tpu.train.logging import MetricsLogger
+
+    return MetricsLogger(run.log, stdout=False)
+
+
+def _maybe_log(log, run: RunConfig, step: int, loss):
+    every = run.eval_every or 50
+    if (step + 1) % every == 0:
+        log.log(step + 1, loss=float(loss))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hyperspace_tpu.cli.train",
+        description="Train a hyperspace-tpu workload.")
+    ap.add_argument("workload", choices=sorted(WORKLOADS))
+    ap.add_argument("overrides", nargs="*",
+                    help="key=value overrides (run- or workload-config)")
+    ap.add_argument("--yaml", default=None,
+                    help="YAML file of overrides (CLI wins on conflict)")
+    args = ap.parse_args(argv)
+
+    pairs = []
+    if args.yaml:
+        import yaml
+
+        with open(args.yaml) as f:
+            doc = yaml.safe_load(f) or {}
+        pairs += [f"{k}={json.dumps(v) if isinstance(v, list) else v}"
+                  for k, v in doc.items()]
+    pairs += args.overrides
+
+    run, wl_overrides = split_overrides(pairs, RunConfig())
+    if run.multihost:
+        jax.distributed.initialize(
+            coordinator_address=run.coordinator,
+            num_processes=run.num_processes,
+            process_id=run.process_id)
+    result = WORKLOADS[args.workload](run, wl_overrides)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

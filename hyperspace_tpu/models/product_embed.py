@@ -1,0 +1,222 @@
+"""Product-manifold embeddings with learned curvature (reference workload 5).
+
+BASELINE.json configs[4]: mixed-curvature (hyperbolic × spherical ×
+Euclidean) embeddings with **learned curvature**, **multi-host**; semantics
+per Gu et al. 2019 (SURVEY.md §2 "Product-manifold embedder", §3.4).
+
+Learned curvature forces a design departure from the statically-tagged
+optimizers in :mod:`hyperspace_tpu.optim`: the parameter's manifold changes
+every step (its curvatures are themselves parameters), so the Riemannian
+update is done inline in the train step — build the Product manifold from
+``softplus(c_raw)``, convert the Euclidean gradient, expmap — while the
+curvature parameters take an Adam step from the same backward pass.  The
+whole thing is still one XLA program; the gradient w.r.t. curvature flows
+through every distance because manifolds are pytrees of traced scalars.
+
+Multi-host (SURVEY.md §3.4): the same jitted step under a mesh whose
+leading ``host`` axis rides DCN; batch sharded over (host, data), table
+replicated (the gradient all-reduce GSPMD inserts is the reference's NCCL
+all-reduce).  ``train_step_sharded`` takes the mesh; Python never
+communicates across hosts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from hyperspace_tpu.manifolds import Euclidean, PoincareBall, Product, Sphere
+from hyperspace_tpu.parallel.mesh import batch_sharding, replicated
+
+
+FACTOR_KINDS = {"poincare": PoincareBall, "sphere": Sphere, "euclidean": Euclidean}
+
+
+@dataclasses.dataclass(frozen=True)
+class ProductEmbedConfig:
+    num_nodes: int = 0
+    # (kind, ambient_dim) per factor; curvature learned for non-Euclidean
+    factors: tuple = (("poincare", 5), ("sphere", 5), ("euclidean", 2))
+    init_c: float = 1.0
+    lr_table: float = 0.3
+    lr_curv: float = 1e-2
+    neg_samples: int = 10
+    batch_size: int = 256
+    burnin_steps: int = 50
+    burnin_factor: float = 0.05
+    init_scale: float = 1e-2
+    dtype: Any = jnp.float32
+
+    @property
+    def total_dim(self) -> int:
+        return sum(d for _, d in self.factors)
+
+    @property
+    def num_curved(self) -> int:
+        return sum(1 for k, _ in self.factors if k != "euclidean")
+
+
+def build_manifold(cfg: ProductEmbedConfig, c_raw: jax.Array) -> Product:
+    """Product manifold with curvatures softplus(c_raw) (traced, learnable)."""
+    factors, i = [], 0
+    for kind, dim in cfg.factors:
+        if kind == "euclidean":
+            factors.append(Euclidean())
+        else:
+            factors.append(FACTOR_KINDS[kind](jax.nn.softplus(c_raw[i])))
+            i += 1
+    return Product(factors, [d for _, d in cfg.factors])
+
+
+class Params(NamedTuple):
+    table: jax.Array  # [N, total_dim] points on the product manifold
+    c_raw: jax.Array  # [num_curved] inverse-softplus curvatures
+
+
+class TrainState(NamedTuple):
+    params: Params
+    curv_opt_state: Any
+    key: jax.Array
+    step: jax.Array
+
+
+def init_state(cfg: ProductEmbedConfig, seed: int = 0) -> tuple[TrainState, Any]:
+    key = jax.random.PRNGKey(seed)
+    k_init, key = jax.random.split(key)
+    c_raw = jnp.full((cfg.num_curved,),
+                     float(np.log(np.expm1(cfg.init_c))), cfg.dtype)
+    m = build_manifold(cfg, c_raw)
+    v = cfg.init_scale * jax.random.normal(
+        k_init, (cfg.num_nodes, cfg.total_dim), cfg.dtype)
+    table = m.expmap0(m.proju(m.origin(v.shape, cfg.dtype), v))
+    curv_opt = optax.adam(cfg.lr_curv)
+    state = TrainState(
+        Params(table, c_raw), curv_opt.init(c_raw), key, jnp.zeros((), jnp.int32))
+    return state, curv_opt
+
+
+def loss_fn(params: Params, cfg: ProductEmbedConfig,
+            u_idx: jax.Array, v_idx: jax.Array, neg_idx: jax.Array) -> jax.Array:
+    """Ranking loss -log softmax(-d(u, ·)) (Nickel & Kiela form, product
+    distance d² = Σ factor d² per Gu et al.)."""
+    m = build_manifold(cfg, params.c_raw)
+    u = params.table[u_idx]
+    cand = jnp.concatenate([v_idx[:, None], neg_idx], axis=1)
+    cv = params.table[cand]
+    d = m.dist(u[:, None, :], cv)
+    logits = -d
+    collide = (neg_idx == v_idx[:, None]) | (neg_idx == u_idx[:, None])
+    mask = jnp.concatenate([jnp.zeros_like(v_idx[:, None], bool), collide], axis=1)
+    logits = jnp.where(mask, -jnp.inf, logits)
+    return jnp.mean(jax.nn.logsumexp(logits, axis=1) - logits[:, 0])
+
+
+def _step_body(cfg: ProductEmbedConfig, curv_opt, state: TrainState,
+               pairs: jax.Array, constrain=None):
+    """Shared step body; ``constrain(u, v, neg)`` pins batch shardings when
+    running under a mesh (identity when single-device)."""
+    key, k_batch, k_neg = jax.random.split(state.key, 3)
+    rows = jax.random.randint(k_batch, (cfg.batch_size,), 0, pairs.shape[0])
+    batch = pairs[rows]
+    u_idx, v_idx = batch[:, 0], batch[:, 1]
+    neg_idx = jax.random.randint(
+        k_neg, (cfg.batch_size, cfg.neg_samples), 0, cfg.num_nodes)
+    if constrain is not None:
+        u_idx, v_idx, neg_idx = constrain(u_idx, v_idx, neg_idx)
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params, cfg, u_idx, v_idx, neg_idx)
+
+    # Riemannian SGD on the table under the *current* manifold
+    lr = jnp.where(state.step < cfg.burnin_steps,
+                   cfg.lr_table * cfg.burnin_factor, cfg.lr_table)
+    m = build_manifold(cfg, state.params.c_raw)
+    rg = m.egrad2rgrad(state.params.table, grads.table)
+    table = m.expmap(state.params.table, -lr * rg)
+
+    # Adam on the curvatures
+    c_upd, curv_opt_state = curv_opt.update(
+        grads.c_raw, state.curv_opt_state, state.params.c_raw)
+    c_raw = optax.apply_updates(state.params.c_raw, c_upd)
+
+    # the curvature change moves the manifold itself (sphere radius, ball
+    # boundary) — re-project the table onto the *new* manifold
+    table = build_manifold(cfg, c_raw).proj(table)
+
+    new_state = TrainState(Params(table, c_raw), curv_opt_state, key, state.step + 1)
+    return new_state, loss
+
+
+@partial(jax.jit, static_argnames=("cfg", "curv_opt"), donate_argnames=("state",))
+def train_step(cfg: ProductEmbedConfig, curv_opt, state: TrainState,
+               pairs: jax.Array):
+    return _step_body(cfg, curv_opt, state, pairs)
+
+
+def make_sharded_step(cfg: ProductEmbedConfig, curv_opt, mesh):
+    """The multi-host variant: same body, GSPMD shardings pinned.
+
+    Batch indices are drawn on device and constrained to the (host, data)
+    axes; the table and optimizer state are replicated, so XLA inserts the
+    gradient all-reduce (ICI within a host, DCN across hosts) exactly where
+    the reference used NCCL.
+    """
+    repl = replicated(mesh)
+
+    def constrain(u, v, neg):
+        return (
+            jax.lax.with_sharding_constraint(u, batch_sharding(mesh, 1)),
+            jax.lax.with_sharding_constraint(v, batch_sharding(mesh, 1)),
+            jax.lax.with_sharding_constraint(neg, batch_sharding(mesh, 2)),
+        )
+
+    def body(state, pairs):
+        return _step_body(cfg, curv_opt, state, pairs, constrain=constrain)
+
+    return jax.jit(body, in_shardings=(repl, repl), out_shardings=(repl, repl),
+                   donate_argnums=(0,))
+
+
+def curvatures(cfg: ProductEmbedConfig, params: Params) -> list[float]:
+    return [float(c) for c in jax.nn.softplus(params.c_raw)]
+
+
+# --- evaluation ---------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _rank_chunk(cfg: ProductEmbedConfig, params: Params,
+                u_idx: jax.Array, v_idx: jax.Array) -> jax.Array:
+    m = build_manifold(cfg, params.c_raw)
+    u = params.table[u_idx]
+    d_all = m.dist(u[:, None, :], params.table[None, :, :])
+    d_pos = jnp.take_along_axis(d_all, v_idx[:, None], axis=1)
+    closer = (d_all < d_pos).astype(jnp.int32)
+    closer = closer.at[jnp.arange(u_idx.shape[0]), u_idx].set(0)
+    closer = closer.at[jnp.arange(u_idx.shape[0]), v_idx].set(0)
+    return jnp.sum(closer, axis=1) + 1
+
+
+def evaluate(cfg: ProductEmbedConfig, params: Params, pairs, batch: int = 1024) -> dict:
+    """Mean rank / MAP over held pairs (same protocol as Poincaré embed)."""
+    pairs = np.asarray(pairs)
+    ranks = []
+    for s in range(0, len(pairs), batch):
+        chunk = pairs[s : s + batch]
+        r = _rank_chunk(cfg, params, jnp.asarray(chunk[:, 0]), jnp.asarray(chunk[:, 1]))
+        ranks.append(np.asarray(r))
+    ranks = np.concatenate(ranks)
+    by_u: dict[int, list[int]] = {}
+    for (u, v), r in zip(pairs, ranks):
+        by_u.setdefault(int(u), []).append(int(r))
+    aps, filtered = [], []
+    for u, rs in by_u.items():
+        rs = sorted(rs)
+        aps.append(np.mean([(i + 1) / max(r, i + 1) for i, r in enumerate(rs)]))
+        filtered.extend(max(r - i, 1) for i, r in enumerate(rs))
+    return {"mean_rank": float(np.mean(filtered)), "map": float(np.mean(aps))}

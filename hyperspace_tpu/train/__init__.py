@@ -1,0 +1,5 @@
+"""L4 train runtime (SURVEY.md §1b): checkpointing, metrics, profiling."""
+
+from hyperspace_tpu.train.checkpoint import CheckpointManager  # noqa: F401
+from hyperspace_tpu.train.logging import MetricsLogger  # noqa: F401
+from hyperspace_tpu.train.profiling import benchmark_step  # noqa: F401

@@ -1,0 +1,95 @@
+"""Checkpoint / resume on orbax (SURVEY.md §5 "Checkpoint / resume").
+
+Saves the full training state pytree — params, optimizer state (for
+Riemannian Adam that includes the tangent moments *and* the step count
+whose base points are the saved params themselves), PRNG key, step, and
+any learned curvatures, since they all live inside the state pytree.
+
+Restore applies an optional ``project`` function (manifold re-projection):
+checkpoints written in one dtype and restored in another can drift off the
+constraint surface, and re-projection is idempotent for clean restores
+(SURVEY.md §5: "restore re-projects params onto their manifolds").
+
+Async by default: `keep_period`-style retention is delegated to orbax's
+CheckpointManager options.  The recovery model is restart-from-checkpoint
+(XLA programs are fixed-topology; SURVEY.md §5 "Failure detection").
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin orbax wrapper pinned to this framework's conventions."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+        async_save: bool = True,
+    ):
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self._dir, options=options)
+
+    def save(self, step: int, state: Any) -> bool:
+        """Maybe-save (interval-gated); returns True if a save started."""
+        return self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def restore(
+        self,
+        state_like: Any,
+        *,
+        step: Optional[int] = None,
+        project: Optional[Callable[[Any], Any]] = None,
+    ) -> tuple[Any, int]:
+        """Restore (state, step); ``state_like`` supplies structure/shapes."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self._dir}")
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(state_like))
+        if project is not None:
+            restored = project(restored)
+        return restored, step
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def wait(self):
+        """Block until async saves land (call before process exit)."""
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait()
+        self.close()
+
+
+def reproject_params(tags, params):
+    """Build a ``project`` fn argument from a manifold tag tree: re-projects
+    every manifold-tagged leaf, passes Euclidean leaves through."""
+    from hyperspace_tpu.optim.tags import map_tagged
+
+    def apply(tree):
+        return map_tagged(
+            lambda t, p: p if t is None else t.proj(p), tags, tree)
+
+    return apply

@@ -1,0 +1,69 @@
+"""JSONL metrics/event logging (SURVEY.md §5 "Metrics/logging").
+
+One JSON object per line: {"step": ..., "ts": ..., "host": ..., **metrics}.
+Cheap enough to call every step; file handle is line-buffered so a crashed
+run keeps everything up to the last step.  Multi-host: each process writes
+its own file (suffix = process index); step metrics are device-reduced
+*before* logging by the caller, so host 0's file is the canonical one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+
+class MetricsLogger:
+    def __init__(self, path: Optional[str], *, stdout: bool = False):
+        """``path`` None → stdout-only when ``stdout`` else no-op."""
+        self._stdout = stdout
+        self._f = None
+        if path is not None:
+            try:
+                import jax
+
+                idx = jax.process_index()
+            except Exception:
+                idx = 0
+            if idx != 0:
+                root, ext = os.path.splitext(path)
+                path = f"{root}.{idx}{ext or '.jsonl'}"
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._f = open(path, "a", buffering=1)
+        self._host = os.environ.get("HOSTNAME", "")
+
+    def log(self, step: int, **metrics: Any):
+        rec = {"step": int(step), "ts": time.time(), "host": self._host}
+        for k, v in metrics.items():
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                rec[k] = v
+        line = json.dumps(rec)
+        if self._f is not None:
+            self._f.write(line + "\n")
+        if self._stdout:
+            print(line, flush=True)
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_jsonl(path: str) -> list[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -1,0 +1,67 @@
+"""Profiling & timing harness (SURVEY.md §5 "Tracing/profiling").
+
+- ``benchmark_step``: wall-clock a jitted step with warmup +
+  ``block_until_ready`` — the number the benchmark suite reports.
+- ``trace``: context manager around ``jax.profiler`` producing an XPlane/
+  Perfetto trace directory for TPU runs.
+- ``compiled_cost``: XLA's own FLOP/bytes estimate for a jitted function —
+  per-kernel cost visibility without hardware counters.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable
+
+import jax
+
+
+def benchmark_step(
+    fn: Callable[[], Any],
+    *,
+    warmup: int = 3,
+    iters: int = 20,
+) -> dict:
+    """Time ``fn()`` (must return jax arrays); returns seconds statistics."""
+    for _ in range(warmup):
+        out = fn()
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    n = len(times)
+    return {
+        "mean_s": sum(times) / n,
+        "p50_s": times[n // 2],
+        "min_s": times[0],
+        "max_s": times[-1],
+        "iters": n,
+    }
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """jax.profiler trace (view with TensorBoard/Perfetto/xprof)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def compiled_cost(fn: Callable, *args, **kwargs) -> dict:
+    """Cost analysis of the XLA executable for fn(*args)."""
+    lowered = jax.jit(fn).lower(*args, **kwargs)
+    compiled = lowered.compile()
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:  # backend without cost analysis
+        return {}
+    if isinstance(cost, list):  # some backends return [dict]
+        cost = cost[0] if cost else {}
+    return {k: cost[k] for k in ("flops", "bytes accessed") if k in cost}
