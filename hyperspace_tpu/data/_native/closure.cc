@@ -1,0 +1,100 @@
+// Native data-prep helpers for hyperspace_tpu (SURVEY.md §2 "Data" rows).
+//
+// The reference's data pipeline is native (C++/CUDA); the TPU rebuild keeps
+// host-side graph preprocessing native too: transitive closure of the
+// hypernymy DAG (WordNet-scale: 82k nodes / ~750k closure pairs) and
+// rejection-sampled negative edges for link prediction (arxiv-scale edge
+// sets).  Exposed through ctypes (no pybind11 in this environment); see
+// hyperspace_tpu/data/native.py for the Python side.
+//
+// Build: g++ -O2 -shared -fPIC closure.cc -o libhsdata.so
+
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <unordered_set>
+#include <vector>
+
+extern "C" {
+
+struct PairBuf {
+  std::vector<int32_t> flat;  // (u, v) pairs, flattened
+};
+
+// ---- transitive closure ----------------------------------------------------
+
+// edges: [n_edges * 2] (child, parent).  Returns a PairBuf* handle holding
+// all (node, ancestor) pairs reachable through the parent relation.
+void* closure_compute(const int32_t* edges, int64_t n_edges,
+                      int32_t num_nodes) {
+  std::vector<std::vector<int32_t>> parents(num_nodes);
+  for (int64_t i = 0; i < n_edges; ++i) {
+    int32_t u = edges[2 * i], v = edges[2 * i + 1];
+    if (u >= 0 && u < num_nodes && v >= 0 && v < num_nodes)
+      parents[u].push_back(v);
+  }
+  auto* out = new PairBuf();
+  // iterative DFS per node; `seen` is epoch-stamped to avoid re-clearing
+  std::vector<int32_t> stamp(num_nodes, -1);
+  std::vector<int32_t> stack;
+  for (int32_t start = 0; start < num_nodes; ++start) {
+    stack.assign(parents[start].begin(), parents[start].end());
+    while (!stack.empty()) {
+      int32_t p = stack.back();
+      stack.pop_back();
+      if (stamp[p] == start) continue;
+      stamp[p] = start;
+      out->flat.push_back(start);
+      out->flat.push_back(p);
+      for (int32_t q : parents[p])
+        if (stamp[q] != start) stack.push_back(q);
+    }
+  }
+  return out;
+}
+
+int64_t pairbuf_size(void* handle) {  // number of pairs
+  return static_cast<PairBuf*>(handle)->flat.size() / 2;
+}
+
+void pairbuf_copy(void* handle, int32_t* dst) {
+  auto* buf = static_cast<PairBuf*>(handle);
+  std::memcpy(dst, buf->flat.data(), buf->flat.size() * sizeof(int32_t));
+}
+
+void pairbuf_free(void* handle) { delete static_cast<PairBuf*>(handle); }
+
+// ---- negative-edge sampling ------------------------------------------------
+
+// Uniform (u, v) non-edges, u != v, rejecting members of the undirected
+// edge set.  edges: [n_edges * 2] canonical (min, max) pairs.  Fills
+// out[2*k]; returns k actually sampled (k unless the graph is near-complete
+// and max_tries is exhausted).
+int64_t sample_negative_edges(const int32_t* edges, int64_t n_edges,
+                              int32_t num_nodes, int64_t k, uint64_t seed,
+                              int32_t* out) {
+  std::unordered_set<int64_t> edge_set;
+  edge_set.reserve(static_cast<size_t>(n_edges) * 2);
+  for (int64_t i = 0; i < n_edges; ++i) {
+    int64_t a = edges[2 * i], b = edges[2 * i + 1];
+    if (a > b) std::swap(a, b);
+    edge_set.insert(a * num_nodes + b);
+  }
+  std::mt19937_64 rng(seed);
+  std::uniform_int_distribution<int32_t> uni(0, num_nodes - 1);
+  int64_t got = 0, tries = 0;
+  const int64_t max_tries = 1000 * (k + 16);
+  while (got < k && tries < max_tries) {
+    ++tries;
+    int64_t a = uni(rng), b = uni(rng);
+    if (a == b) continue;
+    if (a > b) std::swap(a, b);
+    if (edge_set.count(a * num_nodes + b)) continue;
+    out[2 * got] = static_cast<int32_t>(a);
+    out[2 * got + 1] = static_cast<int32_t>(b);
+    ++got;
+  }
+  return got;
+}
+
+}  // extern "C"

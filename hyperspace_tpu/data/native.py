@@ -1,0 +1,101 @@
+"""ctypes bindings for the native C++ data helpers (SURVEY.md §2 "Data").
+
+Compiles ``_native/closure.cc`` with g++ on first use into the package's
+``_native`` directory (cached by source mtime) and exposes:
+
+- :func:`transitive_closure` — WordNet-scale DAG closure (the hook
+  :mod:`hyperspace_tpu.data.wordnet` dispatches to),
+- :func:`sample_negative_edges` — rejection-sampled LP negatives at
+  arxiv scale (used by :mod:`hyperspace_tpu.data.graphs`).
+
+No pybind11 in this environment: plain C ABI + ctypes (the sanctioned
+binding route).  Raises ImportError if no C++ toolchain is available, and
+callers fall back to their pure-Python/numpy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(__file__), "_native")
+_SRC = os.path.join(_DIR, "closure.cc")
+_LIB = os.path.join(_DIR, "libhsdata.so")
+
+_lib = None
+
+
+def _build() -> str:
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        raise ImportError("no C++ compiler for hyperspace_tpu native helpers")
+    if (not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+        cmd = [cxx, "-O2", "-shared", "-fPIC", _SRC, "-o", _LIB + ".tmp"]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(_LIB + ".tmp", _LIB)
+    return _LIB
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_build())
+    lib.closure_compute.restype = ctypes.c_void_p
+    lib.closure_compute.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32]
+    lib.pairbuf_size.restype = ctypes.c_int64
+    lib.pairbuf_size.argtypes = [ctypes.c_void_p]
+    lib.pairbuf_copy.restype = None
+    lib.pairbuf_copy.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+    lib.pairbuf_free.restype = None
+    lib.pairbuf_free.argtypes = [ctypes.c_void_p]
+    lib.sample_negative_edges.restype = ctypes.c_int64
+    lib.sample_negative_edges.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int64, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32)]
+    _lib = lib
+    return lib
+
+
+def _as_i32_pairs(a: np.ndarray) -> np.ndarray:
+    a = np.ascontiguousarray(np.asarray(a, np.int32))
+    if a.ndim != 2 or a.shape[1] != 2:
+        raise ValueError(f"expected [N, 2] pairs, got {a.shape}")
+    return a
+
+
+def transitive_closure(edges: np.ndarray, num_nodes: int) -> np.ndarray:
+    """All (node, ancestor) pairs of the parent DAG; [P, 2] int32."""
+    lib = _load()
+    e = _as_i32_pairs(edges)
+    ptr = e.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    handle = lib.closure_compute(ptr, e.shape[0], int(num_nodes))
+    try:
+        n = lib.pairbuf_size(handle)
+        out = np.empty((n, 2), np.int32)
+        if n:
+            lib.pairbuf_copy(handle, out.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int32)))
+    finally:
+        lib.pairbuf_free(handle)
+    return out
+
+
+def sample_negative_edges(
+    edges: np.ndarray, num_nodes: int, k: int, seed: int = 0
+) -> np.ndarray:
+    """k uniform undirected non-edges (canonical u<v form); [k, 2] int32."""
+    lib = _load()
+    e = _as_i32_pairs(edges)
+    out = np.empty((k, 2), np.int32)
+    got = lib.sample_negative_edges(
+        e.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), e.shape[0],
+        int(num_nodes), int(k), int(seed) & (2**64 - 1),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out[:got]

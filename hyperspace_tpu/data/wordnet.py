@@ -65,9 +65,10 @@ def transitive_closure(edges: np.ndarray, num_nodes: int) -> np.ndarray:
     """
     try:
         from hyperspace_tpu.data import native
-    except ImportError:
+
+        return native.transitive_closure(edges, num_nodes)
+    except (ImportError, OSError):  # no toolchain / build failed
         return _closure_numpy(edges, num_nodes)
-    return native.transitive_closure(edges, num_nodes)
 
 
 def _closure_numpy(edges: np.ndarray, num_nodes: int) -> np.ndarray:

@@ -1,0 +1,53 @@
+"""Native C++ data-helper tests: closure parity with the numpy oracle,
+negative-sampler invariants (SURVEY.md §4 parity-test strategy)."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.data import wordnet
+
+native = pytest.importorskip("hyperspace_tpu.data.native")
+
+
+def _canon(pairs):
+    return {(int(u), int(v)) for u, v in pairs}
+
+
+def test_closure_matches_numpy_oracle():
+    rng = np.random.default_rng(0)
+    n = 200
+    # random DAG: each node picks ≤2 parents with smaller index
+    edges = []
+    for u in range(1, n):
+        for p in rng.choice(u, size=min(u, rng.integers(0, 3)), replace=False):
+            edges.append((u, int(p)))
+    edges = np.asarray(edges, np.int32)
+    got = native.transitive_closure(edges, n)
+    want = wordnet._closure_numpy(edges, n)
+    assert _canon(got) == _canon(want)
+
+
+def test_closure_empty_and_chain():
+    assert native.transitive_closure(np.zeros((0, 2), np.int32), 4).shape == (0, 2)
+    chain = np.asarray([[1, 0], [2, 1], [3, 2]], np.int32)
+    got = _canon(native.transitive_closure(chain, 4))
+    assert got == {(1, 0), (2, 1), (2, 0), (3, 2), (3, 1), (3, 0)}
+
+
+def test_negative_sampler_invariants():
+    edges = np.asarray([[0, 1], [1, 2], [2, 3]], np.int32)
+    neg = native.sample_negative_edges(edges, 50, 200, seed=7)
+    assert neg.shape == (200, 2)
+    es = _canon(edges)
+    for u, v in neg:
+        assert u < v and 0 <= u < 50 and v < 50
+        assert (int(u), int(v)) not in es
+
+
+def test_negative_sampler_deterministic():
+    edges = np.asarray([[0, 1]], np.int32)
+    a = native.sample_negative_edges(edges, 20, 50, seed=3)
+    b = native.sample_negative_edges(edges, 20, 50, seed=3)
+    np.testing.assert_array_equal(a, b)
+    c = native.sample_negative_edges(edges, 20, 50, seed=4)
+    assert not np.array_equal(a, c)
