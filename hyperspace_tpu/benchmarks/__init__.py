@@ -1,0 +1,1 @@
+"""Benchmark harness (SURVEY.md §4.8): emits the BASELINE.json metrics."""

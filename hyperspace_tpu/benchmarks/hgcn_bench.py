@@ -1,0 +1,92 @@
+"""HGCN throughput benchmark — the north-star metric (SURVEY.md §6).
+
+BASELINE.json: "HGCN samples/sec/chip on ogbn-arxiv"; target ≥ 2× a single
+A100 at matching ROC-AUC.  Samples/sec = nodes forward+backward per second
+of full-graph training (the HGCN-codebase convention: one full-graph step
+processes every node once).
+
+Without the real ogbn-arxiv files on disk the graph is a synthetic
+hierarchy at exactly arxiv scale (169 343 nodes / 1.166 M directed edges,
+128 features, 40 classes); with ``data_root`` pointing at extracted OGB
+csvs the real graph is used — shapes and therefore timings match either
+way.
+"""
+
+from __future__ import annotations
+
+import time
+
+ARXIV_NODES = 169_343
+ARXIV_EDGES = 1_166_243
+ARXIV_FEATS = 128
+ARXIV_CLASSES = 40
+
+
+def run_hgcn_bench(
+    repeats: int = 3,
+    steps_per_repeat: int = 10,
+    backend: str = "",
+    data_root: str | None = None,
+    num_nodes: int = ARXIV_NODES,
+    dtype: str = "float32",
+) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.data import graphs as G
+    from hyperspace_tpu.models import hgcn
+
+    if data_root is not None:
+        edges, x, labels, ncls, source = G.load_graph("ogbn-arxiv", data_root)
+        num_nodes = x.shape[0]
+    else:
+        # arxiv-scale synthetic hierarchy: same node/edge/feature counts
+        branching = 3
+        extra = (ARXIV_EDGES - (num_nodes - 1) * 3) / num_nodes
+        edges, x, labels, ncls = G.synthetic_hierarchy(
+            num_nodes=num_nodes, branching=branching, feat_dim=ARXIV_FEATS,
+            ancestor_hops=3, extra_edge_frac=max(extra, 0.0),
+            num_classes=ARXIV_CLASSES, seed=0)
+        source = "synthetic"
+
+    split = G.split_edges(edges, num_nodes, x, val_frac=0.02, test_frac=0.02,
+                          seed=0, pad_multiple=65536)
+    cfg = hgcn.HGCNConfig(
+        feat_dim=x.shape[1], hidden_dims=(128, 32), kind="lorentz",
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
+    ga = hgcn._device_graph(split.graph)
+    train_pos = jnp.asarray(split.train_pos)
+
+    # compile + warmup
+    state, loss = hgcn.train_step_lp(model, opt, num_nodes, state, ga, train_pos)
+    jax.block_until_ready(loss)
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps_per_repeat):
+            state, loss = hgcn.train_step_lp(
+                model, opt, num_nodes, state, ga, train_pos)
+        jax.block_until_ready(loss)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    samples_per_sec = num_nodes * steps_per_repeat / best
+    n_dev = jax.device_count()
+    return {
+        "metric": "hgcn_samples_per_sec_per_chip",
+        "value": round(samples_per_sec / n_dev, 1),
+        "unit": "samples/s/chip",
+        "vs_baseline": None,
+        "detail": {
+            "num_nodes": num_nodes,
+            "num_edges_padded": int(split.graph.senders.shape[0]),
+            "steps": steps_per_repeat,
+            "step_time_s": round(best / steps_per_repeat, 5),
+            "loss": float(loss),
+            "devices": n_dev,
+            "backend": backend,
+            "source": source,
+            "dtype": dtype,
+        },
+    }
