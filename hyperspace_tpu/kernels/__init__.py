@@ -1,0 +1,37 @@
+"""Pallas TPU kernel layer — TPU-native equivalents of the reference's
+CUDA kernels N1-N7 (SURVEY.md §2 native inventory).
+
+Every op in this package:
+
+- runs as a **Pallas (Mosaic) kernel** when the default backend is TPU;
+- runs its **pure-JAX twin** (the manifold-math oracle) on CPU/GPU;
+- can be forced with ``HYPERSPACE_KERNELS={auto,pallas,interpret,xla}``
+  (``interpret`` = Pallas interpreter on CPU, used by the parity tests);
+- differentiates through the twin via ``custom_vjp`` (rematerializing
+  backward — the TPU-idiomatic FLOPs-for-HBM trade).
+"""
+
+from hyperspace_tpu.kernels._support import mode
+from hyperspace_tpu.kernels.distmat import lorentz_pdist, poincare_pdist
+from hyperspace_tpu.kernels.pointwise import (
+    expmap,
+    expmap0,
+    logmap,
+    logmap0,
+    mobius_add,
+    mobius_scalar_mul,
+    ptransp,
+)
+
+__all__ = [
+    "mode",
+    "mobius_add",
+    "mobius_scalar_mul",
+    "expmap",
+    "logmap",
+    "expmap0",
+    "logmap0",
+    "ptransp",
+    "poincare_pdist",
+    "lorentz_pdist",
+]

@@ -1,0 +1,169 @@
+"""Fused pairwise-distance matrix kernels (ball and hyperboloid).
+
+The hot loop of Poincaré-embedding training and of WordNet MAP/mean-rank
+eval (SURVEY.md §3.1, §3.5) is an all-pairs hyperbolic distance: every
+batch row against every candidate row.  The reference computes this with
+its CUDA distance kernels inside autograd [INFERRED]; here it is one
+Pallas kernel per (row-block × col-block) output tile built around MXU
+matmuls, with **no transposes or 1-D relayouts** — every broadcast of a
+per-column quantity is expressed as a rank-1 ``dot_general`` so Mosaic
+sees only (sublane, lane)-shaped data.
+
+Math (both forms are the textbook closed expressions, equal to
+``PoincareBall.dist`` / ``Lorentz.dist``):
+
+- ball:      d(x,y) = (1/√c)·arcosh(1 + 2c‖x−y‖² / ((1−c‖x‖²)(1−c‖y‖²)))
+  with ‖x−y‖² = ‖x‖² − 2⟨x,y⟩ + ‖y‖² — one Gram matmul.
+- hyperboloid: d(x,y) = (1/√c)·arcosh(−c⟨x,y⟩_L) — one Minkowski Gram
+  matmul (time lane negated).
+
+Gradients flow through the XLA twin (custom_vjp), which is itself a
+matmul-shaped expression — fast and fused by XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hyperspace_tpu.kernels import _support as S
+from hyperspace_tpu.manifolds import smath
+
+
+def _dotT(a: jax.Array, b: jax.Array) -> jax.Array:
+    """[n, k] × [m, k] → [n, m], contracting the last axis of both.
+
+    HIGHEST precision: distances feed quality metrics (ROC-AUC / MAP), and
+    the default TPU matmul precision (bf16 passes) costs ~1e-2 absolute on
+    arcosh-amplified distance values.
+    """
+    return jax.lax.dot_general(
+        a, b, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+# --- Poincaré ball ------------------------------------------------------------
+
+
+def _poincare_body(c_ref, x_ref, y_ref, o_ref):
+    c = c_ref[0, 0]
+    x = x_ref[:].astype(jnp.float32)
+    y = y_ref[:].astype(jnp.float32)
+    sc = S.ksafe_sqrt(c)
+    gram = _dotT(x, y)                      # [bn, bm]
+    xx = S.ksq_norm(x)                      # [bn, 1]
+    yy = S.ksq_norm(y)                      # [bm, 1]
+    ones = jnp.ones_like(xx)
+    yy_t = _dotT(ones, yy)                  # [bn, bm] — rank-1 row broadcast
+    d2 = jnp.maximum(xx - 2.0 * gram + yy_t, 0.0)
+    den = _dotT(1.0 - c * xx, 1.0 - c * yy)  # (1−c‖x‖²)(1−c‖y‖²), rank-1
+    u = 2.0 * c * d2 / jnp.maximum(den, S.EPS_F32)
+    dist = S.karcosh1p(u) / jnp.maximum(sc, S.MIN_NORM_F32)
+    o_ref[:] = dist.astype(o_ref.dtype)
+
+
+def _t_poincare_pdist(x, y, c):
+    """XLA twin: same closed form, vectorized (== PoincareBall.dist pairwise)."""
+    cc = jnp.asarray(c, x.dtype)
+    sc = smath.sqrt_c(cc)
+    gram = jnp.matmul(x, y.T, precision=jax.lax.Precision.HIGHEST)
+    xx = smath.sq_norm(x)          # [n, 1]
+    yy = smath.sq_norm(y)[:, 0]    # [m]
+    d2 = smath.clamp_min(xx - 2.0 * gram + yy[None, :], 0.0)
+    den = smath.clamp_min((1.0 - cc * xx) * (1.0 - cc * yy[None, :]),
+                          smath.eps_for(x.dtype))
+    u = 2.0 * cc * d2 / den
+    return smath.arcosh1p(u) / smath.clamp_min(sc, smath.min_norm(x.dtype))
+
+
+# --- Lorentz hyperboloid ------------------------------------------------------
+
+
+def _lorentz_body(c_ref, x_ref, y_ref, o_ref):
+    c = c_ref[0, 0]
+    x = x_ref[:].astype(jnp.float32)
+    y = y_ref[:].astype(jnp.float32)
+    sc = S.ksafe_sqrt(c)
+    lane = jax.lax.broadcasted_iota(jnp.int32, y.shape, dimension=1)
+    y_flip = jnp.where(lane == 0, -y, y)    # Minkowski signature on the time lane
+    gram = _dotT(x, y_flip)                 # ⟨x, y⟩_L
+    u = jnp.maximum(-c * gram - 1.0, 0.0)
+    dist = S.karcosh1p(u) / jnp.maximum(sc, S.MIN_NORM_F32)
+    o_ref[:] = dist.astype(o_ref.dtype)
+
+
+def _t_lorentz_pdist(x, y, c):
+    """XLA twin: arcosh(−c⟨x,y⟩_L)/√c on the full Gram matrix."""
+    cc = jnp.asarray(c, x.dtype)
+    y_flip = y.at[..., 0].multiply(-1.0)
+    gram = jnp.matmul(x, y_flip.T, precision=jax.lax.Precision.HIGHEST)
+    u = smath.clamp_min(-cc * gram - 1.0, 0.0)
+    return smath.arcosh1p(u) / smath.clamp_min(
+        smath.sqrt_c(cc), smath.min_norm(x.dtype))
+
+
+# --- launcher + public API ----------------------------------------------------
+
+
+def _launch_pdist(body, x, y, c, mode_):
+    n, d = x.shape
+    m = y.shape[0]
+    bn = min(S.round_up(n, 8), 256)
+    bm = min(S.round_up(m, 128), 512)
+    # keep x-block + y-block + out-block under the VMEM budget for wide d
+    dp_ = S.round_up(d, 128)
+    while 4 * (bn * dp_ + bm * dp_ + bn * bm) > S.VMEM_BUDGET and (bn > 8 or bm > 128):
+        if bm > 128 and bm >= bn:
+            bm = max(128, (bm // 2) // 128 * 128)  # keep 128-lane alignment
+        else:
+            bn = max(8, (bn // 2) // 8 * 8)
+    xp = S.pad_rows_lanes(x, rows_to=bn)
+    yp = S.pad_rows_lanes(y, rows_to=bm)
+    np_, dp = xp.shape
+    mp_ = yp.shape[0]
+    grid = (np_ // bn, mp_ // bm)
+
+    out = pl.pallas_call(
+        body,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((bn, dp), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, dp), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bn, bm), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((np_, mp_), x.dtype),
+        interpret=S.interpret_flag(mode_),
+    )(S.c_smem(c), xp, yp)
+    return out[:n, :m]
+
+
+def _make_pdist(twin, body):
+    def fwd_impl(x, y, c):
+        m = S.mode()
+        if m == "xla":
+            return twin(x, y, c)
+        return _launch_pdist(body, x, y, c, m)
+
+    @jax.custom_vjp
+    def op(x, y, c):
+        return fwd_impl(x, y, c)
+
+    def op_fwd(x, y, c):
+        return fwd_impl(x, y, c), (x, y, c)
+
+    def op_bwd(res, g):
+        _, vjp = jax.vjp(twin, *res)
+        return vjp(g)
+
+    op.defvjp(op_fwd, op_bwd)
+    op.__doc__ = twin.__doc__
+    return op
+
+
+poincare_pdist = _make_pdist(_t_poincare_pdist, _poincare_body)
+lorentz_pdist = _make_pdist(_t_lorentz_pdist, _lorentz_body)

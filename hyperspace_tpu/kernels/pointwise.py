@@ -1,0 +1,230 @@
+"""Rowwise Pallas kernels: Möbius ops, exp/log maps, parallel transport.
+
+TPU equivalents of the reference's elementwise CUDA kernels N1-N4
+(SURVEY.md §2): ``mobius_add``, ``mobius_scalar_mul``, ``expmap``/``logmap``
+(and their origin forms), ``ptransp`` — each fuses the whole chain of
+norms, clamps, and transcendentals for a row block into one VMEM-resident
+kernel pass instead of a string of HBM round-trips.
+
+Every op dispatches per :func:`hyperspace_tpu.kernels._support.mode`:
+the Pallas kernel on TPU, the :class:`PoincareBall` method (the oracle
+twin) on other backends.  Gradients always flow through the twin via
+``jax.custom_vjp`` — backward re-derives the op with XLA autodiff, which
+both avoids hand-written transposes and acts as rematerialization
+(TPU-idiomatic: trade FLOPs for HBM).
+
+All ops accept [..., d] with broadcasting between operands; compute is
+f32 inside the kernel regardless of input dtype (bf16-safe).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hyperspace_tpu.kernels import _support as S
+from hyperspace_tpu.manifolds.poincare import PoincareBall
+
+
+def _launch_rowwise(body, tensors, scalars, mode_):
+    """Run ``body(*scalar_refs, *tensor_refs, o_ref)`` over row blocks.
+
+    tensors: list of [N, d] arrays (identical shapes); scalars: list of
+    python/traced scalars, passed as (1, 1) SMEM blocks. Output matches
+    tensors[0] in shape/dtype.
+    """
+    n, d = tensors[0].shape
+    dtype = tensors[0].dtype
+    bn = S.row_block(n, dp=S.round_up(d, 128), n_bufs=len(tensors) + 1)
+    padded = [S.pad_rows_lanes(t, rows_to=bn) for t in tensors]
+    np_, dp = padded[0].shape
+    grid = (np_ // bn,)
+
+    smem_spec = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    vmem_spec = pl.BlockSpec((bn, dp), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+    out = pl.pallas_call(
+        body,
+        grid=grid,
+        in_specs=[smem_spec] * len(scalars) + [vmem_spec] * len(tensors),
+        out_specs=vmem_spec,
+        out_shape=jax.ShapeDtypeStruct((np_, dp), dtype),
+        interpret=S.interpret_flag(mode_),
+    )(*[S.c_smem(s) for s in scalars], *padded)
+    return out[:n, :d]
+
+
+def _rowwise_op(twin, kernel_fn, n_tensors):
+    """Build a custom-vjp op: pallas forward (twin elsewhere), twin backward.
+
+    Signature of the produced op: (t1, ..., tn, c) with [..., d] tensors
+    broadcast against each other and a scalar curvature c.
+    """
+
+    def fwd_impl(*args):
+        *tensors, c = args
+        m = S.mode()
+        if m == "xla":
+            return twin(*tensors, c)
+        tensors = jnp.broadcast_arrays(*tensors) if n_tensors > 1 else list(tensors)
+        flat0, lead = S.flatten_batch(tensors[0])
+        flats = [flat0] + [S.flatten_batch(t)[0] for t in tensors[1:]]
+        out = _launch_rowwise(kernel_fn, flats, [c], m)
+        return out.reshape(lead + out.shape[-1:])
+
+    @jax.custom_vjp
+    def op(*args):
+        return fwd_impl(*args)
+
+    def op_fwd(*args):
+        return fwd_impl(*args), args
+
+    def op_bwd(res, g):
+        _, vjp = jax.vjp(twin, *res)
+        return vjp(g)
+
+    op.defvjp(op_fwd, op_bwd)
+    return functools.wraps(twin)(op)
+
+
+# --- kernel bodies (f32 compute; zero-padded lanes are exact no-ops) ----------
+
+
+def _mobius_add_body(c_ref, x_ref, y_ref, o_ref):
+    c = c_ref[0, 0]
+    x = x_ref[:].astype(jnp.float32)
+    y = y_ref[:].astype(jnp.float32)
+    o_ref[:] = S.kmobius_add(x, y, c).astype(o_ref.dtype)
+
+
+def _mobius_scalar_mul_body(c_ref, r_ref, x_ref, o_ref):
+    c = c_ref[0, 0]
+    r = r_ref[0, 0]
+    x = x_ref[:].astype(jnp.float32)
+    sc = S.ksafe_sqrt(c)
+    norm = jnp.maximum(S.ksafe_norm(x), S.MIN_NORM_F32)
+    t = S.ktanh(r * S.kartanh(sc * norm))
+    o_ref[:] = (t * x / jnp.maximum(sc * norm, S.MIN_NORM_F32)).astype(o_ref.dtype)
+
+
+def _expmap_body(c_ref, x_ref, v_ref, o_ref):
+    c = c_ref[0, 0]
+    x = x_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    sc = S.ksafe_sqrt(c)
+    lam = S.klambda_x(x, c)
+    t = sc * lam * S.ksafe_norm(v) / 2.0
+    second = S.ktanc(t) * lam / 2.0 * v
+    o_ref[:] = S.kproj(S.kmobius_add(x, second, c), c).astype(o_ref.dtype)
+
+
+def _logmap_body(c_ref, x_ref, y_ref, o_ref):
+    c = c_ref[0, 0]
+    x = x_ref[:].astype(jnp.float32)
+    y = y_ref[:].astype(jnp.float32)
+    sc = S.ksafe_sqrt(c)
+    sub = S.kmobius_add(-x, y, c)
+    lam = S.klambda_x(x, c)
+    o_ref[:] = ((2.0 / lam) * S.kartanc(sc * S.ksafe_norm(sub)) * sub).astype(o_ref.dtype)
+
+
+def _expmap0_body(c_ref, v_ref, o_ref):
+    c = c_ref[0, 0]
+    v = v_ref[:].astype(jnp.float32)
+    sc = S.ksafe_sqrt(c)
+    o_ref[:] = S.kproj(S.ktanc(sc * S.ksafe_norm(v)) * v, c).astype(o_ref.dtype)
+
+
+def _logmap0_body(c_ref, y_ref, o_ref):
+    c = c_ref[0, 0]
+    y = y_ref[:].astype(jnp.float32)
+    sc = S.ksafe_sqrt(c)
+    o_ref[:] = (S.kartanc(sc * S.ksafe_norm(y)) * y).astype(o_ref.dtype)
+
+
+def _ptransp_body(c_ref, x_ref, y_ref, v_ref, o_ref):
+    c = c_ref[0, 0]
+    x = x_ref[:].astype(jnp.float32)
+    y = y_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    lam_x = S.klambda_x(x, c)
+    lam_y = S.klambda_x(y, c)
+    o_ref[:] = (S.kgyration(y, -x, v, c) * lam_x / lam_y).astype(o_ref.dtype)
+
+
+# --- twins (the manifold methods themselves) ----------------------------------
+
+
+def _t_mobius_add(x, y, c):
+    """x ⊕_c y on the Poincaré ball (reference CUDA kernel N1)."""
+    return PoincareBall(c).mobius_add(x, y)
+
+
+def _t_mobius_scalar_mul(x, r, c):
+    """r ⊗_c x (reference CUDA kernel N2); r is the second tensor arg."""
+    return PoincareBall(c).mobius_scalar_mul(r, x)
+
+
+def _t_expmap(x, v, c):
+    """exp_x(v) on the ball (reference CUDA kernel N3)."""
+    return PoincareBall(c).expmap(x, v)
+
+
+def _t_logmap(x, y, c):
+    """log_x(y) on the ball (reference CUDA kernel N3)."""
+    return PoincareBall(c).logmap(x, y)
+
+
+def _t_expmap0(v, c):
+    """exp_0(v) on the ball."""
+    return PoincareBall(c).expmap0(v)
+
+
+def _t_logmap0(y, c):
+    """log_0(y) on the ball."""
+    return PoincareBall(c).logmap0(y)
+
+
+def _t_ptransp(x, y, v, c):
+    """P_{x→y}(v) on the ball (reference CUDA kernel N4)."""
+    return PoincareBall(c).ptransp(x, y, v)
+
+
+mobius_add = _rowwise_op(_t_mobius_add, _mobius_add_body, 2)
+expmap = _rowwise_op(_t_expmap, _expmap_body, 2)
+logmap = _rowwise_op(_t_logmap, _logmap_body, 2)
+expmap0 = _rowwise_op(_t_expmap0, _expmap0_body, 1)
+logmap0 = _rowwise_op(_t_logmap0, _logmap0_body, 1)
+ptransp = _rowwise_op(_t_ptransp, _ptransp_body, 3)
+
+
+def _msm_fwd_impl(r, x, c):
+    m = S.mode()
+    if m == "xla":
+        return _t_mobius_scalar_mul(x, r, c)
+    flat, lead = S.flatten_batch(x)
+    out = _launch_rowwise(_mobius_scalar_mul_body, [flat], [c, r], m)
+    return out.reshape(lead + out.shape[-1:])
+
+
+@jax.custom_vjp
+def mobius_scalar_mul(r, x, c):
+    """r ⊗_c x with scalar r (kernel N2); r may be traced (differentiable)."""
+    return _msm_fwd_impl(r, x, c)
+
+
+def _msm_fwd(r, x, c):
+    return _msm_fwd_impl(r, x, c), (r, x, c)
+
+
+def _msm_bwd(res, g):
+    r, x, c = res
+    _, vjp = jax.vjp(lambda r_, x_, c_: _t_mobius_scalar_mul(x_, r_, c_), r, x, c)
+    return vjp(g)
+
+
+mobius_scalar_mul.defvjp(_msm_fwd, _msm_bwd)

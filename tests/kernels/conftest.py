@@ -1,0 +1,18 @@
+"""Shared helpers for the kernel parity suite."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+
+def ball_points(rng, shape, c, scale=0.8):
+    """Points strictly inside the ball of curvature -c (norm < scale/sqrt(c))."""
+    v = rng.standard_normal(shape)
+    v = v / (1.0 + np.linalg.norm(v, axis=-1, keepdims=True))
+    return jnp.asarray(v * scale / np.sqrt(c), jnp.float32)
+
+
+@pytest.fixture
+def interp(monkeypatch):
+    """Force Pallas interpreter mode for the test (SURVEY.md §4.4)."""
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "interpret")

@@ -1,0 +1,70 @@
+"""Parity for the fused pairwise-distance kernels: kernel == twin == the
+per-pair manifold distance (vmapped), on both manifolds."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.kernels import distmat
+from hyperspace_tpu.manifolds import Lorentz, PoincareBall
+
+from tests.kernels.conftest import ball_points as _ball_points
+
+
+
+def _lorentz_points(rng, n, d, c):
+    man = Lorentz(c)
+    v = jnp.asarray(rng.standard_normal((n, d + 1)) * 0.5, jnp.float64)
+    v = v.at[:, 0].set(0.0)
+    return np.asarray(man.expmap0(v))
+
+
+@pytest.mark.parametrize("n,m,d", [(10, 13, 5), (64, 200, 10), (257, 129, 3)])
+def test_poincare_pdist_parity(interp, rng, n, m, d):
+    c = 1.0
+    x = _ball_points(rng, (n, d), c)
+    y = _ball_points(rng, (m, d), c)
+    out = distmat.poincare_pdist(x, y, c)
+    assert out.shape == (n, m)
+
+    ball = PoincareBall(c)
+    x64, y64 = x.astype(jnp.float64), y.astype(jnp.float64)
+    oracle = jax.vmap(lambda xi: ball.dist(xi, y64))(x64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle, np.float32),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_lorentz_pdist_parity(interp, rng):
+    c = 0.8
+    x = jnp.asarray(_lorentz_points(rng, 33, 6, c), jnp.float32)
+    y = jnp.asarray(_lorentz_points(rng, 50, 6, c), jnp.float32)
+    out = distmat.lorentz_pdist(x, y, c)
+
+    man = Lorentz(c)
+    x64, y64 = x.astype(jnp.float64), y.astype(jnp.float64)
+    oracle = jax.vmap(lambda xi: man.dist(xi, y64))(x64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle, np.float32),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_twin_matches_manifold_dist(rng):
+    """The closed-form twin == artanh-form PoincareBall.dist in f64."""
+    c = 1.7
+    x = jnp.asarray(_ball_points(rng, (20, 4), c), jnp.float64)
+    y = jnp.asarray(_ball_points(rng, (30, 4), c), jnp.float64)
+    twin = distmat._t_poincare_pdist(x, y, c)
+    ball = PoincareBall(c)
+    oracle = jax.vmap(lambda xi: ball.dist(xi, y))(x)
+    np.testing.assert_allclose(np.asarray(twin), np.asarray(oracle),
+                               rtol=1e-9, atol=1e-9)
+
+
+def test_pdist_gradients(interp, rng):
+    c = 1.0
+    x = _ball_points(rng, (6, 4), c)
+    y = _ball_points(rng, (8, 4), c)
+    g_k = jax.grad(lambda xx: jnp.sum(distmat.poincare_pdist(xx, y, c)))(x)
+    g_t = jax.grad(lambda xx: jnp.sum(distmat._t_poincare_pdist(xx, y, c)))(x)
+    np.testing.assert_allclose(np.asarray(g_k), np.asarray(g_t), rtol=1e-5, atol=1e-5)
+    assert np.all(np.isfinite(np.asarray(g_k)))

@@ -1,0 +1,119 @@
+"""Kernel↔twin parity for the rowwise Pallas kernels (SURVEY.md §4.4).
+
+Kernels run through the Pallas interpreter on CPU (HYPERSPACE_KERNELS=
+interpret); the oracle is the PoincareBall manifold method at matching f32
+precision (same eps policy).  This is the CUDA-vs-CPU parity suite of the
+reference family, re-targeted at Mosaic.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.kernels import pointwise as pw
+from hyperspace_tpu.manifolds import PoincareBall
+
+from tests.kernels.conftest import ball_points as _ball_points
+
+
+CURVATURES = [1.0, 0.5, 2.3]
+SHAPES = [(4, 2), (40, 10), (130, 7), (9, 128), (17, 200)]
+
+
+
+def _check(kernel_out, oracle_out, rtol=2e-4, atol=2e-5):
+    # oracle runs the manifold method at the same f32 precision (identical
+    # eps policy); tolerance covers log-form vs arctanh transcendentals.
+    np.testing.assert_allclose(
+        np.asarray(kernel_out), np.asarray(oracle_out, np.float32),
+        rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("c", CURVATURES)
+@pytest.mark.parametrize("shape", SHAPES[:3])
+def test_mobius_add_parity(interp, rng, c, shape):
+    x = _ball_points(rng, shape, c)
+    y = _ball_points(rng, shape, c)
+    ball = PoincareBall(c)
+    _check(pw.mobius_add(x, y, c),
+           ball.mobius_add(x, y))
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_expmap_logmap_parity(interp, rng, shape):
+    c = 1.3
+    ball = PoincareBall(c)
+    x = _ball_points(rng, shape, c)
+    v = jnp.asarray(rng.standard_normal(shape) * 0.3, jnp.float32)
+    y = _ball_points(rng, shape, c, scale=0.5)
+    _check(pw.expmap(x, v, c), ball.expmap(x, v))
+    _check(pw.logmap(x, y, c), ball.logmap(x, y))
+    _check(pw.expmap0(v, c), ball.expmap0(v))
+    _check(pw.logmap0(y, c), ball.logmap0(y))
+
+
+def test_mobius_scalar_mul_parity(interp, rng):
+    c = 0.7
+    x = _ball_points(rng, (33, 6), c)
+    for r in [-1.5, 0.0, 0.5, 3.0]:
+        _check(pw.mobius_scalar_mul(r, x, c),
+               PoincareBall(c).mobius_scalar_mul(r, x))
+
+
+def test_ptransp_parity(interp, rng):
+    c = 1.0
+    x = _ball_points(rng, (21, 5), c)
+    y = _ball_points(rng, (21, 5), c, scale=0.6)
+    v = jnp.asarray(rng.standard_normal((21, 5)) * 0.4, jnp.float32)
+    _check(pw.ptransp(x, y, v, c),
+           PoincareBall(c).ptransp(x, y, v))
+
+
+def test_broadcasting_and_batch_dims(interp, rng):
+    c = 1.0
+    x = _ball_points(rng, (3, 8, 6), c)
+    b = _ball_points(rng, (6,), c, scale=0.2)
+    out = pw.mobius_add(x, b, c)
+    oracle = PoincareBall(c).mobius_add(x, jnp.broadcast_to(b, x.shape))
+    _check(out, oracle)
+    assert out.shape == x.shape
+
+
+def test_gradients_flow_through_twin(interp, rng):
+    """custom_vjp backward == direct autodiff of the manifold method."""
+    c = 1.0
+    x = _ball_points(rng, (5, 4), c).astype(jnp.float32)
+    v = jnp.asarray(rng.standard_normal((5, 4)) * 0.2, jnp.float32)
+
+    g_kernel = jax.grad(lambda xx: jnp.sum(pw.expmap(xx, v, c) ** 2))(x)
+    g_direct = jax.grad(lambda xx: jnp.sum(PoincareBall(c).expmap(xx, v) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g_kernel), np.asarray(g_direct),
+                               rtol=1e-5, atol=1e-5)
+
+    # curvature gradient (learned-c path, workload 5) is finite and matches
+    gc_kernel = jax.grad(lambda cc: jnp.sum(pw.expmap0(v, cc)))(jnp.float32(c))
+    gc_direct = jax.grad(lambda cc: jnp.sum(PoincareBall(cc).expmap0(v)))(jnp.float32(c))
+    np.testing.assert_allclose(gc_kernel, gc_direct, rtol=1e-5, atol=1e-5)
+
+
+def test_xla_mode_is_twin(monkeypatch, rng):
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "xla")
+    c = 1.0
+    x = _ball_points(rng, (7, 3), c)
+    y = _ball_points(rng, (7, 3), c)
+    np.testing.assert_array_equal(
+        np.asarray(pw.mobius_add(x, y, c)),
+        np.asarray(PoincareBall(c).mobius_add(x, y)))
+
+
+def test_bf16_inputs_compute_in_f32(interp, rng):
+    c = 1.0
+    x = _ball_points(rng, (16, 8), c).astype(jnp.bfloat16)
+    y = _ball_points(rng, (16, 8), c).astype(jnp.bfloat16)
+    out = pw.mobius_add(x, y, c)
+    assert out.dtype == jnp.bfloat16
+    oracle = PoincareBall(c).mobius_add(
+        x.astype(jnp.float32), y.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(oracle), rtol=2e-2, atol=2e-2)
