@@ -13,6 +13,7 @@ Every op in this package:
 
 from hyperspace_tpu.kernels._support import mode
 from hyperspace_tpu.kernels.distmat import lorentz_pdist, poincare_pdist
+from hyperspace_tpu.kernels.mlr import hyp_mlr
 from hyperspace_tpu.kernels.pointwise import (
     expmap,
     expmap0,
@@ -34,4 +35,5 @@ __all__ = [
     "ptransp",
     "poincare_pdist",
     "lorentz_pdist",
+    "hyp_mlr",
 ]

@@ -97,7 +97,33 @@ def c_smem(c, dtype=jnp.float32) -> jax.Array:
     return jnp.asarray(c, dtype).reshape(1, 1)
 
 
+def dotT(a: jax.Array, b: jax.Array) -> jax.Array:
+    """[n, k] × [m, k] → [n, m], contracting the last axis of both.
+
+    HIGHEST precision: kernel matmuls feed arcosh/asinh-amplified quantities
+    (distances, logits), where the default bf16-pass matmul costs ~1e-2
+    absolute.  Also the rank-1 broadcast idiom: ``dotT(ones, col)`` turns a
+    per-column [m, 1] quantity into [n, m] without a transpose/relayout.
+    """
+    return jax.lax.dot_general(
+        a, b, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
 # --- Mosaic-safe transcendentals (f32 in-kernel compute) ----------------------
+
+
+def kasinh(x: jax.Array) -> jax.Array:
+    """asinh via logs: sign(x)·log1p(|x| + |x|²/(1+sqrt(1+x²))), Mosaic-safe.
+
+    The log1p form is exact for small |x| and never catastrophically
+    cancels; callers bound |x| via their artanh-style clamps.
+    """
+    ax = jnp.abs(x)
+    r = ksafe_sqrt(ax * ax + 1.0)
+    return jnp.sign(x) * jnp.log1p(ax + ax * ax / (1.0 + r))
 
 
 def ksafe_sqrt(x: jax.Array) -> jax.Array:

@@ -32,18 +32,7 @@ from hyperspace_tpu.kernels import _support as S
 from hyperspace_tpu.manifolds import smath
 
 
-def _dotT(a: jax.Array, b: jax.Array) -> jax.Array:
-    """[n, k] × [m, k] → [n, m], contracting the last axis of both.
-
-    HIGHEST precision: distances feed quality metrics (ROC-AUC / MAP), and
-    the default TPU matmul precision (bf16 passes) costs ~1e-2 absolute on
-    arcosh-amplified distance values.
-    """
-    return jax.lax.dot_general(
-        a, b, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )
+_dotT = S.dotT
 
 
 # --- Poincaré ball ------------------------------------------------------------

@@ -1,0 +1,165 @@
+"""Fused hyperbolic-MLR kernel (reference CUDA kernel N6; SURVEY.md §2).
+
+The naive hyperbolic softmax head (hyperspace_tpu/nn/mlr.py,
+``hyp_mlr_logits``) materializes z_k = (−p_k) ⊕_c x for every
+(point, class) pair — an [..., K, d] intermediate that is pure HBM
+traffic.  Expanding the Möbius addition algebraically removes it: with
+
+    α  = 1 − 2c⟨p,x⟩ + c‖x‖²          β   = 1 − c‖p‖²
+    den = 1 − 2c⟨p,x⟩ + c²‖p‖²‖x‖²    (clamped like mobius_add)
+
+the two reductions the logit needs are rank-2 expressions
+
+    ⟨z,a⟩ = (−α⟨p,a⟩ + β⟨x,a⟩) / den
+    ‖z‖²  = (α²‖p‖² − 2αβ⟨p,x⟩ + β²‖x‖²) / den² ,
+
+so the whole [N, K] logit matrix is TWO MXU matmuls (x pᵀ and x aᵀ) plus
+elementwise — the same cost shape as a Euclidean linear head.  That
+expansion is both the XLA twin (used on CPU/GPU and for gradients) and
+the Pallas kernel body here; ``tests/kernels/test_mlr.py`` pins both to
+the naive Möbius-form oracle.
+
+    logit_k(x) = (λ_{p_k}‖a_k‖/√c) · asinh( 2√c⟨z,a⟩ / ((1−c‖z‖²)‖a_k‖) )
+
+(Ganea et al. 2018 eq. (25)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hyperspace_tpu.kernels import _support as S
+from hyperspace_tpu.manifolds import smath
+
+
+_dotT = S.dotT
+kasinh = S.kasinh
+
+
+def _mlr_body(c_ref, x_ref, p_ref, a_ref, o_ref):
+    c = c_ref[0, 0]
+    x = x_ref[:].astype(jnp.float32)   # [bn, dp]
+    p = p_ref[:].astype(jnp.float32)   # [bk, dp]
+    a = a_ref[:].astype(jnp.float32)   # [bk, dp]
+    sc = jnp.maximum(S.ksafe_sqrt(c), S.MIN_NORM_F32)
+
+    x2 = S.ksq_norm(x)                 # [bn, 1] — broadcasts over lanes
+    p2 = S.ksq_norm(p)                 # [bk, 1]
+    pa = jnp.sum(p * a, axis=-1, keepdims=True)                   # [bk, 1]
+    a_norm = jnp.maximum(S.ksafe_sqrt(S.ksq_norm(a)), S.MIN_NORM_F32)
+
+    ones = jnp.ones_like(x2)
+    # rank-1 row broadcasts of per-class scalars (no transposes in Mosaic)
+    p2_t = _dotT(ones, p2)             # [bn, bk]
+    pa_t = _dotT(ones, pa)
+    an_t = _dotT(ones, a_norm)
+
+    xp = _dotT(x, p)                   # ⟨x, p_k⟩ — MXU matmul 1
+    xa = _dotT(x, a)                   # ⟨x, a_k⟩ — MXU matmul 2
+
+    alpha = 1.0 - 2.0 * c * xp + c * x2
+    beta = 1.0 - c * p2_t
+    den = jnp.maximum(1.0 - 2.0 * c * xp + (c * c) * p2_t * x2, S.EPS_F32)
+
+    za = (-alpha * pa_t + beta * xa) / den
+    z2 = (alpha * alpha * p2_t - 2.0 * alpha * beta * xp + beta * beta * x2) / (den * den)
+
+    lam_p = 2.0 / jnp.maximum(1.0 - c * p2_t, S.EPS_F32)
+    arg = 2.0 * sc * za / (jnp.maximum(1.0 - c * z2, S.EPS_F32) * an_t)
+    o_ref[:] = ((lam_p * an_t / sc) * kasinh(arg)).astype(o_ref.dtype)
+
+
+def _t_hyp_mlr(x, p, a, c):
+    """XLA twin: the same expansion, vectorized (== naive hyp_mlr_logits).
+
+    x: [..., d] ball points; p: [K, d] hyperplane base points; a: [K, d]
+    tangent normals.  Returns [..., K].
+    """
+    cc = jnp.asarray(c, x.dtype)
+    sc = smath.clamp_min(smath.sqrt_c(cc), smath.min_norm(x.dtype))
+    eps = smath.eps_for(x.dtype)
+
+    x2 = smath.sq_norm(x)                                   # [..., 1]
+    p2 = smath.sq_norm(p)[:, 0]                             # [K]
+    pa = jnp.sum(p * a, axis=-1)                            # [K]
+    a_norm = smath.clamp_min(smath.safe_norm(a, keepdims=False),
+                             smath.min_norm(x.dtype))       # [K]
+
+    xp = jnp.matmul(x, p.T, precision=jax.lax.Precision.HIGHEST)  # [..., K]
+    xa = jnp.matmul(x, a.T, precision=jax.lax.Precision.HIGHEST)  # [..., K]
+
+    alpha = 1.0 - 2.0 * cc * xp + cc * x2
+    beta = 1.0 - cc * p2
+    den = smath.clamp_min(1.0 - 2.0 * cc * xp + (cc ** 2) * p2 * x2, eps)
+
+    za = (-alpha * pa + beta * xa) / den
+    z2 = (alpha ** 2 * p2 - 2.0 * alpha * beta * xp + beta ** 2 * x2) / (den ** 2)
+
+    lam_p = 2.0 / smath.clamp_min(1.0 - cc * p2, eps)
+    arg = 2.0 * sc * za / (smath.clamp_min(1.0 - cc * z2, eps) * a_norm)
+    return (lam_p * a_norm / sc) * jnp.arcsinh(arg)
+
+
+def _launch_mlr(x, p, a, c, mode_):
+    n, d = x.shape
+    k = p.shape[0]
+    bn = min(S.round_up(n, 8), 256)
+    bk = min(S.round_up(k, 128), 512)
+    dp_ = S.round_up(d, 128)
+    # x-block + p-block + a-block + out-block under the VMEM budget
+    while 4 * (bn * dp_ + 2 * bk * dp_ + bn * bk) > S.VMEM_BUDGET and (bn > 8 or bk > 128):
+        if bk > 128 and bk >= bn:
+            bk = max(128, (bk // 2) // 128 * 128)
+        else:
+            bn = max(8, (bn // 2) // 8 * 8)
+    xp_ = S.pad_rows_lanes(x, rows_to=bn)
+    pp = S.pad_rows_lanes(p, rows_to=bk)
+    ap = S.pad_rows_lanes(a, rows_to=bk)
+    np_, dp = xp_.shape
+    kp = pp.shape[0]
+    grid = (np_ // bn, kp // bk)
+
+    out = pl.pallas_call(
+        _mlr_body,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((bn, dp), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk, dp), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk, dp), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bn, bk), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((np_, kp), x.dtype),
+        interpret=S.interpret_flag(mode_),
+    )(S.c_smem(c), xp_, pp, ap)
+    return out[:n, :k]
+
+
+def _fwd_impl(x, p, a, c):
+    m = S.mode()
+    if m == "xla":
+        return _t_hyp_mlr(x, p, a, c)
+    flat, lead = S.flatten_batch(x)
+    out = _launch_mlr(flat, p, a, c, m)
+    return out.reshape(lead + out.shape[-1:])
+
+
+@jax.custom_vjp
+def hyp_mlr(x, p, a, c):
+    """Fused hyperbolic-MLR logits (kernel N6); see module docstring."""
+    return _fwd_impl(x, p, a, c)
+
+
+def _mlr_fwd(x, p, a, c):
+    return _fwd_impl(x, p, a, c), (x, p, a, c)
+
+
+def _mlr_bwd(res, g):
+    _, vjp = jax.vjp(_t_hyp_mlr, *res)
+    return vjp(g)
+
+
+hyp_mlr.defvjp(_mlr_fwd, _mlr_bwd)

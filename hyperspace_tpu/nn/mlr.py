@@ -24,6 +24,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from hyperspace_tpu.kernels.mlr import hyp_mlr
 from hyperspace_tpu.manifolds import PoincareBall, smath
 from hyperspace_tpu.manifolds.maps import lorentz_to_ball
 
@@ -31,10 +32,14 @@ from hyperspace_tpu.manifolds.maps import lorentz_to_ball
 def hyp_mlr_logits(
     x: jax.Array, p: jax.Array, a: jax.Array, c
 ) -> jax.Array:
-    """Hyperbolic MLR logits.
+    """Hyperbolic MLR logits — naive Möbius form (the test oracle).
 
     x: [..., d] points on the ball; p: [K, d] hyperplane points (on the
     ball); a: [K, d] normals (tangent at p_k). Returns [..., K].
+
+    Materializes z_k = (−p_k) ⊕ x per (point, class) pair; the layers
+    below call the fused kernel (hyperspace_tpu/kernels/mlr.py) instead,
+    which removes that [..., K, d] intermediate.
     """
     ball = PoincareBall(c)
     cc = jnp.asarray(c, x.dtype)
@@ -63,7 +68,7 @@ def _mlr_apply(module: nn.Module, xb: jax.Array, ball: PoincareBall,
     p_t = module.param("p_tangent", p_init, (num_classes, d), xb.dtype)
     a = module.param("a", a_init, (num_classes, d), xb.dtype)
     p = ball.expmap0(p_t)
-    return hyp_mlr_logits(xb, p, a, ball.c)
+    return hyp_mlr(xb, p, a, ball.c)
 
 
 class HypMLR(nn.Module):

@@ -1,0 +1,76 @@
+"""Parity suite for the fused hyperbolic-MLR kernel (N6).
+
+Pins the algebraic expansion (two matmuls, kernels/mlr.py) to the naive
+Möbius-form oracle (nn/mlr.py hyp_mlr_logits) — catching any drift in
+either direction — and the Pallas kernel (interpret mode, SURVEY.md §4.4)
+to the twin.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.kernels import mlr as kmlr
+from hyperspace_tpu.nn.mlr import hyp_mlr_logits
+
+from .conftest import ball_points
+
+
+def _case(rng, n, k, d, c, dtype):
+    x = ball_points(rng, (n, d), c).astype(dtype)
+    p = ball_points(rng, (k, d), c, scale=0.5).astype(dtype)
+    a = jnp.asarray(rng.standard_normal((k, d)), dtype)
+    return x, p, a
+
+
+@pytest.mark.parametrize("c", [1.0, 0.5, 2.0])
+def test_twin_matches_naive_f64(rng, c):
+    x, p, a = _case(rng, 33, 7, 10, c, jnp.float64)
+    got = kmlr._t_hyp_mlr(x, p, a, c)
+    want = hyp_mlr_logits(x, p, a, c)
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10)
+
+
+def test_twin_matches_naive_batched(rng):
+    c = 1.0
+    x = ball_points(rng, (4, 5, 10), c).astype(jnp.float64)
+    p = ball_points(rng, (6, 10), c, scale=0.5).astype(jnp.float64)
+    a = jnp.asarray(rng.standard_normal((6, 10)), jnp.float64)
+    got = kmlr._t_hyp_mlr(x, p, a, c)
+    want = hyp_mlr_logits(x, p, a, c)
+    assert got.shape == (4, 5, 6)
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10)
+
+
+@pytest.mark.parametrize(
+    "n,k,d", [(17, 5, 10), (8, 128, 128), (200, 300, 33), (260, 520, 7)]
+)  # (260, 520, 7) forces a multi-tile grid in both i and j
+def test_kernel_matches_twin(rng, interp, n, k, d):
+    c = 1.0
+    x, p, a = _case(rng, n, k, d, c, jnp.float32)
+    got = kmlr.hyp_mlr(x, p, a, c)
+    want = kmlr._t_hyp_mlr(x, p, a, c)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_gradients_match_naive(rng):
+    c = 1.0
+    x, p, a = _case(rng, 9, 4, 10, c, jnp.float64)
+
+    def loss_kernel(x, p, a, cc):
+        return jnp.sum(jnp.tanh(kmlr.hyp_mlr(x, p, a, cc)))
+
+    def loss_naive(x, p, a, cc):
+        return jnp.sum(jnp.tanh(hyp_mlr_logits(x, p, a, cc)))
+
+    g1 = jax.grad(loss_kernel, argnums=(0, 1, 2, 3))(x, p, a, jnp.float64(c))
+    g2 = jax.grad(loss_naive, argnums=(0, 1, 2, 3))(x, p, a, jnp.float64(c))
+    for a_, b_ in zip(g1, g2):
+        np.testing.assert_allclose(a_, b_, rtol=1e-8, atol=1e-8)
+
+
+def test_learned_curvature_grad_nonzero(rng):
+    x, p, a = _case(rng, 9, 4, 10, 1.0, jnp.float64)
+    g = jax.grad(lambda cc: jnp.sum(kmlr.hyp_mlr(x, p, a, cc) ** 2))(jnp.float64(0.7))
+    assert np.isfinite(g) and abs(g) > 0
