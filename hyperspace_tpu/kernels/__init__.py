@@ -13,6 +13,8 @@ Every op in this package:
 
 from hyperspace_tpu.kernels._support import mode
 from hyperspace_tpu.kernels.distmat import lorentz_pdist, poincare_pdist
+from hyperspace_tpu.kernels.attention import flash_attention
+from hyperspace_tpu.kernels.hyplinear import hyp_linear
 from hyperspace_tpu.kernels.mlr import hyp_mlr
 from hyperspace_tpu.kernels.pointwise import (
     expmap,
@@ -36,4 +38,6 @@ __all__ = [
     "poincare_pdist",
     "lorentz_pdist",
     "hyp_mlr",
+    "hyp_linear",
+    "flash_attention",
 ]

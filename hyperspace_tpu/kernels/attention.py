@@ -1,0 +1,244 @@
+"""Flash-style hyperbolic attention kernel (reference CUDA kernel N7).
+
+Scores are affine in squared Lorentz distance (Gulcehre et al. 2019 /
+HyboNet),   s(q,k) = (−d²_L(q,k) + β)/τ = (2/c + 2⟨q,k⟩_L + β)/τ ,
+and values aggregate to the **Lorentz centroid** (Law et al. 2019) of the
+softmax weights.  Because the centroid numerator is a plain weighted sum,
+the flash-attention online-softmax recurrence carries over unchanged from
+the Euclidean kernel — only the epilogue differs (a Minkowski-norm
+row-rescale instead of nothing).  See SURVEY.md §2 N7 and §5
+"Long-context": the same recurrence, fed by ``ppermute`` instead of HBM,
+is ring attention (hyperspace_tpu/parallel/ring.py).
+
+Kernel shape: grid (batch·heads, Q blocks, KV blocks), KV innermost and
+sequential; scratch carries (running max, denominator, centroid
+numerator) per Q block.  Scores and accumulation are f32 regardless of
+input dtype; the two matmuls per tile (Minkowski Gram, weight × V) hit
+the MXU.
+
+β and τ must be constant per (batch, head) — per-position values fall
+back to the XLA twin.  Gradients always flow through the twin
+(rematerializing custom_vjp, like every kernel in this package).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hyperspace_tpu.kernels import _support as S
+from hyperspace_tpu.manifolds import smath
+
+_NEG = -1e30  # finite -inf surrogate (avoids inf-inf NaN in the recurrence)
+
+
+def _t_flash_attention(q, k, v, c, beta, tau, maskf):
+    """XLA twin: dense hyperbolic attention (== nn.attention.lorentz_attention).
+
+    maskf: f32 broadcastable to [..., Nq, Nk]; > 0 means attend (the float
+    carrier keeps the custom_vjp signature uniform; it is non-differentiable
+    by construction).
+    """
+    cc = jnp.asarray(c, q.dtype)
+    k_flip = k.at[..., 0].multiply(-1.0)
+    gram = jnp.matmul(q, jnp.swapaxes(k_flip, -1, -2),
+                      precision=jax.lax.Precision.HIGHEST)
+    logits = (2.0 / cc + 2.0 * gram + beta) / tau
+    if maskf is not None:
+        logits = jnp.where(maskf > 0.0, logits, -jnp.inf)
+    w = jax.nn.softmax(logits, axis=-1)
+    w = jnp.where(jnp.isnan(w), 0.0, w)  # fully-masked rows
+    s = jnp.matmul(w, v, precision=jax.lax.Precision.HIGHEST)
+    sp = (jnp.sum(s[..., 1:] * s[..., 1:], axis=-1, keepdims=True)
+          - s[..., :1] * s[..., :1])
+    nrm = smath.safe_sqrt(smath.clamp_min(-sp, smath.eps_for(q.dtype)))
+    return s / (smath.sqrt_c(cc) * nrm)
+
+
+def _attn_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, o_ref,
+               m_scr, l_scr, acc_scr, *, bk: int, masked: bool, mask_ref=None):
+    ik = pl.program_id(2)
+    nk_blocks = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    c = c_ref[0, 0]
+    beta = beta_ref[0, 0]
+    tau = tau_ref[0, 0]
+    nk = nk_ref[0, 0]
+    q = q_ref[0].astype(jnp.float32)   # [bq, dp]
+    k = k_ref[0].astype(jnp.float32)   # [bk, dp]
+    v = v_ref[0].astype(jnp.float32)
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, k.shape, dimension=1)
+    k_flip = jnp.where(lane == 0, -k, k)
+    gram = S.dotT(q, k_flip)           # ⟨q, k⟩_L — MXU matmul 1, [bq, bk]
+    logits = (2.0 / c + 2.0 * gram + beta) / tau
+
+    col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, dimension=1) + ik * bk
+    valid = col < nk
+    if masked:
+        valid = jnp.logical_and(valid, mask_ref[0] > 0.0)
+    logits = jnp.where(valid, logits, _NEG)
+
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(logits - m_new)
+    p = jnp.where(valid, p, 0.0)       # exp(_NEG - m) underflows to 0 anyway
+    l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = alpha * acc_scr[:] + jax.lax.dot_general(
+        p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )                                   # MXU matmul 2
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+    acc_scr[:] = acc_new
+
+    @pl.when(ik == nk_blocks - 1)
+    def _epilogue():
+        s = acc_scr[:] / jnp.maximum(l_scr[:, :1], S.MIN_NORM_F32)
+        lane_o = jax.lax.broadcasted_iota(jnp.int32, s.shape, dimension=1)
+        sp = jnp.sum(jnp.where(lane_o == 0, -s * s, s * s), axis=-1, keepdims=True)
+        nrm = S.ksafe_sqrt(jnp.maximum(-sp, S.EPS_F32))
+        sc = jnp.maximum(S.ksafe_sqrt(c), S.MIN_NORM_F32)
+        o_ref[0] = (s / (sc * nrm)).astype(o_ref.dtype)
+
+
+def _launch(q, k, v, c, beta_b, tau_b, maskf, mode_):
+    """q [B, Nq, D], k/v [B, Nk, D], beta_b/tau_b [B], maskf [B, Nq, Nk]|None."""
+    b, nq, d = q.shape
+    nk = k.shape[1]
+    dp = S.round_up(d, 128)
+    bq = min(S.round_up(nq, 8), 256)
+    bk = min(S.round_up(nk, 128), 512)
+    # q + k + v + out + acc blocks (+ mask + logits) under the VMEM budget
+    while 4 * (3 * bq * dp + 2 * bk * dp + 2 * bq * bk) > S.VMEM_BUDGET and (bq > 8 or bk > 128):
+        if bk > 128 and bk >= bq:
+            bk = max(128, (bk // 2) // 128 * 128)
+        else:
+            bq = max(8, (bq // 2) // 8 * 8)
+
+    pad3 = lambda a, rows: S.pad_axis(S.pad_axis(a, -1, 128), -2, rows)
+    qp = pad3(q, bq)
+    kp = pad3(k, bk)
+    vp = pad3(v, bk)
+    nq_p, nk_p = qp.shape[1], kp.shape[1]
+    grid = (b, nq_p // bq, nk_p // bk)
+
+    smem = lambda idx: pl.BlockSpec((1, 1), idx, memory_space=pltpu.SMEM)
+    in_specs = [
+        smem(lambda ib, iq, ik: (0, 0)),                   # c
+        smem(lambda ib, iq, ik: (0, 0)),                   # nk
+        smem(lambda ib, iq, ik: (ib, 0)),                  # beta
+        smem(lambda ib, iq, ik: (ib, 0)),                  # tau
+        pl.BlockSpec((1, bq, dp), lambda ib, iq, ik: (ib, iq, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, dp), lambda ib, iq, ik: (ib, ik, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, dp), lambda ib, iq, ik: (ib, ik, 0), memory_space=pltpu.VMEM),
+    ]
+    args = [S.c_smem(c), jnp.asarray(nk, jnp.int32).reshape(1, 1),
+            beta_b.reshape(b, 1), tau_b.reshape(b, 1), qp, kp, vp]
+    masked = maskf is not None
+    if masked:
+        mp = S.pad_axis(S.pad_axis(maskf.astype(jnp.float32), -1, bk), -2, bq)
+        in_specs.append(pl.BlockSpec((1, bq, bk), lambda ib, iq, ik: (ib, iq, ik),
+                                     memory_space=pltpu.VMEM))
+        args.append(mp)
+
+    def body(*refs):
+        # layout: 4 smem + 3 vmem inputs (+ mask), out, 3 scratch
+        if masked:
+            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, mk_r, o_r, m_s, l_s, a_s) = refs
+        else:
+            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, o_r, m_s, l_s, a_s) = refs
+            mk_r = None
+        _attn_body(c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, o_r, m_s, l_s, a_s,
+                   bk=bk, masked=masked, mask_ref=mk_r)
+
+    out = pl.pallas_call(
+        body,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, dp), lambda ib, iq, ik: (ib, iq, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, nq_p, dp), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, dp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=S.interpret_flag(mode_),
+    )(*args)
+    return out[:, :nq, :d]
+
+
+def _scalar_per_batch(x, lead, dtype):
+    """Broadcast a per-(batch, head) scalar spec (e.g. [h, 1, 1]) to [B]."""
+    arr = jnp.asarray(x, dtype)
+    return jnp.broadcast_to(arr, lead + (1, 1))[..., 0, 0].reshape(-1)
+
+
+def _fwd_impl(q, k, v, c, beta, tau, maskf):
+    mode_ = S.mode()
+    if mode_ == "xla":
+        return _t_flash_attention(q, k, v, c, beta, tau, maskf)
+    lead = q.shape[:-2]
+    bshape = jnp.shape(beta)
+    tshape = jnp.shape(tau)
+    # per-position β/τ (trailing dims not all 1) → twin
+    if (bshape[-2:] not in ((), (1, 1)) and len(bshape) >= 2) or (
+            tshape[-2:] not in ((), (1, 1)) and len(tshape) >= 2):
+        return _t_flash_attention(q, k, v, c, beta, tau, maskf)
+    bsz = 1
+    for s in lead:
+        bsz *= s
+    q3 = q.reshape((bsz,) + q.shape[-2:])
+    k3 = jnp.broadcast_to(k, lead + k.shape[-2:]).reshape((bsz,) + k.shape[-2:])
+    v3 = jnp.broadcast_to(v, lead + v.shape[-2:]).reshape((bsz,) + v.shape[-2:])
+    beta_b = _scalar_per_batch(beta, lead, jnp.float32)
+    tau_b = _scalar_per_batch(tau, lead, jnp.float32)
+    if maskf is not None:
+        maskf = jnp.broadcast_to(
+            maskf, lead + (q.shape[-2], k.shape[-2])
+        ).reshape((bsz,) + (q.shape[-2], k.shape[-2]))
+    out = _launch(q3, k3, v3, c, beta_b, tau_b, maskf, mode_)
+    return out.reshape(lead + out.shape[-2:])
+
+
+@jax.custom_vjp
+def _flash_attention_vjp(q, k, v, c, beta, tau, maskf):
+    return _fwd_impl(q, k, v, c, beta, tau, maskf)
+
+
+def _fa_fwd(q, k, v, c, beta, tau, maskf):
+    return _fwd_impl(q, k, v, c, beta, tau, maskf), (q, k, v, c, beta, tau, maskf)
+
+
+def _fa_bwd(res, g):
+    _, vjp = jax.vjp(_t_flash_attention, *res)
+    return vjp(g)
+
+
+_flash_attention_vjp.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention(q, k, v, c, *, beta=0.0, tau=1.0, mask=None):
+    """Hyperbolic flash attention (kernel N7); see module docstring.
+
+    q: [..., Nq, D], k/v: [..., Nk, D] hyperboloid points; beta/tau scalars
+    or [..., 1, 1]-shaped per-(batch, head) arrays; mask: bool/float
+    broadcastable to [..., Nq, Nk], truthy = attend.  Returns hyperboloid
+    points [..., Nq, D].
+    """
+    maskf = None if mask is None else jax.lax.stop_gradient(
+        jnp.asarray(mask, jnp.float32))
+    return _flash_attention_vjp(q, k, v, c, beta, tau, maskf)

@@ -1,0 +1,115 @@
+"""Fused gyro-linear kernel (reference CUDA kernel N5; SURVEY.md §2).
+
+The Poincaré gyro-linear layer  y = proj((M ⊗_c x) ⊕_c b)  (Ganea et al.
+2018) is, unfused, four HBM round-trips: the matmul, the Möbius rescale of
+its output, the Möbius bias addition, and the projection.  This kernel
+keeps the weight resident in VMEM and performs matmul → rescale → ⊕ bias
+→ proj in one pass per row block: the MXU does x @ M, the VPU does the
+rest while the tile is still on-chip.
+
+Dispatch/twin/gradient conventions are those of kernels/pointwise.py:
+Pallas on TPU, the manifold-method composition as the XLA twin elsewhere
+and as the custom-vjp backward (rematerializing).  Falls back to the twin
+when the weight block would not fit the VMEM budget — at that size the
+layer is matmul-bound and XLA's own fusion is already optimal.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hyperspace_tpu.kernels import _support as S
+from hyperspace_tpu.manifolds.poincare import PoincareBall
+
+
+def _hyp_linear_body(c_ref, x_ref, m_ref, b_ref, o_ref):
+    c = c_ref[0, 0]
+    x = x_ref[:].astype(jnp.float32)          # [bn, d_in_p]
+    m = m_ref[:].astype(jnp.float32)          # [d_in_p, d_out_p]
+    b = b_ref[0:1, :].astype(jnp.float32)     # [1, d_out_p]
+    sc = jnp.maximum(S.ksafe_sqrt(c), S.MIN_NORM_F32)
+
+    # M ⊗_c x — Möbius matvec (kernel N2 semantics on the matmul output)
+    x_norm = jnp.maximum(S.ksafe_norm(x), S.MIN_NORM_F32)
+    mx = jax.lax.dot_general(
+        x, m, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    mx_norm = jnp.maximum(S.ksafe_norm(mx), S.MIN_NORM_F32)
+    res = S.ktanh(mx_norm / x_norm * S.kartanh(sc * x_norm)) * mx / (mx_norm * sc)
+    zero = jnp.max(jnp.abs(mx), axis=-1, keepdims=True) == 0.0
+    res = jnp.where(zero, 0.0, res)
+
+    out = S.kproj(S.kmobius_add(res, b, c), c)
+    o_ref[:] = out.astype(o_ref.dtype)
+
+
+def _t_hyp_linear(x, m, b, c):
+    """XLA twin: proj((M ⊗_c x) ⊕_c b) via the manifold methods."""
+    ball = PoincareBall(c)
+    return ball.proj(ball.mobius_add(ball.mobius_matvec(m, x), b))
+
+
+def _launch_hyp_linear(x, m, b, c, mode_):
+    n, d_in = x.shape
+    d_out = m.shape[1]
+    di = S.round_up(d_in, 128)
+    do = S.round_up(d_out, 128)
+    bn = S.row_block(n, dp=max(di, do), n_bufs=3)
+    xp = S.pad_rows_lanes(x, rows_to=bn)
+    mp = S.pad_axis(S.pad_axis(m, 1, 128), 0, 128)  # [di, do] (zero rows/cols are exact no-ops)
+    bp = S.pad_rows_lanes(b.reshape(1, -1))   # [8, d_out_p]
+    np_, _ = xp.shape
+    grid = (np_ // bn,)
+
+    out = pl.pallas_call(
+        _hyp_linear_body,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((bn, di), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((di, do), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, do), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bn, do), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((np_, do), x.dtype),
+        interpret=S.interpret_flag(mode_),
+    )(S.c_smem(c), xp, mp, bp)
+    return out[:n, :d_out]
+
+
+def _fwd_impl(x, m, b, c):
+    mode_ = S.mode()
+    d_in, d_out = m.shape
+    weight_bytes = 4 * S.round_up(d_in, 128) * S.round_up(d_out, 128)
+    if mode_ == "xla" or weight_bytes > S.VMEM_BUDGET:
+        return _t_hyp_linear(x, m, b, c)
+    flat, lead = S.flatten_batch(x)
+    out = _launch_hyp_linear(flat, m, b, c, mode_)
+    return out.reshape(lead + out.shape[-1:])
+
+
+@jax.custom_vjp
+def hyp_linear(x, m, b, c):
+    """Fused gyro-linear  proj((M ⊗_c x) ⊕_c b)  (kernel N5).
+
+    x: [..., d_in] ball points; m: [d_in, d_out]; b: [d_out] ball point
+    (pass zeros for a bias-free layer — x ⊕ 0 = x exactly).
+    """
+    return _fwd_impl(x, m, b, c)
+
+
+def _hl_fwd(x, m, b, c):
+    return _fwd_impl(x, m, b, c), (x, m, b, c)
+
+
+def _hl_bwd(res, g):
+    _, vjp = jax.vjp(_t_hyp_linear, *res)
+    return vjp(g)
+
+
+hyp_linear.defvjp(_hl_fwd, _hl_bwd)

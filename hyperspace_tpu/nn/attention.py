@@ -31,6 +31,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from hyperspace_tpu.kernels.attention import flash_attention
 from hyperspace_tpu.manifolds import Lorentz
 from hyperspace_tpu.manifolds import smath
 from hyperspace_tpu.nn.layers import LorentzLinear
@@ -198,8 +199,12 @@ class HypMultiHeadAttention(nn.Module):
             (h, 1, 1), x_q.dtype)) + 1e-4
         if mask is not None:
             mask = mask[..., None, :, :]  # broadcast over heads
-        attn = lorentz_attention_tiled if self.use_tiled else lorentz_attention
-        o = attn(q, k, v, m, beta=beta, tau=tau, mask=mask)
+        if self.use_tiled:
+            # XLA online-softmax scan (the ring-attention per-device body)
+            o = lorentz_attention_tiled(q, k, v, m, beta=beta, tau=tau, mask=mask)
+        else:
+            # kernel N7: Pallas flash kernel on TPU, dense twin elsewhere
+            o = flash_attention(q, k, v, m.c, beta=beta, tau=tau, mask=mask)
         # concat head space-coords, reconstruct time on the joint hyperboloid
         o_sp = jnp.swapaxes(o[..., 1:], -3, -2)  # [..., N, h, dh]
         o_sp = o_sp.reshape(o_sp.shape[:-2] + (h * dh,))

@@ -23,6 +23,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from hyperspace_tpu.kernels.hyplinear import hyp_linear
 from hyperspace_tpu.manifolds import Lorentz, PoincareBall
 from hyperspace_tpu.manifolds import smath
 
@@ -44,13 +45,14 @@ class HypLinear(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         d_in = x.shape[-1]
         kernel = self.param("kernel", self.kernel_init, (d_in, self.features), x.dtype)
-        y = self.manifold.mobius_matvec(kernel, x)
         if self.use_bias:
             # bias is a tangent vector at the origin; exp0 makes it a point
             bias_t = self.param("bias", nn.initializers.zeros, (self.features,), x.dtype)
-            b = self.manifold.expmap0(bias_t)  # once; mobius_add broadcasts
-            y = self.manifold.mobius_add(y, b)
-        return self.manifold.proj(y)
+            b = self.manifold.expmap0(bias_t)
+        else:
+            b = jnp.zeros((self.features,), x.dtype)  # x ⊕ 0 = x exactly
+        # fused matmul → Möbius rescale → ⊕ bias → proj (kernel N5)
+        return hyp_linear(x, kernel, b, self.manifold.c)
 
 
 class LorentzLinear(nn.Module):

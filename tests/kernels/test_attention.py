@@ -1,0 +1,120 @@
+"""Parity suite for the flash hyperbolic-attention kernel (N7).
+
+Chain of oracles: Pallas kernel (interpret mode) == XLA dense twin ==
+nn.attention.lorentz_attention (manifold form) == lorentz_attention_tiled
+(the online-softmax scan the kernel implements).  SURVEY.md §4.4.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.kernels import attention as katt
+from hyperspace_tpu.manifolds import Lorentz
+from hyperspace_tpu.nn.attention import lorentz_attention, lorentz_attention_tiled
+
+
+def hyperboloid_points(rng, shape, c=1.0, scale=1.0):
+    sp = rng.standard_normal(shape) * scale
+    t = np.sqrt(1.0 / c + np.sum(sp * sp, axis=-1, keepdims=True))
+    return jnp.asarray(np.concatenate([t, sp], axis=-1), jnp.float32)
+
+
+@pytest.mark.parametrize("c", [1.0, 0.5])
+@pytest.mark.parametrize("nq,nk,d", [(16, 16, 8), (40, 72, 5), (300, 520, 9)])
+def test_kernel_matches_dense(rng, interp, c, nq, nk, d):
+    # (300, 520) forces multi-tile grids in both q and kv
+    q = hyperboloid_points(rng, (2, nq, d), c)
+    k = hyperboloid_points(rng, (2, nk, d), c)
+    v = hyperboloid_points(rng, (2, nk, d), c)
+    got = katt.flash_attention(q, k, v, c, beta=0.3, tau=1.5)
+    want = lorentz_attention(q, k, v, Lorentz(c), beta=0.3, tau=1.5)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_kernel_matches_tiled_twin(rng, interp):
+    c = 1.0
+    q = hyperboloid_points(rng, (24, 7), c)
+    k = hyperboloid_points(rng, (40, 7), c)
+    v = hyperboloid_points(rng, (40, 7), c)
+    got = katt.flash_attention(q, k, v, c)
+    want = lorentz_attention_tiled(q, k, v, Lorentz(c), block_size=16)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_masked_matches_dense(rng, interp):
+    c = 1.0
+    q = hyperboloid_points(rng, (2, 24, 6), c)
+    k = hyperboloid_points(rng, (2, 40, 6), c)
+    v = hyperboloid_points(rng, (2, 40, 6), c)
+    mask = jnp.asarray(rng.random((2, 24, 40)) > 0.4)
+    got = katt.flash_attention(q, k, v, c, mask=mask)
+    want = lorentz_attention(q, k, v, Lorentz(c), mask=mask)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_fully_masked_rows_are_zero(rng, interp):
+    c = 1.0
+    q = hyperboloid_points(rng, (1, 9, 4), c)
+    k = hyperboloid_points(rng, (1, 16, 4), c)
+    v = hyperboloid_points(rng, (1, 16, 4), c)
+    mask = jnp.ones((1, 9, 16), bool).at[0, 3].set(False)
+    got = katt.flash_attention(q, k, v, c, mask=mask)
+    want = lorentz_attention(q, k, v, Lorentz(c), mask=mask)
+    np.testing.assert_allclose(got[0, 3], np.zeros(5), atol=1e-6)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_per_head_beta_tau(rng, interp):
+    """β/τ shaped [h, 1, 1] over q [b, h, N, D] — the HypMultiHeadAttention case."""
+    c = 1.0
+    q = hyperboloid_points(rng, (2, 3, 16, 6), c)
+    k = hyperboloid_points(rng, (2, 3, 16, 6), c)
+    v = hyperboloid_points(rng, (2, 3, 16, 6), c)
+    beta = jnp.asarray(rng.standard_normal((3, 1, 1)), jnp.float32)
+    tau = jnp.asarray(1.0 + rng.random((3, 1, 1)), jnp.float32)
+    got = katt.flash_attention(q, k, v, c, beta=beta, tau=tau)
+    want = lorentz_attention(q, k, v, Lorentz(c), beta=beta, tau=tau)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_output_on_hyperboloid(rng, interp):
+    c = 0.7
+    q = hyperboloid_points(rng, (2, 24, 6), c, scale=2.0)
+    k = hyperboloid_points(rng, (2, 40, 6), c, scale=2.0)
+    v = hyperboloid_points(rng, (2, 40, 6), c, scale=2.0)
+    o = katt.flash_attention(q, k, v, c)
+    mink = np.sum(np.asarray(o[..., 1:]) ** 2, axis=-1) - np.asarray(o[..., 0]) ** 2
+    np.testing.assert_allclose(mink, -1.0 / c, rtol=1e-4)
+
+
+def test_gradients_match_dense(rng):
+    c = 1.0
+    q = hyperboloid_points(rng, (1, 12, 5), c).astype(jnp.float64)
+    k = hyperboloid_points(rng, (1, 20, 5), c).astype(jnp.float64)
+    v = hyperboloid_points(rng, (1, 20, 5), c).astype(jnp.float64)
+
+    def loss_kernel(q, k, v, beta, tau):
+        return jnp.sum(jnp.tanh(katt.flash_attention(q, k, v, c, beta=beta, tau=tau)))
+
+    def loss_dense(q, k, v, beta, tau):
+        return jnp.sum(jnp.tanh(lorentz_attention(q, k, v, Lorentz(c), beta=beta, tau=tau)))
+
+    args = (q, k, v, jnp.float64(0.2), jnp.float64(1.3))
+    g1 = jax.grad(loss_kernel, argnums=(0, 1, 2, 3, 4))(*args)
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2, 3, 4))(*args)
+    for a_, b_ in zip(g1, g2):
+        np.testing.assert_allclose(a_, b_, rtol=1e-8, atol=1e-8)
+
+
+def test_bf16_inputs(rng, interp):
+    c = 1.0
+    q = hyperboloid_points(rng, (1, 16, 8), c).astype(jnp.bfloat16)
+    k = hyperboloid_points(rng, (1, 32, 8), c).astype(jnp.bfloat16)
+    v = hyperboloid_points(rng, (1, 32, 8), c).astype(jnp.bfloat16)
+    got = katt.flash_attention(q, k, v, c)
+    want = lorentz_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                             v.astype(jnp.float32), Lorentz(c))
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(got.astype(jnp.float32), want, rtol=0.02, atol=0.02)

@@ -1,0 +1,89 @@
+"""Parity suite for the fused gyro-linear kernel (N5, SURVEY.md §4.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.kernels import hyplinear as khl
+from hyperspace_tpu.manifolds import PoincareBall
+
+from .conftest import ball_points
+
+
+def _case(rng, n, d_in, d_out, c, dtype=jnp.float32):
+    x = ball_points(rng, (n, d_in), c).astype(dtype)
+    m = jnp.asarray(rng.standard_normal((d_in, d_out)) * 0.3, dtype)
+    b = ball_points(rng, (d_out,), c, scale=0.3).astype(dtype)
+    return x, m, b
+
+
+@pytest.mark.parametrize("c", [1.0, 0.5])
+@pytest.mark.parametrize(
+    "n,d_in,d_out", [(9, 10, 6), (64, 128, 128), (300, 33, 65)]
+)  # (300, ...) forces a multi-row-block grid
+def test_kernel_matches_twin(rng, interp, c, n, d_in, d_out):
+    x, m, b = _case(rng, n, d_in, d_out, c)
+    got = khl.hyp_linear(x, m, b, c)
+    want = khl._t_hyp_linear(x, m, b, c)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_twin_is_manifold_composition(rng):
+    c = 1.0
+    x, m, b = _case(rng, 11, 7, 5, c, jnp.float64)
+    ball = PoincareBall(c)
+    want = ball.proj(ball.mobius_add(ball.mobius_matvec(m, x), b))
+    np.testing.assert_allclose(khl._t_hyp_linear(x, m, b, c), want, rtol=1e-12)
+
+
+def test_zero_bias_is_identity_of_matvec(rng, interp):
+    c = 1.0
+    x, m, _ = _case(rng, 8, 10, 10, c)
+    got = khl.hyp_linear(x, m, jnp.zeros(10, jnp.float32), c)
+    ball = PoincareBall(c)
+    want = ball.proj(ball.mobius_matvec(m, x))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_zero_matvec_maps_to_bias(rng, interp):
+    """M x = 0 → origin, so output is proj(0 ⊕ b) = b."""
+    c = 1.0
+    x = ball_points(rng, (8, 6), c)
+    m = jnp.zeros((6, 4), jnp.float32)
+    b = ball_points(rng, (4,), c, scale=0.3)
+    got = khl.hyp_linear(x, m, b, c)
+    np.testing.assert_allclose(got, jnp.broadcast_to(b, (8, 4)), rtol=1e-5, atol=1e-6)
+
+
+def test_batched_leading_dims(rng, interp):
+    c = 1.0
+    x = ball_points(rng, (3, 5, 10), c)
+    m = jnp.asarray(np.random.default_rng(1).standard_normal((10, 8)) * 0.3, jnp.float32)
+    b = ball_points(rng, (8,), c, scale=0.3)
+    got = khl.hyp_linear(x, m, b, c)
+    want = khl._t_hyp_linear(x, m, b, c)
+    assert got.shape == (3, 5, 8)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_gradients_match_twin(rng):
+    c = 1.0
+    x = ball_points(rng, (9, 10), c).astype(jnp.float64)
+    m = jnp.asarray(rng.standard_normal((10, 6)) * 0.3, jnp.float64)
+    b = ball_points(rng, (6,), c, scale=0.3).astype(jnp.float64)
+
+    def loss(fn, *args):
+        return jnp.sum(jnp.tanh(fn(*args, c)))
+
+    g1 = jax.grad(lambda *a: loss(khl.hyp_linear, *a), argnums=(0, 1, 2))(x, m, b)
+    g2 = jax.grad(lambda *a: loss(khl._t_hyp_linear, *a), argnums=(0, 1, 2))(x, m, b)
+    for a_, b_ in zip(g1, g2):
+        np.testing.assert_allclose(a_, b_, rtol=1e-8, atol=1e-10)
+
+
+def test_output_on_ball(rng, interp):
+    c = 1.0
+    x, m, b = _case(rng, 16, 12, 12, c)
+    y = khl.hyp_linear(x, 10.0 * m, b, c)  # large weights push to the boundary
+    assert float(jnp.max(jnp.linalg.norm(y, axis=-1))) < 1.0 / np.sqrt(c)
