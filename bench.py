@@ -36,14 +36,17 @@ def bench_poincare(repeats: int = 3) -> dict:
 
     # compile + warmup
     state, loss = pe.train_step(cfg, opt, state, pairs)
-    jax.block_until_ready(loss)
+    jax.device_get(loss)
 
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         for _ in range(steps_per_epoch):
             state, loss = pe.train_step(cfg, opt, state, pairs)
-        jax.block_until_ready(loss)
+        # device_get, not block_until_ready: remote-attached TPUs (axon
+        # tunnel) ack block_until_ready before execution finishes; a host
+        # fetch of the loss is the only reliable completion barrier
+        jax.device_get(loss)
         times.append(time.perf_counter() - t0)
     epoch_s = min(times)
     return {

@@ -60,7 +60,7 @@ def run_hgcn_bench(
 
     # compile + warmup
     state, loss = hgcn.train_step_lp(model, opt, num_nodes, state, ga, train_pos)
-    jax.block_until_ready(loss)
+    jax.device_get(loss)
 
     times = []
     for _ in range(repeats):
@@ -68,7 +68,10 @@ def run_hgcn_bench(
         for _ in range(steps_per_repeat):
             state, loss = hgcn.train_step_lp(
                 model, opt, num_nodes, state, ga, train_pos)
-        jax.block_until_ready(loss)
+        # device_get, not block_until_ready: remote-attached TPUs (axon
+        # tunnel) ack block_until_ready before execution finishes; a host
+        # fetch of the loss is the only reliable completion barrier
+        jax.device_get(loss)
         times.append(time.perf_counter() - t0)
     best = min(times)
     samples_per_sec = num_nodes * steps_per_repeat / best

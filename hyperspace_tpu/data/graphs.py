@@ -36,9 +36,10 @@ class Graph:
 
     x: np.ndarray  # [N, F] float32 node features
     senders: np.ndarray  # [E_pad] int32
-    receivers: np.ndarray  # [E_pad] int32
+    receivers: np.ndarray  # [E_pad] int32, sorted ascending (see prepare)
     edge_mask: np.ndarray  # [E_pad] bool (False = padding)
     num_nodes: int
+    rev_perm: np.ndarray | None = None  # [E_pad] int32 edge -> reverse edge
     labels: np.ndarray | None = None  # [N] int32
     num_classes: int = 0
     train_mask: np.ndarray | None = None  # [N] bool (node tasks)
@@ -80,11 +81,19 @@ def prepare(
     pad_multiple: int = 1024,
     **node_fields,
 ) -> Graph:
-    """Symmetrize, add self-loops, dedupe, and pad the edge list.
+    """Symmetrize, add self-loops, dedupe, sort by receiver, pad.
 
-    Padding edges are (0, 0) with ``edge_mask`` False — masked out of every
-    aggregation, they only keep the shape static across graphs of similar
-    size (bucketing; SURVEY.md §2 "padding/bucketing needed on TPU").
+    TPU layout decisions (SURVEY.md §2 "padding/bucketing needed on TPU"
+    and §7 hard-part #3):
+
+    - Edges are **sorted by (receiver, sender)** so every aggregation
+      scatter runs XLA's sorted fast path (~2.3× at arxiv scale).
+    - ``rev_perm`` maps each edge to its reverse (self-loops and padding
+      map to themselves), letting the aggregation VJP scatter sorted too
+      (see nn/scatter.py).  Requires ``symmetrize=True``; otherwise left
+      ``None`` and consumers fall back to plain segment ops.
+    - Padding edges are (N−1, N−1) with ``edge_mask`` False — the max key
+      keeps the receiver order sorted; weight 0 keeps them inert.
     """
     e = np.asarray(edges, np.int64)
     if symmetrize and len(e):
@@ -92,22 +101,32 @@ def prepare(
     if self_loops:
         loops = np.stack([np.arange(num_nodes)] * 2, axis=1)
         e = np.concatenate([e, loops], axis=0) if len(e) else loops
-    # dedupe via flat ids
-    flat = e[:, 0] * num_nodes + e[:, 1]
-    e = e[np.unique(flat, return_index=True)[1]]
+    # dedupe + sort by (receiver, sender) via flat receiver-major keys
+    key = e[:, 1] * num_nodes + e[:, 0]
+    e = e[np.unique(key, return_index=True)[1]]
     e_pad = _pad_to(max(len(e), 1), pad_multiple)
-    senders = np.zeros(e_pad, np.int32)
-    receivers = np.zeros(e_pad, np.int32)
+    senders = np.full(e_pad, num_nodes - 1, np.int32)
+    receivers = np.full(e_pad, num_nodes - 1, np.int32)
     mask = np.zeros(e_pad, bool)
     senders[: len(e)] = e[:, 0]
     receivers[: len(e)] = e[:, 1]
     mask[: len(e)] = True
+
+    rev_perm = None
+    if symmetrize:
+        # reverse of (s, r) has key s·N + r; keys are sorted, so searchsorted
+        # gives its index.  Padding maps to itself (identity tail).
+        keys_sorted = e[:, 1] * num_nodes + e[:, 0]
+        rev_perm = np.arange(e_pad, dtype=np.int32)
+        rev_perm[: len(e)] = np.searchsorted(
+            keys_sorted, e[:, 0] * num_nodes + e[:, 1]).astype(np.int32)
     return Graph(
         x=np.asarray(x, np.float32),
         senders=senders,
         receivers=receivers,
         edge_mask=mask,
         num_nodes=num_nodes,
+        rev_perm=rev_perm,
         **node_fields,
     )
 

@@ -69,8 +69,8 @@ def _attn_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, o_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     c = c_ref[0, 0]
-    beta = beta_ref[0, 0]
-    tau = tau_ref[0, 0]
+    beta = beta_ref[pl.program_id(0), 0]
+    tau = tau_ref[pl.program_id(0), 0]
     nk = nk_ref[0, 0]
     q = q_ref[0].astype(jnp.float32)   # [bq, dp]
     k = k_ref[0].astype(jnp.float32)   # [bk, dp]
@@ -134,11 +134,14 @@ def _launch(q, k, v, c, beta_b, tau_b, maskf, mode_):
     grid = (b, nq_p // bq, nk_p // bk)
 
     smem = lambda idx: pl.BlockSpec((1, 1), idx, memory_space=pltpu.SMEM)
+    # β/τ ride whole in SMEM (Mosaic rejects per-row blocks of a [B, 1]
+    # array); the body indexes them with program_id(0)
+    per_b = pl.BlockSpec((b, 1), lambda ib, iq, ik: (0, 0), memory_space=pltpu.SMEM)
     in_specs = [
         smem(lambda ib, iq, ik: (0, 0)),                   # c
         smem(lambda ib, iq, ik: (0, 0)),                   # nk
-        smem(lambda ib, iq, ik: (ib, 0)),                  # beta
-        smem(lambda ib, iq, ik: (ib, 0)),                  # tau
+        per_b,                                             # beta
+        per_b,                                             # tau
         pl.BlockSpec((1, bq, dp), lambda ib, iq, ik: (ib, iq, 0), memory_space=pltpu.VMEM),
         pl.BlockSpec((1, bk, dp), lambda ib, iq, ik: (ib, ik, 0), memory_space=pltpu.VMEM),
         pl.BlockSpec((1, bk, dp), lambda ib, iq, ik: (ib, ik, 0), memory_space=pltpu.VMEM),

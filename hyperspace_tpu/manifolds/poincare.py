@@ -95,7 +95,9 @@ class PoincareBall(Manifold):
         c = self._c(x.dtype)
         sc = smath.sqrt_c(c)
         x_norm = smath.clamp_min(smath.safe_norm(x), smath.min_norm(x.dtype))
-        mx = x @ m
+        # HIGHEST: the matmul feeds tanh∘artanh-amplified norms; the default
+        # bf16-pass TPU matmul costs ~2e-3 absolute on ball points
+        mx = jnp.matmul(x, m, precision=jax.lax.Precision.HIGHEST)
         mx_norm = smath.clamp_min(smath.safe_norm(mx), smath.min_norm(x.dtype))
         sc = smath.clamp_min(sc, smath.min_norm(x.dtype))  # guard learned c → 0
         res = smath.safe_tanh(mx_norm / x_norm * smath.artanh(sc * x_norm)) * mx / (mx_norm * sc)

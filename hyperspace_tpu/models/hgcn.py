@@ -58,7 +58,8 @@ class HGCNEncoder(nn.Module):
     cfg: HGCNConfig
 
     @nn.compact
-    def __call__(self, x, senders, receivers, edge_mask, *, deterministic=True):
+    def __call__(self, x, senders, receivers, edge_mask, rev_perm=None, *,
+                 deterministic=True):
         cfg = self.cfg
         m0 = make_manifold(cfg.kind, cfg.c)
         # Euclidean features are origin-tangent coordinates; lift to the
@@ -77,7 +78,7 @@ class HGCNEncoder(nn.Module):
                 dropout_rate=cfg.dropout,
                 activation=(lambda v: v) if is_last else nn.relu,
                 name=f"conv{i}",
-            )(h, senders, receivers, edge_mask, deterministic=deterministic)
+            )(h, senders, receivers, edge_mask, rev_perm, deterministic=deterministic)
             c_prev = m.c
         return h, m  # points on the final layer's manifold
 
@@ -88,9 +89,10 @@ class HGCNLinkPred(nn.Module):
     cfg: HGCNConfig
 
     @nn.compact
-    def __call__(self, x, senders, receivers, edge_mask, pairs, *, deterministic=True):
+    def __call__(self, x, senders, receivers, edge_mask, pairs, rev_perm=None, *,
+                 deterministic=True):
         z, m = HGCNEncoder(self.cfg, name="encoder")(
-            x, senders, receivers, edge_mask, deterministic=deterministic
+            x, senders, receivers, edge_mask, rev_perm, deterministic=deterministic
         )
         sq = m.sqdist(z[pairs[:, 0]], z[pairs[:, 1]])
         return FermiDiracDecoder(name="decoder")(sq)
@@ -102,9 +104,10 @@ class HGCNNodeClf(nn.Module):
     cfg: HGCNConfig
 
     @nn.compact
-    def __call__(self, x, senders, receivers, edge_mask, *, deterministic=True):
+    def __call__(self, x, senders, receivers, edge_mask, rev_perm=None, *,
+                 deterministic=True):
         z, m = HGCNEncoder(self.cfg, name="encoder")(
-            x, senders, receivers, edge_mask, deterministic=deterministic
+            x, senders, receivers, edge_mask, rev_perm, deterministic=deterministic
         )
         head = LorentzMLR if self.cfg.kind == "lorentz" else HypMLR
         return head(self.cfg.num_classes, m, name="head")(z)
@@ -130,6 +133,7 @@ def _device_graph(g: graph_data.Graph):
         jnp.asarray(g.senders),
         jnp.asarray(g.receivers),
         jnp.asarray(g.edge_mask),
+        None if g.rev_perm is None else jnp.asarray(g.rev_perm),
     )
 
 
@@ -140,9 +144,9 @@ def init_lp(cfg: HGCNConfig, g: graph_data.Graph, seed: int = 0):
     model = HGCNLinkPred(cfg)
     key = jax.random.PRNGKey(seed)
     k_init, key = jax.random.split(key)
-    x, s, r, m = _device_graph(g)
+    x, s, r, m, rp = _device_graph(g)
     dummy_pairs = jnp.zeros((2, 2), jnp.int32)
-    params = model.init({"params": k_init}, x, s, r, m, dummy_pairs)["params"]
+    params = model.init({"params": k_init}, x, s, r, m, dummy_pairs, rp)["params"]
     opt = make_optimizer(cfg)
     state = TrainState(params, opt.init(params), key, jnp.zeros((), jnp.int32))
     return model, opt, state
@@ -158,7 +162,7 @@ def train_step_lp(
     train_pos: jax.Array,  # [P, 2]
 ):
     """One LP step: sample negatives on device, BCE on pos+neg logits."""
-    x, senders, receivers, edge_mask = graph_arrays
+    x, senders, receivers, edge_mask, rev_perm = graph_arrays
     key, k_neg, k_drop = jax.random.split(state.key, 3)
     n_neg = train_pos.shape[0] * model.cfg.neg_per_pos
     neg = jax.random.randint(k_neg, (n_neg, 2), 0, num_nodes)
@@ -166,7 +170,7 @@ def train_step_lp(
     def loss_fn(params):
         pairs = jnp.concatenate([train_pos, neg], axis=0)
         logits = model.apply(
-            {"params": params}, x, senders, receivers, edge_mask, pairs,
+            {"params": params}, x, senders, receivers, edge_mask, pairs, rev_perm,
             deterministic=False, rngs={"dropout": k_drop},
         )
         labels = jnp.concatenate(
@@ -182,8 +186,8 @@ def train_step_lp(
 
 @partial(jax.jit, static_argnames=("model",))
 def eval_scores_lp(model: HGCNLinkPred, params, graph_arrays, pairs):
-    x, s, r, m = graph_arrays
-    return model.apply({"params": params}, x, s, r, m, pairs)
+    x, s, r, m, rp = graph_arrays
+    return model.apply({"params": params}, x, s, r, m, pairs, rp)
 
 
 def evaluate_lp(model, params, split: graph_data.LinkSplit, which: str = "test") -> dict:
@@ -222,8 +226,8 @@ def init_nc(cfg: HGCNConfig, g: graph_data.Graph, seed: int = 0):
     model = HGCNNodeClf(cfg)
     key = jax.random.PRNGKey(seed)
     k_init, key = jax.random.split(key)
-    x, s, r, m = _device_graph(g)
-    params = model.init({"params": k_init}, x, s, r, m)["params"]
+    x, s, r, m, rp = _device_graph(g)
+    params = model.init({"params": k_init}, x, s, r, m, rp)["params"]
     opt = make_optimizer(cfg)
     state = TrainState(params, opt.init(params), key, jnp.zeros((), jnp.int32))
     return model, opt, state
@@ -238,12 +242,12 @@ def train_step_nc(
     labels: jax.Array,  # [N] int32
     train_mask: jax.Array,  # [N] bool
 ):
-    x, senders, receivers, edge_mask = graph_arrays
+    x, senders, receivers, edge_mask, rev_perm = graph_arrays
     key, k_drop = jax.random.split(state.key)
 
     def loss_fn(params):
         logits = model.apply(
-            {"params": params}, x, senders, receivers, edge_mask,
+            {"params": params}, x, senders, receivers, edge_mask, rev_perm,
             deterministic=False, rngs={"dropout": k_drop},
         )
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
@@ -258,8 +262,8 @@ def train_step_nc(
 
 @partial(jax.jit, static_argnames=("model",))
 def eval_logits_nc(model: HGCNNodeClf, params, graph_arrays):
-    x, s, r, m = graph_arrays
-    return model.apply({"params": params}, x, s, r, m)
+    x, s, r, m, rp = graph_arrays
+    return model.apply({"params": params}, x, s, r, m, rp)
 
 
 def train_nc(

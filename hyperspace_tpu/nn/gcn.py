@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from hyperspace_tpu.manifolds import Lorentz, PoincareBall
+from hyperspace_tpu.nn.scatter import sym_segment_aggregate
 
 
 # --- segment ops (shared with any graph aggregation) --------------------------
@@ -39,19 +40,24 @@ def segment_softmax(
     segment_ids: jax.Array,
     num_segments: int,
     mask: Optional[jax.Array] = None,
+    indices_are_sorted: bool = False,
 ) -> jax.Array:
     """Softmax of ``logits`` within each segment; masked entries get 0.
 
-    Max-subtracted for stability; safe for empty segments.
+    Max-subtracted for stability; safe for empty segments.  Pass
+    ``indices_are_sorted=True`` for receiver-sorted edge lists (the
+    ``data.graphs.prepare`` layout) to take XLA's sorted-scatter fast path.
     """
     if mask is not None:
         logits = jnp.where(mask, logits, -jnp.inf)
-    seg_max = jax.ops.segment_max(logits, segment_ids, num_segments)
+    seg_max = jax.ops.segment_max(logits, segment_ids, num_segments,
+                                  indices_are_sorted=indices_are_sorted)
     seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
     ex = jnp.exp(logits - seg_max[segment_ids])
     if mask is not None:
         ex = jnp.where(mask, ex, 0.0)
-    denom = jax.ops.segment_sum(ex, segment_ids, num_segments)
+    denom = jax.ops.segment_sum(ex, segment_ids, num_segments,
+                                indices_are_sorted=indices_are_sorted)
     return ex / jnp.maximum(denom[segment_ids], 1e-15)
 
 
@@ -115,6 +121,7 @@ class HGCConv(nn.Module):
         senders: jax.Array,  # [E] int32
         receivers: jax.Array,  # [E] int32
         edge_mask: jax.Array,  # [E] bool
+        rev_perm: Optional[jax.Array] = None,  # [E] int32 (sorted-graph fast path)
         *,
         deterministic: bool = True,
     ) -> tuple[jax.Array, Any]:
@@ -138,19 +145,26 @@ class HGCConv(nn.Module):
         if self.dropout_rate > 0.0:
             h = nn.Dropout(self.dropout_rate)(h, deterministic=deterministic)
 
-        hs = h[senders]  # [E, d]
+        sorted_fast = rev_perm is not None
         if self.use_att:
             # GAT-style additive attention in the tangent chart.
             a_s = self.param("att_src", self.kernel_init, (self.features, 1), h.dtype)
             a_r = self.param("att_dst", self.kernel_init, (self.features, 1), h.dtype)
-            logits = nn.leaky_relu((hs @ a_s)[:, 0] + (h[receivers] @ a_r)[:, 0], 0.2)
-            w = segment_softmax(logits, receivers, n, mask=edge_mask)
+            logits = nn.leaky_relu(
+                (h @ a_s)[senders, 0] + (h @ a_r)[receivers, 0], 0.2)
+            w = segment_softmax(logits, receivers, n, mask=edge_mask,
+                                indices_are_sorted=sorted_fast)
         else:
             # mean aggregation: 1/deg with masked degree count
             ones = edge_mask.astype(h.dtype)
-            deg = jax.ops.segment_sum(ones, receivers, n)
+            deg = jax.ops.segment_sum(ones, receivers, n,
+                                      indices_are_sorted=sorted_fast)
             w = ones / jnp.maximum(deg[receivers], 1.0)
-        agg = jax.ops.segment_sum(w[:, None] * hs, receivers, n)  # [N, d]
+        if sorted_fast:
+            # receiver-sorted scatter in forward AND backward (nn/scatter.py)
+            agg = sym_segment_aggregate(h, w, senders, receivers, rev_perm, n)
+        else:
+            agg = jax.ops.segment_sum(w[:, None] * h[senders], receivers, n)
 
         out = from_tangent0_coords(m_out, self.activation(agg))
         return out, m_out
