@@ -21,7 +21,10 @@ from __future__ import annotations
 
 import dataclasses
 import os
+from typing import NamedTuple, Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -40,6 +43,8 @@ class Graph:
     edge_mask: np.ndarray  # [E_pad] bool (False = padding)
     num_nodes: int
     rev_perm: np.ndarray | None = None  # [E_pad] int32 edge -> reverse edge
+    deg: np.ndarray | None = None  # [N] float32 masked in-degree (static)
+    csr_plan: tuple | None = None  # kernels.segment.CsrPlan work items
     labels: np.ndarray | None = None  # [N] int32
     num_classes: int = 0
     train_mask: np.ndarray | None = None  # [N] bool (node tasks)
@@ -49,6 +54,55 @@ class Graph:
     @property
     def num_edges(self) -> int:
         return int(self.edge_mask.sum())
+
+
+class DeviceGraph(NamedTuple):
+    """Device-resident graph arrays, one pytree leaf per field.
+
+    The single argument models/layers take for message passing; built once
+    per graph with :func:`to_device`.  Optional fields are ``None`` when
+    the graph was not built by :func:`prepare` (consumers then fall back
+    to plain masked segment ops).
+    """
+
+    x: "jax.Array"                      # [N, F]
+    senders: "jax.Array"                # [E] int32
+    receivers: "jax.Array"              # [E] int32 sorted
+    edge_mask: "jax.Array"              # [E] bool
+    num_nodes: int                      # static (python int)
+    rev_perm: Optional["jax.Array"] = None   # [E] int32 involution
+    deg: Optional["jax.Array"] = None        # [N] f32 masked in-degree
+    plan: Optional[tuple] = None             # 3 × [T] int32 CSR work items
+
+
+# num_nodes must stay a static (hashable) field across jit boundaries, so
+# DeviceGraph is registered with num_nodes as auxiliary pytree data.
+def _dg_flatten(g: DeviceGraph):
+    return (g.x, g.senders, g.receivers, g.edge_mask, g.rev_perm, g.deg,
+            g.plan), g.num_nodes
+
+
+def _dg_unflatten(num_nodes, leaves):
+    x, s, r, m, rp, deg, plan = leaves
+    return DeviceGraph(x, s, r, m, num_nodes, rp, deg, plan)
+
+
+jax.tree_util.register_pytree_node(DeviceGraph, _dg_flatten, _dg_unflatten)
+
+
+def to_device(g: Graph) -> DeviceGraph:
+    """Put a host :class:`Graph` on device as a :class:`DeviceGraph`."""
+    return DeviceGraph(
+        x=jnp.asarray(g.x),
+        senders=jnp.asarray(g.senders),
+        receivers=jnp.asarray(g.receivers),
+        edge_mask=jnp.asarray(g.edge_mask),
+        num_nodes=g.num_nodes,
+        rev_perm=None if g.rev_perm is None else jnp.asarray(g.rev_perm),
+        deg=None if g.deg is None else jnp.asarray(g.deg),
+        plan=None if g.csr_plan is None
+        else tuple(jnp.asarray(a) for a in g.csr_plan),
+    )
 
 
 @dataclasses.dataclass
@@ -94,6 +148,10 @@ def prepare(
       ``None`` and consumers fall back to plain segment ops.
     - Padding edges are (N−1, N−1) with ``edge_mask`` False — the max key
       keeps the receiver order sorted; weight 0 keeps them inert.
+    - ``deg`` (masked in-degree) and ``csr_plan`` (the block-CSR work-item
+      schedule for :func:`hyperspace_tpu.kernels.segment.csr_segment_sum`)
+      are static per graph, so they are computed here once instead of per
+      training step.
     """
     e = np.asarray(edges, np.int64)
     if symmetrize and len(e):
@@ -120,6 +178,10 @@ def prepare(
         rev_perm = np.arange(e_pad, dtype=np.int32)
         rev_perm[: len(e)] = np.searchsorted(
             keys_sorted, e[:, 0] * num_nodes + e[:, 1]).astype(np.int32)
+
+    from hyperspace_tpu.kernels.segment import build_csr_plan
+
+    deg = np.bincount(receivers[mask], minlength=num_nodes).astype(np.float32)
     return Graph(
         x=np.asarray(x, np.float32),
         senders=senders,
@@ -127,6 +189,8 @@ def prepare(
         edge_mask=mask,
         num_nodes=num_nodes,
         rev_perm=rev_perm,
+        deg=deg,
+        csr_plan=tuple(build_csr_plan(receivers, num_nodes)),
         **node_fields,
     )
 

@@ -53,18 +53,17 @@ class HGCNConfig:
 
 
 class HGCNEncoder(nn.Module):
-    """Feature lift (exp0) + stacked HGCConv layers."""
+    """Feature lift (exp0) + stacked HGCConv layers over a DeviceGraph."""
 
     cfg: HGCNConfig
 
     @nn.compact
-    def __call__(self, x, senders, receivers, edge_mask, rev_perm=None, *,
-                 deterministic=True):
+    def __call__(self, g: graph_data.DeviceGraph, *, deterministic=True):
         cfg = self.cfg
         m0 = make_manifold(cfg.kind, cfg.c)
         # Euclidean features are origin-tangent coordinates; lift to the
         # manifold (SURVEY.md §3.2 "embed: expmap₀(features)").
-        h = from_tangent0_coords(m0, x.astype(cfg.dtype))
+        h = from_tangent0_coords(m0, g.x.astype(cfg.dtype))
         c_prev = cfg.c
         for i, d in enumerate(cfg.hidden_dims):
             is_last = i == len(cfg.hidden_dims) - 1
@@ -78,7 +77,7 @@ class HGCNEncoder(nn.Module):
                 dropout_rate=cfg.dropout,
                 activation=(lambda v: v) if is_last else nn.relu,
                 name=f"conv{i}",
-            )(h, senders, receivers, edge_mask, rev_perm, deterministic=deterministic)
+            )(h, g, deterministic=deterministic)
             c_prev = m.c
         return h, m  # points on the final layer's manifold
 
@@ -89,10 +88,9 @@ class HGCNLinkPred(nn.Module):
     cfg: HGCNConfig
 
     @nn.compact
-    def __call__(self, x, senders, receivers, edge_mask, pairs, rev_perm=None, *,
-                 deterministic=True):
+    def __call__(self, g: graph_data.DeviceGraph, pairs, *, deterministic=True):
         z, m = HGCNEncoder(self.cfg, name="encoder")(
-            x, senders, receivers, edge_mask, rev_perm, deterministic=deterministic
+            g, deterministic=deterministic
         )
         sq = m.sqdist(z[pairs[:, 0]], z[pairs[:, 1]])
         return FermiDiracDecoder(name="decoder")(sq)
@@ -104,10 +102,9 @@ class HGCNNodeClf(nn.Module):
     cfg: HGCNConfig
 
     @nn.compact
-    def __call__(self, x, senders, receivers, edge_mask, rev_perm=None, *,
-                 deterministic=True):
+    def __call__(self, g: graph_data.DeviceGraph, *, deterministic=True):
         z, m = HGCNEncoder(self.cfg, name="encoder")(
-            x, senders, receivers, edge_mask, rev_perm, deterministic=deterministic
+            g, deterministic=deterministic
         )
         head = LorentzMLR if self.cfg.kind == "lorentz" else HypMLR
         return head(self.cfg.num_classes, m, name="head")(z)
@@ -127,14 +124,8 @@ def make_optimizer(cfg: HGCNConfig) -> optax.GradientTransformation:
     return optax.adamw(cfg.lr, weight_decay=cfg.weight_decay)
 
 
-def _device_graph(g: graph_data.Graph):
-    return (
-        jnp.asarray(g.x),
-        jnp.asarray(g.senders),
-        jnp.asarray(g.receivers),
-        jnp.asarray(g.edge_mask),
-        None if g.rev_perm is None else jnp.asarray(g.rev_perm),
-    )
+def _device_graph(g: graph_data.Graph) -> graph_data.DeviceGraph:
+    return graph_data.to_device(g)
 
 
 # ---- link prediction ----
@@ -144,9 +135,9 @@ def init_lp(cfg: HGCNConfig, g: graph_data.Graph, seed: int = 0):
     model = HGCNLinkPred(cfg)
     key = jax.random.PRNGKey(seed)
     k_init, key = jax.random.split(key)
-    x, s, r, m, rp = _device_graph(g)
+    dg = _device_graph(g)
     dummy_pairs = jnp.zeros((2, 2), jnp.int32)
-    params = model.init({"params": k_init}, x, s, r, m, dummy_pairs, rp)["params"]
+    params = model.init({"params": k_init}, dg, dummy_pairs)["params"]
     opt = make_optimizer(cfg)
     state = TrainState(params, opt.init(params), key, jnp.zeros((), jnp.int32))
     return model, opt, state
@@ -158,11 +149,10 @@ def train_step_lp(
     opt,
     num_nodes: int,
     state: TrainState,
-    graph_arrays,
+    g: graph_data.DeviceGraph,
     train_pos: jax.Array,  # [P, 2]
 ):
     """One LP step: sample negatives on device, BCE on pos+neg logits."""
-    x, senders, receivers, edge_mask, rev_perm = graph_arrays
     key, k_neg, k_drop = jax.random.split(state.key, 3)
     n_neg = train_pos.shape[0] * model.cfg.neg_per_pos
     neg = jax.random.randint(k_neg, (n_neg, 2), 0, num_nodes)
@@ -170,7 +160,7 @@ def train_step_lp(
     def loss_fn(params):
         pairs = jnp.concatenate([train_pos, neg], axis=0)
         logits = model.apply(
-            {"params": params}, x, senders, receivers, edge_mask, pairs, rev_perm,
+            {"params": params}, g, pairs,
             deterministic=False, rngs={"dropout": k_drop},
         )
         labels = jnp.concatenate(
@@ -185,9 +175,8 @@ def train_step_lp(
 
 
 @partial(jax.jit, static_argnames=("model",))
-def eval_scores_lp(model: HGCNLinkPred, params, graph_arrays, pairs):
-    x, s, r, m, rp = graph_arrays
-    return model.apply({"params": params}, x, s, r, m, pairs, rp)
+def eval_scores_lp(model: HGCNLinkPred, params, g: graph_data.DeviceGraph, pairs):
+    return model.apply({"params": params}, g, pairs)
 
 
 def evaluate_lp(model, params, split: graph_data.LinkSplit, which: str = "test") -> dict:
@@ -226,8 +215,8 @@ def init_nc(cfg: HGCNConfig, g: graph_data.Graph, seed: int = 0):
     model = HGCNNodeClf(cfg)
     key = jax.random.PRNGKey(seed)
     k_init, key = jax.random.split(key)
-    x, s, r, m, rp = _device_graph(g)
-    params = model.init({"params": k_init}, x, s, r, m, rp)["params"]
+    dg = _device_graph(g)
+    params = model.init({"params": k_init}, dg)["params"]
     opt = make_optimizer(cfg)
     state = TrainState(params, opt.init(params), key, jnp.zeros((), jnp.int32))
     return model, opt, state
@@ -238,16 +227,15 @@ def train_step_nc(
     model: HGCNNodeClf,
     opt,
     state: TrainState,
-    graph_arrays,
+    g: graph_data.DeviceGraph,
     labels: jax.Array,  # [N] int32
     train_mask: jax.Array,  # [N] bool
 ):
-    x, senders, receivers, edge_mask, rev_perm = graph_arrays
     key, k_drop = jax.random.split(state.key)
 
     def loss_fn(params):
         logits = model.apply(
-            {"params": params}, x, senders, receivers, edge_mask, rev_perm,
+            {"params": params}, g,
             deterministic=False, rngs={"dropout": k_drop},
         )
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
@@ -261,9 +249,8 @@ def train_step_nc(
 
 
 @partial(jax.jit, static_argnames=("model",))
-def eval_logits_nc(model: HGCNNodeClf, params, graph_arrays):
-    x, s, r, m, rp = graph_arrays
-    return model.apply({"params": params}, x, s, r, m, rp)
+def eval_logits_nc(model: HGCNNodeClf, params, g: graph_data.DeviceGraph):
+    return model.apply({"params": params}, g)
 
 
 def train_nc(

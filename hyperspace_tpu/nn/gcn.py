@@ -118,10 +118,7 @@ class HGCConv(nn.Module):
     def __call__(
         self,
         x: jax.Array,  # [N, ambient_in] points
-        senders: jax.Array,  # [E] int32
-        receivers: jax.Array,  # [E] int32
-        edge_mask: jax.Array,  # [E] bool
-        rev_perm: Optional[jax.Array] = None,  # [E] int32 (sorted-graph fast path)
+        g,             # data.graphs.DeviceGraph (x field unused here)
         *,
         deterministic: bool = True,
     ) -> tuple[jax.Array, Any]:
@@ -137,6 +134,7 @@ class HGCConv(nn.Module):
         m_out = make_manifold(self.kind, c_out)
 
         n = x.shape[0]
+        senders, receivers, edge_mask = g.senders, g.receivers, g.edge_mask
         v = tangent0_coords(m_in, x)  # [N, d_in]
         kernel = self.param("kernel", self.kernel_init, (v.shape[-1], self.features), v.dtype)
         h = v @ kernel  # the MXU matmul
@@ -145,7 +143,8 @@ class HGCConv(nn.Module):
         if self.dropout_rate > 0.0:
             h = nn.Dropout(self.dropout_rate)(h, deterministic=deterministic)
 
-        sorted_fast = rev_perm is not None
+        sorted_fast = g.rev_perm is not None
+        w_static = False
         if self.use_att:
             # GAT-style additive attention in the tangent chart.
             a_s = self.param("att_src", self.kernel_init, (self.features, 1), h.dtype)
@@ -155,14 +154,21 @@ class HGCConv(nn.Module):
             w = segment_softmax(logits, receivers, n, mask=edge_mask,
                                 indices_are_sorted=sorted_fast)
         else:
-            # mean aggregation: 1/deg with masked degree count
+            # mean aggregation: 1/deg; degree is static per graph, so prefer
+            # the precomputed g.deg over a per-step segment count
             ones = edge_mask.astype(h.dtype)
-            deg = jax.ops.segment_sum(ones, receivers, n,
-                                      indices_are_sorted=sorted_fast)
+            if g.deg is not None:
+                deg = g.deg.astype(h.dtype)
+            else:
+                deg = jax.ops.segment_sum(ones, receivers, n,
+                                          indices_are_sorted=sorted_fast)
             w = ones / jnp.maximum(deg[receivers], 1.0)
+            w_static = True
         if sorted_fast:
             # receiver-sorted scatter in forward AND backward (nn/scatter.py)
-            agg = sym_segment_aggregate(h, w, senders, receivers, rev_perm, n)
+            pb, pc, pf = g.plan if g.plan is not None else (None, None, None)
+            agg = sym_segment_aggregate(h, w, senders, receivers, g.rev_perm,
+                                        pb, pc, pf, n, not w_static)
         else:
             agg = jax.ops.segment_sum(w[:, None] * h[senders], receivers, n)
 

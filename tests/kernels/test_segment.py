@@ -1,0 +1,75 @@
+"""Block-CSR segment-sum kernel parity (SURVEY.md §4.4): the Pallas kernel
+in interpret mode must match ``jax.ops.segment_sum`` exactly-ish, over
+random sorted segment layouts including empty segments, hub nodes, and
+padding tails."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.kernels.segment import build_csr_plan, csr_segment_sum
+
+
+def _run(receivers, vals, n):
+    plan = tuple(jnp.asarray(a) for a in build_csr_plan(receivers, n))
+    return csr_segment_sum(jnp.asarray(vals), jnp.asarray(receivers), plan, n)
+
+
+@pytest.mark.parametrize(
+    "n,e,f", [(300, 2000, 17), (50, 64, 128), (1000, 5000, 64), (7, 3, 5)]
+)
+def test_matches_segment_sum(n, e, f, rng, interp):
+    r = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    vals = rng.standard_normal((e, f)).astype(np.float32)
+    got = _run(r, vals, n)
+    want = jax.ops.segment_sum(jnp.asarray(vals), jnp.asarray(r), n)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_hub_node_and_empty_segments(rng, interp):
+    # one node receives 90% of edges; most segments empty
+    n, e, f = 500, 4000, 32
+    r = np.where(rng.random(e) < 0.9, 137, rng.integers(0, n, e))
+    r = np.sort(r).astype(np.int32)
+    vals = rng.standard_normal((e, f)).astype(np.float32)
+    got = _run(r, vals, n)
+    want = jax.ops.segment_sum(jnp.asarray(vals), jnp.asarray(r), n)
+    # a ~3600-edge hub sums in a different order than segment_sum's chain:
+    # tolerance scales with sqrt(deg)·eps
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=5e-4)
+
+
+def test_zero_padding_tail_is_inert(rng, interp):
+    # padding convention: receivers = n-1 with zero values
+    n, e, f = 100, 700, 16
+    r = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    vals = rng.standard_normal((e, f)).astype(np.float32)
+    r_pad = np.concatenate([r, np.full(300, n - 1, np.int32)])
+    vals_pad = np.concatenate([vals, np.zeros((300, f), np.float32)])
+    got = _run(r_pad, vals_pad, n)
+    want = jax.ops.segment_sum(jnp.asarray(vals), jnp.asarray(r), n)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_plan_requires_sorted():
+    with pytest.raises(ValueError):
+        build_csr_plan(np.asarray([3, 1, 2], np.int32), 5)
+
+
+def test_plan_chunks_in_range_for_empty_trailing_blocks(rng, interp):
+    # E an exact multiple of bk with all receivers far below num_nodes:
+    # trailing node blocks are empty and their mandatory zeroing item must
+    # not index one chunk past the end of the padded edge array
+    n, e, f = 300, 512, 8
+    r = np.sort(rng.integers(0, 128, e)).astype(np.int32)
+    plan = build_csr_plan(r, n)
+    assert int(plan.chunk.max()) < max(e // 512, 1)
+    vals = rng.standard_normal((e, f)).astype(np.float32)
+    got = _run(r, vals, n)
+    want = jax.ops.segment_sum(jnp.asarray(vals), jnp.asarray(r), n)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)

@@ -50,6 +50,12 @@ def _tiny_graph(n=6, e=10, seed=0):
     return jnp.asarray(senders), jnp.asarray(receivers), jnp.asarray(mask)
 
 
+def _dg(x, s, r, mask, n):
+    from hyperspace_tpu.data.graphs import DeviceGraph
+
+    return DeviceGraph(x=x, senders=s, receivers=r, edge_mask=mask, num_nodes=n)
+
+
 @pytest.mark.parametrize("kind", ["lorentz", "poincare"])
 @pytest.mark.parametrize("use_att", [False, True])
 def test_hgcconv_on_manifold(kind, use_att, rng):
@@ -58,8 +64,9 @@ def test_hgcconv_on_manifold(kind, use_att, rng):
     x = m_in.random_normal(jax.random.PRNGKey(0), (n, m_in.ambient_dim(4)), jnp.float64)
     s, r, mask = _tiny_graph(n)
     conv = HGCConv(features=d_out, kind=kind, c_in=1.0, c_out=0.5, use_att=use_att)
-    params = conv.init(jax.random.PRNGKey(1), x, s, r, mask)
-    y, m_out = conv.apply(params, x, s, r, mask)
+    g = _dg(x, s, r, mask, n)
+    params = conv.init(jax.random.PRNGKey(1), x, g)
+    y, m_out = conv.apply(params, x, g)
     assert y.shape == (n, m_out.ambient_dim(d_out))
     assert float(jnp.max(m_out.check_point(y))) < 1e-6
     assert abs(float(m_out.c) - 0.5) < 1e-12
@@ -72,14 +79,14 @@ def test_hgcconv_padding_invariance(rng):
     x = m.random_normal(jax.random.PRNGKey(2), (n, 5), jnp.float64)
     s, r, mask = _tiny_graph(n, e=8, seed=3)
     conv = HGCConv(features=4, kind="lorentz", use_att=True)
-    params = conv.init(jax.random.PRNGKey(3), x, s, r, mask)
-    y1, _ = conv.apply(params, x, s, r, mask)
+    params = conv.init(jax.random.PRNGKey(3), x, _dg(x, s, r, mask, n))
+    y1, _ = conv.apply(params, x, _dg(x, s, r, mask, n))
     # pad with junk edges, masked out
     pad = jnp.asarray(np.full(7, 2, np.int32))
     s2 = jnp.concatenate([s, pad])
     r2 = jnp.concatenate([r, pad])
     mask2 = jnp.concatenate([mask, jnp.zeros(7, bool)])
-    y2, _ = conv.apply(params, x, s2, r2, mask2)
+    y2, _ = conv.apply(params, x, _dg(x, s2, r2, mask2, n))
     np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-12, atol=1e-12)
 
 
@@ -90,11 +97,12 @@ def test_hgcconv_learned_curvature_grad():
     x = m.random_normal(jax.random.PRNGKey(4), (n, 5), jnp.float64)
     s, r, mask = _tiny_graph(n, e=6, seed=5)
     conv = HGCConv(features=4, kind="lorentz", learn_c=True)
-    params = conv.init(jax.random.PRNGKey(5), x, s, r, mask)
+    g = _dg(x, s, r, mask, n)
+    params = conv.init(jax.random.PRNGKey(5), x, g)
     assert "c_raw" in params["params"]
 
     def loss(p):
-        y, m_out = conv.apply(p, x, s, r, mask)
+        y, m_out = conv.apply(p, x, g)
         return jnp.sum(m_out.sqdist(y[:1], y[1:2]))
 
     g = jax.grad(loss)(params)

@@ -35,7 +35,8 @@ def test_forward_matches_naive():
     h = jnp.asarray(rng.standard_normal((g.num_nodes, 8)), jnp.float64)
     w = jnp.asarray(rng.random(len(g.senders)) * g.edge_mask, jnp.float64)
     got = sym_segment_aggregate(h, w, jnp.asarray(g.senders), jnp.asarray(g.receivers),
-                                jnp.asarray(g.rev_perm), g.num_nodes)
+                                jnp.asarray(g.rev_perm), None, None, None,
+                                g.num_nodes)
     want = jax.ops.segment_sum(w[:, None] * h[jnp.asarray(g.senders)],
                                jnp.asarray(g.receivers), g.num_nodes)
     np.testing.assert_allclose(got, want, rtol=1e-12)
@@ -50,7 +51,9 @@ def test_vjp_matches_naive():
     t = jnp.asarray(rng.standard_normal((g.num_nodes, 8)), jnp.float64)
 
     def loss_sym(h, w):
-        return jnp.sum(sym_segment_aggregate(h, w, s, r, rp, g.num_nodes) * t)
+        return jnp.sum(
+            sym_segment_aggregate(h, w, s, r, rp, None, None, None,
+                                  g.num_nodes) * t)
 
     def loss_naive(h, w):
         return jnp.sum(jax.ops.segment_sum(w[:, None] * h[s], r, g.num_nodes) * t)
@@ -62,6 +65,54 @@ def test_vjp_matches_naive():
     # compare on real edges only
     m = jnp.asarray(g.edge_mask)
     np.testing.assert_allclose(gw1 * m, gw2 * m, rtol=1e-12)
+
+
+def test_plan_path_fwd_and_vjp_match_xla(monkeypatch):
+    """The production path: plan-carrying aggregation through the Pallas CSR
+    kernel (interpret mode) must match the XLA path in forward AND backward —
+    guards the pb/pc/pf plumbing through the custom_vjp."""
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "interpret")
+    g = _graph()
+    from hyperspace_tpu.kernels.segment import build_csr_plan
+
+    plan = tuple(jnp.asarray(a) for a in build_csr_plan(g.receivers, g.num_nodes))
+    rng = np.random.default_rng(4)
+    h = jnp.asarray(rng.standard_normal((g.num_nodes, 8)), jnp.float32)
+    w = jnp.asarray(rng.random(len(g.senders)) * g.edge_mask, jnp.float32)
+    s, r, rp = map(jnp.asarray, (g.senders, g.receivers, g.rev_perm))
+    t = jnp.asarray(rng.standard_normal((g.num_nodes, 8)), jnp.float32)
+
+    def loss(h, w, pb, pc, pf):
+        return jnp.sum(
+            sym_segment_aggregate(h, w, s, r, rp, pb, pc, pf, g.num_nodes) * t)
+
+    out_plan = sym_segment_aggregate(h, w, s, r, rp, *plan, g.num_nodes)
+    out_xla = sym_segment_aggregate(h, w, s, r, rp, None, None, None, g.num_nodes)
+    np.testing.assert_allclose(np.asarray(out_plan), np.asarray(out_xla),
+                               rtol=1e-5, atol=1e-5)
+    gh1, gw1 = jax.grad(loss, argnums=(0, 1))(h, w, *plan)
+    gh2, gw2 = jax.grad(loss, argnums=(0, 1))(h, w, None, None, None)
+    np.testing.assert_allclose(np.asarray(gh1), np.asarray(gh2), rtol=1e-5, atol=1e-5)
+    m = np.asarray(g.edge_mask)
+    np.testing.assert_allclose(np.asarray(gw1) * m, np.asarray(gw2) * m,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_with_dw_false_zeroes_weight_grad():
+    g = _graph()
+    rng = np.random.default_rng(3)
+    h = jnp.asarray(rng.standard_normal((g.num_nodes, 8)), jnp.float64)
+    w = jnp.asarray(rng.random(len(g.senders)) * g.edge_mask, jnp.float64)
+    s, r, rp = map(jnp.asarray, (g.senders, g.receivers, g.rev_perm))
+
+    def loss(h, w):
+        return jnp.sum(
+            sym_segment_aggregate(h, w, s, r, rp, None, None, None,
+                                  g.num_nodes, False))
+
+    gh, gw = jax.grad(loss, argnums=(0, 1))(h, w)
+    assert np.all(np.asarray(gw) == 0.0)
+    assert np.all(np.isfinite(np.asarray(gh)))
 
 
 def test_sorted_segment_softmax_matches():
