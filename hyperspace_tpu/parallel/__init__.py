@@ -1,4 +1,5 @@
-"""Parallelism: device meshes, sharding rules, ring sequence parallelism.
+"""Parallelism: device meshes, sharding rules, ring + Ulysses sequence
+parallelism.
 
 The TPU-native replacement for the reference's NCCL backend (SURVEY.md §2
 N8, §5 "Distributed comms backend"): XLA collectives over ICI/DCN under
@@ -12,3 +13,4 @@ from hyperspace_tpu.parallel.mesh import (  # noqa: F401
     shard_batch,
 )
 from hyperspace_tpu.parallel.ring import ring_lorentz_attention  # noqa: F401
+from hyperspace_tpu.parallel.ulysses import ulysses_lorentz_attention  # noqa: F401

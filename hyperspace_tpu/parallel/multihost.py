@@ -1,0 +1,99 @@
+"""Multi-host runtime: process-group init, host↔global array movement,
+and the restart-from-checkpoint failure-recovery drill helpers
+(SURVEY.md §2 "Multi-host DP" [B], §3.4, §5 "Failure detection").
+
+The reference reaches multi-host scale through an NCCL/MPI process group
+[B]; here the whole story is:
+
+1. every process calls :func:`initialize` (one line — JAX's distributed
+   runtime does discovery over the coordinator, Gloo/ICI do transport),
+2. a mesh from :func:`hyperspace_tpu.parallel.mesh.multihost_mesh` puts
+   the ``host`` axis on DCN and inner axes on ICI,
+3. jitted programs move data with :func:`host_local_to_global` and read
+   results with :func:`fetch_replicated`; Python never touches the wire.
+
+Failure model (SURVEY.md §5): XLA programs are fixed-topology, so there
+is no mid-step elasticity — a lost host aborts the program and recovery
+is **restart-from-checkpoint**: every process re-runs the same script,
+:func:`initialize` re-forms the group, and
+:func:`hyperspace_tpu.train.checkpoint.CheckpointManager.restore` resumes
+from the last saved step.  ``tests/parallel/test_multihost.py`` drills
+exactly this: kill one loopback process mid-run, restart both, assert
+the resumed run matches an uninterrupted one.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.experimental import multihost_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def initialize(
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    *,
+    local_device_count: Optional[int] = None,
+) -> None:
+    """Join the process group; call before any other JAX API.
+
+    ``local_device_count`` forces N virtual CPU devices per process — the
+    loopback test topology (SURVEY.md §4.6); leave None on real TPU hosts.
+    """
+    if local_device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{local_device_count}").strip()
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def host_local_to_global(x, mesh: Mesh, spec: P):
+    """Assemble per-host shards into one global array (data loading path:
+    each host feeds only its own batch shard; no host sees the full array)."""
+    return multihost_utils.host_local_array_to_global_array(x, mesh, spec)
+
+
+def global_to_host_local(x, mesh: Mesh, spec: P):
+    """Inverse of :func:`host_local_to_global` (eval/debug path)."""
+    return multihost_utils.global_array_to_host_local_array(x, mesh, spec)
+
+
+def fetch_replicated(x) -> np.ndarray:
+    """Host copy of a replicated global array (loss/metrics).
+
+    Raises on sharded input — returning one shard of a batch-sharded
+    array as if it were the full value would corrupt metrics silently.
+    """
+    if hasattr(x, "addressable_shards"):
+        if not x.is_fully_replicated:
+            raise ValueError(
+                f"fetch_replicated on a sharded array ({x.sharding}); "
+                "use global_to_host_local for sharded values")
+        return np.asarray(jax.device_get(x.addressable_shards[0].data))
+    return np.asarray(jax.device_get(x))
+
+
+def sync(name: str = "barrier") -> None:
+    """Cross-host barrier (checkpoint commit points, shutdown)."""
+    multihost_utils.sync_global_devices(name)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def assert_equal_across_hosts(x, msg: str = "") -> None:
+    """Debug guard: all hosts must hold identical values (e.g. params
+    after a DP step) — the multi-host analogue of a determinism check."""
+    multihost_utils.assert_equal(x, fail_message=msg)

@@ -1,0 +1,117 @@
+"""Loopback multi-host worker (SURVEY.md §4.6): one process of an
+N-process DP training job over a ``host × data`` mesh.
+
+Trains a tiny least-squares model with SGD, checkpointing every
+``--ckpt-every`` steps; ``--crash-at S`` makes this process die abruptly
+(os._exit) right after the step-S checkpoint commits — the fault half of
+the restart-from-checkpoint drill.  Process 0 prints the final params as
+one JSON line prefixed ``RESULT``.
+
+Run by tests/parallel/test_multihost.py; also runnable by hand:
+
+    python tests/parallel/_mh_worker.py --pid 0 --nprocs 2 --port 9731 \
+        --workdir /tmp/mh &
+    python tests/parallel/_mh_worker.py --pid 1 --nprocs 2 --port 9731 \
+        --workdir /tmp/mh
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pid", type=int, required=True)
+    ap.add_argument("--nprocs", type=int, required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--ckpt-every", type=int, default=2)
+    ap.add_argument("--crash-at", type=int, default=0)  # 0 = never
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args()
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from hyperspace_tpu.parallel import multihost as mh
+
+    mh.initialize(f"127.0.0.1:{args.port}", args.nprocs, args.pid,
+                  local_device_count=2)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hyperspace_tpu.parallel.mesh import multihost_mesh
+    from hyperspace_tpu.train.checkpoint import CheckpointManager
+
+    mesh = multihost_mesh({"data": 2})
+    repl = NamedSharding(mesh, P())
+    batch_spec = P(("host", "data"))
+
+    # fixed global problem; each process feeds only its own row slice
+    rng = np.random.default_rng(0)
+    xh = rng.standard_normal((16, 4)).astype(np.float32)
+    yh = (xh @ np.asarray([1.0, -2.0, 3.0, 0.5], np.float32)).astype(np.float32)
+    rows = 16 // args.nprocs
+    sl = slice(args.pid * rows, (args.pid + 1) * rows)
+    xg = mh.host_local_to_global(xh[sl], mesh, batch_spec)
+    yg = mh.host_local_to_global(yh[sl], mesh, batch_spec)
+
+    opt = optax.sgd(0.2)
+    params = jnp.zeros(4, jnp.float32)
+    state = {"params": params, "opt": opt.init(params),
+             "step": jnp.zeros((), jnp.int32)}
+    state = jax.device_put(state, repl)
+
+    @jax.jit
+    def train_step(state, x, y):
+        def loss_fn(p):
+            return jnp.mean((x @ p - y) ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(state["params"])
+        updates, opt_state = opt.update(g, state["opt"], state["params"])
+        return {
+            "params": optax.apply_updates(state["params"], updates),
+            "opt": opt_state,
+            "step": state["step"] + 1,
+        }, loss
+
+    mgr = CheckpointManager(os.path.join(args.workdir, "ckpt"),
+                            async_save=False)
+    start = 0
+    if args.resume:
+        latest = mgr.latest_step()
+        if latest is not None:
+            state, start = mgr.restore(state)
+
+    loss = None
+    for i in range(start, args.steps):
+        state, loss = train_step(state, xg, yg)
+        done = i + 1
+        if done % args.ckpt_every == 0:
+            mgr.save(done, state)
+            mgr.wait()
+            mh.sync(f"ckpt-{done}")
+            if args.crash_at == done and args.pid == args.nprocs - 1:
+                os._exit(7)  # simulated host failure, post-commit
+    mgr.wait()
+    mgr.close()
+
+    final = mh.fetch_replicated(state["params"])
+    if args.pid == 0:
+        print("RESULT " + json.dumps({
+            "params": [float(v) for v in final],
+            "loss": float(jax.device_get(loss)) if loss is not None else None,
+            "devices": jax.device_count(),
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Ulysses (all-to-all) sequence parallelism on the 8-fake-device CPU mesh
+(SURVEY.md §4.6, §5): must equal dense attention and the ring mode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.manifolds import Lorentz
+from hyperspace_tpu.nn.attention import lorentz_attention
+from hyperspace_tpu.parallel.mesh import make_mesh
+from hyperspace_tpu.parallel.ring import ring_attention_sharded
+from hyperspace_tpu.parallel.ulysses import ulysses_attention_sharded
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_mesh({"seq": 8})
+
+
+def _pts(key, m, shape):
+    return m.random_normal(key, shape, jnp.float64)
+
+
+@pytest.mark.parametrize("L,H", [(32, 8), (64, 16)])
+def test_ulysses_matches_dense(mesh8, L, H):
+    m = Lorentz(1.0)
+    q = _pts(jax.random.PRNGKey(0), m, (2, H, L, 7))
+    k = _pts(jax.random.PRNGKey(1), m, (2, H, L, 7))
+    v = _pts(jax.random.PRNGKey(2), m, (2, H, L, 7))
+    dense = lorentz_attention(q, k, v, m, beta=0.2, tau=1.3)
+    uly = ulysses_attention_sharded(q, k, v, m, mesh8, "seq", beta=0.2, tau=1.3)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(dense),
+                               rtol=1e-9, atol=1e-11)
+
+
+def test_ulysses_matches_ring(mesh8):
+    """The two SP modes are numerically interchangeable (same math)."""
+    m = Lorentz(0.7)
+    H, L = 8, 24
+    q = _pts(jax.random.PRNGKey(3), m, (1, H, L, 5))
+    k = _pts(jax.random.PRNGKey(4), m, (1, H, L, 5))
+    v = _pts(jax.random.PRNGKey(5), m, (1, H, L, 5))
+    uly = ulysses_attention_sharded(q, k, v, m, mesh8, "seq")
+    ring = ring_attention_sharded(q, k, v, m, mesh8, "seq")
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(ring),
+                               rtol=1e-9, atol=1e-11)
+
+
+def test_ulysses_jit_grads_and_manifold(mesh8):
+    m = Lorentz(1.0)
+    q = _pts(jax.random.PRNGKey(6), m, (1, 8, 16, 5))
+
+    @jax.jit
+    def f(q):
+        return ulysses_attention_sharded(q, q, q, m, mesh8, "seq")
+
+    out = f(q)
+    assert out.shape == q.shape
+    assert float(jnp.max(m.check_point(out))) < 1e-8
+    g = jax.grad(lambda q: jnp.sum(f(q)[..., 1:] ** 2))(q)
+    assert bool(jnp.isfinite(g).all())
+
+
+def test_ulysses_rejects_indivisible_heads(mesh8):
+    m = Lorentz(1.0)
+    q = _pts(jax.random.PRNGKey(7), m, (1, 6, 16, 5))  # 6 heads, 8 devices
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention_sharded(q, q, q, m, mesh8, "seq")
