@@ -95,6 +95,37 @@ class HGCNLinkPred(nn.Module):
         sq = m.sqdist(z[pairs[:, 0]], z[pairs[:, 1]])
         return FermiDiracDecoder(name="decoder")(sq)
 
+    @nn.compact
+    def edge_logits(self, g: graph_data.DeviceGraph, neg_u, neg_v, neg_plan,
+                    *, deterministic=True):
+        """Fast-path logits for one LP train step (same params as __call__):
+        positives scored on the graph's own (sorted, planned) edge list and
+        negatives on (static sorted u, fresh v) pairs, so every decoder
+        gradient scatter is planned (nn/edge_dist.py).  Returns
+        (pos_logits [E], pos_weight [E], neg_logits [P])."""
+        from hyperspace_tpu.nn.edge_dist import (
+            graph_edge_sqdist,
+            pair_sqdist_semi_planned,
+        )
+
+        if g.rev_perm is None:
+            raise ValueError(
+                "edge_logits needs a symmetric edge layout — build the graph "
+                "with graphs.prepare(..., symmetrize=True) (rev_perm is None)")
+        z, m = HGCNEncoder(self.cfg, name="encoder")(
+            g, deterministic=deterministic
+        )
+        pb, pc, pf = g.plan if g.plan is not None else (None, None, None)
+        sq_pos = graph_edge_sqdist(z, m.c, g.senders, g.receivers, g.rev_perm,
+                                   pb, pc, pf, self.cfg.kind)
+        # self-loops are degenerate positives (d = 0); weight them out
+        w_pos = (g.edge_mask & (g.senders != g.receivers)).astype(sq_pos.dtype)
+        npb, npc, npf = neg_plan
+        sq_neg = pair_sqdist_semi_planned(z, m.c, neg_u, neg_v,
+                                          npb, npc, npf, self.cfg.kind)
+        dec = FermiDiracDecoder(name="decoder")
+        return dec(sq_pos), w_pos, dec(sq_neg)
+
 
 class HGCNNodeClf(nn.Module):
     """Encoder + hyperbolic MLR head; returns per-node class logits."""
@@ -167,6 +198,53 @@ def train_step_lp(
             [jnp.ones(train_pos.shape[0]), jnp.zeros(n_neg)]
         ).astype(logits.dtype)
         return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    updates, opt_state = opt.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, key, state.step + 1), loss
+
+
+def make_static_negatives(num_nodes: int, n_neg: int, seed: int = 0):
+    """Host-side one-time negative scaffold for the planned LP step: a
+    sorted static u column with its CSR plan; only v re-randomizes on
+    device each step (corrupt-one-side sampling — the u marginal is fixed
+    uniform, drawn once)."""
+    from hyperspace_tpu.kernels.segment import build_csr_plan
+
+    rng = np.random.default_rng(seed)
+    u = np.sort(rng.integers(0, num_nodes, n_neg)).astype(np.int32)
+    plan = tuple(jnp.asarray(a) for a in build_csr_plan(u, num_nodes))
+    return jnp.asarray(u), plan
+
+
+@partial(jax.jit, static_argnames=("model", "opt", "num_nodes"), donate_argnames=("state",))
+def train_step_lp_planned(
+    model: HGCNLinkPred,
+    opt,
+    num_nodes: int,
+    state: TrainState,
+    g: graph_data.DeviceGraph,
+    neg_u: jax.Array,  # [P] sorted static (make_static_negatives)
+    neg_plan: tuple,
+):
+    """One LP step with every decoder gradient scatter planned: positives
+    are the graph's own edge list, negatives corrupt only the v side."""
+    key, k_neg, k_drop = jax.random.split(state.key, 3)
+    neg_v = jax.random.randint(k_neg, neg_u.shape, 0, num_nodes)
+
+    def loss_fn(params):
+        pos_logit, w_pos, neg_logit = model.apply(
+            {"params": params}, g, neg_u, neg_v, neg_plan,
+            deterministic=False, rngs={"dropout": k_drop},
+            method=HGCNLinkPred.edge_logits,
+        )
+        bce_pos = optax.sigmoid_binary_cross_entropy(
+            pos_logit, jnp.ones_like(pos_logit))
+        bce_neg = optax.sigmoid_binary_cross_entropy(
+            neg_logit, jnp.zeros_like(neg_logit))
+        denom = jnp.sum(w_pos) + neg_logit.shape[0]
+        return (jnp.sum(bce_pos * w_pos) + jnp.sum(bce_neg)) / denom
 
     loss, grads = jax.value_and_grad(loss_fn)(state.params)
     updates, opt_state = opt.update(grads, state.opt_state, state.params)
