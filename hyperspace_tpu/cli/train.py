@@ -37,6 +37,7 @@ class RunConfig:
     seed: int = 0
     eval_every: int = 0  # 0 = eval only at the end
     log: str | None = None  # JSONL path
+    tensorboard_dir: str | None = None  # optional TB sink (process 0 only)
     ckpt_dir: str | None = None
     ckpt_every: int = 100
     resume: bool = False
@@ -211,7 +212,8 @@ WORKLOADS = {
 def _logger(run: RunConfig):
     from hyperspace_tpu.train.logging import MetricsLogger
 
-    return MetricsLogger(run.log, stdout=False)
+    return MetricsLogger(run.log, stdout=False,
+                         tensorboard_dir=run.tensorboard_dir)
 
 
 def _maybe_log(log, run: RunConfig, step: int, loss):

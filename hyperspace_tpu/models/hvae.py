@@ -164,15 +164,24 @@ def iwae_bound(model: HVAE, params, x: jax.Array, key: jax.Array, k: int = 16):
     return jnp.mean(jax.nn.logsumexp(logw, axis=0) - jnp.log(float(k)))
 
 
+@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
+def train_step_sampled(model: HVAE, opt, state: TrainState, x_all: jax.Array):
+    """Like :func:`train_step` but samples the minibatch on device from
+    ``state.key`` — the data-iterator state is then exactly the PRNG key
+    inside the (checkpointed) TrainState, and the step remains one XLA
+    program with no host-side indexing (SURVEY.md §5 "Checkpoint /
+    resume": data-iterator state)."""
+    key, k_next = jax.random.split(state.key)
+    idx = jax.random.randint(k_next, (model.cfg.batch_size,), 0, x_all.shape[0])
+    return train_step(model, opt, state._replace(key=key), x_all[idx])
+
+
 def train(cfg: HVAEConfig, images: np.ndarray, steps: int = 200, seed: int = 0):
     """Minibatch loop; returns (model, state, last-metrics)."""
     model, opt, state = init_model(cfg, seed)
     x_all = jnp.asarray(images, cfg.dtype)
-    n = x_all.shape[0]
-    rng = np.random.default_rng(seed)
     metrics = {}
     for _ in range(steps):
-        idx = jnp.asarray(rng.integers(0, n, cfg.batch_size))
-        state, loss, recon, kl = train_step(model, opt, state, x_all[idx])
+        state, loss, recon, kl = train_step_sampled(model, opt, state, x_all)
         metrics = {"loss": float(loss), "recon": float(recon), "kl": float(kl)}
     return model, state, metrics

@@ -147,18 +147,26 @@ def eval_logits(model, params, tokens, mask):
     return model.apply({"params": params}, tokens, mask)
 
 
+@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
+def train_step_sampled(model, opt, state: TrainState, toks, mask, labels):
+    """Minibatch sampled on device from ``state.key``: the data-iterator
+    state is the (checkpointed) PRNG key and the step stays one XLA
+    program (SURVEY.md §5 "Checkpoint / resume": data-iterator state)."""
+    key, k_next = jax.random.split(state.key)
+    idx = jax.random.randint(k_next, (model.cfg.batch_size,), 0, toks.shape[0])
+    return train_step(model, opt, state._replace(key=key),
+                      toks[idx], mask[idx], labels[idx])
+
+
 def train(cfg: HyboNetConfig, ds, steps: int = 200, seed: int = 0):
     """Minibatch training loop over a TextDataset; returns (model, params)."""
     model, opt, state = init_model(cfg, seed)
     toks = jnp.asarray(ds.tokens)
     mask = jnp.asarray(ds.mask)
     labels = jnp.asarray(ds.labels)
-    n = toks.shape[0]
-    rng = np.random.default_rng(seed)
     loss = jnp.nan
     for _ in range(steps):
-        idx = jnp.asarray(rng.integers(0, n, cfg.batch_size))
-        state, loss = train_step(model, opt, state, toks[idx], mask[idx], labels[idx])
+        state, loss = train_step_sampled(model, opt, state, toks, mask, labels)
     return model, state.params, float(loss)
 
 

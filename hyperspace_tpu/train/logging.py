@@ -1,10 +1,14 @@
-"""JSONL metrics/event logging (SURVEY.md §5 "Metrics/logging").
+"""JSONL metrics/event logging + optional TensorBoard sink
+(SURVEY.md §5 "Metrics/logging": "JSONL event log ... + optional
+TensorBoard writer").
 
 One JSON object per line: {"step": ..., "ts": ..., "host": ..., **metrics}.
 Cheap enough to call every step; file handle is line-buffered so a crashed
 run keeps everything up to the last step.  Multi-host: each process writes
 its own file (suffix = process index); step metrics are device-reduced
 *before* logging by the caller, so host 0's file is the canonical one.
+TensorBoard (``tensorboard_dir=``) is best-effort: only process 0 writes,
+and a missing writer library degrades to JSONL-only with a warning.
 """
 
 from __future__ import annotations
@@ -16,22 +20,34 @@ from typing import Any, Optional
 
 
 class MetricsLogger:
-    def __init__(self, path: Optional[str], *, stdout: bool = False):
+    def __init__(self, path: Optional[str], *, stdout: bool = False,
+                 tensorboard_dir: Optional[str] = None):
         """``path`` None → stdout-only when ``stdout`` else no-op."""
         self._stdout = stdout
         self._f = None
-        if path is not None:
-            try:
-                import jax
+        self._tb = None
+        try:
+            import jax
 
-                idx = jax.process_index()
-            except Exception:
-                idx = 0
+            idx = jax.process_index()
+        except Exception:
+            idx = 0
+        if path is not None:
             if idx != 0:
                 root, ext = os.path.splitext(path)
                 path = f"{root}.{idx}{ext or '.jsonl'}"
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
             self._f = open(path, "a", buffering=1)
+        if tensorboard_dir is not None and idx == 0:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(tensorboard_dir)
+            except Exception as e:  # best-effort sink; JSONL stays canonical
+                import warnings
+
+                warnings.warn(f"TensorBoard writer unavailable ({e!r}); "
+                              "logging JSONL only")
         self._host = os.environ.get("HOSTNAME", "")
 
     def log(self, step: int, **metrics: Any):
@@ -44,6 +60,10 @@ class MetricsLogger:
         line = json.dumps(rec)
         if self._f is not None:
             self._f.write(line + "\n")
+        if self._tb is not None:
+            for k, v in rec.items():
+                if k not in ("step", "ts", "host") and isinstance(v, float):
+                    self._tb.add_scalar(k, v, int(step))
         if self._stdout:
             print(line, flush=True)
 
@@ -51,6 +71,9 @@ class MetricsLogger:
         if self._f is not None:
             self._f.close()
             self._f = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
 
     def __enter__(self):
         return self

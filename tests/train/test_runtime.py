@@ -59,6 +59,16 @@ def test_checkpoint_interval_and_retention(tmp_path):
     assert int(restored["x"]) == 10
 
 
+def test_metrics_logger_tensorboard_sink(tmp_path):
+    pytest.importorskip("torch.utils.tensorboard")
+    tb_dir = str(tmp_path / "tb")
+    with MetricsLogger(str(tmp_path / "m.jsonl"),
+                       tensorboard_dir=tb_dir) as lg:
+        lg.log(1, loss=0.5)
+        lg.log(2, loss=0.25)
+    assert any(f.startswith("events.") for f in os.listdir(tb_dir))
+
+
 def test_metrics_logger(tmp_path):
     p = str(tmp_path / "m.jsonl")
     with MetricsLogger(p) as log:
