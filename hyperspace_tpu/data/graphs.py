@@ -125,6 +125,43 @@ def _pad_to(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+def _prepare_edges_numpy(edges, num_nodes, *, symmetrize=True,
+                         self_loops=True, pad_multiple=1024):
+    """Numpy edge-layout pipeline: the fallback for :func:`prepare` and
+    the parity oracle for ``native.prepare_edges`` (tests/data).
+
+    Returns (senders, receivers, mask, rev_perm, deg); ``rev_perm`` is
+    None unless ``symmetrize``.
+    """
+    e = np.asarray(edges, np.int64)
+    if symmetrize and len(e):
+        e = np.concatenate([e, e[:, ::-1]], axis=0)
+    if self_loops:
+        loops = np.stack([np.arange(num_nodes)] * 2, axis=1)
+        e = np.concatenate([e, loops], axis=0) if len(e) else loops
+    # dedupe + sort by (receiver, sender) via flat receiver-major keys
+    key = e[:, 1] * num_nodes + e[:, 0]
+    e = e[np.unique(key, return_index=True)[1]]
+    e_pad = _pad_to(max(len(e), 1), pad_multiple)
+    senders = np.full(e_pad, num_nodes - 1, np.int32)
+    receivers = np.full(e_pad, num_nodes - 1, np.int32)
+    mask = np.zeros(e_pad, bool)
+    senders[: len(e)] = e[:, 0]
+    receivers[: len(e)] = e[:, 1]
+    mask[: len(e)] = True
+
+    rev_perm = None
+    if symmetrize:
+        # reverse of (s, r) has key s·N + r; keys are sorted, so
+        # searchsorted gives its index.  Padding maps to itself.
+        keys_sorted = e[:, 1] * num_nodes + e[:, 0]
+        rev_perm = np.arange(e_pad, dtype=np.int32)
+        rev_perm[: len(e)] = np.searchsorted(
+            keys_sorted, e[:, 0] * num_nodes + e[:, 1]).astype(np.int32)
+    deg = np.bincount(receivers[mask], minlength=num_nodes).astype(np.float32)
+    return senders, receivers, mask, rev_perm, deg
+
+
 def prepare(
     edges: np.ndarray,
     num_nodes: int,
@@ -153,35 +190,24 @@ def prepare(
       are static per graph, so they are computed here once instead of per
       training step.
     """
-    e = np.asarray(edges, np.int64)
-    if symmetrize and len(e):
-        e = np.concatenate([e, e[:, ::-1]], axis=0)
-    if self_loops:
-        loops = np.stack([np.arange(num_nodes)] * 2, axis=1)
-        e = np.concatenate([e, loops], axis=0) if len(e) else loops
-    # dedupe + sort by (receiver, sender) via flat receiver-major keys
-    key = e[:, 1] * num_nodes + e[:, 0]
-    e = e[np.unique(key, return_index=True)[1]]
-    e_pad = _pad_to(max(len(e), 1), pad_multiple)
-    senders = np.full(e_pad, num_nodes - 1, np.int32)
-    receivers = np.full(e_pad, num_nodes - 1, np.int32)
-    mask = np.zeros(e_pad, bool)
-    senders[: len(e)] = e[:, 0]
-    receivers[: len(e)] = e[:, 1]
-    mask[: len(e)] = True
+    senders = receivers = mask = rev_perm = deg = None
+    try:  # native C++ pipeline; _prepare_edges_numpy is the oracle
+        from hyperspace_tpu.data import native
 
-    rev_perm = None
-    if symmetrize:
-        # reverse of (s, r) has key s·N + r; keys are sorted, so searchsorted
-        # gives its index.  Padding maps to itself (identity tail).
-        keys_sorted = e[:, 1] * num_nodes + e[:, 0]
-        rev_perm = np.arange(e_pad, dtype=np.int32)
-        rev_perm[: len(e)] = np.searchsorted(
-            keys_sorted, e[:, 0] * num_nodes + e[:, 1]).astype(np.int32)
+        senders, receivers, mask, rev_perm, deg = native.prepare_edges(
+            np.asarray(edges, np.int32), num_nodes, symmetrize=symmetrize,
+            self_loops=self_loops, pad_multiple=pad_multiple)
+        if not symmetrize:
+            rev_perm = None
+    except (ImportError, OSError):
+        pass
+    if senders is None:
+        senders, receivers, mask, rev_perm, deg = _prepare_edges_numpy(
+            edges, num_nodes, symmetrize=symmetrize, self_loops=self_loops,
+            pad_multiple=pad_multiple)
 
     from hyperspace_tpu.kernels.segment import build_csr_plan
 
-    deg = np.bincount(receivers[mask], minlength=num_nodes).astype(np.float32)
     return Graph(
         x=np.asarray(x, np.float32),
         senders=senders,

@@ -51,3 +51,23 @@ def test_negative_sampler_deterministic():
     np.testing.assert_array_equal(a, b)
     c = native.sample_negative_edges(edges, 20, 50, seed=4)
     assert not np.array_equal(a, c)
+
+
+def test_prepare_edges_matches_numpy_oracle():
+    """Native pipeline vs the ACTUAL numpy fallback used by graphs.prepare
+    (same function object — no drift possible)."""
+    from hyperspace_tpu.data.graphs import _prepare_edges_numpy
+
+    rng = np.random.default_rng(0)
+    for n, ne, sym, loops in [(40, 100, True, True), (40, 100, True, False),
+                              (40, 100, False, True), (7, 0, True, True)]:
+        edges = rng.integers(0, n, (ne, 2)).astype(np.int32)
+        got = native.prepare_edges(edges, n, symmetrize=sym, self_loops=loops,
+                                   pad_multiple=64)
+        want = _prepare_edges_numpy(edges, n, symmetrize=sym,
+                                    self_loops=loops, pad_multiple=64)
+        for a, b, name in zip(got, want,
+                              ("senders", "receivers", "mask", "rev", "deg")):
+            if name == "rev" and not sym:
+                continue
+            np.testing.assert_array_equal(a, b, err_msg=name)
