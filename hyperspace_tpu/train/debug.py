@@ -1,0 +1,41 @@
+"""Debug/correctness modes — the TPU analogue of the reference's CUDA
+sanitizer story (SURVEY.md §5 "Race detection / sanitizers": not
+applicable on TPU; instead NaN trapping, determinism assertions, and the
+kernel parity suite).
+
+- :func:`nan_checks` — context manager enabling ``jax_debug_nans``:
+  any NaN produced inside jitted code raises at the producing op
+  (re-runs the failing computation op-by-op), instead of surfacing
+  steps later as a corrupted loss.
+- :func:`assert_replicas_match` — asserts a value is identical across
+  hosts/replicas (gradient sync / determinism guard; wraps
+  ``multihost_utils.assert_equal``).
+- Determinism across device counts is asserted by
+  ``tests/parallel/test_dp_equivalence.py``: the same DP train step on an
+  8-device mesh must match the single-device run to float tolerance.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+
+
+@contextmanager
+def nan_checks(enabled: bool = True):
+    """Enable jax_debug_nans within the block (compile caches are per-config,
+    so expect recompiles inside)."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enabled)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def assert_replicas_match(x, message: str = "replica values diverged"):
+    """Raise if ``x`` differs across processes (multi-host determinism)."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.assert_equal(x, fail_message=message)

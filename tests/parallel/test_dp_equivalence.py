@@ -1,0 +1,58 @@
+"""SURVEY.md §4.6: a data-parallel GSPMD train step over the 8-fake-device
+mesh must match the single-device run.  Uses product_embed's mesh-aware
+step — its batch indices carry real (host, data) sharding constraints, so
+XLA compiles an actual gradient all-reduce (unlike a replicated program,
+where equality would hold vacuously).  Same PRNG stream both ways, so
+only the reduction order differs — float tolerance, not bitwise."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.data.wordnet import synthetic_tree
+from hyperspace_tpu.models import product_embed as pme
+from hyperspace_tpu.parallel.mesh import make_mesh, replicated
+from hyperspace_tpu.train.debug import nan_checks
+
+
+def _cfg(n):
+    return pme.ProductEmbedConfig(
+        num_nodes=n, factors=(("poincare", 3), ("euclidean", 2)),
+        batch_size=64, neg_samples=4, lr_table=0.2, burnin_steps=0)
+
+
+def test_dp_mesh_matches_single_device():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    ds = synthetic_tree(depth=3, branching=3)
+    cfg = _cfg(ds.num_nodes)
+    pairs = jnp.asarray(ds.pairs)
+    mesh = make_mesh({"host": 2, "data": 4})
+
+    state1, curv_opt = pme.init_state(cfg, seed=0)
+    for _ in range(15):
+        state1, loss1 = pme.train_step(cfg, curv_opt, state1, pairs)
+
+    state8, _ = pme.init_state(cfg, seed=0)
+    state8 = jax.device_put(state8, replicated(mesh))
+    step8 = pme.make_sharded_step(cfg, curv_opt, mesh)
+    for _ in range(15):
+        state8, loss8 = step8(state8, pairs)
+
+    assert np.isfinite(float(loss1)) and np.isfinite(float(loss8))
+    np.testing.assert_allclose(float(loss8), float(loss1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(state8.params.table),
+                               np.asarray(state1.params.table),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(state8.params.c_raw),
+                               np.asarray(state1.params.c_raw),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_nan_checks_traps():
+    with nan_checks():
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda x: jnp.log(x - 1.0))(jnp.zeros(4))
+    # config restored
+    assert not jax.config.jax_debug_nans
