@@ -51,6 +51,7 @@ def riemannian_adam(
     b2: float = 0.999,
     eps: float = 1e-8,
     use_expmap: bool = True,
+    stabilize_every: int = 0,
 ) -> optax.GradientTransformation:
     """Riemannian Adam.
 
@@ -60,6 +61,12 @@ def riemannian_adam(
       b1, b2, eps: Adam constants.
       use_expmap: exact exponential-map update if True, else retraction
         (``proj(x + v)``) — the reference's "tangent-space retraction" mode.
+      stabilize_every: if > 0, every that-many steps the new point is
+        re-projected onto the manifold and the transported first moment
+        onto its tangent space (geoopt's ``stabilize`` cadence,
+        SURVEY.md §2 "Riemannian Adam") — counters float drift off the
+        constraint surface over long runs without paying the projection
+        on every step.
     """
 
     def init_fn(params):
@@ -79,6 +86,9 @@ def riemannian_adam(
         ftype = jnp.result_type(float)  # f64 under x64, f32 on TPU
         c1 = 1.0 - b1 ** count.astype(ftype)
         c2 = 1.0 - b2 ** count.astype(ftype)
+        do_stab = (
+            (count % stabilize_every == 0) if stabilize_every > 0 else None
+        )
 
         def one(tag, g, p, mu, nu):
             if tag is None:
@@ -95,6 +105,16 @@ def riemannian_adam(
             new_p = tag.expmap(p, step) if use_expmap else tag.retr(p, step)
             # transport the first moment to the new point's tangent space
             mu_t = tag.ptransp(p, new_p, mu_n)
+            if do_stab is not None:
+                # lax.cond (not where): projection work is actually skipped
+                # on the non-stabilize steps
+                def _stab(args):
+                    q, v = args
+                    q = tag.proj(q)
+                    return q, tag.proju(q, v)
+
+                new_p, mu_t = jax.lax.cond(
+                    do_stab, _stab, lambda a: a, (new_p, mu_t))
             return new_p - p, mu_t, nu_n
 
         out = map_tagged(one, tags, grads, params, state.mu, state.nu)

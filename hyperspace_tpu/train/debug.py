@@ -8,8 +8,8 @@ kernel parity suite).
   (re-runs the failing computation op-by-op), instead of surfacing
   steps later as a corrupted loss.
 - :func:`assert_replicas_match` — asserts a value is identical across
-  hosts/replicas (gradient sync / determinism guard; wraps
-  ``multihost_utils.assert_equal``).
+  hosts/replicas (gradient sync / determinism guard); alias of
+  :func:`hyperspace_tpu.parallel.multihost.assert_equal_across_hosts`.
 - Determinism across device counts is asserted by
   ``tests/parallel/test_dp_equivalence.py``: the same DP train step on an
   8-device mesh must match the single-device run to float tolerance.
@@ -25,17 +25,14 @@ import jax
 @contextmanager
 def nan_checks(enabled: bool = True):
     """Enable jax_debug_nans within the block (compile caches are per-config,
-    so expect recompiles inside)."""
-    prev = jax.config.jax_debug_nans
-    jax.config.update("jax_debug_nans", enabled)
-    try:
+    so expect recompiles inside).  Defers to JAX's own config context
+    manager — same thread-local handling as ``with jax.debug_nans(...)``."""
+    with jax.debug_nans(enabled):
         yield
-    finally:
-        jax.config.update("jax_debug_nans", prev)
 
 
 def assert_replicas_match(x, message: str = "replica values diverged"):
     """Raise if ``x`` differs across processes (multi-host determinism)."""
-    from jax.experimental import multihost_utils
+    from hyperspace_tpu.parallel.multihost import assert_equal_across_hosts
 
-    multihost_utils.assert_equal(x, fail_message=message)
+    assert_equal_across_hosts(x, msg=message)
