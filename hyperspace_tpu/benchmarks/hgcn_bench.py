@@ -41,8 +41,11 @@ def run_hgcn_bench(
         num_nodes = x.shape[0]
     else:
         # arxiv-scale synthetic hierarchy: same node/edge/feature counts
+        # (edge count scales with num_nodes at arxiv's edge density, so
+        # reduced-size runs stay proportionate)
         branching = 3
-        extra = (ARXIV_EDGES - (num_nodes - 1) * 3) / num_nodes
+        n_edges = ARXIV_EDGES * num_nodes / ARXIV_NODES
+        extra = (n_edges - (num_nodes - 1) * 3) / num_nodes
         edges, x, labels, ncls = G.synthetic_hierarchy(
             num_nodes=num_nodes, branching=branching, feat_dim=ARXIV_FEATS,
             ancestor_hops=3, extra_edge_frac=max(extra, 0.0),

@@ -104,10 +104,12 @@ def run_poincare(run: RunConfig, overrides: dict):
         pe.PoincareEmbedConfig(num_nodes=ds.num_nodes), overrides)
     state, opt = pe.init_state(cfg, run.seed)
     pairs = jnp.asarray(ds.pairs)
-    with _logger(run) as log:
-        for i in range(run.steps):
-            state, loss = pe.train_step(cfg, opt, state, pairs)
-            _maybe_log(log, run, i, loss)
+    from hyperspace_tpu.manifolds import PoincareBall
+
+    ball = PoincareBall(cfg.c)
+    state, _ = _train_loop(run, state,
+                           lambda st: pe.train_step(cfg, opt, st, pairs),
+                           project=lambda st: st._replace(table=ball.proj(st.table)))
     res = pe.evaluate(state.table, ds.pairs, cfg.c)
     return {"workload": "poincare", "steps": run.steps, **res}
 
@@ -123,15 +125,31 @@ def run_hgcn(run: RunConfig, overrides: dict):
         hgcn.HGCNConfig(feat_dim=x.shape[1],
                         num_classes=ncls if task == "nc" else 0),
         overrides)
+    num_nodes = x.shape[0]
     if task == "lp":
-        split = G.split_edges(edges, x.shape[0], x, seed=run.seed)
-        model, params, _ = hgcn.train_lp(cfg, split, steps=run.steps, seed=run.seed)
-        res = hgcn.evaluate_lp(model, params, split, "test")
+        split = G.split_edges(edges, num_nodes, x, seed=run.seed)
+        model, opt, state = hgcn.init_lp(cfg, split.graph, seed=run.seed)
+        ga = hgcn._device_graph(split.graph)
+        train_pos = jnp.asarray(split.train_pos)
+        state, loss = _train_loop(
+            run, state,
+            lambda st: hgcn.train_step_lp(model, opt, num_nodes, st, ga,
+                                          train_pos))
+        res = {"loss": float(loss),
+               **hgcn.evaluate_lp(model, state.params, split, "test")}
     else:
-        tr, va, te = G.node_split_masks(x.shape[0], seed=run.seed)
-        g = G.prepare(edges, x.shape[0], x, labels=labels, num_classes=ncls,
+        tr, va, te = G.node_split_masks(num_nodes, seed=run.seed)
+        g = G.prepare(edges, num_nodes, x, labels=labels, num_classes=ncls,
                       train_mask=tr, val_mask=va, test_mask=te)
-        model, params, res = hgcn.train_nc(cfg, g, steps=run.steps, seed=run.seed)
+        model, opt, state = hgcn.init_nc(cfg, g, seed=run.seed)
+        ga = hgcn._device_graph(g)
+        lab = jnp.asarray(g.labels)
+        mask = jnp.asarray(g.train_mask)
+        state, loss = _train_loop(
+            run, state,
+            lambda st: hgcn.train_step_nc(model, opt, st, ga, lab, mask))
+        res = {"loss": float(loss),
+               **hgcn.evaluate_nc(model, state.params, g, ga=ga)}
     return {"workload": "hgcn", "task": task, "dataset": dataset,
             "source": source, **res}
 
@@ -148,9 +166,15 @@ def run_hybonet(run: RunConfig, overrides: dict):
                               num_classes=ds.num_classes,
                               max_len=ds.tokens.shape[1]),
         overrides)
-    model, params, loss = hybonet.train(cfg, tr, steps=run.steps, seed=run.seed)
-    res = hybonet.evaluate(model, params, te)
-    return {"workload": "hybonet", "source": source, "loss": loss, **res}
+    model, opt, state = hybonet.init_model(cfg, seed=run.seed)
+    toks, mask, labels = (jnp.asarray(tr.tokens), jnp.asarray(tr.mask),
+                          jnp.asarray(tr.labels))
+    state, loss = _train_loop(
+        run, state,
+        lambda st: hybonet.train_step_sampled(model, opt, st, toks, mask,
+                                              labels))
+    res = hybonet.evaluate(model, state.params, te)
+    return {"workload": "hybonet", "source": source, "loss": float(loss), **res}
 
 
 def run_hvae(run: RunConfig, overrides: dict):
@@ -159,10 +183,22 @@ def run_hvae(run: RunConfig, overrides: dict):
 
     ds, source = M.load_mnist(run.data_root)
     cfg = apply_overrides(hvae.HVAEConfig(image_size=ds.images.shape[1]), overrides)
-    model, state, metrics = hvae.train(cfg, ds.images, steps=run.steps, seed=run.seed)
+    model, opt, state = hvae.init_model(cfg, seed=run.seed)
+    x_all = jnp.asarray(ds.images, cfg.dtype)
+    metrics = {}
+
+    def stepper(st):
+        st, loss, recon, kl = hvae.train_step_sampled(model, opt, st, x_all)
+        metrics["rk"] = (recon, kl)  # device arrays; fetched once at the end
+        return st, loss
+
+    state, loss = _train_loop(run, state, stepper)
+    recon, kl = (float(v) for v in metrics.get("rk", (jnp.nan,) * 2))
+    loss = float(loss)
     x = jnp.asarray(ds.images[:256], cfg.dtype)
     iwae = float(hvae.iwae_bound(model, state.params, x, jax.random.PRNGKey(1), k=16))
-    return {"workload": "hvae", "source": source, **metrics, "iwae": iwae}
+    return {"workload": "hvae", "source": source, "loss": loss, "recon": recon,
+            "kl": kl, "iwae": iwae}
 
 
 def run_product(run: RunConfig, overrides: dict):
@@ -188,10 +224,12 @@ def run_product(run: RunConfig, overrides: dict):
         stepper = lambda st: step(st, pairs)
     else:
         stepper = lambda st: pme.train_step(cfg, curv_opt, state=st, pairs=pairs)
-    with _logger(run) as log:
-        for i in range(run.steps):
-            state, loss = stepper(state)
-            _maybe_log(log, run, i, loss)
+    def project(st):
+        m = pme.build_manifold(cfg, st.params.c_raw)
+        return st._replace(params=st.params._replace(
+            table=m.proj(st.params.table)))
+
+    state, _ = _train_loop(run, state, stepper, project=project)
     res = pme.evaluate(cfg, state.params, ds.pairs)
     return {"workload": "product", **res,
             "curvatures": pme.curvatures(cfg, state.params)}
@@ -214,6 +252,43 @@ def _logger(run: RunConfig):
 
     return MetricsLogger(run.log, stdout=False,
                          tensorboard_dir=run.tensorboard_dir)
+
+
+def _train_loop(run: RunConfig, state, stepper, project=None):
+    """Shared CLI step loop: optional checkpoint/resume + JSONL logging.
+
+    Every workload runner goes through here, so --ckpt-dir / resume work
+    uniformly.  The checkpoint manager is context-managed (its __exit__
+    waits for in-flight async saves and closes background threads, also on
+    the exception path).  Orbax async saves copy device→host synchronously
+    before returning, so saving a state whose buffers the next step's
+    donation invalidates is safe.  ``project`` re-projects restored states
+    onto their manifolds (train/checkpoint.py's restore contract — guards
+    dtype/float drift off the constraint surface).  Returns
+    ``(final_state, final_loss)``; loss is nan when no step ran.
+    """
+    import contextlib
+
+    ck = None
+    start = 0
+    loss = jnp.nan
+    if run.ckpt_dir:
+        from hyperspace_tpu.train.checkpoint import CheckpointManager
+
+        ck = CheckpointManager(run.ckpt_dir,
+                               save_interval_steps=run.ckpt_every)
+    # restore inside the with-block: a corrupt checkpoint raising in
+    # restore() still closes the manager's async machinery on the way out
+    with (ck if ck is not None else contextlib.nullcontext()), \
+            _logger(run) as log:
+        if ck is not None and run.resume and ck.latest_step() is not None:
+            state, start = ck.restore(state, project=project)
+        for i in range(start, run.steps):
+            state, loss = stepper(state)
+            _maybe_log(log, run, i, loss)
+            if ck is not None:
+                ck.save(i + 1, state)
+    return state, loss
 
 
 def _maybe_log(log, run: RunConfig, step: int, loss):

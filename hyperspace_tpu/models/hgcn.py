@@ -331,6 +331,20 @@ def eval_logits_nc(model: HGCNNodeClf, params, g: graph_data.DeviceGraph):
     return model.apply({"params": params}, g)
 
 
+def evaluate_nc(model: HGCNNodeClf, params, g: graph_data.Graph,
+                ga: graph_data.DeviceGraph | None = None) -> dict:
+    """NC metrics; pass ``ga`` to reuse an already-transferred DeviceGraph
+    (the [N, F] feature tensor is ~90 MB at arxiv scale)."""
+    logits = np.asarray(eval_logits_nc(
+        model, params, _device_graph(g) if ga is None else ga))
+    return {
+        "val_acc": metrics_lib.accuracy(logits, g.labels, g.val_mask),
+        "test_acc": metrics_lib.accuracy(logits, g.labels, g.test_mask),
+        "test_f1": metrics_lib.f1_macro(
+            logits, g.labels, model.cfg.num_classes, g.test_mask),
+    }
+
+
 def train_nc(
     cfg: HGCNConfig,
     g: graph_data.Graph,
@@ -343,11 +357,5 @@ def train_nc(
     tr = jnp.asarray(g.train_mask)
     for _ in range(steps):
         state, loss = train_step_nc(model, opt, state, ga, labels, tr)
-    logits = np.asarray(eval_logits_nc(model, state.params, ga))
-    res = {
-        "loss": float(loss),
-        "val_acc": metrics_lib.accuracy(logits, g.labels, g.val_mask),
-        "test_acc": metrics_lib.accuracy(logits, g.labels, g.test_mask),
-        "test_f1": metrics_lib.f1_macro(logits, g.labels, cfg.num_classes, g.test_mask),
-    }
+    res = {"loss": float(loss), **evaluate_nc(model, state.params, g, ga=ga)}
     return model, state.params, res

@@ -1,0 +1,81 @@
+"""Compare HGCN LP train-step variants on the live backend (TPU or CPU).
+
+Variants:
+  unplanned  — train_step_lp: fresh (u, v) negatives, XLA scatter decoder grads
+  planned    — train_step_lp_planned: graph-edge positives + corrupt-one-side
+               negatives, every decoder gradient scatter CSR-planned
+  bf16       — the faster variant re-run in bfloat16
+
+Prints one JSON line per variant.  Run under nohup; compiles go through the
+remote helper (~1-3 min each).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def timed(step, state, *args, steps=10, repeats=3):
+    import jax
+
+    state, loss = step(state, *args)  # compile + warmup
+    jax.device_get(loss)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = step(state, *args)
+        jax.device_get(loss)
+        best = min(best, time.perf_counter() - t0)
+    return best / steps, state
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.benchmarks import hgcn_bench as HB
+    from hyperspace_tpu.data import graphs as G
+    from hyperspace_tpu.models import hgcn
+
+    num_nodes = HB.ARXIV_NODES
+    branching = 3
+    extra = (HB.ARXIV_EDGES - (num_nodes - 1) * 3) / num_nodes
+    edges, x, labels, ncls = G.synthetic_hierarchy(
+        num_nodes=num_nodes, branching=branching, feat_dim=HB.ARXIV_FEATS,
+        ancestor_hops=3, extra_edge_frac=max(extra, 0.0),
+        num_classes=HB.ARXIV_CLASSES, seed=0)
+    split = G.split_edges(edges, num_nodes, x, val_frac=0.02, test_frac=0.02,
+                          seed=0, pad_multiple=65536)
+
+    for name, dtype in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        cfg = hgcn.HGCNConfig(feat_dim=x.shape[1], hidden_dims=(128, 32),
+                              kind="lorentz", dtype=dtype)
+        model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
+        ga = hgcn._device_graph(split.graph)
+
+        # unplanned
+        train_pos = jnp.asarray(split.train_pos)
+        t, _ = timed(
+            lambda st, g, tp: hgcn.train_step_lp(model, opt, num_nodes, st, g, tp),
+            state, ga, train_pos)
+        print(json.dumps({"variant": f"unplanned_{name}",
+                          "step_s": round(t, 5),
+                          "samples_per_s": round(num_nodes / t, 1)}), flush=True)
+
+        # planned
+        model2, opt2, state2 = hgcn.init_lp(cfg, split.graph, seed=0)
+        n_neg = int(split.graph.senders.shape[0])
+        neg_u, neg_plan = hgcn.make_static_negatives(num_nodes, n_neg, seed=0)
+        t, _ = timed(
+            lambda st, g, nu, npl: hgcn.train_step_lp_planned(
+                model2, opt2, num_nodes, st, g, nu, npl),
+            state2, ga, neg_u, neg_plan)
+        print(json.dumps({"variant": f"planned_{name}",
+                          "step_s": round(t, 5),
+                          "samples_per_s": round(num_nodes / t, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

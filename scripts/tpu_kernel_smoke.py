@@ -1,0 +1,101 @@
+"""On-chip Pallas kernel smoke: every N1-N7 kernel lowered through Mosaic on
+the real TPU, compared against its pure-JAX twin on identical inputs.
+
+Prints one JSON line per kernel: {"kernel", "max_err", "ok"}.  Run with the
+default (axon/TPU) backend:
+
+    PYTHONPATH="/root/repo:$PYTHONPATH" python scripts/tpu_kernel_smoke.py
+
+The kernel/twin switch is the HYPERSPACE_KERNELS env var read at trace time
+(kernels/_support.mode), so each op is evaluated eagerly twice — once forced
+'pallas', once forced 'xla' — inside one process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def run(name, fn, tol=5e-4):
+    """Mixed abs/rel check: |pallas - xla| / max(|xla|, 1) < tol.
+
+    Relative for amplified quantities (MLR logits reach O(100) for points
+    near the boundary; TPU transcendental precision gives ~1e-4 relative),
+    absolute for O(1) outputs — one formula covers both.
+    """
+    os.environ["HYPERSPACE_KERNELS"] = "pallas"
+    out_p = np.asarray(jax.device_get(fn()), np.float64)
+    os.environ["HYPERSPACE_KERNELS"] = "xla"
+    out_x = np.asarray(jax.device_get(fn()), np.float64)
+    err = float(np.max(np.abs(out_p - out_x) / np.maximum(np.abs(out_x), 1.0)))
+    ok = bool(err < tol and np.isfinite(out_p).all())
+    print(json.dumps({"kernel": name, "max_err": err, "ok": ok}), flush=True)
+    return ok
+
+
+def main():
+    from hyperspace_tpu import kernels as K
+    from hyperspace_tpu.kernels.segment import build_csr_plan, csr_segment_sum
+    from hyperspace_tpu.manifolds import Lorentz, PoincareBall
+
+    assert jax.default_backend() != "cpu", "smoke needs the TPU backend"
+    key = jax.random.PRNGKey(0)
+    ks = list(jax.random.split(key, 16))
+    ball, lor = PoincareBall(1.0), Lorentz(1.0)
+    c = 1.0
+    B, D = 256, 48
+
+    x = ball.random_normal(ks[0], (B, D), jnp.float32, std=0.3)
+    y = ball.random_normal(ks[1], (B, D), jnp.float32, std=0.3)
+    v = 0.3 * jax.random.normal(ks[2], (B, D), jnp.float32)
+    r = 0.7  # kernel N2 takes a scalar multiplier
+
+    oks = [
+        run("mobius_add", lambda: K.mobius_add(x, y, c)),
+        run("mobius_scalar_mul", lambda: K.mobius_scalar_mul(r, x, c)),
+        run("expmap", lambda: K.expmap(x, v, c)),
+        run("logmap", lambda: K.logmap(x, y, c)),
+        run("expmap0", lambda: K.expmap0(v, c)),
+        run("logmap0", lambda: K.logmap0(y, c)),
+        run("ptransp", lambda: K.ptransp(x, y, v, c)),
+        run("poincare_pdist", lambda: K.poincare_pdist(x, y, c)),
+    ]
+
+    lx = lor.random_normal(ks[4], (B, D + 1), jnp.float32, std=0.3)
+    ly = lor.random_normal(ks[5], (B, D + 1), jnp.float32, std=0.3)
+    oks.append(run("lorentz_pdist", lambda: K.lorentz_pdist(lx, ly, c)))
+
+    m = 0.2 * jax.random.normal(ks[6], (D, 32), jnp.float32)
+    b = ball.random_normal(ks[7], (32,), jnp.float32, std=0.1)
+    oks.append(run("hyp_linear", lambda: K.hyp_linear(x, m, b, c)))
+
+    p = ball.random_normal(ks[8], (16, D), jnp.float32, std=0.2)
+    a = 0.3 * jax.random.normal(ks[9], (16, D), jnp.float32)
+    oks.append(run("hyp_mlr", lambda: K.hyp_mlr(x, p, a, c)))
+
+    q = lor.random_normal(ks[10], (2, 128, 17), jnp.float32, std=0.3)
+    kk = lor.random_normal(ks[11], (2, 128, 17), jnp.float32, std=0.3)
+    oks.append(run("flash_attention",
+                   lambda: K.flash_attention(q, kk, kk, c)))
+
+    rng = np.random.default_rng(0)
+    recv = np.sort(rng.integers(0, 200, 1024)).astype(np.int32)
+    vals = jnp.asarray(rng.normal(size=(1024, 64)).astype(np.float32))
+    plan = tuple(jnp.asarray(a_) for a_ in build_csr_plan(recv, 200))
+    recv_d = jnp.asarray(recv)
+    oks.append(run("csr_segment_sum",
+                   lambda: csr_segment_sum(vals, recv_d, plan, 200)))
+
+    print(json.dumps({"all_ok": all(oks), "backend": jax.default_backend()}),
+          flush=True)
+    sys.exit(0 if all(oks) else 1)
+
+
+if __name__ == "__main__":
+    main()

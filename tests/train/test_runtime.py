@@ -120,3 +120,25 @@ def test_cli_end_to_end_poincare(tmp_path, capsys):
     res = json.loads(out)
     assert res["workload"] == "poincare" and "map" in res
     assert os.path.exists(tmp_path / "run.jsonl")
+
+
+@pytest.mark.slow
+def test_cli_checkpoint_resume_poincare(tmp_path, capsys):
+    """Interrupted-and-resumed CLI run matches an uninterrupted one: the
+    checkpoint carries table, RSGD count, and PRNG key, so steps
+    [k, N) replay identically (restart-from-checkpoint recovery model)."""
+    from hyperspace_tpu.cli import train as cli
+
+    common = ["poincare", "dim=4", "batch_size=32", "neg_samples=4"]
+
+    cli.main(common + ["steps=20", f"ckpt_dir={tmp_path}/full", "ckpt_every=1"])
+    full = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    cli.main(common + ["steps=12", f"ckpt_dir={tmp_path}/ab", "ckpt_every=1"])
+    capsys.readouterr()
+    cli.main(common + ["steps=20", f"ckpt_dir={tmp_path}/ab", "ckpt_every=1",
+                       "resume=true"])
+    resumed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    assert resumed["map"] == pytest.approx(full["map"], abs=1e-9)
+    assert resumed["mean_rank"] == pytest.approx(full["mean_rank"], abs=1e-9)
