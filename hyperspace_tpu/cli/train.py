@@ -136,7 +136,7 @@ def run_hgcn(run: RunConfig, overrides: dict):
             lambda st: hgcn.train_step_lp(model, opt, num_nodes, st, ga,
                                           train_pos))
         res = {"loss": float(loss),
-               **hgcn.evaluate_lp(model, state.params, split, "test")}
+               **hgcn.evaluate_lp(model, state.params, split, "test", ga=ga)}
     else:
         tr, va, te = G.node_split_masks(num_nodes, seed=run.seed)
         g = G.prepare(edges, num_nodes, x, labels=labels, num_classes=ncls,
@@ -325,8 +325,23 @@ def main(argv: list[str] | None = None) -> int:
             num_processes=run.num_processes,
             process_id=run.process_id)
     result = WORKLOADS[args.workload](run, wl_overrides)
-    print(json.dumps(result))
+    print(json.dumps(_json_safe(result)))
     return 0
+
+
+def _json_safe(x):
+    """Non-finite floats → null so the final line is always strict JSON
+    (loss is nan when a resumed run had nothing left to do, or when a run
+    diverged — both must still print parseably)."""
+    import math
+
+    if isinstance(x, float) and not math.isfinite(x):
+        return None
+    if isinstance(x, dict):
+        return {k: _json_safe(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_json_safe(v) for v in x]
+    return x
 
 
 if __name__ == "__main__":

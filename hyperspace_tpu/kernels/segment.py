@@ -152,8 +152,11 @@ def csr_segment_sum(
     """
     m = S.mode()
     if m == "xla":
-        return jax.ops.segment_sum(values, receivers, num_segments,
-                                   indices_are_sorted=True)
+        # same accumulate-in-≥f32 semantics as the kernel (f64 stays f64)
+        acc_dt = jnp.promote_types(values.dtype, jnp.float32)
+        acc = jax.ops.segment_sum(values.astype(acc_dt), receivers,
+                                  num_segments, indices_are_sorted=True)
+        return acc.astype(values.dtype)
     e, f = values.shape
     bn, bk = _BN, _BK
     dp = S.round_up(f, 128)

@@ -50,6 +50,9 @@ class HGCNConfig:
     weight_decay: float = 5e-4
     neg_per_pos: int = 1  # LP negatives sampled per positive per step
     dtype: Any = jnp.float32
+    # edge-message dtype for neighbor aggregation (None = dtype); bf16
+    # halves the dominant HBM traffic while the kernel accumulates f32
+    agg_dtype: Any = None
 
 
 class HGCNEncoder(nn.Module):
@@ -76,6 +79,7 @@ class HGCNEncoder(nn.Module):
                 use_att=cfg.use_att,
                 dropout_rate=cfg.dropout,
                 activation=(lambda v: v) if is_last else nn.relu,
+                agg_dtype=cfg.agg_dtype,
                 name=f"conv{i}",
             )(h, g, deterministic=deterministic)
             c_prev = m.c
@@ -257,8 +261,10 @@ def eval_scores_lp(model: HGCNLinkPred, params, g: graph_data.DeviceGraph, pairs
     return model.apply({"params": params}, g, pairs)
 
 
-def evaluate_lp(model, params, split: graph_data.LinkSplit, which: str = "test") -> dict:
-    ga = _device_graph(split.graph)
+def evaluate_lp(model, params, split: graph_data.LinkSplit, which: str = "test",
+                ga: graph_data.DeviceGraph | None = None) -> dict:
+    """LP ROC-AUC; pass ``ga`` to reuse an already-transferred DeviceGraph."""
+    ga = _device_graph(split.graph) if ga is None else ga
     pos = jnp.asarray(getattr(split, f"{which}_pos"))
     neg = jnp.asarray(getattr(split, f"{which}_neg"))
     s_pos = np.asarray(eval_scores_lp(model, params, ga, pos))
@@ -281,7 +287,7 @@ def train_lp(
     for i in range(steps):
         state, loss = train_step_lp(model, opt, split.graph.num_nodes, state, ga, train_pos)
         if log_every and (i + 1) % log_every == 0:
-            ev = evaluate_lp(model, state.params, split, "val")
+            ev = evaluate_lp(model, state.params, split, "val", ga=ga)
             history.append({"step": i + 1, "loss": float(loss), **ev})
     return model, state.params, history
 

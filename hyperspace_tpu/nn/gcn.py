@@ -113,6 +113,11 @@ class HGCConv(nn.Module):
     activation: Callable = nn.relu
     dropout_rate: float = 0.0
     kernel_init: Callable = nn.initializers.glorot_uniform()
+    # dtype for the gathered edge messages only (the aggregation kernel
+    # accumulates in f32 regardless) — jnp.bfloat16 halves the dominant
+    # HBM traffic of the layer at ~bf16-matmul-level quality cost; None
+    # keeps the input dtype
+    agg_dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(
@@ -164,13 +169,19 @@ class HGCConv(nn.Module):
                                           indices_are_sorted=sorted_fast)
             w = ones / jnp.maximum(deg[receivers], 1.0)
             w_static = True
+        h_in = h if self.agg_dtype is None else h.astype(self.agg_dtype)
+        w_in = w if self.agg_dtype is None else w.astype(self.agg_dtype)
         if sorted_fast:
             # receiver-sorted scatter in forward AND backward (nn/scatter.py)
             pb, pc, pf = g.plan if g.plan is not None else (None, None, None)
-            agg = sym_segment_aggregate(h, w, senders, receivers, g.rev_perm,
-                                        pb, pc, pf, n, not w_static)
+            agg = sym_segment_aggregate(h_in, w_in, senders, receivers,
+                                        g.rev_perm, pb, pc, pf, n, not w_static)
         else:
-            agg = jax.ops.segment_sum(w[:, None] * h[senders], receivers, n)
+            msgs = w_in[:, None] * h_in[senders]
+            agg = jax.ops.segment_sum(
+                msgs.astype(jnp.promote_types(msgs.dtype, jnp.float32)),
+                receivers, n)
+        agg = agg.astype(h.dtype)
 
         out = from_tangent0_coords(m_out, self.activation(agg))
         return out, m_out

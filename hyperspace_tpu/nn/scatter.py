@@ -42,8 +42,13 @@ from hyperspace_tpu.kernels.segment import csr_segment_sum
 def _sorted_segsum(vals, receivers, pb, pc, pf, num_segments):
     if pb is not None:
         return csr_segment_sum(vals, receivers, (pb, pc, pf), num_segments)
-    return jax.ops.segment_sum(vals, receivers, num_segments,
-                               indices_are_sorted=True)
+    # match the kernel's accumulate-in-≥f32 contract on the XLA fallback:
+    # scatter-add in the message dtype would sum thousands of bf16 terms
+    # on hub nodes (promote_types keeps f64 accumulation exact under x64)
+    acc_dt = jnp.promote_types(vals.dtype, jnp.float32)
+    acc = jax.ops.segment_sum(vals.astype(acc_dt), receivers,
+                              num_segments, indices_are_sorted=True)
+    return acc.astype(vals.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9))

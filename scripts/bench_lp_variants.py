@@ -36,18 +36,10 @@ def main():
     import jax.numpy as jnp
 
     from hyperspace_tpu.benchmarks import hgcn_bench as HB
-    from hyperspace_tpu.data import graphs as G
     from hyperspace_tpu.models import hgcn
 
     num_nodes = HB.ARXIV_NODES
-    branching = 3
-    extra = (HB.ARXIV_EDGES - (num_nodes - 1) * 3) / num_nodes
-    edges, x, labels, ncls = G.synthetic_hierarchy(
-        num_nodes=num_nodes, branching=branching, feat_dim=HB.ARXIV_FEATS,
-        ancestor_hops=3, extra_edge_frac=max(extra, 0.0),
-        num_classes=HB.ARXIV_CLASSES, seed=0)
-    split = G.split_edges(edges, num_nodes, x, val_frac=0.02, test_frac=0.02,
-                          seed=0, pad_multiple=65536)
+    split, x = HB.arxiv_scale_split(num_nodes)
 
     for name, dtype in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
         cfg = hgcn.HGCNConfig(feat_dim=x.shape[1], hidden_dims=(128, 32),

@@ -1,0 +1,75 @@
+"""bf16-vs-f32 ROC-AUC parity for HGCN LP at arxiv density.
+
+The north-star metric couples throughput to matching test ROC-AUC
+(SURVEY.md §6); bf16 is ~11% faster per step, so this measures what it
+costs in quality.  Trains the same split with both dtypes and prints one
+JSON line per run.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def configs(hgcn, jnp, feat_dim):
+    base = dict(feat_dim=feat_dim, hidden_dims=(128, 32), kind="lorentz")
+    return [
+        ("f32", hgcn.HGCNConfig(**base)),
+        ("f32_aggbf16", hgcn.HGCNConfig(**base, agg_dtype=jnp.bfloat16)),
+        ("bf16", hgcn.HGCNConfig(**base, dtype=jnp.bfloat16)),
+    ]
+
+
+def make_split(num_nodes):
+    from hyperspace_tpu.benchmarks import hgcn_bench as HB
+
+    return HB.arxiv_scale_split(num_nodes)
+
+
+def main(quality_nodes=32768, steps=400):
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.benchmarks import hgcn_bench as HB
+    from hyperspace_tpu.models import hgcn
+
+    # phase A: step time at full arxiv scale
+    split, x = make_split(HB.ARXIV_NODES)
+    n = HB.ARXIV_NODES
+    for name, cfg in configs(hgcn, jnp, x.shape[1]):
+        model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
+        ga = hgcn._device_graph(split.graph)
+        train_pos = jnp.asarray(split.train_pos)
+        state, loss = hgcn.train_step_lp(model, opt, n, state, ga, train_pos)
+        jax.device_get(loss)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                state, loss = hgcn.train_step_lp(model, opt, n, state, ga,
+                                                 train_pos)
+            jax.device_get(loss)
+            best = min(best, time.perf_counter() - t0)
+        print(json.dumps({"phase": "time", "config": name,
+                          "step_s": round(best / 10, 5),
+                          "samples_per_s": round(n / (best / 10), 1)}),
+              flush=True)
+
+    # phase B: ROC-AUC parity at reduced scale
+    split, x = make_split(quality_nodes)
+    for name, cfg in configs(hgcn, jnp, x.shape[1]):
+        model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
+        ga = hgcn._device_graph(split.graph)
+        train_pos = jnp.asarray(split.train_pos)
+        for _ in range(steps):
+            state, loss = hgcn.train_step_lp(model, opt, quality_nodes, state,
+                                             ga, train_pos)
+        res = hgcn.evaluate_lp(model, state.params, split, "test")
+        print(json.dumps({"phase": "quality", "config": name, "steps": steps,
+                          "loss": float(loss), **res}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

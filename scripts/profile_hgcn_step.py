@@ -1,0 +1,117 @@
+"""Decompose the HGCN LP train-step time on the live backend.
+
+Times (min over repeats, 10 chained calls per repeat, scalar-fetch
+barrier): encoder forward, full forward (encoder + decoder), loss+grad,
+and the full train step — the differences isolate decoder, backward, and
+optimizer cost.  One JSON line per probe.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def timed(fn, *args, steps=10, repeats=3):
+    import jax
+
+    out = fn(*args)
+    jax.device_get(jax.tree_util.tree_leaves(out)[0])
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0])
+        best = min(best, time.perf_counter() - t0)
+    return best / steps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from hyperspace_tpu.benchmarks import hgcn_bench as HB
+    from hyperspace_tpu.models import hgcn
+
+    num_nodes = HB.ARXIV_NODES
+    split, x = HB.arxiv_scale_split(num_nodes)
+    cfg = hgcn.HGCNConfig(feat_dim=x.shape[1], hidden_dims=(128, 32),
+                          kind="lorentz")
+    model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
+    ga = hgcn._device_graph(split.graph)
+    train_pos = jnp.asarray(split.train_pos)
+    n_pairs = train_pos.shape[0]
+    pairs2 = jnp.concatenate([train_pos, train_pos], axis=0)
+
+    enc = jax.jit(lambda p, g: hgcn.HGCNEncoder(cfg).apply(
+        {"params": p["encoder"]}, g)[0].sum())
+    fwd = jax.jit(lambda p, g, pr: model.apply({"params": p}, g, pr).sum())
+
+    def loss_fn(p, g, pr):
+        logits = model.apply({"params": p}, g, pr)
+        labels = jnp.concatenate(
+            [jnp.ones(n_pairs), jnp.zeros(n_pairs)]).astype(logits.dtype)
+        return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+
+    @jax.jit
+    def grad(p, g, pr):
+        # return a scalar depending on every grad leaf so nothing is DCE'd
+        l, gr = jax.value_and_grad(loss_fn)(p, g, pr)
+        return l + sum(jnp.sum(x) for x in jax.tree_util.tree_leaves(gr))
+
+    from hyperspace_tpu.nn.scatter import sym_segment_aggregate
+
+    h0 = jnp.zeros((num_nodes, 128), jnp.float32)
+    w0 = ga.edge_mask.astype(jnp.float32)
+    pb, pc, pf = ga.plan
+
+    @jax.jit
+    def agg_fwd_bwd(h):
+        def f(hh):
+            out = sym_segment_aggregate(hh, w0, ga.senders, ga.receivers,
+                                        ga.rev_perm, pb, pc, pf, num_nodes,
+                                        False)
+            return jnp.sum(out * out)
+        l, g_ = jax.value_and_grad(f)(h)
+        return l + jnp.sum(g_)
+
+    probes = {
+        "encoder_fwd": lambda: enc(state.params, ga),
+        "full_fwd": lambda: fwd(state.params, ga, pairs2),
+        "loss_grad": lambda: grad(state.params, ga, pairs2),
+        "one_agg_fwd_bwd": lambda: agg_fwd_bwd(h0),
+    }
+    for name, fn in probes.items():
+        t = timed(fn)
+        print(json.dumps({"probe": name, "time_s": round(t, 5)}), flush=True)
+
+    def step(st):
+        return hgcn.train_step_lp(model, opt, num_nodes, st, ga, train_pos)
+
+    st, loss = step(state)
+    jax.device_get(loss)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            st, loss = step(st)
+        jax.device_get(loss)
+        best = min(best, time.perf_counter() - t0)
+    print(json.dumps({"probe": "train_step", "time_s": round(best / 10, 5)}),
+          flush=True)
+
+    try:
+        cost = jax.jit(lambda st: step(st)).lower(st).compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0] if cost else {}
+        print(json.dumps({"probe": "xla_cost",
+                          "flops": cost.get("flops"),
+                          "bytes": cost.get("bytes accessed")}), flush=True)
+    except Exception as e:
+        print(json.dumps({"probe": "xla_cost", "error": repr(e)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
