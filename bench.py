@@ -64,20 +64,22 @@ def bench_poincare(repeats: int = 3) -> dict:
     }
 
 
-def bench_hgcn(repeats: int = 3, dtype: str = "float32") -> dict:
+def bench_hgcn(repeats: int = 3, dtype: str = "float32",
+               agg_dtype: str = "bfloat16") -> dict:
     """HGCN training throughput (samples/sec/chip) on an arxiv-scale graph.
 
-    float32 default: the north-star target couples throughput to *matching*
-    test ROC-AUC, so the reported number is the full-precision step.
-    bfloat16 measured ~11% faster on v5e (scripts/bench_lp_variants.py);
-    pass --dtype bfloat16 to report it instead.
+    Default config is f32 compute with bf16 *edge messages* (f32
+    accumulation) — measured quality-neutral at convergence and ~6% faster
+    (docs/benchmarks.md).  ``--agg-dtype float32`` reproduces the pure-f32
+    step; ``--dtype bfloat16`` runs everything in bf16 (faster still, but
+    ROC-AUC degrades, so it is opt-in).
     """
     import jax
 
     from hyperspace_tpu.benchmarks.hgcn_bench import run_hgcn_bench
 
     return run_hgcn_bench(repeats=repeats, backend=jax.default_backend(),
-                          dtype=dtype)
+                          dtype=dtype, agg_dtype=agg_dtype)
 
 
 def main() -> None:
@@ -85,11 +87,14 @@ def main() -> None:
     p.add_argument("--metric", choices=["auto", "hgcn", "poincare"], default="auto")
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
+    p.add_argument("--agg-dtype", choices=["float32", "bfloat16"],
+                   default="bfloat16")
     args = p.parse_args()
 
     import functools
 
-    hgcn_fn = functools.partial(bench_hgcn, dtype=args.dtype)
+    hgcn_fn = functools.partial(bench_hgcn, dtype=args.dtype,
+                                agg_dtype=args.agg_dtype)
     order = {
         "auto": [hgcn_fn, bench_poincare],
         "hgcn": [hgcn_fn],

@@ -38,10 +38,10 @@ def main(quality_nodes=32768, steps=400):
     # phase A: step time at full arxiv scale
     split, x = make_split(HB.ARXIV_NODES)
     n = HB.ARXIV_NODES
+    ga = hgcn._device_graph(split.graph)
+    train_pos = jnp.asarray(split.train_pos)
     for name, cfg in configs(hgcn, jnp, x.shape[1]):
         model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
-        ga = hgcn._device_graph(split.graph)
-        train_pos = jnp.asarray(split.train_pos)
         state, loss = hgcn.train_step_lp(model, opt, n, state, ga, train_pos)
         jax.device_get(loss)
         best = float("inf")
@@ -59,14 +59,14 @@ def main(quality_nodes=32768, steps=400):
 
     # phase B: ROC-AUC parity at reduced scale
     split, x = make_split(quality_nodes)
+    ga = hgcn._device_graph(split.graph)
+    train_pos = jnp.asarray(split.train_pos)
     for name, cfg in configs(hgcn, jnp, x.shape[1]):
         model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
-        ga = hgcn._device_graph(split.graph)
-        train_pos = jnp.asarray(split.train_pos)
         for _ in range(steps):
             state, loss = hgcn.train_step_lp(model, opt, quality_nodes, state,
                                              ga, train_pos)
-        res = hgcn.evaluate_lp(model, state.params, split, "test")
+        res = hgcn.evaluate_lp(model, state.params, split, "test", ga=ga)
         print(json.dumps({"phase": "quality", "config": name, "steps": steps,
                           "loss": float(loss), **res}), flush=True)
 

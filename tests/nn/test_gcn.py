@@ -107,3 +107,34 @@ def test_hgcconv_learned_curvature_grad():
 
     g = jax.grad(loss)(params)
     assert np.isfinite(float(g["params"]["c_raw"]))
+
+
+@pytest.mark.parametrize("layout", ["unsorted", "sorted_planned"])
+def test_hgcconv_agg_dtype_bf16_close_to_f32(layout):
+    """agg_dtype=bfloat16 changes only the message dtype (accumulation is
+    >= f32 on every path), so outputs track the full-precision layer to
+    bf16-rounding tolerance and stay on-manifold — on both the unsorted
+    XLA fallback and the sorted/CSR-planned path used in training."""
+    n = 32
+    m = Lorentz(1.0)
+    x = m.random_normal(jax.random.PRNGKey(7), (n, 9), jnp.float32, std=0.3)
+    if layout == "sorted_planned":
+        from hyperspace_tpu.data.graphs import prepare, to_device
+
+        rng = np.random.default_rng(7)
+        edges = rng.integers(0, n, (48, 2)).astype(np.int32)
+        g = to_device(prepare(edges, n, np.asarray(x)))
+        x_dev = g.x
+    else:
+        s, r, mask = _tiny_graph(n, e=96, seed=7)
+        g = _dg(x, s, r, mask, n)
+        x_dev = x
+    conv32 = HGCConv(features=8, kind="lorentz")
+    convbf = HGCConv(features=8, kind="lorentz", agg_dtype=jnp.bfloat16)
+    params = conv32.init(jax.random.PRNGKey(8), x_dev, g)
+    y32, m_out = conv32.apply(params, x_dev, g)
+    ybf, _ = convbf.apply(params, x_dev, g)
+    assert ybf.dtype == y32.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(ybf), np.asarray(y32),
+                               rtol=0.0, atol=0.05)
+    assert float(jnp.max(m_out.check_point(ybf))) < 1e-5
