@@ -73,7 +73,9 @@ def run_hgcn_bench(
     cfg = hgcn.HGCNConfig(
         feat_dim=x.shape[1], hidden_dims=(128, 32), kind="lorentz",
         dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
-        agg_dtype=jnp.bfloat16 if agg_dtype == "bfloat16" else None)
+        # explicit f32 (not None): "--agg-dtype float32" must force f32
+        # messages even when the compute dtype is bf16
+        agg_dtype=jnp.bfloat16 if agg_dtype == "bfloat16" else jnp.float32)
     model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
     ga = hgcn._device_graph(split.graph)
     train_pos = jnp.asarray(split.train_pos)

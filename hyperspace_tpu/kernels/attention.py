@@ -69,8 +69,8 @@ def _attn_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, o_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     c = c_ref[0, 0]
-    beta = beta_ref[pl.program_id(0), 0]
-    tau = tau_ref[pl.program_id(0), 0]
+    beta = beta_ref[pl.program_id(0)]
+    tau = tau_ref[pl.program_id(0)]
     nk = nk_ref[0, 0]
     q = q_ref[0].astype(jnp.float32)   # [bq, dp]
     k = k_ref[0].astype(jnp.float32)   # [bk, dp]
@@ -134,9 +134,13 @@ def _launch(q, k, v, c, beta_b, tau_b, maskf, mode_):
     grid = (b, nq_p // bq, nk_p // bk)
 
     smem = lambda idx: pl.BlockSpec((1, 1), idx, memory_space=pltpu.SMEM)
-    # β/τ ride whole in SMEM (Mosaic rejects per-row blocks of a [B, 1]
-    # array); the body indexes them with program_id(0)
-    per_b = pl.BlockSpec((b, 1), lambda ib, iq, ik: (0, 0), memory_space=pltpu.SMEM)
+    # β/τ ride whole in SMEM as flat 1-D [B] arrays (4 B per entry; the
+    # body picks its entry with program_id).  A 2-D [B, 1] SMEM window
+    # pads every row to a 512 B sublane and blows the 1 MB SMEM budget
+    # once B ≈ 1k (B = batch×heads at eval); Mosaic only allows rank-1
+    # blocks that span the whole array, which is exactly what we want.
+    per_b = pl.BlockSpec((b,), lambda ib, iq, ik: (0,),
+                         memory_space=pltpu.SMEM)
     in_specs = [
         smem(lambda ib, iq, ik: (0, 0)),                   # c
         smem(lambda ib, iq, ik: (0, 0)),                   # nk
@@ -147,7 +151,7 @@ def _launch(q, k, v, c, beta_b, tau_b, maskf, mode_):
         pl.BlockSpec((1, bk, dp), lambda ib, iq, ik: (ib, ik, 0), memory_space=pltpu.VMEM),
     ]
     args = [S.c_smem(c), jnp.asarray(nk, jnp.int32).reshape(1, 1),
-            beta_b.reshape(b, 1), tau_b.reshape(b, 1), qp, kp, vp]
+            beta_b.reshape(b), tau_b.reshape(b), qp, kp, vp]
     masked = maskf is not None
     if masked:
         mp = S.pad_axis(S.pad_axis(maskf.astype(jnp.float32), -1, bk), -2, bq)

@@ -84,6 +84,13 @@ def main():
     oks.append(run("flash_attention",
                    lambda: K.flash_attention(q, kk, kk, c)))
 
+    # large batch×heads: regression for the β/τ SMEM windowing (a whole
+    # [B, 1] SMEM block overflowed the 1 MB budget at B ≈ 1k)
+    qb = lor.random_normal(ks[12], (1024, 32, 17), jnp.float32, std=0.3)
+    kb = lor.random_normal(ks[13], (1024, 32, 17), jnp.float32, std=0.3)
+    oks.append(run("flash_attention_B1024",
+                   lambda: K.flash_attention(qb, kb, kb, c)))
+
     rng = np.random.default_rng(0)
     recv = np.sort(rng.integers(0, 200, 1024)).astype(np.int32)
     vals = jnp.asarray(rng.normal(size=(1024, 64)).astype(np.float32))
