@@ -166,3 +166,91 @@ def csr_segment_sum(
     out = _pallas_csr(vals, recv2d, tuple(plan), num_segments, bn, bk,
                       S.interpret_flag(m))
     return out[:num_segments, :f].astype(values.dtype)
+
+
+# --- scalar (per-edge) segment reductions -------------------------------------
+
+
+NEG_FILL = -3.0e38  # f32-safe -inf stand-in (finite so max-accumulate stays exact;
+# nn.gcn imports it for the matching empty-segment threshold)
+
+
+def _body_1d(bn: int, op: str):
+    init = 0.0 if op == "sum" else NEG_FILL
+
+    def body(blk_ref, chk_ref, first_ref, recv_ref, vals_ref, o_ref):
+        t = pl.program_id(0)
+        b = blk_ref[t]
+
+        @pl.when(first_ref[t] == 1)
+        def _():
+            o_ref[:] = jnp.full_like(o_ref, init)
+
+        recv = recv_ref[0]                        # [bk//128, 128] int32
+        vals = vals_ref[0].astype(jnp.float32)    # [bk//128, 128]
+        local = recv - b * bn
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bn, 128), 0)
+        acc = o_ref[:]
+        # lane-partial accumulation: each 128-edge sub-chunk contributes a
+        # [bn, 128] select; the per-row combine over lanes happens once,
+        # outside the kernel (an XLA row reduction of [n_pad, 128])
+        for j in range(recv.shape[0]):
+            sel = jnp.where(rows == local[j : j + 1, :],
+                            jnp.broadcast_to(vals[j : j + 1, :], (bn, 128)),
+                            init)
+            acc = acc + sel if op == "sum" else jnp.maximum(acc, sel)
+        o_ref[:] = acc
+
+    return body
+
+
+def csr_segment_reduce_1d(
+    values: jax.Array,     # [E] per-edge scalars (0 / -inf-safe on padding)
+    receivers: jax.Array,  # [E] int32, sorted ascending
+    plan: tuple,           # CsrPlan device arrays (block, chunk, first)
+    num_segments: int,
+    op: str = "sum",
+) -> jax.Array:
+    """Per-segment scalar ``sum`` or ``max`` via the block-CSR plan.
+
+    The matmul trick doesn't apply to scalars (and padding a [E] column to
+    128 lanes would 128x the HBM traffic), so the kernel keeps a [bn, 128]
+    lane-partial accumulator per node block and the final 128-lane combine
+    runs as one XLA row-reduction.  Replaces XLA's serialized scalar
+    scatter (~0.8 s at 2.4 M edges) in segment-softmax attention.
+    """
+    assert op in ("sum", "max"), op
+    m = S.mode()
+    if m == "xla":
+        f = jax.ops.segment_sum if op == "sum" else jax.ops.segment_max
+        return f(values, receivers, num_segments, indices_are_sorted=True)
+    e = values.shape[0]
+    bn, bk = _BN, _BK
+    e_pad = S.round_up(e, bk)
+    fill = 0.0 if op == "sum" else NEG_FILL
+    v = jnp.pad(values.astype(jnp.float32), (0, e_pad - e),
+                constant_values=fill)
+    v2d = v.reshape(e_pad // bk, bk // 128, 128)
+    recv2d = S.pad_axis(receivers, 0, bk).reshape(e_pad // bk, bk // 128, 128)
+    t = plan[0].shape[0]
+    n_pad = S.round_up(num_segments, bn)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, bk // 128, 128),
+                         lambda t, blk, chk, first: (chk[t], 0, 0)),
+            pl.BlockSpec((1, bk // 128, 128),
+                         lambda t, blk, chk, first: (chk[t], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, 128),
+                               lambda t, blk, chk, first: (blk[t], 0)),
+    )
+    out = pl.pallas_call(
+        _body_1d(bn, op),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_pad, 128), jnp.float32),
+        interpret=S.interpret_flag(m),
+    )(*tuple(plan), recv2d, v2d)
+    red = jnp.sum(out, axis=-1) if op == "sum" else jnp.max(out, axis=-1)
+    return red[:num_segments].astype(values.dtype)

@@ -73,3 +73,29 @@ def test_plan_chunks_in_range_for_empty_trailing_blocks(rng, interp):
     want = jax.ops.segment_sum(jnp.asarray(vals), jnp.asarray(r), n)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("op", ["sum", "max"])
+def test_csr_segment_reduce_1d_parity(op, monkeypatch):
+    """Scalar per-segment sum/max kernel == jax.ops reference (interpret)."""
+    from hyperspace_tpu.kernels.segment import (
+        build_csr_plan,
+        csr_segment_reduce_1d,
+    )
+
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "interpret")
+    rng = np.random.default_rng(3)
+    n, e = 300, 2048
+    recv = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    vals = jnp.asarray(rng.normal(size=e).astype(np.float32))
+    plan = tuple(jnp.asarray(a) for a in build_csr_plan(recv, n))
+    got = csr_segment_reduce_1d(vals, jnp.asarray(recv), plan, n, op=op)
+    ref_f = jax.ops.segment_sum if op == "sum" else jax.ops.segment_max
+    ref = ref_f(vals, jnp.asarray(recv), n, indices_are_sorted=True)
+    if op == "max":
+        # empty segments: kernel yields the -inf stand-in, ref yields -inf
+        got = np.where(np.asarray(got) < -1e37, -np.inf, np.asarray(got))
+        ref = np.where(np.isinf(np.asarray(ref)) | (np.asarray(ref) < -1e37),
+                       -np.inf, np.asarray(ref))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
