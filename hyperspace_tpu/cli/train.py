@@ -130,11 +130,21 @@ def run_hgcn(run: RunConfig, overrides: dict):
         split = G.split_edges(edges, num_nodes, x, seed=run.seed)
         model, opt, state = hgcn.init_lp(cfg, split.graph, seed=run.seed)
         ga = hgcn._device_graph(split.graph)
-        train_pos = jnp.asarray(split.train_pos)
-        state, loss = _train_loop(
-            run, state,
-            lambda st: hgcn.train_step_lp(model, opt, num_nodes, st, ga,
-                                          train_pos))
+        from hyperspace_tpu.parallel.mesh import auto_mesh
+
+        mesh = auto_mesh(run.multihost, tp=2)
+        if mesh is not None:
+            train_pos = jnp.asarray(hgcn.round_up_pairs(split.train_pos, mesh))
+            step, state, ga = hgcn.make_sharded_step_lp(
+                model, opt, num_nodes, mesh, state, ga)
+            state, loss = _train_loop(
+                run, state, lambda st: step(st, ga, train_pos))
+        else:
+            train_pos = jnp.asarray(split.train_pos)
+            state, loss = _train_loop(
+                run, state,
+                lambda st: hgcn.train_step_lp(model, opt, num_nodes, st, ga,
+                                              train_pos))
         res = {"loss": float(loss),
                **hgcn.evaluate_lp(model, state.params, split, "test", ga=ga)}
     else:
@@ -204,7 +214,7 @@ def run_hvae(run: RunConfig, overrides: dict):
 def run_product(run: RunConfig, overrides: dict):
     from hyperspace_tpu.data import wordnet
     from hyperspace_tpu.models import product_embed as pme
-    from hyperspace_tpu.parallel.mesh import make_mesh, multihost_mesh
+    from hyperspace_tpu.parallel.mesh import auto_mesh
 
     if run.data_root:
         ds = wordnet.load_closure_tsv(run.data_root)
@@ -214,12 +224,8 @@ def run_product(run: RunConfig, overrides: dict):
         pme.ProductEmbedConfig(num_nodes=ds.num_nodes), overrides)
     state, curv_opt = pme.init_state(cfg, run.seed)
     pairs = jnp.asarray(ds.pairs)
-    if run.multihost:
-        mesh = multihost_mesh()
-        step = pme.make_sharded_step(cfg, curv_opt, mesh)
-        stepper = lambda st: step(st, pairs)
-    elif len(jax.devices()) > 1:
-        mesh = make_mesh({"data": len(jax.devices())})
+    mesh = auto_mesh(run.multihost)
+    if mesh is not None:
         step = pme.make_sharded_step(cfg, curv_opt, mesh)
         stepper = lambda st: step(st, pairs)
     else:

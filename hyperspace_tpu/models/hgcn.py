@@ -178,19 +178,17 @@ def init_lp(cfg: HGCNConfig, g: graph_data.Graph, seed: int = 0):
     return model, opt, state
 
 
-@partial(jax.jit, static_argnames=("model", "opt", "num_nodes"), donate_argnames=("state",))
-def train_step_lp(
-    model: HGCNLinkPred,
-    opt,
-    num_nodes: int,
-    state: TrainState,
-    g: graph_data.DeviceGraph,
-    train_pos: jax.Array,  # [P, 2]
-):
-    """One LP step: sample negatives on device, BCE on pos+neg logits."""
+def _lp_step_impl(model, opt, num_nodes, state, g, train_pos, constrain=None):
+    """Shared LP step body: sample negatives on device, BCE on pos+neg
+    logits.  ``constrain`` (optional) pins the supervision batch's sharding
+    (GSPMD hint) — the only difference between the single-device and the
+    mesh-sharded step, so both jit wrappers compile this same program."""
     key, k_neg, k_drop = jax.random.split(state.key, 3)
     n_neg = train_pos.shape[0] * model.cfg.neg_per_pos
     neg = jax.random.randint(k_neg, (n_neg, 2), 0, num_nodes)
+    if constrain is not None:
+        train_pos = constrain(train_pos)
+        neg = constrain(neg)
 
     def loss_fn(params):
         pairs = jnp.concatenate([train_pos, neg], axis=0)
@@ -207,6 +205,19 @@ def train_step_lp(
     updates, opt_state = opt.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
     return TrainState(params, opt_state, key, state.step + 1), loss
+
+
+@partial(jax.jit, static_argnames=("model", "opt", "num_nodes"), donate_argnames=("state",))
+def train_step_lp(
+    model: HGCNLinkPred,
+    opt,
+    num_nodes: int,
+    state: TrainState,
+    g: graph_data.DeviceGraph,
+    train_pos: jax.Array,  # [P, 2]
+):
+    """One LP step: sample negatives on device, BCE on pos+neg logits."""
+    return _lp_step_impl(model, opt, num_nodes, state, g, train_pos)
 
 
 def make_static_negatives(num_nodes: int, n_neg: int, seed: int = 0):
@@ -254,6 +265,58 @@ def train_step_lp_planned(
     updates, opt_state = opt.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
     return TrainState(params, opt_state, key, state.step + 1), loss
+
+
+def round_up_pairs(pairs: np.ndarray, mesh) -> np.ndarray:
+    """Resize a [P, 2] supervision batch to a multiple of the mesh's
+    data-axis extent (GSPMD needs the sharded axis divisible).  Repeats
+    the leading edges cyclically — a negligible reweighting of a batch
+    that already covers every positive edge each step."""
+    d = int(np.prod([mesh.shape[a] for a in ("host", "data")
+                     if a in mesh.axis_names]))
+    n = -(-pairs.shape[0] // d) * d
+    return np.resize(np.asarray(pairs), (n, 2))
+
+
+def make_sharded_step_lp(
+    model: HGCNLinkPred,
+    opt,
+    num_nodes: int,
+    mesh,
+    state: TrainState,
+    g: graph_data.DeviceGraph,
+):
+    """Build a dp×tp LP train step jitted over ``mesh`` (SURVEY.md §2 N8).
+
+    Compiles the *same* step body as `train_step_lp` with GSPMD shardings:
+    the supervision batch (positives + sampled negatives) is sharded over
+    the data-like mesh axes, so the gradient all-reduce XLA inserts is the
+    NCCL all-reduce of the reference's trainer riding ICI; 2-D kernels are
+    column-sharded over the ``model`` axis when present
+    (`parallel/tp.tp_param_shardings`); optimizer moments are co-located
+    with their parameter shards; the graph itself is replicated.
+
+    Returns ``(step, placed_state, placed_graph)`` — call as
+    ``state, loss = step(state, g, train_pos)``; ``state`` is donated.
+    """
+    from hyperspace_tpu.parallel.mesh import batch_sharding, replicated
+    from hyperspace_tpu.parallel.tp import replicated_like, state_shardings
+
+    state_sh = state_shardings(state, state.params, mesh)
+    g_sh = replicated_like(g, mesh)
+    bsh = batch_sharding(mesh, ndim=2)
+    constrain = lambda x: jax.lax.with_sharding_constraint(x, bsh)
+
+    # batch enters replicated and is constrained *in-program* (like
+    # product_embed.make_sharded_step): a partitioned in_sharding would
+    # reject process-local arrays on a multi-host mesh
+    step = jax.jit(
+        partial(_lp_step_impl, model, opt, num_nodes, constrain=constrain),
+        in_shardings=(state_sh, g_sh, replicated(mesh)),
+        out_shardings=(state_sh, replicated(mesh)),
+        donate_argnums=(0,),
+    )
+    return step, jax.device_put(state, state_sh), jax.device_put(g, g_sh)
 
 
 @partial(jax.jit, static_argnames=("model",))

@@ -62,6 +62,20 @@ def multihost_mesh(axes: dict[str, int] | None = None) -> Mesh:
     return make_mesh({"host": n_hosts, **inner})
 
 
+def auto_mesh(multihost: bool = False, tp: int = 1) -> Optional[Mesh]:
+    """Mesh selection shared by the CLI runners: the multi-host mesh when
+    requested, a data(-×model) mesh over all local devices when there is
+    more than one, else ``None`` (caller takes its single-device path)."""
+    if multihost:
+        return multihost_mesh()
+    n = len(jax.devices())
+    if n <= 1:
+        return None
+    if tp > 1 and n > tp and n % tp == 0:
+        return make_mesh({"data": n // tp, "model": tp})
+    return make_mesh({"data": n})
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
