@@ -107,8 +107,9 @@ def run_poincare(run: RunConfig, overrides: dict):
     from hyperspace_tpu.manifolds import PoincareBall
 
     ball = PoincareBall(cfg.c)
+    step_fn = pe.make_train_step(cfg)
     state, _ = _train_loop(run, state,
-                           lambda st: pe.train_step(cfg, opt, st, pairs),
+                           lambda st: step_fn(cfg, opt, st, pairs),
                            project=lambda st: st._replace(table=ball.proj(st.table)))
     res = pe.evaluate(state.table, ds.pairs, cfg.c)
     return {"workload": "poincare", "steps": run.steps, **res}

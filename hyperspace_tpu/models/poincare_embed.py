@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import optax
 
 from hyperspace_tpu.manifolds import PoincareBall
+from hyperspace_tpu.optim.radam import RAdamState, riemannian_adam
 from hyperspace_tpu.optim.rsgd import riemannian_sgd
 
 
@@ -38,6 +39,13 @@ class PoincareEmbedConfig:
     burnin_factor: float = 0.01
     init_scale: float = 1e-3
     dtype: Any = jnp.float32
+    # "rsgd" (Nickel & Kiela) or "radam" (Bécigneul & Ganea transported
+    # moments) — both run inside the same single XLA-compiled train step
+    optimizer: str = "rsgd"
+    # sparse=True uses train_step_sparse: only the rows a batch touches are
+    # gathered, updated and scattered back (SURVEY.md §7 hard-part #2) —
+    # O(B·(2+K)·d) update work instead of O(N·d)
+    sparse: bool = False
 
 
 class TrainState(NamedTuple):
@@ -57,6 +65,15 @@ def init_table(cfg: PoincareEmbedConfig, key: jax.Array) -> jax.Array:
 
 def make_optimizer(cfg: PoincareEmbedConfig):
     ball = PoincareBall(cfg.c)
+    if cfg.optimizer == "radam":
+        # burn-in as a schedule (radam has no native burn-in knob)
+        lr = cfg.lr
+        if cfg.burnin_steps > 0:
+            factor, steps = cfg.burnin_factor, cfg.burnin_steps
+            lr = lambda n: cfg.lr * jnp.where(n < steps, factor, 1.0)
+        return riemannian_adam(lr, tags=ball)
+    if cfg.optimizer != "rsgd":
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     return riemannian_sgd(
         cfg.lr,
         tags=ball,  # single-leaf param tree: the whole table is on the ball
@@ -109,6 +126,89 @@ def train_step(
     updates, opt_state = opt.update(grads, state.opt_state, state.table)
     table = optax.apply_updates(state.table, updates)
     return TrainState(table, opt_state, key, state.step + 1), loss
+
+
+@partial(jax.jit, static_argnames=("cfg", "opt"), donate_argnames=("state",))
+def train_step_sparse(
+    cfg: PoincareEmbedConfig,
+    opt,
+    state: TrainState,
+    pairs: jax.Array,  # [P, 2] the full closure, resident on device
+) -> tuple[TrainState, jax.Array]:
+    """Sparse-row variant of `train_step` (SURVEY.md §7 hard-part #2).
+
+    The dense step differentiates a gather into a full [N, d] cotangent and
+    expmaps the whole table; fine at WordNet scale, ruinous for arxiv-scale
+    tables.  Here the batch's unique touched rows (≤ B·(2+K), static shape)
+    are gathered, the loss is computed on the gathered sub-table, and only
+    those rows are updated and scattered back — update work is O(B·(2+K)·d)
+    regardless of N.  TPU mechanics: `jnp.unique(..., size=...)` keeps the
+    shape static; sentinel-padded slots point one past the table, gather
+    clips them (their gradient is identically zero) and the final scatter
+    uses ``mode="drop"`` so they never write back.
+
+    Optimizer-state semantics for stateful optimizers (radam): moment rows
+    are gathered/updated/scattered with the same index set — untouched rows
+    keep stale moments ("lazy" sparse Adam, geoopt's
+    SparseRiemannianAdam/torch SparseAdam semantics), while bias correction
+    uses the global step count.  For rsgd the sparse step is mathematically
+    identical to the dense one (untouched rows: expmap(x, 0) = x).
+    """
+    key, k_batch, k_neg = jax.random.split(state.key, 3)
+    num_pairs = pairs.shape[0]
+    rows_sel = jax.random.randint(k_batch, (cfg.batch_size,), 0, num_pairs)
+    batch = pairs[rows_sel]  # [B, 2]
+    u_idx, v_idx = batch[:, 0], batch[:, 1]
+    neg_idx = jax.random.randint(
+        k_neg, (cfg.batch_size, cfg.neg_samples), 0, cfg.num_nodes
+    )
+
+    all_idx = jnp.concatenate([u_idx, v_idx, neg_idx.reshape(-1)])
+    uniq = jnp.unique(all_idx, size=all_idx.shape[0],
+                      fill_value=cfg.num_nodes)  # sorted; sentinel-padded
+    sub = lambda i: jnp.searchsorted(uniq, i)  # global id -> slot in uniq
+    rows = state.table[jnp.minimum(uniq, cfg.num_nodes - 1)]  # [U, d]
+
+    def sub_loss(rows):
+        ball = PoincareBall(cfg.c)
+        u = rows[sub(u_idx)]
+        cand = jnp.concatenate([v_idx[:, None], neg_idx], axis=1)
+        cv = rows[sub(cand)]
+        d = ball.dist(u[:, None, :], cv)
+        logits = -d
+        collide = (neg_idx == v_idx[:, None]) | (neg_idx == u_idx[:, None])
+        mask = jnp.concatenate(
+            [jnp.zeros_like(v_idx[:, None], bool), collide], axis=1)
+        logits = jnp.where(mask, -jnp.inf, logits)
+        return jnp.mean(jax.nn.logsumexp(logits, axis=1) - logits[:, 0])
+
+    loss, g_rows = jax.value_and_grad(sub_loss)(rows)
+
+    # run the optimizer transform on the gathered rows; gather/scatter any
+    # per-row optimizer state (radam moments) with the same index set
+    opt_state = state.opt_state
+    if isinstance(opt_state, RAdamState):
+        row_state = RAdamState(
+            count=opt_state.count,
+            mu=opt_state.mu[jnp.minimum(uniq, cfg.num_nodes - 1)],
+            nu=opt_state.nu[jnp.minimum(uniq, cfg.num_nodes - 1)],
+        )
+        updates, row_state = opt.update(g_rows, row_state, rows)
+        new_opt_state = RAdamState(
+            count=row_state.count,
+            mu=opt_state.mu.at[uniq].set(row_state.mu, mode="drop"),
+            nu=opt_state.nu.at[uniq].set(row_state.nu, mode="drop"),
+        )
+    else:  # stateless-per-row (rsgd: count only)
+        updates, new_opt_state = opt.update(g_rows, opt_state, rows)
+    new_rows = optax.apply_updates(rows, updates)
+    table = state.table.at[uniq].set(new_rows, mode="drop")
+    return TrainState(table, new_opt_state, key, state.step + 1), loss
+
+
+def make_train_step(cfg: PoincareEmbedConfig):
+    """The configured step function: ``f(cfg, opt, state, pairs)``."""
+    return train_step_sparse if cfg.sparse else train_step
 
 
 def init_state(cfg: PoincareEmbedConfig, seed: int = 0) -> tuple[TrainState, optax.GradientTransformation]:

@@ -67,6 +67,10 @@ def auto_mesh(multihost: bool = False, tp: int = 1) -> Optional[Mesh]:
     requested, a data(-×model) mesh over all local devices when there is
     more than one, else ``None`` (caller takes its single-device path)."""
     if multihost:
+        per_host = jax.local_device_count()
+        if tp > 1 and per_host > tp and per_host % tp == 0:
+            # tp stays intra-host so its collectives ride ICI, not DCN
+            return multihost_mesh({"data": per_host // tp, "model": tp})
         return multihost_mesh()
     n = len(jax.devices())
     if n <= 1:

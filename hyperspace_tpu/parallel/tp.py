@@ -68,18 +68,22 @@ def state_shardings(state: Any, params: Any, mesh: Mesh,
     ``encoder.conv0.kernel``) takes that parameter's TP spec; everything
     else (counts, PRNG keys, step counters) is replicated.
     """
+    if axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        return replicated_like(state, mesh)
+
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
-    by_path = {tuple(_path_names(p)): tp_param_spec(p, l, axis)
-               for p, l in flat}
+    # longest-suffix-first so a param path that is itself a suffix of
+    # another's can never shadow the longer match
+    by_path = sorted(
+        ((tuple(_path_names(p)), tp_param_spec(p, l, axis)) for p, l in flat),
+        key=lambda kv: -len(kv[0]))
 
     def spec_for(path, leaf):
         names = tuple(_path_names(path))
-        for ppath, spec in by_path.items():
+        for ppath, spec in by_path:
             if len(names) >= len(ppath) and names[-len(ppath):] == ppath:
                 return spec
         return P()
 
-    if axis not in mesh.axis_names or mesh.shape[axis] == 1:
-        return replicated_like(state, mesh)
     return jax.tree_util.tree_map_with_path(
         lambda p, l: NamedSharding(mesh, spec_for(p, l)), state)
