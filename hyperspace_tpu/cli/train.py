@@ -290,11 +290,16 @@ def _train_loop(run: RunConfig, state, stepper, project=None):
             _logger(run) as log:
         if ck is not None and run.resume and ck.latest_step() is not None:
             state, start = ck.restore(state, project=project)
+        last_saved = None
         for i in range(start, run.steps):
             state, loss = stepper(state)
             _maybe_log(log, run, i, loss)
-            if ck is not None:
-                ck.save(i + 1, state)
+            if ck is not None and ck.save(i + 1, state):
+                last_saved = i + 1
+        if ck is not None and start < run.steps and last_saved != run.steps:
+            # the final state must land even when steps % ckpt_every != 0 —
+            # otherwise resume silently replays up to ckpt_every-1 steps
+            ck.save(run.steps, state, force=True)
     return state, loss
 
 

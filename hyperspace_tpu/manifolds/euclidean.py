@@ -15,6 +15,7 @@ from hyperspace_tpu.manifolds.base import Manifold
 @dataclasses.dataclass
 class Euclidean(Manifold):
     name = "euclidean"
+    c = 0.0  # curvature, for API uniformity with the curved manifolds
 
     def tree_flatten(self):
         return (), None

@@ -141,6 +141,8 @@ class HGCNNodeClf(nn.Module):
         z, m = HGCNEncoder(self.cfg, name="encoder")(
             g, deterministic=deterministic
         )
+        if self.cfg.kind == "euclidean":  # flat control: plain linear head
+            return nn.Dense(self.cfg.num_classes, name="head")(z)
         head = LorentzMLR if self.cfg.kind == "lorentz" else HypMLR
         return head(self.cfg.num_classes, m, name="head")(z)
 

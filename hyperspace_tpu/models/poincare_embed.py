@@ -194,15 +194,21 @@ def train_step_sparse(
             nu=opt_state.nu[jnp.minimum(uniq, cfg.num_nodes - 1)],
         )
         updates, row_state = opt.update(g_rows, row_state, rows)
+        # explicit casts: under x64 the bias-corrected moments come back
+        # f64; scattering them into the f32 state arrays must not rely on
+        # implicit (and soon-to-be-removed) scatter dtype promotion
         new_opt_state = RAdamState(
             count=row_state.count,
-            mu=opt_state.mu.at[uniq].set(row_state.mu, mode="drop"),
-            nu=opt_state.nu.at[uniq].set(row_state.nu, mode="drop"),
+            mu=opt_state.mu.at[uniq].set(
+                row_state.mu.astype(opt_state.mu.dtype), mode="drop"),
+            nu=opt_state.nu.at[uniq].set(
+                row_state.nu.astype(opt_state.nu.dtype), mode="drop"),
         )
     else:  # stateless-per-row (rsgd: count only)
         updates, new_opt_state = opt.update(g_rows, opt_state, rows)
     new_rows = optax.apply_updates(rows, updates)
-    table = state.table.at[uniq].set(new_rows, mode="drop")
+    table = state.table.at[uniq].set(
+        new_rows.astype(state.table.dtype), mode="drop")
     return TrainState(table, new_opt_state, key, state.step + 1), loss
 
 

@@ -93,6 +93,13 @@ def make_manifold(kind: str, c) -> Any:
         return Lorentz(c)
     if kind == "poincare":
         return PoincareBall(c)
+    if kind == "euclidean":
+        # flat control (c is ignored): the same HGCConv becomes a plain
+        # GCN — tangent0 charts are identities — giving the
+        # hyperbolic-vs-Euclidean quality comparison a shared codepath
+        from hyperspace_tpu.manifolds import Euclidean
+
+        return Euclidean()
     raise ValueError(f"unknown manifold kind {kind!r}")
 
 
@@ -154,6 +161,7 @@ class HGCConv(nn.Module):
 
         sorted_fast = g.rev_perm is not None
         w_static = False
+        den_planned = False  # planned softmax: denominator folded post-agg
         if self.use_att:
             # GAT-style additive attention in the tangent chart.
             a_s = self.param("att_src", self.kernel_init, (self.features, 1), h.dtype)
@@ -188,10 +196,11 @@ class HGCConv(nn.Module):
                                                  pb_, pc_, pf_, n)
                 seg_max = jnp.where(seg_max > 0.5 * _NEG, seg_max, 0.0)
                 # out = (Σ ex·h) / (Σ ex): invariant to the (stopped) max
-                # shift, so autodiff through ex gives the exact softmax grad
+                # shift, so autodiff through ex gives the exact softmax grad.
+                # The denominator is summed *after* the agg_dtype cast below
+                # so numerator and denominator see identically-rounded weights
                 w = jnp.exp(lm - seg_max[receivers]) * maskf
-                att_den = planned_segment_sum_1d(w, receivers,
-                                                 pb_, pc_, pf_, n)
+                den_planned = True
             else:
                 logits = nn.leaky_relu(
                     alpha_s[senders] + alpha_r[receivers], 0.2)
@@ -212,6 +221,8 @@ class HGCConv(nn.Module):
             att_den = None
         h_in = h if self.agg_dtype is None else h.astype(self.agg_dtype)
         w_in = w if self.agg_dtype is None else w.astype(self.agg_dtype)
+        if den_planned:  # the CSR scalar kernel accumulates f32
+            att_den = planned_segment_sum_1d(w_in, receivers, pb_, pc_, pf_, n)
         if sorted_fast:
             # receiver-sorted scatter in forward AND backward (nn/scatter.py)
             pb, pc, pf = g.plan if g.plan is not None else (None, None, None)

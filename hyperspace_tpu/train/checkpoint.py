@@ -44,9 +44,14 @@ class CheckpointManager:
         )
         self._mgr = ocp.CheckpointManager(self._dir, options=options)
 
-    def save(self, step: int, state: Any) -> bool:
-        """Maybe-save (interval-gated); returns True if a save started."""
-        return self._mgr.save(step, args=ocp.args.StandardSave(state))
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Maybe-save (interval-gated); returns True if a save started.
+
+        ``force=True`` bypasses the interval gate — used for the final
+        step of a run, which must always land on disk regardless of
+        where it falls in the save cadence."""
+        return self._mgr.save(step, args=ocp.args.StandardSave(state),
+                              force=force)
 
     def restore(
         self,

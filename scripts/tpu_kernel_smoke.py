@@ -99,6 +99,18 @@ def main():
     oks.append(run("csr_segment_sum",
                    lambda: csr_segment_sum(vals, recv_d, plan, 200)))
 
+    # scalar CSR reductions: the lane-partial accumulator layout is exactly
+    # what interpret mode can't exercise — real-chip parity matters here
+    from hyperspace_tpu.kernels.segment import csr_segment_reduce_1d
+
+    svals = jnp.asarray(rng.normal(size=(1024,)).astype(np.float32))
+    oks.append(run("csr_segment_reduce_1d_sum",
+                   lambda: csr_segment_reduce_1d(svals, recv_d, plan, 200,
+                                                 op="sum")))
+    oks.append(run("csr_segment_reduce_1d_max",
+                   lambda: csr_segment_reduce_1d(svals, recv_d, plan, 200,
+                                                 op="max")))
+
     print(json.dumps({"all_ok": all(oks), "backend": jax.default_backend()}),
           flush=True)
     sys.exit(0 if all(oks) else 1)

@@ -1,6 +1,7 @@
 """Workload-1 integration test (SURVEY.md §4.7): recover a small tree's
 hierarchy with Poincaré embeddings to high MAP."""
 
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -22,6 +23,7 @@ def test_synthetic_tree_counts():
     assert ds.num_pairs == 2 * 1 + 4 * 2
 
 
+@pytest.mark.slow
 def test_poincare_embed_recovers_tree():
     ds = synthetic_tree(depth=3, branching=2)  # 15 nodes
     cfg = pe.PoincareEmbedConfig(

@@ -89,6 +89,7 @@ def test_output_on_hyperboloid(rng, interp):
     np.testing.assert_allclose(mink, -1.0 / c, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_gradients_match_dense(rng):
     c = 1.0
     q = hyperboloid_points(rng, (1, 12, 5), c).astype(jnp.float64)

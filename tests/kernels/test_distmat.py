@@ -60,6 +60,7 @@ def test_twin_matches_manifold_dist(rng):
                                rtol=1e-9, atol=1e-9)
 
 
+@pytest.mark.slow
 def test_pdist_gradients(interp, rng):
     c = 1.0
     x = _ball_points(rng, (6, 4), c)

@@ -67,6 +67,7 @@ def test_batched_leading_dims(rng, interp):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gradients_match_twin(rng):
     c = 1.0
     x = ball_points(rng, (9, 10), c).astype(jnp.float64)

@@ -32,6 +32,7 @@ def test_twin_matches_naive_f64(rng, c):
     np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10)
 
 
+@pytest.mark.slow
 def test_twin_matches_naive_batched(rng):
     c = 1.0
     x = ball_points(rng, (4, 5, 10), c).astype(jnp.float64)
@@ -54,6 +55,7 @@ def test_kernel_matches_twin(rng, interp, n, k, d):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gradients_match_naive(rng):
     c = 1.0
     x, p, a = _case(rng, 9, 4, 10, c, jnp.float64)

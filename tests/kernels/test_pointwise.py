@@ -80,6 +80,7 @@ def test_broadcasting_and_batch_dims(interp, rng):
     assert out.shape == x.shape
 
 
+@pytest.mark.slow
 def test_gradients_flow_through_twin(interp, rng):
     """custom_vjp backward == direct autodiff of the manifold method."""
     c = 1.0

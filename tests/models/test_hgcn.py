@@ -50,6 +50,23 @@ def test_split_edges_no_leak():
 
 
 @pytest.mark.slow
+def test_hyperbolic_not_worse_than_euclidean_control_on_hierarchy():
+    """VERDICT r1 #4a: the same HGCConv stack with kind="euclidean" is a
+    plain GCN; on hierarchical data the hyperbolic model must not lose
+    (scripts/euclidean_control.py measured +0.012 mean AUC over 3 seeds
+    at 4k nodes — this pins one smaller config with slack for noise)."""
+    aucs = {}
+    for kind in ("lorentz", "euclidean"):
+        edges, x, labels, k = G.synthetic_hierarchy(
+            num_nodes=1024, feat_dim=16, ancestor_hops=4, seed=1)
+        split = G.split_edges(edges, 1024, x, seed=1)
+        cfg = hgcn.HGCNConfig(feat_dim=16, hidden_dims=(64, 16), kind=kind)
+        model, params, _ = hgcn.train_lp(cfg, split, steps=300, seed=1)
+        aucs[kind] = hgcn.evaluate_lp(model, params, split, "test")["roc_auc"]
+    assert aucs["lorentz"] >= aucs["euclidean"] - 0.01, aucs
+
+
+@pytest.mark.slow
 def test_hgcn_link_prediction_converges():
     edges, x, labels, k = G.synthetic_hierarchy(num_nodes=256, feat_dim=16, seed=0)
     split = G.split_edges(edges, 256, x, seed=0, pad_multiple=256)

@@ -38,6 +38,7 @@ def test_idx_roundtrip(tmp_path):
     assert list(ds.labels) == [3, 7]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kind", ["poincare", "lorentz"])
 def test_hvae_forward_and_latents_on_manifold(kind):
     cfg = hvae.HVAEConfig(image_size=16, latent_dim=3, hidden=32,

@@ -40,6 +40,7 @@ def _cfg(**kw):
     return pe.PoincareEmbedConfig(**base)
 
 
+@pytest.mark.slow
 def test_radam_dense_converges():
     cfg = _cfg(optimizer="radam", lr=0.05)
     state, loss = _train(cfg, 1500)
@@ -51,6 +52,7 @@ def test_radam_dense_converges():
     assert r < 1.0
 
 
+@pytest.mark.slow
 def test_radam_sparse_converges():
     cfg = _cfg(optimizer="radam", lr=0.05, sparse=True)
     state, loss = _train(cfg, 1500)
@@ -59,6 +61,7 @@ def test_radam_sparse_converges():
     assert res["map"] >= 0.85, res
 
 
+@pytest.mark.slow
 def test_sparse_rsgd_matches_dense():
     """Same seed, same PRNG stream → identical batches; sparse and dense
     rsgd must produce the same table to float tolerance."""

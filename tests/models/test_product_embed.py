@@ -19,6 +19,7 @@ def _cfg(n, **kw):
         batch_size=64, neg_samples=8, burnin_steps=20, **kw)
 
 
+@pytest.mark.slow
 def test_build_manifold_curvature_grad():
     cfg = _cfg(8)
     c_raw = jnp.zeros((2,))

@@ -70,6 +70,7 @@ def test_tiled_matches_dense_masked():
     np.testing.assert_allclose(np.asarray(tiled), np.asarray(dense), rtol=1e-8, atol=1e-10)
 
 
+@pytest.mark.slow
 def test_attention_mask_equals_dropped_keys():
     """Masking the tail keys == running attention on the truncated KV."""
     m = Lorentz(1.0)
@@ -93,6 +94,7 @@ def test_mha_module_shapes_and_manifold(use_tiled):
     assert float(jnp.max(m.check_point(y))) < 1e-8
 
 
+@pytest.mark.slow
 def test_mha_grads_finite():
     m = Lorentz(1.0)
     x = _pts(jax.random.PRNGKey(15), m, (1, 4, 9))

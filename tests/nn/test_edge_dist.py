@@ -19,6 +19,7 @@ def _graph(n=60, seed=0):
     return G.prepare(edges, n, x, pad_multiple=64)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kind", ["lorentz", "poincare"])
 def test_graph_edge_sqdist_matches_direct(kind, rng):
     g = _graph()
@@ -47,6 +48,7 @@ def test_graph_edge_sqdist_matches_direct(kind, rng):
     np.testing.assert_allclose(float(gc1), float(gc2), rtol=1e-9)
 
 
+@pytest.mark.slow
 def test_pair_sqdist_semi_planned_matches_direct(rng):
     n, p = 50, 200
     m = make_manifold("lorentz", 0.7)

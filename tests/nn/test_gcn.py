@@ -90,6 +90,7 @@ def test_hgcconv_padding_invariance(rng):
     np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-12, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_hgcconv_learned_curvature_grad():
     """learn_c exposes a c_raw param that receives a gradient."""
     n = 4

@@ -1,5 +1,6 @@
 """Layer tests: outputs on-manifold, gradients finite, known reductions."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -82,6 +83,7 @@ def test_mlr_flat_limit_matches_euclidean_logit():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_hyp_mlr_module_and_grads():
     ball = PoincareBall(1.0)
     head = HypMLR(num_classes=7, manifold=ball)

@@ -83,6 +83,7 @@ def test_logprob_highest_at_loc_for_isotropic():
     assert float(lp_loc) >= float(jnp.max(dist.log_prob(z))) - 1e-9
 
 
+@pytest.mark.slow
 def test_reparameterized_gradients_flow_to_loc_and_scale():
     """∂/∂(loc,scale) of an expectation estimated with rsample is finite."""
     m = Lorentz(1.0)

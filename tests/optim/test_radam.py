@@ -101,6 +101,7 @@ def test_mixed_tree_and_jit():
     assert float(jnp.max(ball.check_point(params["emb"]))) == 0.0
 
 
+@pytest.mark.slow
 def test_retraction_mode():
     m = PoincareBall(1.0)
     x = m.random_normal(jax.random.PRNGKey(6), (3,), jnp.float64, std=0.3)
@@ -114,6 +115,7 @@ def test_retraction_mode():
     assert float(m.dist(x, target)) < 5e-2
 
 
+@pytest.mark.slow
 def test_stabilize_cadence():
     """stabilize_every: params stay exactly on-manifold and the first moment
     is exactly re-tangentialized on stabilize steps; convergence matches the

@@ -1,6 +1,7 @@
 """Optimizer tests (SURVEY.md §4.5): RSGD decreases an on-manifold objective
 and stays on the manifold; mixed Euclidean/manifold trees work via tags."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -33,6 +34,7 @@ def test_rsgd_converges_to_target_on_ball():
     assert losses[50] < losses[0] and losses[-1] < losses[50]
 
 
+@pytest.mark.slow
 def test_rsgd_stays_on_hyperboloid():
     lor = Lorentz(1.0)
     o = lor.origin((4, 5), jnp.float64)
@@ -48,6 +50,7 @@ def test_rsgd_stays_on_hyperboloid():
     assert float(jnp.max(lor.dist(x, target))) < 1e-3
 
 
+@pytest.mark.slow
 def test_rsgd_mixed_tree_euclidean_and_manifold():
     ball = PoincareBall(1.0)
     params = {

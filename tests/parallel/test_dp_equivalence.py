@@ -22,6 +22,7 @@ def _cfg(n):
         batch_size=64, neg_samples=4, lr_table=0.2, burnin_steps=0)
 
 
+@pytest.mark.slow
 def test_dp_mesh_matches_single_device():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")

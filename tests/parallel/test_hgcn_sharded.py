@@ -46,16 +46,16 @@ def _run_sharded(cfg, split, steps, axes, train_pos):
 
 
 @pytest.mark.parametrize("axes", [
-    {"data": 8},
-    {"data": 1, "model": 8},
-    {"data": 4, "model": 2},
-    {"host": 2, "data": 4},
+    pytest.param({"data": 8}, marks=pytest.mark.slow),
+    pytest.param({"data": 1, "model": 8}, marks=pytest.mark.slow),
+    {"data": 4, "model": 2},  # dp×tp — the fast-suite representative
+    pytest.param({"host": 2, "data": 4}, marks=pytest.mark.slow),
 ])
 def test_sharded_lp_matches_single_device(axes):
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
     cfg, split = _setup()
-    steps = 8
+    steps = 5
     mesh = make_mesh(axes)
     train_pos = jnp.asarray(hgcn.round_up_pairs(split.train_pos, mesh))
     state1, loss1 = _run_single(cfg, split, steps, train_pos)

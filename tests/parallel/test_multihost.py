@@ -20,6 +20,9 @@ import pytest
 
 _WORKER = os.path.join(os.path.dirname(__file__), "_mh_worker.py")
 
+# real OS-process spawns + distributed init: inherently slow (>1 min total)
+pytestmark = pytest.mark.slow
+
 
 def _free_port() -> int:
     with socket.socket() as s:

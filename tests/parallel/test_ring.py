@@ -23,7 +23,8 @@ def _pts(key, m, shape):
     return m.random_normal(key, shape, jnp.float64)
 
 
-@pytest.mark.parametrize("L", [32, 64])
+@pytest.mark.parametrize("L", [
+    32, pytest.param(64, marks=pytest.mark.slow)])
 def test_ring_matches_dense(mesh8, L):
     m = Lorentz(1.0)
     q = _pts(jax.random.PRNGKey(0), m, (2, L, 7))
@@ -35,6 +36,7 @@ def test_ring_matches_dense(mesh8, L):
                                rtol=1e-9, atol=1e-11)
 
 
+@pytest.mark.slow
 def test_ring_under_jit_compiles_collectives(mesh8):
     """The sharded ring must jit as one program (collectives inside XLA)."""
     m = Lorentz(0.5)

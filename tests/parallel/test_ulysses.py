@@ -24,7 +24,8 @@ def _pts(key, m, shape):
     return m.random_normal(key, shape, jnp.float64)
 
 
-@pytest.mark.parametrize("L,H", [(32, 8), (64, 16)])
+@pytest.mark.parametrize("L,H", [
+    (32, 8), pytest.param(64, 16, marks=pytest.mark.slow)])
 def test_ulysses_matches_dense(mesh8, L, H):
     m = Lorentz(1.0)
     q = _pts(jax.random.PRNGKey(0), m, (2, H, L, 7))
@@ -36,6 +37,7 @@ def test_ulysses_matches_dense(mesh8, L, H):
                                rtol=1e-9, atol=1e-11)
 
 
+@pytest.mark.slow
 def test_ulysses_matches_ring(mesh8):
     """The two SP modes are numerically interchangeable (same math)."""
     m = Lorentz(0.7)
@@ -49,6 +51,7 @@ def test_ulysses_matches_ring(mesh8):
                                rtol=1e-9, atol=1e-11)
 
 
+@pytest.mark.slow
 def test_ulysses_jit_grads_and_manifold(mesh8):
     m = Lorentz(1.0)
     q = _pts(jax.random.PRNGKey(6), m, (1, 8, 16, 5))

@@ -59,6 +59,7 @@ def test_checkpoint_interval_and_retention(tmp_path):
     assert int(restored["x"]) == 10
 
 
+@pytest.mark.slow
 def test_metrics_logger_tensorboard_sink(tmp_path):
     pytest.importorskip("torch.utils.tensorboard")
     tb_dir = str(tmp_path / "tb")
