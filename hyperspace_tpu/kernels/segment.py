@@ -222,8 +222,14 @@ def csr_segment_reduce_1d(
     assert op in ("sum", "max"), op
     m = S.mode()
     if m == "xla":
-        f = jax.ops.segment_sum if op == "sum" else jax.ops.segment_max
-        return f(values, receivers, num_segments, indices_are_sorted=True)
+        if op == "sum":
+            # match the Pallas path's f32 accumulation (and output dtype):
+            # summing bf16 terms directly drops contributions past ~256×
+            return jax.ops.segment_sum(
+                values.astype(jnp.promote_types(values.dtype, jnp.float32)),
+                receivers, num_segments, indices_are_sorted=True)
+        return jax.ops.segment_max(values, receivers, num_segments,
+                                   indices_are_sorted=True)
     e = values.shape[0]
     bn, bk = _BN, _BK
     e_pad = S.round_up(e, bk)

@@ -100,6 +100,31 @@ class HGCNLinkPred(nn.Module):
         return FermiDiracDecoder(name="decoder")(sq)
 
     @nn.compact
+    def pair_logits(self, g: graph_data.DeviceGraph, pos, neg_u, neg_v,
+                    neg_plan, *, deterministic=True):
+        """Logits for one LP step with every *static* scatter planned:
+        positives are the run's train_pos pairs through
+        `pair_sqdist_planned` (both endpoint scatters block-CSR), negatives
+        corrupt only v (u-side planned).  ``pos`` is the bundle from
+        :func:`make_planned_pairs`.  Returns (pos_logits [P], neg_logits [Q])."""
+        from hyperspace_tpu.nn.edge_dist import (
+            pair_sqdist_planned,
+            pair_sqdist_semi_planned,
+        )
+
+        z, m = HGCNEncoder(self.cfg, name="encoder")(
+            g, deterministic=deterministic
+        )
+        sq_pos = pair_sqdist_planned(
+            z, m.c, pos.u, pos.v, *pos.u_plan, pos.v_perm, pos.v_sorted,
+            *pos.v_plan, self.cfg.kind)
+        npb, npc, npf = neg_plan
+        sq_neg = pair_sqdist_semi_planned(z, m.c, neg_u, neg_v,
+                                          npb, npc, npf, self.cfg.kind)
+        dec = FermiDiracDecoder(name="decoder")
+        return dec(sq_pos), dec(sq_neg)
+
+    @nn.compact
     def edge_logits(self, g: graph_data.DeviceGraph, neg_u, neg_v, neg_plan,
                     *, deterministic=True):
         """Fast-path logits for one LP train step (same params as __call__):
@@ -220,6 +245,76 @@ def train_step_lp(
 ):
     """One LP step: sample negatives on device, BCE on pos+neg logits."""
     return _lp_step_impl(model, opt, num_nodes, state, g, train_pos)
+
+
+class PlannedPairs(NamedTuple):
+    """Static supervision pairs with both-side CSR scatter plans
+    (see nn/edge_dist.pair_sqdist_planned)."""
+
+    u: jax.Array         # [P] sorted
+    v: jax.Array         # [P] aligned with u
+    u_plan: tuple
+    v_perm: jax.Array    # [P] argsort of v
+    v_sorted: jax.Array  # [P]
+    v_plan: tuple
+
+
+def make_planned_pairs(pairs: np.ndarray, num_nodes: int) -> PlannedPairs:
+    """One-time host-side prep of a static pair set for the fully-planned
+    decoder pass: sort by u and build its CSR plan; keep the static argsort
+    of the aligned v column with its own plan for the backward."""
+    from hyperspace_tpu.kernels.segment import build_csr_plan
+
+    pairs = np.asarray(pairs)
+    order = np.argsort(pairs[:, 0], kind="stable")
+    u = np.ascontiguousarray(pairs[order, 0]).astype(np.int32)
+    v = np.ascontiguousarray(pairs[order, 1]).astype(np.int32)
+    v_perm = np.argsort(v, kind="stable").astype(np.int32)
+    v_sorted = v[v_perm]
+    to_dev = lambda plan: tuple(jnp.asarray(a) for a in plan)
+    return PlannedPairs(
+        u=jnp.asarray(u), v=jnp.asarray(v),
+        u_plan=to_dev(build_csr_plan(u, num_nodes)),
+        v_perm=jnp.asarray(v_perm), v_sorted=jnp.asarray(v_sorted),
+        v_plan=to_dev(build_csr_plan(v_sorted, num_nodes)),
+    )
+
+
+@partial(jax.jit, static_argnames=("model", "opt", "num_nodes"), donate_argnames=("state",))
+def train_step_lp_pairs(
+    model: HGCNLinkPred,
+    opt,
+    num_nodes: int,
+    state: TrainState,
+    g: graph_data.DeviceGraph,
+    pos: "PlannedPairs",
+    neg_u: jax.Array,
+    neg_plan: tuple,
+):
+    """One LP step scoring exactly the train positives with both decoder
+    scatters planned, plus corrupt-one-side negatives (u planned).  Same
+    pair count as `train_step_lp`, no unsorted scatter in the decoder
+    backward (VERDICT r1 #6)."""
+    key, k_neg, k_drop = jax.random.split(state.key, 3)
+    neg_v = jax.random.randint(k_neg, neg_u.shape, 0, num_nodes)
+
+    def loss_fn(params):
+        pos_logit, neg_logit = model.apply(
+            {"params": params}, g, pos, neg_u, neg_v, neg_plan,
+            deterministic=False, rngs={"dropout": k_drop},
+            method=HGCNLinkPred.pair_logits,
+        )
+        bce_pos = optax.sigmoid_binary_cross_entropy(
+            pos_logit, jnp.ones_like(pos_logit))
+        bce_neg = optax.sigmoid_binary_cross_entropy(
+            neg_logit, jnp.zeros_like(neg_logit))
+        return ((jnp.sum(bce_pos) + jnp.sum(bce_neg))
+                / (pos_logit.shape[0] + neg_logit.shape[0]))
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    updates, opt_state = opt.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, key, state.step + 1), loss
 
 
 def make_static_negatives(num_nodes: int, n_neg: int, seed: int = 0):

@@ -52,6 +52,10 @@ class HyboNetConfig:
     weight_decay: float = 1e-4
     dropout: float = 0.0
     batch_size: int = 64
+    # False (default) = kernels/attention.py flash path — the N7 Pallas
+    # kernel on TPU, its dense twin elsewhere.  True = the XLA
+    # online-softmax scan (the ring-attention per-device body).  The
+    # default workload DOES exercise the Pallas kernel on chip.
     use_tiled_attention: bool = False
     dtype: Any = jnp.float32
 

@@ -124,3 +124,50 @@ def _ps_bwd(kind, res, gbar):
 
 
 pair_sqdist_semi_planned.defvjp(_ps_fwd, _ps_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(12,))
+def pair_sqdist_planned(
+    z: jax.Array,   # [N, D]
+    c,
+    u: jax.Array,   # [P] int32, sorted ascending, static across steps
+    v: jax.Array,   # [P] int32, static across steps (any order)
+    u_pb, u_pc, u_pf,   # CSR plan for u
+    v_perm: jax.Array,  # [P] int32 static argsort of v
+    v_sorted: jax.Array,  # [P] = v[v_perm]
+    v_pb, v_pc, v_pf,   # CSR plan for v_sorted
+    kind: str = "lorentz",
+) -> jax.Array:
+    """sqdist(z[u_p], z[v_p]) with BOTH VJP scatters planned.
+
+    For *static* pair sets (e.g. the training positives, fixed for a whole
+    run) the v column can be pre-sorted too: the backward permutes the
+    v-side cotangents through the static ``v_perm`` and feeds them to the
+    same sorted block-CSR scatter as the u side — no unsorted scatter
+    anywhere in the decoder (VERDICT r1 #6: fold the Fermi–Dirac decoder's
+    distance pass into the planned kernel).  Build the inputs once with
+    ``models.hgcn.make_planned_pairs``.
+    """
+    return _sqdist_fn(kind)(z[u], z[v], c)
+
+
+def _pair_planned_fwd(z, c, u, v, u_pb, u_pc, u_pf, v_perm, v_sorted,
+                      v_pb, v_pc, v_pf, kind):
+    out = pair_sqdist_planned(z, c, u, v, u_pb, u_pc, u_pf, v_perm,
+                              v_sorted, v_pb, v_pc, v_pf, kind)
+    return out, (z, c, u, v, u_pb, u_pc, u_pf, v_perm, v_sorted,
+                 v_pb, v_pc, v_pf)
+
+
+def _pair_planned_bwd(kind, res, gbar):
+    (z, c, u, v, u_pb, u_pc, u_pf, v_perm, v_sorted, v_pb, v_pc, v_pf) = res
+    _, vjp = jax.vjp(_sqdist_fn(kind), z[u], z[v], c)
+    gu, gv, dc = vjp(gbar)
+    n = z.shape[0]
+    dz = _sorted_segsum(gu, u, u_pb, u_pc, u_pf, n)
+    dz = dz + _sorted_segsum(gv[v_perm], v_sorted, v_pb, v_pc, v_pf, n)
+    return (dz.astype(z.dtype), dc, None, None, None, None, None, None,
+            None, None, None, None)
+
+
+pair_sqdist_planned.defvjp(_pair_planned_fwd, _pair_planned_bwd)

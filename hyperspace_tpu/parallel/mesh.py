@@ -66,23 +66,28 @@ def auto_mesh(multihost: bool = False, tp: int = 1) -> Optional[Mesh]:
     """Mesh selection shared by the CLI runners: the multi-host mesh when
     requested, a data(-×model) mesh over all local devices when there is
     more than one, else ``None`` (caller takes its single-device path)."""
+    def warn_tp_dropped(n_avail):
+        import warnings
+
+        warnings.warn(
+            f"auto_mesh: tp={tp} does not divide the {n_avail} available "
+            "devices; falling back to pure data parallelism")
+
     if multihost:
         per_host = jax.local_device_count()
         if tp > 1 and per_host >= tp and per_host % tp == 0:
             # tp stays intra-host so its collectives ride ICI, not DCN
             return multihost_mesh({"data": per_host // tp, "model": tp})
         if tp > 1:
-            import warnings
-
-            warnings.warn(
-                f"auto_mesh: tp={tp} does not divide the {per_host} local "
-                "devices per host; falling back to pure data parallelism")
+            warn_tp_dropped(per_host)
         return multihost_mesh()
     n = len(jax.devices())
     if n <= 1:
         return None
     if tp > 1 and n >= tp and n % tp == 0:
         return make_mesh({"data": n // tp, "model": tp})
+    if tp > 1:
+        warn_tp_dropped(n)
     return make_mesh({"data": n})
 
 

@@ -1,0 +1,129 @@
+"""Generate golden constants for tests/manifolds/test_golden.py.
+
+Implements the *published* closed forms (Ganea et al. 2018; Nickel &
+Kiela 2018) directly in mpmath at 50 digits — deliberately independent of
+hyperspace_tpu, so the goldens catch silent formula drift in the library
+(SURVEY.md §4.3).  Run and paste the printed block into the test.
+
+    python scripts/gen_golden.py
+"""
+
+from mpmath import mp, mpf, sqrt, atanh, asinh, acosh, tanh, cosh, sinh
+
+mp.dps = 50
+
+
+def dot(a, b):
+    return sum(x * y for x, y in zip(a, b))
+
+
+def nrm(a):
+    return sqrt(dot(a, a))
+
+
+def mobius_add(x, y, c):
+    """(x ⊕_c y) — Ganea et al. 2018 eq. (1)."""
+    xy, x2, y2 = dot(x, y), dot(x, x), dot(y, y)
+    den = 1 + 2 * c * xy + c * c * x2 * y2
+    cx = (1 + 2 * c * xy + c * y2) / den
+    cy = (1 - c * x2) / den
+    return [cx * xi + cy * yi for xi, yi in zip(x, y)]
+
+
+def poincare_dist(x, y, c):
+    """d_c(x,y) = (2/√c)·artanh(√c‖(−x)⊕_c y‖) — Ganea eq. (2)."""
+    z = mobius_add([-xi for xi in x], y, c)
+    return (2 / sqrt(c)) * atanh(sqrt(c) * nrm(z))
+
+
+def poincare_expmap(x, v, c):
+    """exp_x(v) = x ⊕_c (tanh(√c·λ_x‖v‖/2)·v/(√c‖v‖)) — Ganea eq. (8)."""
+    lam = 2 / (1 - c * dot(x, x))
+    nv = nrm(v)
+    t = tanh(sqrt(c) * lam * nv / 2) / (sqrt(c) * nv)
+    return mobius_add(x, [t * vi for vi in v], c)
+
+
+def gyration(a, b, v, c):
+    """gyr[a,b]v = −(a⊕b) ⊕ (a ⊕ (b ⊕ v)) (Ungar)."""
+    ab = mobius_add(a, b, c)
+    inner = mobius_add(a, mobius_add(b, v, c), c)
+    return mobius_add([-t for t in ab], inner, c)
+
+
+def poincare_ptransp(x, y, v, c):
+    """P_{x→y}(v) = (λ_x/λ_y)·gyr[y, −x]v — Ganea eq. (after 10)."""
+    lx = 2 / (1 - c * dot(x, x))
+    ly = 2 / (1 - c * dot(y, y))
+    g = gyration(y, [-t for t in x], v, c)
+    return [(lx / ly) * t for t in g]
+
+
+def mlr_logit(x, p, a, c):
+    """Ganea et al. 2018 eq. (25)."""
+    z = mobius_add([-t for t in p], x, c)
+    lam_p = 2 / (1 - c * dot(p, p))
+    na = nrm(a)
+    arg = 2 * sqrt(c) * dot(z, a) / ((1 - c * dot(z, z)) * na)
+    return (lam_p * na / sqrt(c)) * asinh(arg)
+
+
+def ldot(x, y):
+    """Minkowski inner product, time coordinate first."""
+    return -x[0] * y[0] + dot(x[1:], y[1:])
+
+
+def lorentz_point(space, c):
+    """Lift a space vector onto {⟨x,x⟩_L = −1/c}, time first."""
+    t = sqrt(1 / c + dot(space, space))
+    return [t] + list(space)
+
+
+def lorentz_dist(x, y, c):
+    """d = (1/√c)·arcosh(−c⟨x,y⟩_L) — Nickel & Kiela 2018."""
+    return acosh(-c * ldot(x, y)) / sqrt(c)
+
+
+def lorentz_expmap(x, v, c):
+    """exp_x(v) = cosh(√c‖v‖_L)x + sinh(√c‖v‖_L)v/(√c‖v‖_L)."""
+    nv = sqrt(ldot(v, v))
+    s = sqrt(c) * nv
+    return [cosh(s) * xi + sinh(s) * vi / s for xi, vi in zip(x, v)]
+
+
+def fmt(v):
+    if isinstance(v, list):
+        return "[" + ", ".join(fmt(t) for t in v) + "]"
+    return mp.nstr(v, 20)
+
+
+if __name__ == "__main__":
+    c1, c2 = mpf(1), mpf("0.7")
+    x = [mpf("0.3"), mpf("-0.2"), mpf("0.1")]
+    y = [mpf("-0.5"), mpf("0.1"), mpf("0.4")]
+    v = [mpf("0.25"), mpf("0.4"), mpf("-0.1")]
+    p = [mpf("0.1"), mpf("0.2"), mpf("-0.3")]
+    a = [mpf("0.8"), mpf("-0.5"), mpf("0.2")]
+
+    print("POINCARE_DIST_C1  =", fmt(poincare_dist(x, y, c1)))
+    print("POINCARE_DIST_C07 =", fmt(poincare_dist(x, y, c2)))
+    print("POINCARE_EXPMAP_C1  =", fmt(poincare_expmap(x, v, c1)))
+    print("POINCARE_EXPMAP_C07 =", fmt(poincare_expmap(x, v, c2)))
+    print("POINCARE_PTRANSP_C1 =", fmt(poincare_ptransp(x, y, v, c1)))
+    print("MLR_LOGIT_C1  =", fmt(mlr_logit(x, p, a, c1)))
+    print("MLR_LOGIT_C07 =", fmt(mlr_logit(x, p, a, c2)))
+
+    lx = lorentz_point(x, c1)
+    ly = lorentz_point(y, c1)
+    print("LORENTZ_X_C1 =", fmt(lx))
+    print("LORENTZ_Y_C1 =", fmt(ly))
+    print("LORENTZ_DIST_C1 =", fmt(lorentz_dist(lx, ly, c1)))
+    lx2 = lorentz_point(x, c2)
+    ly2 = lorentz_point(y, c2)
+    print("LORENTZ_DIST_C07 =", fmt(lorentz_dist(lx2, ly2, c2)))
+    # tangent at lx: project v' = v - <x,v>_L / <x,x>_L x  (time-first)
+    v4 = [mpf(0)] + v
+    coef = ldot(lx, v4) * c1  # <x,x>_L = -1/c ⇒ proj = v + c<x,v> x
+    tv = [vi + coef * xi for vi, xi in zip(v4, lx)]
+    print("LORENTZ_TANGENT_C1 =", fmt(tv))
+    print("LORENTZ_EXPMAP_C1 =", fmt(lorentz_expmap(lx, tv, c1)))

@@ -120,3 +120,39 @@ def test_hgcn_learned_curvature_trains():
     # curvature moved off its init
     c_raw = float(params["encoder"]["conv0"]["c_raw"])
     assert np.isfinite(c_raw)
+
+
+def test_train_step_lp_pairs_smoke():
+    """Fully-planned-pairs step (VERDICT r1 #6) runs and reduces loss."""
+    edges, x, labels, k = G.synthetic_hierarchy(num_nodes=192, feat_dim=12,
+                                                seed=0)
+    split = G.split_edges(edges, 192, x, seed=0, pad_multiple=128)
+    cfg = hgcn.HGCNConfig(feat_dim=12, hidden_dims=(16, 8))
+    model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
+    ga = hgcn._device_graph(split.graph)
+    pos = hgcn.make_planned_pairs(split.train_pos, 192)
+    neg_u, neg_plan = hgcn.make_static_negatives(192, pos.u.shape[0], seed=0)
+    losses = []
+    for _ in range(25):
+        state, loss = hgcn.train_step_lp_pairs(
+            model, opt, 192, state, ga, pos, neg_u, neg_plan)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.slow
+def test_train_step_lp_pairs_reaches_auc():
+    edges, x, labels, k = G.synthetic_hierarchy(num_nodes=512, feat_dim=16,
+                                                seed=0)
+    split = G.split_edges(edges, 512, x, seed=0, pad_multiple=512)
+    cfg = hgcn.HGCNConfig(feat_dim=16, hidden_dims=(32, 8))
+    model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
+    ga = hgcn._device_graph(split.graph)
+    pos = hgcn.make_planned_pairs(split.train_pos, 512)
+    neg_u, neg_plan = hgcn.make_static_negatives(512, pos.u.shape[0], seed=0)
+    for _ in range(300):
+        state, loss = hgcn.train_step_lp_pairs(
+            model, opt, 512, state, ga, pos, neg_u, neg_plan)
+    res = hgcn.evaluate_lp(model, state.params, split, "test", ga=ga)
+    assert res["roc_auc"] > 0.85, res
