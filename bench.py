@@ -87,21 +87,27 @@ def bench_poincare(repeats: int = 3) -> dict:
 
 
 def bench_hgcn(repeats: int = 3, dtype: str = "float32",
-               agg_dtype: str = "bfloat16") -> dict:
+               agg_dtype: str = "bfloat16", use_att: bool = False,
+               step: str = "pairs", decoder_dtype: str | None = "bfloat16") -> dict:
     """HGCN training throughput (samples/sec/chip) on an arxiv-scale graph.
 
-    Default config is f32 compute with bf16 *edge messages* (f32
-    accumulation) — measured quality-neutral at convergence and ~6% faster
-    (docs/benchmarks.md).  ``--agg-dtype float32`` reproduces the pure-f32
-    step; ``--dtype bfloat16`` runs everything in bf16 (faster still, but
-    ROC-AUC degrades, so it is opt-in).
+    Default config (validated quality-neutral at full 169 k-node scale
+    over 3 seeds — docs/benchmarks.md quality-anchor section): f32
+    compute, bf16 *edge messages* and a bf16 decoder pass (everything
+    accumulates f32), with the fully-planned-pairs train step whose
+    decoder scatters are block-CSR.  Measured 987 k samples/s/chip vs
+    812 k for the r01 default on the same chip/session.  ``--step lp
+    --decoder-dtype float32 --agg-dtype float32`` reproduces pure-f32;
+    ``--dtype bfloat16`` runs everything in bf16 (faster, AUC degrades,
+    opt-in); ``--use-att`` benches the attention-aggregation model.
     """
     import jax
 
     from hyperspace_tpu.benchmarks.hgcn_bench import run_hgcn_bench
 
     return run_hgcn_bench(repeats=repeats, backend=jax.default_backend(),
-                          dtype=dtype, agg_dtype=agg_dtype)
+                          dtype=dtype, agg_dtype=agg_dtype, use_att=use_att,
+                          step=step, decoder_dtype=decoder_dtype)
 
 
 def main() -> None:
@@ -111,12 +117,19 @@ def main() -> None:
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
     p.add_argument("--agg-dtype", choices=["float32", "bfloat16"],
                    default="bfloat16")
+    p.add_argument("--use-att", action="store_true",
+                   help="attention aggregation (GAT-style) instead of mean")
+    p.add_argument("--step", choices=["lp", "pairs"], default="pairs")
+    p.add_argument("--decoder-dtype", choices=["float32", "bfloat16"],
+                   default="bfloat16")
     args = p.parse_args()
 
     import functools
 
     hgcn_fn = functools.partial(bench_hgcn, dtype=args.dtype,
-                                agg_dtype=args.agg_dtype)
+                                agg_dtype=args.agg_dtype,
+                                use_att=args.use_att, step=args.step,
+                                decoder_dtype=args.decoder_dtype)
     order = {
         "auto": [hgcn_fn, bench_poincare],
         "hgcn": [hgcn_fn],

@@ -51,6 +51,9 @@ def run_hgcn_bench(
     num_nodes: int = ARXIV_NODES,
     dtype: str = "float32",
     agg_dtype: str = "bfloat16",
+    use_att: bool = False,
+    step: str = "lp",  # "lp" | "pairs" (fully-planned decoder scatters)
+    decoder_dtype: str | None = None,
 ) -> dict:
     """``agg_dtype="bfloat16"`` is the reported default: edge messages ride
     in bf16 while the aggregation kernel accumulates f32 — measured
@@ -72,24 +75,38 @@ def run_hgcn_bench(
         source = "synthetic"
     cfg = hgcn.HGCNConfig(
         feat_dim=x.shape[1], hidden_dims=(128, 32), kind="lorentz",
+        use_att=use_att,
         dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
         # explicit f32 (not None): "--agg-dtype float32" must force f32
         # messages even when the compute dtype is bf16
-        agg_dtype=jnp.bfloat16 if agg_dtype == "bfloat16" else jnp.float32)
+        agg_dtype=jnp.bfloat16 if agg_dtype == "bfloat16" else jnp.float32,
+        # like agg_dtype: explicit "float32" must force an f32 decoder
+        # pass even when the compute dtype is bf16; None inherits dtype
+        decoder_dtype=(jnp.bfloat16 if decoder_dtype == "bfloat16"
+                       else jnp.float32 if decoder_dtype == "float32"
+                       else None))
     model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
     ga = hgcn._device_graph(split.graph)
-    train_pos = jnp.asarray(split.train_pos)
+    if step == "pairs":
+        pos = hgcn.make_planned_pairs(split.train_pos, num_nodes)
+        neg_u, neg_plan = hgcn.make_static_negatives(
+            num_nodes, int(pos.u.shape[0]), seed=0)
+        step_fn = lambda st: hgcn.train_step_lp_pairs(
+            model, opt, num_nodes, st, ga, pos, neg_u, neg_plan)
+    else:
+        train_pos = jnp.asarray(split.train_pos)
+        step_fn = lambda st: hgcn.train_step_lp(
+            model, opt, num_nodes, st, ga, train_pos)
 
     # compile + warmup
-    state, loss = hgcn.train_step_lp(model, opt, num_nodes, state, ga, train_pos)
+    state, loss = step_fn(state)
     jax.device_get(loss)
 
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         for _ in range(steps_per_repeat):
-            state, loss = hgcn.train_step_lp(
-                model, opt, num_nodes, state, ga, train_pos)
+            state, loss = step_fn(state)
         # device_get, not block_until_ready: remote-attached TPUs (axon
         # tunnel) ack block_until_ready before execution finishes; a host
         # fetch of the loss is the only reliable completion barrier
@@ -114,5 +131,10 @@ def run_hgcn_bench(
             "source": source,
             "dtype": dtype,
             "agg_dtype": agg_dtype,
+            "use_att": use_att,
+            "step": step,
+            # the lp step's decoder never consults decoder_dtype — record
+            # what actually executed, not the unused flag
+            "decoder_dtype": decoder_dtype if step == "pairs" else None,
         },
     }

@@ -223,11 +223,13 @@ def csr_segment_reduce_1d(
     m = S.mode()
     if m == "xla":
         if op == "sum":
-            # match the Pallas path's f32 accumulation (and output dtype):
-            # summing bf16 terms directly drops contributions past ~256×
-            return jax.ops.segment_sum(
+            # match the Pallas path: accumulate in ≥f32 (summing bf16
+            # terms directly drops contributions past ~256×), then cast
+            # back to the input dtype like the kernel's epilogue does
+            acc = jax.ops.segment_sum(
                 values.astype(jnp.promote_types(values.dtype, jnp.float32)),
                 receivers, num_segments, indices_are_sorted=True)
+            return acc.astype(values.dtype)
         return jax.ops.segment_max(values, receivers, num_segments,
                                    indices_are_sorted=True)
     e = values.shape[0]

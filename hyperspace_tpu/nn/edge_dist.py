@@ -119,7 +119,11 @@ def _ps_bwd(kind, res, gbar):
     _, vjp = jax.vjp(_sqdist_fn(kind), z[u], z[v], c)
     gu, gv, dc = vjp(gbar)
     dz = _sorted_segsum(gu, u, pb, pc, pf, z.shape[0])
-    dz = dz + jax.ops.segment_sum(gv, v, z.shape[0])
+    # v side is fresh randomness each step — unsorted scatter is the cost
+    # of that; accumulate it in ≥f32 so bf16 cotangents don't truncate
+    acc_dt = jnp.promote_types(gv.dtype, jnp.float32)
+    dz = dz.astype(acc_dt) + jax.ops.segment_sum(
+        gv.astype(acc_dt), v, z.shape[0])
     return dz.astype(z.dtype), dc, None, None, None, None, None
 
 

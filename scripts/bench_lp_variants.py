@@ -52,14 +52,17 @@ def main():
         num_nodes, int(pos.u.shape[0]), seed=0)
 
     combos = (
-        ("f32", jnp.float32, None),
-        ("f32_aggbf16", jnp.float32, jnp.bfloat16),  # the bench default
-        ("bf16", jnp.bfloat16, None),
+        ("f32", jnp.float32, None, None),
+        ("f32_aggbf16", jnp.float32, jnp.bfloat16, None),
+        # bench default (pairs row): + bf16 decoder pass
+        ("f32_aggbf16_decbf16", jnp.float32, jnp.bfloat16, jnp.bfloat16),
+        ("bf16", jnp.bfloat16, None, None),
     )
-    for name, dtype, agg_dtype in combos:
+    for name, dtype, agg_dtype, decoder_dtype in combos:
         cfg = hgcn.HGCNConfig(feat_dim=x.shape[1], hidden_dims=(128, 32),
                               kind="lorentz", dtype=dtype,
-                              agg_dtype=agg_dtype)
+                              agg_dtype=agg_dtype,
+                              decoder_dtype=decoder_dtype)
         model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
         ga = hgcn._device_graph(split.graph)
 

@@ -19,13 +19,28 @@ import argparse
 import json
 
 
-def configs(hgcn, jnp, feat_dim):
+def configs(hgcn, jnp, feat_dim, which="all"):
+    """(name, cfg, step) triples; step "lp" = train_step_lp (fresh uv
+    negatives), "pairs" = train_step_lp_pairs (fully-planned decoder,
+    corrupt-v negatives)."""
     base = dict(feat_dim=feat_dim, hidden_dims=(128, 32), kind="lorentz")
-    return [
-        ("f32", hgcn.HGCNConfig(**base)),
-        ("f32_aggbf16", hgcn.HGCNConfig(**base, agg_dtype=jnp.bfloat16)),
-        ("bf16", hgcn.HGCNConfig(**base, dtype=jnp.bfloat16)),
+    all_ = [
+        ("f32", hgcn.HGCNConfig(**base), "lp"),
+        ("f32_aggbf16", hgcn.HGCNConfig(**base, agg_dtype=jnp.bfloat16),
+         "lp"),
+        ("bf16", hgcn.HGCNConfig(**base, dtype=jnp.bfloat16), "lp"),
+        # the r02 bench candidate: f32 encoder, bf16 messages, bf16
+        # decoder pass, fully-planned pairs step (987 k samples/s/chip)
+        ("pairs_f32_aggbf16_decbf16",
+         hgcn.HGCNConfig(**base, agg_dtype=jnp.bfloat16,
+                         decoder_dtype=jnp.bfloat16), "pairs"),
+        # its f32 control through the same step/negative sampler, so the
+        # dtype effect is isolated from the sampler change
+        ("pairs_f32", hgcn.HGCNConfig(**base), "pairs"),
     ]
+    if which == "all":
+        return all_
+    return [t for t in all_ if t[0] in which.split(",")]
 
 
 def make_split(num_nodes):
@@ -34,7 +49,7 @@ def make_split(num_nodes):
     return HB.arxiv_scale_split(num_nodes)
 
 
-def time_phase():
+def time_phase(which: str = "all"):
     """Step time per config at full arxiv scale."""
     import time
 
@@ -47,17 +62,18 @@ def time_phase():
     split, x = make_split(HB.ARXIV_NODES)
     n = HB.ARXIV_NODES
     ga = hgcn._device_graph(split.graph)
-    train_pos = jnp.asarray(split.train_pos)
-    for name, cfg in configs(hgcn, jnp, x.shape[1]):
+    sel = configs(hgcn, jnp, x.shape[1], which)
+    steppers = _steppers(hgcn, split, n, {k for _, _, k in sel})
+    for name, cfg, kind in sel:
         model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
-        state, loss = hgcn.train_step_lp(model, opt, n, state, ga, train_pos)
+        step = steppers[kind]
+        state, loss = step(model, opt, state, ga)
         jax.device_get(loss)
         best = float("inf")
         for _ in range(3):
             t0 = time.perf_counter()
             for _ in range(10):
-                state, loss = hgcn.train_step_lp(model, opt, n, state, ga,
-                                                 train_pos)
+                state, loss = step(model, opt, state, ga)
             jax.device_get(loss)
             best = min(best, time.perf_counter() - t0)
         print(json.dumps({"phase": "time", "config": name,
@@ -66,7 +82,28 @@ def time_phase():
               flush=True)
 
 
-def quality_phase(quality_nodes: int, steps: int, seeds: int):
+def _steppers(hgcn, split, n, kinds):
+    """step(model, opt, state, ga) closures, built only for ``kinds``
+    (the pairs prep sorts millions of host-side indices — skip it when no
+    selected config needs it)."""
+    import jax.numpy as jnp
+
+    out = {}
+    if "lp" in kinds:
+        train_pos = jnp.asarray(split.train_pos)
+        out["lp"] = lambda m, o, st, g: hgcn.train_step_lp(
+            m, o, n, st, g, train_pos)
+    if "pairs" in kinds:
+        pos = hgcn.make_planned_pairs(split.train_pos, n)
+        neg_u, neg_plan = hgcn.make_static_negatives(
+            n, int(pos.u.shape[0]), seed=0)
+        out["pairs"] = lambda m, o, st, g: hgcn.train_step_lp_pairs(
+            m, o, n, st, g, pos, neg_u, neg_plan)
+    return out
+
+
+def quality_phase(quality_nodes: int, steps: int, seeds: int,
+                  which: str = "all"):
     """Converged test ROC-AUC per config per seed at the requested scale."""
     import jax.numpy as jnp
 
@@ -75,13 +112,14 @@ def quality_phase(quality_nodes: int, steps: int, seeds: int):
     split, x = make_split(quality_nodes)
     n = quality_nodes
     ga = hgcn._device_graph(split.graph)
-    train_pos = jnp.asarray(split.train_pos)
-    for name, cfg in configs(hgcn, jnp, x.shape[1]):
+    sel = configs(hgcn, jnp, x.shape[1], which)
+    steppers = _steppers(hgcn, split, n, {k for _, _, k in sel})
+    for name, cfg, kind in sel:
+        step = steppers[kind]
         for seed in range(seeds):
             model, opt, state = hgcn.init_lp(cfg, split.graph, seed=seed)
             for _ in range(steps):
-                state, loss = hgcn.train_step_lp(model, opt, n, state, ga,
-                                                 train_pos)
+                state, loss = step(model, opt, state, ga)
             res = hgcn.evaluate_lp(model, state.params, split, "test", ga=ga)
             print(json.dumps({"phase": "quality", "config": name,
                               "seed": seed, "nodes": n, "steps": steps,
@@ -95,11 +133,13 @@ if __name__ == "__main__":
     ap.add_argument("--steps", type=int, default=400)
     ap.add_argument("--seeds", type=int, default=3)
     ap.add_argument("--skip-timing", action="store_true")
+    ap.add_argument("--configs", default="all",
+                    help='comma-separated config names, or "all"')
     args = ap.parse_args()
     if args.quality_nodes is None:
         from hyperspace_tpu.benchmarks import hgcn_bench as HB
 
         args.quality_nodes = HB.ARXIV_NODES
     if not args.skip_timing:
-        time_phase()
-    quality_phase(args.quality_nodes, args.steps, args.seeds)
+        time_phase(args.configs)
+    quality_phase(args.quality_nodes, args.steps, args.seeds, args.configs)
