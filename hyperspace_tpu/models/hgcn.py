@@ -53,10 +53,11 @@ class HGCNConfig:
     # edge-message dtype for neighbor aggregation (None = dtype); bf16
     # halves the dominant HBM traffic while the kernel accumulates f32
     agg_dtype: Any = None
-    # dtype for the LP decoder's pair-distance pass (None = dtype): bf16
-    # halves the 2.2 M-pair gather/scatter traffic; only the planned
-    # (train_step_lp_pairs) scatters actually speed up from it — see
-    # docs/benchmarks.md LP-variant table
+    # dtype of the LP decoder's pair-distance pass during TRAINING
+    # (None = dtype); eval always scores in full precision.  bf16 halves
+    # the 2.2 M-pair gather/scatter traffic; the planned scatters
+    # (train_step_lp_pairs / _planned) get the full bandwidth win, the
+    # unplanned step's XLA scatter much less — docs/benchmarks.md
     decoder_dtype: Any = None
 
 
@@ -101,8 +102,10 @@ class HGCNLinkPred(nn.Module):
         z, m = HGCNEncoder(self.cfg, name="encoder")(
             g, deterministic=deterministic
         )
+        if self.cfg.decoder_dtype is not None and not deterministic:
+            z = z.astype(self.cfg.decoder_dtype)  # train only; eval full-prec
         sq = m.sqdist(z[pairs[:, 0]], z[pairs[:, 1]])
-        return FermiDiracDecoder(name="decoder")(sq)
+        return FermiDiracDecoder(name="decoder")(sq.astype(self.cfg.dtype))
 
     @nn.compact
     def pair_logits(self, g: graph_data.DeviceGraph, pos, neg_u, neg_v,
@@ -152,16 +155,19 @@ class HGCNLinkPred(nn.Module):
         z, m = HGCNEncoder(self.cfg, name="encoder")(
             g, deterministic=deterministic
         )
+        if self.cfg.decoder_dtype is not None:
+            z = z.astype(self.cfg.decoder_dtype)  # train-only method
         pb, pc, pf = g.plan if g.plan is not None else (None, None, None)
         sq_pos = graph_edge_sqdist(z, m.c, g.senders, g.receivers, g.rev_perm,
                                    pb, pc, pf, self.cfg.kind)
+        sq_pos = sq_pos.astype(self.cfg.dtype)
         # self-loops are degenerate positives (d = 0); weight them out
         w_pos = (g.edge_mask & (g.senders != g.receivers)).astype(sq_pos.dtype)
         npb, npc, npf = neg_plan
         sq_neg = pair_sqdist_semi_planned(z, m.c, neg_u, neg_v,
                                           npb, npc, npf, self.cfg.kind)
         dec = FermiDiracDecoder(name="decoder")
-        return dec(sq_pos), w_pos, dec(sq_neg)
+        return dec(sq_pos), w_pos, dec(sq_neg.astype(self.cfg.dtype))
 
 
 class HGCNNodeClf(nn.Module):

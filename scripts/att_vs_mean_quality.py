@@ -18,8 +18,6 @@ import json
 
 
 def run_lp(use_att: bool, nodes: int, steps: int, seed: int):
-    import jax.numpy as jnp
-
     from hyperspace_tpu.data import graphs as G
     from hyperspace_tpu.models import hgcn
 
@@ -28,13 +26,8 @@ def run_lp(use_att: bool, nodes: int, steps: int, seed: int):
     split = G.split_edges(edges, nodes, x, seed=seed)
     cfg = hgcn.HGCNConfig(feat_dim=x.shape[1], hidden_dims=(64, 16),
                           kind="lorentz", use_att=use_att)
-    model, opt, state = hgcn.init_lp(cfg, split.graph, seed=seed)
-    ga = hgcn._device_graph(split.graph)
-    train_pos = jnp.asarray(split.train_pos)
-    for _ in range(steps):
-        state, loss = hgcn.train_step_lp(model, opt, nodes, state, ga,
-                                         train_pos)
-    ev = hgcn.evaluate_lp(model, state.params, split, "test", ga=ga)
+    model, params, _ = hgcn.train_lp(cfg, split, steps=steps, seed=seed)
+    ev = hgcn.evaluate_lp(model, params, split, "test")
     return {"task": "lp", "use_att": use_att, "seed": seed,
             "test_roc_auc": round(ev["roc_auc"], 4)}
 

@@ -40,7 +40,13 @@ def configs(hgcn, jnp, feat_dim, which="all"):
     ]
     if which == "all":
         return all_
-    return [t for t in all_ if t[0] in which.split(",")]
+    names = {t[0] for t in all_}
+    sel = which.split(",")
+    unknown = [s for s in sel if s not in names]
+    if unknown:  # fail fast — a typo must not silently run nothing
+        raise SystemExit(
+            f"unknown config(s) {unknown}; known: {sorted(names)}")
+    return [t for t in all_ if t[0] in sel]
 
 
 def make_split(num_nodes):

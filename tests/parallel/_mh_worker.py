@@ -31,6 +31,9 @@ def main() -> int:
     ap.add_argument("--ckpt-every", type=int, default=2)
     ap.add_argument("--crash-at", type=int, default=0)  # 0 = never
     ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--hgcn", action="store_true",
+                    help="train the sharded HGCN LP step instead of the "
+                         "least-squares toy (north-star workload over DCN)")
     args = ap.parse_args()
 
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -40,6 +43,9 @@ def main() -> int:
 
     mh.initialize(f"127.0.0.1:{args.port}", args.nprocs, args.pid,
                   local_device_count=2)
+
+    if args.hgcn:
+        return run_hgcn(args, mh)
 
     import jax
     import jax.numpy as jnp
@@ -109,6 +115,40 @@ def main() -> int:
             "params": [float(v) for v in final],
             "loss": float(jax.device_get(loss)) if loss is not None else None,
             "devices": jax.device_count(),
+        }), flush=True)
+    return 0
+
+
+def run_hgcn(args, mh) -> int:
+    """The north-star workload's library dp step over a real host×data
+    mesh: every process builds the same graph deterministically, the
+    supervision batch is sharded over (host, data), and the gradient
+    all-reduce crosses the process boundary inside XLA (SURVEY.md §3.4:
+    Python never communicates across hosts, only collectives do)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.data import graphs as G
+    from hyperspace_tpu.models import hgcn
+    from hyperspace_tpu.parallel.mesh import multihost_mesh
+
+    mesh = multihost_mesh({"data": 2})
+    edges, x, labels, ncls = G.synthetic_hierarchy(
+        num_nodes=128, feat_dim=8, seed=0)
+    split = G.split_edges(edges, 128, x, seed=0, pad_multiple=128)
+    cfg = hgcn.HGCNConfig(feat_dim=8, hidden_dims=(16, 8))
+    model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
+    ga = G.to_device(split.graph)
+    train_pos = jnp.asarray(hgcn.round_up_pairs(split.train_pos, mesh))
+    step, state, ga = hgcn.make_sharded_step_lp(
+        model, opt, 128, mesh, state, ga)
+    losses = []
+    for _ in range(args.steps):
+        state, loss = step(state, ga, train_pos)
+        losses.append(float(jax.device_get(loss)))
+    if args.pid == 0:
+        print("RESULT " + json.dumps({
+            "losses": losses, "devices": jax.device_count(),
         }), flush=True)
     return 0
 

@@ -117,3 +117,14 @@ def test_kill_one_host_restart_from_checkpoint(ref_result, tmp_path):
                          "--resume")
     np.testing.assert_allclose(resumed["params"], ref_result["params"],
                                rtol=1e-6, atol=1e-7)
+
+
+def test_two_process_hgcn_sharded_step(tmp_path):
+    """The north-star workload's library dp step (make_sharded_step_lp)
+    trains over a real 2-process host×data mesh — the gradient all-reduce
+    crosses the process boundary inside XLA."""
+    res = _run_group(2, tmp_path, "--steps", "5", "--hgcn")
+    assert res["devices"] == 4
+    losses = res["losses"]
+    assert len(losses) == 5 and np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
