@@ -127,13 +127,13 @@ def run_hgcn(run: RunConfig, overrides: dict):
                         num_classes=ncls if task == "nc" else 0),
         overrides)
     num_nodes = x.shape[0]
+    from hyperspace_tpu.parallel.mesh import auto_mesh
+
+    mesh = auto_mesh(run.multihost, tp=2)
     if task == "lp":
         split = G.split_edges(edges, num_nodes, x, seed=run.seed)
         model, opt, state = hgcn.init_lp(cfg, split.graph, seed=run.seed)
         ga = hgcn._device_graph(split.graph)
-        from hyperspace_tpu.parallel.mesh import auto_mesh
-
-        mesh = auto_mesh(run.multihost, tp=2)
         if mesh is not None:
             train_pos = jnp.asarray(hgcn.round_up_pairs(split.train_pos, mesh))
             step, state, ga = hgcn.make_sharded_step_lp(
@@ -156,9 +156,15 @@ def run_hgcn(run: RunConfig, overrides: dict):
         ga = hgcn._device_graph(g)
         lab = jnp.asarray(g.labels)
         mask = jnp.asarray(g.train_mask)
-        state, loss = _train_loop(
-            run, state,
-            lambda st: hgcn.train_step_nc(model, opt, st, ga, lab, mask))
+        if mesh is not None:
+            step, state, ga = hgcn.make_sharded_step_nc(
+                model, opt, mesh, state, ga)
+            state, loss = _train_loop(
+                run, state, lambda st: step(st, ga, lab, mask))
+        else:
+            state, loss = _train_loop(
+                run, state,
+                lambda st: hgcn.train_step_nc(model, opt, st, ga, lab, mask))
         res = {"loss": float(loss),
                **hgcn.evaluate_nc(model, state.params, g, ga=ga)}
     return {"workload": "hgcn", "task": task, "dataset": dataset,

@@ -481,6 +481,28 @@ def init_nc(cfg: HGCNConfig, g: graph_data.Graph, seed: int = 0):
     return model, opt, state
 
 
+def _nc_step_impl(model, opt, state, g, labels, train_mask, constrain=None):
+    """Shared NC step body; ``constrain`` optionally pins the per-node
+    loss terms' sharding (data-parallel over the node axis)."""
+    key, k_drop = jax.random.split(state.key)
+
+    def loss_fn(params):
+        logits = model.apply(
+            {"params": params}, g,
+            deterministic=False, rngs={"dropout": k_drop},
+        )
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        if constrain is not None:
+            ce = constrain(ce)
+        w = train_mask.astype(ce.dtype)
+        return jnp.sum(ce * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    updates, opt_state = opt.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, key, state.step + 1), loss
+
+
 @partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
 def train_step_nc(
     model: HGCNNodeClf,
@@ -490,21 +512,38 @@ def train_step_nc(
     labels: jax.Array,  # [N] int32
     train_mask: jax.Array,  # [N] bool
 ):
-    key, k_drop = jax.random.split(state.key)
+    return _nc_step_impl(model, opt, state, g, labels, train_mask)
 
-    def loss_fn(params):
-        logits = model.apply(
-            {"params": params}, g,
-            deterministic=False, rngs={"dropout": k_drop},
-        )
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-        w = train_mask.astype(ce.dtype)
-        return jnp.sum(ce * w) / jnp.maximum(jnp.sum(w), 1.0)
 
-    loss, grads = jax.value_and_grad(loss_fn)(state.params)
-    updates, opt_state = opt.update(grads, state.opt_state, state.params)
-    params = optax.apply_updates(state.params, updates)
-    return TrainState(params, opt_state, key, state.step + 1), loss
+def make_sharded_step_nc(
+    model: HGCNNodeClf,
+    opt,
+    mesh,
+    state: TrainState,
+    g: graph_data.DeviceGraph,
+):
+    """dp×tp NC train step over ``mesh`` — the NC twin of
+    `make_sharded_step_lp`: per-node cross-entropy terms shard over the
+    data-like axes (GSPMD partitions the node-dim compute and inserts the
+    gradient all-reduce), 2-D kernels column-shard over ``model``.
+    Returns ``(step, placed_state, placed_graph)``; call as
+    ``state, loss = step(state, g, labels, train_mask)``.
+    """
+    from hyperspace_tpu.parallel.mesh import batch_sharding, replicated
+    from hyperspace_tpu.parallel.tp import replicated_like, state_shardings
+
+    state_sh = state_shardings(state, state.params, mesh)
+    g_sh = replicated_like(g, mesh)
+    nsh = batch_sharding(mesh, ndim=1)
+    constrain = lambda x: jax.lax.with_sharding_constraint(x, nsh)
+
+    step = jax.jit(
+        partial(_nc_step_impl, model, opt, constrain=constrain),
+        in_shardings=(state_sh, g_sh, replicated(mesh), replicated(mesh)),
+        out_shardings=(state_sh, replicated(mesh)),
+        donate_argnums=(0,),
+    )
+    return step, jax.device_put(state, state_sh), jax.device_put(g, g_sh)
 
 
 @partial(jax.jit, static_argnames=("model",))

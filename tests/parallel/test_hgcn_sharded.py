@@ -91,3 +91,35 @@ def test_tp_shards_kernels_and_colocates_moments():
     mu_specs = {str(s.spec) for s in jax.tree_util.tree_leaves(ssh.opt_state)}
     for s in jax.tree_util.tree_leaves(psh):
         assert str(s.spec) in mu_specs
+
+
+def test_sharded_nc_matches_single_device():
+    """NC twin of the LP equivalence: dp×tp sharded step == single device."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    edges, x, labels, ncls = G.synthetic_hierarchy(
+        num_nodes=192, feat_dim=12, num_classes=4, seed=0)
+    tr, va, te = G.node_split_masks(192, seed=0)
+    g = G.prepare(edges, 192, x, labels=labels, num_classes=ncls,
+                  train_mask=tr, val_mask=va, test_mask=te)
+    cfg = hgcn.HGCNConfig(feat_dim=12, hidden_dims=(16, 8), num_classes=ncls)
+    lab = jnp.asarray(g.labels)
+    mask = jnp.asarray(g.train_mask)
+
+    model, opt, state1 = hgcn.init_nc(cfg, g, seed=0)
+    ga1 = G.to_device(g)
+    for _ in range(5):
+        state1, loss1 = hgcn.train_step_nc(model, opt, state1, ga1, lab, mask)
+
+    model, opt, stateN = hgcn.init_nc(cfg, g, seed=0)
+    mesh = make_mesh({"data": 4, "model": 2})
+    step, stateN, gaN = hgcn.make_sharded_step_nc(
+        model, opt, mesh, stateN, G.to_device(g))
+    for _ in range(5):
+        stateN, lossN = step(stateN, gaN, lab, mask)
+
+    np.testing.assert_allclose(float(lossN), float(loss1), rtol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(state1.params),
+                    jax.tree_util.tree_leaves(stateN.params)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-6)
