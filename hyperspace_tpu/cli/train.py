@@ -186,10 +186,17 @@ def run_hybonet(run: RunConfig, overrides: dict):
     model, opt, state = hybonet.init_model(cfg, seed=run.seed)
     toks, mask, labels = (jnp.asarray(tr.tokens), jnp.asarray(tr.mask),
                           jnp.asarray(tr.labels))
-    state, loss = _train_loop(
-        run, state,
-        lambda st: hybonet.train_step_sampled(model, opt, st, toks, mask,
-                                              labels))
+    from hyperspace_tpu.parallel.mesh import auto_mesh
+
+    mesh = auto_mesh(run.multihost)
+    if mesh is not None:
+        step, state, (toks, mask, labels) = hybonet.make_sharded_step(
+            model, opt, mesh, state, toks, mask, labels)
+        stepper = lambda st: step(st, toks, mask, labels)
+    else:
+        stepper = lambda st: hybonet.train_step_sampled(model, opt, st, toks,
+                                                        mask, labels)
+    state, loss = _train_loop(run, state, stepper)
     res = hybonet.evaluate(model, state.params, te)
     return {"workload": "hybonet", "source": source, "loss": float(loss), **res}
 
@@ -203,9 +210,18 @@ def run_hvae(run: RunConfig, overrides: dict):
     model, opt, state = hvae.init_model(cfg, seed=run.seed)
     x_all = jnp.asarray(ds.images, cfg.dtype)
     metrics = {}
+    from hyperspace_tpu.parallel.mesh import auto_mesh
+
+    mesh = auto_mesh(run.multihost)
+    if mesh is not None:
+        step, state, x_all = hvae.make_sharded_step(model, opt, mesh, state,
+                                                    x_all)
+        fn = lambda st: step(st, x_all)
+    else:
+        fn = lambda st: hvae.train_step_sampled(model, opt, st, x_all)
 
     def stepper(st):
-        st, loss, recon, kl = hvae.train_step_sampled(model, opt, st, x_all)
+        st, loss, recon, kl = fn(st)
         metrics["rk"] = (recon, kl)  # device arrays; fetched once at the end
         return st, loss
 

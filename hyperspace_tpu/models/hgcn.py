@@ -384,8 +384,9 @@ def round_up_pairs(pairs: np.ndarray, mesh) -> np.ndarray:
     data-axis extent (GSPMD needs the sharded axis divisible).  Repeats
     the leading edges cyclically — a negligible reweighting of a batch
     that already covers every positive edge each step."""
-    d = int(np.prod([mesh.shape[a] for a in ("host", "data")
-                     if a in mesh.axis_names]))
+    from hyperspace_tpu.parallel.mesh import data_extent
+
+    d = data_extent(mesh)
     n = -(-pairs.shape[0] // d) * d
     return np.resize(np.asarray(pairs), (n, 2))
 

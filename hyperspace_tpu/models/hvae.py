@@ -133,10 +133,13 @@ def init_model(cfg: HVAEConfig, seed: int = 0):
     return model, opt, TrainState(params, opt.init(params), key, jnp.zeros((), jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
-def train_step(model: HVAE, opt, state: TrainState, x: jax.Array):
+def _step_impl(model, opt, state, x, constrain=None):
+    """Shared step body; ``constrain`` pins the batch's sharding (the
+    only difference between the single-device and mesh-sharded steps)."""
     key, k_sample = jax.random.split(state.key)
     prior = model.prior(x.dtype)
+    if constrain is not None:
+        x = constrain(x)
 
     def loss_fn(params):
         out = model.apply({"params": params}, x, k_sample)
@@ -148,6 +151,11 @@ def train_step(model: HVAE, opt, state: TrainState, x: jax.Array):
     updates, opt_state = opt.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
     return TrainState(params, opt_state, key, state.step + 1), loss, recon, kl
+
+
+@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
+def train_step(model: HVAE, opt, state: TrainState, x: jax.Array):
+    return _step_impl(model, opt, state, x)
 
 
 @partial(jax.jit, static_argnames=("model", "k"))
@@ -164,6 +172,13 @@ def iwae_bound(model: HVAE, params, x: jax.Array, key: jax.Array, k: int = 16):
     return jnp.mean(jax.nn.logsumexp(logw, axis=0) - jnp.log(float(k)))
 
 
+def _sampled_impl(model, opt, state, x_all, constrain=None):
+    key, k_next = jax.random.split(state.key)
+    idx = jax.random.randint(k_next, (model.cfg.batch_size,), 0, x_all.shape[0])
+    return _step_impl(model, opt, state._replace(key=key), x_all[idx],
+                      constrain)
+
+
 @partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
 def train_step_sampled(model: HVAE, opt, state: TrainState, x_all: jax.Array):
     """Like :func:`train_step` but samples the minibatch on device from
@@ -171,9 +186,34 @@ def train_step_sampled(model: HVAE, opt, state: TrainState, x_all: jax.Array):
     inside the (checkpointed) TrainState, and the step remains one XLA
     program with no host-side indexing (SURVEY.md §5 "Checkpoint /
     resume": data-iterator state)."""
-    key, k_next = jax.random.split(state.key)
-    idx = jax.random.randint(k_next, (model.cfg.batch_size,), 0, x_all.shape[0])
-    return train_step(model, opt, state._replace(key=key), x_all[idx])
+    return _sampled_impl(model, opt, state, x_all)
+
+
+def make_sharded_step(model: HVAE, opt, mesh, state: TrainState, x_all):
+    """Data-parallel sampled train step over ``mesh``: the on-device
+    minibatch shards over the data-like axes (XLA inserts the gradient
+    all-reduce over ICI/DCN — SURVEY.md §2 N8), the dataset array is
+    placed replicated ONCE (re-broadcasting it per step would swamp the
+    step).  Returns ``(step, placed_state, placed_x)``; call as
+    ``state, loss, recon, kl = step(state, x_all)``."""
+    from hyperspace_tpu.parallel.mesh import data_extent, replicated, shard_batch
+    from hyperspace_tpu.parallel.tp import state_shardings
+
+    d = data_extent(mesh)
+    if model.cfg.batch_size % d:
+        raise ValueError(
+            f"batch_size={model.cfg.batch_size} not divisible by the "
+            f"mesh's data extent {d}")
+    state_sh = state_shardings(state, state.params, mesh)
+    repl = replicated(mesh)
+    step = jax.jit(
+        partial(_sampled_impl, model, opt,
+                constrain=partial(shard_batch, mesh=mesh)),
+        in_shardings=(state_sh, repl),
+        out_shardings=(state_sh, repl, repl, repl),
+        donate_argnums=(0,),
+    )
+    return step, jax.device_put(state, state_sh), jax.device_put(x_all, repl)
 
 
 def train(cfg: HVAEConfig, images: np.ndarray, steps: int = 200, seed: int = 0):

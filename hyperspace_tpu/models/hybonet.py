@@ -128,10 +128,12 @@ def init_model(cfg: HyboNetConfig, seed: int = 0):
     return model, opt, state
 
 
-@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
-def train_step(model, opt, state: TrainState, tokens, mask, labels):
-    """One step over a [B, L] batch — a single XLA program."""
+def _step_impl(model, opt, state, tokens, mask, labels, constrain=None):
+    """Shared step body; ``constrain`` pins the batch's sharding (the
+    only difference between the single-device and mesh-sharded steps)."""
     key, k_drop = jax.random.split(state.key)
+    if constrain is not None:
+        tokens, mask, labels = (constrain(t) for t in (tokens, mask, labels))
 
     def loss_fn(params):
         logits = model.apply(
@@ -146,9 +148,22 @@ def train_step(model, opt, state: TrainState, tokens, mask, labels):
     return TrainState(params, opt_state, key, state.step + 1), loss
 
 
+@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
+def train_step(model, opt, state: TrainState, tokens, mask, labels):
+    """One step over a [B, L] batch — a single XLA program."""
+    return _step_impl(model, opt, state, tokens, mask, labels)
+
+
 @partial(jax.jit, static_argnames=("model",))
 def eval_logits(model, params, tokens, mask):
     return model.apply({"params": params}, tokens, mask)
+
+
+def _sampled_impl(model, opt, state, toks, mask, labels, constrain=None):
+    key, k_next = jax.random.split(state.key)
+    idx = jax.random.randint(k_next, (model.cfg.batch_size,), 0, toks.shape[0])
+    return _step_impl(model, opt, state._replace(key=key),
+                      toks[idx], mask[idx], labels[idx], constrain)
 
 
 @partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
@@ -156,10 +171,36 @@ def train_step_sampled(model, opt, state: TrainState, toks, mask, labels):
     """Minibatch sampled on device from ``state.key``: the data-iterator
     state is the (checkpointed) PRNG key and the step stays one XLA
     program (SURVEY.md §5 "Checkpoint / resume": data-iterator state)."""
-    key, k_next = jax.random.split(state.key)
-    idx = jax.random.randint(k_next, (model.cfg.batch_size,), 0, toks.shape[0])
-    return train_step(model, opt, state._replace(key=key),
-                      toks[idx], mask[idx], labels[idx])
+    return _sampled_impl(model, opt, state, toks, mask, labels)
+
+
+def make_sharded_step(model, opt, mesh, state: TrainState, toks, mask, labels):
+    """Data-parallel sampled train step over ``mesh``: the on-device
+    minibatch shards over the data-like axes (XLA inserts the gradient
+    all-reduce over ICI/DCN — SURVEY.md §2 N8), the dataset arrays are
+    placed replicated ONCE (re-broadcasting them per step would swamp the
+    step).  Returns ``(step, placed_state, (toks, mask, labels))``; call
+    as ``state, loss = step(state, toks, mask, labels)``.  ``batch_size``
+    must divide the data-axis extent."""
+    from hyperspace_tpu.parallel.mesh import data_extent, replicated, shard_batch
+    from hyperspace_tpu.parallel.tp import state_shardings
+
+    d = data_extent(mesh)
+    if model.cfg.batch_size % d:
+        raise ValueError(
+            f"batch_size={model.cfg.batch_size} not divisible by the "
+            f"mesh's data extent {d}")
+    state_sh = state_shardings(state, state.params, mesh)
+    repl = replicated(mesh)
+    step = jax.jit(
+        partial(_sampled_impl, model, opt,
+                constrain=partial(shard_batch, mesh=mesh)),
+        in_shardings=(state_sh, repl, repl, repl),
+        out_shardings=(state_sh, repl),
+        donate_argnums=(0,),
+    )
+    data = tuple(jax.device_put(t, repl) for t in (toks, mask, labels))
+    return step, jax.device_put(state, state_sh), data
 
 
 def train(cfg: HyboNetConfig, ds, steps: int = 200, seed: int = 0):

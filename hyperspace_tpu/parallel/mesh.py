@@ -105,3 +105,9 @@ def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
 def shard_batch(x: jax.Array, mesh: Mesh) -> jax.Array:
     """Constrain an in-program value to batch sharding (GSPMD hint)."""
     return jax.lax.with_sharding_constraint(x, batch_sharding(mesh, x.ndim))
+
+
+def data_extent(mesh: Mesh) -> int:
+    """Total size of the data-like (batch-sharding) axes of ``mesh``."""
+    return int(np.prod([mesh.shape[a] for a in ("host", "data")
+                        if a in mesh.axis_names]))

@@ -1,0 +1,94 @@
+"""Every sampled-minibatch workload's dp step matches single-device
+(hybonet, hvae — hgcn and product have their own equivalence suites).
+
+Same PRNG stream both ways → identical sampled batches; only collective
+reduction order differs (float tolerance, not bitwise).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.parallel.mesh import make_mesh
+
+
+def _assert_trees_close(a, b, rtol=2e-4, atol=1e-5):
+    # atol dominates for near-zero params (Adam's eps floor turns
+    # reduction-order noise into large *relative* error on tiny weights)
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x),
+                                   rtol=rtol, atol=atol)
+
+
+def test_hybonet_dp_matches_single_device():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from hyperspace_tpu.data.text import synthetic_text
+    from hyperspace_tpu.models import hybonet
+
+    ds = synthetic_text(num_samples=96, seed=0)
+    cfg = hybonet.HyboNetConfig(
+        vocab_size=ds.vocab_size, num_classes=ds.num_classes,
+        max_len=ds.tokens.shape[1], dim=16, num_heads=2, num_layers=1,
+        batch_size=32)
+    toks, mask, labels = (jnp.asarray(ds.tokens), jnp.asarray(ds.mask),
+                          jnp.asarray(ds.labels))
+
+    model, opt, s1 = hybonet.init_model(cfg, seed=0)
+    for _ in range(4):
+        s1, l1 = hybonet.train_step_sampled(model, opt, s1, toks, mask, labels)
+
+    model, opt, sN = hybonet.init_model(cfg, seed=0)
+    mesh = make_mesh({"data": 8})
+    step, sN, (toks, mask, labels) = hybonet.make_sharded_step(
+        model, opt, mesh, sN, toks, mask, labels)
+    for _ in range(4):
+        sN, lN = step(sN, toks, mask, labels)
+
+    np.testing.assert_allclose(float(lN), float(l1), rtol=2e-5)
+    _assert_trees_close(s1.params, sN.params)
+
+
+def test_hvae_dp_matches_single_device():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from hyperspace_tpu.data.mnist import synthetic_mnist
+    from hyperspace_tpu.models import hvae
+
+    ds = synthetic_mnist(num_samples=128, seed=0)
+    cfg = hvae.HVAEConfig(image_size=ds.images.shape[1], latent_dim=4,
+                          batch_size=32)
+    x_all = jnp.asarray(ds.images, cfg.dtype)
+
+    model, opt, s1 = hvae.init_model(cfg, seed=0)
+    for _ in range(3):
+        s1, l1, _, _ = hvae.train_step_sampled(model, opt, s1, x_all)
+
+    model, opt, sN = hvae.init_model(cfg, seed=0)
+    mesh = make_mesh({"host": 2, "data": 4})
+    step, sN, x_all = hvae.make_sharded_step(model, opt, mesh, sN, x_all)
+    for _ in range(3):
+        sN, lN, _, _ = step(sN, x_all)
+
+    np.testing.assert_allclose(float(lN), float(l1), rtol=5e-5)
+    _assert_trees_close(s1.params, sN.params)
+
+
+def test_sharded_step_rejects_indivisible_batch():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from hyperspace_tpu.data.text import synthetic_text
+    from hyperspace_tpu.models import hybonet
+
+    ds = synthetic_text(num_samples=24, seed=0)
+    cfg = hybonet.HyboNetConfig(
+        vocab_size=ds.vocab_size, num_classes=ds.num_classes,
+        max_len=ds.tokens.shape[1], dim=16, num_heads=2, num_layers=1,
+        batch_size=12)  # not divisible by 8
+    model, opt, state = hybonet.init_model(cfg, seed=0)
+    with pytest.raises(ValueError, match="not divisible"):
+        hybonet.make_sharded_step(model, opt, make_mesh({"data": 8}), state,
+                                  jnp.asarray(ds.tokens), jnp.asarray(ds.mask),
+                                  jnp.asarray(ds.labels))
