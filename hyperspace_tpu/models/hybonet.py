@@ -181,7 +181,7 @@ def make_sharded_step(model, opt, mesh, state: TrainState, toks, mask, labels):
     placed replicated ONCE (re-broadcasting them per step would swamp the
     step).  Returns ``(step, placed_state, (toks, mask, labels))``; call
     as ``state, loss = step(state, toks, mask, labels)``.  ``batch_size``
-    must divide the data-axis extent."""
+    must be divisible by the data-axis extent."""
     from hyperspace_tpu.parallel.mesh import data_extent, replicated, shard_batch
     from hyperspace_tpu.parallel.tp import state_shardings
 

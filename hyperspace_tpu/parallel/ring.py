@@ -30,11 +30,17 @@ from hyperspace_tpu.manifolds import Lorentz, smath
 from hyperspace_tpu.nn.attention import minkowski_gram
 
 
-def _fold_block(q, kj, vj, c, beta, tau, carry):
-    """One online-softmax fold of KV block (kj, vj) into the carry."""
+def _fold_block(q, kj, vj, c, beta, tau, carry, mask_j=None):
+    """One online-softmax fold of KV block (kj, vj) into the carry;
+    ``mask_j`` ([B, Lk_block] bool, batch-level key padding) drops padded
+    keys — expanded here to align with logits of any rank."""
     m_run, l_run, s_run = carry
     gram = minkowski_gram(q, kj)
     logits = (2.0 / c + 2.0 * gram + beta) / tau
+    if mask_j is not None:
+        mj = mask_j.reshape(
+            mask_j.shape[0], *([1] * (logits.ndim - 3)), 1, mask_j.shape[-1])
+        logits = jnp.where(mj, logits, -jnp.inf)
     m_new = jnp.maximum(m_run, jnp.max(logits, axis=-1))
     m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
     alpha = jnp.exp(jnp.where(jnp.isfinite(m_run), m_run - m_safe, -jnp.inf))
@@ -53,11 +59,15 @@ def ring_lorentz_attention(
     *,
     beta: jax.Array | float = 0.0,
     tau: jax.Array | float = 1.0,
+    k_mask: Optional[jax.Array] = None,  # [B, Lk_local] bool key padding
 ) -> jax.Array:
     """Per-device body of ring attention; call inside shard_map.
 
-    Equivalent to full (unmasked) :func:`lorentz_attention` over the
-    gathered sequence, without ever materializing it on one device.
+    Equivalent to :func:`lorentz_attention` over the gathered sequence
+    (with ``mask`` broadcast from the batch-level key-padding mask when
+    ``k_mask`` is given), without ever materializing it on one device.
+    The mask shard rotates around the ring with its KV shard; the
+    unmasked path carries no mask at all (no extra collective payload).
     """
     c = jnp.asarray(manifold.c, q.dtype)
     n = jax.lax.psum(1, axis_name)
@@ -71,15 +81,18 @@ def ring_lorentz_attention(
     s0 = jnp.zeros_like(q)
 
     def body(i, state):
-        kv, carry = state
-        kj, vj = kv
-        carry = _fold_block(q, kj, vj, c, beta, tau, carry)
-        # rotate KV one hop around the ring (skipped data is re-sent; the
-        # last hop's permute is dead code XLA removes when n is static)
-        kv = jax.lax.ppermute((kj, vj), axis_name, perm)
-        return kv, carry
+        kvm, carry = state
+        carry = _fold_block(q, kvm[0], kvm[1], c, beta, tau, carry,
+                            mask_j=(kvm[2] if k_mask is not None else None))
+        # rotate KV (+ mask) one hop around the ring (skipped data is
+        # re-sent; the last hop's permute is dead code XLA removes when n
+        # is static)
+        kvm = jax.lax.ppermute(kvm, axis_name, perm)
+        return kvm, carry
 
-    (_, (m_f, l_f, s_f)) = jax.lax.fori_loop(0, n, body, ((k, v), (m0, l0, s0)))
+    kvm0 = (k, v) if k_mask is None else (k, v, k_mask)
+    (_, (m_f, l_f, s_f)) = jax.lax.fori_loop(
+        0, n, body, (kvm0, (m0, l0, s0)))
     s = s_f / smath.clamp_min(l_f, smath.min_norm(q.dtype))[..., None]
     sp = jnp.sum(s[..., 1:] * s[..., 1:], axis=-1, keepdims=True) - s[..., :1] * s[..., :1]
     nrm = smath.safe_sqrt(smath.clamp_min(-sp, smath.eps_for(q.dtype)))
@@ -96,19 +109,32 @@ def ring_attention_sharded(
     *,
     beta: jax.Array | float = 0.0,
     tau: jax.Array | float = 1.0,
+    k_mask: Optional[jax.Array] = None,  # [B, L] bool key-padding mask
 ) -> jax.Array:
     """shard_map wrapper: shards the sequence axis over ``axis`` and runs
-    the ring.  Batch/head axes stay replicated across the seq axis."""
+    the ring.  Batch/head axes stay replicated across the seq axis.
+    ``k_mask`` is batch-level (same contract as the Ulysses wrapper);
+    omitting it compiles the maskless ring — no mask ever rides the
+    collectives."""
     seq_spec = P(*((None,) * (q.ndim - 2) + (axis, None)))
+
+    if k_mask is None:
+        @partial(jax.shard_map, mesh=mesh,
+                 in_specs=(seq_spec, seq_spec, seq_spec), out_specs=seq_spec)
+        def run(q, k, v):
+            return ring_lorentz_attention(
+                q, k, v, manifold, axis, beta=beta, tau=tau)
+
+        return run(q, k, v)
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(seq_spec, seq_spec, seq_spec),
+        in_specs=(seq_spec, seq_spec, seq_spec, P(None, axis)),
         out_specs=seq_spec,
     )
-    def run(q, k, v):
+    def run(q, k, v, mk):
         return ring_lorentz_attention(
-            q, k, v, manifold, axis, beta=beta, tau=tau)
+            q, k, v, manifold, axis, beta=beta, tau=tau, k_mask=mk)
 
-    return run(q, k, v)
+    return run(q, k, v, k_mask)

@@ -43,6 +43,7 @@ def ulysses_lorentz_attention(
     *,
     beta: jax.Array | float = 0.0,
     tau: jax.Array | float = 1.0,
+    k_mask: jax.Array | None = None,  # [B, L_local] bool key padding
 ) -> jax.Array:
     """Per-device body; call inside shard_map over ``axis_name``.
 
@@ -56,7 +57,14 @@ def ulysses_lorentz_attention(
     a2a = partial(jax.lax.all_to_all, axis_name=axis_name,
                   split_axis=1, concat_axis=2, tiled=True)
     qh, kh, vh = a2a(q), a2a(k), a2a(v)        # [B, H/n, L, D]
-    out = lorentz_attention(qh, kh, vh, manifold, beta=beta, tau=tau)
+    mask = None
+    if k_mask is not None:
+        # the head-sharded view sees the FULL sequence of keys — gather
+        # the key-padding mask and broadcast over heads/queries
+        mk = jax.lax.all_gather(k_mask, axis_name, axis=-1, tiled=True)
+        mask = mk[:, None, None, :]  # [B, 1, 1, L]
+    out = lorentz_attention(qh, kh, vh, manifold, beta=beta, tau=tau,
+                            mask=mask)
     # head-sharded -> seq-sharded: split sequence, gather heads
     return jax.lax.all_to_all(out, axis_name=axis_name,
                               split_axis=2, concat_axis=1, tiled=True)
@@ -72,14 +80,25 @@ def ulysses_attention_sharded(
     *,
     beta: jax.Array | float = 0.0,
     tau: jax.Array | float = 1.0,
+    k_mask: jax.Array | None = None,  # [B, L] bool key-padding mask
 ) -> jax.Array:
-    """shard_map wrapper: shards the sequence axis (dim 2) over ``axis``."""
+    """shard_map wrapper: shards the sequence axis (dim 2) over ``axis``.
+    Omitting ``k_mask`` compiles the maskless path (no mask all_gather)."""
     spec = P(None, None, axis, None)
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-             out_specs=spec)
-    def run(q, k, v):
-        return ulysses_lorentz_attention(q, k, v, manifold, axis,
-                                         beta=beta, tau=tau)
+    if k_mask is None:
+        @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                 out_specs=spec)
+        def run(q, k, v):
+            return ulysses_lorentz_attention(q, k, v, manifold, axis,
+                                             beta=beta, tau=tau)
 
-    return run(q, k, v)
+        return run(q, k, v)
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(spec, spec, spec, P(None, axis)), out_specs=spec)
+    def run(q, k, v, mk):
+        return ulysses_lorentz_attention(q, k, v, manifold, axis,
+                                         beta=beta, tau=tau, k_mask=mk)
+
+    return run(q, k, v, k_mask)

@@ -52,3 +52,39 @@ def test_ring_under_jit_compiles_collectives(mesh8):
     # grads flow through ppermute
     g = jax.grad(lambda q: jnp.sum(f(q)[..., 1:] ** 2))(q)
     assert bool(jnp.isfinite(g).all())
+
+
+def test_ring_with_key_padding_mask_matches_dense(mesh8):
+    """Masked ring == dense attention with the same key-padding mask (the
+    long-context path must support padded batches, not just packed ones)."""
+    m = Lorentz(1.0)
+    L = 32
+    q = _pts(jax.random.PRNGKey(4), m, (2, L, 7))
+    rng = np.random.default_rng(0)
+    k_mask = jnp.asarray(rng.random((2, L)) > 0.3)
+    dense = lorentz_attention(q, q, q, m, mask=k_mask[:, None, :])
+    ring = ring_attention_sharded(q, q, q, m, mesh8, "seq", k_mask=k_mask)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               rtol=1e-9, atol=1e-11)
+
+
+def test_ring_body_direct_shard_map_unmasked(mesh8):
+    """ring_lorentz_attention with k_mask=None must work inside a caller's
+    own shard_map (no mask in the loop carry — regression for the
+    varying-type carry mismatch)."""
+    from functools import partial as fpartial
+
+    from hyperspace_tpu.parallel.ring import ring_lorentz_attention
+    from jax.sharding import PartitionSpec as P
+
+    m = Lorentz(1.0)
+    q = _pts(jax.random.PRNGKey(6), m, (2, 32, 7))
+    spec = P(None, "seq", None)
+
+    @fpartial(jax.shard_map, mesh=mesh8, in_specs=(spec,), out_specs=spec)
+    def run(q):
+        return ring_lorentz_attention(q, q, q, m, "seq")
+
+    dense = lorentz_attention(q, q, q, m)
+    np.testing.assert_allclose(np.asarray(run(q)), np.asarray(dense),
+                               rtol=1e-9, atol=1e-11)

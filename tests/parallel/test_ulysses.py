@@ -72,3 +72,15 @@ def test_ulysses_rejects_indivisible_heads(mesh8):
     q = _pts(jax.random.PRNGKey(7), m, (1, 6, 16, 5))  # 6 heads, 8 devices
     with pytest.raises(ValueError, match="divisible"):
         ulysses_attention_sharded(q, q, q, m, mesh8, "seq")
+
+
+def test_ulysses_with_key_padding_mask_matches_dense(mesh8):
+    m = Lorentz(1.0)
+    B, H, L, D = 2, 8, 32, 7
+    q = _pts(jax.random.PRNGKey(5), m, (B, H, L, D))
+    rng = np.random.default_rng(1)
+    k_mask = jnp.asarray(rng.random((B, L)) > 0.3)
+    dense = lorentz_attention(q, q, q, m, mask=k_mask[:, None, None, :])
+    uly = ulysses_attention_sharded(q, q, q, m, mesh8, "seq", k_mask=k_mask)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(dense),
+                               rtol=1e-9, atol=1e-11)
