@@ -8,7 +8,8 @@ hyperspace_tpu, so the goldens catch silent formula drift in the library
     python scripts/gen_golden.py
 """
 
-from mpmath import mp, mpf, sqrt, atanh, asinh, acosh, tanh, cosh, sinh
+from mpmath import (acos, acosh, asinh, atanh, cos, cosh, mp, mpf, sin,
+                    sinh, sqrt, tanh)
 
 mp.dps = 50
 
@@ -91,6 +92,18 @@ def lorentz_expmap(x, v, c):
     return [cosh(s) * xi + sinh(s) * vi / s for xi, vi in zip(x, v)]
 
 
+def sphere_point(theta, phi, c):
+    """Spherical coordinates on the radius-1/√c sphere in R³."""
+    r = 1 / sqrt(c)
+    return [r * sin(theta) * cos(phi), r * sin(theta) * sin(phi),
+            r * cos(theta)]
+
+
+def sphere_dist(x, y, c):
+    """Great-circle distance: r·angle = arccos(c⟨x,y⟩)/√c."""
+    return acos(c * dot(x, y)) / sqrt(c)
+
+
 def fmt(v):
     if isinstance(v, list):
         return "[" + ", ".join(fmt(t) for t in v) + "]"
@@ -127,3 +140,13 @@ if __name__ == "__main__":
     tv = [vi + coef * xi for vi, xi in zip(v4, lx)]
     print("LORENTZ_TANGENT_C1 =", fmt(tv))
     print("LORENTZ_EXPMAP_C1 =", fmt(lorentz_expmap(lx, tv, c1)))
+
+    sx = sphere_point(mpf("0.4"), mpf("1.1"), c2)
+    sy = sphere_point(mpf("1.3"), mpf("-0.5"), c2)
+    print("SPHERE_X_C07 =", fmt(sx))
+    print("SPHERE_Y_C07 =", fmt(sy))
+    print("SPHERE_DIST_C07 =", fmt(sphere_dist(sx, sy, c2)))
+    # same points rescaled onto the unit sphere
+    s = sqrt(c2)
+    print("SPHERE_DIST_C1 =", fmt(sphere_dist(
+        [v * s for v in sx], [v * s for v in sy], c1)))
