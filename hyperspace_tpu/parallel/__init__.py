@@ -7,10 +7,23 @@ GSPMD or shard_map — no hand-written transport.
 """
 
 from hyperspace_tpu.parallel.mesh import (  # noqa: F401
+    auto_mesh,
     batch_sharding,
+    data_extent,
     make_mesh,
+    multihost_mesh,
     replicated,
     shard_batch,
 )
-from hyperspace_tpu.parallel.ring import ring_lorentz_attention  # noqa: F401
-from hyperspace_tpu.parallel.ulysses import ulysses_lorentz_attention  # noqa: F401
+from hyperspace_tpu.parallel.ring import (  # noqa: F401
+    ring_attention_sharded,
+    ring_lorentz_attention,
+)
+from hyperspace_tpu.parallel.tp import (  # noqa: F401
+    state_shardings,
+    tp_param_shardings,
+)
+from hyperspace_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention_sharded,
+    ulysses_lorentz_attention,
+)
