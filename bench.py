@@ -125,33 +125,33 @@ def main() -> None:
     args = p.parse_args()
 
     import functools
+    import traceback
 
     hgcn_fn = functools.partial(bench_hgcn, dtype=args.dtype,
                                 agg_dtype=args.agg_dtype,
                                 use_att=args.use_att, step=args.step,
                                 decoder_dtype=args.decoder_dtype)
-    order = {
-        "auto": [hgcn_fn, bench_poincare],
-        "hgcn": [hgcn_fn],
-        "poincare": [bench_poincare],
-    }[args.metric]
+    primary = bench_poincare if args.metric == "poincare" else hgcn_fn
 
-    last_err = None
-    result = None
-    for fn in order:
-        try:
-            result = fn(repeats=args.repeats)
-            break
-        except Exception as e:  # fall through to the next available benchmark
-            last_err = e
-    if result is None:
-        print(json.dumps({"metric": "error", "value": 0, "unit": "",
-                          "vs_baseline": None,
-                          "detail": {"error": repr(last_err)}}))
-        sys.exit(1)
-    if args.metric == "auto" and result["metric"] != "poincare_embed_epoch_time":
+    # the headline metric NEVER switches silently: a failure of the
+    # selected benchmark (hgcn under auto) is reported as metric="error"
+    # with the traceback, not papered over with a different green metric
+    failed = False
+    try:
+        result = primary(repeats=args.repeats)
+    except Exception as e:
+        failed = True
+        result = {"metric": "error", "value": 0, "unit": "",
+                  "vs_baseline": None,
+                  "detail": {"error": repr(e),
+                             "traceback": traceback.format_exc(),
+                             "failed_benchmark": (
+                                 "poincare" if args.metric == "poincare"
+                                 else "hgcn")}}
+    if args.metric == "auto":
         # both BASELINE metrics in the one JSON line: hgcn stays the
-        # headline, the poincare epoch time rides in detail
+        # headline (or the error record), the poincare epoch time rides
+        # in detail either way
         try:
             p = bench_poincare(repeats=max(1, args.repeats - 1))
             result["detail"]["poincare_embed_epoch_time_s"] = p["value"]
@@ -159,6 +159,8 @@ def main() -> None:
         except Exception as e:
             result["detail"]["poincare_error"] = repr(e)
     print(json.dumps(result))
+    if failed:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -91,7 +91,7 @@ def run_hgcn_bench(
     if step == "pairs":
         pos = hgcn.make_planned_pairs(split.train_pos, num_nodes)
         neg_u, neg_plan = hgcn.make_static_negatives(
-            num_nodes, int(pos.u.shape[0]), seed=0)
+            num_nodes, int(pos.u.shape[0]) * cfg.neg_per_pos, seed=0)
         step_fn = lambda st: hgcn.train_step_lp_pairs(
             model, opt, num_nodes, st, ga, pos, neg_u, neg_plan)
     else:
@@ -134,8 +134,9 @@ def run_hgcn_bench(
             "agg_dtype": agg_dtype,
             "use_att": use_att,
             "step": step,
-            # the lp step's decoder never consults decoder_dtype — record
-            # what actually executed, not the unused flag
-            "decoder_dtype": decoder_dtype if step == "pairs" else None,
+            # both steps run the training decoder pass through
+            # cfg.decoder_dtype (HGCNLinkPred casts z whenever
+            # deterministic=False), so the record is the flag as executed
+            "decoder_dtype": decoder_dtype,
         },
     }

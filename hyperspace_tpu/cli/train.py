@@ -43,6 +43,7 @@ class RunConfig:
     resume: bool = False
     data_root: str | None = None  # on-disk dataset directory
     multihost: bool = False  # jax.distributed.initialize + host mesh axis
+    tp: int = 2  # tensor-parallel degree for HGCN's auto mesh (1 = pure dp)
     coordinator: str = "127.0.0.1:9357"
     num_processes: int = 1
     process_id: int = 0
@@ -129,7 +130,7 @@ def run_hgcn(run: RunConfig, overrides: dict):
     num_nodes = x.shape[0]
     from hyperspace_tpu.parallel.mesh import auto_mesh
 
-    mesh = auto_mesh(run.multihost, tp=2)
+    mesh = auto_mesh(run.multihost, tp=run.tp)
     if task == "lp":
         split = G.split_edges(edges, num_nodes, x, seed=run.seed)
         model, opt, state = hgcn.init_lp(cfg, split.graph, seed=run.seed)

@@ -310,6 +310,10 @@ def train_step_lp_pairs(
     pair count as `train_step_lp`; the only unsorted scatter left in the
     decoder backward is the negatives' fresh-random v side, which cannot
     be pre-planned (VERDICT r1 #6)."""
+    assert neg_u.shape[0] == pos.u.shape[0] * model.cfg.neg_per_pos, (
+        f"neg_u has {neg_u.shape[0]} rows; cfg.neg_per_pos="
+        f"{model.cfg.neg_per_pos} needs {pos.u.shape[0]} * neg_per_pos "
+        "(size the static negatives with make_static_negatives accordingly)")
     key, k_neg, k_drop = jax.random.split(state.key, 3)
     neg_v = jax.random.randint(k_neg, neg_u.shape, 0, num_nodes)
 

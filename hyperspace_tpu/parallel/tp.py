@@ -75,14 +75,19 @@ def state_shardings(state: Any, params: Any, mesh: Mesh,
     # longest-suffix-first so a param path that is itself a suffix of
     # another's can never shadow the longer match
     by_path = sorted(
-        ((tuple(_path_names(p)), tp_param_spec(p, l, axis)) for p, l in flat),
+        ((tuple(_path_names(p)), tp_param_spec(p, l, axis),
+          getattr(l, "shape", ())) for p, l in flat),
         key=lambda kv: -len(kv[0]))
 
     def spec_for(path, leaf):
         names = tuple(_path_names(path))
-        for ppath, spec in by_path:
+        for ppath, spec, pshape in by_path:
             if len(names) >= len(ppath) and names[-len(ppath):] == ppath:
-                return spec
+                # a state leaf only inherits the param's spec if its shape
+                # is compatible — optax transforms may carry per-parameter
+                # state of a different rank (e.g. scalars keyed by the
+                # param name), which must fall back to replication
+                return spec if getattr(leaf, "shape", ()) == pshape else P()
         return P()
 
     return jax.tree_util.tree_map_with_path(

@@ -1,0 +1,72 @@
+"""bench.py headline-metric contract (VERDICT r2 weak #3 / next #7).
+
+Under ``--metric auto`` a failing HGCN benchmark must surface as
+``metric: "error"`` with the traceback — never silently fall through to a
+green Poincaré line about a different metric.
+"""
+
+import json
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def bench_mod(monkeypatch):
+    sys.path.insert(0, "/root/repo")
+    import bench
+
+    yield bench
+    sys.path.remove("/root/repo")
+
+
+def _stub_poincare(repeats=1):
+    return {"metric": "poincare_embed_epoch_time", "value": 0.5, "unit": "s",
+            "vs_baseline": None, "detail": {"num_nodes": 10}}
+
+
+def test_auto_hgcn_failure_reports_error(bench_mod, monkeypatch, capsys):
+    def boom(repeats=1, **kw):
+        raise RuntimeError("synthetic hgcn failure")
+
+    monkeypatch.setattr(bench_mod, "bench_hgcn", boom)
+    monkeypatch.setattr(bench_mod, "bench_poincare", _stub_poincare)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--metric", "auto"])
+    with pytest.raises(SystemExit) as ei:
+        bench_mod.main()
+    assert ei.value.code == 1
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "error"
+    assert "synthetic hgcn failure" in out["detail"]["error"]
+    assert "RuntimeError" in out["detail"]["traceback"]
+    assert out["detail"]["failed_benchmark"] == "hgcn"
+    # poincare still rides along in detail — available, just not headline
+    assert out["detail"]["poincare_embed_epoch_time_s"] == 0.5
+
+
+def test_auto_success_keeps_hgcn_headline(bench_mod, monkeypatch, capsys):
+    def ok(repeats=1, **kw):
+        return {"metric": "hgcn_samples_per_sec_per_chip", "value": 1e6,
+                "unit": "samples/s/chip", "vs_baseline": None, "detail": {}}
+
+    monkeypatch.setattr(bench_mod, "bench_hgcn", ok)
+    monkeypatch.setattr(bench_mod, "bench_poincare", _stub_poincare)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--metric", "auto"])
+    bench_mod.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "hgcn_samples_per_sec_per_chip"
+    assert out["detail"]["poincare_embed_epoch_time_s"] == 0.5
+
+
+def test_explicit_poincare_failure_is_error(bench_mod, monkeypatch, capsys):
+    def boom(repeats=1):
+        raise ValueError("poincare broke")
+
+    monkeypatch.setattr(bench_mod, "bench_poincare", boom)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--metric", "poincare"])
+    with pytest.raises(SystemExit) as ei:
+        bench_mod.main()
+    assert ei.value.code == 1
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "error"
+    assert out["detail"]["failed_benchmark"] == "poincare"
