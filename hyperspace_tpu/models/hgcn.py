@@ -436,6 +436,86 @@ def make_sharded_step_lp(
     return step, jax.device_put(state, state_sh), jax.device_put(g, g_sh)
 
 
+def make_node_sharded_step_lp(
+    model: HGCNLinkPred,
+    opt,
+    num_nodes: int,
+    mesh,
+    state: TrainState,
+    split: graph_data.LinkSplit,
+):
+    """LP train step whose ENCODER work divides across the mesh.
+
+    `make_sharded_step_lp` shards only the supervision pairs — the
+    full-graph encoder (~95% of step time) is replicated per device.
+    This builder instead node-shards the graph (`parallel/node_shard`):
+    the [N, F] activations, every matmul row, and each shard's slice of
+    the edge aggregation live on one device; the only collective in the
+    encoder is an [N, F] all-gather per layer per direction riding ICI.
+    Per-device FLOPs and HBM bytes scale ~1/ndev (asserted by
+    tests/parallel/test_node_sharded.py's compiled-cost check).
+
+    Mean aggregation only (the bench default); attention raises in
+    HGCConv.  Returns ``(step, placed_state, placed_graph)``; call as
+    ``state, loss = step(state, nsg, train_pos)``.
+    """
+    from hyperspace_tpu.parallel.mesh import batch_sharding, replicated
+    from hyperspace_tpu.parallel.node_shard import graph_shardings, shard_graph
+    from hyperspace_tpu.parallel.tp import state_shardings
+
+    nsg = shard_graph(split.graph, mesh)
+    state_sh = state_shardings(state, state.params, mesh)
+    bsh = batch_sharding(mesh, ndim=2)
+    constrain = lambda x: jax.lax.with_sharding_constraint(x, bsh)
+
+    step = jax.jit(
+        partial(_lp_step_impl, model, opt, num_nodes, constrain=constrain),
+        in_shardings=(state_sh, graph_shardings(nsg), replicated(mesh)),
+        out_shardings=(state_sh, replicated(mesh)),
+        donate_argnums=(0,),
+    )
+    return step, jax.device_put(state, state_sh), nsg
+
+
+def make_node_sharded_step_nc(
+    model: HGCNNodeClf,
+    opt,
+    mesh,
+    state: TrainState,
+    g: graph_data.Graph,
+):
+    """NC twin of `make_node_sharded_step_lp`: node-sharded encoder, with
+    labels/train-mask padded to the sharded node count and the per-node
+    cross-entropy terms sharded over the same axes.  Returns
+    ``(step, placed_state, placed_graph, labels, train_mask)``.
+    """
+    from hyperspace_tpu.parallel.mesh import replicated
+    from hyperspace_tpu.parallel.node_shard import (
+        graph_shardings,
+        pad_node_array,
+        shard_graph,
+    )
+    from hyperspace_tpu.parallel.tp import state_shardings
+
+    nsg = shard_graph(g, mesh)
+    n_pad = nsg.x.shape[0]
+    labels = jnp.asarray(pad_node_array(g.labels, n_pad, 0))
+    train_mask = jnp.asarray(pad_node_array(g.train_mask, n_pad, False))
+    state_sh = state_shardings(state, state.params, mesh)
+    nsh = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(nsg.axes))
+    constrain = lambda x: jax.lax.with_sharding_constraint(x, nsh)
+
+    step = jax.jit(
+        partial(_nc_step_impl, model, opt, constrain=constrain),
+        in_shardings=(state_sh, graph_shardings(nsg),
+                      replicated(mesh), replicated(mesh)),
+        out_shardings=(state_sh, replicated(mesh)),
+        donate_argnums=(0,),
+    )
+    return step, jax.device_put(state, state_sh), nsg, labels, train_mask
+
+
 @partial(jax.jit, static_argnames=("model",))
 def eval_scores_lp(model: HGCNLinkPred, params, g: graph_data.DeviceGraph, pairs):
     return model.apply({"params": params}, g, pairs)

@@ -150,7 +150,6 @@ class HGCConv(nn.Module):
         m_out = make_manifold(self.kind, c_out)
 
         n = x.shape[0]
-        senders, receivers, edge_mask = g.senders, g.receivers, g.edge_mask
         v = tangent0_coords(m_in, x)  # [N, d_in]
         kernel = self.param("kernel", self.kernel_init, (v.shape[-1], self.features), v.dtype)
         h = v @ kernel  # the MXU matmul
@@ -158,6 +157,26 @@ class HGCConv(nn.Module):
             h = h + self.param("bias", nn.initializers.zeros, (self.features,), v.dtype)
         if self.dropout_rate > 0.0:
             h = nn.Dropout(self.dropout_rate)(h, deterministic=deterministic)
+
+        # node-sharded graphs (parallel/node_shard.py) carry their own
+        # per-shard edge lists + precomputed mean weights: aggregation is
+        # a shard_map (all-gather + local block-CSR) and the rest of the
+        # layer is ordinary row-wise math that GSPMD keeps node-sharded
+        if hasattr(g, "w_fwd"):
+            from hyperspace_tpu.parallel.node_shard import (
+                node_sharded_aggregate,
+            )
+
+            if self.use_att:
+                raise NotImplementedError(
+                    "node-sharded HGCConv supports mean aggregation only "
+                    "(attention softmax needs cross-shard normalization); "
+                    "use use_att=False or the replicated-graph sharded step")
+            agg = node_sharded_aggregate(h, g, self.agg_dtype).astype(h.dtype)
+            out = from_tangent0_coords(m_out, self.activation(agg))
+            return out, m_out
+
+        senders, receivers, edge_mask = g.senders, g.receivers, g.edge_mask
 
         sorted_fast = g.rev_perm is not None
         w_static = False

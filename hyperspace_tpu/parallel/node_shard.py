@@ -1,0 +1,282 @@
+"""Node-sharded graph aggregation — the pod actually divides the work.
+
+VERDICT r2 measured that the dp axis of `make_sharded_step_lp` shards only
+the supervision pairs: the full-graph encoder (~95% of step time) was
+replicated on every device, so a dp=8 mesh left 95% of single-device FLOPs
+on every chip.  This module shards the *node dimension* instead — the
+TPU-native analogue of the reference trainer's graph partitioning
+(SURVEY.md §2 N8, §7 hard-part #3):
+
+- **Host-side partition** (:func:`partition_graph`): nodes are split into
+  ``ndev`` contiguous blocks (the receiver-sorted edge layout from
+  ``data.graphs.prepare`` makes each block's incoming edges a contiguous
+  slice); each shard gets its own receiver-local edge list, per-edge mean
+  weights, and block-CSR plan, all padded to common static shapes.
+- **Device-side aggregation** (:func:`node_sharded_aggregate`): a
+  ``jax.shard_map`` over the data-like mesh axes.  Each device all-gathers
+  the [N, F] activations over ICI (the one collective; at bf16 this is
+  ~N·F·2 bytes, ≪ the E·F gather it feeds), then runs *its shard's*
+  gather + block-CSR segment-sum — E/ndev edges and N/ndev output rows
+  per device.
+- **Symmetric backward without cross-shard scatters**: for a symmetric
+  edge list, dh[i] = Σ_{e: s_e=i} w_e·ḡ[r_e] re-indexes through the edge
+  involution onto *receiver*-side edges (the nn/scatter.py identity), and
+  every receiver-side edge of shard k lives on shard k.  So the backward
+  is the same all-gather (of ḡ) + local planned segment-sum, with the
+  reverse-edge weights ``w_bwd[e] = 1/deg[s_e]`` precomputed on host.
+  No scatter ever crosses a shard boundary.
+
+Mean aggregation only: the bench- and quality-default HGCN path.  (The
+attention path's softmax normalization needs cross-shard max/sum of
+runtime values; its node-sharded variant is a further round's work —
+`HGCConv` raises explicitly.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hyperspace_tpu.data import graphs as graph_data
+from hyperspace_tpu.kernels.segment import build_csr_plan, csr_segment_sum
+
+_BN = 128   # node-block rows (must match kernels.segment._BN tiling)
+_BK = 512   # edge-chunk size (must match kernels.segment._BK)
+
+
+class NodeShardedGraph(NamedTuple):
+    """Device-resident node-sharded graph (pytree; statics in aux data).
+
+    Per-edge arrays are [ndev, E_s] so a ``P(axes, None)`` sharding gives
+    each device exactly its shard's slice; ``senders`` hold *global* node
+    ids (they index the all-gathered activations), ``recv`` holds
+    *shard-local* receiver ids, ascending within each shard.
+    """
+
+    x: Any          # [N_pad, F] node features, node-sharded
+    senders: Any    # [ndev, E_s] int32 global sender ids
+    recv: Any       # [ndev, E_s] int32 local receiver ids (sorted)
+    w_fwd: Any      # [ndev, E_s] f32 forward mean weights (0 on padding)
+    w_bwd: Any      # [ndev, E_s] f32 reverse-edge weights (0 on padding)
+    plan: tuple     # 3 × [ndev, T] int32 padded block-CSR work items
+    num_nodes: int  # static: real node count (< N_pad)
+    n_shard: int    # static: nodes per shard (N_pad = n_shard · ndev)
+    mesh: Any       # static: jax.sharding.Mesh
+    axes: tuple     # static: data-like mesh axis names the nodes shard over
+
+
+def _nsg_flatten(g: NodeShardedGraph):
+    return ((g.x, g.senders, g.recv, g.w_fwd, g.w_bwd, g.plan),
+            (g.num_nodes, g.n_shard, g.mesh, g.axes))
+
+
+def _nsg_unflatten(aux, leaves):
+    x, s, r, wf, wb, plan = leaves
+    return NodeShardedGraph(x, s, r, wf, wb, plan, *aux)
+
+
+jax.tree_util.register_pytree_node(NodeShardedGraph, _nsg_flatten, _nsg_unflatten)
+
+
+def data_axes(mesh: Mesh) -> tuple:
+    """The data-like axes of ``mesh`` (nodes shard over these)."""
+    return tuple(a for a in ("host", "data") if a in mesh.axis_names)
+
+
+class HostPartition(NamedTuple):
+    """Host-side (numpy) result of :func:`partition_graph`."""
+
+    x: np.ndarray        # [N_pad, F]
+    senders: np.ndarray  # [ndev, E_s] global
+    recv: np.ndarray     # [ndev, E_s] local sorted
+    w_fwd: np.ndarray    # [ndev, E_s]
+    w_bwd: np.ndarray    # [ndev, E_s]
+    plan: tuple          # 3 × [ndev, T]
+    num_nodes: int
+    n_shard: int
+
+
+def partition_graph(g: graph_data.Graph, ndev: int,
+                    bn: int = _BN, bk: int = _BK) -> HostPartition:
+    """Partition a `prepare`-built symmetric graph into ``ndev`` node shards.
+
+    Requires ``g`` built by ``data.graphs.prepare(symmetrize=True)`` (so
+    the receiver-sorted layout, the masked degree, and the edge involution
+    invariants hold — the backward identity needs every edge's reverse to
+    exist).  Shard k owns nodes [k·n_shard, (k+1)·n_shard) and exactly the
+    edges whose receiver falls in that range.
+
+    Plan padding: every shard's edge list ends with one full all-padding
+    chunk, and plan rows are padded with (last block, last chunk,
+    first=0) items — the padding chunk's values are zero, so the extra
+    work items are exact no-ops in the kernel.
+    """
+    if g.rev_perm is None or g.deg is None:
+        raise ValueError(
+            "partition_graph needs a symmetric prepare()-built graph "
+            "(rev_perm/deg missing)")
+    n = g.num_nodes
+    per_dev = -(-n // ndev)                 # ceil(n / ndev)
+    n_shard = (-(-per_dev // bn)) * bn      # rounded up to whole node blocks
+    n_pad = n_shard * ndev
+
+    x = np.zeros((n_pad, g.x.shape[1]), np.float32)
+    x[:n] = g.x
+
+    mask = np.asarray(g.edge_mask)
+    s = np.asarray(g.senders)[mask]
+    r = np.asarray(g.receivers)[mask]
+    deg = np.maximum(np.asarray(g.deg), 1.0)
+
+    bounds = np.searchsorted(r, np.arange(ndev + 1) * n_shard)
+    counts = np.diff(bounds)
+    # every shard ends with ≥ one full all-padding chunk so padded plan
+    # items always have an inert chunk to point at
+    e_s = (-(-max(int(counts.max()), 1) // bk)) * bk + bk
+
+    senders = np.zeros((ndev, e_s), np.int32)
+    recv = np.full((ndev, e_s), n_shard - 1, np.int32)
+    w_fwd = np.zeros((ndev, e_s), np.float32)
+    w_bwd = np.zeros((ndev, e_s), np.float32)
+    plans = []
+    for k in range(ndev):
+        lo, hi = bounds[k], bounds[k + 1]
+        m = hi - lo
+        senders[k, :m] = s[lo:hi]
+        recv[k, :m] = r[lo:hi] - k * n_shard
+        w_fwd[k, :m] = 1.0 / deg[r[lo:hi]]
+        # weight of the reverse edge (r, s): 1/deg of ITS receiver, s —
+        # the backward identity's w∘π without any cross-shard lookup
+        w_bwd[k, :m] = 1.0 / deg[s[lo:hi]]
+        plans.append(build_csr_plan(recv[k], n_shard, bn, bk))
+
+    t_max = max(p.block.shape[0] for p in plans)
+    nb, nchunks = n_shard // bn, e_s // bk
+    plan = tuple(np.full((ndev, t_max), fill, np.int32)
+                 for fill in (nb - 1, nchunks - 1, 0))
+    for k, p in enumerate(plans):
+        t = p.block.shape[0]
+        plan[0][k, :t] = p.block
+        plan[1][k, :t] = p.chunk
+        plan[2][k, :t] = p.first
+    return HostPartition(x, senders, recv, w_fwd, w_bwd, plan, n, n_shard)
+
+
+def graph_shardings(g: NodeShardedGraph) -> NodeShardedGraph:
+    """Sharding pytree matching ``g`` (for jit in_shardings) — the aux
+    statics are copied from ``g`` so the tree structures are identical."""
+    sh = NamedSharding(g.mesh, P(g.axes, None))
+    return NodeShardedGraph(sh, sh, sh, sh, sh, (sh, sh, sh),
+                            g.num_nodes, g.n_shard, g.mesh, g.axes)
+
+
+def to_device_sharded(hp: HostPartition, mesh: Mesh,
+                      axes: Optional[tuple] = None) -> NodeShardedGraph:
+    """Place a :class:`HostPartition` on ``mesh`` as a NodeShardedGraph."""
+    axes = data_axes(mesh) if axes is None else axes
+    ndev = int(np.prod([mesh.shape[a] for a in axes])) if axes else 1
+    if hp.senders.shape[0] != ndev:
+        raise ValueError(
+            f"partition has {hp.senders.shape[0]} shards but mesh axes "
+            f"{axes} have extent {ndev}")
+    sh = NamedSharding(mesh, P(axes, None))
+    put = lambda a: jax.device_put(jnp.asarray(a), sh)
+    return NodeShardedGraph(
+        x=put(hp.x), senders=put(hp.senders), recv=put(hp.recv),
+        w_fwd=put(hp.w_fwd), w_bwd=put(hp.w_bwd),
+        plan=tuple(put(a) for a in hp.plan),
+        num_nodes=hp.num_nodes, n_shard=hp.n_shard, mesh=mesh, axes=axes)
+
+
+def shard_graph(g: graph_data.Graph, mesh: Mesh,
+                axes: Optional[tuple] = None) -> NodeShardedGraph:
+    """partition_graph + to_device_sharded in one call."""
+    axes = data_axes(mesh) if axes is None else axes
+    ndev = int(np.prod([mesh.shape[a] for a in axes])) if axes else 1
+    return to_device_sharded(partition_graph(g, ndev), mesh, axes)
+
+
+# --- the sharded aggregation --------------------------------------------------
+
+
+def _local_segsum(msgs, recv, pb, pc, pf, n_shard):
+    """Per-shard sorted segment-sum: block-CSR kernel on TPU, XLA sorted
+    scatter elsewhere — same dispatch contract as nn/scatter.py."""
+    return csr_segment_sum(msgs, recv, (pb, pc, pf), n_shard)
+
+
+def _gather_aggregate(mesh, axes, n_shard, h, w, senders, recv, pb, pc, pf):
+    """all_gather(h) over the node-sharding axes, then local planned
+    aggregation of this shard's edges.  Used for forward (w = w_fwd) and,
+    via the edge involution, for backward (h = ḡ, w = w_bwd)."""
+
+    def body(h_l, w_l, s_l, r_l, pb_l, pc_l, pf_l):
+        h_full = jax.lax.all_gather(h_l, axes, axis=0, tiled=True)
+        msgs = w_l[0][:, None] * h_full[s_l[0]]
+        return _local_segsum(msgs, r_l[0], pb_l[0], pc_l[0], pf_l[0], n_shard)
+
+    spec = P(axes, None)
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec,) * 7, out_specs=spec, check_vma=False,
+    )(h, w, senders, recv, pb, pc, pf)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _nsagg(mesh, axes, n_shard, h, w_fwd, w_bwd, senders, recv, pb, pc, pf):
+    """out[r] = Σ_{e: recv_e = r} w_e · h[senders_e], node-sharded."""
+    return _gather_aggregate(mesh, axes, n_shard, h, w_fwd,
+                             senders, recv, pb, pc, pf)
+
+
+def _nsagg_fwd(mesh, axes, n_shard, h, w_fwd, w_bwd, senders, recv, pb, pc, pf):
+    out = _gather_aggregate(mesh, axes, n_shard, h, w_fwd,
+                            senders, recv, pb, pc, pf)
+    return out, (w_bwd, senders, recv, pb, pc, pf)
+
+
+def _nsagg_bwd(mesh, axes, n_shard, res, g):
+    w_bwd, senders, recv, pb, pc, pf = res
+    # dh[i] = Σ_{e: s_e = i} w_e ḡ[r_e]  =  Σ_{e: r_e = i} w_{π(e)} ḡ[s_e]
+    # — the nn/scatter.py involution identity, which lands every term on
+    # the shard that owns node i; so the backward is the same collective-
+    # plus-local-CSR program as the forward with (ḡ, w_bwd) in place of
+    # (h, w_fwd).  Weights are static (mean aggregation): no dw.
+    dh = _gather_aggregate(mesh, axes, n_shard, g, w_bwd,
+                           senders, recv, pb, pc, pf)
+    return dh, None, None, None, None, None, None, None
+
+
+_nsagg.defvjp(_nsagg_fwd, _nsagg_bwd)
+
+
+def node_sharded_aggregate(h: jax.Array, g: NodeShardedGraph,
+                           agg_dtype: Optional[Any] = None) -> jax.Array:
+    """Mean-aggregate ``h`` over ``g``'s edges, node-sharded over
+    ``g.axes``; returns [N_pad, F] in ``h``'s dtype (f32 accumulation).
+
+    ``agg_dtype`` (e.g. bf16) casts the activations *before* the
+    all-gather — halving the ICI bytes as well as the edge-gather HBM
+    traffic, same contract as HGCConv's ``agg_dtype``.
+    """
+    out_dt = h.dtype
+    if agg_dtype is not None:
+        h = h.astype(agg_dtype)
+    w_f = g.w_fwd.astype(h.dtype)
+    w_b = g.w_bwd.astype(h.dtype)
+    out = _nsagg(g.mesh, g.axes, g.n_shard, h, w_f, w_b,
+                 g.senders, g.recv, *g.plan)
+    return out.astype(out_dt)
+
+
+def pad_node_array(a: np.ndarray, n_pad: int, fill=0) -> np.ndarray:
+    """Pad a per-node host array to the sharded node count ``n_pad``."""
+    a = np.asarray(a)
+    out = np.full((n_pad,) + a.shape[1:], fill, a.dtype)
+    out[: a.shape[0]] = a
+    return out

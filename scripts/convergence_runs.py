@@ -1,0 +1,97 @@
+"""Full-scale HGCN LP convergence runs (VERDICT r2 next #3).
+
+Trains the bench-scale (169 k-node) graph to AUC plateau for three arms —
+the f32 control, the bf16 bench default, and attention aggregation with
+the same dtype policy — 3 seeds each, logging a val-AUC curve every
+``--eval-every`` steps and the final test AUC.  One JSON line per event;
+tee stdout into docs/data/ and summarize in docs/benchmarks.md.
+
+Seed-major order: after one seed's worth of wall-clock every arm has a
+complete curve, so a truncated session still yields a comparable table.
+
+    python scripts/convergence_runs.py --steps 6000 --eval-every 500
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def arms(hgcn, jnp, feat_dim, which="all"):
+    base = dict(feat_dim=feat_dim, hidden_dims=(128, 32), kind="lorentz")
+    all_ = [
+        # f32 control through the same planned-pairs step as the bench
+        ("pairs_f32", hgcn.HGCNConfig(**base)),
+        # the bench default: f32 compute, bf16 edge messages + decoder pass
+        ("pairs_f32_aggbf16_decbf16",
+         hgcn.HGCNConfig(**base, agg_dtype=jnp.bfloat16,
+                         decoder_dtype=jnp.bfloat16)),
+        # attention aggregation under the identical dtype policy — the
+        # mean-vs-att quality comparison at bench scale, 3 seeds
+        ("pairs_att_aggbf16_decbf16",
+         hgcn.HGCNConfig(**base, use_att=True, agg_dtype=jnp.bfloat16,
+                         decoder_dtype=jnp.bfloat16)),
+    ]
+    if which == "all":
+        return all_
+    sel = which.split(",")
+    unknown = [s for s in sel if s not in {n for n, _ in all_}]
+    if unknown:
+        raise SystemExit(f"unknown arm(s) {unknown}")
+    return [t for t in all_ if t[0] in sel]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=None,
+                    help="default: full bench scale (ARXIV_NODES)")
+    ap.add_argument("--steps", type=int, default=6000)
+    ap.add_argument("--eval-every", type=int, default=500)
+    ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--arms", default="all")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.benchmarks import hgcn_bench as HB
+    from hyperspace_tpu.models import hgcn
+
+    n = args.nodes or HB.ARXIV_NODES
+    split, x = HB.arxiv_scale_split(n)
+    ga = hgcn._device_graph(split.graph)
+    pos = hgcn.make_planned_pairs(split.train_pos, n)
+    neg_u, neg_plan = hgcn.make_static_negatives(n, int(pos.u.shape[0]), seed=0)
+    sel = arms(hgcn, jnp, x.shape[1], args.arms)
+
+    for seed in range(args.seeds):
+        for name, cfg in sel:
+            model, opt, state = hgcn.init_lp(cfg, split.graph, seed=seed)
+            t0 = time.perf_counter()
+            for i in range(args.steps):
+                state, loss = hgcn.train_step_lp_pairs(
+                    model, opt, n, state, ga, pos, neg_u, neg_plan)
+                if (i + 1) % args.eval_every == 0:
+                    ev = hgcn.evaluate_lp(model, state.params, split, "val",
+                                          ga=ga)
+                    print(json.dumps({
+                        "phase": "curve", "config": name, "seed": seed,
+                        "step": i + 1, "loss": float(loss),
+                        "val_auc": round(ev["roc_auc"], 4),
+                        "elapsed_s": round(time.perf_counter() - t0, 1),
+                    }), flush=True)
+            test = hgcn.evaluate_lp(model, state.params, split, "test", ga=ga)
+            val = hgcn.evaluate_lp(model, state.params, split, "val", ga=ga)
+            print(json.dumps({
+                "phase": "final", "config": name, "seed": seed,
+                "nodes": n, "steps": args.steps, "loss": float(loss),
+                "test_auc": round(test["roc_auc"], 4),
+                "val_auc": round(val["roc_auc"], 4),
+                "train_s": round(time.perf_counter() - t0, 1),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,253 @@
+"""Node-sharded HGCN training (VERDICT r2 next #1).
+
+The point of this file is twofold: (a) the node-sharded step computes the
+SAME training trajectory as the single-device step, and (b) — the part r2
+showed was missing — the mesh actually *divides* the work: compiled
+per-device FLOPs and HBM bytes at dp=8 must drop to a fraction of the
+single-device step, not stay ~95% like the pair-sharded step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.data import graphs as G
+from hyperspace_tpu.models import hgcn
+from hyperspace_tpu.parallel.mesh import make_mesh
+from hyperspace_tpu.parallel import node_shard as NS
+
+
+def _setup(num_nodes=256, seed=0):
+    edges, x, labels, ncls = G.synthetic_hierarchy(
+        num_nodes=num_nodes, feat_dim=12, num_classes=4, seed=seed)
+    split = G.split_edges(edges, num_nodes, x, seed=seed, pad_multiple=128)
+    cfg = hgcn.HGCNConfig(feat_dim=12, hidden_dims=(16, 8))
+    return cfg, split, (edges, x, labels, ncls)
+
+
+# --- host-side partition invariants ------------------------------------------
+
+
+def test_partition_covers_every_edge_once():
+    _, split, _ = _setup()
+    g = split.graph
+    ndev = 4
+    hp = NS.partition_graph(g, ndev)
+    # real (sender, receiver) multiset must be preserved exactly
+    mask = g.edge_mask
+    want = sorted(zip(g.receivers[mask].tolist(), g.senders[mask].tolist()))
+    got = []
+    for k in range(ndev):
+        real = hp.w_fwd[k] > 0
+        got += list(zip((hp.recv[k][real] + k * hp.n_shard).tolist(),
+                        hp.senders[k][real].tolist()))
+    assert sorted(got) == want
+
+
+def test_partition_receivers_local_sorted_and_weights():
+    _, split, _ = _setup()
+    g = split.graph
+    hp = NS.partition_graph(g, 4)
+    deg = np.maximum(g.deg, 1.0)
+    for k in range(4):
+        r = hp.recv[k]
+        assert np.all(np.diff(r) >= 0), "local receivers must stay sorted"
+        assert np.all(r >= 0) and np.all(r < hp.n_shard)
+        real = hp.w_fwd[k] > 0
+        glob_r = r[real] + k * hp.n_shard
+        np.testing.assert_allclose(hp.w_fwd[k][real], 1.0 / deg[glob_r],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(hp.w_bwd[k][real],
+                                   1.0 / deg[hp.senders[k][real]], rtol=1e-6)
+
+
+def test_padded_plan_items_are_inert(interp_kernels):
+    """The [ndev, T] plan rows are padded with (last block, last chunk,
+    first=0) items; the Pallas kernel must treat them as exact no-ops."""
+    _, split, _ = _setup()
+    hp = NS.partition_graph(split.graph, 4)
+    for k in range(4):
+        vals = np.zeros((hp.recv.shape[1], 8), np.float32)
+        real = hp.w_fwd[k] > 0
+        vals[real] = np.random.default_rng(k).standard_normal(
+            (int(real.sum()), 8)).astype(np.float32)
+        plan = tuple(jnp.asarray(p[k]) for p in hp.plan)
+        got = hgcn.graph_data  # noqa: F841  (keep import surface stable)
+        from hyperspace_tpu.kernels.segment import csr_segment_sum
+
+        out = csr_segment_sum(jnp.asarray(vals), jnp.asarray(hp.recv[k]),
+                              plan, hp.n_shard)
+        want = jax.ops.segment_sum(jnp.asarray(vals),
+                                   jnp.asarray(hp.recv[k]), hp.n_shard)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+@pytest.fixture
+def interp_kernels(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "interpret")
+
+
+# --- aggregation equivalence --------------------------------------------------
+
+
+def _mesh_or_skip(axes):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_mesh(axes)
+
+
+@pytest.mark.parametrize("axes", [
+    {"data": 8},
+    pytest.param({"host": 2, "data": 4}, marks=pytest.mark.slow),
+])
+def test_aggregate_matches_segment_sum(axes):
+    mesh = _mesh_or_skip(axes)
+    _, split, _ = _setup()
+    g = split.graph
+    nsg = NS.shard_graph(g, mesh)
+    n_pad = nsg.x.shape[0]
+    rng = np.random.default_rng(0)
+    h = jnp.asarray(rng.standard_normal((n_pad, 16)).astype(np.float32))
+
+    out = node_agg = NS.node_sharded_aggregate(h, nsg)
+    # oracle: plain masked mean aggregation on the unsharded layout
+    w = g.edge_mask / np.maximum(g.deg, 1.0)[g.receivers]
+    msgs = np.asarray(w)[:, None] * np.asarray(h)[g.senders]
+    want = jax.ops.segment_sum(jnp.asarray(msgs, jnp.float32),
+                               jnp.asarray(g.receivers), g.num_nodes)
+    np.testing.assert_allclose(np.asarray(out)[: g.num_nodes],
+                               np.asarray(want), rtol=1e-5, atol=1e-5)
+    assert np.asarray(node_agg).shape == (n_pad, 16)
+
+
+def test_aggregate_gradient_matches_dense(rng):
+    """d/dh of a scalar of the sharded aggregation == the dense jacobian
+    path computed on the unsharded layout (the involution backward)."""
+    mesh = _mesh_or_skip({"data": 8})
+    _, split, _ = _setup(num_nodes=192)
+    g = split.graph
+    nsg = NS.shard_graph(g, mesh)
+    n_pad = nsg.x.shape[0]
+    h0 = jnp.asarray(rng.standard_normal((n_pad, 8)).astype(np.float32))
+    probe = jnp.asarray(rng.standard_normal((n_pad, 8)).astype(np.float32))
+
+    def f_sharded(h):
+        return jnp.sum(NS.node_sharded_aggregate(h, nsg) * probe)
+
+    w = jnp.asarray(
+        (g.edge_mask / np.maximum(g.deg, 1.0)[g.receivers]).astype(np.float32))
+    recv = jnp.asarray(g.receivers)
+    send = jnp.asarray(g.senders)
+
+    def f_dense(h):
+        msgs = w[:, None] * h[send]
+        out = jax.ops.segment_sum(msgs, recv, g.num_nodes)
+        return jnp.sum(out * probe[: g.num_nodes])
+
+    gs = jax.grad(f_sharded)(h0)
+    gd = jax.grad(f_dense)(h0)  # padded rows get zero grad naturally
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gd),
+                               rtol=1e-4, atol=1e-5)
+
+
+# --- full train-step equivalence ----------------------------------------------
+
+
+@pytest.mark.parametrize("axes", [
+    {"data": 8},
+    {"data": 4, "model": 2},
+])
+def test_node_sharded_lp_matches_single_device(axes):
+    mesh = _mesh_or_skip(axes)
+    cfg, split, _ = _setup(num_nodes=192)
+    n = split.graph.num_nodes
+    steps = 3
+    train_pos = jnp.asarray(hgcn.round_up_pairs(split.train_pos, mesh))
+
+    model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
+    ga = G.to_device(split.graph)
+    for _ in range(steps):
+        state, loss_single = hgcn.train_step_lp(
+            model, opt, n, state, ga, train_pos)
+
+    model2, opt2, state2 = hgcn.init_lp(cfg, split.graph, seed=0)
+    step, state2, nsg = hgcn.make_node_sharded_step_lp(
+        model2, opt2, n, mesh, state2, split)
+    for _ in range(steps):
+        state2, loss_sharded = step(state2, nsg, train_pos)
+
+    np.testing.assert_allclose(float(loss_sharded), float(loss_single),
+                               rtol=1e-4, atol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
+        state.params, state2.params)
+
+
+def test_node_sharded_nc_matches_single_device():
+    mesh = _mesh_or_skip({"data": 8})
+    _, _, (edges, x, labels, ncls) = _setup(num_nodes=192)
+    tr, va, te = G.node_split_masks(192, seed=0)
+    g = G.prepare(edges, 192, x, labels=labels, num_classes=ncls,
+                  train_mask=tr, val_mask=va, test_mask=te)
+    cfg = hgcn.HGCNConfig(feat_dim=12, hidden_dims=(16, 8), num_classes=ncls)
+    steps = 3
+
+    model, opt, state = hgcn.init_nc(cfg, g, seed=0)
+    ga = G.to_device(g)
+    lab, msk = jnp.asarray(g.labels), jnp.asarray(g.train_mask)
+    for _ in range(steps):
+        state, loss_single = hgcn.train_step_nc(model, opt, state, ga, lab, msk)
+
+    model2, opt2, state2 = hgcn.init_nc(cfg, g, seed=0)
+    step, state2, nsg, lab_p, msk_p = hgcn.make_node_sharded_step_nc(
+        model2, opt2, mesh, state2, g)
+    for _ in range(steps):
+        state2, loss_sharded = step(state2, nsg, lab_p, msk_p)
+
+    np.testing.assert_allclose(float(loss_sharded), float(loss_single),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_attention_raises_on_node_sharded():
+    mesh = _mesh_or_skip({"data": 8})
+    cfg, split, _ = _setup(num_nodes=192)
+    cfg = hgcn.HGCNConfig(feat_dim=12, hidden_dims=(16, 8), use_att=True)
+    model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
+    with pytest.raises(NotImplementedError, match="mean aggregation"):
+        step, state, nsg = hgcn.make_node_sharded_step_lp(
+            model, opt, split.graph.num_nodes, mesh, state, split)
+        step(state, nsg, jnp.asarray(
+            hgcn.round_up_pairs(split.train_pos, mesh)))
+
+
+# --- the scaling assertion (the r2 gap) ---------------------------------------
+
+
+@pytest.mark.slow
+def test_per_device_cost_scales_down():
+    """dp=8 must leave ≤35% of the single-device FLOPs and bytes per
+    device (r2's pair-sharded step left 95%/85% — the whole point of the
+    node-sharded path is to fix this)."""
+    mesh = _mesh_or_skip({"data": 8})
+    cfg, split, _ = _setup(num_nodes=2048)
+    n = split.graph.num_nodes
+
+    model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
+    ga = G.to_device(split.graph)
+    train_pos = jnp.asarray(hgcn.round_up_pairs(split.train_pos, mesh))
+    single = jax.jit(
+        lambda st, g, p: hgcn._lp_step_impl(model, opt, n, st, g, p)
+    ).lower(state, ga, train_pos).compile().cost_analysis()
+
+    model2, opt2, state2 = hgcn.init_lp(cfg, split.graph, seed=0)
+    step, state2, nsg = hgcn.make_node_sharded_step_lp(
+        model2, opt2, n, mesh, state2, split)
+    sharded = step.lower(state2, nsg, train_pos).compile().cost_analysis()
+
+    flops_ratio = sharded["flops"] / single["flops"]
+    bytes_ratio = sharded["bytes accessed"] / single["bytes accessed"]
+    assert flops_ratio <= 0.35, f"per-device flops ratio {flops_ratio:.2f}"
+    assert bytes_ratio <= 0.35, f"per-device bytes ratio {bytes_ratio:.2f}"
