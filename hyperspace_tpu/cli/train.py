@@ -137,10 +137,18 @@ def run_hgcn(run: RunConfig, overrides: dict):
         ga = hgcn._device_graph(split.graph)
         if mesh is not None:
             train_pos = jnp.asarray(hgcn.round_up_pairs(split.train_pos, mesh))
-            step, state, ga = hgcn.make_sharded_step_lp(
-                model, opt, num_nodes, mesh, state, ga)
+            if cfg.use_att:
+                # attention needs cross-shard softmax state: fall back to
+                # the replicated-graph step (pairs shard, encoder doesn't)
+                step, state, ga_s = hgcn.make_sharded_step_lp(
+                    model, opt, num_nodes, mesh, state, ga)
+            else:
+                # default multi-chip path: node-sharded encoder — each
+                # device owns N/ndev nodes and their incoming edges
+                step, state, ga_s = hgcn.make_node_sharded_step_lp(
+                    model, opt, num_nodes, mesh, state, split)
             state, loss = _train_loop(
-                run, state, lambda st: step(st, ga, train_pos))
+                run, state, lambda st: step(st, ga_s, train_pos))
         else:
             train_pos = jnp.asarray(split.train_pos)
             state, loss = _train_loop(
@@ -158,10 +166,15 @@ def run_hgcn(run: RunConfig, overrides: dict):
         lab = jnp.asarray(g.labels)
         mask = jnp.asarray(g.train_mask)
         if mesh is not None:
-            step, state, ga = hgcn.make_sharded_step_nc(
-                model, opt, mesh, state, ga)
+            if cfg.use_att:
+                step, state, ga_s = hgcn.make_sharded_step_nc(
+                    model, opt, mesh, state, ga)
+                lab_s, mask_s = lab, mask
+            else:
+                step, state, ga_s, lab_s, mask_s = (
+                    hgcn.make_node_sharded_step_nc(model, opt, mesh, state, g))
             state, loss = _train_loop(
-                run, state, lambda st: step(st, ga, lab, mask))
+                run, state, lambda st: step(st, ga_s, lab_s, mask_s))
         else:
             state, loss = _train_loop(
                 run, state,

@@ -163,17 +163,20 @@ def train_step_sparse(
         k_neg, (cfg.batch_size, cfg.neg_samples), 0, cfg.num_nodes
     )
 
+    b = cfg.batch_size
     all_idx = jnp.concatenate([u_idx, v_idx, neg_idx.reshape(-1)])
-    uniq = jnp.unique(all_idx, size=all_idx.shape[0],
-                      fill_value=cfg.num_nodes)  # sorted; sentinel-padded
-    sub = lambda i: jnp.searchsorted(uniq, i)  # global id -> slot in uniq
+    # return_inverse gives every slot mapping in the one unique call — the
+    # r02 version re-derived them with three searchsorted passes
+    uniq, inv = jnp.unique(all_idx, size=all_idx.shape[0],
+                           fill_value=cfg.num_nodes, return_inverse=True)
     rows = state.table[jnp.minimum(uniq, cfg.num_nodes - 1)]  # [U, d]
 
     def sub_loss(rows):
         ball = PoincareBall(cfg.c)
-        u = rows[sub(u_idx)]
-        cand = jnp.concatenate([v_idx[:, None], neg_idx], axis=1)
-        cv = rows[sub(cand)]
+        u = rows[inv[:b]]
+        cand_slots = jnp.concatenate(
+            [inv[b : 2 * b, None], inv[2 * b :].reshape(b, -1)], axis=1)
+        cv = rows[cand_slots]
         d = ball.dist(u[:, None, :], cv)
         logits = -d
         collide = (neg_idx == v_idx[:, None]) | (neg_idx == u_idx[:, None])

@@ -15,6 +15,12 @@ from hyperspace_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     shard_batch,
 )
+from hyperspace_tpu.parallel.node_shard import (  # noqa: F401
+    NodeShardedGraph,
+    node_sharded_aggregate,
+    partition_graph,
+    shard_graph,
+)
 from hyperspace_tpu.parallel.ring import (  # noqa: F401
     ring_attention_sharded,
     ring_lorentz_attention,
