@@ -16,21 +16,18 @@ import sys
 import time
 
 
-def _time_poincare_epochs(cfg, pairs, steps_per_epoch, repeats) -> float:
+def _time_steps(stepper, state, n_steps, repeats):
+    """min-of-repeats wall time for ``n_steps`` calls of ``stepper``."""
     import jax
 
-    from hyperspace_tpu.models import poincare_embed as pe
-
-    state, opt = pe.init_state(cfg)
-    step_fn = pe.make_train_step(cfg)
     # compile + warmup
-    state, loss = step_fn(cfg, opt, state, pairs)
+    state, loss = stepper(state)
     jax.device_get(loss)
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        for _ in range(steps_per_epoch):
-            state, loss = step_fn(cfg, opt, state, pairs)
+        for _ in range(n_steps):
+            state, loss = stepper(state)
         # device_get, not block_until_ready: remote-attached TPUs (axon
         # tunnel) ack block_until_ready before execution finishes; a host
         # fetch of the loss is the only reliable completion barrier
@@ -39,13 +36,39 @@ def _time_poincare_epochs(cfg, pairs, steps_per_epoch, repeats) -> float:
     return min(times)
 
 
+def _poincare_steppers(cfg, pairs, plan_steps):
+    """(name -> (stepper, fresh_state)) for the three update strategies:
+    dense (whole-table), sparse (device unique), planned (host-planned
+    indices, no device sort / unsorted scatter)."""
+    import dataclasses
+
+    from hyperspace_tpu.models import poincare_embed as pe
+
+    out = {}
+    for name, c in (("dense", cfg),
+                    ("sparse", dataclasses.replace(cfg, sparse=True))):
+        state, opt = pe.init_state(c)
+        step_fn = pe.make_train_step(c)
+        out[name] = ((lambda st, c=c, o=opt, f=step_fn: f(c, o, st, pairs)),
+                     state)
+    state, opt = pe.init_state(cfg)
+    plan = pe.plan_sparse_steps(cfg, pairs, plan_steps, seed=0)
+    out["planned"] = (
+        (lambda st, o=opt, p=plan: pe.train_step_sparse_planned(cfg, o, st, p)),
+        state)
+    return out
+
+
 def bench_poincare(repeats: int = 3) -> dict:
     """Epoch time for Poincaré embeddings on a WordNet-noun-scale tree.
 
-    Times both update strategies — dense (whole-table expmap) and
-    sparse-row (gather/update/scatter of touched rows only,
-    `poincare_embed.train_step_sparse`) — and reports the faster as the
-    headline, with both in ``detail``.
+    Times three update strategies — dense (whole-table expmap), sparse
+    (device-side unique + row scatter), and planned-sparse (host-planned
+    indices; `poincare_embed.train_step_sparse_planned`) — reporting the
+    fastest as the headline.  ``detail.large_table`` re-times dense vs
+    planned at an arxiv-scale table (≥500 k rows) with riemannian_adam,
+    where the per-step moment/table traffic is what the sparse path
+    exists to avoid (SURVEY.md §7 hard-part #2).
     """
     import dataclasses
 
@@ -64,13 +87,34 @@ def bench_poincare(repeats: int = 3) -> dict:
     pairs = jnp.asarray(ds.pairs)
     steps_per_epoch = max(1, ds.num_pairs // cfg.batch_size)
 
-    dense_s = _time_poincare_epochs(cfg, pairs, steps_per_epoch, repeats)
-    sparse_s = _time_poincare_epochs(
-        dataclasses.replace(cfg, sparse=True), pairs, steps_per_epoch, repeats)
-    epoch_s = min(dense_s, sparse_s)
+    epochs = {}
+    for name, (stepper, state) in _poincare_steppers(
+            cfg, pairs, steps_per_epoch).items():
+        epochs[name] = round(_time_steps(stepper, state, steps_per_epoch,
+                                         repeats), 4)
+    update = min(epochs, key=epochs.get)
+
+    # arxiv-scale table: dense pays O(N) table+moment traffic per step,
+    # the planned path O(batch); timed per-step over a fixed step count
+    big = synthetic_tree(depth=6, branching=9)
+    big_cfg = pe.PoincareEmbedConfig(
+        num_nodes=big.num_nodes, dim=10, batch_size=1024, neg_samples=10,
+        optimizer="radam")
+    big_pairs = jnp.asarray(big.pairs)
+    n_big_steps = 50
+    large = {"num_nodes": big.num_nodes, "optimizer": "radam"}
+    for name, (stepper, state) in _poincare_steppers(
+            big_cfg, big_pairs, n_big_steps).items():
+        large[f"{name}_step_ms"] = round(
+            _time_steps(stepper, state, n_big_steps, max(2, repeats - 1))
+            / n_big_steps * 1e3, 3)
+    large["update"] = min(
+        ("dense", "sparse", "planned"),
+        key=lambda n: large[f"{n}_step_ms"])
+
     return {
         "metric": "poincare_embed_epoch_time",
-        "value": round(epoch_s, 4),
+        "value": epochs[update],
         "unit": "s",
         "vs_baseline": None,
         "detail": {
@@ -78,9 +122,9 @@ def bench_poincare(repeats: int = 3) -> dict:
             "num_pairs": ds.num_pairs,
             "steps_per_epoch": steps_per_epoch,
             "batch_size": cfg.batch_size,
-            "dense_epoch_s": round(dense_s, 4),
-            "sparse_epoch_s": round(sparse_s, 4),
-            "update": "sparse" if sparse_s <= dense_s else "dense",
+            **{f"{k}_epoch_s": v for k, v in epochs.items()},
+            "update": update,
+            "large_table": large,
             "backend": jax.default_backend(),
         },
     }

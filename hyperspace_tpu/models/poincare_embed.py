@@ -220,6 +220,185 @@ def make_train_step(cfg: PoincareEmbedConfig):
     return train_step_sparse if cfg.sparse else train_step
 
 
+# --- host-planned sparse updates (VERDICT r2 next #2) -------------------------
+#
+# `train_step_sparse` pays a device-side sort (jnp.unique) every step —
+# measured 3.6x slower than the dense step on TPU at WordNet scale, because
+# the table work it saves is smaller than the sort latency it adds.  The
+# planned variant moves ALL index preparation to the host, amortized over a
+# chunk of steps (the `make_planned_pairs` philosophy from the HGCN LP
+# decoder applied to embedding batches):
+#
+# - batches + negatives are drawn on host (numpy, vectorized over the chunk);
+# - each step's flat index multiset is argsorted ONCE on host, yielding:
+#   uniq (sorted unique rows, sentinel-padded), inv_map (flat position →
+#   slot), order (occurrences sorted by row), seg_sorted (their slots,
+#   ascending);
+# - on device the step is: one sorted gather of touched rows (+ their radam
+#   moment rows), the batch loss through `_dedup_gather` — whose custom VJP
+#   routes every cotangent through gathers and one SORTED segment-sum (no
+#   unsorted scatter anywhere in autodiff) — the optimizer on the [U, d]
+#   sub-table, and three sorted scatter-sets (table, mu, nu) with
+#   ``mode="drop"`` for the sentinel rows.
+#
+# No device sort, no searchsorted, no unsorted scatter: update work is
+# O(B·(2+K)·d) + the sorted-scatter latency, independent of N.
+
+
+class SparsePlan(NamedTuple):
+    """Device-resident plan for S planned-sparse steps (host-built).
+
+    U = B·(2+K) flat index slots per step; all arrays static-shaped.
+    """
+
+    u_idx: jax.Array       # [S, B]
+    v_idx: jax.Array       # [S, B]
+    neg_idx: jax.Array     # [S, B, K]
+    uniq: jax.Array        # [S, U] sorted unique rows, sentinel = num_nodes
+    inv_map: jax.Array     # [S, U] flat position -> slot in uniq
+    order: jax.Array       # [S, U] occurrences argsorted by row id
+    seg_sorted: jax.Array  # [S, U] = inv_map[order] (ascending)
+
+
+def plan_from_indices(cfg: PoincareEmbedConfig, u_idx, v_idx,
+                      neg_idx) -> SparsePlan:
+    """Build the per-step index plans for explicit [S, B] / [S, B, K]
+    batches — one vectorized numpy pass, ~milliseconds per epoch-chunk."""
+    import numpy as np
+
+    steps = u_idx.shape[0]
+    u_idx = np.asarray(u_idx, np.int32)
+    v_idx = np.asarray(v_idx, np.int32)
+    neg_idx = np.asarray(neg_idx, np.int32)
+    flat = np.concatenate(
+        [u_idx, v_idx, neg_idx.reshape(steps, -1)], axis=1)   # [S, U]
+    order = np.argsort(flat, axis=1, kind="stable").astype(np.int32)
+    sorted_ids = np.take_along_axis(flat, order, axis=1)
+    # slot boundaries: a new unique row wherever the sorted id changes
+    new_seg = np.ones_like(sorted_ids, bool)
+    new_seg[:, 1:] = sorted_ids[:, 1:] != sorted_ids[:, :-1]
+    seg_sorted = (np.cumsum(new_seg, axis=1) - 1).astype(np.int32)
+    u_slots = flat.shape[1]
+    uniq = np.full((steps, u_slots), cfg.num_nodes, np.int32)
+    s_grid, _ = np.nonzero(new_seg)
+    uniq[s_grid, seg_sorted[new_seg]] = sorted_ids[new_seg]
+    inv_map = np.empty_like(seg_sorted)
+    np.put_along_axis(inv_map, order, seg_sorted, axis=1)
+    return SparsePlan(*(jnp.asarray(a) for a in
+                        (u_idx, v_idx, neg_idx, uniq, inv_map, order,
+                         seg_sorted)))
+
+
+def plan_sparse_steps(cfg: PoincareEmbedConfig, pairs, steps: int,
+                      seed: int = 0) -> SparsePlan:
+    """Draw ``steps`` batches + negatives on host and plan their indices."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    pairs = np.asarray(pairs)
+    b, k = cfg.batch_size, cfg.neg_samples
+    batch = pairs[rng.integers(0, len(pairs), (steps, b))]    # [S, B, 2]
+    neg_idx = rng.integers(0, cfg.num_nodes, (steps, b, k))
+    return plan_from_indices(cfg, batch[..., 0], batch[..., 1], neg_idx)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _dedup_gather(rows, inv_map, order, seg_sorted, num_slots: int):
+    """rows[inv_map] whose VJP never scatters: the cotangent is permuted
+    into row-sorted occurrence order (a gather) and combined per slot with
+    a SORTED segment-sum."""
+    return rows[inv_map]
+
+
+def _dg_fwd(rows, inv_map, order, seg_sorted, num_slots):
+    return rows[inv_map], (inv_map, order, seg_sorted)
+
+
+def _dg_bwd(num_slots, res, g):
+    inv_map, order, seg_sorted = res
+    acc_dt = jnp.promote_types(g.dtype, jnp.float32)
+    d_rows = jax.ops.segment_sum(
+        g[order].astype(acc_dt), seg_sorted, num_slots,
+        indices_are_sorted=True).astype(g.dtype)
+    return d_rows, None, None, None
+
+
+_dedup_gather.defvjp(_dg_fwd, _dg_bwd)
+
+
+@partial(jax.jit, static_argnames=("cfg", "opt"), donate_argnames=("state",))
+def train_step_sparse_planned(
+    cfg: PoincareEmbedConfig,
+    opt,
+    state: TrainState,
+    plan: SparsePlan,
+) -> tuple[TrainState, jax.Array]:
+    """One planned-sparse step; consumes plan row ``state.step % S``.
+
+    Mathematically identical to the dense step on the planned batch
+    (duplicate cotangents are summed per row before the expmap), with the
+    same lazy-moment radam semantics as `train_step_sparse`.
+    """
+    s = plan.u_idx.shape[0]
+    i = state.step % s
+    take = lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+    u_idx, v_idx, neg_idx, uniq, inv_map, order, seg_sorted = (
+        take(a) for a in plan)
+    b = cfg.batch_size
+    n_slots = uniq.shape[0]
+    safe_uniq = jnp.minimum(uniq, cfg.num_nodes - 1)
+    rows = state.table[safe_uniq]  # [U, d] sorted gather
+
+    def sub_loss(rows):
+        ball = PoincareBall(cfg.c)
+        flat = _dedup_gather(rows, inv_map, order, seg_sorted, n_slots)
+        u = flat[:b]
+        cv = jnp.concatenate(
+            [flat[b : 2 * b, None], flat[2 * b :].reshape(b, -1, rows.shape[-1])],
+            axis=1)
+        d = ball.dist(u[:, None, :], cv)
+        logits = -d
+        collide = (neg_idx == v_idx[:, None]) | (neg_idx == u_idx[:, None])
+        mask = jnp.concatenate(
+            [jnp.zeros_like(v_idx[:, None], bool), collide], axis=1)
+        logits = jnp.where(mask, -jnp.inf, logits)
+        return jnp.mean(jax.nn.logsumexp(logits, axis=1) - logits[:, 0])
+
+    loss, g_rows = jax.value_and_grad(sub_loss)(rows)
+
+    opt_state = state.opt_state
+    if isinstance(opt_state, RAdamState):
+        row_state = RAdamState(
+            count=opt_state.count,
+            mu=opt_state.mu[safe_uniq],
+            nu=opt_state.nu[safe_uniq],
+        )
+        updates, row_state = opt.update(g_rows, row_state, rows)
+        new_opt_state = RAdamState(
+            count=row_state.count,
+            mu=opt_state.mu.at[uniq].set(
+                row_state.mu.astype(opt_state.mu.dtype),
+                mode="drop", indices_are_sorted=True),
+            nu=opt_state.nu.at[uniq].set(
+                row_state.nu.astype(opt_state.nu.dtype),
+                mode="drop", indices_are_sorted=True),
+        )
+    else:
+        updates, new_opt_state = opt.update(g_rows, opt_state, rows)
+    new_rows = optax.apply_updates(rows, updates)
+    table = state.table.at[uniq].set(
+        new_rows.astype(state.table.dtype),
+        mode="drop", indices_are_sorted=True)
+    return TrainState(table, new_opt_state, key_after(state.key),
+                      state.step + 1), loss
+
+
+def key_after(key: jax.Array) -> jax.Array:
+    """Advance the state PRNG key (planned steps draw nothing on device,
+    but the key must still move so dense/sparse states stay interchangeable)."""
+    return jax.random.split(key, 1)[0]
+
+
 def init_state(cfg: PoincareEmbedConfig, seed: int = 0) -> tuple[TrainState, optax.GradientTransformation]:
     """Build the initial state *and* its matching optimizer.
 
