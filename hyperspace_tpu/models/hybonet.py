@@ -52,11 +52,11 @@ class HyboNetConfig:
     weight_decay: float = 1e-4
     dropout: float = 0.0
     batch_size: int = 64
-    # False (default) = kernels/attention.py flash path — the N7 Pallas
-    # kernel on TPU, its dense twin elsewhere.  True = the XLA
-    # online-softmax scan (the ring-attention per-device body).  The
-    # default workload DOES exercise the Pallas kernel on chip.
-    use_tiled_attention: bool = False
+    # "flash" (default) = the N7 Pallas flash-attention kernel on TPU
+    # (kernels/attention.py; dense twin on CPU) — the default workload
+    # executes the flagship kernel on chip.  "scan" = the XLA
+    # online-softmax KV scan (the ring-attention per-device body).
+    attention_impl: str = "flash"
     dtype: Any = jnp.float32
 
 
@@ -71,7 +71,7 @@ class HyboNetBlock(nn.Module):
         att_mask = mask[..., None, :] & mask[..., :, None]  # [B, L, L]
         a = HypMultiHeadAttention(
             dim=cfg.dim, num_heads=cfg.num_heads, manifold=m,
-            use_tiled=cfg.use_tiled_attention, name="mha",
+            impl=cfg.attention_impl, name="mha",
         )(x, mask=att_mask)
         x = m.centroid(jnp.stack([x, a], axis=-2))  # hyperbolic residual
         # FFN sublayer: expand (with tangent ReLU on ambient input) → project

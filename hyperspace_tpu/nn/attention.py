@@ -161,7 +161,10 @@ class HypMultiHeadAttention(nn.Module):
     num_heads: int = 4
     manifold: Lorentz = None  # type: ignore[assignment]
     tau_init: float = 1.0
-    use_tiled: bool = False
+    # "flash" = kernels/attention.flash_attention — the N7 Pallas kernel
+    # on TPU (dense twin elsewhere); "scan" = the XLA online-softmax KV
+    # scan (lorentz_attention_tiled, the ring-attention per-device body)
+    impl: str = "flash"
 
     @nn.compact
     def __call__(
@@ -199,12 +202,12 @@ class HypMultiHeadAttention(nn.Module):
             (h, 1, 1), x_q.dtype)) + 1e-4
         if mask is not None:
             mask = mask[..., None, :, :]  # broadcast over heads
-        if self.use_tiled:
-            # XLA online-softmax scan (the ring-attention per-device body)
+        if self.impl == "scan":
             o = lorentz_attention_tiled(q, k, v, m, beta=beta, tau=tau, mask=mask)
-        else:
-            # kernel N7: Pallas flash kernel on TPU, dense twin elsewhere
+        elif self.impl == "flash":
             o = flash_attention(q, k, v, m.c, beta=beta, tau=tau, mask=mask)
+        else:
+            raise ValueError(f"unknown attention impl {self.impl!r}")
         # concat head space-coords, reconstruct time on the joint hyperboloid
         o_sp = jnp.swapaxes(o[..., 1:], -3, -2)  # [..., N, h, dh]
         o_sp = o_sp.reshape(o_sp.shape[:-2] + (h * dh,))

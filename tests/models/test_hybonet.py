@@ -53,10 +53,44 @@ def test_hybonet_tiled_attention_parity():
     model, _, state = hybonet.init_model(cfg, seed=0)
     logits_dense = hybonet.eval_logits(
         model, state.params, jnp.asarray(ds.tokens), jnp.asarray(ds.mask))
-    cfg_t = dataclasses.replace(cfg, use_tiled_attention=True)
+    cfg_t = dataclasses.replace(cfg, attention_impl="scan")
     model_t = hybonet.HyboNetClassifier(cfg_t)
     logits_tiled = hybonet.eval_logits(
         model_t, state.params, jnp.asarray(ds.tokens), jnp.asarray(ds.mask))
     # f32 forward: online-softmax reassociation costs a few ulp
     np.testing.assert_allclose(
         np.asarray(logits_tiled), np.asarray(logits_dense), rtol=1e-5, atol=1e-6)
+
+
+def test_default_config_executes_n7_kernel(monkeypatch):
+    """The DEFAULT HyboNet config must route through the N7 flash-attention
+    kernel (VERDICT r2 next #5): with kernels forced to interpret mode the
+    Pallas launch is spied on and must fire once per block per step."""
+    import jax.numpy as jnp
+
+    import hyperspace_tpu.kernels.attention as KA
+
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "interpret")
+    calls = []
+    real_launch = KA._launch
+
+    def spy(*args, **kw):
+        calls.append(1)
+        return real_launch(*args, **kw)
+
+    monkeypatch.setattr(KA, "_launch", spy)
+
+    ds = T.synthetic_text(num_samples=16, vocab_size=64, max_len=8, seed=0)
+    cfg = hybonet.HyboNetConfig(vocab_size=64, num_classes=4, max_len=8,
+                                dim=8, num_heads=2, num_layers=2,
+                                batch_size=8)
+    assert cfg.attention_impl == "flash"  # the default IS the kernel path
+    model, opt, state = hybonet.init_model(cfg, seed=0)
+    calls.clear()  # init traced the forward too; count the train step only
+    state, loss = hybonet.train_step_sampled(
+        model, opt, state, jnp.asarray(ds.tokens), jnp.asarray(ds.mask),
+        jnp.asarray(ds.labels))
+    assert np.isfinite(float(loss))
+    # one Pallas launch per transformer block in the forward trace
+    # (backward uses the XLA twin by design — kernels/attention.py VJP)
+    assert len(calls) == cfg.num_layers

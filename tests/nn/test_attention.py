@@ -83,11 +83,11 @@ def test_attention_mask_equals_dropped_keys():
     np.testing.assert_allclose(np.asarray(full), np.asarray(trunc), rtol=1e-10)
 
 
-@pytest.mark.parametrize("use_tiled", [False, True])
-def test_mha_module_shapes_and_manifold(use_tiled):
+@pytest.mark.parametrize("impl", ["flash", "scan"])
+def test_mha_module_shapes_and_manifold(impl):
     m = Lorentz(1.0)
     x = _pts(jax.random.PRNGKey(13), m, (2, 6, 9))  # dim 8 manifold
-    mha = HypMultiHeadAttention(dim=8, num_heads=2, manifold=m, use_tiled=use_tiled)
+    mha = HypMultiHeadAttention(dim=8, num_heads=2, manifold=m, impl=impl)
     params = mha.init(jax.random.PRNGKey(14), x)
     y = mha.apply(params, x)
     assert y.shape == (2, 6, 9)
