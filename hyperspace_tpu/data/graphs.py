@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import NamedTuple, Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +45,7 @@ class Graph:
     rev_perm: np.ndarray | None = None  # [E_pad] int32 edge -> reverse edge
     deg: np.ndarray | None = None  # [N] float32 masked in-degree (static)
     csr_plan: tuple | None = None  # kernels.segment.CsrPlan work items
+    cluster_split: Any | None = None  # kernels.cluster.ClusterSplit (mean agg)
     labels: np.ndarray | None = None  # [N] int32
     num_classes: int = 0
     train_mask: np.ndarray | None = None  # [N] bool (node tasks)
@@ -73,18 +74,19 @@ class DeviceGraph(NamedTuple):
     rev_perm: Optional["jax.Array"] = None   # [E] int32 involution
     deg: Optional["jax.Array"] = None        # [N] f32 masked in-degree
     plan: Optional[tuple] = None             # 3 × [T] int32 CSR work items
+    cluster: Any = None                      # nn.scatter.ClusterAgg (mean agg)
 
 
 # num_nodes must stay a static (hashable) field across jit boundaries, so
 # DeviceGraph is registered with num_nodes as auxiliary pytree data.
 def _dg_flatten(g: DeviceGraph):
     return (g.x, g.senders, g.receivers, g.edge_mask, g.rev_perm, g.deg,
-            g.plan), g.num_nodes
+            g.plan, g.cluster), g.num_nodes
 
 
 def _dg_unflatten(num_nodes, leaves):
-    x, s, r, m, rp, deg, plan = leaves
-    return DeviceGraph(x, s, r, m, num_nodes, rp, deg, plan)
+    x, s, r, m, rp, deg, plan, cluster = leaves
+    return DeviceGraph(x, s, r, m, num_nodes, rp, deg, plan, cluster)
 
 
 jax.tree_util.register_pytree_node(DeviceGraph, _dg_flatten, _dg_unflatten)
@@ -92,6 +94,11 @@ jax.tree_util.register_pytree_node(DeviceGraph, _dg_flatten, _dg_unflatten)
 
 def to_device(g: Graph) -> DeviceGraph:
     """Put a host :class:`Graph` on device as a :class:`DeviceGraph`."""
+    cluster = None
+    if g.cluster_split is not None:
+        from hyperspace_tpu.nn.scatter import ClusterAgg
+
+        cluster = ClusterAgg.from_host(g.cluster_split)
     return DeviceGraph(
         x=jnp.asarray(g.x),
         senders=jnp.asarray(g.senders),
@@ -102,6 +109,7 @@ def to_device(g: Graph) -> DeviceGraph:
         deg=None if g.deg is None else jnp.asarray(g.deg),
         plan=None if g.csr_plan is None
         else tuple(jnp.asarray(a) for a in g.csr_plan),
+        cluster=cluster,
     )
 
 
@@ -170,6 +178,7 @@ def prepare(
     symmetrize: bool = True,
     self_loops: bool = True,
     pad_multiple: int = 1024,
+    cluster: str | bool = "auto",
     **node_fields,
 ) -> Graph:
     """Symmetrize, add self-loops, dedupe, sort by receiver, pad.
@@ -208,6 +217,19 @@ def prepare(
 
     from hyperspace_tpu.kernels.segment import build_csr_plan
 
+    # cluster-pair split (kernels/cluster.py): avoids the [E, F] message
+    # round-trip for block-dense edges.  "auto" builds it only at scales
+    # where the aggregation is actually HBM-bound (the one-time host sort
+    # is wasted on toy graphs, and small graphs fit the plain path fine).
+    split = None
+    n_real = int(mask.sum())
+    if cluster is True or (cluster == "auto" and n_real >= 200_000):
+        if symmetrize:  # the involution backward needs a symmetric set
+            from hyperspace_tpu.kernels.cluster import build_cluster_split
+
+            split = build_cluster_split(senders, receivers, mask, deg,
+                                        num_nodes)
+
     return Graph(
         x=np.asarray(x, np.float32),
         senders=senders,
@@ -217,6 +239,7 @@ def prepare(
         rev_perm=rev_perm,
         deg=deg,
         csr_plan=tuple(build_csr_plan(receivers, num_nodes)),
+        cluster_split=split,
         **node_fields,
     )
 

@@ -1,0 +1,289 @@
+"""Cluster-pair SpMM aggregation — kill the [E, F] message round-trip.
+
+The r02 anatomy probes (docs/benchmarks.md) showed the aggregation's
+gather (`w·h[senders]`) is latency-bound and the block-CSR scatter reads
+the materialized [E, F] messages back from HBM: every pass pays ~2·E·F
+bytes of HBM traffic that exists only because the gather and the scatter
+are separate XLA/Pallas ops.
+
+This kernel processes edges grouped by (receiver-block, sender-block)
+pairs and never materializes messages: with both endpoint blocks resident
+in VMEM, a 128-edge sub-chunk becomes two MXU matmuls
+
+    out_tile  +=  A @ (B @ h_tile)
+    A[r_loc, e] = w_e      (edge-weighted receiver one-hot, [bn, 128])
+    B[e, s_loc] = 1        (sender one-hot, [128, bs])
+
+so HBM traffic is one h-tile load per (rb, sb) pair plus the edge id/
+weight stream — for edges with block locality that is a fraction of
+E·F.  Low-density pairs would waste a whole tile load on a few edges, so
+the host splitter (`build_cluster_split`) routes only pairs with
+``>= min_pair_edges`` through this kernel; the rest ("stragglers") keep
+the existing gather + block-CSR path.  For a symmetrized edge list the
+pair (a, b) and its mirror (b, a) have equal edge counts, so the split
+is closed under edge reversal and the involution backward
+(nn/scatter.py) survives on both paths.
+
+Exactness: B@h is a pure row selection (each edge row has exactly one 1,
+so no two nonzeros ever sum) — in bf16 the products and single-term sums
+are exact, which is why the bf16 path can use the fast single-pass MXU
+mode; accumulation is f32 throughout.  f32 inputs use HIGHEST precision
+like kernels/segment.py.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hyperspace_tpu.kernels import _support as S
+
+_BN = 256   # receiver-block rows (output tile)
+_BS = 256   # sender-block rows (h tile)
+_BK = 512   # edges per chunk
+
+
+class ClusterPlan(NamedTuple):
+    """Work-item schedule for :func:`cluster_aggregate` (host-built).
+
+    Items are receiver-block-major; ``first`` marks each rb's first item
+    (the kernel zeroes the output tile there).  Every receiver block gets
+    at least one item even if it owns no clustered edge.
+    """
+
+    rb: np.ndarray     # [T] item -> receiver-block index
+    sb: np.ndarray     # [T] item -> sender-block index
+    chunk: np.ndarray  # [T] item -> edge-chunk index
+    first: np.ndarray  # [T] 1 iff first item of its receiver block
+
+
+def build_cluster_plan(
+    receivers: np.ndarray,  # [E] sorted by (rb, sb) within the clustered set
+    senders: np.ndarray,    # [E] aligned
+    num_nodes: int,
+    bn: int = _BN,
+    bs: int = _BS,
+    bk: int = _BK,
+) -> ClusterPlan:
+    """Plan (rb, sb, chunk) items over edges pre-sorted by (rb, sb).
+
+    Boundary chunks shared by two pairs are loaded by both and masked by
+    the in-kernel local-range test (same trick as kernels/segment.py).
+    """
+    r = np.asarray(receivers)
+    s = np.asarray(senders)
+    e_pad = S.round_up(max(len(r), 1), bk)
+    nchunks = e_pad // bk
+    nb = -(-num_nodes // bn)
+    key = (r // bn).astype(np.int64) * ((num_nodes // bs) + 1) + s // bs
+    if len(key) > 1 and not np.all(np.diff(key) >= 0):
+        raise ValueError("cluster plan needs edges sorted by (rb, sb)")
+    # pair boundaries
+    starts = np.flatnonzero(np.r_[True, key[1:] != key[:-1]]) if len(key) else np.zeros(0, np.int64)
+    ends = np.r_[starts[1:], len(key)] if len(starts) else starts
+    p_rb = (r[starts] // bn).astype(np.int32) if len(starts) else np.zeros(0, np.int32)
+    p_sb = (s[starts] // bs).astype(np.int32) if len(starts) else np.zeros(0, np.int32)
+    c0 = np.minimum(starts // bk, nchunks - 1)
+    c1 = np.clip(-(-ends // bk), c0 + 1, nchunks)
+    counts = (c1 - c0).astype(np.int64)
+
+    rb_items = np.repeat(p_rb, counts)
+    sb_items = np.repeat(p_sb, counts)
+    chunk_items = (np.arange(counts.sum(), dtype=np.int64)
+                   - np.repeat(np.cumsum(counts) - counts, counts)
+                   + np.repeat(c0, counts)).astype(np.int32)
+
+    # every receiver block needs >= 1 item so its output tile is zeroed;
+    # dummy items point at chunk 0 whose edges (some other pair's) fail
+    # the local-range test and contribute nothing
+    present = np.zeros(nb, bool)
+    present[p_rb] = True
+    missing = np.flatnonzero(~present).astype(np.int32)
+    rb_items = np.concatenate([rb_items, missing])
+    sb_items = np.concatenate([sb_items, np.zeros(len(missing), np.int32)])
+    chunk_items = np.concatenate([chunk_items, np.zeros(len(missing), np.int32)])
+
+    order = np.argsort(rb_items, kind="stable")
+    rb_items = rb_items[order].astype(np.int32)
+    sb_items = sb_items[order].astype(np.int32)
+    chunk_items = chunk_items[order].astype(np.int32)
+    first = np.zeros(len(rb_items), np.int32)
+    first[np.flatnonzero(np.r_[True, rb_items[1:] != rb_items[:-1]])] = 1
+    return ClusterPlan(rb_items, sb_items, chunk_items, first)
+
+
+def _body(bn: int, bs: int, fast_bf16: bool):
+    prec = None if fast_bf16 else jax.lax.Precision.HIGHEST
+    dt = jnp.bfloat16 if fast_bf16 else jnp.float32
+
+    def body(rb_ref, sb_ref, chk_ref, first_ref, r_ref, s_ref, w_ref,
+             h_ref, o_ref):
+        t = pl.program_id(0)
+        rb = rb_ref[t]
+        sb = sb_ref[t]
+
+        @pl.when(first_ref[t] == 1)
+        def _():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        r = r_ref[0]                    # [bk//128, 128] int32 (global)
+        s = s_ref[0]
+        w = w_ref[0].astype(jnp.float32)
+        h_t = h_ref[:].astype(dt)       # [bs, F]
+        acc = jnp.zeros_like(o_ref[:], jnp.float32)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bn, 128), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (128, bs), 1)
+        for j in range(r.shape[0]):
+            ls = s[j] - sb * bs          # [128]; out-of-range matches nothing
+            lr = r[j] - rb * bn
+            b_oh = (cols == ls[:, None]).astype(dt)          # [128, bs]
+            tmp = jnp.dot(b_oh, h_t, preferred_element_type=jnp.float32,
+                          precision=prec)                    # [128, F] exact
+            a_w = jnp.where(rows == lr[None, :], w[j][None, :], 0.0)
+            acc += jnp.dot(a_w.astype(dt), tmp.astype(dt),
+                           preferred_element_type=jnp.float32, precision=prec)
+        o_ref[:] += acc
+
+    return body
+
+
+def cluster_aggregate(
+    h: jax.Array,          # [N, F] node values
+    w: jax.Array,          # [E] edge weights (0 on padding/masked edges)
+    receivers: jax.Array,  # [E] int32 global, sorted by (rb, sb)
+    senders: jax.Array,    # [E] int32 global, aligned
+    plan: tuple,           # ClusterPlan device arrays (rb, sb, chunk, first)
+    num_nodes: int,
+    bn: int = _BN,
+    bs: int = _BS,
+    bk: int = _BK,
+) -> jax.Array:
+    """out[r] = Σ_{e: receivers_e = r} w_e · h[senders_e] without ever
+    materializing [E, F] messages.  Twin/oracle: ``segment_sum`` of the
+    gathered messages (any receiver order)."""
+    m = S.mode()
+    if m == "xla":
+        acc_dt = jnp.promote_types(h.dtype, jnp.float32)
+        msgs = (w[:, None] * h[senders]).astype(acc_dt)
+        return jax.ops.segment_sum(msgs, receivers, num_nodes).astype(h.dtype)
+    e = receivers.shape[0]
+    f = h.shape[-1]
+    fp = S.round_up(f, 128)
+    n_pad = S.round_up(num_nodes, max(bn, bs))
+    h_p = S.pad_axis(S.pad_axis(h, -1, 128), 0, max(bn, bs))
+    e_pad = S.round_up(e, bk)
+    # pad ids out-of-range so padded lanes match no local row
+    pad_ids = lambda a: jnp.pad(a, (0, e_pad - e), constant_values=n_pad)
+    r2d = pad_ids(receivers).reshape(e_pad // bk, bk // 128, 128)
+    s2d = pad_ids(senders).reshape(e_pad // bk, bk // 128, 128)
+    w2d = jnp.pad(w.astype(jnp.float32), (0, e_pad - e)).reshape(
+        e_pad // bk, bk // 128, 128)
+    t = plan[0].shape[0]
+    fast_bf16 = h.dtype == jnp.bfloat16
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, bk // 128, 128),
+                         lambda t, rb, sb, chk, first: (chk[t], 0, 0)),
+            pl.BlockSpec((1, bk // 128, 128),
+                         lambda t, rb, sb, chk, first: (chk[t], 0, 0)),
+            pl.BlockSpec((1, bk // 128, 128),
+                         lambda t, rb, sb, chk, first: (chk[t], 0, 0)),
+            pl.BlockSpec((bs, fp), lambda t, rb, sb, chk, first: (sb[t], 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, fp),
+                               lambda t, rb, sb, chk, first: (rb[t], 0)),
+    )
+    out = pl.pallas_call(
+        _body(bn, bs, fast_bf16),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S.round_up(n_pad, bn), fp),
+                                       jnp.float32),
+        interpret=S.interpret_flag(m),
+    )(*tuple(plan), r2d, s2d, w2d, h_p)
+    return out[:num_nodes, :f].astype(h.dtype)
+
+
+# --- host-side split: clustered pairs vs stragglers ---------------------------
+
+
+class ClusterSplit(NamedTuple):
+    """Host result of :func:`build_cluster_split` (numpy; see to_device).
+
+    Clustered edges (pair density >= threshold) carry a ClusterPlan;
+    stragglers keep the receiver-sorted layout + block-CSR plan of the
+    main path.  ``w_*`` are the static mean-aggregation weights of each
+    edge and of its reverse (1/deg of the opposite endpoint) — the
+    involution backward needs no index lookup (same trick as
+    parallel/node_shard.py).
+    """
+
+    c_recv: np.ndarray   # [Ec] clustered receivers, (rb, sb)-sorted
+    c_send: np.ndarray   # [Ec]
+    c_wf: np.ndarray     # [Ec] 1/deg[recv]
+    c_wb: np.ndarray     # [Ec] 1/deg[send]
+    c_plan: ClusterPlan
+    s_recv: np.ndarray   # [Es] straggler receivers, ascending
+    s_send: np.ndarray   # [Es]
+    s_wf: np.ndarray
+    s_wb: np.ndarray
+    s_plan: tuple        # block-CSR plan for the straggler receivers
+    frac_clustered: float
+
+
+def build_cluster_split(
+    senders: np.ndarray,
+    receivers: np.ndarray,  # ascending (prepare layout)
+    edge_mask: np.ndarray,
+    deg: np.ndarray,
+    num_nodes: int,
+    bn: int = _BN,
+    bs: int = _BS,
+    bk: int = _BK,
+    min_pair_edges: int = 128,
+) -> ClusterSplit:
+    from hyperspace_tpu.kernels.segment import build_csr_plan
+
+    mask = np.asarray(edge_mask)
+    r = np.asarray(receivers)[mask]
+    s = np.asarray(senders)[mask]
+    d = np.maximum(np.asarray(deg), 1.0).astype(np.float32)
+    nsb = num_nodes // bs + 1
+    key = (r // bn).astype(np.int64) * nsb + s // bs
+    order = np.argsort(key, kind="stable")
+    key_s = key[order]
+    uniq, inv, counts = np.unique(key_s, return_inverse=True,
+                                  return_counts=True)
+    dense = counts[inv] >= min_pair_edges   # per sorted edge
+    c_idx = order[dense]
+    s_idx = np.sort(order[~dense])          # back to receiver-ascending
+    c_recv, c_send = r[c_idx], s[c_idx]
+    s_recv, s_send = r[s_idx], s[s_idx]
+
+    c_plan = build_cluster_plan(c_recv, c_send, num_nodes, bn, bs, bk)
+    # straggler CSR plan wants every node block covered; sentinel-pad to
+    # keep receivers sorted (padding edges carry w = 0)
+    e_s = S.round_up(max(len(s_recv), 1), bk)
+    s_recv_p = np.full(e_s, num_nodes - 1, np.int32)
+    s_send_p = np.zeros(e_s, np.int32)
+    s_wf = np.zeros(e_s, np.float32)
+    s_wb = np.zeros(e_s, np.float32)
+    s_recv_p[: len(s_recv)] = s_recv
+    s_send_p[: len(s_send)] = s_send
+    s_wf[: len(s_recv)] = 1.0 / d[s_recv]
+    s_wb[: len(s_recv)] = 1.0 / d[s_send]
+    s_plan = tuple(build_csr_plan(s_recv_p, num_nodes, bn=128, bk=bk))
+    return ClusterSplit(
+        c_recv=c_recv.astype(np.int32), c_send=c_send.astype(np.int32),
+        c_wf=(1.0 / d[c_recv]), c_wb=(1.0 / d[c_send]),
+        c_plan=c_plan,
+        s_recv=s_recv_p, s_send=s_send_p, s_wf=s_wf, s_wb=s_wb,
+        s_plan=s_plan,
+        frac_clustered=float(len(c_recv)) / max(len(r), 1),
+    )

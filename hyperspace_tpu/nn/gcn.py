@@ -226,6 +226,17 @@ class HGCConv(nn.Module):
                 w = segment_softmax(logits, receivers, n, mask=edge_mask,
                                     indices_are_sorted=sorted_fast)
                 att_den = None
+        elif g.cluster is not None:
+            # cluster-pair SpMM kernel (kernels/cluster.py): block-dense
+            # edges aggregate as two one-hot MXU matmuls over VMEM tiles
+            # (no [E, F] message round-trip); stragglers keep the CSR
+            # path; the symmetric backward runs the same two-path program
+            from hyperspace_tpu.nn.scatter import cluster_sym_aggregate
+
+            h_in = h if self.agg_dtype is None else h.astype(self.agg_dtype)
+            agg = cluster_sym_aggregate(h_in, g.cluster, n).astype(h.dtype)
+            out = from_tangent0_coords(m_out, self.activation(agg))
+            return out, m_out
         else:
             # mean aggregation: 1/deg; degree is static per graph, so prefer
             # the precomputed g.deg over a per-step segment count

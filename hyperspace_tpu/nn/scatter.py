@@ -193,3 +193,90 @@ def _psm_bwd(num_segments, receivers, g):
 
 
 planned_segment_max_1d.defvjp(_psm_fwd, _psm_bwd)
+
+
+# --- cluster-pair aggregation (kernels/cluster.py) with the same symmetric
+# backward: clustered and straggler subsets are each closed under the edge
+# involution (equal pair/mirror-pair counts), so dh runs the identical
+# two-path program on (ḡ, w_bwd).  Mean aggregation only — weights are
+# static per graph and precomputed host-side (including the reverse-edge
+# weights, so the backward needs no index lookup).
+
+
+class ClusterAgg:
+    """Device arrays of a host `kernels.cluster.build_cluster_split`.
+
+    Registered as a pytree so it can ride inside DeviceGraph.  Static
+    plan shapes are leaves (int32 arrays), nothing auxiliary.
+    """
+
+    def __init__(self, c_recv, c_send, c_wf, c_wb, c_plan,
+                 s_recv, s_send, s_wf, s_wb, s_plan):
+        self.c_recv, self.c_send = c_recv, c_send
+        self.c_wf, self.c_wb = c_wf, c_wb
+        self.c_plan = c_plan
+        self.s_recv, self.s_send = s_recv, s_send
+        self.s_wf, self.s_wb = s_wf, s_wb
+        self.s_plan = s_plan
+
+    def tree_flatten(self):
+        return ((self.c_recv, self.c_send, self.c_wf, self.c_wb,
+                 tuple(self.c_plan), self.s_recv, self.s_send, self.s_wf,
+                 self.s_wb, tuple(self.s_plan)), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+    @classmethod
+    def from_host(cls, split):
+        import jax.numpy as jnp
+
+        dev = lambda a: jnp.asarray(a)
+        return cls(dev(split.c_recv), dev(split.c_send), dev(split.c_wf),
+                   dev(split.c_wb), tuple(dev(a) for a in split.c_plan),
+                   dev(split.s_recv), dev(split.s_send), dev(split.s_wf),
+                   dev(split.s_wb), tuple(dev(a) for a in split.s_plan))
+
+
+jax.tree_util.register_pytree_node(
+    ClusterAgg,
+    lambda c: c.tree_flatten(),
+    lambda aux, leaves: ClusterAgg.tree_unflatten(aux, leaves))
+
+
+def _cluster_two_path(h, wf_c, wf_s, agg: ClusterAgg, num_segments: int):
+    from hyperspace_tpu.kernels.cluster import cluster_aggregate
+
+    out = cluster_aggregate(h, wf_c, agg.c_recv, agg.c_send,
+                            agg.c_plan, num_segments)
+    msgs = wf_s.astype(h.dtype)[:, None] * h[agg.s_send]
+    out = out + _sorted_segsum(msgs, agg.s_recv, *agg.s_plan,
+                               num_segments).astype(out.dtype)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def cluster_sym_aggregate(h, agg: ClusterAgg, num_segments: int):
+    """Mean aggregation through the cluster-pair kernel + straggler CSR.
+
+    out[r] = Σ_e w_e h[senders_e] with w the precomputed 1/deg weights;
+    ``h`` should already be cast to the aggregation dtype (bf16 messages
+    halve the straggler traffic AND let the cluster kernel use the fast
+    single-pass MXU mode).
+    """
+    return _cluster_two_path(h, agg.c_wf, agg.s_wf, agg, num_segments)
+
+
+def _ca_fwd(h, agg, num_segments):
+    return _cluster_two_path(h, agg.c_wf, agg.s_wf, agg, num_segments), agg
+
+
+def _ca_bwd(num_segments, agg, g):
+    # dh[i] = Σ_{e: r_e = i} w_{π(e)} ḡ[s_e] — identical program on
+    # (ḡ, w_bwd); both subsets are reversal-closed so the split is exact
+    dh = _cluster_two_path(g, agg.c_wb, agg.s_wb, agg, num_segments)
+    return dh, None
+
+
+cluster_sym_aggregate.defvjp(_ca_fwd, _ca_bwd)
