@@ -246,7 +246,7 @@ def build_cluster_split(
     bn: int = _BN,
     bs: int = _BS,
     bk: int = _BK,
-    min_pair_edges: int = 128,
+    min_pair_edges: int = 256,
 ) -> ClusterSplit:
     from hyperspace_tpu.kernels.segment import build_csr_plan
 

@@ -1,0 +1,73 @@
+"""Sweep the cluster-split threshold on the bench-scale HGCN step.
+
+Each (receiver-block x sender-block) pair above the threshold runs the
+cluster-pair SpMM kernel; below it, the gather+CSR path.  Lower
+thresholds cluster more edges but waste h-tile loads on thin pairs.
+Prints one JSON line per config: step time + clustered fraction.
+
+    python scripts/bench_cluster_sweep.py --thresholds 64,128,256
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--thresholds", default="64,128,256")
+    ap.add_argument("--nodes", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--repeats", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.benchmarks import hgcn_bench as HB
+    from hyperspace_tpu.kernels.cluster import build_cluster_split
+    from hyperspace_tpu.models import hgcn
+
+    n = args.nodes or HB.ARXIV_NODES
+    split, x = HB.arxiv_scale_split(n)
+    g = split.graph
+    cfg = hgcn.HGCNConfig(
+        feat_dim=x.shape[1], hidden_dims=(128, 32), kind="lorentz",
+        agg_dtype=jnp.bfloat16, decoder_dtype=jnp.bfloat16)
+    pos = hgcn.make_planned_pairs(split.train_pos, n)
+    neg_u, neg_plan = hgcn.make_static_negatives(n, int(pos.u.shape[0]), seed=0)
+
+    configs = [None] + [int(t) for t in args.thresholds.split(",")]
+    for thr in configs:
+        if thr is None:
+            g.cluster_split = None  # the r02 gather+CSR-only baseline
+            frac = 0.0
+        else:
+            g.cluster_split = build_cluster_split(
+                g.senders, g.receivers, g.edge_mask, g.deg, n,
+                min_pair_edges=thr)
+            frac = g.cluster_split.frac_clustered
+        ga = hgcn._device_graph(g)
+        model, opt, state = hgcn.init_lp(cfg, g, seed=0)
+        stepper = lambda st: hgcn.train_step_lp_pairs(
+            model, opt, n, st, ga, pos, neg_u, neg_plan)
+        state, loss = stepper(state)
+        jax.device_get(loss)
+        best = float("inf")
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                state, loss = stepper(state)
+            jax.device_get(loss)
+            best = min(best, time.perf_counter() - t0)
+        print(json.dumps({
+            "min_pair_edges": thr, "frac_clustered": round(frac, 3),
+            "step_s": round(best / args.steps, 5),
+            "samples_per_s": round(n / (best / args.steps), 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -70,10 +70,17 @@ def test_empty_receiver_blocks_zeroed(rng, interp):
 
 def _toy_graph(n=600, seed=0):
     from hyperspace_tpu.data import graphs as G
+    from hyperspace_tpu.kernels.cluster import build_cluster_split
 
     edges, x, labels, ncls = G.synthetic_hierarchy(
         num_nodes=n, feat_dim=12, seed=seed)
-    return G.prepare(edges, n, x, cluster=True, pad_multiple=256)
+    g = G.prepare(edges, n, x, cluster=True, pad_multiple=256)
+    # the production threshold (256) clusters nothing on a toy graph;
+    # rebuild with a low threshold so BOTH paths carry edges here
+    g.cluster_split = build_cluster_split(
+        g.senders, g.receivers, g.edge_mask, g.deg, n, min_pair_edges=8)
+    assert 0.1 < g.cluster_split.frac_clustered < 1.0
+    return g
 
 
 def test_split_covers_every_edge_once_and_is_symmetric():
