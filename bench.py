@@ -53,9 +53,11 @@ def _poincare_steppers(cfg, pairs, plan_steps):
                      state)
     state, opt = pe.init_state(cfg)
     plan = pe.plan_sparse_steps(cfg, pairs, plan_steps, seed=0)
+    # the packed variant: one row gather + ONE sorted scatter-set per step
+    # regardless of optimizer moment count (docs/benchmarks.md)
     out["planned"] = (
-        (lambda st, o=opt, p=plan: pe.train_step_sparse_planned(cfg, o, st, p)),
-        state)
+        (lambda st, o=opt, p=plan: pe.train_step_planned_packed(cfg, o, st, p)),
+        pe.pack_state(cfg, state))
     return out
 
 

@@ -399,6 +399,102 @@ def key_after(key: jax.Array) -> jax.Array:
     return jax.random.split(key, 1)[0]
 
 
+# --- packed planned state: one gather + ONE scatter per step ------------------
+#
+# On-chip breakdown at 598 k rows (docs/benchmarks.md sparse section): the
+# planned radam step spent ~2.6 ms of its 4.7 ms in its three sorted
+# scatter-sets (table, mu, nu) — each scatter pays the serialization
+# latency once.  Packing the table and both moment tables side-by-side as
+# one [N, 3d] array (a layout private to the planned path; `unpack_state`
+# restores the standard TrainState) turns the update into ONE [U, 3d]
+# gather and ONE sorted scatter-set, which is what lets the sparse path
+# finally beat the dense step at arxiv-scale tables.
+
+
+class PackedState(NamedTuple):
+    packed: jax.Array  # [N, d] (rsgd) or [N, 2d+1] (radam: table|mu|nu-scalar)
+    aux: Any           # non-row optimizer state (counts)
+    key: jax.Array
+    step: jax.Array
+
+
+def pack_state(cfg: PoincareEmbedConfig, state: TrainState) -> PackedState:
+    if isinstance(state.opt_state, RAdamState):
+        packed = jnp.concatenate(
+            [state.table, state.opt_state.mu, state.opt_state.nu], axis=1)
+        aux = state.opt_state.count
+    else:
+        packed = state.table
+        aux = state.opt_state
+    return PackedState(packed, aux, state.key, state.step)
+
+
+def unpack_state(cfg: PoincareEmbedConfig, p: PackedState) -> TrainState:
+    d = cfg.dim
+    if p.packed.shape[1] > d:  # radam rows: table | mu | nu (nu is [*, 1])
+        table = p.packed[:, :d]
+        opt_state = RAdamState(count=p.aux, mu=p.packed[:, d : 2 * d],
+                               nu=p.packed[:, 2 * d :])
+    else:
+        table, opt_state = p.packed, p.aux
+    return TrainState(table, opt_state, p.key, p.step)
+
+
+@partial(jax.jit, static_argnames=("cfg", "opt"), donate_argnames=("state",))
+def train_step_planned_packed(
+    cfg: PoincareEmbedConfig,
+    opt,
+    state: PackedState,
+    plan: SparsePlan,
+) -> tuple[PackedState, jax.Array]:
+    """`train_step_sparse_planned` on a :class:`PackedState` — identical
+    math, one row gather and one sorted scatter-set regardless of the
+    optimizer's moment count."""
+    s = plan.u_idx.shape[0]
+    i = state.step % s
+    take = lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+    u_idx, v_idx, neg_idx, uniq, inv_map, order, seg_sorted = (
+        take(a) for a in plan)
+    b, d = cfg.batch_size, cfg.dim
+    n_slots = uniq.shape[0]
+    safe_uniq = jnp.minimum(uniq, cfg.num_nodes - 1)
+    all_rows = state.packed[safe_uniq]        # ONE gather, [U, d or 3d]
+    rows = all_rows[:, :d]
+
+    def sub_loss(rows):
+        ball = PoincareBall(cfg.c)
+        flat = _dedup_gather(rows, inv_map, order, seg_sorted, n_slots)
+        u = flat[:b]
+        cv = jnp.concatenate(
+            [flat[b : 2 * b, None], flat[2 * b :].reshape(b, -1, d)], axis=1)
+        dist = ball.dist(u[:, None, :], cv)
+        logits = -dist
+        collide = (neg_idx == v_idx[:, None]) | (neg_idx == u_idx[:, None])
+        mask = jnp.concatenate(
+            [jnp.zeros_like(v_idx[:, None], bool), collide], axis=1)
+        logits = jnp.where(mask, -jnp.inf, logits)
+        return jnp.mean(jax.nn.logsumexp(logits, axis=1) - logits[:, 0])
+
+    loss, g_rows = jax.value_and_grad(sub_loss)(rows)
+
+    if all_rows.shape[1] > d:  # radam: moments ride in the packed rows
+        row_state = RAdamState(count=state.aux, mu=all_rows[:, d : 2 * d],
+                               nu=all_rows[:, 2 * d :])
+        updates, row_state = opt.update(g_rows, row_state, rows)
+        new_all = jnp.concatenate(
+            [optax.apply_updates(rows, updates),
+             row_state.mu.astype(all_rows.dtype),
+             row_state.nu.astype(all_rows.dtype)], axis=1)
+        aux = row_state.count
+    else:
+        updates, aux = opt.update(g_rows, state.aux, rows)
+        new_all = optax.apply_updates(rows, updates)
+    packed = state.packed.at[uniq].set(
+        new_all.astype(state.packed.dtype),
+        mode="drop", indices_are_sorted=True)  # ONE scatter
+    return PackedState(packed, aux, key_after(state.key), state.step + 1), loss
+
+
 def init_state(cfg: PoincareEmbedConfig, seed: int = 0) -> tuple[TrainState, optax.GradientTransformation]:
     """Build the initial state *and* its matching optimizer.
 
