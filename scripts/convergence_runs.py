@@ -33,6 +33,15 @@ def arms(hgcn, jnp, feat_dim, which="all"):
         ("pairs_att_aggbf16_decbf16",
          hgcn.HGCNConfig(**base, use_att=True, agg_dtype=jnp.bfloat16,
                          decoder_dtype=jnp.bfloat16)),
+        # stabilized attention arms (seed-0 att at lr=1e-2 trained to
+        # val-AUC 0.596 by step 500 then diverged to chance by 1000):
+        # lower lr with the bench dtype policy, and an f32-message control
+        # to separate the lr effect from bf16-gradient noise
+        ("pairs_att_lr3e3_aggbf16_decbf16",
+         hgcn.HGCNConfig(**{**base, "lr": 3e-3}, use_att=True,
+                         agg_dtype=jnp.bfloat16, decoder_dtype=jnp.bfloat16)),
+        ("pairs_att_lr3e3_f32",
+         hgcn.HGCNConfig(**{**base, "lr": 3e-3}, use_att=True)),
     ]
     if which == "all":
         return all_

@@ -146,9 +146,21 @@ def run_hgcn(args, mh) -> int:
     for _ in range(args.steps):
         state, loss = step(state, ga, train_pos)
         losses.append(float(jax.device_get(loss)))
+
+    # node-sharded path across the same real processes: each process
+    # device_puts its addressable shards of the partitioned graph, and
+    # the encoder's all-gather crosses the host boundary inside XLA
+    model2, opt2, state2 = hgcn.init_lp(cfg, split.graph, seed=1)
+    nstep, state2, nsg = hgcn.make_node_sharded_step_lp(
+        model2, opt2, 128, mesh, state2, split)
+    ns_losses = []
+    for _ in range(args.steps):
+        state2, nloss = nstep(state2, nsg, train_pos)
+        ns_losses.append(float(jax.device_get(nloss)))
     if args.pid == 0:
         print("RESULT " + json.dumps({
-            "losses": losses, "devices": jax.device_count(),
+            "losses": losses, "ns_losses": ns_losses,
+            "devices": jax.device_count(),
         }), flush=True)
     return 0
 

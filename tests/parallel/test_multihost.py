@@ -128,3 +128,7 @@ def test_two_process_hgcn_sharded_step(tmp_path):
     losses = res["losses"]
     assert len(losses) == 5 and np.all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+    # the node-sharded encoder path over the same real processes
+    ns = res["ns_losses"]
+    assert len(ns) == 5 and np.all(np.isfinite(ns))
+    assert ns[-1] < ns[0]
