@@ -1,0 +1,52 @@
+"""Summarize convergence_runs.py JSONL logs into the docs tables.
+
+    python scripts/summarize_convergence.py docs/data/convergence_r03.jsonl ...
+
+Prints (a) a final-AUC table (mean ± spread over seeds per arm) and (b) a
+compact val-AUC-vs-step curve per arm (seed mean), ready to paste into
+docs/benchmarks.md.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import defaultdict
+
+import numpy as np
+
+
+def main(paths):
+    finals = defaultdict(list)
+    curves = defaultdict(lambda: defaultdict(list))  # arm -> step -> [auc]
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("phase") == "final":
+                    finals[rec["config"]].append(rec)
+                elif rec.get("phase") == "curve":
+                    curves[rec["config"]][rec["step"]].append(rec["val_auc"])
+
+    print("| arm | seeds | steps | test AUC mean | spread | val AUC mean |")
+    print("|---|---|---|---|---|---|")
+    for arm, recs in finals.items():
+        t = [r["test_auc"] for r in recs]
+        v = [r["val_auc"] for r in recs]
+        print(f"| {arm} | {len(recs)} | {recs[0]['steps']} "
+              f"| {np.mean(t):.4f} | ±{(max(t) - min(t)) / 2:.4f} "
+              f"| {np.mean(v):.4f} |")
+
+    print()
+    for arm, by_step in curves.items():
+        steps = sorted(by_step)
+        vals = [f"{np.mean(by_step[s]):.3f}" for s in steps]
+        print(f"{arm}: steps {steps[0]}..{steps[-1]}")
+        print("  val AUC: " + " ".join(vals))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:] or ["docs/data/convergence_r03.jsonl"])

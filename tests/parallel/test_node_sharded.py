@@ -251,3 +251,34 @@ def test_per_device_cost_scales_down():
     bytes_ratio = sharded["bytes accessed"] / single["bytes accessed"]
     assert flops_ratio <= 0.35, f"per-device flops ratio {flops_ratio:.2f}"
     assert bytes_ratio <= 0.35, f"per-device bytes ratio {bytes_ratio:.2f}"
+
+
+def test_node_sharded_learned_curvature_and_bf16_messages():
+    """The bench dtype policy (bf16 edge messages) and learned per-layer
+    curvature both train through the node-sharded step and match the
+    single-device trajectory."""
+    mesh = _mesh_or_skip({"data": 8})
+    _, split, _ = _setup(num_nodes=192)
+    cfg = hgcn.HGCNConfig(feat_dim=12, hidden_dims=(16, 8), learn_c=True,
+                          agg_dtype=jnp.bfloat16)
+    n = split.graph.num_nodes
+    train_pos = jnp.asarray(hgcn.round_up_pairs(split.train_pos, mesh))
+
+    model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
+    ga = G.to_device(split.graph)
+    for _ in range(3):
+        state, loss_single = hgcn.train_step_lp(
+            model, opt, n, state, ga, train_pos)
+
+    model2, opt2, state2 = hgcn.init_lp(cfg, split.graph, seed=0)
+    step, state2, nsg = hgcn.make_node_sharded_step_lp(
+        model2, opt2, n, mesh, state2, split)
+    for _ in range(3):
+        state2, loss_sharded = step(state2, nsg, train_pos)
+
+    # bf16 messages accumulate f32 on both paths; small reassociation slack
+    np.testing.assert_allclose(float(loss_sharded), float(loss_single),
+                               rtol=5e-3)
+    c0 = state.params["encoder"]["conv0"]["c_raw"]
+    c1 = state2.params["encoder"]["conv0"]["c_raw"]
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c0), rtol=1e-2)
