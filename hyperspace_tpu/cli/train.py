@@ -137,16 +137,12 @@ def run_hgcn(run: RunConfig, overrides: dict):
         ga = hgcn._device_graph(split.graph)
         if mesh is not None:
             train_pos = jnp.asarray(hgcn.round_up_pairs(split.train_pos, mesh))
-            if cfg.use_att:
-                # attention needs cross-shard softmax state: fall back to
-                # the replicated-graph step (pairs shard, encoder doesn't)
-                step, state, ga_s = hgcn.make_sharded_step_lp(
-                    model, opt, num_nodes, mesh, state, ga)
-            else:
-                # default multi-chip path: node-sharded encoder — each
-                # device owns N/ndev nodes and their incoming edges
-                step, state, ga_s = hgcn.make_node_sharded_step_lp(
-                    model, opt, num_nodes, mesh, state, split)
+            # default multi-chip path: node-sharded encoder — each device
+            # owns N/ndev nodes and their incoming edges (mean AND
+            # attention aggregation; the receiver partition keeps the
+            # attention softmax shard-local)
+            step, state, ga_s = hgcn.make_node_sharded_step_lp(
+                model, opt, num_nodes, mesh, state, split)
             state, loss = _train_loop(
                 run, state, lambda st: step(st, ga_s, train_pos))
         else:
@@ -166,13 +162,8 @@ def run_hgcn(run: RunConfig, overrides: dict):
         lab = jnp.asarray(g.labels)
         mask = jnp.asarray(g.train_mask)
         if mesh is not None:
-            if cfg.use_att:
-                step, state, ga_s = hgcn.make_sharded_step_nc(
-                    model, opt, mesh, state, ga)
-                lab_s, mask_s = lab, mask
-            else:
-                step, state, ga_s, lab_s, mask_s = (
-                    hgcn.make_node_sharded_step_nc(model, opt, mesh, state, g))
+            step, state, ga_s, lab_s, mask_s = (
+                hgcn.make_node_sharded_step_nc(model, opt, mesh, state, g))
             state, loss = _train_loop(
                 run, state, lambda st: step(st, ga_s, lab_s, mask_s))
         else:

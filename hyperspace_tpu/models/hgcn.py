@@ -455,8 +455,11 @@ def make_node_sharded_step_lp(
     Per-device FLOPs and HBM bytes scale ~1/ndev (asserted by
     tests/parallel/test_node_sharded.py's compiled-cost check).
 
-    Mean aggregation only (the bench default); attention raises in
-    HGCConv.  Returns ``(step, placed_state, placed_graph)``; call as
+    Mean aggregation uses the involution backward (no cross-shard
+    scatter); attention works too — the receiver partition keeps its
+    segment softmax shard-local (`parallel.node_shard.
+    node_sharded_att_aggregate`, autodiff collectives).  Returns
+    ``(step, placed_state, placed_graph)``; call as
     ``state, loss = step(state, nsg, train_pos)``.
     """
     from hyperspace_tpu.parallel.mesh import batch_sharding, replicated

@@ -165,14 +165,21 @@ class HGCConv(nn.Module):
         if hasattr(g, "w_fwd"):
             from hyperspace_tpu.parallel.node_shard import (
                 node_sharded_aggregate,
+                node_sharded_att_aggregate,
             )
 
             if self.use_att:
-                raise NotImplementedError(
-                    "node-sharded HGCConv supports mean aggregation only "
-                    "(attention softmax needs cross-shard normalization); "
-                    "use use_att=False or the replicated-graph sharded step")
-            agg = node_sharded_aggregate(h, g, self.agg_dtype).astype(h.dtype)
+                # receiver partitioning keeps the segment softmax
+                # shard-local; autodiff collectives carry the backward
+                a_s = self.param("att_src", self.kernel_init,
+                                 (self.features, 1), h.dtype)
+                a_r = self.param("att_dst", self.kernel_init,
+                                 (self.features, 1), h.dtype)
+                agg = node_sharded_att_aggregate(
+                    h, (h @ a_s)[:, 0], (h @ a_r)[:, 0], g, self.agg_dtype)
+            else:
+                agg = node_sharded_aggregate(h, g, self.agg_dtype)
+            agg = agg.astype(h.dtype)
             out = from_tangent0_coords(m_out, self.activation(agg))
             return out, m_out
 
