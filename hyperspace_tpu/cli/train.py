@@ -122,7 +122,10 @@ def run_hgcn(run: RunConfig, overrides: dict):
 
     task = overrides.pop("task", "lp")
     dataset = overrides.pop("dataset", "cora")
+    reorder = overrides.pop("reorder", "false").lower() in ("1", "true", "yes")
     edges, x, labels, ncls, source = G.load_graph(dataset, run.data_root)
+    if reorder:  # BFS locality relabeling: feeds the cluster-pair kernel
+        edges, x, labels, _ = G.apply_locality_order(edges, x, labels)
     cfg = apply_overrides(
         hgcn.HGCNConfig(feat_dim=x.shape[1],
                         num_classes=ncls if task == "nc" else 0),
