@@ -172,6 +172,11 @@ def cluster_aggregate(
         msgs = (w[:, None] * h[senders]).astype(acc_dt)
         return jax.ops.segment_sum(msgs, receivers, num_nodes).astype(h.dtype)
     e = receivers.shape[0]
+    if e == 0:
+        # an empty clustered set still carries one dummy plan item per
+        # receiver block; skipping the kernel (sum of nothing = 0) avoids
+        # indexing chunk 0 of a zero-chunk edge array
+        return jnp.zeros((num_nodes, h.shape[-1]), h.dtype)
     f = h.shape[-1]
     fp = S.round_up(f, 128)
     n_pad = S.round_up(num_nodes, max(bn, bs))

@@ -82,6 +82,24 @@ def make_optimizer(cfg: PoincareEmbedConfig):
     )
 
 
+def _ranking_loss(u, cv, u_idx, v_idx, neg_idx, c):
+    """-log softmax(-d)[positive]: u [B, d] against cv [B, 1+K, d]
+    (column 0 = the positive v), with sampled negatives that collide with
+    the positive v or the query u itself masked out -- otherwise ~K/N of
+    rows get a log(2) loss floor and a gradient pushing the true ancestor
+    away.  (Collisions with *other* ancestors of u remain, as in standard
+    on-the-fly sampled-softmax training.)  The one loss body every step
+    variant (dense / sparse / planned / packed) shares."""
+    ball = PoincareBall(c)
+    d = ball.dist(u[:, None, :], cv)
+    logits = -d
+    collide = (neg_idx == v_idx[:, None]) | (neg_idx == u_idx[:, None])
+    mask = jnp.concatenate(
+        [jnp.zeros_like(v_idx[:, None], bool), collide], axis=1)
+    logits = jnp.where(mask, -jnp.inf, logits)
+    return jnp.mean(jax.nn.logsumexp(logits, axis=1) - logits[:, 0])
+
+
 def loss_fn(
     table: jax.Array,
     u_idx: jax.Array,
@@ -90,21 +108,9 @@ def loss_fn(
     c,
 ) -> jax.Array:
     """Batch loss. u_idx, v_idx: [B]; neg_idx: [B, K]."""
-    ball = PoincareBall(c)
     u = table[u_idx]  # [B, d]
     cand = jnp.concatenate([v_idx[:, None], neg_idx], axis=1)  # [B, 1+K]
-    cv = table[cand]  # [B, 1+K, d]
-    d = ball.dist(u[:, None, :], cv)  # [B, 1+K]
-    logits = -d
-    # Mask sampled negatives that collide with the positive v or the query u
-    # itself — otherwise ~K/N of rows get a log(2) loss floor and a gradient
-    # pushing the true ancestor away. (Collisions with *other* ancestors of u
-    # remain, as in standard on-the-fly sampled-softmax training.)
-    collide = (neg_idx == v_idx[:, None]) | (neg_idx == u_idx[:, None])
-    mask = jnp.concatenate([jnp.zeros_like(v_idx[:, None], bool), collide], axis=1)
-    logits = jnp.where(mask, -jnp.inf, logits)
-    # -log softmax(-d)[0]
-    return jnp.mean(jax.nn.logsumexp(logits, axis=1) - logits[:, 0])
+    return _ranking_loss(u, table[cand], u_idx, v_idx, neg_idx, c)
 
 
 @partial(jax.jit, static_argnames=("cfg", "opt"), donate_argnames=("state",))
@@ -172,18 +178,10 @@ def train_step_sparse(
     rows = state.table[jnp.minimum(uniq, cfg.num_nodes - 1)]  # [U, d]
 
     def sub_loss(rows):
-        ball = PoincareBall(cfg.c)
-        u = rows[inv[:b]]
         cand_slots = jnp.concatenate(
             [inv[b : 2 * b, None], inv[2 * b :].reshape(b, -1)], axis=1)
-        cv = rows[cand_slots]
-        d = ball.dist(u[:, None, :], cv)
-        logits = -d
-        collide = (neg_idx == v_idx[:, None]) | (neg_idx == u_idx[:, None])
-        mask = jnp.concatenate(
-            [jnp.zeros_like(v_idx[:, None], bool), collide], axis=1)
-        logits = jnp.where(mask, -jnp.inf, logits)
-        return jnp.mean(jax.nn.logsumexp(logits, axis=1) - logits[:, 0])
+        return _ranking_loss(rows[inv[:b]], rows[cand_slots],
+                             u_idx, v_idx, neg_idx, cfg.c)
 
     loss, g_rows = jax.value_and_grad(sub_loss)(rows)
 
@@ -350,19 +348,11 @@ def train_step_sparse_planned(
     rows = state.table[safe_uniq]  # [U, d] sorted gather
 
     def sub_loss(rows):
-        ball = PoincareBall(cfg.c)
         flat = _dedup_gather(rows, inv_map, order, seg_sorted, n_slots)
-        u = flat[:b]
         cv = jnp.concatenate(
-            [flat[b : 2 * b, None], flat[2 * b :].reshape(b, -1, rows.shape[-1])],
-            axis=1)
-        d = ball.dist(u[:, None, :], cv)
-        logits = -d
-        collide = (neg_idx == v_idx[:, None]) | (neg_idx == u_idx[:, None])
-        mask = jnp.concatenate(
-            [jnp.zeros_like(v_idx[:, None], bool), collide], axis=1)
-        logits = jnp.where(mask, -jnp.inf, logits)
-        return jnp.mean(jax.nn.logsumexp(logits, axis=1) - logits[:, 0])
+            [flat[b : 2 * b, None],
+             flat[2 * b :].reshape(b, -1, rows.shape[-1])], axis=1)
+        return _ranking_loss(flat[:b], cv, u_idx, v_idx, neg_idx, cfg.c)
 
     loss, g_rows = jax.value_and_grad(sub_loss)(rows)
 
@@ -462,18 +452,10 @@ def train_step_planned_packed(
     rows = all_rows[:, :d]
 
     def sub_loss(rows):
-        ball = PoincareBall(cfg.c)
         flat = _dedup_gather(rows, inv_map, order, seg_sorted, n_slots)
-        u = flat[:b]
         cv = jnp.concatenate(
             [flat[b : 2 * b, None], flat[2 * b :].reshape(b, -1, d)], axis=1)
-        dist = ball.dist(u[:, None, :], cv)
-        logits = -dist
-        collide = (neg_idx == v_idx[:, None]) | (neg_idx == u_idx[:, None])
-        mask = jnp.concatenate(
-            [jnp.zeros_like(v_idx[:, None], bool), collide], axis=1)
-        logits = jnp.where(mask, -jnp.inf, logits)
-        return jnp.mean(jax.nn.logsumexp(logits, axis=1) - logits[:, 0])
+        return _ranking_loss(flat[:b], cv, u_idx, v_idx, neg_idx, cfg.c)
 
     loss, g_rows = jax.value_and_grad(sub_loss)(rows)
 

@@ -26,10 +26,12 @@ TPU-native analogue of the reference trainer's graph partitioning
   reverse-edge weights ``w_bwd[e] = 1/deg[s_e]`` precomputed on host.
   No scatter ever crosses a shard boundary.
 
-Mean aggregation only: the bench- and quality-default HGCN path.  (The
-attention path's softmax normalization needs cross-shard max/sum of
-runtime values; its node-sharded variant is a further round's work —
-`HGCConv` raises explicitly.)
+Mean aggregation uses the involution backward above (the bench- and
+quality-default HGCN path).  Attention aggregation node-shards too —
+receiver partitioning keeps its segment softmax shard-local, so
+:func:`node_sharded_att_aggregate` runs it with plain autodiff
+collectives (all-gather forward, psum-scatter backward) at a somewhat
+worse constant than the mean path.
 """
 
 from __future__ import annotations

@@ -163,3 +163,32 @@ def test_hgcconv_cluster_path_matches_default(rng):
     o2 = run(G.to_device(g_clust))
     np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_empty_clustered_set_is_safe(rng, interp):
+    """A split where nothing clusters still aggregates correctly: the
+    kernel path must not index chunk 0 of a zero-length edge array (it
+    returns zeros), and the straggler path carries everything."""
+    from hyperspace_tpu.data import graphs as G
+    from hyperspace_tpu.nn.scatter import cluster_sym_aggregate
+    from hyperspace_tpu.data.graphs import synthetic_hierarchy
+    from hyperspace_tpu.kernels.cluster import build_cluster_split
+
+    n = 600
+    edges, x, labels, ncls = synthetic_hierarchy(
+        num_nodes=n, feat_dim=12, seed=0)
+    g = G.prepare(edges, n, x, cluster=True, pad_multiple=256)
+    # production threshold on a toy graph: nothing reaches 10**6 edges
+    g.cluster_split = build_cluster_split(
+        g.senders, g.receivers, g.edge_mask, g.deg, n,
+        min_pair_edges=10**6)
+    assert len(g.cluster_split.c_recv) == 0
+    dg = G.to_device(g)
+    h = jnp.asarray(rng.standard_normal((n, 16)).astype(np.float32))
+    out = cluster_sym_aggregate(h, dg.cluster, n)
+    w = (g.edge_mask / np.maximum(g.deg, 1.0)[g.receivers]).astype(np.float32)
+    want = jax.ops.segment_sum(
+        jnp.asarray(w)[:, None] * h[jnp.asarray(g.senders)],
+        jnp.asarray(g.receivers), n)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
