@@ -107,9 +107,41 @@ def main():
     oks.append(run("csr_segment_reduce_1d_sum",
                    lambda: csr_segment_reduce_1d(svals, recv_d, plan, 200,
                                                  op="sum")))
+    # empty segments: the kernel's contract is the finite NEG_FILL
+    # sentinel where XLA's segment_max gives -inf — clamp both so the
+    # comparison tests the real values, not the sentinel encodings
+    from hyperspace_tpu.kernels.segment import NEG_FILL
+
     oks.append(run("csr_segment_reduce_1d_max",
-                   lambda: csr_segment_reduce_1d(svals, recv_d, plan, 200,
-                                                 op="max")))
+                   lambda: jnp.maximum(
+                       csr_segment_reduce_1d(svals, recv_d, plan, 200,
+                                             op="max"), NEG_FILL)))
+
+    # cluster-pair SpMM kernel (r03): one-hot matmuls over VMEM tiles,
+    # f32 and the fast single-pass bf16 mode
+    from hyperspace_tpu.kernels.cluster import (
+        build_cluster_plan,
+        cluster_aggregate,
+    )
+
+    n_cl = 700
+    r_cl = rng.integers(0, n_cl, 4096).astype(np.int32)
+    s_cl = rng.integers(0, n_cl, 4096).astype(np.int32)
+    key_cl = (r_cl // 256).astype(np.int64) * (n_cl // 256 + 1) + s_cl // 256
+    o_cl = np.argsort(key_cl, kind="stable")
+    r_cl, s_cl = r_cl[o_cl], s_cl[o_cl]
+    w_cl = jnp.asarray(rng.random(4096).astype(np.float32))
+    h_cl = jnp.asarray(rng.normal(size=(n_cl, 64)).astype(np.float32))
+    cplan = tuple(jnp.asarray(a_)
+                  for a_ in build_cluster_plan(r_cl, s_cl, n_cl))
+    r_cld, s_cld = jnp.asarray(r_cl), jnp.asarray(s_cl)
+    oks.append(run("cluster_aggregate_f32",
+                   lambda: cluster_aggregate(h_cl, w_cl, r_cld, s_cld,
+                                             cplan, n_cl)))
+    h_bf = h_cl.astype(jnp.bfloat16)
+    oks.append(run("cluster_aggregate_bf16",
+                   lambda: cluster_aggregate(h_bf, w_cl, r_cld, s_cld,
+                                             cplan, n_cl), tol=2e-2))
 
     print(json.dumps({"all_ok": all(oks), "backend": jax.default_backend()}),
           flush=True)
