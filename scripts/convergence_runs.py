@@ -59,6 +59,8 @@ def main():
     ap.add_argument("--steps", type=int, default=6000)
     ap.add_argument("--eval-every", type=int, default=500)
     ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--seed-start", type=int, default=0,
+                    help="resume a truncated session at this seed")
     ap.add_argument("--arms", default="all")
     args = ap.parse_args()
 
@@ -75,7 +77,7 @@ def main():
     neg_u, neg_plan = hgcn.make_static_negatives(n, int(pos.u.shape[0]), seed=0)
     sel = arms(hgcn, jnp, x.shape[1], args.arms)
 
-    for seed in range(args.seeds):
+    for seed in range(args.seed_start, args.seeds):
         for name, cfg in sel:
             model, opt, state = hgcn.init_lp(cfg, split.graph, seed=seed)
             t0 = time.perf_counter()
