@@ -59,6 +59,10 @@ class HGCNConfig:
     # (train_step_lp_pairs / _planned) get the full bandwidth win, the
     # unplanned step's XLA scatter much less — docs/benchmarks.md
     decoder_dtype: Any = None
+    # rematerialize each conv layer in the backward pass: trades one
+    # extra forward per layer for not keeping its [N, F] intermediates
+    # live — the HBM lever for graphs beyond arxiv scale (jax.checkpoint)
+    remat: bool = False
 
 
 class HGCNEncoder(nn.Module):
@@ -76,7 +80,7 @@ class HGCNEncoder(nn.Module):
         c_prev = cfg.c
         for i, d in enumerate(cfg.hidden_dims):
             is_last = i == len(cfg.hidden_dims) - 1
-            h, m = HGCConv(
+            conv = HGCConv(
                 features=d,
                 kind=cfg.kind,
                 c_in=c_prev,
@@ -87,7 +91,25 @@ class HGCNEncoder(nn.Module):
                 activation=(lambda v: v) if is_last else nn.relu,
                 agg_dtype=cfg.agg_dtype,
                 name=f"conv{i}",
-            )(h, g, deterministic=deterministic)
+            )
+            if cfg.remat:
+                # re-run the layer's forward during the backward instead
+                # of keeping its [N, F] / [E, F] intermediates live — the
+                # HBM lever for beyond-arxiv graphs.  Static curvature
+                # only: the remat'd callable must return arrays, so the
+                # output manifold is reconstructed outside.
+                if cfg.learn_c:
+                    raise ValueError("remat=True requires learn_c=False "
+                                     "(the remat boundary returns arrays)")
+
+                def run_conv(mdl, hh):
+                    out, _ = mdl(hh, g, deterministic=deterministic)
+                    return out
+
+                h = nn.remat(run_conv)(conv, h)
+                m = make_manifold(cfg.kind, cfg.c)
+            else:
+                h, m = conv(h, g, deterministic=deterministic)
             c_prev = m.c
         return h, m  # points on the final layer's manifold
 

@@ -156,3 +156,47 @@ def test_train_step_lp_pairs_reaches_auc():
             model, opt, 512, state, ga, pos, neg_u, neg_plan)
     res = hgcn.evaluate_lp(model, state.params, split, "test", ga=ga)
     assert res["roc_auc"] > 0.85, res
+
+
+def test_remat_matches_default():
+    """cfg.remat re-runs each conv in the backward; losses and gradients
+    must match the default step exactly (same math, less live memory)."""
+    import dataclasses
+
+    from hyperspace_tpu.data import graphs as G
+
+    edges, x, labels, ncls = G.synthetic_hierarchy(num_nodes=192, feat_dim=12,
+                                                   seed=0)
+    split = G.split_edges(edges, 192, x, seed=0, pad_multiple=128)
+    cfg = hgcn.HGCNConfig(feat_dim=12, hidden_dims=(16, 8))
+    ga = G.to_device(split.graph)
+    pos = jnp.asarray(split.train_pos)
+
+    model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
+    for _ in range(2):
+        state, loss = hgcn.train_step_lp(model, opt, 192, state, ga, pos)
+
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    model_r = hgcn.HGCNLinkPred(cfg_r)
+    _, _, state_r = hgcn.init_lp(cfg_r, split.graph, seed=0)
+    for _ in range(2):
+        state_r, loss_r = hgcn.train_step_lp(model_r, opt, 192, state_r, ga,
+                                             pos)
+    import jax
+
+    np.testing.assert_allclose(float(loss_r), float(loss), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7),
+        state.params, state_r.params)
+
+
+def test_remat_rejects_learned_curvature():
+    from hyperspace_tpu.data import graphs as G
+
+    edges, x, *_ = G.synthetic_hierarchy(num_nodes=128, feat_dim=8, seed=0)
+    split = G.split_edges(edges, 128, x, seed=0, pad_multiple=128)
+    cfg = hgcn.HGCNConfig(feat_dim=8, hidden_dims=(8,), remat=True,
+                          learn_c=True)
+    with pytest.raises(ValueError, match="remat"):
+        hgcn.init_lp(cfg, split.graph, seed=0)
