@@ -59,9 +59,11 @@ class HGCNConfig:
     # (train_step_lp_pairs / _planned) get the full bandwidth win, the
     # unplanned step's XLA scatter much less — docs/benchmarks.md
     decoder_dtype: Any = None
-    # rematerialize each conv layer in the backward pass: trades one
-    # extra forward per layer for not keeping its [N, F] intermediates
-    # live — the HBM lever for graphs beyond arxiv scale (jax.checkpoint)
+    # rematerialize each conv layer in the backward pass (jax.checkpoint):
+    # trades an extra forward per layer for not storing its residuals.
+    # Measured at arxiv-like shapes the peak temp is a single pass's
+    # [E, F] working set, not the residuals, so this only pays off for
+    # DEEP stacks (many layers) or very wide features; off by default.
     remat: bool = False
 
 
