@@ -18,6 +18,7 @@ from hyperspace_tpu.parallel.mesh import (  # noqa: F401
 from hyperspace_tpu.parallel.node_shard import (  # noqa: F401
     NodeShardedGraph,
     node_sharded_aggregate,
+    node_sharded_att_aggregate,
     partition_graph,
     shard_graph,
 )
