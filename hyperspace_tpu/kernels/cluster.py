@@ -44,7 +44,12 @@ from jax.experimental.pallas import tpu as pltpu
 from hyperspace_tpu.kernels import _support as S
 
 _BN = 256   # receiver-block rows (output tile)
-_BS = 256   # sender-block rows (h tile)
+_BS = 256   # sender-block rows (h tile).  bs=128/thr=64 wins the
+# ISOLATED forward aggregation (24.1 vs 29.4 ms — smaller tiles make
+# ~200-edge pairs profitable) but LOSES the full train step (0.146 vs
+# 0.136 s clean-chip): in the full step XLA overlaps the straggler
+# gather chain with other work, so shrinking it saves nothing while the
+# larger cluster grid adds serial time.  Full-step wins set the default.
 _BK = 512   # edges per chunk
 
 

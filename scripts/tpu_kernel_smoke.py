@@ -127,7 +127,10 @@ def main():
     n_cl = 700
     r_cl = rng.integers(0, n_cl, 4096).astype(np.int32)
     s_cl = rng.integers(0, n_cl, 4096).astype(np.int32)
-    key_cl = (r_cl // 256).astype(np.int64) * (n_cl // 256 + 1) + s_cl // 256
+    from hyperspace_tpu.kernels import cluster as CL
+
+    key_cl = ((r_cl // CL._BN).astype(np.int64) * (n_cl // CL._BS + 1)
+              + s_cl // CL._BS)
     o_cl = np.argsort(key_cl, kind="stable")
     r_cl, s_cl = r_cl[o_cl], s_cl[o_cl]
     w_cl = jnp.asarray(rng.random(4096).astype(np.float32))

@@ -18,7 +18,11 @@ from hyperspace_tpu.kernels.cluster import (
 )
 
 
-def _sorted_by_pair(r, s, num_nodes, bn=256, bs=256):
+def _sorted_by_pair(r, s, num_nodes, bn=None, bs=None):
+    from hyperspace_tpu.kernels import cluster as C
+
+    bn = bn or C._BN
+    bs = bs or C._BS
     key = (r // bn).astype(np.int64) * (num_nodes // bs + 1) + s // bs
     o = np.argsort(key, kind="stable")
     return r[o], s[o]
