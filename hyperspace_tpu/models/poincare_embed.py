@@ -113,13 +113,17 @@ def loss_fn(
     return _ranking_loss(u, table[cand], u_idx, v_idx, neg_idx, c)
 
 
-@partial(jax.jit, static_argnames=("cfg", "opt"), donate_argnames=("state",))
-def train_step(
+def _dense_step_body(
     cfg: PoincareEmbedConfig,
     opt,
     state: TrainState,
-    pairs: jax.Array,  # [P, 2] the full closure, resident on device
+    pairs: jax.Array,
 ) -> tuple[TrainState, jax.Array]:
+    """Un-jitted dense step body: device-side batch + negative sampling,
+    loss, grad, whole-table Riemannian update.  Shared verbatim by
+    :func:`train_step` (one dispatch per step) and
+    :func:`train_epoch_scan` (one dispatch per epoch) so the two
+    trajectories are the same computation."""
     key, k_batch, k_neg = jax.random.split(state.key, 3)
     num_pairs = pairs.shape[0]
     rows = jax.random.randint(k_batch, (cfg.batch_size,), 0, num_pairs)
@@ -132,6 +136,39 @@ def train_step(
     updates, opt_state = opt.update(grads, state.opt_state, state.table)
     table = optax.apply_updates(state.table, updates)
     return TrainState(table, opt_state, key, state.step + 1), loss
+
+
+@partial(jax.jit, static_argnames=("cfg", "opt"), donate_argnames=("state",))
+def train_step(
+    cfg: PoincareEmbedConfig,
+    opt,
+    state: TrainState,
+    pairs: jax.Array,  # [P, 2] the full closure, resident on device
+) -> tuple[TrainState, jax.Array]:
+    return _dense_step_body(cfg, opt, state, pairs)
+
+
+@partial(jax.jit, static_argnames=("cfg", "opt", "steps"),
+         donate_argnames=("state",))
+def train_epoch_scan(
+    cfg: PoincareEmbedConfig,
+    opt,
+    state: TrainState,
+    pairs: jax.Array,  # [P, 2] the full closure, resident on device
+    steps: int,
+) -> tuple[TrainState, jax.Array]:
+    """``steps`` dense steps as ONE XLA program (`lax.scan` over the step
+    body).  At WordNet scale the per-step device work is ~tens of µs of
+    compute on a [66 k, 10] table, so an epoch of separate dispatches is
+    dominated by launch latency; scanning the epoch removes all but one
+    dispatch.  Bitwise the same trajectory as ``steps`` calls of
+    :func:`train_step` (same body, same PRNG stream).  Returns the final
+    state and the [steps] per-step losses."""
+
+    def body(st, _):
+        return _dense_step_body(cfg, opt, st, pairs)
+
+    return jax.lax.scan(body, state, None, length=steps)
 
 
 @partial(jax.jit, static_argnames=("cfg", "opt"), donate_argnames=("state",))
@@ -430,21 +467,15 @@ def unpack_state(cfg: PoincareEmbedConfig, p: PackedState) -> TrainState:
     return TrainState(table, opt_state, p.key, p.step)
 
 
-@partial(jax.jit, static_argnames=("cfg", "opt"), donate_argnames=("state",))
-def train_step_planned_packed(
+def _packed_row_body(
     cfg: PoincareEmbedConfig,
     opt,
     state: PackedState,
-    plan: SparsePlan,
+    row: SparsePlan,  # single-step slices: [B], [B], [B, K], [U] ×4
 ) -> tuple[PackedState, jax.Array]:
-    """`train_step_sparse_planned` on a :class:`PackedState` — identical
-    math, one row gather and one sorted scatter-set regardless of the
-    optimizer's moment count."""
-    s = plan.u_idx.shape[0]
-    i = state.step % s
-    take = lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
-    u_idx, v_idx, neg_idx, uniq, inv_map, order, seg_sorted = (
-        take(a) for a in plan)
+    """Un-jitted packed-planned step body on one plan row; shared by
+    :func:`train_step_planned_packed` and :func:`train_epoch_planned_packed`."""
+    u_idx, v_idx, neg_idx, uniq, inv_map, order, seg_sorted = row
     b, d = cfg.batch_size, cfg.dim
     n_slots = uniq.shape[0]
     safe_uniq = jnp.minimum(uniq, cfg.num_nodes - 1)
@@ -475,6 +506,43 @@ def train_step_planned_packed(
         new_all.astype(state.packed.dtype),
         mode="drop", indices_are_sorted=True)  # ONE scatter
     return PackedState(packed, aux, key_after(state.key), state.step + 1), loss
+
+
+@partial(jax.jit, static_argnames=("cfg", "opt"), donate_argnames=("state",))
+def train_step_planned_packed(
+    cfg: PoincareEmbedConfig,
+    opt,
+    state: PackedState,
+    plan: SparsePlan,
+) -> tuple[PackedState, jax.Array]:
+    """`train_step_sparse_planned` on a :class:`PackedState` — identical
+    math, one row gather and one sorted scatter-set regardless of the
+    optimizer's moment count.  Consumes plan row ``state.step % S``."""
+    s = plan.u_idx.shape[0]
+    i = state.step % s
+    take = lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+    row = SparsePlan(*(take(a) for a in plan))
+    return _packed_row_body(cfg, opt, state, row)
+
+
+@partial(jax.jit, static_argnames=("cfg", "opt"), donate_argnames=("state",))
+def train_epoch_planned_packed(
+    cfg: PoincareEmbedConfig,
+    opt,
+    state: PackedState,
+    plan: SparsePlan,
+) -> tuple[PackedState, jax.Array]:
+    """All S planned steps as ONE XLA program: `lax.scan` over the plan
+    rows in order.  Identical trajectory to S calls of
+    :func:`train_step_planned_packed` when ``state.step % S == 0`` at
+    entry (the single-step variant picks rows by ``step % S``, the scan
+    consumes them front to back).  Returns the final state and the [S]
+    per-step losses."""
+
+    def body(st, row):
+        return _packed_row_body(cfg, opt, st, row)
+
+    return jax.lax.scan(body, state, plan)
 
 
 def init_state(cfg: PoincareEmbedConfig, seed: int = 0) -> tuple[TrainState, optax.GradientTransformation]:

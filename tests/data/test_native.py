@@ -71,3 +71,19 @@ def test_prepare_edges_matches_numpy_oracle():
             if name == "rev" and not sym:
                 continue
             np.testing.assert_array_equal(a, b, err_msg=name)
+
+
+def test_locality_order_matches_python_oracle():
+    """Native BFS relabeling vs the deque walk — exact order equality
+    (adjacency order and seed tie-breaking must match, not just the set
+    of visited nodes)."""
+    from hyperspace_tpu.data.graphs import _locality_order_python
+
+    rng = np.random.default_rng(1)
+    for n, ne in [(1, 0), (30, 0), (60, 150), (200, 800)]:
+        edges = (rng.integers(0, n, (ne, 2)).astype(np.int32)
+                 if ne else np.zeros((0, 2), np.int32))
+        got = native.locality_order(edges, n)
+        want = _locality_order_python(edges, n)
+        np.testing.assert_array_equal(got, want)
+        assert sorted(got.tolist()) == list(range(n))  # a permutation

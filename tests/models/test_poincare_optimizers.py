@@ -89,3 +89,33 @@ def test_sparse_handles_duplicate_rows_in_batch():
     assert np.isfinite(t).all()
     assert np.linalg.norm(t, axis=-1).max() < 1.0
     assert np.isfinite(float(loss))
+
+
+def test_epoch_scan_matches_stepwise_dense():
+    """train_epoch_scan is the same computation as N train_step calls —
+    same body, same PRNG stream, so the trajectories agree bitwise."""
+    cfg = _cfg()
+    pairs = jnp.asarray(_DS.pairs)
+    s1, opt = pe.init_state(cfg, 3)
+    s2, _ = pe.init_state(cfg, 3)
+    for _ in range(4):
+        s1, _ = pe.train_step(cfg, opt, s1, pairs)
+    s2, losses = pe.train_epoch_scan(cfg, opt, s2, pairs, 4)
+    np.testing.assert_array_equal(np.asarray(s1.table), np.asarray(s2.table))
+    assert losses.shape == (4,)
+    assert int(s2.step) == 4
+
+
+def test_epoch_scan_matches_stepwise_planned_packed():
+    """Scanned plan consumption == step%S consumption from step 0 (radam
+    moments ride along in the packed rows)."""
+    cfg = _cfg(optimizer="radam", lr=0.05, burnin_steps=0)
+    plan = pe.plan_sparse_steps(cfg, _DS.pairs, 4, seed=2)
+    st1, opt = pe.init_state(cfg, 5)
+    st2, _ = pe.init_state(cfg, 5)
+    p1, p2 = pe.pack_state(cfg, st1), pe.pack_state(cfg, st2)
+    for _ in range(4):
+        p1, _ = pe.train_step_planned_packed(cfg, opt, p1, plan)
+    p2, losses = pe.train_epoch_planned_packed(cfg, opt, p2, plan)
+    np.testing.assert_array_equal(np.asarray(p1.packed), np.asarray(p2.packed))
+    assert losses.shape == (4,)
