@@ -61,6 +61,17 @@ def _poincare_steppers(cfg, pairs, plan_steps):
     return out, plan
 
 
+def _time_planned_scan(cfg, plan, repeats):
+    """Wall time of one scanned planned epoch (all plan rows, one program)."""
+    from hyperspace_tpu.models import poincare_embed as pe
+
+    state, opt = pe.init_state(cfg)
+    return _time_steps(
+        (lambda st, o=opt, p=plan:
+         pe.train_epoch_planned_packed(cfg, o, st, p)),
+        pe.pack_state(cfg, state), 1, repeats)
+
+
 def bench_poincare(repeats: int = 3) -> dict:
     """Epoch time for Poincaré embeddings on a WordNet-noun-scale tree.
 
@@ -107,11 +118,8 @@ def bench_poincare(repeats: int = 3) -> dict:
         (lambda st, o=opt: pe.train_epoch_scan(cfg, o, st, pairs,
                                                steps_per_epoch)),
         state, 1, repeats), 4)
-    state, opt = pe.init_state(cfg)  # plan reused from _poincare_steppers
-    epochs["planned_scan"] = round(_time_steps(
-        (lambda st, o=opt, p=plan:
-         pe.train_epoch_planned_packed(cfg, o, st, p)),
-        pe.pack_state(cfg, state), 1, repeats), 4)
+    epochs["planned_scan"] = round(  # plan reused from _poincare_steppers
+        _time_planned_scan(cfg, plan, repeats), 4)
     update = min(epochs, key=epochs.get)
 
     # arxiv-scale table: dense pays O(N) table+moment traffic per step,
@@ -129,11 +137,8 @@ def bench_poincare(repeats: int = 3) -> dict:
         large[f"{name}_step_ms"] = round(
             _time_steps(stepper, state, n_big_steps, max(2, repeats - 1))
             / n_big_steps * 1e3, 3)
-    state, opt = pe.init_state(big_cfg)
-    large["planned_scan_step_ms"] = round(_time_steps(
-        (lambda st, o=opt, p=big_plan:
-         pe.train_epoch_planned_packed(big_cfg, o, st, p)),
-        pe.pack_state(big_cfg, state), 1, max(2, repeats - 1))
+    large["planned_scan_step_ms"] = round(
+        _time_planned_scan(big_cfg, big_plan, max(2, repeats - 1))
         / n_big_steps * 1e3, 3)
     large["update"] = min(
         ("dense", "sparse", "planned", "planned_scan"),

@@ -301,3 +301,39 @@ def test_node_sharded_learned_curvature_and_bf16_messages():
     c0 = state.params["encoder"]["conv0"]["c_raw"]
     c1 = state2.params["encoder"]["conv0"]["c_raw"]
     np.testing.assert_allclose(np.asarray(c1), np.asarray(c0), rtol=1e-2)
+
+
+@pytest.mark.slow
+def test_per_device_cost_scales_to_v5e16_shape():
+    """The v5e-16 projection (BASELINE north star): on a 16-virtual-device
+    mesh, compiled per-device cost of the node-sharded step must keep
+    falling through dp=16 — <=20% of single-device FLOPs (ideal 6.25%,
+    overhead is the per-layer [N, F] all-gather) and monotone in dp.
+    Runs scripts/cost_scaling_probe.py in a subprocess because the
+    conftest pins this process to 8 virtual devices."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)  # the probe sets its own device count
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo_root] + env.get("PYTHONPATH", "").split(os.pathsep))
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(repo_root, "scripts", "cost_scaling_probe.py"),
+         "--ndev", "16"],
+        capture_output=True, text=True, env=env, timeout=900, check=True)
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    ratios = [(int(k), v["flops_ratio"], v["bytes_ratio"])
+              for k, v in sorted(rec["dp"].items(), key=lambda kv: int(kv[0]))]
+    assert ratios[0][0] == 1 and 0.9 <= ratios[0][1] <= 1.2  # sanity anchor
+    flops = [f for _, f, _ in ratios]
+    assert flops == sorted(flops, reverse=True), f"not monotone: {ratios}"
+    dp16 = rec["dp"]["16"]
+    assert dp16["flops_ratio"] <= 0.20, dp16
+    assert dp16["bytes_ratio"] <= 0.25, dp16
