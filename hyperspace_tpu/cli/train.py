@@ -44,6 +44,10 @@ class RunConfig:
     data_root: str | None = None  # on-disk dataset directory
     multihost: bool = False  # jax.distributed.initialize + host mesh axis
     tp: int = 2  # tensor-parallel degree for HGCN's auto mesh (1 = pure dp)
+    # >1: run this many steps per dispatch as one lax.scan program
+    # (poincare only; see models/poincare_embed.train_epoch_scan —
+    # removes per-step launch latency on small-step workloads)
+    scan_chunk: int = 1
     coordinator: str = "127.0.0.1:9357"
     num_processes: int = 1
     process_id: int = 0
@@ -108,10 +112,27 @@ def run_poincare(run: RunConfig, overrides: dict):
     from hyperspace_tpu.manifolds import PoincareBall
 
     ball = PoincareBall(cfg.c)
-    step_fn = pe.make_train_step(cfg)
-    state, _ = _train_loop(run, state,
-                           lambda st: step_fn(cfg, opt, st, pairs),
-                           project=lambda st: st._replace(table=ball.proj(st.table)))
+    project = lambda st: st._replace(table=ball.proj(st.table))
+    if run.scan_chunk > 1:  # chunked dispatch: scan_chunk steps/program
+        if cfg.sparse:
+            raise SystemExit(
+                "scan_chunk>1 scans the dense step body only — drop "
+                "sparse=true or scan_chunk (the planned-sparse scan lives "
+                "in poincare_embed.train_epoch_planned_packed)")
+        # every dispatch runs exactly scan_chunk steps, so round the
+        # step budget up to a chunk multiple — checkpoint/log step
+        # numbers then always equal the steps actually taken
+        chunks = -(-run.steps // run.scan_chunk)
+        run = dataclasses.replace(run, steps=chunks * run.scan_chunk)
+        stepper = lambda st: pe.train_epoch_scan(cfg, opt, st, pairs,
+                                                 run.scan_chunk)
+        state, _ = _train_loop(run, state, stepper, project=project,
+                               steps_per_call=run.scan_chunk)
+    else:
+        step_fn = pe.make_train_step(cfg)
+        state, _ = _train_loop(run, state,
+                               lambda st: step_fn(cfg, opt, st, pairs),
+                               project=project)
     res = pe.evaluate(state.table, ds.pairs, cfg.c)
     return {"workload": "poincare", "steps": run.steps, **res}
 
@@ -291,7 +312,8 @@ def _logger(run: RunConfig):
                          tensorboard_dir=run.tensorboard_dir)
 
 
-def _train_loop(run: RunConfig, state, stepper, project=None):
+def _train_loop(run: RunConfig, state, stepper, project=None,
+                steps_per_call=1):
     """Shared CLI step loop: optional checkpoint/resume + JSONL logging.
 
     Every workload runner goes through here, so --ckpt-dir / resume work
@@ -321,22 +343,33 @@ def _train_loop(run: RunConfig, state, stepper, project=None):
         if ck is not None and run.resume and ck.latest_step() is not None:
             state, start = ck.restore(state, project=project)
         last_saved = None
-        for i in range(start, run.steps):
+        every = run.eval_every or 50
+        done = start
+        while done < run.steps:
             state, loss = stepper(state)
-            _maybe_log(log, run, i, loss)
-            if ck is not None and ck.save(i + 1, state):
-                last_saved = i + 1
-        if ck is not None and start < run.steps and last_saved != run.steps:
-            # the final state must land even when steps % ckpt_every != 0 —
-            # otherwise resume silently replays up to ckpt_every-1 steps
-            ck.save(run.steps, state, force=True)
+            if jnp.ndim(loss):  # scanned chunk: [steps_per_call] losses
+                loss = loss[-1]
+            # the stepper always executes exactly steps_per_call steps
+            # (the scan length is baked into the program), so the
+            # recorded step count is the TRUE count — never clamped
+            prev, done = done, done + steps_per_call
+            # boundary-crossing gates: with chunked stepping, `done` only
+            # takes chunk multiples, so exact-equality cadence would
+            # degrade to lcm(chunk, interval); fire whenever the chunk
+            # crossed an interval boundary (identical to the old
+            # `done % every == 0` when steps_per_call == 1)
+            if (done // every) > (prev // every):
+                log.log(done, loss=float(loss))
+            if ck is not None:
+                crossed = (done // run.ckpt_every) > (prev // run.ckpt_every)
+                if ck.save(done, state,
+                           force=crossed and steps_per_call > 1):
+                    last_saved = done
+        if ck is not None and start < run.steps and last_saved != done:
+            # the final state must land even when it misses the save
+            # cadence — otherwise resume silently replays a partial chunk
+            ck.save(done, state, force=True)
     return state, loss
-
-
-def _maybe_log(log, run: RunConfig, step: int, loss):
-    every = run.eval_every or 50
-    if (step + 1) % every == 0:
-        log.log(step + 1, loss=float(loss))
 
 
 def main(argv: list[str] | None = None) -> int:

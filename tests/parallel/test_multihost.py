@@ -35,8 +35,11 @@ def _env() -> dict:
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)  # workers set their own device count
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    extra = env.get("PYTHONPATH")  # no empty entry (= cwd) when unset
     env["PYTHONPATH"] = os.pathsep.join(
-        [os.getcwd()] + env.get("PYTHONPATH", "").split(os.pathsep))
+        [repo_root] + (extra.split(os.pathsep) if extra else []))
     return env
 
 

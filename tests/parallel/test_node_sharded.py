@@ -321,8 +321,9 @@ def test_per_device_cost_scales_to_v5e16_shape():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.pop("XLA_FLAGS", None)  # the probe sets its own device count
+    extra = env.get("PYTHONPATH")  # no empty entry (= cwd) when unset
     env["PYTHONPATH"] = os.pathsep.join(
-        [repo_root] + env.get("PYTHONPATH", "").split(os.pathsep))
+        [repo_root] + (extra.split(os.pathsep) if extra else []))
     out = subprocess.run(
         [sys.executable,
          os.path.join(repo_root, "scripts", "cost_scaling_probe.py"),

@@ -143,3 +143,19 @@ def test_cli_checkpoint_resume_poincare(tmp_path, capsys):
 
     assert resumed["map"] == pytest.approx(full["map"], abs=1e-9)
     assert resumed["mean_rank"] == pytest.approx(full["mean_rank"], abs=1e-9)
+
+
+@pytest.mark.slow
+def test_cli_scan_chunk_poincare(tmp_path, capsys):
+    """scan_chunk trains through train_epoch_scan with the step budget
+    rounded up to a chunk multiple, and checkpoint steps stay truthful."""
+    from hyperspace_tpu.cli import train as cli
+    from hyperspace_tpu.train.checkpoint import CheckpointManager
+
+    rc = cli.main(["poincare", "steps=20", "scan_chunk=8", "dim=4",
+                   "batch_size=32", f"ckpt_dir={tmp_path}/ck"])
+    assert rc == 0
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert res["steps"] == 24  # 20 rounded up to a multiple of 8
+    with CheckpointManager(f"{tmp_path}/ck") as ck:
+        assert ck.latest_step() == 24
