@@ -134,7 +134,9 @@ def run_poincare(run: RunConfig, overrides: dict):
                                lambda st: step_fn(cfg, opt, st, pairs),
                                project=project)
     res = pe.evaluate(state.table, ds.pairs, cfg.c)
-    return {"workload": "poincare", "steps": run.steps, **res}
+    # state.step is the authoritative count (survives resume/chunk
+    # rounding — a resumed chunked run can legitimately exceed run.steps)
+    return {"workload": "poincare", "steps": int(state.step), **res}
 
 
 def run_hgcn(run: RunConfig, overrides: dict):
@@ -144,6 +146,17 @@ def run_hgcn(run: RunConfig, overrides: dict):
     task = overrides.pop("task", "lp")
     dataset = overrides.pop("dataset", "cora")
     reorder = overrides.pop("reorder", "false").lower() in ("1", "true", "yes")
+    # neighbor-sampled minibatch mode (task=nc only): fixed-fanout
+    # pyramids from the native sampler; supervises `batch` seeds/step
+    sampled = overrides.pop("sampled", "false").lower() in ("1", "true", "yes")
+    if sampled and task != "nc":
+        raise SystemExit("sampled=true requires task=nc (the minibatch "
+                         "trainer supervises labeled seed nodes)")
+    fanouts = tuple(json.loads(overrides.pop("fanouts", "[10, 10]")))
+    batch = int(overrides.pop("batch", "512"))
+    # batches are pre-planned host-side and recycled modulo this count —
+    # caps the [S, B, f1, f2] id pyramid's device footprint on long runs
+    plan_steps = int(overrides.pop("plan_steps", "64"))
     edges, x, labels, ncls, source = G.load_graph(dataset, run.data_root)
     if reorder:  # BFS locality relabeling: feeds the cluster-pair kernel
         edges, x, labels, _ = G.apply_locality_order(edges, x, labels)
@@ -181,6 +194,34 @@ def run_hgcn(run: RunConfig, overrides: dict):
         tr, va, te = G.node_split_masks(num_nodes, seed=run.seed)
         g = G.prepare(edges, num_nodes, x, labels=labels, num_classes=ncls,
                       train_mask=tr, val_mask=va, test_mask=te)
+        if sampled:
+            # minibatch trainer (models/hgcn_sampled.py): single-device
+            # dense-block steps (a local mesh is simply unused);
+            # evaluation runs the FULL-GRAPH model on the sampled-trained
+            # parameters (identical param tree)
+            if run.multihost:
+                raise SystemExit(
+                    "sampled=true is single-process — drop multihost=true "
+                    "(sampled minibatch DP is not wired yet)")
+            from hyperspace_tpu.models import hgcn_sampled as HS
+
+            scfg = HS.SampledConfig(base=cfg, fanouts=fanouts,
+                                    batch_size=batch)
+            model_s, opt, state = HS.init_sampled_nc(
+                scfg, feat_dim=x.shape[1], seed=run.seed)
+            batches, deg = HS.plan_batches(
+                scfg, edges, labels, tr, num_nodes,
+                steps=min(run.steps, plan_steps), seed=run.seed)
+            xt = jnp.asarray(np.asarray(x, np.float32))
+            state, loss = _train_loop(
+                run, state,
+                lambda st: HS.train_step_sampled_nc(model_s, opt, st, xt,
+                                                    deg, batches))
+            full = hgcn.HGCNNodeClf(cfg)
+            res = {"loss": float(loss),
+                   **hgcn.evaluate_nc(full, state.params, g)}
+            return {"workload": "hgcn", "task": "nc", "dataset": dataset,
+                    "source": source, "sampled": True, **res}
         model, opt, state = hgcn.init_nc(cfg, g, seed=run.seed)
         ga = hgcn._device_graph(g)
         lab = jnp.asarray(g.labels)
@@ -360,8 +401,12 @@ def _train_loop(run: RunConfig, state, stepper, project=None,
             # `done % every == 0` when steps_per_call == 1)
             if (done // every) > (prev // every):
                 log.log(done, loss=float(loss))
-            if ck is not None:
-                crossed = (done // run.ckpt_every) > (prev // run.ckpt_every)
+            # ckpt_every <= 0 = final save only (mirrors eval_every's
+            # "0 = eval only at the end"; orbax's interval gate divides
+            # by the interval, so it never sees a 0)
+            if ck is not None and run.ckpt_every > 0:
+                iv = run.ckpt_every
+                crossed = (done // iv) > (prev // iv)
                 if ck.save(done, state,
                            force=crossed and steps_per_call > 1):
                     last_saved = done

@@ -1,0 +1,44 @@
+// Uniform neighbor sampling for minibatch (GraphSAGE-style) HGCN
+// training: the host-side data-loader hot path that fills the static
+// [B, f1], [B, f1, f2], ... index blocks the jitted sampled train step
+// consumes.  Stateless per-cell RNG (splitmix64 of seed ^ cell index) so
+// the numpy oracle in data/native.py reproduces every draw bit-exactly
+// (tests/data/test_native.py).
+//
+// Sampling is uniform WITH replacement over the node's adjacency list;
+// a node with no neighbors yields itself (the sampled aggregation then
+// weights its neighbor sum by zero — see models/hgcn_sampled.py).
+
+#include <cstdint>
+
+extern "C" {
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+// indptr: [num_nodes + 1] int64 CSR row offsets; indices: neighbor ids.
+// seeds: [n_seeds] int32 nodes to sample for.  out: [n_seeds * fanout].
+void sample_neighbors(const int64_t* indptr, const int32_t* indices,
+                      const int32_t* seeds, int64_t n_seeds, int32_t fanout,
+                      uint64_t seed, int32_t* out) {
+  for (int64_t i = 0; i < n_seeds; ++i) {
+    const int32_t u = seeds[i];
+    const int64_t off = indptr[u];
+    const int64_t deg = indptr[u + 1] - off;
+    for (int32_t j = 0; j < fanout; ++j) {
+      const int64_t cell = i * fanout + j;
+      if (deg == 0) {
+        out[cell] = u;  // isolated: self (weighted 0 by the aggregator)
+      } else {
+        const uint64_t r = splitmix64(seed ^ static_cast<uint64_t>(cell));
+        out[cell] = indices[off + static_cast<int64_t>(r % deg)];
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -24,7 +24,7 @@ import numpy as np
 
 _DIR = os.path.join(os.path.dirname(__file__), "_native")
 _SRCS = [os.path.join(_DIR, "closure.cc"), os.path.join(_DIR, "graphprep.cc"),
-         os.path.join(_DIR, "localorder.cc")]
+         os.path.join(_DIR, "localorder.cc"), os.path.join(_DIR, "sampler.cc")]
 _LIB = os.path.join(_DIR, "libhsdata.so")
 
 _lib = None
@@ -82,6 +82,11 @@ def _load() -> ctypes.CDLL:
     lib.locality_order.argtypes = [
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_int64)]
+    lib.sample_neighbors.restype = None
+    lib.sample_neighbors.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32)]
     _lib = lib
     return lib
 
@@ -170,6 +175,61 @@ def locality_order(edges: np.ndarray, num_nodes: int) -> np.ndarray:
         e.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), e.shape[0],
         int(num_nodes), out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
     return out
+
+
+def sample_neighbors(indptr: np.ndarray, indices: np.ndarray,
+                     seeds: np.ndarray, fanout: int,
+                     seed: int = 0) -> np.ndarray:
+    """[len(seeds), fanout] uniform with-replacement neighbor draws.
+
+    CSR adjacency (``indptr`` int64 [N+1], ``indices`` int32); isolated
+    nodes yield themselves.  Per-cell stateless splitmix64 RNG —
+    :func:`sample_neighbors_numpy` is the bit-exact oracle.
+    """
+    lib = _load()
+    indptr = np.ascontiguousarray(indptr, np.int64)
+    indices = np.ascontiguousarray(indices, np.int32)
+    seeds = np.ascontiguousarray(seeds, np.int32)
+    out = np.empty((len(seeds), fanout), np.int32)
+    lib.sample_neighbors(
+        indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        seeds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(seeds), int(fanout), int(seed) & (2**64 - 1),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out
+
+
+def sample_neighbors_numpy(indptr: np.ndarray, indices: np.ndarray,
+                           seeds: np.ndarray, fanout: int,
+                           seed: int = 0) -> np.ndarray:
+    """Vectorized numpy twin of :func:`sample_neighbors` — same splitmix64
+    stream per output cell, so the two agree bit-exactly (parity oracle
+    and the no-toolchain fallback)."""
+    indptr = np.asarray(indptr, np.int64)
+    indices = np.asarray(indices, np.int32)
+    seeds = np.asarray(seeds, np.int64)
+    off = indptr[seeds]                                     # [K]
+    deg = indptr[seeds + 1] - off                           # [K]
+    cells = (np.arange(len(seeds), dtype=np.uint64)[:, None]
+             * np.uint64(fanout)
+             + np.arange(fanout, dtype=np.uint64)[None, :])  # [K, f]
+    with np.errstate(over="ignore"):
+        x = np.uint64(seed) ^ cells
+        x += np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x ^= x >> np.uint64(31)
+    if len(indices) == 0:  # every node isolated: all-self
+        return np.broadcast_to(seeds[:, None], (len(seeds), fanout)
+                               ).astype(np.int32).copy()
+    safe_deg = np.maximum(deg, 1).astype(np.uint64)[:, None]
+    # isolated rows (deg 0) produce an in-range dummy pick, then np.where
+    # replaces them with the seed itself (the C++ branch does the same)
+    pick = np.minimum((x % safe_deg).astype(np.int64) + off[:, None],
+                      len(indices) - 1)
+    return np.where(deg[:, None] > 0, indices[pick],
+                    seeds[:, None]).astype(np.int32)
 
 
 def sample_negative_edges(

@@ -1,0 +1,304 @@
+"""Neighbor-sampled minibatch HGCN training (GraphSAGE-style fanouts).
+
+Full-graph HGCN training (models/hgcn.py) holds every [N, F] layer
+activation per step — the right trade at ogbn-arxiv scale, but the
+per-step footprint grows with the graph, and its "samples/s" counts
+every node each step.  This module is the complementary training mode
+the reference family ships alongside full-graph trainers [INFERRED —
+SURVEY.md §1a "models" layer]: fixed-fanout neighbor sampling with
+**static block shapes**, where one step supervises exactly
+``batch_size`` labeled seed nodes.
+
+TPU-first design (what makes this NOT a translation of a CPU sampler
+loop):
+
+- **No scatter, no segment ops, no edge lists on device.**  A batch is a
+  pyramid of dense index blocks — seeds ``[B]``, their sampled neighbors
+  ``[B, f1]``, the neighbors' neighbors ``[B, f1, f2]`` — so every
+  aggregation is a plain ``mean`` over a trailing axis of an MXU-shaped
+  tensor.  The irregular work (adjacency walk, uniform draws) happens in
+  the native C++ sampler (`data/_native/sampler.cc`) on the host, where
+  it belongs.
+- **Unbiased estimator of the full-graph operator.**  The full-graph
+  layer aggregates with self-loop-inclusive mean weights
+  ``(h_self + Σ_nbrs h) / (1 + n_nbrs)``; the sampled layer computes
+  ``(h_self + (n_nbrs / f) · Σ_{f samples} h) / (1 + n_nbrs)`` whose
+  expectation over the sampler's uniform draws is exactly the full sum.
+  Nodes whose degree ≤ the fanout are reconstructed near-exactly;
+  isolated nodes reduce to ``h_self``.
+- **Parameter-tree compatibility.**  Layer/param names mirror
+  ``HGCNEncoder``/``HGCNNodeClf`` (``encoder/conv{i}/kernel`` …,
+  ``head``), so parameters trained with sampled minibatches evaluate
+  with the exact full-graph model (`hgcn.evaluate_nc`) — tested in
+  tests/models/test_hgcn_sampled.py.
+
+Mean aggregation only: attention weights over a sampled multiset would
+estimate a different (renormalized) operator than the full-graph
+segment softmax, so ``use_att=True`` is rejected rather than silently
+diverging.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, NamedTuple, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from hyperspace_tpu.models import hgcn
+from hyperspace_tpu.nn.gcn import (
+    from_tangent0_coords,
+    make_manifold,
+    tangent0_coords,
+)
+from hyperspace_tpu.nn.mlr import HypMLR, LorentzMLR
+
+
+@dataclasses.dataclass(frozen=True)
+class SampledConfig:
+    base: hgcn.HGCNConfig
+    # fanouts[l] = neighbors sampled per node at pyramid level l; length
+    # must equal len(base.hidden_dims) (one sampling level per conv)
+    fanouts: Sequence[int] = (10, 10)
+    batch_size: int = 512
+
+    def __post_init__(self):
+        if len(self.fanouts) != len(self.base.hidden_dims):
+            raise ValueError(
+                f"need one fanout per conv layer: {self.fanouts} vs "
+                f"hidden_dims {self.base.hidden_dims}")
+        if self.base.use_att:
+            raise ValueError(
+                "sampled HGCN is mean-aggregation only (a sampled softmax "
+                "estimates a different operator than the full-graph one)")
+
+
+class SampledHGCConv(nn.Module):
+    """One conv layer on a dense (self, sampled-neighbors) block.
+
+    Same math as ``nn.gcn.HGCConv`` — tangent-0 matmul, mean
+    aggregation, activation, expmap at the (optionally learned) output
+    curvature — with identical param names/shapes, so trees transfer."""
+
+    features: int
+    kind: str = "lorentz"
+    c_in: float = 1.0
+    c_out: float = 1.0
+    learn_c: bool = False
+    use_bias: bool = True
+    activation: Any = nn.relu
+    dropout_rate: float = 0.0
+    kernel_init: Any = nn.initializers.glorot_uniform()
+
+    @nn.compact
+    def __call__(self, x_self, x_nbr, n_nbrs, *, deterministic=True):
+        # x_self [..., amb]; x_nbr [..., f, amb]; n_nbrs [...] true degree
+        m_in = make_manifold(self.kind, self.c_in)
+        if self.learn_c:
+            init = float(np.log(np.expm1(self.c_out)))
+            c_raw = self.param("c_raw", nn.initializers.constant(init), ())
+            c_out = nn.softplus(c_raw)
+        else:
+            c_out = self.c_out
+        m_out = make_manifold(self.kind, c_out)
+
+        v_self = tangent0_coords(m_in, x_self)
+        v_nbr = tangent0_coords(m_in, x_nbr)
+        kernel = self.param("kernel", self.kernel_init,
+                            (v_self.shape[-1], self.features), v_self.dtype)
+        h_self = v_self @ kernel
+        h_nbr = v_nbr @ kernel
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), v_self.dtype)
+            h_self = h_self + bias
+            h_nbr = h_nbr + bias
+        if self.dropout_rate > 0.0:
+            drop = nn.Dropout(self.dropout_rate)
+            h_self = drop(h_self, deterministic=deterministic)
+            h_nbr = drop(h_nbr, deterministic=deterministic)
+
+        # E[agg] = the full-graph self-loop-inclusive mean (module doc)
+        f = x_nbr.shape[-2]
+        n = n_nbrs.astype(h_self.dtype)[..., None]
+        agg = (h_self + (n / f) * jnp.sum(h_nbr, axis=-2)) / (1.0 + n)
+        return from_tangent0_coords(m_out, self.activation(agg)), m_out
+
+
+class SampledEncoder(nn.Module):
+    """Feature lift + stacked SampledHGCConv over the index pyramid."""
+
+    cfg: hgcn.HGCNConfig
+
+    @nn.compact
+    def __call__(self, levels, n_nbrs, *, deterministic=True):
+        # levels[l]: [B, f1, .., fl, F0] raw features; n_nbrs[l] degrees
+        cfg = self.cfg
+        m0 = make_manifold(cfg.kind, cfg.c)
+        pts = [from_tangent0_coords(m0, x.astype(cfg.dtype)) for x in levels]
+        c_prev = cfg.c
+        m = m0
+        for i, d in enumerate(cfg.hidden_dims):
+            is_last = i == len(cfg.hidden_dims) - 1
+            conv = SampledHGCConv(
+                features=d,
+                kind=cfg.kind,
+                c_in=c_prev,
+                c_out=cfg.c,
+                learn_c=cfg.learn_c,
+                dropout_rate=cfg.dropout,
+                activation=(lambda v: v) if is_last else nn.relu,
+                name=f"conv{i}",
+            )
+            new_pts = []
+            for l in range(len(pts) - 1):
+                out, m = conv(pts[l], pts[l + 1], n_nbrs[l],
+                              deterministic=deterministic)
+                new_pts.append(out)
+            pts = new_pts  # every call shares the layer's params, so the
+            c_prev = m.c   # manifold from the last call is THE layer output
+        return pts[0], m
+
+
+class SampledHGCNNodeClf(nn.Module):
+    """Sampled encoder + the same MLR head as ``HGCNNodeClf``."""
+
+    cfg: hgcn.HGCNConfig
+
+    @nn.compact
+    def __call__(self, levels, n_nbrs, *, deterministic=True):
+        z, m = SampledEncoder(self.cfg, name="encoder")(
+            levels, n_nbrs, deterministic=deterministic)
+        if self.cfg.kind == "euclidean":
+            return nn.Dense(self.cfg.num_classes, name="head")(z)
+        head = LorentzMLR if self.cfg.kind == "lorentz" else HypMLR
+        return head(self.cfg.num_classes, m, name="head")(z)
+
+
+# --- host-side batch planning -------------------------------------------------
+
+
+def build_adjacency(edges: np.ndarray, num_nodes: int):
+    """Undirected CSR (indptr int64 [N+1], indices int32) for the sampler.
+
+    Self-loops are NOT added — the sampled layer handles the self term
+    explicitly (module doc), mirroring how ``data.graphs.prepare`` owns
+    the self-loop for the full-graph path."""
+    e = np.asarray(edges, np.int64)
+    e = e[e[:, 0] != e[:, 1]] if len(e) else e.reshape(0, 2)
+    both = np.concatenate([e, e[:, ::-1]]) if len(e) else e
+    # dedupe like graphs.prepare does: duplicate rows or both orientations
+    # in the input must not inflate degrees, or the sampled estimator
+    # targets a different operator than the full-graph eval model
+    key = both[:, 0] * num_nodes + both[:, 1] if len(both) else both[:, :0]
+    s = both[np.unique(key, return_index=True)[1]] if len(both) else \
+        np.zeros((0, 2), np.int64)
+    indptr = np.searchsorted(s[:, 0], np.arange(num_nodes + 1)).astype(np.int64)
+    return indptr, s[:, 1].astype(np.int32)
+
+
+def _sample(indptr, indices, seeds, fanout, seed):
+    try:
+        from hyperspace_tpu.data import native
+
+        return native.sample_neighbors(indptr, indices, seeds, fanout, seed)
+    except (ImportError, OSError):
+        from hyperspace_tpu.data.native import sample_neighbors_numpy
+
+        return sample_neighbors_numpy(indptr, indices, seeds, fanout, seed)
+
+
+class SampledBatches(NamedTuple):
+    """S planned minibatches, device-resident (one pyramid per step)."""
+
+    ids: tuple      # level l: [S, B, f1, .., fl] int32
+    labels: jax.Array  # [S, B] int32 seed labels
+
+
+def plan_batches(cfg: SampledConfig, edges: np.ndarray, labels: np.ndarray,
+                 train_mask: np.ndarray, num_nodes: int, steps: int,
+                 seed: int = 0) -> tuple[SampledBatches, jax.Array]:
+    """Draw ``steps`` seed batches + their fanout pyramids on the host.
+
+    Returns the device-resident batches and the ``[N]`` true-degree
+    array the steps gather their estimator weights from."""
+    indptr, indices = build_adjacency(edges, num_nodes)
+    rng = np.random.default_rng(seed)
+    train_nodes = np.flatnonzero(np.asarray(train_mask))
+    b = cfg.batch_size
+    seeds = rng.choice(train_nodes, size=(steps, b)).astype(np.int32)
+    levels = [seeds]
+    for li, f in enumerate(cfg.fanouts):
+        prev = levels[-1]
+        nxt = np.stack([
+            _sample(indptr, indices, prev[s].ravel(), f,
+                    seed=(seed * 1_000_003 + s * 97 + li))
+            for s in range(steps)
+        ]).reshape(prev.shape + (f,))
+        levels.append(nxt)
+    deg = (indptr[1:] - indptr[:-1]).astype(np.float32)
+    lab = np.asarray(labels, np.int32)[seeds]
+    return (SampledBatches(tuple(jnp.asarray(l) for l in levels),
+                           jnp.asarray(lab)),
+            jnp.asarray(deg))
+
+
+# --- training ----------------------------------------------------------------
+
+
+def init_sampled_nc(cfg: SampledConfig, feat_dim: int, seed: int = 0):
+    """Model + optimizer + TrainState (same tree as ``hgcn.init_nc``)."""
+    model = SampledHGCNNodeClf(cfg.base)
+    key = jax.random.PRNGKey(seed)
+    k_init, key = jax.random.split(key)
+    b = cfg.batch_size
+    dummy_levels, shape = [], (b,)
+    for f in (None,) + tuple(cfg.fanouts):
+        if f is not None:
+            shape = shape + (f,)
+        dummy_levels.append(jnp.zeros(shape + (feat_dim,), jnp.float32))
+    dummy_nn = [jnp.ones(l.shape[:-1], jnp.float32)
+                for l in dummy_levels[:-1]]
+    params = model.init(k_init, dummy_levels, dummy_nn)["params"]
+    opt = hgcn.make_optimizer(cfg.base)
+    return model, opt, hgcn.TrainState(params, opt.init(params), key,
+                                       jnp.zeros((), jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
+def train_step_sampled_nc(
+    model: SampledHGCNNodeClf,
+    opt,
+    state: hgcn.TrainState,
+    x_table: jax.Array,   # [N, F0] raw features, device-resident
+    deg: jax.Array,       # [N] true degrees
+    batches: SampledBatches,
+):
+    """One minibatch step; consumes pyramid ``state.step % S``.
+
+    Supervises exactly ``batch_size`` seed nodes — the honest
+    "samples/step" unit of the sampled trainer."""
+    s = batches.ids[0].shape[0]
+    i = state.step % s
+    take = lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+    ids = [take(a) for a in batches.ids]
+    labels = take(batches.labels)
+    levels = [x_table[a] for a in ids]
+    n_nbrs = [deg[a] for a in ids[:-1]]
+    key, k_drop = jax.random.split(state.key)
+
+    def loss_fn(params):
+        logits = model.apply({"params": params}, levels, n_nbrs,
+                             deterministic=False, rngs={"dropout": k_drop})
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    updates, opt_state = opt.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return hgcn.TrainState(params, opt_state, key, state.step + 1), loss

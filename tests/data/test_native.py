@@ -87,3 +87,23 @@ def test_locality_order_matches_python_oracle():
         want = _locality_order_python(edges, n)
         np.testing.assert_array_equal(got, want)
         assert sorted(got.tolist()) == list(range(n))  # a permutation
+
+
+def test_sample_neighbors_matches_numpy_oracle():
+    """C++ sampler vs the vectorized numpy twin: bit-exact draws (same
+    per-cell splitmix64 stream), neighbors only, isolated -> self."""
+    from hyperspace_tpu.models.hgcn_sampled import build_adjacency
+
+    rng = np.random.default_rng(3)
+    edges = rng.integers(0, 40, (120, 2)).astype(np.int32)
+    indptr, indices = build_adjacency(edges, 41)  # node 40 isolated
+    seeds = np.concatenate([rng.integers(0, 40, 30), [40]]).astype(np.int32)
+    for seed in (0, 7):
+        a = native.sample_neighbors(indptr, indices, seeds, 5, seed=seed)
+        b = native.sample_neighbors_numpy(indptr, indices, seeds, 5,
+                                          seed=seed)
+        np.testing.assert_array_equal(a, b)
+    assert np.all(a[-1] == 40)  # isolated node samples itself
+    for i, u in enumerate(seeds[:-1]):
+        nbrs = set(indices[indptr[u]:indptr[u + 1]].tolist())
+        assert set(a[i].tolist()) <= nbrs
