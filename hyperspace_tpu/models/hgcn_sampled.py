@@ -270,24 +270,11 @@ def init_sampled_nc(cfg: SampledConfig, feat_dim: int, seed: int = 0):
                                        jnp.zeros((), jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
-def train_step_sampled_nc(
-    model: SampledHGCNNodeClf,
-    opt,
-    state: hgcn.TrainState,
-    x_table: jax.Array,   # [N, F0] raw features, device-resident
-    deg: jax.Array,       # [N] true degrees
-    batches: SampledBatches,
-):
-    """One minibatch step; consumes pyramid ``state.step % S``.
-
-    Supervises exactly ``batch_size`` seed nodes — the honest
-    "samples/step" unit of the sampled trainer."""
-    s = batches.ids[0].shape[0]
-    i = state.step % s
-    take = lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
-    ids = [take(a) for a in batches.ids]
-    labels = take(batches.labels)
+def _row_step(model, opt, state, x_table, deg, ids, labels, constrain=None):
+    """One minibatch step on a single pyramid row (un-jitted body)."""
+    if constrain is not None:  # GSPMD hint: shard the batch axis
+        ids = [constrain(a) for a in ids]
+        labels = constrain(labels)
     levels = [x_table[a] for a in ids]
     n_nbrs = [deg[a] for a in ids[:-1]]
     key, k_drop = jax.random.split(state.key)
@@ -302,3 +289,89 @@ def train_step_sampled_nc(
     updates, opt_state = opt.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
     return hgcn.TrainState(params, opt_state, key, state.step + 1), loss
+
+
+def _take_row(state, batches: SampledBatches):
+    s = batches.ids[0].shape[0]
+    i = state.step % s
+    take = lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+    return [take(a) for a in batches.ids], take(batches.labels)
+
+
+def _sampled_impl(model, opt, state, x_table, deg, batches, constrain=None):
+    ids, labels = _take_row(state, batches)
+    return _row_step(model, opt, state, x_table, deg, ids, labels, constrain)
+
+
+@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
+def train_step_sampled_nc(
+    model: SampledHGCNNodeClf,
+    opt,
+    state: hgcn.TrainState,
+    x_table: jax.Array,   # [N, F0] raw features, device-resident
+    deg: jax.Array,       # [N] true degrees
+    batches: SampledBatches,
+):
+    """One minibatch step; consumes pyramid ``state.step % S``.
+
+    Supervises exactly ``batch_size`` seed nodes — the honest
+    "samples/step" unit of the sampled trainer."""
+    return _sampled_impl(model, opt, state, x_table, deg, batches)
+
+
+@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
+def train_epoch_sampled_nc(
+    model: SampledHGCNNodeClf,
+    opt,
+    state: hgcn.TrainState,
+    x_table: jax.Array,
+    deg: jax.Array,
+    batches: SampledBatches,
+):
+    """All S planned minibatches as ONE XLA program (`lax.scan` over the
+    pyramid rows, front to back — identical trajectory to S calls of
+    :func:`train_step_sampled_nc` from ``state.step % S == 0``).  The
+    per-step device work is a handful of small dense ops, so the scan's
+    dispatch amortization is worth ~the same factor it buys the Poincaré
+    workload (docs/benchmarks.md r03b)."""
+
+    def body(st, row):
+        ids, labels = row
+        return _row_step(model, opt, st, x_table, deg, list(ids), labels)
+
+    return jax.lax.scan(body, state, (tuple(batches.ids), batches.labels))
+
+
+def make_sharded_step(model, opt, mesh, state: hgcn.TrainState,
+                      x_table, deg, batches: SampledBatches):
+    """Data-parallel sampled step over ``mesh``: the pyramid's batch axis
+    shards across the data-like axes (XLA inserts the gradient
+    all-reduce — SURVEY.md §2 N8); features/degrees/plan are placed
+    replicated once.  Returns ``(step, placed_state, placed_data)``;
+    call as ``state, loss = step(state, *placed_data)``.  ``batch_size``
+    must divide by the mesh's data extent."""
+    from hyperspace_tpu.parallel.mesh import (
+        data_extent,
+        replicated,
+        shard_batch,
+    )
+    from hyperspace_tpu.parallel.tp import state_shardings
+
+    d = data_extent(mesh)
+    if batches.ids[0].shape[1] % d:
+        raise ValueError(
+            f"batch_size={batches.ids[0].shape[1]} not divisible by the "
+            f"mesh's data extent {d}")
+    state_sh = state_shardings(state, state.params, mesh)
+    repl = replicated(mesh)
+    step = jax.jit(
+        partial(_sampled_impl, model, opt,
+                constrain=partial(shard_batch, mesh=mesh)),
+        in_shardings=(state_sh, repl, repl, repl),
+        out_shardings=(state_sh, repl),
+        donate_argnums=(0,),
+    )
+    data = (jax.device_put(x_table, repl), jax.device_put(deg, repl),
+            jax.tree_util.tree_map(lambda a: jax.device_put(a, repl),
+                                   batches))
+    return step, jax.device_put(state, state_sh), data

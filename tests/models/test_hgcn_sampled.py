@@ -138,3 +138,56 @@ def test_learned_curvature_trains_through_sampled_step():
             model, opt, state, jnp.asarray(x), deg, batches)
     assert np.isfinite(float(loss))
     assert float(state.params["encoder"]["conv0"]["c_raw"]) != c0
+
+
+def test_epoch_scan_matches_stepwise_sampled():
+    """Scanned plan consumption == step%S consumption from step 0."""
+    cfg = _cfg()
+    edges, x, labels, _ = G.synthetic_hierarchy(
+        num_nodes=64, feat_dim=8, num_classes=3, seed=2)
+    tr = np.ones(64, bool)
+    batches, deg = HS.plan_batches(cfg, edges, labels, tr, 64, steps=3,
+                                   seed=0)
+    xt = jnp.asarray(x)
+    model, opt, s1 = HS.init_sampled_nc(cfg, feat_dim=8, seed=0)
+    _, _, s2 = HS.init_sampled_nc(cfg, feat_dim=8, seed=0)
+    for _ in range(3):
+        s1, _ = HS.train_step_sampled_nc(model, opt, s1, xt, deg, batches)
+    s2, losses = HS.train_epoch_sampled_nc(model, opt, s2, xt, deg, batches)
+    # two separately compiled XLA programs: tolerance, not bitwise
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=2e-5, atol=2e-5),
+        s1.params, s2.params)
+    assert losses.shape == (3,)
+
+
+def test_sharded_sampled_step_matches_single_device():
+    """DP over the batch axis: same trajectory as the single-device step
+    to float tolerance (the gradient all-reduce is the only difference)."""
+    from hyperspace_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = make_mesh({"data": 8})
+    cfg = _cfg(batch_size=16, base_kw=dict(dropout=0.0))
+    edges, x, labels, _ = G.synthetic_hierarchy(
+        num_nodes=64, feat_dim=8, num_classes=3, seed=3)
+    tr = np.ones(64, bool)
+    batches, deg = HS.plan_batches(cfg, edges, labels, tr, 64, steps=4,
+                                   seed=0)
+    xt = jnp.asarray(x)
+    model, opt, s1 = HS.init_sampled_nc(cfg, feat_dim=8, seed=0)
+    _, _, s2 = HS.init_sampled_nc(cfg, feat_dim=8, seed=0)
+    for _ in range(4):
+        s1, loss1 = HS.train_step_sampled_nc(model, opt, s1, xt, deg,
+                                             batches)
+    step, s2, data = HS.make_sharded_step(model, opt, mesh, s2, xt, deg,
+                                          batches)
+    for _ in range(4):
+        s2, loss2 = step(s2, *data)
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=2e-5, atol=2e-5),
+        s1.params, jax.device_get(s2.params))
