@@ -186,6 +186,14 @@ def bench_hgcn(repeats: int = 3, dtype: str = "float32",
                           step=step, decoder_dtype=decoder_dtype)
 
 
+def bench_sampled(repeats: int = 2) -> dict:
+    """Minibatch-trainer detail metric: supervised samples/s (the
+    labeled-seeds-per-second unit; docs/benchmarks.md r03b)."""
+    from hyperspace_tpu.benchmarks.hgcn_bench import run_sampled_bench
+
+    return run_sampled_bench(repeats=repeats)
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--metric", choices=["auto", "hgcn", "poincare"], default="auto")
@@ -234,6 +242,11 @@ def main() -> None:
             result["detail"]["poincare"] = p["detail"]
         except Exception as e:
             result["detail"]["poincare_error"] = repr(e)
+        try:  # minibatch trainer: supervised samples/s (honest unit)
+            result["detail"]["hgcn_sampled"] = bench_sampled(
+                repeats=max(1, args.repeats - 1))
+        except Exception as e:
+            result["detail"]["hgcn_sampled_error"] = repr(e)
     print(json.dumps(result))
     if failed:
         sys.exit(1)

@@ -22,22 +22,29 @@ ARXIV_FEATS = 128
 ARXIV_CLASSES = 40
 
 
-def arxiv_scale_split(num_nodes: int = ARXIV_NODES, seed: int = 0):
-    """Synthetic hierarchy at ogbn-arxiv edge density + its LP split.
+def arxiv_scale_graph(num_nodes: int = ARXIV_NODES, seed: int = 0):
+    """Synthetic hierarchy at ogbn-arxiv edge density.
 
     Edge count scales with ``num_nodes`` at arxiv's density so reduced-size
-    runs stay proportionate.  Shared by bench.py, the step-variant and
-    precision-comparison scripts — one construction, comparable numbers.
-    Returns (split, x).
+    runs stay proportionate.  The one construction every bench shares
+    (full-graph LP, NC, sampled) — comparable numbers by construction.
+    Returns (edges, x, labels, num_classes).
     """
     from hyperspace_tpu.data import graphs as G
 
     n_edges = ARXIV_EDGES * num_nodes / ARXIV_NODES
     extra = (n_edges - (num_nodes - 1) * 3) / num_nodes
-    edges, x, labels, ncls = G.synthetic_hierarchy(
+    return G.synthetic_hierarchy(
         num_nodes=num_nodes, branching=3, feat_dim=ARXIV_FEATS,
         ancestor_hops=3, extra_edge_frac=max(extra, 0.0),
         num_classes=ARXIV_CLASSES, seed=seed)
+
+
+def arxiv_scale_split(num_nodes: int = ARXIV_NODES, seed: int = 0):
+    """:func:`arxiv_scale_graph` + its LP split; returns (split, x)."""
+    from hyperspace_tpu.data import graphs as G
+
+    edges, x, labels, ncls = arxiv_scale_graph(num_nodes, seed)
     split = G.split_edges(edges, num_nodes, x, val_frac=0.02, test_frac=0.02,
                           seed=seed, pad_multiple=65536)
     return split, x
@@ -143,4 +150,52 @@ def run_hgcn_bench(
             # deterministic=False), so the record is the flag as executed
             "decoder_dtype": decoder_dtype,
         },
+    }
+
+
+def run_sampled_bench(repeats: int = 3, steps: int = 64,
+                      num_nodes: int = ARXIV_NODES) -> dict:
+    """Neighbor-sampled minibatch trainer throughput (models/hgcn_sampled).
+
+    Reports *supervised* samples/s — labeled seed nodes receiving a loss
+    term per step (the minibatch-GNN paper unit; contrast with the
+    full-graph metric's nodes-per-step convention, both defined in
+    docs/benchmarks.md).  Rides in bench.py's auto detail.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hyperspace_tpu.data import graphs as G
+    from hyperspace_tpu.models import hgcn, hgcn_sampled as HS
+
+    edges, x, labels, ncls = arxiv_scale_graph(num_nodes, seed=0)
+    tr, _, _ = G.node_split_masks(num_nodes, seed=0)
+    cfg = HS.SampledConfig(
+        base=hgcn.HGCNConfig(feat_dim=ARXIV_FEATS, hidden_dims=(128, 32),
+                             num_classes=ncls),
+        fanouts=(10, 10), batch_size=512)
+    batches, deg = HS.plan_batches(cfg, edges, labels, tr, num_nodes,
+                                   steps=steps, seed=0)
+    model, opt, state = HS.init_sampled_nc(cfg, feat_dim=ARXIV_FEATS, seed=0)
+    xt = jnp.asarray(np.asarray(x, np.float32))
+
+    state, loss = HS.train_step_sampled_nc(model, opt, state, xt, deg,
+                                           batches)
+    jax.device_get(loss)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = HS.train_step_sampled_nc(model, opt, state, xt,
+                                                   deg, batches)
+        jax.device_get(loss)
+        times.append(time.perf_counter() - t0)
+    step_s = min(times) / steps
+    return {
+        "step_ms": round(step_s * 1e3, 3),
+        "supervised_samples_per_s": round(cfg.batch_size / step_s, 1),
+        "batch_size": cfg.batch_size,
+        "fanouts": list(cfg.fanouts),
+        "num_nodes": num_nodes,
     }

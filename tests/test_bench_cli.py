@@ -25,12 +25,17 @@ def _stub_poincare(repeats=1):
             "vs_baseline": None, "detail": {"num_nodes": 10}}
 
 
+def _stub_sampled(repeats=1):
+    return {"step_ms": 2.5, "supervised_samples_per_s": 2e5}
+
+
 def test_auto_hgcn_failure_reports_error(bench_mod, monkeypatch, capsys):
     def boom(repeats=1, **kw):
         raise RuntimeError("synthetic hgcn failure")
 
     monkeypatch.setattr(bench_mod, "bench_hgcn", boom)
     monkeypatch.setattr(bench_mod, "bench_poincare", _stub_poincare)
+    monkeypatch.setattr(bench_mod, "bench_sampled", _stub_sampled)
     monkeypatch.setattr(sys, "argv", ["bench.py", "--metric", "auto"])
     with pytest.raises(SystemExit) as ei:
         bench_mod.main()
@@ -51,11 +56,13 @@ def test_auto_success_keeps_hgcn_headline(bench_mod, monkeypatch, capsys):
 
     monkeypatch.setattr(bench_mod, "bench_hgcn", ok)
     monkeypatch.setattr(bench_mod, "bench_poincare", _stub_poincare)
+    monkeypatch.setattr(bench_mod, "bench_sampled", _stub_sampled)
     monkeypatch.setattr(sys, "argv", ["bench.py", "--metric", "auto"])
     bench_mod.main()
     out = json.loads(capsys.readouterr().out.strip())
     assert out["metric"] == "hgcn_samples_per_sec_per_chip"
     assert out["detail"]["poincare_embed_epoch_time_s"] == 0.5
+    assert out["detail"]["hgcn_sampled"]["supervised_samples_per_s"] == 2e5
 
 
 def test_explicit_poincare_failure_is_error(bench_mod, monkeypatch, capsys):
