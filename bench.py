@@ -13,27 +13,15 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
 
 
 def _time_steps(stepper, state, n_steps, repeats):
-    """min-of-repeats wall time for ``n_steps`` calls of ``stepper``."""
-    import jax
+    """min-of-repeats wall time for ``n_steps`` calls of ``stepper``
+    (the shared harness in benchmarks/hgcn_bench.py — one copy of the
+    device_get-as-completion-barrier rationale)."""
+    from hyperspace_tpu.benchmarks.hgcn_bench import time_steps
 
-    # compile + warmup
-    state, loss = stepper(state)
-    jax.device_get(loss)
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        for _ in range(n_steps):
-            state, loss = stepper(state)
-        # device_get, not block_until_ready: remote-attached TPUs (axon
-        # tunnel) ack block_until_ready before execution finishes; a host
-        # fetch of the loss is the only reliable completion barrier
-        jax.device_get(loss)
-        times.append(time.perf_counter() - t0)
-    return min(times)
+    return time_steps(stepper, state, n_steps, repeats)[0]
 
 
 def _poincare_steppers(cfg, pairs, plan_steps):

@@ -16,6 +16,31 @@ from __future__ import annotations
 
 import time
 
+def time_steps(stepper, state, n_steps: int, repeats: int):
+    """min-of-repeats wall time for ``n_steps`` chained ``stepper`` calls.
+
+    The ONE timing harness every benchmark here and in bench.py shares;
+    returns ``(best_seconds, final_state, final_loss)``.  Completion
+    barrier is a host fetch of the loss (``jax.device_get``), not
+    ``block_until_ready``: remote-attached TPUs (axon tunnel) ack
+    block_until_ready before execution finishes, and only a host fetch
+    reliably waits — keep that rationale with this function, it is
+    load-bearing for every number in docs/benchmarks.md.
+    """
+    import jax
+
+    state, loss = stepper(state)  # compile + warmup
+    jax.device_get(loss)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, loss = stepper(state)
+        jax.device_get(loss)
+        times.append(time.perf_counter() - t0)
+    return min(times), state, loss
+
+
 ARXIV_NODES = 169_343
 ARXIV_EDGES = 1_166_243
 ARXIV_FEATS = 128
@@ -110,21 +135,7 @@ def run_hgcn_bench(
         step_fn = lambda st: hgcn.train_step_lp(
             model, opt, num_nodes, st, ga, train_pos)
 
-    # compile + warmup
-    state, loss = step_fn(state)
-    jax.device_get(loss)
-
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        for _ in range(steps_per_repeat):
-            state, loss = step_fn(state)
-        # device_get, not block_until_ready: remote-attached TPUs (axon
-        # tunnel) ack block_until_ready before execution finishes; a host
-        # fetch of the loss is the only reliable completion barrier
-        jax.device_get(loss)
-        times.append(time.perf_counter() - t0)
-    best = min(times)
+    best, state, loss = time_steps(step_fn, state, steps_per_repeat, repeats)
     samples_per_sec = num_nodes * steps_per_repeat / best
     n_dev = jax.device_count()
     return {
@@ -180,18 +191,11 @@ def run_sampled_bench(repeats: int = 3, steps: int = 64,
     model, opt, state = HS.init_sampled_nc(cfg, feat_dim=ARXIV_FEATS, seed=0)
     xt = jnp.asarray(np.asarray(x, np.float32))
 
-    state, loss = HS.train_step_sampled_nc(model, opt, state, xt, deg,
-                                           batches)
-    jax.device_get(loss)
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, loss = HS.train_step_sampled_nc(model, opt, state, xt,
-                                                   deg, batches)
-        jax.device_get(loss)
-        times.append(time.perf_counter() - t0)
-    step_s = min(times) / steps
+    best, _, _ = time_steps(
+        lambda st: HS.train_step_sampled_nc(model, opt, st, xt, deg,
+                                            batches),
+        state, steps, repeats)
+    step_s = best / steps
     return {
         "step_ms": round(step_s * 1e3, 3),
         "supervised_samples_per_s": round(cfg.batch_size / step_s, 1),
