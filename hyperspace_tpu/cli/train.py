@@ -146,12 +146,9 @@ def run_hgcn(run: RunConfig, overrides: dict):
     task = overrides.pop("task", "lp")
     dataset = overrides.pop("dataset", "cora")
     reorder = overrides.pop("reorder", "false").lower() in ("1", "true", "yes")
-    # neighbor-sampled minibatch mode (task=nc only): fixed-fanout
+    # neighbor-sampled minibatch mode (task=nc or lp): fixed-fanout
     # pyramids from the native sampler; supervises `batch` seeds/step
     sampled = overrides.pop("sampled", "false").lower() in ("1", "true", "yes")
-    if sampled and task != "nc":
-        raise SystemExit("sampled=true requires task=nc (the minibatch "
-                         "trainer supervises labeled seed nodes)")
     fanouts = tuple(json.loads(overrides.pop("fanouts", "[10, 10]")))
     batch = int(overrides.pop("batch", "512"))
     # batches are pre-planned host-side and recycled modulo this count —
@@ -170,6 +167,31 @@ def run_hgcn(run: RunConfig, overrides: dict):
     mesh = auto_mesh(run.multihost, tp=run.tp)
     if task == "lp":
         split = G.split_edges(edges, num_nodes, x, seed=run.seed)
+        if sampled:
+            # minibatch LP (models/hgcn_sampled.py): pyramids over the
+            # four endpoint chunks; full-graph eval on the shared tree
+            if run.multihost:
+                raise SystemExit(
+                    "sampled=true is single-process — drop multihost=true")
+            from hyperspace_tpu.models import hgcn_sampled as HS
+
+            scfg = HS.SampledConfig(base=cfg, fanouts=fanouts,
+                                    batch_size=batch)
+            model_s, opt, state = HS.init_sampled_lp(
+                scfg, feat_dim=x.shape[1], seed=run.seed)
+            batches, deg = HS.plan_lp_batches(
+                scfg, split.train_pos, num_nodes,
+                steps=min(run.steps, plan_steps), seed=run.seed)
+            xt = jnp.asarray(np.asarray(x, np.float32))
+            state, loss = _train_loop(
+                run, state,
+                lambda st: HS.train_step_sampled_lp(model_s, opt, st, xt,
+                                                    deg, batches))
+            full = hgcn.HGCNLinkPred(cfg)
+            res = {"loss": float(loss),
+                   **hgcn.evaluate_lp(full, state.params, split, "test")}
+            return {"workload": "hgcn", "task": "lp", "dataset": dataset,
+                    "source": source, "sampled": True, **res}
         model, opt, state = hgcn.init_lp(cfg, split.graph, seed=run.seed)
         ga = hgcn._device_graph(split.graph)
         if mesh is not None:

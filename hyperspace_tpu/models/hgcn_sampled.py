@@ -180,6 +180,33 @@ class SampledHGCNNodeClf(nn.Module):
         return head(self.cfg.num_classes, m, name="head")(z)
 
 
+class SampledHGCNLinkPred(nn.Module):
+    """Sampled encoder + the same Fermi–Dirac decoder as ``HGCNLinkPred``.
+
+    The seed vector is four aligned [P] chunks — (u_pos, v_pos, u_neg,
+    v_neg) — so the pyramid encodes all endpoints in one pass; logits
+    come from pairwise squared distances within chunks.  Param tree
+    matches ``HGCNLinkPred`` (``encoder/...`` + ``decoder/{r, t_raw}``),
+    so `hgcn.evaluate_lp` scores sampled-trained params directly."""
+
+    cfg: hgcn.HGCNConfig
+
+    @nn.compact
+    def __call__(self, levels, n_nbrs, *, deterministic=True):
+        from hyperspace_tpu.nn.decoders import FermiDiracDecoder
+
+        z, m = SampledEncoder(self.cfg, name="encoder")(
+            levels, n_nbrs, deterministic=deterministic)
+        if self.cfg.decoder_dtype is not None and not deterministic:
+            z = z.astype(self.cfg.decoder_dtype)
+        p = z.shape[0] // 4
+        sq_pos = m.sqdist(z[:p], z[p : 2 * p])
+        sq_neg = m.sqdist(z[2 * p : 3 * p], z[3 * p :])
+        dec = FermiDiracDecoder(name="decoder")
+        return (dec(sq_pos.astype(self.cfg.dtype)),
+                dec(sq_neg.astype(self.cfg.dtype)))
+
+
 # --- host-side batch planning -------------------------------------------------
 
 
@@ -217,7 +244,27 @@ class SampledBatches(NamedTuple):
     """S planned minibatches, device-resident (one pyramid per step)."""
 
     ids: tuple      # level l: [S, B, f1, .., fl] int32
-    labels: jax.Array  # [S, B] int32 seed labels
+    # [S, B] int32 seed labels (NC); None for LP batches, where
+    # positives/negatives are positional in the seed chunks
+    labels: Any
+
+
+def _build_pyramid(cfg: SampledConfig, indptr, indices, seeds, seed: int):
+    """Fanout levels over per-step seed rows ([S, B] → [S, B, f1], ...).
+
+    The ONE sampler-driving loop both planners share (same per-(step,
+    level) seed derivation — NC and LP pyramids must never diverge)."""
+    levels = [seeds]
+    steps = seeds.shape[0]
+    for li, f in enumerate(cfg.fanouts):
+        prev = levels[-1]
+        nxt = np.stack([
+            _sample(indptr, indices, prev[s].ravel(), f,
+                    seed=(seed * 1_000_003 + s * 97 + li))
+            for s in range(steps)
+        ]).reshape(prev.shape + (f,))
+        levels.append(nxt)
+    return levels
 
 
 def plan_batches(cfg: SampledConfig, edges: np.ndarray, labels: np.ndarray,
@@ -232,19 +279,40 @@ def plan_batches(cfg: SampledConfig, edges: np.ndarray, labels: np.ndarray,
     train_nodes = np.flatnonzero(np.asarray(train_mask))
     b = cfg.batch_size
     seeds = rng.choice(train_nodes, size=(steps, b)).astype(np.int32)
-    levels = [seeds]
-    for li, f in enumerate(cfg.fanouts):
-        prev = levels[-1]
-        nxt = np.stack([
-            _sample(indptr, indices, prev[s].ravel(), f,
-                    seed=(seed * 1_000_003 + s * 97 + li))
-            for s in range(steps)
-        ]).reshape(prev.shape + (f,))
-        levels.append(nxt)
+    levels = _build_pyramid(cfg, indptr, indices, seeds, seed)
     deg = (indptr[1:] - indptr[:-1]).astype(np.float32)
     lab = np.asarray(labels, np.int32)[seeds]
     return (SampledBatches(tuple(jnp.asarray(l) for l in levels),
                            jnp.asarray(lab)),
+            jnp.asarray(deg))
+
+
+def plan_lp_batches(cfg: SampledConfig, train_pos: np.ndarray,
+                    num_nodes: int, steps: int,
+                    seed: int = 0) -> tuple[SampledBatches, jax.Array]:
+    """LP pyramids: per step, ``batch_size`` positive pairs drawn from
+    ``train_pos`` and as many uniform-random negative pairs; the seed
+    vector is the four aligned endpoint chunks (u⁺, v⁺, u⁻, v⁻).
+    ``labels`` is None — positives/negatives are positional.
+
+    Message passing samples over the TRAIN edges only (``train_pos`` is
+    both the supervision set and the adjacency), matching the full-graph
+    LP protocol where ``split_edges`` builds the encoder graph from
+    train edges — held-out val/test edges must never leak into the
+    neighborhood aggregation."""
+    indptr, indices = build_adjacency(np.asarray(train_pos), num_nodes)
+    rng = np.random.default_rng(seed)
+    train_pos = np.asarray(train_pos)
+    p = cfg.batch_size
+    rows = rng.integers(0, len(train_pos), (steps, p))
+    pos = train_pos[rows]                                    # [S, P, 2]
+    neg = rng.integers(0, num_nodes, (steps, p, 2))
+    seeds = np.concatenate(
+        [pos[..., 0], pos[..., 1], neg[..., 0], neg[..., 1]],
+        axis=1).astype(np.int32)                             # [S, 4P]
+    levels = _build_pyramid(cfg, indptr, indices, seeds, seed)
+    deg = (indptr[1:] - indptr[:-1]).astype(np.float32)
+    return (SampledBatches(tuple(jnp.asarray(l) for l in levels), None),
             jnp.asarray(deg))
 
 
@@ -270,6 +338,82 @@ def init_sampled_nc(cfg: SampledConfig, feat_dim: int, seed: int = 0):
                                        jnp.zeros((), jnp.int32))
 
 
+def init_sampled_lp(cfg: SampledConfig, feat_dim: int, seed: int = 0):
+    """LP model + optimizer + TrainState (same tree as ``hgcn.init_lp``)."""
+    model = SampledHGCNLinkPred(cfg.base)
+    key = jax.random.PRNGKey(seed)
+    k_init, key = jax.random.split(key)
+    dummy_levels, shape = [], (4 * cfg.batch_size,)
+    for f in (None,) + tuple(cfg.fanouts):
+        if f is not None:
+            shape = shape + (f,)
+        dummy_levels.append(jnp.zeros(shape + (feat_dim,), jnp.float32))
+    dummy_nn = [jnp.ones(l.shape[:-1], jnp.float32)
+                for l in dummy_levels[:-1]]
+    params = model.init(k_init, dummy_levels, dummy_nn)["params"]
+    opt = hgcn.make_optimizer(cfg.base)
+    return model, opt, hgcn.TrainState(params, opt.init(params), key,
+                                       jnp.zeros((), jnp.int32))
+
+
+def _lp_row_step(model, opt, state, x_table, deg, ids, constrain=None):
+    """One LP minibatch step on a single pyramid row (un-jitted body)."""
+    if constrain is not None:
+        ids = [constrain(a) for a in ids]
+    levels = [x_table[a] for a in ids]
+    n_nbrs = [deg[a] for a in ids[:-1]]
+    key, k_drop = jax.random.split(state.key)
+
+    def loss_fn(params):
+        pos_logit, neg_logit = model.apply(
+            {"params": params}, levels, n_nbrs,
+            deterministic=False, rngs={"dropout": k_drop})
+        bce_pos = optax.sigmoid_binary_cross_entropy(
+            pos_logit, jnp.ones_like(pos_logit))
+        bce_neg = optax.sigmoid_binary_cross_entropy(
+            neg_logit, jnp.zeros_like(neg_logit))
+        return ((jnp.sum(bce_pos) + jnp.sum(bce_neg))
+                / (pos_logit.shape[0] + neg_logit.shape[0]))
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    updates, opt_state = opt.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return hgcn.TrainState(params, opt_state, key, state.step + 1), loss
+
+
+@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
+def train_step_sampled_lp(
+    model: SampledHGCNLinkPred,
+    opt,
+    state: hgcn.TrainState,
+    x_table: jax.Array,
+    deg: jax.Array,
+    batches: SampledBatches,
+):
+    """One sampled LP step; consumes pyramid ``state.step % S``.
+
+    Supervises ``batch_size`` positive pairs (+ as many negatives)."""
+    ids, _ = _take_row(state, batches)
+    return _lp_row_step(model, opt, state, x_table, deg, ids)
+
+
+@partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
+def train_epoch_sampled_lp(
+    model: SampledHGCNLinkPred,
+    opt,
+    state: hgcn.TrainState,
+    x_table: jax.Array,
+    deg: jax.Array,
+    batches: SampledBatches,
+):
+    """All S planned LP minibatches as one `lax.scan` program."""
+
+    def body(st, ids):
+        return _lp_row_step(model, opt, st, x_table, deg, list(ids))
+
+    return jax.lax.scan(body, state, tuple(batches.ids))
+
+
 def _row_step(model, opt, state, x_table, deg, ids, labels, constrain=None):
     """One minibatch step on a single pyramid row (un-jitted body)."""
     if constrain is not None:  # GSPMD hint: shard the batch axis
@@ -292,10 +436,13 @@ def _row_step(model, opt, state, x_table, deg, ids, labels, constrain=None):
 
 
 def _take_row(state, batches: SampledBatches):
+    """Row ``state.step % S`` of the plan (the one modulo-indexed
+    selection both the NC and LP steps use)."""
     s = batches.ids[0].shape[0]
     i = state.step % s
     take = lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
-    return [take(a) for a in batches.ids], take(batches.labels)
+    labels = None if batches.labels is None else take(batches.labels)
+    return [take(a) for a in batches.ids], labels
 
 
 def _sampled_impl(model, opt, state, x_table, deg, batches, constrain=None):

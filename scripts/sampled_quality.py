@@ -52,7 +52,8 @@ def main() -> None:
                   train_mask=tr, val_mask=va, test_mask=te)
     ga = G.to_device(g)
     full_eval_model = hgcn.HGCNNodeClf(base)
-    out = open(args.out, "a")
+    out = open(args.out, "w")  # one run = one file; re-runs replace, not
+    # append — the committed docs/data artifact must match one run
 
     def emit(rec):
         rec["ts"] = time.time()
