@@ -232,3 +232,26 @@ def test_sampled_lp_tree_and_training():
                                                 rtol=2e-5, atol=2e-5),
         s1.params, s2.params)
     assert losses.shape == (3,)
+
+
+def test_three_layer_pyramid_trains():
+    """The pyramid generalizes past the 2-layer default: 3 convs, 3
+    fanout levels ([B], [B,3], [B,3,3], [B,3,3,2]) — tree still matches
+    the full-graph model and the step trains."""
+    cfg = _cfg(base_kw=dict(hidden_dims=(12, 8, 6)), fanouts=(3, 3, 2))
+    edges, x, labels, ncls = G.synthetic_hierarchy(
+        num_nodes=64, feat_dim=8, num_classes=3, seed=5)
+    tr, va, te = G.node_split_masks(64, seed=0)
+    g = G.prepare(edges, 64, x, labels=labels, num_classes=ncls,
+                  train_mask=tr, val_mask=va, test_mask=te)
+    model, opt, state = HS.init_sampled_nc(cfg, feat_dim=8, seed=0)
+    _, _, st_f = hgcn.init_nc(cfg.base, g, seed=0)
+    shp = lambda t: jax.tree_util.tree_map(lambda a: a.shape, t)
+    assert shp(state.params) == shp(st_f.params)
+    batches, deg = HS.plan_batches(cfg, edges, labels, tr, 64, steps=2,
+                                   seed=0)
+    assert batches.ids[3].shape == (2, 16, 3, 3, 2)
+    for _ in range(4):
+        state, loss = HS.train_step_sampled_nc(
+            model, opt, state, jnp.asarray(x), deg, batches)
+    assert np.isfinite(float(loss))
