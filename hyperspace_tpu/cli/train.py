@@ -48,6 +48,9 @@ class RunConfig:
     # (poincare only; see models/poincare_embed.train_epoch_scan —
     # removes per-step launch latency on small-step workloads)
     scan_chunk: int = 1
+    # >1: accumulate this many microbatch gradients per optimizer update
+    # (hybonet/hvae; optax.MultiSteps — `steps` counts microsteps)
+    accum: int = 1
     coordinator: str = "127.0.0.1:9357"
     num_processes: int = 1
     process_id: int = 0
@@ -97,7 +100,29 @@ def split_overrides(pairs: list[str], run: RunConfig):
 # --- workload runners ---------------------------------------------------------
 
 
+def _maybe_accum(run: RunConfig, opt, state):
+    """Wrap ``opt`` for gradient accumulation when ``run.accum > 1``.
+
+    Rebuilds the optimizer state (a wrapped transform has a different
+    state pytree — the old one must never be reused)."""
+    if run.accum <= 1:
+        return opt, state
+    from hyperspace_tpu.optim.accum import with_grad_accumulation
+
+    opt, opt_state = with_grad_accumulation(opt, state.params, run.accum)
+    return opt, state._replace(opt_state=opt_state)
+
+
+def _reject_accum(run: RunConfig, workload: str):
+    if run.accum > 1:
+        raise SystemExit(
+            f"accum>1 is wired for hybonet/hvae only — the {workload} "
+            "step updates full-batch (hgcn full-graph) or sparse rows "
+            "(embeddings), where microbatch accumulation has no meaning")
+
+
 def run_poincare(run: RunConfig, overrides: dict):
+    _reject_accum(run, "poincare")
     from hyperspace_tpu.data import wordnet
     from hyperspace_tpu.models import poincare_embed as pe
 
@@ -140,6 +165,7 @@ def run_poincare(run: RunConfig, overrides: dict):
 
 
 def run_hgcn(run: RunConfig, overrides: dict):
+    _reject_accum(run, "hgcn")
     from hyperspace_tpu.data import graphs as G
     from hyperspace_tpu.models import hgcn
 
@@ -276,6 +302,7 @@ def run_hybonet(run: RunConfig, overrides: dict):
                               max_len=ds.tokens.shape[1]),
         overrides)
     model, opt, state = hybonet.init_model(cfg, seed=run.seed)
+    opt, state = _maybe_accum(run, opt, state)
     toks, mask, labels = (jnp.asarray(tr.tokens), jnp.asarray(tr.mask),
                           jnp.asarray(tr.labels))
     from hyperspace_tpu.parallel.mesh import auto_mesh
@@ -300,6 +327,7 @@ def run_hvae(run: RunConfig, overrides: dict):
     ds, source = M.load_mnist(run.data_root)
     cfg = apply_overrides(hvae.HVAEConfig(image_size=ds.images.shape[1]), overrides)
     model, opt, state = hvae.init_model(cfg, seed=run.seed)
+    opt, state = _maybe_accum(run, opt, state)
     x_all = jnp.asarray(ds.images, cfg.dtype)
     metrics = {}
     from hyperspace_tpu.parallel.mesh import auto_mesh
@@ -327,6 +355,7 @@ def run_hvae(run: RunConfig, overrides: dict):
 
 
 def run_product(run: RunConfig, overrides: dict):
+    _reject_accum(run, "product")
     from hyperspace_tpu.data import wordnet
     from hyperspace_tpu.models import product_embed as pme
     from hyperspace_tpu.parallel.mesh import auto_mesh

@@ -1,0 +1,27 @@
+"""Gradient accumulation for the minibatch trainers.
+
+The reference family's DDP trainer grows its effective batch past device
+memory by accumulating microbatch gradients between optimizer updates
+[INFERRED — SURVEY.md §1a "Distributed trainer"]; the optax-native
+equivalent is ``optax.MultiSteps``: every k-th ``update`` applies the
+inner transform to the mean of the last k gradients, the others emit
+zero updates.  This wrapper exists so every workload wires it the same
+way (CLI ``accum=N``) and so the optimizer state is rebuilt consistently
+— a wrapped transform has a different state pytree, so the old state
+must be discarded, never reused.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def with_grad_accumulation(opt: optax.GradientTransformation, params,
+                           every_k: int):
+    """Return ``(wrapped_opt, fresh_opt_state)`` accumulating ``every_k``
+    microbatch gradients per optimizer update (k <= 1: unchanged opt,
+    fresh state)."""
+    if every_k <= 1:
+        return opt, opt.init(params)
+    wrapped = optax.MultiSteps(opt, every_k_schedule=every_k)
+    return wrapped, wrapped.init(params)

@@ -1,0 +1,51 @@
+"""Gradient accumulation (optim/accum.py): wiring + semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from hyperspace_tpu.optim.accum import with_grad_accumulation
+
+
+def test_accum_semantics_identity_on_repeated_grads():
+    """MultiSteps(2) fed the same gradient twice == one inner update with
+    that gradient; the intermediate microstep must not move params."""
+    params = jnp.asarray([1.0, -2.0, 0.5])
+    g = jnp.asarray([0.3, -0.1, 0.2])
+    inner = optax.adamw(1e-2)
+
+    opt, st = with_grad_accumulation(inner, params, 2)
+    p = params
+    up, st = opt.update(g, st, p)
+    p_mid = optax.apply_updates(p, up)
+    np.testing.assert_array_equal(np.asarray(p_mid), np.asarray(params))
+    up, st = opt.update(g, st, p_mid)
+    p_end = optax.apply_updates(p_mid, up)
+
+    st1 = inner.init(params)
+    up1, _ = inner.update(g, st1, params)
+    p_ref = optax.apply_updates(params, up1)
+    np.testing.assert_allclose(np.asarray(p_end), np.asarray(p_ref),
+                               rtol=1e-6)
+
+
+def test_accum_k1_is_inner_transform():
+    params = {"w": jnp.ones((2,))}
+    inner = optax.sgd(0.1)
+    opt, st = with_grad_accumulation(inner, params, 1)
+    assert opt is inner
+    up, _ = opt.update({"w": jnp.ones((2,))}, st, params)
+    np.testing.assert_allclose(np.asarray(up["w"]), -0.1 * np.ones(2))
+
+
+def test_cli_hybonet_accum_runs(tmp_path, capsys):
+    import json
+
+    from hyperspace_tpu.cli import train as cli
+
+    rc = cli.main(["hybonet", "steps=4", "accum=2", "dim=16", "num_layers=1",
+                   "num_heads=2", "batch_size=8"])
+    assert rc == 0
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert res["workload"] == "hybonet" and np.isfinite(res["loss"])
