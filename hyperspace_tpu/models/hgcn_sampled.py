@@ -393,8 +393,7 @@ def train_step_sampled_lp(
     """One sampled LP step; consumes pyramid ``state.step % S``.
 
     Supervises ``batch_size`` positive pairs (+ as many negatives)."""
-    ids, _ = _take_row(state, batches)
-    return _lp_row_step(model, opt, state, x_table, deg, ids)
+    return _sampled_lp_impl(model, opt, state, x_table, deg, batches)
 
 
 @partial(jax.jit, static_argnames=("model", "opt"), donate_argnames=("state",))
@@ -489,14 +488,20 @@ def train_epoch_sampled_nc(
     return jax.lax.scan(body, state, (tuple(batches.ids), batches.labels))
 
 
-def make_sharded_step(model, opt, mesh, state: hgcn.TrainState,
-                      x_table, deg, batches: SampledBatches):
-    """Data-parallel sampled step over ``mesh``: the pyramid's batch axis
-    shards across the data-like axes (XLA inserts the gradient
-    all-reduce — SURVEY.md §2 N8); features/degrees/plan are placed
-    replicated once.  Returns ``(step, placed_state, placed_data)``;
-    call as ``state, loss = step(state, *placed_data)``.  ``batch_size``
-    must divide by the mesh's data extent."""
+def _sampled_lp_impl(model, opt, state, x_table, deg, batches,
+                     constrain=None):
+    ids, _ = _take_row(state, batches)
+    return _lp_row_step(model, opt, state, x_table, deg, ids, constrain)
+
+
+def _make_sharded(impl, model, opt, mesh, state: hgcn.TrainState,
+                  x_table, deg, batches: SampledBatches):
+    """Shared DP builder: the pyramid's batch axis shards across the
+    data-like axes (XLA inserts the gradient all-reduce — SURVEY.md §2
+    N8); features/degrees/plan are placed replicated once.  Returns
+    ``(step, placed_state, placed_data)``; call as ``state, loss =
+    step(state, *placed_data)``.  The pyramid's leading batch axis (B
+    for NC, 4·batch_size for LP) must divide by the mesh's data extent."""
     from hyperspace_tpu.parallel.mesh import (
         data_extent,
         replicated,
@@ -507,13 +512,12 @@ def make_sharded_step(model, opt, mesh, state: hgcn.TrainState,
     d = data_extent(mesh)
     if batches.ids[0].shape[1] % d:
         raise ValueError(
-            f"batch_size={batches.ids[0].shape[1]} not divisible by the "
-            f"mesh's data extent {d}")
+            f"pyramid batch axis {batches.ids[0].shape[1]} not divisible "
+            f"by the mesh's data extent {d}")
     state_sh = state_shardings(state, state.params, mesh)
     repl = replicated(mesh)
     step = jax.jit(
-        partial(_sampled_impl, model, opt,
-                constrain=partial(shard_batch, mesh=mesh)),
+        partial(impl, model, opt, constrain=partial(shard_batch, mesh=mesh)),
         in_shardings=(state_sh, repl, repl, repl),
         out_shardings=(state_sh, repl),
         donate_argnums=(0,),
@@ -522,3 +526,17 @@ def make_sharded_step(model, opt, mesh, state: hgcn.TrainState,
             jax.tree_util.tree_map(lambda a: jax.device_put(a, repl),
                                    batches))
     return step, jax.device_put(state, state_sh), data
+
+
+def make_sharded_step(model, opt, mesh, state: hgcn.TrainState,
+                      x_table, deg, batches: SampledBatches):
+    """Data-parallel sampled NC step over ``mesh`` (see _make_sharded)."""
+    return _make_sharded(_sampled_impl, model, opt, mesh, state, x_table,
+                         deg, batches)
+
+
+def make_sharded_lp_step(model, opt, mesh, state: hgcn.TrainState,
+                         x_table, deg, batches: SampledBatches):
+    """Data-parallel sampled LP step over ``mesh`` (see _make_sharded)."""
+    return _make_sharded(_sampled_lp_impl, model, opt, mesh, state,
+                         x_table, deg, batches)

@@ -255,3 +255,35 @@ def test_three_layer_pyramid_trains():
         state, loss = HS.train_step_sampled_nc(
             model, opt, state, jnp.asarray(x), deg, batches)
     assert np.isfinite(float(loss))
+
+
+def test_sharded_sampled_lp_step_matches_single_device():
+    """LP DP over the (4P) endpoint axis: same trajectory as the
+    single-device LP step to float tolerance."""
+    from hyperspace_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = make_mesh({"data": 8})
+    n = 64
+    edges, x, labels, _ = G.synthetic_hierarchy(
+        num_nodes=n, feat_dim=8, num_classes=3, seed=6)
+    split = G.split_edges(edges, n, x, seed=0, pad_multiple=64)
+    cfg = _cfg(batch_size=16, base_kw=dict(dropout=0.0, num_classes=0))
+    batches, deg = HS.plan_lp_batches(cfg, split.train_pos, n, steps=4,
+                                      seed=0)
+    xt = jnp.asarray(x)
+    model, opt, s1 = HS.init_sampled_lp(cfg, feat_dim=8, seed=0)
+    _, _, s2 = HS.init_sampled_lp(cfg, feat_dim=8, seed=0)
+    for _ in range(4):
+        s1, loss1 = HS.train_step_sampled_lp(model, opt, s1, xt, deg,
+                                             batches)
+    step, s2, data = HS.make_sharded_lp_step(model, opt, mesh, s2, xt, deg,
+                                             batches)
+    for _ in range(4):
+        s2, loss2 = step(s2, *data)
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=2e-5, atol=2e-5),
+        s1.params, jax.device_get(s2.params))
