@@ -148,6 +148,10 @@ def run_poincare(run: RunConfig, overrides: dict):
         # step budget up to a chunk multiple — checkpoint/log step
         # numbers then always equal the steps actually taken
         chunks = -(-run.steps // run.scan_chunk)
+        if chunks * run.scan_chunk != run.steps:
+            print(f"scan_chunk={run.scan_chunk}: step budget rounded up "
+                  f"{run.steps} -> {chunks * run.scan_chunk} (every "
+                  "dispatch runs a full chunk)", flush=True)
         run = dataclasses.replace(run, steps=chunks * run.scan_chunk)
         stepper = lambda st: pe.train_epoch_scan(cfg, opt, st, pairs,
                                                  run.scan_chunk)
@@ -162,6 +166,28 @@ def run_poincare(run: RunConfig, overrides: dict):
     # state.step is the authoritative count (survives resume/chunk
     # rounding — a resumed chunked run can legitimately exceed run.steps)
     return {"workload": "poincare", "steps": int(state.step), **res}
+
+
+def hgcn_mode_defaults(base, overrides: dict, sampled: bool):
+    """Mode-aware HGCN defaults (VERDICT r3 #2).
+
+    The full-graph lr=1e-2 is measured-bad for two modes
+    (docs/benchmarks.md): sampled minibatch gradients oscillate at 1e-2
+    (val acc 0.3–0.76 swings) and the attention arm collapses 2-of-3
+    seeds to the degenerate logits-0 solution.  3e-3 reaches the plateau
+    in both studies; attention additionally gets grad-norm clipping
+    (the collapse is driven by early gradient spikes).  Explicit lr= /
+    clip_norm= overrides always win.  NOTE: a run resumed from a
+    checkpoint re-derives its lr from config, so a pre-r4 sampled /
+    attention checkpoint resumes at the NEW default lr unless the old
+    value is passed explicitly.
+    """
+    use_att = _coerce(False, overrides.get("use_att", "false"))
+    if (sampled or use_att) and "lr" not in overrides:
+        base = dataclasses.replace(base, lr=3e-3)
+    if use_att and "clip_norm" not in overrides:
+        base = dataclasses.replace(base, clip_norm=1.0)
+    return base
 
 
 def run_hgcn(run: RunConfig, overrides: dict):
@@ -183,10 +209,11 @@ def run_hgcn(run: RunConfig, overrides: dict):
     edges, x, labels, ncls, source = G.load_graph(dataset, run.data_root)
     if reorder:  # BFS locality relabeling: feeds the cluster-pair kernel
         edges, x, labels, _ = G.apply_locality_order(edges, x, labels)
-    cfg = apply_overrides(
+    base = hgcn_mode_defaults(
         hgcn.HGCNConfig(feat_dim=x.shape[1],
                         num_classes=ncls if task == "nc" else 0),
-        overrides)
+        overrides, sampled)
+    cfg = apply_overrides(base, overrides)
     num_nodes = x.shape[0]
     from hyperspace_tpu.parallel.mesh import auto_mesh
 

@@ -469,12 +469,20 @@ def locality_order(edges: np.ndarray, num_nodes: int) -> np.ndarray:
     available; the pure-Python deque walk below is the fallback and the
     parity oracle.
     """
+    e = np.asarray(edges)
+    # validate HERE so native and fallback paths fail identically (the
+    # C++ walk would OOB-write silently; the python walk would wrap
+    # negative ids)
+    if len(e) and (e.min() < 0 or e.max() >= num_nodes):
+        raise IndexError(
+            f"edge ids out of range [0, {num_nodes}): min {e.min()}, "
+            f"max {e.max()}")
     try:
         from hyperspace_tpu.data import native
 
-        return native.locality_order(np.asarray(edges, np.int32), num_nodes)
+        return native.locality_order(np.asarray(e, np.int32), num_nodes)
     except (ImportError, OSError):
-        return _locality_order_python(edges, num_nodes)
+        return _locality_order_python(e, num_nodes)
 
 
 def _locality_order_python(edges: np.ndarray, num_nodes: int) -> np.ndarray:

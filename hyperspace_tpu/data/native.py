@@ -170,6 +170,12 @@ def locality_order(edges: np.ndarray, num_nodes: int) -> np.ndarray:
     """
     lib = _load()
     e = _as_i32_pairs(edges) if len(edges) else np.zeros((0, 2), np.int32)
+    # the C++ side does no bounds checks (silent OOB write); fail here the
+    # way the numpy twin would (IndexError) instead
+    if len(e) and (e.min() < 0 or e.max() >= num_nodes):
+        raise IndexError(
+            f"edge ids out of range [0, {num_nodes}): min {e.min()}, "
+            f"max {e.max()}")
     out = np.empty(num_nodes, np.int64)
     lib.locality_order(
         e.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), e.shape[0],
@@ -190,6 +196,12 @@ def sample_neighbors(indptr: np.ndarray, indices: np.ndarray,
     indptr = np.ascontiguousarray(indptr, np.int64)
     indices = np.ascontiguousarray(indices, np.int32)
     seeds = np.ascontiguousarray(seeds, np.int32)
+    # the C++ side does no bounds checks (silent OOB read); fail here the
+    # way the numpy twin would (IndexError) instead
+    if len(seeds) and (seeds.min() < 0 or seeds.max() >= len(indptr) - 1):
+        raise IndexError(
+            f"seed ids out of range [0, {len(indptr) - 1}): "
+            f"min {seeds.min()}, max {seeds.max()}")
     out = np.empty((len(seeds), fanout), np.int32)
     lib.sample_neighbors(
         indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
@@ -209,6 +221,12 @@ def sample_neighbors_numpy(indptr: np.ndarray, indices: np.ndarray,
     indptr = np.asarray(indptr, np.int64)
     indices = np.asarray(indices, np.int32)
     seeds = np.asarray(seeds, np.int64)
+    # same guard as the native path — without it numpy would wrap
+    # negative ids instead of raising, and the twins would diverge
+    if len(seeds) and (seeds.min() < 0 or seeds.max() >= len(indptr) - 1):
+        raise IndexError(
+            f"seed ids out of range [0, {len(indptr) - 1}): "
+            f"min {seeds.min()}, max {seeds.max()}")
     off = indptr[seeds]                                     # [K]
     deg = indptr[seeds + 1] - off                           # [K]
     cells = (np.arange(len(seeds), dtype=np.uint64)[:, None]

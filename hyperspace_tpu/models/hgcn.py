@@ -48,6 +48,12 @@ class HGCNConfig:
     num_classes: int = 0  # NC head only when > 0
     lr: float = 1e-2
     weight_decay: float = 5e-4
+    # >0: clip the global gradient norm before adamw.  The attention
+    # arm's measured failure mode (docs/benchmarks.md convergence §2) is
+    # a collapse to the degenerate logits-0 solution driven by early
+    # gradient spikes; clipping at ~1.0 removes the cliff (regression-
+    # tested in tests/models/test_stability.py).  0 disables.
+    clip_norm: float = 0.0
     neg_per_pos: int = 1  # LP negatives sampled per positive per step
     dtype: Any = jnp.float32
     # edge-message dtype for neighbor aggregation (None = dtype); bf16
@@ -221,7 +227,13 @@ class TrainState(NamedTuple):
 
 
 def make_optimizer(cfg: HGCNConfig) -> optax.GradientTransformation:
-    return optax.adamw(cfg.lr, weight_decay=cfg.weight_decay)
+    # the clip stage is always present (inf = no-op) so the opt_state
+    # pytree structure is identical across clip_norm settings — a
+    # checkpoint written with clipping on restores with it off and
+    # vice versa (orbax restore is structure-strict)
+    max_norm = cfg.clip_norm if cfg.clip_norm > 0.0 else float("inf")
+    return optax.chain(optax.clip_by_global_norm(max_norm),
+                       optax.adamw(cfg.lr, weight_decay=cfg.weight_decay))
 
 
 def _device_graph(g: graph_data.Graph) -> graph_data.DeviceGraph:

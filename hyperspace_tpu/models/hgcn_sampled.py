@@ -119,6 +119,13 @@ class SampledHGCConv(nn.Module):
             h_self = h_self + bias
             h_nbr = h_nbr + bias
         if self.dropout_rate > 0.0:
+            # h_self and h_nbr get INDEPENDENT masks, so a node that
+            # appears both as itself and as a sampled neighbor (or is
+            # drawn multiple times with replacement) sees different masks
+            # than the full-graph layer's single per-node dropout: with
+            # dropout>0 the sampled step is therefore not an unbiased
+            # estimator of the full-graph training operator (standard
+            # minibatch-GNN behavior; eval/deterministic paths agree).
             drop = nn.Dropout(self.dropout_rate)
             h_self = drop(h_self, deterministic=deterministic)
             h_nbr = drop(h_nbr, deterministic=deterministic)
@@ -249,18 +256,32 @@ class SampledBatches(NamedTuple):
     labels: Any
 
 
+def _mix64(x: int) -> int:
+    """Host-side splitmix64 finalizer (one round) over a python int."""
+    m = (1 << 64) - 1
+    x = (x + 0x9E3779B97F4A7C15) & m
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & m
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & m
+    return x ^ (x >> 31)
+
+
 def _build_pyramid(cfg: SampledConfig, indptr, indices, seeds, seed: int):
     """Fanout levels over per-step seed rows ([S, B] → [S, B, f1], ...).
 
     The ONE sampler-driving loop both planners share (same per-(step,
-    level) seed derivation — NC and LP pyramids must never diverge)."""
+    level) seed derivation — NC and LP pyramids must never diverge).
+    The per-call seed is splitmix64-hashed before it drives the sampler:
+    the sampler itself computes ``splitmix64(seed ^ cell)``, so raw
+    small-integer call seeds would make different calls' RNG streams
+    XOR-shifted permutations of each other (weakly correlated draws
+    across steps/levels); hashing first decorrelates the streams."""
     levels = [seeds]
     steps = seeds.shape[0]
     for li, f in enumerate(cfg.fanouts):
         prev = levels[-1]
         nxt = np.stack([
             _sample(indptr, indices, prev[s].ravel(), f,
-                    seed=(seed * 1_000_003 + s * 97 + li))
+                    seed=_mix64(seed * 1_000_003 + s * 97 + li))
             for s in range(steps)
         ]).reshape(prev.shape + (f,))
         levels.append(nxt)
