@@ -65,11 +65,22 @@ def arxiv_scale_graph(num_nodes: int = ARXIV_NODES, seed: int = 0):
         num_classes=ARXIV_CLASSES, seed=seed)
 
 
-def arxiv_scale_split(num_nodes: int = ARXIV_NODES, seed: int = 0):
-    """:func:`arxiv_scale_graph` + its LP split; returns (split, x)."""
+def arxiv_scale_split(num_nodes: int = ARXIV_NODES, seed: int = 0,
+                      reorder: str | None = "community"):
+    """:func:`arxiv_scale_graph` + its LP split; returns (split, x).
+
+    The graph is community-reordered by default: the LPA locality order
+    lifts the synthetic hierarchy's clusterable edge fraction from 8%
+    to ~39% (the tree+ancestor structure is there — the generation-order
+    ids just hide it), which is the layout the cluster-pair kernels are
+    built for.  A pure relabeling: quality metrics are unaffected.
+    """
     from hyperspace_tpu.data import graphs as G
 
     edges, x, labels, ncls = arxiv_scale_graph(num_nodes, seed)
+    if reorder:
+        edges, x, labels, _ = G.apply_locality_order(edges, x, labels,
+                                                     method=reorder)
     split = G.split_edges(edges, num_nodes, x, val_frac=0.02, test_frac=0.02,
                           seed=seed, pad_multiple=65536)
     return split, x
@@ -145,6 +156,10 @@ def run_hgcn_bench(
         "vs_baseline": None,
         "detail": {
             "num_nodes": num_nodes,
+            "reorder": "community",
+            "frac_clustered": (
+                None if split.graph.cluster_split is None
+                else round(split.graph.cluster_split.frac_clustered, 4)),
             "num_edges_padded": int(split.graph.senders.shape[0]),
             "steps": steps_per_repeat,
             "step_time_s": round(best / steps_per_repeat, 5),
@@ -162,6 +177,95 @@ def run_hgcn_bench(
             "decoder_dtype": decoder_dtype,
         },
     }
+
+
+def ensure_disk_dataset(root: str | None = None, seed: int = 0) -> str:
+    """Materialize the community-structured power-law dataset on disk in
+    the OGB extracted-csv layout (generate once, ~180 MB, cached).
+
+    The uniform-random synthetic bench graph is adversarial to the
+    locality/cluster levers (8% clusterable by construction); this
+    dataset carries the hierarchical community structure real citation
+    graphs have, AND exercises the full disk → ``load_ogbn_arxiv`` →
+    ``prepare`` pipeline (VERDICT r3 #3: ``source: "disk"``).
+    """
+    import os
+
+    from hyperspace_tpu.data import graphs as G
+
+    if root is None:
+        root = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                            ".cache", "arxiv-synth")
+    root = os.path.abspath(root)
+    if not os.path.exists(os.path.join(root, "raw", "edge.csv")):
+        # write into a temp sibling and rename whole: an interrupted
+        # generation must not leave a half-written tree that the
+        # edge.csv existence sentinel would treat as complete
+        tmp = root + ".tmp"
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        edges, x, labels, _ = G.community_power_law_graph(seed=seed)
+        G.write_ogb_csv_layout(tmp, edges, x, labels)
+        os.makedirs(os.path.dirname(root), exist_ok=True)
+        shutil.rmtree(root, ignore_errors=True)
+        os.replace(tmp, root)
+    return root
+
+
+def run_realistic_bench(repeats: int = 2, steps_per_repeat: int = 10,
+                        data_root: str | None = None) -> dict:
+    """Realistic-locality variant: disk csvs → loader → community reorder
+    → cluster split → timed mean AND attention steps on the live backend.
+
+    Reports the clusterable edge fraction the reorder achieves and both
+    step times — the honest test of the r03/r04 cluster levers (the
+    uniform synthetic caps clusterable edges at ~8%; this graph reaches
+    ~31% under the community order).  Rides in bench.py's auto detail.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.data import graphs as G
+    from hyperspace_tpu.models import hgcn
+
+    root = ensure_disk_dataset(data_root)
+    edges, x, labels, ncls, source = G.load_graph("ogbn-arxiv", root)
+    edges, x, labels, _ = G.apply_locality_order(edges, x, labels,
+                                                 method="community")
+    num_nodes = x.shape[0]
+    split = G.split_edges(edges, num_nodes, x, val_frac=0.02, test_frac=0.02,
+                          seed=0, pad_multiple=65536)
+    out = {
+        "source": source,
+        "num_nodes": num_nodes,
+        "num_edges_padded": int(split.graph.senders.shape[0]),
+        "reorder": "community",
+        "frac_clustered": (
+            None if split.graph.cluster_split is None
+            else round(split.graph.cluster_split.frac_clustered, 4)),
+        "backend": jax.default_backend(),
+    }
+    for use_att in (False, True):
+        cfg = hgcn.HGCNConfig(
+            feat_dim=x.shape[1], hidden_dims=(128, 32), kind="lorentz",
+            use_att=use_att, agg_dtype=jnp.bfloat16,
+            decoder_dtype=jnp.bfloat16)
+        model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
+        ga = hgcn._device_graph(split.graph)
+        pos = hgcn.make_planned_pairs(split.train_pos, num_nodes)
+        neg_u, neg_plan = hgcn.make_static_negatives(
+            num_nodes, int(pos.u.shape[0]) * cfg.neg_per_pos, seed=0)
+        step_fn = lambda st: hgcn.train_step_lp_pairs(
+            model, opt, num_nodes, st, ga, pos, neg_u, neg_plan)
+        best, state, loss = time_steps(step_fn, state, steps_per_repeat,
+                                       repeats)
+        key = "att" if use_att else "mean"
+        out[f"{key}_step_s"] = round(best / steps_per_repeat, 5)
+        out[f"{key}_samples_per_s"] = round(
+            num_nodes * steps_per_repeat / best, 1)
+        out[f"{key}_loss"] = float(loss)
+    return out
 
 
 def run_sampled_bench(repeats: int = 3, steps: int = 64,
@@ -191,14 +295,40 @@ def run_sampled_bench(repeats: int = 3, steps: int = 64,
     model, opt, state = HS.init_sampled_nc(cfg, feat_dim=ARXIV_FEATS, seed=0)
     xt = jnp.asarray(np.asarray(x, np.float32))
 
-    best, _, _ = time_steps(
+    best, state, _ = time_steps(
         lambda st: HS.train_step_sampled_nc(model, opt, st, xt, deg,
                                             batches),
         state, steps, repeats)
     step_s = best / steps
+
+    # sampling-INCLUSIVE wall clock (VERDICT r3 weak #4): fresh batches
+    # flow from the background SampledBatchStream while the device
+    # trains; the honest samples/s includes planning + transfer
+    import time as _time
+
+    tr_mask, _, _ = G.node_split_masks(num_nodes, seed=0)
+    with HS.SampledBatchStream(
+            cfg, "nc", num_nodes=num_nodes, edges=edges, labels=labels,
+            train_mask=tr_mask, chunk_steps=steps, seed=1) as stream:
+        batches1 = stream.next()          # warm the pipeline
+        state, loss = HS.train_step_sampled_nc(model, opt, state, xt, deg,
+                                               batches1)
+        jax.device_get(loss)
+        n_chunks = max(2, repeats)
+        t0 = _time.perf_counter()
+        for _ in range(n_chunks):
+            b = stream.next()
+            for _ in range(steps):
+                state, loss = HS.train_step_sampled_nc(model, opt, state,
+                                                       xt, deg, b)
+            jax.device_get(loss)
+        incl = (_time.perf_counter() - t0) / (n_chunks * steps)
+
     return {
         "step_ms": round(step_s * 1e3, 3),
         "supervised_samples_per_s": round(cfg.batch_size / step_s, 1),
+        "sampling_inclusive_step_ms": round(incl * 1e3, 3),
+        "sampling_inclusive_samples_per_s": round(cfg.batch_size / incl, 1),
         "batch_size": cfg.batch_size,
         "fanouts": list(cfg.fanouts),
         "num_nodes": num_nodes,

@@ -168,6 +168,24 @@ def run_poincare(run: RunConfig, overrides: dict):
     return {"workload": "poincare", "steps": int(state.step), **res}
 
 
+def _stream_stepper(stream, step_fn):
+    """Stepper that pulls a fresh pyramid chunk every ``chunk_steps``
+    calls from a :class:`hgcn_sampled.SampledBatchStream` — long runs
+    never recycle batches (VERDICT r3 #5).  The device step indexes its
+    pyramid row by ``state.step % chunk_steps``; a resume offset only
+    rotates the within-chunk consumption order (batches are iid draws),
+    every row of every chunk is still consumed exactly once."""
+    holder = {"batches": None, "calls": 0}
+
+    def stepper(st):
+        if holder["calls"] % stream.chunk_steps == 0:
+            holder["batches"] = stream.next()
+        holder["calls"] += 1
+        return step_fn(st, holder["batches"])
+
+    return stepper
+
+
 def hgcn_mode_defaults(base, overrides: dict, sampled: bool):
     """Mode-aware HGCN defaults (VERDICT r3 #2).
 
@@ -197,7 +215,9 @@ def run_hgcn(run: RunConfig, overrides: dict):
 
     task = overrides.pop("task", "lp")
     dataset = overrides.pop("dataset", "cora")
-    reorder = overrides.pop("reorder", "false").lower() in ("1", "true", "yes")
+    # reorder=true|bfs → BFS locality order; reorder=community → LPA
+    # community order (best block density on community graphs)
+    reorder = overrides.pop("reorder", "false").lower()
     # neighbor-sampled minibatch mode (task=nc or lp): fixed-fanout
     # pyramids from the native sampler; supervises `batch` seeds/step
     sampled = overrides.pop("sampled", "false").lower() in ("1", "true", "yes")
@@ -207,8 +227,15 @@ def run_hgcn(run: RunConfig, overrides: dict):
     # caps the [S, B, f1, f2] id pyramid's device footprint on long runs
     plan_steps = int(overrides.pop("plan_steps", "64"))
     edges, x, labels, ncls, source = G.load_graph(dataset, run.data_root)
-    if reorder:  # BFS locality relabeling: feeds the cluster-pair kernel
-        edges, x, labels, _ = G.apply_locality_order(edges, x, labels)
+    if reorder not in ("0", "false", "no", "1", "true", "yes", "bfs",
+                       "community"):
+        raise SystemExit(
+            f"reorder={reorder!r}: want true/false, bfs, or community")
+    if reorder in ("1", "true", "yes", "bfs", "community"):
+        # locality relabeling: feeds the cluster-pair kernel
+        edges, x, labels, _ = G.apply_locality_order(
+            edges, x, labels,
+            method="community" if reorder == "community" else "bfs")
     base = hgcn_mode_defaults(
         hgcn.HGCNConfig(feat_dim=x.shape[1],
                         num_classes=ncls if task == "nc" else 0),
@@ -232,14 +259,16 @@ def run_hgcn(run: RunConfig, overrides: dict):
                                     batch_size=batch)
             model_s, opt, state = HS.init_sampled_lp(
                 scfg, feat_dim=x.shape[1], seed=run.seed)
-            batches, deg = HS.plan_lp_batches(
-                scfg, split.train_pos, num_nodes,
-                steps=min(run.steps, plan_steps), seed=run.seed)
             xt = jnp.asarray(np.asarray(x, np.float32))
-            state, loss = _train_loop(
-                run, state,
-                lambda st: HS.train_step_sampled_lp(model_s, opt, st, xt,
-                                                    deg, batches))
+            with HS.SampledBatchStream(
+                    scfg, "lp", num_nodes=num_nodes,
+                    train_pos=split.train_pos,
+                    chunk_steps=min(run.steps, plan_steps),
+                    seed=run.seed) as stream:
+                stepper = _stream_stepper(
+                    stream, lambda st, b: HS.train_step_sampled_lp(
+                        model_s, opt, st, xt, stream.deg, b))
+                state, loss = _train_loop(run, state, stepper)
             full = hgcn.HGCNLinkPred(cfg)
             res = {"loss": float(loss),
                    **hgcn.evaluate_lp(full, state.params, split, "test")}
@@ -284,14 +313,16 @@ def run_hgcn(run: RunConfig, overrides: dict):
                                     batch_size=batch)
             model_s, opt, state = HS.init_sampled_nc(
                 scfg, feat_dim=x.shape[1], seed=run.seed)
-            batches, deg = HS.plan_batches(
-                scfg, edges, labels, tr, num_nodes,
-                steps=min(run.steps, plan_steps), seed=run.seed)
             xt = jnp.asarray(np.asarray(x, np.float32))
-            state, loss = _train_loop(
-                run, state,
-                lambda st: HS.train_step_sampled_nc(model_s, opt, st, xt,
-                                                    deg, batches))
+            with HS.SampledBatchStream(
+                    scfg, "nc", num_nodes=num_nodes, edges=edges,
+                    labels=labels, train_mask=tr,
+                    chunk_steps=min(run.steps, plan_steps),
+                    seed=run.seed) as stream:
+                stepper = _stream_stepper(
+                    stream, lambda st, b: HS.train_step_sampled_nc(
+                        model_s, opt, st, xt, stream.deg, b))
+                state, loss = _train_loop(run, state, stepper)
             full = hgcn.HGCNNodeClf(cfg)
             res = {"loss": float(loss),
                    **hgcn.evaluate_nc(full, state.params, g)}

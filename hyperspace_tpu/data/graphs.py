@@ -199,6 +199,13 @@ def prepare(
       are static per graph, so they are computed here once instead of per
       training step.
     """
+    e_chk = np.asarray(edges)
+    # validate before the native path: the C++ pipeline does no bounds
+    # checks and a bad id would segfault instead of raising
+    if len(e_chk) and (e_chk.min() < 0 or e_chk.max() >= num_nodes):
+        raise IndexError(
+            f"edge ids out of range [0, {num_nodes}): min {e_chk.min()}, "
+            f"max {e_chk.max()}")
     senders = receivers = mask = rev_perm = deg = None
     try:  # native C++ pipeline; _prepare_edges_numpy is the oracle
         from hyperspace_tpu.data import native
@@ -228,7 +235,7 @@ def prepare(
             from hyperspace_tpu.kernels.cluster import build_cluster_split
 
             split = build_cluster_split(senders, receivers, mask, deg,
-                                        num_nodes)
+                                        num_nodes, rev_perm=rev_perm)
 
     return Graph(
         x=np.asarray(x, np.float32),
@@ -346,13 +353,50 @@ def load_cora(root: str):
     )
 
 
+def _read_csv(path: str, dtype):
+    """Fast csv matrix read: pandas C engine when available (an order of
+    magnitude faster at arxiv scale — node-feat.csv is ~21.7 M floats),
+    np.loadtxt as the no-pandas fallback."""
+    try:
+        import pandas as pd
+
+        return pd.read_csv(path, header=None, dtype=dtype).to_numpy()
+    except ImportError:
+        return np.loadtxt(path, delimiter=",", dtype=dtype)
+
+
 def load_ogbn_arxiv(root: str):
     """OGB extracted-csv layout (``raw/edge.csv``, ``raw/node-feat.csv``...)."""
     raw = os.path.join(root, "raw")
-    edges = np.loadtxt(os.path.join(raw, "edge.csv"), delimiter=",", dtype=np.int64)
-    x = np.loadtxt(os.path.join(raw, "node-feat.csv"), delimiter=",", dtype=np.float32)
-    labels = np.loadtxt(os.path.join(raw, "node-label.csv"), delimiter=",", dtype=np.int64)
+    edges = _read_csv(os.path.join(raw, "edge.csv"), np.int64)
+    x = np.ascontiguousarray(
+        _read_csv(os.path.join(raw, "node-feat.csv"), np.float32))
+    labels = _read_csv(os.path.join(raw, "node-label.csv"), np.int64)
     return edges, x, labels.astype(np.int32).reshape(-1), int(labels.max()) + 1
+
+
+def write_ogb_csv_layout(root: str, edges: np.ndarray, x: np.ndarray,
+                         labels: np.ndarray) -> None:
+    """Write a graph to the OGB extracted-csv layout ``load_ogbn_arxiv``
+    reads (``raw/{edge,node-feat,node-label}.csv``) — the disk end of the
+    disk → load → prepare → train pipeline."""
+    raw = os.path.join(root, "raw")
+    os.makedirs(raw, exist_ok=True)
+
+    def _write(path, a, fmt):
+        try:  # pandas C writer: ~10x np.savetxt on the 21.7M-float feat
+            import pandas as pd
+
+            pd.DataFrame(a).to_csv(path, header=False, index=False,
+                                   float_format="%.6g")
+        except ImportError:
+            np.savetxt(path, a, fmt=fmt, delimiter=",")
+
+    _write(os.path.join(raw, "edge.csv"), np.asarray(edges, np.int64), "%d")
+    _write(os.path.join(raw, "node-feat.csv"), np.asarray(x, np.float32),
+           "%.6g")
+    _write(os.path.join(raw, "node-label.csv"),
+           np.asarray(labels, np.int64).reshape(-1, 1), "%d")
 
 
 # --- synthetic fallbacks ------------------------------------------------------
@@ -413,6 +457,119 @@ def synthetic_hierarchy(
     x = protos[labels] + 0.4 * rng.normal(size=(num_nodes, feat_dim)).astype(np.float32)
     x[:, 0] = depth / max(depth.max(), 1)
     return edges, x, labels, num_classes
+
+
+def community_power_law_graph(
+    num_nodes: int = 169_343,
+    num_edges: int = 1_166_243,
+    num_classes: int = 40,
+    feat_dim: int = 128,
+    gamma: float = 2.6,
+    p_in: float = 0.72,
+    p_sub: float = 0.55,
+    sub_size: int = 400,
+    triadic_frac: float = 0.15,
+    seed: int = 0,
+):
+    """Community-structured power-law graph at citation-network statistics.
+
+    The uniform-random edge majority of :func:`synthetic_hierarchy` is
+    *unclusterable by construction* — adversarial to the BFS-locality /
+    cluster-pair levers real citation graphs reward (VERDICT r3 #3).
+    This generator produces the structure those levers were built for,
+    with ogbn-arxiv-like shape statistics:
+
+    - **degree-corrected SBM**: node degrees follow a truncated power law
+      (exponent ``gamma``, arxiv's in-degree tail fits ~2.5–3); both edge
+      endpoints are degree-weighted, so hubs emerge.
+    - **communities**: ``num_classes`` groups with power-law sizes; a
+      ``p_in`` fraction of edges stay inside the sender's community
+      (arxiv's label assortativity ~0.65–0.8 depending on measure).
+      Class label = community; features = community prototype + noise
+      (same recipe as :func:`synthetic_hierarchy`).
+    - **hierarchical sub-communities**: citation topics cluster down to
+      research-group scale, not just field scale — within a community,
+      a ``p_sub`` fraction of its internal edges stay inside the
+      sender's ~``sub_size``-node sub-community.  This is the level the
+      BFS locality reorder converts into (receiver-block × sender-block)
+      density for the cluster-pair kernel.
+    - **triadic closure**: ``triadic_frac`` of edges connect two
+      neighbors of a shared node, lifting the clustering coefficient
+      from the SBM's near-zero toward citation-graph levels.
+
+    Returns (edges [E, 2] directed, x [N, F], labels [N], num_classes).
+    """
+    rng = np.random.default_rng(seed)
+    # truncated power-law degree propensities (inverse-transform Pareto)
+    u = rng.random(num_nodes)
+    prop = np.minimum(u ** (-1.0 / (gamma - 1.0)), num_nodes ** 0.5)
+    prop /= prop.sum()
+    # power-law community sizes via Dirichlet over a decaying base measure
+    base = (1.0 / np.arange(1, num_classes + 1)) ** 0.8
+    sizes = rng.dirichlet(base * num_classes)
+    comm = rng.choice(num_classes, size=num_nodes, p=sizes)
+
+    # sub-communities: chunk each community's member list into
+    # ~sub_size-node groups (globally-unique sub ids)
+    sub = np.zeros(num_nodes, np.int64)
+    next_sub = 0
+    for c in range(num_classes):
+        members = np.flatnonzero(comm == c)
+        n_sub = max(1, len(members) // sub_size)
+        sub[members] = next_sub + rng.integers(0, n_sub, len(members))
+        next_sub += n_sub
+
+    n_base = int(num_edges * (1.0 - triadic_frac))
+    senders = rng.choice(num_nodes, size=n_base, p=prop)
+    receivers = np.empty(n_base, np.int64)
+    r_scope = rng.random(n_base)
+    in_comm = r_scope < p_in
+    in_sub = r_scope < p_in * p_sub
+    out_idx = np.flatnonzero(~in_comm)
+    receivers[out_idx] = rng.choice(num_nodes, size=len(out_idx), p=prop)
+
+    def _fill_grouped(group_of, take_mask):
+        """Degree-weighted receiver draw within the sender's group."""
+        take = np.flatnonzero(take_mask)
+        if len(take) == 0:
+            return
+        gids = group_of[senders[take]]
+        order = np.argsort(gids, kind="stable")
+        take = take[order]
+        gids = gids[order]
+        starts = np.flatnonzero(np.r_[True, gids[1:] != gids[:-1]])
+        ends = np.r_[starts[1:], len(gids)]
+        for st, en in zip(starts, ends):
+            members = np.flatnonzero(group_of == gids[st])
+            pc = prop[members] / prop[members].sum()
+            receivers[take[st:en]] = members[
+                rng.choice(len(members), size=en - st, p=pc)]
+
+    _fill_grouped(sub, in_sub)
+    _fill_grouped(comm, in_comm & ~in_sub)
+    edges = np.stack([senders, receivers], axis=1)
+    edges = edges[edges[:, 0] != edges[:, 1]]
+
+    # triadic closure: connect two neighbors of a shared pivot
+    n_tri = num_edges - len(edges)
+    if n_tri > 0:
+        # close triangles by pairing receivers of edges sharing a sender:
+        # sort by sender, draw pivot edges, connect each pivot's receiver
+        # to its sender-sorted neighbor's receiver
+        pivots = rng.choice(len(edges), size=n_tri)
+        bysend = np.argsort(edges[:, 0], kind="stable")
+        a = edges[bysend[pivots], :]
+        b = edges[bysend[np.minimum(pivots + 1, len(edges) - 1)], :]
+        share = a[:, 0] == b[:, 0]
+        tri = np.stack([a[share, 1], b[share, 1]], axis=1)
+        tri = tri[tri[:, 0] != tri[:, 1]]
+        edges = np.concatenate([edges, tri], axis=0)[:num_edges]
+
+    protos = rng.normal(size=(num_classes, feat_dim)).astype(np.float32)
+    labels = comm.astype(np.int32)
+    x = protos[labels] + 0.4 * rng.normal(
+        size=(num_nodes, feat_dim)).astype(np.float32)
+    return edges.astype(np.int64), x, labels, num_classes
 
 
 def node_split_masks(num_nodes: int, train_frac=0.6, val_frac=0.2, seed: int = 0):
@@ -518,16 +675,99 @@ def _locality_order_python(edges: np.ndarray, num_nodes: int) -> np.ndarray:
     return out
 
 
+def _lpa_sweeps(snd: np.ndarray, rcv: np.ndarray, num_nodes: int,
+                sweeps: int, rng) -> np.ndarray:
+    """Semi-asynchronous label propagation over a symmetric edge list.
+
+    Each sweep computes every node's majority neighbor label (ties break
+    to the smaller label) but applies it to a random HALF of the nodes —
+    synchronous LPA on community graphs oscillates on near-bipartite
+    motifs and strands ~40% of nodes as singletons (measured); the half
+    update converges instead.  Vectorized: two lexsorts + run-length
+    counts per sweep, O(E log E).
+    """
+    lab = np.arange(num_nodes, dtype=np.int64)
+    for _ in range(sweeps):
+        nl = lab[snd]
+        o = np.lexsort((nl, rcv))
+        r_s, l_s = rcv[o], nl[o]
+        new_pair = np.r_[True, (r_s[1:] != r_s[:-1]) | (l_s[1:] != l_s[:-1])]
+        starts = np.flatnonzero(new_pair)
+        counts = np.diff(np.r_[starts, len(r_s)])
+        pr, pl = r_s[starts], l_s[starts]
+        ordp = np.lexsort((-counts, pr))
+        firsts = np.flatnonzero(np.r_[True, pr[ordp][1:] != pr[ordp][:-1]])
+        upd_r, upd_l = pr[ordp][firsts], pl[ordp][firsts]
+        m = rng.random(len(upd_r)) < 0.5
+        lab2 = lab.copy()
+        lab2[upd_r[m]] = upd_l[m]
+        lab = lab2
+    return lab
+
+
+def community_order(edges: np.ndarray, num_nodes: int,
+                    sweeps: int = 16, split_rounds: int = 2,
+                    split_above: int = 1024, seed: int = 0) -> np.ndarray:
+    """Community-clustered relabeling: LPA groups + BFS-rank interleave.
+
+    :func:`locality_order`'s plain BFS mixes communities at every
+    frontier expansion — on a community-structured power-law graph at
+    arxiv scale it recovers only ~21% block-clusterable edges where the
+    planted-partition oracle reaches ~41%.  This ordering first detects
+    communities with semi-async label propagation (giant labels get
+    re-clustered on their internal subgraph), then orders nodes by
+    (community's first BFS rank, BFS rank): communities become
+    contiguous id ranges, adjacent communities stay near each other, and
+    within a community the BFS rank preserves neighborhood locality —
+    measured ~31% clusterable on the same graph (docs/benchmarks.md
+    r04).  Pure host-side numpy, ~20 s at arxiv scale (one-time prep,
+    amortized over the whole training run).  Like the BFS order this is
+    a graph isomorphism: only the memory layout changes.
+    """
+    e = np.asarray(edges, np.int64)
+    if len(e) and (e.min() < 0 or e.max() >= num_nodes):
+        raise IndexError(
+            f"edge ids out of range [0, {num_nodes}): min {e.min()}, "
+            f"max {e.max()}")
+    rng = np.random.default_rng(seed)
+    sym = np.concatenate([e, e[:, ::-1]], axis=0)
+    snd, rcv = sym[:, 0], sym[:, 1]
+    lab = _lpa_sweeps(snd, rcv, num_nodes, sweeps, rng)
+    for _ in range(split_rounds):
+        szmap = np.bincount(lab)
+        big = szmap[lab] > split_above
+        keep = big[snd] & big[rcv] & (lab[snd] == lab[rcv])
+        if not keep.sum():
+            break
+        sub = _lpa_sweeps(snd[keep], rcv[keep], num_nodes, max(sweeps - 6, 4),
+                          rng)
+        lab = np.where(big, lab.max() + 1 + sub, lab)
+    bfs = locality_order(e, num_nodes)
+    rank = np.empty(num_nodes, np.int64)
+    rank[bfs] = np.arange(num_nodes)
+    minr = np.full(int(lab.max()) + 1, num_nodes, np.int64)
+    np.minimum.at(minr, lab, rank)
+    return np.lexsort((rank, minr[lab]))
+
+
 def apply_locality_order(edges: np.ndarray, x: np.ndarray,
-                         labels: Optional[np.ndarray] = None):
-    """Relabel a loaded graph with :func:`locality_order`.
+                         labels: Optional[np.ndarray] = None,
+                         method: str = "bfs"):
+    """Relabel a loaded graph with :func:`locality_order` (``method=
+    "bfs"``) or :func:`community_order` (``method="community"`` — better
+    block density on community-structured graphs, costlier host prep).
 
     Returns (edges, x, labels, order) with node ``order[rank]`` renamed
     to ``rank``; pass the result straight to :func:`prepare` /
     :func:`split_edges`.
     """
     n = x.shape[0]
-    order = locality_order(edges, n)
+    if method == "community":
+        order = community_order(edges, n)
+    elif method == "bfs":
+        order = locality_order(edges, n)
+    else:
+        raise ValueError(f"unknown reorder method {method!r}")
     rank = np.empty(n, np.int64)
     rank[order] = np.arange(n)
     new_edges = rank[np.asarray(edges, np.int64)]

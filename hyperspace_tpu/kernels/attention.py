@@ -17,8 +17,18 @@ input dtype; the two matmuls per tile (Minkowski Gram, weight × V) hit
 the MXU.
 
 β and τ must be constant per (batch, head) — per-position values fall
-back to the XLA twin.  Gradients always flow through the twin
-(rematerializing custom_vjp, like every kernel in this package).
+back to the XLA twin.
+
+**Backward (r04, VERDICT r3 #4):** a recomputing flash backward replaces
+the dense-twin VJP on the kernel path.  The forward additionally emits
+per-row ``lse`` (softmax log-sum-exp) and the centroid Minkowski norm;
+the backward is the Lorentz-epilogue VJP (elementwise, XLA) followed by
+two Pallas kernels — dq (KV inner, recomputes the score tile and
+weights from lse) and dk/dv (Q inner) — so the [Nq, Nk] score matrix is
+never materialized in EITHER direction: backward peak memory is
+O(N·D + blocks), not O(N²).  dβ/dτ/dc fold out of per-Q-block partial
+sums the dq kernel also emits.  The XLA twin (CPU / per-position β,τ)
+keeps plain autodiff.
 """
 
 from __future__ import annotations
@@ -58,7 +68,8 @@ def _t_flash_attention(q, k, v, c, beta, tau, maskf):
 
 
 def _attn_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, o_ref,
-               m_scr, l_scr, acc_scr, *, bk: int, masked: bool, mask_ref=None):
+               lse_ref, nrm_ref, m_scr, l_scr, acc_scr, *, bk: int,
+               masked: bool, mask_ref=None):
     ik = pl.program_id(2)
     nk_blocks = pl.num_programs(2)
 
@@ -110,6 +121,15 @@ def _attn_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, o_ref,
         nrm = S.ksafe_sqrt(jnp.maximum(-sp, S.EPS_F32))
         sc = jnp.maximum(S.ksafe_sqrt(c), S.MIN_NORM_F32)
         o_ref[0] = (s / (sc * nrm)).astype(o_ref.dtype)
+        # backward-pass residuals: log-sum-exp of the score rows (big
+        # positive on fully-masked/padded rows so recomputed weights
+        # underflow to 0) and the pre-normalization Minkowski norm
+        l_row = l_scr[:, :1]
+        lse = jnp.where(l_row > 0.0,
+                        m_scr[:, :1] + jnp.log(jnp.maximum(l_row, 1e-38)),
+                        1e30)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        nrm_ref[0] = jnp.broadcast_to(nrm, nrm_ref.shape[1:])
 
 
 def _launch(q, k, v, c, beta_b, tau_b, maskf, mode_):
@@ -160,22 +180,34 @@ def _launch(q, k, v, c, beta_b, tau_b, maskf, mode_):
         args.append(mp)
 
     def body(*refs):
-        # layout: 4 smem + 3 vmem inputs (+ mask), out, 3 scratch
+        # layout: 4 smem + 3 vmem inputs (+ mask), 3 outs, 3 scratch
         if masked:
-            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, mk_r, o_r, m_s, l_s, a_s) = refs
+            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, mk_r, o_r, ls_r, nr_r,
+             m_s, l_s, a_s) = refs
         else:
-            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, o_r, m_s, l_s, a_s) = refs
+            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, o_r, ls_r, nr_r,
+             m_s, l_s, a_s) = refs
             mk_r = None
-        _attn_body(c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, o_r, m_s, l_s, a_s,
-                   bk=bk, masked=masked, mask_ref=mk_r)
+        _attn_body(c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, o_r, ls_r, nr_r,
+                   m_s, l_s, a_s, bk=bk, masked=masked, mask_ref=mk_r)
 
-    out = pl.pallas_call(
+    row_spec = pl.BlockSpec((1, bq, 128), lambda ib, iq, ik: (ib, iq, 0),
+                            memory_space=pltpu.VMEM)
+    out, lse, nrm = pl.pallas_call(
         body,
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, bq, dp), lambda ib, iq, ik: (ib, iq, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, nq_p, dp), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, dp), lambda ib, iq, ik: (ib, iq, 0),
+                         memory_space=pltpu.VMEM),
+            row_spec,
+            row_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nq_p, dp), q.dtype),
+            jax.ShapeDtypeStruct((b, nq_p, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, nq_p, 128), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
@@ -185,7 +217,7 @@ def _launch(q, k, v, c, beta_b, tau_b, maskf, mode_):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=S.interpret_flag(mode_),
     )(*args)
-    return out[:, :nq, :d]
+    return out[:, :nq, :d], lse[:, :, 0], nrm[:, :, 0]
 
 
 def _scalar_per_batch(x, lead, dtype):
@@ -194,20 +226,331 @@ def _scalar_per_batch(x, lead, dtype):
     return jnp.broadcast_to(arr, lead + (1, 1))[..., 0, 0].reshape(-1)
 
 
-def _fwd_impl(q, k, v, c, beta, tau, maskf):
+# --- recomputing flash backward (module doc) ----------------------------------
+
+
+def _score_tile(c, beta, tau, q, k, nk, ik, bk, masked, mask_ref):
+    """Recompute one [bq, bk] score tile + validity (shared by both
+    backward kernels; identical math to the forward body)."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, k.shape, dimension=1)
+    k_flip = jnp.where(lane == 0, -k, k)
+    gram = S.dotT(q, k_flip)
+    sigma = (2.0 / c + 2.0 * gram + beta) / tau
+    col = jax.lax.broadcasted_iota(jnp.int32, sigma.shape, dimension=1) + ik * bk
+    valid = col < nk
+    if masked:
+        valid = jnp.logical_and(valid, mask_ref[0] > 0.0)
+    return sigma, valid, k_flip
+
+
+def _dq_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, dsp_ref,
+             lse_ref, di_ref, dq_ref, dsg_ref, dst_ref, dq_scr, part_scr,
+             *, bk: int, masked: bool, mask_ref=None):
+    ik = pl.program_id(2)
+    nk_blocks = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+        part_scr[0] = 0.0
+        part_scr[1] = 0.0
+
+    c = c_ref[0, 0]
+    beta = beta_ref[pl.program_id(0)]
+    tau = tau_ref[pl.program_id(0)]
+    nk = nk_ref[0, 0]
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    dsp = dsp_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, :1]
+    di = di_ref[0][:, :1]
+
+    sigma, valid, k_flip = _score_tile(c, beta, tau, q, k, nk, ik, bk,
+                                       masked, mask_ref)
+    p = jnp.where(valid, jnp.exp(sigma - lse), 0.0)
+    dv_dot = S.dotT(dsp, v)                       # ⟨dsp_i, v_j⟩, MXU
+    dsig = jnp.where(valid, p * (dv_dot - di), 0.0)
+    dq_scr[:] += (2.0 / tau) * jax.lax.dot_general(
+        dsig, k_flip, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST)
+    part_scr[0] += jnp.sum(dsig)
+    part_scr[1] += jnp.sum(jnp.where(valid, dsig * sigma, 0.0))
+
+    @pl.when(ik == nk_blocks - 1)
+    def _write():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+        dsg_ref[0, 0] = part_scr[0]
+        dst_ref[0, 0] = part_scr[1]
+
+
+def _dkv_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, dsp_ref,
+              lse_ref, di_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+              *, bk: int, masked: bool, mask_ref=None):
+    iq = pl.program_id(2)
+    nq_blocks = pl.num_programs(2)
+    ik = pl.program_id(1)          # KV block index is the OUTER grid dim
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    c = c_ref[0, 0]
+    beta = beta_ref[pl.program_id(0)]
+    tau = tau_ref[pl.program_id(0)]
+    nk = nk_ref[0, 0]
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    dsp = dsp_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, :1]
+    di = di_ref[0][:, :1]
+
+    sigma, valid, _ = _score_tile(c, beta, tau, q, k, nk, ik, bk,
+                                  masked, mask_ref)
+    p = jnp.where(valid, jnp.exp(sigma - lse), 0.0)
+    dv_scr[:] += jax.lax.dot_general(                 # pᵀ @ dsp
+        p, dsp, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST)
+    dv_dot = S.dotT(dsp, v)
+    dsig = jnp.where(valid, p * (dv_dot - di), 0.0)
+    lane_q = jax.lax.broadcasted_iota(jnp.int32, q.shape, dimension=1)
+    q_flip = jnp.where(lane_q == 0, -q, q)
+    dk_scr[:] += (2.0 / tau) * jax.lax.dot_general(   # dsigᵀ @ (J q)
+        dsig, q_flip, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST)
+
+    @pl.when(iq == nq_blocks - 1)
+    def _write():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_blocks(nq, nk, dp):
+    bq = min(S.round_up(nq, 8), 256)
+    bk = min(S.round_up(nk, 128), 512)
+    # q + k + v + dsp + dq/dkv scratch + lse/di + score tiles
+    while 4 * (6 * bq * dp + 4 * bk * dp + 3 * bq * bk) > S.VMEM_BUDGET and (
+            bq > 8 or bk > 128):
+        if bk > 128 and bk >= bq:
+            bk = max(128, (bk // 2) // 128 * 128)
+        else:
+            bq = max(8, (bq // 2) // 8 * 8)
+    return bq, bk
+
+
+def _bwd_launch(q, k, v, c, beta_b, tau_b, maskf, dsp, lse, di, mode_):
+    """Run both backward kernels; returns (dq, dk, dv, dsg [B], dst [B])."""
+    b, nq, d = q.shape
+    nk = k.shape[1]
+    dp = S.round_up(d, 128)
+    bq, bk = _bwd_blocks(nq, nk, dp)
+    pad3 = lambda a, rows: S.pad_axis(S.pad_axis(a, -1, 128), -2, rows)
+    qp, kp, vp = pad3(q, bq), pad3(k, bk), pad3(v, bk)
+    dspp = pad3(dsp, bq)
+    nq_p, nk_p = qp.shape[1], kp.shape[1]
+    lse_p = S.pad_axis(lse, -1, bq)[:, :nq_p]
+    di_p = S.pad_axis(di, -1, bq)[:, :nq_p]
+    # per-row scalars ride as [B, nq_p, 128] lanes (standard TPU layout)
+    lse_b = jnp.broadcast_to(lse_p[..., None], (b, nq_p, 128))
+    di_b = jnp.broadcast_to(di_p[..., None], (b, nq_p, 128))
+
+    smem = lambda idx: pl.BlockSpec((1, 1), idx, memory_space=pltpu.SMEM)
+    per_b = lambda: pl.BlockSpec((b,), lambda ib, i1, i2: (0,),
+                                 memory_space=pltpu.SMEM)
+    base_args = [S.c_smem(c), jnp.asarray(nk, jnp.int32).reshape(1, 1),
+                 beta_b.reshape(b), tau_b.reshape(b)]
+    masked = maskf is not None
+    mp = None
+    if masked:
+        mp = S.pad_axis(S.pad_axis(maskf.astype(jnp.float32), -1, bk), -2, bq)
+
+    # dq kernel: grid (B, Qb, KVb), KV inner
+    in_specs = [
+        smem(lambda ib, iq, ik: (0, 0)),
+        smem(lambda ib, iq, ik: (0, 0)),
+        per_b(),
+        per_b(),
+        pl.BlockSpec((1, bq, dp), lambda ib, iq, ik: (ib, iq, 0)),
+        pl.BlockSpec((1, bk, dp), lambda ib, iq, ik: (ib, ik, 0)),
+        pl.BlockSpec((1, bk, dp), lambda ib, iq, ik: (ib, ik, 0)),
+        pl.BlockSpec((1, bq, dp), lambda ib, iq, ik: (ib, iq, 0)),
+        pl.BlockSpec((1, bq, 128), lambda ib, iq, ik: (ib, iq, 0)),
+        pl.BlockSpec((1, bq, 128), lambda ib, iq, ik: (ib, iq, 0)),
+    ]
+    args = base_args + [qp, kp, vp, dspp, lse_b, di_b]
+    if masked:
+        in_specs.append(pl.BlockSpec((1, bq, bk),
+                                     lambda ib, iq, ik: (ib, iq, ik)))
+        args.append(mp)
+
+    def dq_kernel(*refs):
+        if masked:
+            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r, mk_r,
+             dq_r, sg_r, st_r, dq_s, pt_s) = refs
+        else:
+            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r,
+             dq_r, sg_r, st_r, dq_s, pt_s) = refs
+            mk_r = None
+        _dq_body(c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r,
+                 dq_r, sg_r, st_r, dq_s, pt_s, bk=bk, masked=masked,
+                 mask_ref=mk_r)
+
+    nqb, nkb = nq_p // bq, nk_p // bk
+    dq, dsg, dst = pl.pallas_call(
+        dq_kernel,
+        grid=(b, nqb, nkb),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, dp), lambda ib, iq, ik: (ib, iq, 0)),
+            pl.BlockSpec((1, 1), lambda ib, iq, ik: (ib, iq),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda ib, iq, ik: (ib, iq),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nq_p, dp), jnp.float32),
+            jax.ShapeDtypeStruct((b, nqb), jnp.float32),
+            jax.ShapeDtypeStruct((b, nqb), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, dp), jnp.float32),
+            pltpu.SMEM((2,), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=S.interpret_flag(mode_),
+    )(*args)
+
+    # dkv kernel: grid (B, KVb, Qb), Q inner
+    in_specs2 = [
+        smem(lambda ib, ik, iq: (0, 0)),
+        smem(lambda ib, ik, iq: (0, 0)),
+        per_b(),
+        per_b(),
+        pl.BlockSpec((1, bq, dp), lambda ib, ik, iq: (ib, iq, 0)),
+        pl.BlockSpec((1, bk, dp), lambda ib, ik, iq: (ib, ik, 0)),
+        pl.BlockSpec((1, bk, dp), lambda ib, ik, iq: (ib, ik, 0)),
+        pl.BlockSpec((1, bq, dp), lambda ib, ik, iq: (ib, iq, 0)),
+        pl.BlockSpec((1, bq, 128), lambda ib, ik, iq: (ib, iq, 0)),
+        pl.BlockSpec((1, bq, 128), lambda ib, ik, iq: (ib, iq, 0)),
+    ]
+    args2 = base_args + [qp, kp, vp, dspp, lse_b, di_b]
+    if masked:
+        in_specs2.append(pl.BlockSpec((1, bq, bk),
+                                      lambda ib, ik, iq: (ib, iq, ik)))
+        args2.append(mp)
+
+    def dkv_kernel(*refs):
+        if masked:
+            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r, mk_r,
+             dk_r, dv_r, dk_s, dv_s) = refs
+        else:
+            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r,
+             dk_r, dv_r, dk_s, dv_s) = refs
+            mk_r = None
+        _dkv_body(c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r,
+                  dk_r, dv_r, dk_s, dv_s, bk=bk, masked=masked,
+                  mask_ref=mk_r)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, nkb, nqb),
+        in_specs=in_specs2,
+        out_specs=[
+            pl.BlockSpec((1, bk, dp), lambda ib, ik, iq: (ib, ik, 0)),
+            pl.BlockSpec((1, bk, dp), lambda ib, ik, iq: (ib, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nk_p, dp), jnp.float32),
+            jax.ShapeDtypeStruct((b, nk_p, dp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, dp), jnp.float32),
+            pltpu.VMEM((bk, dp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=S.interpret_flag(mode_),
+    )(*args2)
+    return (dq[:, :nq, :d], dk[:, :nk, :d], dv[:, :nk, :d],
+            jnp.sum(dsg, axis=1), jnp.sum(dst, axis=1))
+
+
+def _epilogue_jax(s, c):
+    """Exact XLA mirror of the kernel epilogue (same clamps) — the
+    elementwise piece of the backward runs through its autodiff."""
+    lane0 = s[..., :1]
+    sp = jnp.sum(s[..., 1:] * s[..., 1:], axis=-1, keepdims=True) - lane0 * lane0
+    nrm = S.ksafe_sqrt(jnp.maximum(-sp, S.EPS_F32))
+    sc = jnp.maximum(S.ksafe_sqrt(jnp.asarray(c, jnp.float32)), S.MIN_NORM_F32)
+    return s / (sc * nrm)
+
+
+@jax.custom_vjp
+def _flash3(q3, k3, v3, c, beta_b, tau_b, maskf, mode_s):
+    out, _, _ = _launch(q3, k3, v3, c, beta_b, tau_b, maskf,
+                        "interpret" if mode_s.shape[0] else "pallas")
+    return out
+
+
+def _fa3_fwd(q3, k3, v3, c, beta_b, tau_b, maskf, mode_s):
+    mode_ = "interpret" if mode_s.shape[0] else "pallas"
+    out, lse, nrm = _launch(q3, k3, v3, c, beta_b, tau_b, maskf, mode_)
+    return out, (q3, k3, v3, c, beta_b, tau_b, maskf, out, lse, nrm, mode_s)
+
+
+def _fa3_bwd(res, g):
+    q3, k3, v3, c, beta_b, tau_b, maskf, out, lse, nrm, mode_s = res
+    mode_ = "interpret" if mode_s.shape[0] else "pallas"
+    nq = q3.shape[1]
+    c32 = jnp.asarray(c, jnp.float32)
+    sc = jnp.maximum(S.ksafe_sqrt(c32), S.MIN_NORM_F32)
+    s_pre = out.astype(jnp.float32) * (sc * nrm[:, :nq, None])
+    # elementwise Lorentz-normalize epilogue: XLA autodiff
+    _, epi_vjp = jax.vjp(_epilogue_jax, s_pre, c32)
+    dsp, dc_epi = epi_vjp(g.astype(jnp.float32))
+    di = jnp.sum(dsp * s_pre, axis=-1)                      # [B, nq]
+    dq, dk, dv, dsg, dst = _bwd_launch(q3, k3, v3, c, beta_b, tau_b, maskf,
+                                       dsp, lse, di, mode_)
+    dbeta = dsg / tau_b
+    dtau = -dst / tau_b
+    dc = (dc_epi + jnp.sum(dsg * (-2.0 / (c32 * c32 * tau_b)))).astype(
+        jnp.float32)
+    dmask = None if maskf is None else jnp.zeros_like(maskf)
+    return (dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype),
+            dc, dbeta, dtau, dmask, None)
+
+
+_flash3.defvjp(_fa3_fwd, _fa3_bwd)
+
+
+def flash_attention(q, k, v, c, *, beta=0.0, tau=1.0, mask=None):
+    """Hyperbolic flash attention (kernel N7); see module docstring.
+
+    q: [..., Nq, D], k/v: [..., Nk, D] hyperboloid points; beta/tau scalars
+    or [..., 1, 1]-shaped per-(batch, head) arrays; mask: bool/float
+    broadcastable to [..., Nq, Nk], truthy = attend.  Returns hyperboloid
+    points [..., Nq, D].  On the kernel path BOTH directions are flash
+    (forward online-softmax, recomputing backward); the XLA twin serves
+    CPU and per-position β/τ with plain autodiff.
+    """
+    maskf = None if mask is None else jax.lax.stop_gradient(
+        jnp.asarray(mask, jnp.float32))
     mode_ = S.mode()
-    if mode_ == "xla":
-        return _t_flash_attention(q, k, v, c, beta, tau, maskf)
-    lead = q.shape[:-2]
     bshape = jnp.shape(beta)
     tshape = jnp.shape(tau)
-    # per-position β/τ (trailing dims not all 1) → twin
-    if (bshape[-2:] not in ((), (1, 1)) and len(bshape) >= 2) or (
-            tshape[-2:] not in ((), (1, 1)) and len(tshape) >= 2):
+    per_pos = (bshape[-2:] not in ((), (1, 1)) and len(bshape) >= 2) or (
+        tshape[-2:] not in ((), (1, 1)) and len(tshape) >= 2)
+    if mode_ == "xla" or per_pos:
         return _t_flash_attention(q, k, v, c, beta, tau, maskf)
+    # 3-D reshape/broadcast happens OUTSIDE the custom_vjp boundary, so
+    # autodiff sums the k/v/β/τ cotangents over broadcast dims for free
+    lead = q.shape[:-2]
     bsz = 1
-    for s in lead:
-        bsz *= s
+    for s_ in lead:
+        bsz *= s_
     q3 = q.reshape((bsz,) + q.shape[-2:])
     k3 = jnp.broadcast_to(k, lead + k.shape[-2:]).reshape((bsz,) + k.shape[-2:])
     v3 = jnp.broadcast_to(v, lead + v.shape[-2:]).reshape((bsz,) + v.shape[-2:])
@@ -217,35 +560,8 @@ def _fwd_impl(q, k, v, c, beta, tau, maskf):
         maskf = jnp.broadcast_to(
             maskf, lead + (q.shape[-2], k.shape[-2])
         ).reshape((bsz,) + (q.shape[-2], k.shape[-2]))
-    out = _launch(q3, k3, v3, c, beta_b, tau_b, maskf, mode_)
+    # static mode flag rides as an empty/1-element dummy int array (shape
+    # is static under jit — and int dtype means a None cotangent is valid)
+    mode_s = jnp.zeros((1 if mode_ == "interpret" else 0,), jnp.int32)
+    out = _flash3(q3, k3, v3, c, beta_b, tau_b, maskf, mode_s)
     return out.reshape(lead + out.shape[-2:])
-
-
-@jax.custom_vjp
-def _flash_attention_vjp(q, k, v, c, beta, tau, maskf):
-    return _fwd_impl(q, k, v, c, beta, tau, maskf)
-
-
-def _fa_fwd(q, k, v, c, beta, tau, maskf):
-    return _fwd_impl(q, k, v, c, beta, tau, maskf), (q, k, v, c, beta, tau, maskf)
-
-
-def _fa_bwd(res, g):
-    _, vjp = jax.vjp(_t_flash_attention, *res)
-    return vjp(g)
-
-
-_flash_attention_vjp.defvjp(_fa_fwd, _fa_bwd)
-
-
-def flash_attention(q, k, v, c, *, beta=0.0, tau=1.0, mask=None):
-    """Hyperbolic flash attention (kernel N7); see module docstring.
-
-    q: [..., Nq, D], k/v: [..., Nk, D] hyperboloid points; beta/tau scalars
-    or [..., 1, 1]-shaped per-(batch, head) arrays; mask: bool/float
-    broadcastable to [..., Nq, Nk], truthy = attend.  Returns hyperboloid
-    points [..., Nq, D].
-    """
-    maskf = None if mask is None else jax.lax.stop_gradient(
-        jnp.asarray(mask, jnp.float32))
-    return _flash_attention_vjp(q, k, v, c, beta, tau, maskf)

@@ -58,13 +58,17 @@ class ClusterPlan(NamedTuple):
 
     Items are receiver-block-major; ``first`` marks each rb's first item
     (the kernel zeroes the output tile there).  Every receiver block gets
-    at least one item even if it owns no clustered edge.
+    at least one item even if it owns no clustered edge.  ``first_chunk``
+    marks the first item touching each edge CHUNK — the edge-aligned
+    output of :func:`cluster_sddmm` zeroes its chunk block there (a
+    boundary chunk is visited by two pairs and must accumulate).
     """
 
     rb: np.ndarray     # [T] item -> receiver-block index
     sb: np.ndarray     # [T] item -> sender-block index
     chunk: np.ndarray  # [T] item -> edge-chunk index
     first: np.ndarray  # [T] 1 iff first item of its receiver block
+    first_chunk: np.ndarray  # [T] 1 iff first item of its edge chunk
 
 
 def build_cluster_plan(
@@ -119,7 +123,10 @@ def build_cluster_plan(
     chunk_items = chunk_items[order].astype(np.int32)
     first = np.zeros(len(rb_items), np.int32)
     first[np.flatnonzero(np.r_[True, rb_items[1:] != rb_items[:-1]])] = 1
-    return ClusterPlan(rb_items, sb_items, chunk_items, first)
+    first_chunk = np.zeros(len(chunk_items), np.int32)
+    _, idx0 = np.unique(chunk_items, return_index=True)
+    first_chunk[idx0] = 1
+    return ClusterPlan(rb_items, sb_items, chunk_items, first, first_chunk)
 
 
 def _body(bn: int, bs: int, fast_bf16: bool):
@@ -216,8 +223,113 @@ def cluster_aggregate(
         out_shape=jax.ShapeDtypeStruct((S.round_up(n_pad, bn), fp),
                                        jnp.float32),
         interpret=S.interpret_flag(m),
-    )(*tuple(plan), r2d, s2d, w2d, h_p)
+    )(*tuple(plan)[:4], r2d, s2d, w2d, h_p)
     return out[:num_nodes, :f].astype(h.dtype)
+
+
+# --- cluster SDDMM: per-edge <g[r], h[s]> without [E, F] gathers --------------
+
+
+def _sddmm_body(bn: int, bs: int, fast_bf16: bool):
+    prec = None if fast_bf16 else jax.lax.Precision.HIGHEST
+    dt = jnp.bfloat16 if fast_bf16 else jnp.float32
+
+    def body(rb_ref, sb_ref, chk_ref, firstc_ref, r_ref, s_ref,
+             g_ref, h_ref, o_ref):
+        t = pl.program_id(0)
+        rb = rb_ref[t]
+        sb = sb_ref[t]
+
+        @pl.when(firstc_ref[t] == 1)
+        def _():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        r = r_ref[0]                    # [bk//128, 128] int32 (global)
+        s = s_ref[0]
+        g_t = g_ref[:].astype(dt)       # [bn, F]
+        h_t = h_ref[:].astype(dt)       # [bs, F]
+        rows_r = jax.lax.broadcasted_iota(jnp.int32, (128, bn), 1)
+        rows_s = jax.lax.broadcasted_iota(jnp.int32, (128, bs), 1)
+        for j in range(r.shape[0]):
+            lr = r[j] - rb * bn          # [128]; out-of-range rows -> all-0
+            ls = s[j] - sb * bs
+            a_oh = (rows_r == lr[:, None]).astype(dt)        # [128, bn]
+            b_oh = (rows_s == ls[:, None]).astype(dt)        # [128, bs]
+            ge = jnp.dot(a_oh, g_t, preferred_element_type=jnp.float32,
+                         precision=prec)                     # [128, F]
+            he = jnp.dot(b_oh, h_t, preferred_element_type=jnp.float32,
+                         precision=prec)
+            o_ref[0, j, :] += jnp.sum(ge * he, axis=-1)
+
+    return body
+
+
+def cluster_sddmm(
+    g: jax.Array,          # [N, F] cotangent rows (receiver side)
+    h: jax.Array,          # [N, F] node values (sender side)
+    receivers: jax.Array,  # [E] int32 global, sorted by (rb, sb)
+    senders: jax.Array,    # [E] int32 global, aligned
+    plan: tuple,           # ClusterPlan device arrays (uses first_chunk)
+    num_nodes: int,
+    bn: int = _BN,
+    bs: int = _BS,
+    bk: int = _BK,
+) -> jax.Array:
+    """Sampled dense-dense matmul on the cluster layout:
+    ``out[e] = <g[receivers_e], h[senders_e]>`` — the attention dw
+    backward — computed per (rb, sb) pair from VMEM-resident tiles (two
+    one-hot MXU matmuls + a row reduce per 128-edge sub-chunk) instead of
+    two [E, F] HBM gathers.  Output is edge-aligned, padded to a ``bk``
+    multiple (padding lanes read 0).  Twin/oracle: the gathered row dot.
+
+    An edge appears in exactly one (rb, sb) pair; a visiting pair that
+    does not own a lane's edge contributes 0 there (its one-hot row is
+    empty), so boundary-chunk accumulation across consecutive pairs is
+    exact.  bf16 inputs take the fast MXU mode: each one-hot matmul is a
+    pure row pick (single-term sums, exact in bf16) and the dot-product
+    reduce accumulates f32.
+    """
+    m = S.mode()
+    e = receivers.shape[0]
+    e_pad = S.round_up(max(e, 1), bk)
+    if m == "xla" or e == 0:
+        if e == 0:
+            return jnp.zeros((e_pad,), jnp.float32)
+        acc = jnp.sum(g[receivers].astype(jnp.float32)
+                      * h[senders].astype(jnp.float32), axis=-1)
+        return jnp.pad(acc, (0, e_pad - e))
+    f = h.shape[-1]
+    fp = S.round_up(f, 128)
+    n_pad = S.round_up(num_nodes, max(bn, bs))
+    g_p = S.pad_axis(S.pad_axis(g, -1, 128), 0, max(bn, bs))
+    h_p = S.pad_axis(S.pad_axis(h, -1, 128), 0, max(bn, bs))
+    pad_ids = lambda a: jnp.pad(a, (0, e_pad - e), constant_values=n_pad)
+    r2d = pad_ids(receivers).reshape(e_pad // bk, bk // 128, 128)
+    s2d = pad_ids(senders).reshape(e_pad // bk, bk // 128, 128)
+    t = plan[0].shape[0]
+    fast_bf16 = (h.dtype == jnp.bfloat16 and g.dtype == jnp.bfloat16)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, bk // 128, 128),
+                         lambda t, rb, sb, chk, fc: (chk[t], 0, 0)),
+            pl.BlockSpec((1, bk // 128, 128),
+                         lambda t, rb, sb, chk, fc: (chk[t], 0, 0)),
+            pl.BlockSpec((bn, fp), lambda t, rb, sb, chk, fc: (rb[t], 0)),
+            pl.BlockSpec((bs, fp), lambda t, rb, sb, chk, fc: (sb[t], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bk // 128, 128),
+                               lambda t, rb, sb, chk, fc: (chk[t], 0, 0)),
+    )
+    out = pl.pallas_call(
+        _sddmm_body(bn, bs, fast_bf16),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((e_pad // bk, bk // 128, 128),
+                                       jnp.float32),
+        interpret=S.interpret_flag(m),
+    )(plan[0], plan[1], plan[2], plan[4], r2d, s2d, g_p, h_p)
+    return out.reshape(e_pad)
 
 
 # --- host-side split: clustered pairs vs stragglers ---------------------------
@@ -232,6 +344,16 @@ class ClusterSplit(NamedTuple):
     edge and of its reverse (1/deg of the opposite endpoint) — the
     involution backward needs no index lookup (same trick as
     parallel/node_shard.py).
+
+    The ``*_map`` fields route RUNTIME per-edge weights (attention) from
+    the prepare layout into the two split layouts without a scatter:
+    ``w_c = w[c_map]`` etc.  ``c_map_rev = rev_perm[c_map]`` so the
+    involution backward's reversed weights are one more static gather.
+    ``inv_map`` goes the other way — ``dw[e] =
+    concat(dw_c_pad, dw_s, [0])[inv_map[e]]`` reconstitutes a prepare-
+    layout per-edge gradient from the two split-layout pieces with a
+    gather instead of a scatter.  All maps are None when the split was
+    built without ``rev_perm`` (weighted aggregation then unsupported).
     """
 
     c_recv: np.ndarray   # [Ec] clustered receivers, (rb, sb)-sorted
@@ -245,6 +367,12 @@ class ClusterSplit(NamedTuple):
     s_wb: np.ndarray
     s_plan: tuple        # block-CSR plan for the straggler receivers
     frac_clustered: float
+    c_map: np.ndarray | None = None      # [Ec] prepare-layout edge index
+    c_map_rev: np.ndarray | None = None  # [Ec] index of the reverse edge
+    s_map: np.ndarray | None = None      # [Es] (padding entries -> 0)
+    s_map_rev: np.ndarray | None = None  # [Es]
+    s_valid: np.ndarray | None = None    # [Es] f32 1 on real stragglers
+    inv_map: np.ndarray | None = None    # [E] -> slot in the dw concat
 
 
 def build_cluster_split(
@@ -257,10 +385,12 @@ def build_cluster_split(
     bs: int = _BS,
     bk: int = _BK,
     min_pair_edges: int = 256,
+    rev_perm: np.ndarray | None = None,
 ) -> ClusterSplit:
     from hyperspace_tpu.kernels.segment import build_csr_plan
 
     mask = np.asarray(edge_mask)
+    pos = np.flatnonzero(mask)              # prepare-layout index per edge
     r = np.asarray(receivers)[mask]
     s = np.asarray(senders)[mask]
     d = np.maximum(np.asarray(deg), 1.0).astype(np.float32)
@@ -289,6 +419,27 @@ def build_cluster_split(
     s_wf[: len(s_recv)] = 1.0 / d[s_recv]
     s_wb[: len(s_recv)] = 1.0 / d[s_send]
     s_plan = tuple(build_csr_plan(s_recv_p, num_nodes, bn=128, bk=bk))
+
+    # weighted-aggregation routing maps (module doc); need rev_perm so
+    # the backward can gather the reverse edge's weight statically
+    maps: dict = {}
+    if rev_perm is not None:
+        rp = np.asarray(rev_perm)
+        c_map = pos[c_idx].astype(np.int32)
+        s_map = np.zeros(e_s, np.int32)
+        s_map[: len(s_idx)] = pos[s_idx]
+        s_valid = np.zeros(e_s, np.float32)
+        s_valid[: len(s_idx)] = 1.0
+        ec_pad = S.round_up(max(len(c_map), 1), bk)  # kernel output size
+        inv_map = np.full(len(mask), ec_pad + e_s, np.int32)  # zero slot
+        inv_map[pos[c_idx]] = np.arange(len(c_idx), dtype=np.int32)
+        inv_map[pos[s_idx]] = ec_pad + np.arange(len(s_idx), dtype=np.int32)
+        maps = dict(
+            c_map=c_map, c_map_rev=rp[c_map].astype(np.int32),
+            s_map=s_map, s_map_rev=rp[s_map].astype(np.int32) * (
+                s_valid > 0),  # padding rows point at edge 0, masked out
+            s_valid=s_valid, inv_map=inv_map)
+
     return ClusterSplit(
         c_recv=c_recv.astype(np.int32), c_send=c_send.astype(np.int32),
         c_wf=(1.0 / d[c_recv]), c_wb=(1.0 / d[c_send]),
@@ -296,4 +447,5 @@ def build_cluster_split(
         s_recv=s_recv_p, s_send=s_send_p, s_wf=s_wf, s_wb=s_wb,
         s_plan=s_plan,
         frac_clustered=float(len(c_recv)) / max(len(r), 1),
+        **maps,
     )

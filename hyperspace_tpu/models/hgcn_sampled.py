@@ -268,24 +268,48 @@ def _mix64(x: int) -> int:
 def _build_pyramid(cfg: SampledConfig, indptr, indices, seeds, seed: int):
     """Fanout levels over per-step seed rows ([S, B] → [S, B, f1], ...).
 
-    The ONE sampler-driving loop both planners share (same per-(step,
-    level) seed derivation — NC and LP pyramids must never diverge).
-    The per-call seed is splitmix64-hashed before it drives the sampler:
-    the sampler itself computes ``splitmix64(seed ^ cell)``, so raw
-    small-integer call seeds would make different calls' RNG streams
-    XOR-shifted permutations of each other (weakly correlated draws
-    across steps/levels); hashing first decorrelates the streams."""
+    The ONE sampler-driving loop both planners share (same per-level
+    seed derivation — NC and LP pyramids must never diverge).  ONE
+    native-sampler call per level over all steps' seeds flattened — the
+    per-(step, level) python loop was the planner's bottleneck, and the
+    overlap pipeline (:class:`SampledBatchStream`) needs planning far
+    cheaper than the device step.  The per-call seed is splitmix64-
+    hashed first: the sampler computes ``splitmix64(seed ^ cell)``, so
+    raw small-integer call seeds would correlate calls' RNG streams
+    (ADVICE r3); within a call every (step, row, draw) is a distinct
+    cell, so one call per level is at least as decorrelated as the old
+    per-step calls."""
     levels = [seeds]
-    steps = seeds.shape[0]
     for li, f in enumerate(cfg.fanouts):
         prev = levels[-1]
-        nxt = np.stack([
-            _sample(indptr, indices, prev[s].ravel(), f,
-                    seed=_mix64(seed * 1_000_003 + s * 97 + li))
-            for s in range(steps)
-        ]).reshape(prev.shape + (f,))
-        levels.append(nxt)
+        nxt = _sample(indptr, indices, prev.ravel(), f,
+                      seed=_mix64(seed * 1_000_003 + li))
+        levels.append(nxt.reshape(prev.shape + (f,)))
     return levels
+
+
+def _plan_nc_chunk(cfg: SampledConfig, indptr, indices, train_nodes,
+                   labels, steps: int, chunk_seed: int):
+    """Numpy core of one NC chunk: (levels, labels) for ``steps`` steps."""
+    rng = np.random.default_rng(chunk_seed)
+    seeds = rng.choice(train_nodes,
+                       size=(steps, cfg.batch_size)).astype(np.int32)
+    levels = _build_pyramid(cfg, indptr, indices, seeds, chunk_seed)
+    return levels, np.asarray(labels, np.int32)[seeds]
+
+
+def _plan_lp_chunk(cfg: SampledConfig, indptr, indices, train_pos,
+                   num_nodes: int, steps: int, chunk_seed: int):
+    """Numpy core of one LP chunk: (levels, None)."""
+    rng = np.random.default_rng(chunk_seed)
+    p = cfg.batch_size
+    rows = rng.integers(0, len(train_pos), (steps, p))
+    pos = train_pos[rows]                                    # [S, P, 2]
+    neg = rng.integers(0, num_nodes, (steps, p, 2))
+    seeds = np.concatenate(
+        [pos[..., 0], pos[..., 1], neg[..., 0], neg[..., 1]],
+        axis=1).astype(np.int32)                             # [S, 4P]
+    return _build_pyramid(cfg, indptr, indices, seeds, chunk_seed), None
 
 
 def plan_batches(cfg: SampledConfig, edges: np.ndarray, labels: np.ndarray,
@@ -296,13 +320,10 @@ def plan_batches(cfg: SampledConfig, edges: np.ndarray, labels: np.ndarray,
     Returns the device-resident batches and the ``[N]`` true-degree
     array the steps gather their estimator weights from."""
     indptr, indices = build_adjacency(edges, num_nodes)
-    rng = np.random.default_rng(seed)
     train_nodes = np.flatnonzero(np.asarray(train_mask))
-    b = cfg.batch_size
-    seeds = rng.choice(train_nodes, size=(steps, b)).astype(np.int32)
-    levels = _build_pyramid(cfg, indptr, indices, seeds, seed)
+    levels, lab = _plan_nc_chunk(cfg, indptr, indices, train_nodes, labels,
+                                 steps, seed)
     deg = (indptr[1:] - indptr[:-1]).astype(np.float32)
-    lab = np.asarray(labels, np.int32)[seeds]
     return (SampledBatches(tuple(jnp.asarray(l) for l in levels),
                            jnp.asarray(lab)),
             jnp.asarray(deg))
@@ -322,19 +343,118 @@ def plan_lp_batches(cfg: SampledConfig, train_pos: np.ndarray,
     train edges — held-out val/test edges must never leak into the
     neighborhood aggregation."""
     indptr, indices = build_adjacency(np.asarray(train_pos), num_nodes)
-    rng = np.random.default_rng(seed)
-    train_pos = np.asarray(train_pos)
-    p = cfg.batch_size
-    rows = rng.integers(0, len(train_pos), (steps, p))
-    pos = train_pos[rows]                                    # [S, P, 2]
-    neg = rng.integers(0, num_nodes, (steps, p, 2))
-    seeds = np.concatenate(
-        [pos[..., 0], pos[..., 1], neg[..., 0], neg[..., 1]],
-        axis=1).astype(np.int32)                             # [S, 4P]
-    levels = _build_pyramid(cfg, indptr, indices, seeds, seed)
+    levels, _ = _plan_lp_chunk(cfg, indptr, indices, np.asarray(train_pos),
+                               num_nodes, steps, seed)
     deg = (indptr[1:] - indptr[:-1]).astype(np.float32)
     return (SampledBatches(tuple(jnp.asarray(l) for l in levels), None),
             jnp.asarray(deg))
+
+
+class SampledBatchStream:
+    """Background-planned, double-buffered minibatch pyramids.
+
+    VERDICT r3 #5: the r03 trainer pre-planned ``plan_steps`` pyramids
+    once and recycled them modulo on long runs.  This stream plans a
+    FRESH chunk of ``chunk_steps`` pyramids in a background thread while
+    the device trains on the current one, transfers it (``device_put``
+    happens in the worker, so the host→device copy overlaps training
+    too) and hands it over through a bounded queue (``depth`` chunks of
+    look-ahead; the put blocks when full, bounding host memory).  Every
+    chunk uses a splitmix64-derived seed, so a run of any length never
+    sees a repeated batch.  ``plan_steps`` keeps its r03 meaning as the
+    device-resident footprint cap — it is now the chunk size, not the
+    total variety.
+
+    The planner cores are the SAME functions the one-shot planners use
+    (`_plan_nc_chunk` / `_plan_lp_chunk`); only the per-chunk seed
+    derivation differs (splitmix64 of (seed, chunk index)).
+    """
+
+    def __init__(self, cfg: SampledConfig, task: str, *, num_nodes: int,
+                 edges=None, labels=None, train_mask=None, train_pos=None,
+                 chunk_steps: int = 64, depth: int = 2, seed: int = 0):
+        import queue
+        import threading
+
+        self.cfg = cfg
+        self.task = task
+        self.chunk_steps = int(chunk_steps)
+        self._seed = int(seed)
+        self._num_nodes = int(num_nodes)
+        if task == "nc":
+            self._indptr, self._indices = build_adjacency(edges, num_nodes)
+            self._train_nodes = np.flatnonzero(np.asarray(train_mask))
+            self._labels = np.asarray(labels, np.int32)
+        elif task == "lp":
+            self._train_pos = np.asarray(train_pos)
+            self._indptr, self._indices = build_adjacency(self._train_pos,
+                                                          num_nodes)
+        else:
+            raise ValueError(f"unknown task {task!r}")
+        self.deg = jnp.asarray(
+            (self._indptr[1:] - self._indptr[:-1]).astype(np.float32))
+        self._q: Any = queue.Queue(maxsize=int(depth))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _plan(self, chunk: int):
+        cs = _mix64((self._seed << 20) ^ chunk)
+        if self.task == "nc":
+            return _plan_nc_chunk(self.cfg, self._indptr, self._indices,
+                                  self._train_nodes, self._labels,
+                                  self.chunk_steps, cs)
+        return _plan_lp_chunk(self.cfg, self._indptr, self._indices,
+                              self._train_pos, self._num_nodes,
+                              self.chunk_steps, cs)
+
+    def _worker(self):
+        import queue
+
+        chunk = 0
+        while not self._stop.is_set():
+            try:
+                levels, lab = self._plan(chunk)
+                item = SampledBatches(
+                    tuple(jax.device_put(l) for l in levels),
+                    None if lab is None else jax.device_put(lab))
+            except BaseException as e:  # noqa: BLE001 — re-raised in next()
+                item = e
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+            if isinstance(item, BaseException):
+                return  # consumer re-raises; a dead silent thread would
+            chunk += 1  # make next() block forever instead
+
+    def next(self) -> SampledBatches:
+        """Block until the next fresh chunk of pyramids is ready.
+
+        Re-raises any exception the planner thread hit (the run fails
+        with the real traceback instead of hanging on an empty queue).
+        """
+        item = self._q.get()
+        if isinstance(item, BaseException):
+            raise RuntimeError("SampledBatchStream planner failed") from item
+        return item
+
+    def close(self):
+        self._stop.set()
+        while not self._q.empty():  # unblock a worker stuck on put
+            try:
+                self._q.get_nowait()
+            except Exception:
+                break
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 # --- training ----------------------------------------------------------------

@@ -36,9 +36,6 @@ from hyperspace_tpu.nn.scatter import sym_segment_aggregate
 # --- segment ops (shared with any graph aggregation) --------------------------
 
 
-from hyperspace_tpu.kernels.segment import NEG_FILL as _NEG
-
-
 def segment_softmax(
     logits: jax.Array,
     segment_ids: jax.Array,
@@ -63,6 +60,34 @@ def segment_softmax(
     denom = jax.ops.segment_sum(ex, segment_ids, num_segments,
                                 indices_are_sorted=indices_are_sorted)
     return ex / jnp.maximum(denom[segment_ids], 1e-15)
+
+
+# --- attention logits ---------------------------------------------------------
+
+
+ATT_LOGIT_BOUND = 30.0
+
+
+def bounded_att_logits(pre: jax.Array, negative_slope: float = 0.2):
+    """leaky_relu + smooth ±30 squash: the TPU-first softmax precondition.
+
+    The textbook segment softmax needs a per-receiver max shift for exp
+    safety — on the padded-edge-list layout that costs a CSR max pass,
+    an [E] gather of the maxima, and their backward bookkeeping, every
+    layer (measured 0.083 s/layer fwd+bwd at arxiv scale, the single
+    biggest attention overhead — docs/benchmarks.md r04).  Squashing the
+    logits through ``B·tanh(·/B)`` with B=30 bounds them so ``exp`` is
+    exact-range-safe in f32 AND bf16 by construction (e^±30 ≈ 1e±13),
+    deleting the max machinery: the whole weight computation becomes one
+    XLA-fused elementwise pass.  Unlike a hard clip the squash keeps a
+    nonzero gradient everywhere (1 − tanh² ≈ 1 for |x| < 10; real logits
+    live well inside that), and it doubles as a logit-explosion guard —
+    the r03 attention collapse study motivated exactly this kind of
+    bounding.  All attention paths (planned, fallback, node-sharded)
+    share this helper so their outputs stay equivalence-testable.
+    """
+    lm = nn.leaky_relu(pre, negative_slope)
+    return ATT_LOGIT_BOUND * jnp.tanh(lm / ATT_LOGIT_BOUND)
 
 
 # --- tangent coordinate helpers ----------------------------------------------
@@ -194,42 +219,51 @@ class HGCConv(nn.Module):
             a_r = self.param("att_dst", self.kernel_init, (self.features, 1), h.dtype)
             alpha_s = (h @ a_s)[:, 0]
             alpha_r = (h @ a_r)[:, 0]
-            if sorted_fast and g.plan is not None:
-                # planned path: logit gathers get planned-scatter VJPs,
-                # segment max/sum run in the CSR scalar kernel, and the
-                # softmax *denominator folds into a per-node divide after
-                # aggregation* — the per-edge normalized weights are never
-                # materialized and no serialized XLA scatter runs anywhere.
-                # (Row gathers cost ~28 ms per 2.4 M edges on v5e
-                # regardless of width, so each avoided [E]-gather counts.)
+            use_cluster_att = (sorted_fast and g.plan is not None
+                               and g.cluster is not None
+                               and g.cluster.weighted_ok)
+            if sorted_fast and g.plan is not None and not use_cluster_att:
+                # fused planned path (nn/scatter.att_aggregate_planned):
+                # the sender pick rides the message gather as an extra
+                # feature column (ONE random [E] gather/layer), bounded-
+                # logit softmax needs no max pass, num/den are one CSR
+                # pass each, and the backward re-uses saved residual rows
+                # instead of re-gathering.  (Row gathers cost ~28 ms per
+                # 2.4 M edges on v5e regardless of width — pass count is
+                # the whole game.)
+                from hyperspace_tpu.nn.scatter import att_aggregate_planned
+
+                agg = att_aggregate_planned(
+                    h, alpha_s, alpha_r, senders, receivers, g.rev_perm,
+                    edge_mask, g.plan, n, self.agg_dtype, 0.2)
+                out = from_tangent0_coords(
+                    m_out, self.activation(agg.astype(h.dtype)))
+                return out, m_out
+            if use_cluster_att:
+                # well-clustered graphs: per-edge weights through the
+                # cluster-pair kernel instead (planned picks feed the
+                # logits; the dw backward is the cluster SDDMM)
                 from hyperspace_tpu.nn.scatter import (
                     pick_receivers,
                     pick_senders,
-                    planned_segment_max_1d,
                     planned_segment_sum_1d,
                 )
 
                 pb_, pc_, pf_ = g.plan
-                logits = nn.leaky_relu(
+                lm = bounded_att_logits(
                     pick_senders(alpha_s, senders, receivers, g.rev_perm,
                                  pb_, pc_, pf_, n)
-                    + pick_receivers(alpha_r, receivers, pb_, pc_, pf_, n),
-                    0.2)
-                maskf = jax.lax.stop_gradient(
-                    edge_mask.astype(logits.dtype))
-                lm = jnp.where(maskf > 0, logits, _NEG)
-                seg_max = planned_segment_max_1d(lm, receivers,
-                                                 pb_, pc_, pf_, n)
-                seg_max = jnp.where(seg_max > 0.5 * _NEG, seg_max, 0.0)
-                # out = (Σ ex·h) / (Σ ex): invariant to the (stopped) max
-                # shift, so autodiff through ex gives the exact softmax grad.
-                # The denominator is summed *after* the agg_dtype cast below
-                # so numerator and denominator see identically-rounded weights
-                w = jnp.exp(lm - seg_max[receivers]) * maskf
+                    + pick_receivers(alpha_r, receivers, pb_, pc_, pf_, n))
+                maskf = jax.lax.stop_gradient(edge_mask.astype(lm.dtype))
+                # masked lanes: exp(lm) ≤ e^30 is finite, the mask zeroes
+                # them — no -inf fill needed.  The denominator is summed
+                # *after* the agg_dtype cast below so numerator and
+                # denominator see identically-rounded weights.
+                w = jnp.exp(lm) * maskf
                 den_planned = True
             else:
-                logits = nn.leaky_relu(
-                    alpha_s[senders] + alpha_r[receivers], 0.2)
+                logits = bounded_att_logits(
+                    alpha_s[senders] + alpha_r[receivers])
                 w = segment_softmax(logits, receivers, n, mask=edge_mask,
                                     indices_are_sorted=sorted_fast)
                 att_den = None
@@ -258,9 +292,17 @@ class HGCConv(nn.Module):
             att_den = None
         h_in = h if self.agg_dtype is None else h.astype(self.agg_dtype)
         w_in = w if self.agg_dtype is None else w.astype(self.agg_dtype)
-        if den_planned:  # the CSR scalar kernel accumulates f32
+        if den_planned:
+            # attention numerator through the cluster-pair kernel
+            # (runtime weights routed by the static maps; the dw backward
+            # is the cluster SDDMM) — the same [E, F]-round-trip kill the
+            # mean path gets, applied to the quality-frontier arm.  The
+            # denominator runs in the CSR scalar kernel (f32 accumulate).
+            from hyperspace_tpu.nn.scatter import cluster_att_aggregate
+
             att_den = planned_segment_sum_1d(w_in, receivers, pb_, pc_, pf_, n)
-        if sorted_fast:
+            agg = cluster_att_aggregate(h_in, w_in, g.cluster, n)
+        elif sorted_fast:
             # receiver-sorted scatter in forward AND backward (nn/scatter.py)
             pb, pc, pf = g.plan if g.plan is not None else (None, None, None)
             agg = sym_segment_aggregate(h_in, w_in, senders, receivers,

@@ -207,36 +207,71 @@ class ClusterAgg:
     """Device arrays of a host `kernels.cluster.build_cluster_split`.
 
     Registered as a pytree so it can ride inside DeviceGraph.  Static
-    plan shapes are leaves (int32 arrays), nothing auxiliary.
+    plan shapes are leaves (int32 arrays), nothing auxiliary.  The
+    optional weight-routing maps (attention; see ClusterSplit doc) are
+    None when the split was built without ``rev_perm``.
     """
 
+    # gate for the weighted (attention) cluster path.  Measured r04:
+    # at 8% clustered it is a net loss (0.51 vs 0.50 s att step) AND at
+    # 39% it is still a wash (0.500 vs 0.489) — the weight-routing
+    # gathers + SDDMM + two-path overhead add [E]-passes, and pass count
+    # is what the attention step pays for (28 ms/2.4 M-row gather,
+    # width-independent).  The fused att_aggregate_planned beats both,
+    # so the gate sits above any realistic fraction until the logits
+    # move INSIDE the cluster kernel tiles (future work: alpha tiles are
+    # block-resident, so the pick could be a one-hot matmul there).
+    # The mean path has no such extra machinery and stays on the cluster
+    # kernel at any fraction (its own threshold sweep, r03).
+    WEIGHTED_MIN_FRAC = 0.95
+
     def __init__(self, c_recv, c_send, c_wf, c_wb, c_plan,
-                 s_recv, s_send, s_wf, s_wb, s_plan):
+                 s_recv, s_send, s_wf, s_wb, s_plan,
+                 c_map=None, c_map_rev=None, s_map=None, s_map_rev=None,
+                 s_valid=None, inv_map=None, use_weighted: bool = False):
         self.c_recv, self.c_send = c_recv, c_send
         self.c_wf, self.c_wb = c_wf, c_wb
         self.c_plan = c_plan
         self.s_recv, self.s_send = s_recv, s_send
         self.s_wf, self.s_wb = s_wf, s_wb
         self.s_plan = s_plan
+        self.c_map, self.c_map_rev = c_map, c_map_rev
+        self.s_map, self.s_map_rev = s_map, s_map_rev
+        self.s_valid, self.inv_map = s_valid, inv_map
+        self.use_weighted = bool(use_weighted)
+
+    @property
+    def weighted_ok(self) -> bool:
+        """Whether attention should take the weighted cluster path: maps
+        present AND the clustered fraction clears WEIGHTED_MIN_FRAC
+        (decided host-side at to_device time — static under jit)."""
+        return self.c_map is not None and self.use_weighted
 
     def tree_flatten(self):
         return ((self.c_recv, self.c_send, self.c_wf, self.c_wb,
                  tuple(self.c_plan), self.s_recv, self.s_send, self.s_wf,
-                 self.s_wb, tuple(self.s_plan)), None)
+                 self.s_wb, tuple(self.s_plan), self.c_map, self.c_map_rev,
+                 self.s_map, self.s_map_rev, self.s_valid, self.inv_map),
+                (self.use_weighted,))
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        return cls(*leaves)
+        return cls(*leaves, use_weighted=aux[0])
 
     @classmethod
     def from_host(cls, split):
         import jax.numpy as jnp
 
-        dev = lambda a: jnp.asarray(a)
+        dev = lambda a: None if a is None else jnp.asarray(a)
         return cls(dev(split.c_recv), dev(split.c_send), dev(split.c_wf),
                    dev(split.c_wb), tuple(dev(a) for a in split.c_plan),
                    dev(split.s_recv), dev(split.s_send), dev(split.s_wf),
-                   dev(split.s_wb), tuple(dev(a) for a in split.s_plan))
+                   dev(split.s_wb), tuple(dev(a) for a in split.s_plan),
+                   dev(split.c_map), dev(split.c_map_rev), dev(split.s_map),
+                   dev(split.s_map_rev), dev(split.s_valid),
+                   dev(split.inv_map),
+                   use_weighted=(split.frac_clustered
+                                 >= cls.WEIGHTED_MIN_FRAC))
 
 
 jax.tree_util.register_pytree_node(
@@ -280,3 +315,169 @@ def _ca_bwd(num_segments, agg, g):
 
 
 cluster_sym_aggregate.defvjp(_ca_fwd, _ca_bwd)
+
+
+# --- fused planned attention aggregation --------------------------------------
+#
+# The attention layer's cost on TPU is dominated by the NUMBER of
+# [E]-length passes, not bytes: a 2.4 M-row gather costs ~28 ms on v5e
+# regardless of width (latency-bound).  This op fuses the whole
+# softmax-aggregate pipeline around ONE random edge gather:
+#
+# - forward: alpha_s rides as an extra feature column of h, so the
+#   sender pick and the message gather are a single [E, F+1] gather;
+#   logits/exp are one fused elementwise pass (bounded-logit softmax —
+#   no max machinery, see nn.gcn.bounded_att_logits); numerator and
+#   denominator are one block-CSR pass each; the division folds in.
+# - backward: the gathered sender rows are SAVED as residuals (a
+#   sequential [E, F] write+read ≈ 1.6 ms vs a 28 ms random re-gather),
+#   so dw needs no new random gather; the only random backward gather is
+#   d_num[senders] for the involution dh; everything else is static-
+#   permutation gathers, sorted gathers, and CSR scalar reductions.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10))
+def att_aggregate_planned(h, alpha_s, alpha_r, senders, receivers, rev_perm,
+                          edge_mask, plan, num_segments: int, agg_dtype,
+                          negative_slope: float):
+    """Softmax-attention neighbor aggregation on the planned layout.
+
+    ``out[r] = Σ_e softmax_r(bounded_logits(α_s[s_e]+α_r[r_e])) h[s_e]``
+    — numerically identical to the unfused pick/exp/den/aggregate chain
+    (the oracle in tests).  ``edge_mask`` is the bool edge-validity mask
+    (a constant of the graph — no cotangent).
+    """
+    out, _ = _att_fwd_impl(h, alpha_s, alpha_r, senders, receivers,
+                           edge_mask, plan, num_segments, agg_dtype,
+                           negative_slope)
+    return out
+
+
+def _att_fwd_impl(h, alpha_s, alpha_r, senders, receivers, edge_mask,
+                  plan, num_segments, agg_dtype, negative_slope):
+    from hyperspace_tpu.kernels.segment import csr_segment_reduce_1d
+    from hyperspace_tpu.nn.gcn import bounded_att_logits
+
+    pb, pc, pf = plan
+    f = h.shape[-1]
+    ha = jnp.concatenate([h, alpha_s[:, None].astype(h.dtype)], axis=1)
+    hs_a = ha[senders]                       # the ONE random gather
+    h_s, a_se = hs_a[:, :f], hs_a[:, f]
+    a_re = alpha_r[receivers]                # sorted gather
+    lm = bounded_att_logits(a_se + a_re, negative_slope)
+    w = jnp.where(edge_mask, jnp.exp(lm), 0.0)
+    h_in = h_s if agg_dtype is None else h_s.astype(agg_dtype)
+    w_in = w if agg_dtype is None else w.astype(agg_dtype)
+    num = _sorted_segsum(w_in[:, None] * h_in, receivers, pb, pc, pf,
+                         num_segments).astype(jnp.float32)
+    den = csr_segment_reduce_1d(w_in, receivers, (pb, pc, pf),
+                                num_segments, op="sum")
+    den = jnp.maximum(den, 1e-15)
+    out = (num / den[:, None]).astype(h.dtype)
+    return out, (h_in, w_in, lm, den, out)
+
+
+def _att_fwd(h, alpha_s, alpha_r, senders, receivers, rev_perm,
+             edge_mask, plan, num_segments, agg_dtype, negative_slope):
+    out, (h_in, w_in, lm, den, out_sv) = _att_fwd_impl(
+        h, alpha_s, alpha_r, senders, receivers, edge_mask, plan,
+        num_segments, agg_dtype, negative_slope)
+    return out, (h_in, w_in, lm, den, out_sv, senders, receivers, rev_perm,
+                 edge_mask, plan, jnp.zeros((0,), h.dtype))
+
+
+def _att_bwd(num_segments, agg_dtype, negative_slope, res, g):
+    from hyperspace_tpu.kernels.segment import csr_segment_reduce_1d
+    from hyperspace_tpu.nn.gcn import ATT_LOGIT_BOUND as B
+
+    (h_in, w_in, lm, den, out, senders, receivers, rev_perm, edge_mask,
+     plan, h_proto) = res
+    h_dtype = h_proto.dtype
+    pb, pc, pf = plan
+    g32 = g.astype(jnp.float32)
+    d_num = g32 / den[:, None]                       # [N, F]
+    d_den = -jnp.sum(g32 * out.astype(jnp.float32), axis=-1) / den  # [N]
+
+    dn_dt = d_num if agg_dtype is None else d_num.astype(agg_dtype)
+    dn_s = dn_dt[senders]                # the one random backward gather
+    # dh via the involution: sender-scatter becomes a receiver-scatter
+    dh = _sorted_segsum(w_in[rev_perm][:, None] * dn_s, receivers,
+                        pb, pc, pf, num_segments).astype(h_dtype)
+    # dw from the saved residual rows — no random re-gather
+    dn_r = dn_dt[receivers]                          # sorted gather
+    dw = (jnp.sum(dn_r.astype(jnp.float32) * h_in.astype(jnp.float32),
+                  axis=-1)
+          + d_den[receivers])
+    # chain through w = exp(lm)·mask, lm = B·tanh(leaky(pre)/B)
+    w32 = w_in.astype(jnp.float32)
+    leaky_g = jnp.where(lm >= 0, 1.0, negative_slope)
+    dpre = jnp.where(edge_mask,
+                     dw * w32 * (1.0 - (lm / B) ** 2) * leaky_g, 0.0)
+    d_alpha_r = csr_segment_reduce_1d(dpre, receivers, (pb, pc, pf),
+                                      num_segments, op="sum")
+    d_alpha_s = csr_segment_reduce_1d(dpre[rev_perm], receivers,
+                                      (pb, pc, pf), num_segments, op="sum")
+    return (dh, d_alpha_s, d_alpha_r, None, None, None, None, None)
+
+
+att_aggregate_planned.defvjp(_att_fwd, _att_bwd)
+
+
+# --- weighted (attention) aggregation on the cluster split --------------------
+#
+# Same two-path program, but the per-edge weights are RUNTIME values in
+# the prepare layout (exp-ed attention logits).  The static c_map/s_map
+# gathers route them into the split layouts ([E] scalars — cheap); the
+# involution backward's reversed weights are one more static gather
+# (c_map_rev = rev_perm∘c_map).  The dw backward — per-edge <ḡ[r], h[s]>
+# — runs the cluster SDDMM kernel on the clustered set (two one-hot MXU
+# matmuls per sub-chunk from VMEM-resident tiles) and the gathered row
+# dot only on the stragglers, then reconstitutes the prepare-layout [E]
+# gradient with the static inv_map GATHER (no scatter anywhere).
+
+
+def _att_two_path(vals, w, agg: ClusterAgg, num_segments: int, rev: bool):
+    from hyperspace_tpu.kernels.cluster import cluster_aggregate
+
+    w = w.astype(jnp.float32)
+    w_c = w[agg.c_map_rev if rev else agg.c_map]
+    w_s = w[agg.s_map_rev if rev else agg.s_map] * agg.s_valid
+    out = cluster_aggregate(vals, w_c, agg.c_recv, agg.c_send,
+                            agg.c_plan, num_segments)
+    msgs = w_s.astype(vals.dtype)[:, None] * vals[agg.s_send]
+    out = out + _sorted_segsum(msgs, agg.s_recv, *agg.s_plan,
+                               num_segments).astype(out.dtype)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def cluster_att_aggregate(h, w, agg: ClusterAgg, num_segments: int):
+    """out[r] = Σ_e w_e · h[senders_e] with runtime per-edge weights
+    ``w`` in the prepare layout (0 on padding edges), through the
+    cluster-pair kernel + straggler CSR.  Requires ``agg.weighted_ok``.
+    Twin/oracle: ``sym_segment_aggregate`` on the same (h, w).
+    """
+    return _att_two_path(h, w, agg, num_segments, rev=False)
+
+
+def _caa_fwd(h, w, agg, num_segments):
+    return _att_two_path(h, w, agg, num_segments, rev=False), (h, w, agg)
+
+
+def _caa_bwd(num_segments, res, g):
+    from hyperspace_tpu.kernels.cluster import cluster_sddmm
+
+    h, w, agg = res
+    dh = _att_two_path(g, w, agg, num_segments, rev=True).astype(h.dtype)
+    # dw_e = <ḡ[r_e], h[s_e]>: SDDMM on the clustered set, row dot on
+    # the stragglers, inv_map gather back to the prepare layout
+    dw_c = cluster_sddmm(g, h, agg.c_recv, agg.c_send, agg.c_plan,
+                         num_segments)
+    dw_s = jnp.sum(g[agg.s_recv].astype(jnp.float32)
+                   * h[agg.s_send].astype(jnp.float32), axis=-1)
+    dw_all = jnp.concatenate([dw_c, dw_s, jnp.zeros((1,), jnp.float32)])
+    dw = dw_all[agg.inv_map].astype(w.dtype)
+    return dh, dw, None
+
+
+cluster_att_aggregate.defvjp(_caa_fwd, _caa_bwd)

@@ -42,6 +42,13 @@ def arms(hgcn, jnp, feat_dim, which="all"):
                          agg_dtype=jnp.bfloat16, decoder_dtype=jnp.bfloat16)),
         ("pairs_att_lr3e3_f32",
          hgcn.HGCNConfig(**{**base, "lr": 3e-3}, use_att=True)),
+        # r04 shipped attention defaults: lr 3e-3 + grad clip 1.0 (what
+        # `use_att=true` now builds via cli.train.hgcn_mode_defaults),
+        # on the bounded-logit softmax + fused planned aggregation path
+        ("pairs_att_stab",
+         hgcn.HGCNConfig(**{**base, "lr": 3e-3, "clip_norm": 1.0},
+                         use_att=True, agg_dtype=jnp.bfloat16,
+                         decoder_dtype=jnp.bfloat16)),
     ]
     if which == "all":
         return all_

@@ -28,6 +28,11 @@ def main() -> None:
     ap.add_argument("--ndev", type=int, default=16)
     ap.add_argument("--num-nodes", type=int, default=2048)
     ap.add_argument("--dp-list", type=str, default="1,4,8,16")
+    ap.add_argument("--reorder", choices=["none", "bfs", "community"],
+                    default="none",
+                    help="locality relabeling before sharding: under "
+                         "'community' the halo exchange replaces the "
+                         "all-gather wherever its static volume wins")
     args = ap.parse_args()
 
     # virtual CPU devices must be configured before jax import; an
@@ -49,6 +54,9 @@ def main() -> None:
 
     n = args.num_nodes
     edges, x, _, _ = G.synthetic_hierarchy(num_nodes=n, feat_dim=16, seed=0)
+    if args.reorder != "none":
+        edges, x, _, _ = G.apply_locality_order(edges, x, None,
+                                                method=args.reorder)
     split = G.split_edges(edges, n, x, seed=0, pad_multiple=256)
     cfg = hgcn.HGCNConfig(feat_dim=16, hidden_dims=(32, 8))
 
@@ -59,7 +67,7 @@ def main() -> None:
         lambda st, g, p: hgcn._lp_step_impl(model, opt, n, st, g, p)
     ).lower(state, ga, pairs).compile().cost_analysis()
 
-    out = {"ndev": args.ndev, "num_nodes": n,
+    out = {"ndev": args.ndev, "num_nodes": n, "reorder": args.reorder,
            "single_flops": single["flops"],
            "single_bytes": single["bytes accessed"], "dp": {}}
     for dp in (int(d) for d in args.dp_list.split(",")):
@@ -72,6 +80,7 @@ def main() -> None:
             model_k, opt_k, n, mesh, state_k, split)
         cost = step.lower(state_k, nsg, tp).compile().cost_analysis()
         out["dp"][str(dp)] = {
+            "halo": bool(nsg.halo),
             "flops_ratio": round(cost["flops"] / single["flops"], 4),
             "bytes_ratio": round(
                 cost["bytes accessed"] / single["bytes accessed"], 4),

@@ -1,0 +1,152 @@
+"""Decompose the ATTENTION HGCN LP train-step time on the live backend.
+
+VERDICT r3 #1: the attention arm (best quality, AUC 0.633) runs ~4x the
+mean step.  This probe isolates where the extra time lives: the logits
+pipeline ([E] scalar picks + CSR segment max/sum), the weighted [E, F]
+aggregation forward, its dh backward, and the dw backward (two [E, F]
+gathers in the current path).  One JSON line per probe.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def timed(fn, *args, steps=10, repeats=3):
+    import jax
+
+    out = fn(*args)
+    jax.device_get(jax.tree_util.tree_leaves(out)[0])
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0])
+        best = min(best, time.perf_counter() - t0)
+    return best / steps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.benchmarks import hgcn_bench as HB
+    from hyperspace_tpu.models import hgcn
+
+    num_nodes = HB.ARXIV_NODES
+    split, x = HB.arxiv_scale_split(num_nodes)
+    g = split.graph
+    print(json.dumps({
+        "probe": "graph",
+        "edges_padded": int(g.senders.shape[0]),
+        "frac_clustered": (None if g.cluster_split is None
+                           else round(g.cluster_split.frac_clustered, 4)),
+    }), flush=True)
+
+    for use_att in (False, True):
+        cfg = hgcn.HGCNConfig(feat_dim=x.shape[1], hidden_dims=(128, 32),
+                              kind="lorentz", use_att=use_att,
+                              agg_dtype=jnp.bfloat16,
+                              decoder_dtype=jnp.bfloat16)
+        model, opt, state = hgcn.init_lp(cfg, g, seed=0)
+        ga = hgcn._device_graph(g)
+        pos = hgcn.make_planned_pairs(split.train_pos, num_nodes)
+        neg_u, neg_plan = hgcn.make_static_negatives(
+            num_nodes, int(pos.u.shape[0]), seed=0)
+        step = lambda st: hgcn.train_step_lp_pairs(
+            model, opt, num_nodes, st, ga, pos, neg_u, neg_plan)
+        st, loss = step(state)
+        jax.device_get(loss)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                st, loss = step(st)
+            jax.device_get(loss)
+            best = min(best, time.perf_counter() - t0)
+        print(json.dumps({"probe": f"train_step att={use_att}",
+                          "time_s": round(best / 10, 5)}), flush=True)
+
+        enc = jax.jit(lambda p, gg: hgcn.HGCNEncoder(cfg).apply(
+            {"params": p["encoder"]}, gg)[0].sum())
+        t = timed(enc, st.params, ga)
+        print(json.dumps({"probe": f"encoder_fwd att={use_att}",
+                          "time_s": round(t, 5)}), flush=True)
+
+        @jax.jit
+        def enc_grad(p, gg):
+            def f(pp):
+                out, _ = hgcn.HGCNEncoder(cfg).apply(
+                    {"params": pp["encoder"]}, gg)
+                return jnp.sum(out * out)
+            l, gr = jax.value_and_grad(f)(p)
+            return l + sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(gr))
+
+        t = timed(enc_grad, st.params, ga)
+        print(json.dumps({"probe": f"encoder_fwd_bwd att={use_att}",
+                          "time_s": round(t, 5)}), flush=True)
+
+    # isolated weighted aggregation: dw on vs off isolates the two
+    # [E, F] gathers of the dw backward
+    from hyperspace_tpu.nn.scatter import sym_segment_aggregate
+
+    ga = hgcn._device_graph(g)
+    pb, pc, pf = ga.plan
+    h0 = jnp.zeros((num_nodes, 128), jnp.bfloat16)
+    w0 = ga.edge_mask.astype(jnp.bfloat16)
+
+    for with_dw in (False, True):
+        @jax.jit
+        def agg_fb(h, w):
+            def f(hh, ww):
+                out = sym_segment_aggregate(hh, ww, ga.senders, ga.receivers,
+                                            ga.rev_perm, pb, pc, pf,
+                                            num_nodes, with_dw)
+                return jnp.sum(out.astype(jnp.float32) ** 2)
+            l, (gh, gw) = jax.value_and_grad(f, argnums=(0, 1))(h, w)
+            return l + jnp.sum(gh.astype(jnp.float32)) + jnp.sum(
+                gw.astype(jnp.float32))
+
+        t = timed(agg_fb, h0, w0)
+        print(json.dumps({"probe": f"one_agg_fwd_bwd dw={with_dw}",
+                          "time_s": round(t, 5)}), flush=True)
+
+    # the logits pipeline alone (picks + segmax + exp + densum), fwd+bwd
+    from hyperspace_tpu.nn.scatter import (
+        pick_receivers,
+        pick_senders,
+        planned_segment_max_1d,
+        planned_segment_sum_1d,
+    )
+    from hyperspace_tpu.kernels.segment import NEG_FILL as _NEG
+
+    a0 = jnp.ones((num_nodes,), jnp.float32)
+    maskf = ga.edge_mask.astype(jnp.float32)
+
+    @jax.jit
+    def logits_fb(a_s, a_r):
+        def f(as_, ar_):
+            logits = (pick_senders(as_, ga.senders, ga.receivers,
+                                   ga.rev_perm, pb, pc, pf, num_nodes)
+                      + pick_receivers(ar_, ga.receivers, pb, pc, pf,
+                                       num_nodes))
+            lm = jnp.where(maskf > 0, logits, _NEG)
+            seg_max = planned_segment_max_1d(lm, ga.receivers, pb, pc, pf,
+                                             num_nodes)
+            seg_max = jnp.where(seg_max > 0.5 * _NEG, seg_max, 0.0)
+            w = jnp.exp(lm - seg_max[ga.receivers]) * maskf
+            den = planned_segment_sum_1d(w, ga.receivers, pb, pc, pf,
+                                         num_nodes)
+            return jnp.sum(w) + jnp.sum(den)
+        l, (g1, g2) = jax.value_and_grad(f, argnums=(0, 1))(a_s, a_r)
+        return l + jnp.sum(g1) + jnp.sum(g2)
+
+    t = timed(logits_fb, a0, a0)
+    print(json.dumps({"probe": "logits_pipeline_fwd_bwd",
+                      "time_s": round(t, 5)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -327,7 +327,7 @@ def test_per_device_cost_scales_to_v5e16_shape():
     out = subprocess.run(
         [sys.executable,
          os.path.join(repo_root, "scripts", "cost_scaling_probe.py"),
-         "--ndev", "16"],
+         "--ndev", "16", "--num-nodes", "4096", "--reorder", "community"],
         capture_output=True, text=True, env=env, timeout=900, check=True)
     rec = json.loads(out.stdout.strip().splitlines()[-1])
     ratios = [(int(k), v["flops_ratio"], v["bytes_ratio"])
@@ -337,4 +337,101 @@ def test_per_device_cost_scales_to_v5e16_shape():
     assert flops == sorted(flops, reverse=True), f"not monotone: {ratios}"
     dp16 = rec["dp"]["16"]
     assert dp16["flops_ratio"] <= 0.20, dp16
-    assert dp16["bytes_ratio"] <= 0.25, dp16
+    # VERDICT r3 #6 criterion: the community locality order (plus the
+    # auto-gated halo exchange where its static volume wins) cuts the
+    # dp=16 byte floor — measured 0.110 here vs 0.154 unordered in r03
+    assert dp16["bytes_ratio"] <= 0.12, dp16
+
+
+# --- halo exchange (VERDICT r3 #6) --------------------------------------------
+
+
+def _ordered_setup(num_nodes=256, seed=0):
+    """Community-ordered graph: the layout the halo path is built for."""
+    edges, x, labels, ncls = G.synthetic_hierarchy(
+        num_nodes=num_nodes, feat_dim=12, num_classes=4, seed=seed)
+    edges, x, labels, _ = G.apply_locality_order(edges, x, labels,
+                                                 method="community")
+    split = G.split_edges(edges, num_nodes, x, seed=seed, pad_multiple=128)
+    return split
+
+
+def test_halo_aggregate_matches_allgather_and_dense(rng):
+    """halo=True aggregation == halo=False == the unsharded oracle,
+    values AND gradients (the involution backward over all_to_all)."""
+    mesh = _mesh_or_skip({"data": 8})
+    split = _ordered_setup()
+    g = split.graph
+    nsg_h = NS.to_device_sharded(NS.partition_graph(g, 8, halo=True), mesh)
+    nsg_a = NS.to_device_sharded(NS.partition_graph(g, 8, halo=False), mesh)
+    assert nsg_h.halo and not nsg_a.halo
+    n_pad = nsg_h.x.shape[0]
+    h = jnp.asarray(rng.standard_normal((n_pad, 16)).astype(np.float32))
+    probe = jnp.asarray(rng.standard_normal((n_pad, 16)).astype(np.float32))
+
+    f_h = lambda h: jnp.sum(NS.node_sharded_aggregate(h, nsg_h) * probe)
+    f_a = lambda h: jnp.sum(NS.node_sharded_aggregate(h, nsg_a) * probe)
+    np.testing.assert_allclose(float(f_h(h)), float(f_a(h)), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(jax.grad(f_h)(h)),
+                               np.asarray(jax.grad(f_a)(h)),
+                               rtol=1e-4, atol=1e-6)
+    # dense oracle for the values
+    w = g.edge_mask / np.maximum(g.deg, 1.0)[g.receivers]
+    msgs = np.asarray(w)[:, None] * np.asarray(h)[g.senders]
+    want = jax.ops.segment_sum(jnp.asarray(msgs, jnp.float32),
+                               jnp.asarray(g.receivers), g.num_nodes)
+    out = NS.node_sharded_aggregate(h, nsg_h)
+    np.testing.assert_allclose(np.asarray(out)[: g.num_nodes],
+                               np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_halo_att_aggregate_matches_allgather(rng):
+    mesh = _mesh_or_skip({"data": 8})
+    split = _ordered_setup(seed=1)
+    g = split.graph
+    nsg_h = NS.to_device_sharded(NS.partition_graph(g, 8, halo=True), mesh)
+    nsg_a = NS.to_device_sharded(NS.partition_graph(g, 8, halo=False), mesh)
+    assert nsg_h.halo
+    n_pad = nsg_h.x.shape[0]
+    h = jnp.asarray(rng.standard_normal((n_pad, 16)).astype(np.float32))
+    a_s = jnp.asarray(rng.standard_normal(n_pad).astype(np.float32))
+    a_r = jnp.asarray(rng.standard_normal(n_pad).astype(np.float32))
+    probe = jnp.asarray(rng.standard_normal((n_pad, 16)).astype(np.float32))
+
+    def f(nsg, h, a_s, a_r):
+        return jnp.sum(
+            NS.node_sharded_att_aggregate(h, a_s, a_r, nsg) * probe)
+
+    np.testing.assert_allclose(float(f(nsg_h, h, a_s, a_r)),
+                               float(f(nsg_a, h, a_s, a_r)), rtol=1e-5)
+    gh = jax.grad(f, argnums=(1, 2, 3))(nsg_h, h, a_s, a_r)
+    ga = jax.grad(f, argnums=(1, 2, 3))(nsg_a, h, a_s, a_r)
+    for a, b in zip(gh, ga):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_halo_auto_engages_on_low_cut_graph():
+    """'auto' must pick the halo exchange when the static exchange volume
+    beats the all-gather — a ring of cliques aligned with the shard
+    boundaries (the shape a locality ordering produces at scale)."""
+    n, k = 512, 4
+    blocks = []
+    for b in range(k):
+        base = b * (n // k)
+        ids = np.arange(base, base + n // k)
+        u = np.repeat(ids, 4)
+        v = ids[(np.tile(np.arange(4), n // k) + u % 17) % (n // k)]
+        blocks.append(np.stack([u, v], 1))
+        # a handful of cross-shard edges to the next clique
+        nxt = (b + 1) % k * (n // k)
+        blocks.append(np.stack([ids[:8], nxt + np.arange(8)], 1))
+    edges = np.concatenate(blocks)
+    edges = edges[edges[:, 0] != edges[:, 1]]
+    x = np.zeros((n, 4), np.float32)
+    g = G.prepare(edges, n, x, pad_multiple=128)
+    hp = NS.partition_graph(g, k, halo="auto")
+    assert hp.halo and hp.send_idx is not None
+    # and the exchange is genuinely smaller than the all-gather
+    ndev, _, h_max = hp.send_idx.shape
+    assert 2 * ndev * h_max <= hp.n_shard * ndev
