@@ -244,6 +244,15 @@ def main() -> None:
                 repeats=max(1, args.repeats - 1))
         except Exception as e:
             result["detail"]["realistic_error"] = repr(e)
+        try:  # workloads 3-5 one-liners + the 4k-token flash fwd+bwd leg
+            from hyperspace_tpu.benchmarks.workloads_bench import (
+                run_workloads_bench,
+            )
+
+            result["detail"]["workloads"] = run_workloads_bench(
+                repeats=max(1, args.repeats - 1))
+        except Exception as e:
+            result["detail"]["workloads_error"] = repr(e)
     print(json.dumps(result))
     if failed:
         sys.exit(1)

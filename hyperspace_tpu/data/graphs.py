@@ -170,6 +170,17 @@ def _prepare_edges_numpy(edges, num_nodes, *, symmetrize=True,
     return senders, receivers, mask, rev_perm, deg
 
 
+def _check_edge_range(edges, num_nodes: int) -> None:
+    """Raise IndexError on out-of-range ids BEFORE any native path runs
+    (the C++ pipelines do no bounds checks — a bad id would silently
+    corrupt memory or segfault instead of raising)."""
+    e = np.asarray(edges)
+    if len(e) and (e.min() < 0 or e.max() >= num_nodes):
+        raise IndexError(
+            f"edge ids out of range [0, {num_nodes}): min {e.min()}, "
+            f"max {e.max()}")
+
+
 def prepare(
     edges: np.ndarray,
     num_nodes: int,
@@ -199,13 +210,7 @@ def prepare(
       are static per graph, so they are computed here once instead of per
       training step.
     """
-    e_chk = np.asarray(edges)
-    # validate before the native path: the C++ pipeline does no bounds
-    # checks and a bad id would segfault instead of raising
-    if len(e_chk) and (e_chk.min() < 0 or e_chk.max() >= num_nodes):
-        raise IndexError(
-            f"edge ids out of range [0, {num_nodes}): min {e_chk.min()}, "
-            f"max {e_chk.max()}")
+    _check_edge_range(edges, num_nodes)
     senders = receivers = mask = rev_perm = deg = None
     try:  # native C++ pipeline; _prepare_edges_numpy is the oracle
         from hyperspace_tpu.data import native
@@ -630,10 +635,7 @@ def locality_order(edges: np.ndarray, num_nodes: int) -> np.ndarray:
     # validate HERE so native and fallback paths fail identically (the
     # C++ walk would OOB-write silently; the python walk would wrap
     # negative ids)
-    if len(e) and (e.min() < 0 or e.max() >= num_nodes):
-        raise IndexError(
-            f"edge ids out of range [0, {num_nodes}): min {e.min()}, "
-            f"max {e.max()}")
+    _check_edge_range(e, num_nodes)
     try:
         from hyperspace_tpu.data import native
 
@@ -725,10 +727,7 @@ def community_order(edges: np.ndarray, num_nodes: int,
     a graph isomorphism: only the memory layout changes.
     """
     e = np.asarray(edges, np.int64)
-    if len(e) and (e.min() < 0 or e.max() >= num_nodes):
-        raise IndexError(
-            f"edge ids out of range [0, {num_nodes}): min {e.min()}, "
-            f"max {e.max()}")
+    _check_edge_range(e, num_nodes)
     rng = np.random.default_rng(seed)
     sym = np.concatenate([e, e[:, ::-1]], axis=0)
     snd, rcv = sym[:, 0], sym[:, 1]

@@ -244,7 +244,7 @@ def _score_tile(c, beta, tau, q, k, nk, ik, bk, masked, mask_ref):
 
 
 def _dq_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, dsp_ref,
-             lse_ref, di_ref, dq_ref, dsg_ref, dst_ref, dq_scr, part_scr,
+             lse_ref, di_ref, dq_ref, dst_ref, dq_scr, part_scr,
              *, bk: int, masked: bool, mask_ref=None):
     ik = pl.program_id(2)
     nk_blocks = pl.num_programs(2)
@@ -252,8 +252,7 @@ def _dq_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, dsp_ref,
     @pl.when(ik == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
-        part_scr[0] = 0.0
-        part_scr[1] = 0.0
+        part_scr[:] = jnp.zeros_like(part_scr)
 
     c = c_ref[0, 0]
     beta = beta_ref[pl.program_id(0)]
@@ -274,14 +273,16 @@ def _dq_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, dsp_ref,
     dq_scr[:] += (2.0 / tau) * jax.lax.dot_general(
         dsig, k_flip, dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST)
-    part_scr[0] += jnp.sum(dsig)
-    part_scr[1] += jnp.sum(jnp.where(valid, dsig * sigma, 0.0))
+    # dτ partial Σ dσ·σ accumulates as a (8, 128)-tiled broadcast (a
+    # scalar-shaped output block fails the Mosaic (8, 128) tiling rule).
+    # dβ needs no partial: Σ_j dσ_ij = 0 exactly (softmax shift
+    # invariance), so dβ ≡ 0 and the score-offset dc term vanishes too.
+    part_scr[:] += jnp.sum(jnp.where(valid, dsig * sigma, 0.0))
 
     @pl.when(ik == nk_blocks - 1)
     def _write():
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
-        dsg_ref[0, 0] = part_scr[0]
-        dst_ref[0, 0] = part_scr[1]
+        dst_ref[0, 0] = part_scr[:]
 
 
 def _dkv_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, dsp_ref,
@@ -388,35 +389,31 @@ def _bwd_launch(q, k, v, c, beta_b, tau_b, maskf, dsp, lse, di, mode_):
     def dq_kernel(*refs):
         if masked:
             (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r, mk_r,
-             dq_r, sg_r, st_r, dq_s, pt_s) = refs
+             dq_r, st_r, dq_s, pt_s) = refs
         else:
             (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r,
-             dq_r, sg_r, st_r, dq_s, pt_s) = refs
+             dq_r, st_r, dq_s, pt_s) = refs
             mk_r = None
         _dq_body(c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r,
-                 dq_r, sg_r, st_r, dq_s, pt_s, bk=bk, masked=masked,
+                 dq_r, st_r, dq_s, pt_s, bk=bk, masked=masked,
                  mask_ref=mk_r)
 
     nqb, nkb = nq_p // bq, nk_p // bk
-    dq, dsg, dst = pl.pallas_call(
+    dq, dst = pl.pallas_call(
         dq_kernel,
         grid=(b, nqb, nkb),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, dp), lambda ib, iq, ik: (ib, iq, 0)),
-            pl.BlockSpec((1, 1), lambda ib, iq, ik: (ib, iq),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1), lambda ib, iq, ik: (ib, iq),
-                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, 8, 128), lambda ib, iq, ik: (ib, iq, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, nq_p, dp), jnp.float32),
-            jax.ShapeDtypeStruct((b, nqb), jnp.float32),
-            jax.ShapeDtypeStruct((b, nqb), jnp.float32),
+            jax.ShapeDtypeStruct((b, nqb, 8, 128), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, dp), jnp.float32),
-            pltpu.SMEM((2,), jnp.float32),
+            pltpu.VMEM((8, 128), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -475,7 +472,7 @@ def _bwd_launch(q, k, v, c, beta_b, tau_b, maskf, dsp, lse, di, mode_):
         interpret=S.interpret_flag(mode_),
     )(*args2)
     return (dq[:, :nq, :d], dk[:, :nk, :d], dv[:, :nk, :d],
-            jnp.sum(dsg, axis=1), jnp.sum(dst, axis=1))
+            jnp.sum(dst[:, :, 0, 0], axis=1))
 
 
 def _epilogue_jax(s, c):
@@ -512,12 +509,14 @@ def _fa3_bwd(res, g):
     _, epi_vjp = jax.vjp(_epilogue_jax, s_pre, c32)
     dsp, dc_epi = epi_vjp(g.astype(jnp.float32))
     di = jnp.sum(dsp * s_pre, axis=-1)                      # [B, nq]
-    dq, dk, dv, dsg, dst = _bwd_launch(q3, k3, v3, c, beta_b, tau_b, maskf,
-                                       dsp, lse, di, mode_)
-    dbeta = dsg / tau_b
+    dq, dk, dv, dst = _bwd_launch(q3, k3, v3, c, beta_b, tau_b, maskf,
+                                  dsp, lse, di, mode_)
+    # β shifts every logit of a softmax row uniformly → dβ ≡ 0 exactly,
+    # and the same row-sum identity kills the score-offset dc term; the
+    # only c gradient is the epilogue's
+    dbeta = jnp.zeros_like(beta_b)
     dtau = -dst / tau_b
-    dc = (dc_epi + jnp.sum(dsg * (-2.0 / (c32 * c32 * tau_b)))).astype(
-        jnp.float32)
+    dc = dc_epi.astype(jnp.float32)
     dmask = None if maskf is None else jnp.zeros_like(maskf)
     return (dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype),
             dc, dbeta, dtau, dmask, None)

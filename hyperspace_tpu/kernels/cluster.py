@@ -373,6 +373,10 @@ class ClusterSplit(NamedTuple):
     s_map_rev: np.ndarray | None = None  # [Es]
     s_valid: np.ndarray | None = None    # [Es] f32 1 on real stragglers
     inv_map: np.ndarray | None = None    # [E] -> slot in the dw concat
+    # the clustered-dw slot count inv_map was built against; the dw
+    # backward pads/slices cluster_sddmm's output to THIS length so a
+    # split built with a non-default bk can never misalign the concat
+    ec_pad: int = 0
 
 
 def build_cluster_split(
@@ -438,7 +442,7 @@ def build_cluster_split(
             c_map=c_map, c_map_rev=rp[c_map].astype(np.int32),
             s_map=s_map, s_map_rev=rp[s_map].astype(np.int32) * (
                 s_valid > 0),  # padding rows point at edge 0, masked out
-            s_valid=s_valid, inv_map=inv_map)
+            s_valid=s_valid, inv_map=inv_map, ec_pad=int(ec_pad))
 
     return ClusterSplit(
         c_recv=c_recv.astype(np.int32), c_send=c_send.astype(np.int32),

@@ -262,3 +262,141 @@ def csr_segment_reduce_1d(
     )(*tuple(plan), recv2d, v2d)
     red = jnp.sum(out, axis=-1) if op == "sum" else jnp.max(out, axis=-1)
     return red[:num_segments].astype(values.dtype)
+
+
+# --- fused attention backward over edges ---------------------------------------
+
+
+def _body_att_bwd(bn: int, bound: float, negative_slope: float):
+    def body(blk_ref, chk_ref, first_ref, firstc_ref, recv_ref, dn_ref,
+             h1_ref, w_ref, lm_ref, dpre_ref, dar_ref):
+        t = pl.program_id(0)
+        b = blk_ref[t]
+
+        @pl.when(first_ref[t] == 1)
+        def _():
+            dar_ref[:] = jnp.zeros_like(dar_ref)
+
+        @pl.when(firstc_ref[t] == 1)
+        def _():
+            dpre_ref[:] = jnp.zeros_like(dpre_ref)
+
+        recv = recv_ref[0]                       # [bk//128, 128] int32
+        w = w_ref[0].astype(jnp.float32)
+        lm = lm_ref[0].astype(jnp.float32)
+        dn = dn_ref[:].astype(jnp.float32)       # [bn, dp1] (d_num | d_den)
+        local = recv - b * bn
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bn, 128), 0)
+        dar_acc = dar_ref[:]
+        for j in range(recv.shape[0]):
+            oh = (rows == local[j : j + 1, :]).astype(jnp.float32)
+            # per-edge pick of this block's (d_num | d_den) rows: ohT @ dn
+            dn_pick = jax.lax.dot_general(      # [128, dp1], MXU
+                oh, dn, dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+            h1 = h1_ref[j * 128 : (j + 1) * 128, :].astype(jnp.float32)
+            # dw = <d_num[r], h[s]> + d_den[r]: h1 carries a ones column
+            # in the d_den lane, so one row-dot covers both terms
+            dw = jnp.sum(dn_pick * h1, axis=-1)            # [128]
+            leaky_g = jnp.where(lm[j] >= 0.0, 1.0, negative_slope)
+            dpre_j = dw * w[j] * (1.0 - (lm[j] / bound) ** 2) * leaky_g
+            # foreign lanes (another block's edges in a boundary chunk)
+            # have all-zero one-hots → dw = 0 → dpre_j = 0: the owning
+            # block's visit supplies the value, accumulation is exact
+            dpre_ref[0, j, :] += dpre_j
+            dar_acc = dar_acc + jnp.where(rows == local[j : j + 1, :],
+                                          jnp.broadcast_to(
+                                              dpre_j[None, :], (bn, 128)),
+                                          0.0)
+        dar_ref[:] = dar_acc
+
+    return body
+
+
+def csr_att_bwd_edges(
+    dn_ext: jax.Array,     # [N, F+1] (d_num | d_den) node rows, f32
+    h1: jax.Array,         # [E, F+1] residual sender rows | ones column
+    w: jax.Array,          # [E] forward softmax weights (0 on padding)
+    lm: jax.Array,         # [E] bounded logits
+    receivers: jax.Array,  # [E] int32 sorted
+    plan: tuple,           # CsrPlan device arrays
+    num_segments: int,
+    bound: float,
+    negative_slope: float,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused attention-backward edge pass (nn/scatter.att_aggregate_planned).
+
+    One walk of the CSR plan computes, per edge,
+    ``dw = <d_num[r], h[s]> + d_den[r]`` (the receiver-side rows are
+    picked from the VMEM-resident node block by one-hot matmul — no [E]
+    gather of d_num), chains it through the bounded-logit softmax weight
+    ``w = exp(B·tanh(leaky(pre)/B))`` to ``dpre``, writes the edge-
+    aligned ``dpre`` stream, AND accumulates the receiver-side score
+    gradient ``d_alpha_r = segsum(dpre)`` in the same pass — replacing a
+    sorted [E, F] gather, an [E, F] elementwise row-dot pass, an [E]
+    elementwise chain, and a scalar CSR reduction (4 HBM passes → 1).
+    Twin/oracle: the unfused chain (tests/nn/test_scatter.py).
+    """
+    m = S.mode()
+    f1 = dn_ext.shape[-1]
+    if m == "xla":
+        dn_r = dn_ext[receivers]
+        dw = jnp.sum(dn_r * h1.astype(jnp.float32), axis=-1)
+        leaky_g = jnp.where(lm >= 0.0, 1.0, negative_slope)
+        dpre = (dw * w.astype(jnp.float32)
+                * (1.0 - (lm / bound) ** 2) * leaky_g)
+        dar = jax.ops.segment_sum(dpre, receivers, num_segments,
+                                  indices_are_sorted=True)
+        return dpre, dar
+    e = w.shape[0]
+    bn, bk = _BN, _BK
+    e_pad = S.round_up(e, bk)
+    dp1 = S.round_up(f1, 128)
+    dn_p = S.pad_axis(S.pad_axis(dn_ext.astype(jnp.float32), -1, 128), 0, bn)
+    h1_p = S.pad_axis(S.pad_axis(h1, -1, 128), 0, bk)
+    w2d = jnp.pad(w.astype(jnp.float32), (0, e_pad - e)).reshape(
+        e_pad // bk, bk // 128, 128)
+    lm2d = jnp.pad(lm.astype(jnp.float32), (0, e_pad - e)).reshape(
+        e_pad // bk, bk // 128, 128)
+    recv2d = S.pad_axis(receivers, 0, bk).reshape(e_pad // bk, bk // 128, 128)
+    pb, pc, pf = tuple(plan)
+    # chunk indices are non-decreasing in item order (block-major plan),
+    # so each chunk's first visitor is where the value changes
+    fc = jnp.concatenate([jnp.ones((1,), jnp.int32),
+                          (pc[1:] > pc[:-1]).astype(jnp.int32)])
+    t = pb.shape[0]
+    n_pad = S.round_up(num_segments, bn)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, bk // 128, 128),
+                         lambda t, blk, chk, first, fc: (chk[t], 0, 0)),
+            pl.BlockSpec((bn, dp1),
+                         lambda t, blk, chk, first, fc: (blk[t], 0)),
+            pl.BlockSpec((bk, dp1),
+                         lambda t, blk, chk, first, fc: (chk[t], 0)),
+            pl.BlockSpec((1, bk // 128, 128),
+                         lambda t, blk, chk, first, fc: (chk[t], 0, 0)),
+            pl.BlockSpec((1, bk // 128, 128),
+                         lambda t, blk, chk, first, fc: (chk[t], 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk // 128, 128),
+                         lambda t, blk, chk, first, fc: (chk[t], 0, 0)),
+            pl.BlockSpec((bn, 128),
+                         lambda t, blk, chk, first, fc: (blk[t], 0)),
+        ],
+    )
+    dpre2d, dar = pl.pallas_call(
+        _body_att_bwd(bn, bound, negative_slope),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((e_pad // bk, bk // 128, 128), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 128), jnp.float32),
+        ],
+        interpret=S.interpret_flag(m),
+    )(pb, pc, pf, fc, recv2d, dn_p, h1_p, w2d, lm2d)
+    return (dpre2d.reshape(e_pad)[:e],
+            jnp.sum(dar, axis=-1)[:num_segments])

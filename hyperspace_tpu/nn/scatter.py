@@ -228,7 +228,8 @@ class ClusterAgg:
     def __init__(self, c_recv, c_send, c_wf, c_wb, c_plan,
                  s_recv, s_send, s_wf, s_wb, s_plan,
                  c_map=None, c_map_rev=None, s_map=None, s_map_rev=None,
-                 s_valid=None, inv_map=None, use_weighted: bool = False):
+                 s_valid=None, inv_map=None, use_weighted: bool = False,
+                 ec_pad: int = 0):
         self.c_recv, self.c_send = c_recv, c_send
         self.c_wf, self.c_wb = c_wf, c_wb
         self.c_plan = c_plan
@@ -239,6 +240,7 @@ class ClusterAgg:
         self.s_map, self.s_map_rev = s_map, s_map_rev
         self.s_valid, self.inv_map = s_valid, inv_map
         self.use_weighted = bool(use_weighted)
+        self.ec_pad = int(ec_pad)
 
     @property
     def weighted_ok(self) -> bool:
@@ -252,11 +254,11 @@ class ClusterAgg:
                  tuple(self.c_plan), self.s_recv, self.s_send, self.s_wf,
                  self.s_wb, tuple(self.s_plan), self.c_map, self.c_map_rev,
                  self.s_map, self.s_map_rev, self.s_valid, self.inv_map),
-                (self.use_weighted,))
+                (self.use_weighted, self.ec_pad))
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        return cls(*leaves, use_weighted=aux[0])
+        return cls(*leaves, use_weighted=aux[0], ec_pad=aux[1])
 
     @classmethod
     def from_host(cls, split):
@@ -271,7 +273,8 @@ class ClusterAgg:
                    dev(split.s_map_rev), dev(split.s_valid),
                    dev(split.inv_map),
                    use_weighted=(split.frac_clustered
-                                 >= cls.WEIGHTED_MIN_FRAC))
+                                 >= cls.WEIGHTED_MIN_FRAC),
+                   ec_pad=split.ec_pad)
 
 
 jax.tree_util.register_pytree_node(
@@ -355,7 +358,6 @@ def att_aggregate_planned(h, alpha_s, alpha_r, senders, receivers, rev_perm,
 
 def _att_fwd_impl(h, alpha_s, alpha_r, senders, receivers, edge_mask,
                   plan, num_segments, agg_dtype, negative_slope):
-    from hyperspace_tpu.kernels.segment import csr_segment_reduce_1d
     from hyperspace_tpu.nn.gcn import bounded_att_logits
 
     pb, pc, pf = plan
@@ -368,11 +370,13 @@ def _att_fwd_impl(h, alpha_s, alpha_r, senders, receivers, edge_mask,
     w = jnp.where(edge_mask, jnp.exp(lm), 0.0)
     h_in = h_s if agg_dtype is None else h_s.astype(agg_dtype)
     w_in = w if agg_dtype is None else w.astype(agg_dtype)
-    num = _sorted_segsum(w_in[:, None] * h_in, receivers, pb, pc, pf,
+    # numerator and denominator ride ONE CSR pass: the messages carry a
+    # constant 1-column, so segsum(w·[h | 1]) = [Σ w·h | Σ w]
+    msgs = jnp.concatenate(
+        [w_in[:, None] * h_in, w_in[:, None]], axis=1)
+    agg = _sorted_segsum(msgs, receivers, pb, pc, pf,
                          num_segments).astype(jnp.float32)
-    den = csr_segment_reduce_1d(w_in, receivers, (pb, pc, pf),
-                                num_segments, op="sum")
-    den = jnp.maximum(den, 1e-15)
+    num, den = agg[:, :f], jnp.maximum(agg[:, f], 1e-15)
     out = (num / den[:, None]).astype(h.dtype)
     return out, (h_in, w_in, lm, den, out)
 
@@ -387,34 +391,41 @@ def _att_fwd(h, alpha_s, alpha_r, senders, receivers, rev_perm,
 
 
 def _att_bwd(num_segments, agg_dtype, negative_slope, res, g):
-    from hyperspace_tpu.kernels.segment import csr_segment_reduce_1d
+    from hyperspace_tpu.kernels.segment import (
+        csr_att_bwd_edges,
+        csr_segment_reduce_1d,
+    )
     from hyperspace_tpu.nn.gcn import ATT_LOGIT_BOUND as B
 
     (h_in, w_in, lm, den, out, senders, receivers, rev_perm, edge_mask,
      plan, h_proto) = res
     h_dtype = h_proto.dtype
+    f = out.shape[-1]
     pb, pc, pf = plan
     g32 = g.astype(jnp.float32)
     d_num = g32 / den[:, None]                       # [N, F]
     d_den = -jnp.sum(g32 * out.astype(jnp.float32), axis=-1) / den  # [N]
 
-    dn_dt = d_num if agg_dtype is None else d_num.astype(agg_dtype)
+    # d(num)/d(den) ride together as [N, F+1] so ONE gather serves each
+    # direction (mirrors the forward's fused num|den aggregation)
+    dn_ext = jnp.concatenate([d_num, d_den[:, None]], axis=1)
+    dn_dt = dn_ext if agg_dtype is None else dn_ext.astype(agg_dtype)
     dn_s = dn_dt[senders]                # the one random backward gather
     # dh via the involution: sender-scatter becomes a receiver-scatter
+    # (the extra lane aggregates Σ w·d_den — sliced off)
     dh = _sorted_segsum(w_in[rev_perm][:, None] * dn_s, receivers,
-                        pb, pc, pf, num_segments).astype(h_dtype)
-    # dw from the saved residual rows — no random re-gather
-    dn_r = dn_dt[receivers]                          # sorted gather
-    dw = (jnp.sum(dn_r.astype(jnp.float32) * h_in.astype(jnp.float32),
-                  axis=-1)
-          + d_den[receivers])
-    # chain through w = exp(lm)·mask, lm = B·tanh(leaky(pre)/B)
-    w32 = w_in.astype(jnp.float32)
-    leaky_g = jnp.where(lm >= 0, 1.0, negative_slope)
-    dpre = jnp.where(edge_mask,
-                     dw * w32 * (1.0 - (lm / B) ** 2) * leaky_g, 0.0)
-    d_alpha_r = csr_segment_reduce_1d(dpre, receivers, (pb, pc, pf),
-                                      num_segments, op="sum")
+                        pb, pc, pf, num_segments)[:, :f].astype(h_dtype)
+    # dw + softmax chain + d_alpha_r: ONE fused CSR pass — the receiver-
+    # side d_num|d_den rows are picked from the resident node block, the
+    # ones-augmented residual rows stream by chunk, and the per-receiver
+    # reduction accumulates in the same walk (kernels/segment.py)
+    h1 = jnp.concatenate(
+        [h_in.astype(jnp.float32), jnp.ones_like(w_in, jnp.float32)[:, None]],
+        axis=1)
+    dpre, d_alpha_r = csr_att_bwd_edges(
+        dn_ext, h1, jnp.where(edge_mask, w_in.astype(jnp.float32), 0.0),
+        lm, receivers, (pb, pc, pf), num_segments, float(B),
+        negative_slope)
     d_alpha_s = csr_segment_reduce_1d(dpre[rev_perm], receivers,
                                       (pb, pc, pf), num_segments, op="sum")
     return (dh, d_alpha_s, d_alpha_r, None, None, None, None, None)
@@ -470,9 +481,13 @@ def _caa_bwd(num_segments, res, g):
     h, w, agg = res
     dh = _att_two_path(g, w, agg, num_segments, rev=True).astype(h.dtype)
     # dw_e = <ḡ[r_e], h[s_e]>: SDDMM on the clustered set, row dot on
-    # the stragglers, inv_map gather back to the prepare layout
+    # the stragglers, inv_map gather back to the prepare layout.  The
+    # kernel output is padded/sliced to the slot count inv_map was built
+    # against (agg.ec_pad) so a non-default split bk cannot misalign it.
     dw_c = cluster_sddmm(g, h, agg.c_recv, agg.c_send, agg.c_plan,
                          num_segments)
+    pad = agg.ec_pad - dw_c.shape[0]
+    dw_c = jnp.pad(dw_c, (0, max(pad, 0)))[: agg.ec_pad]
     dw_s = jnp.sum(g[agg.s_recv].astype(jnp.float32)
                    * h[agg.s_send].astype(jnp.float32), axis=-1)
     dw_all = jnp.concatenate([dw_c, dw_s, jnp.zeros((1,), jnp.float32)])

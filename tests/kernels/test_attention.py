@@ -156,37 +156,32 @@ def test_flash_backward_matches_twin(rng, interp):
             assert float(np.max(np.abs(a_ - b_))) / scale < 2e-3
 
 
-def test_flash_backward_never_materializes_scores():
+def test_flash_backward_never_materializes_scores(monkeypatch):
     """The flash property must hold in BOTH directions: tracing the
     kernel-path gradient at L=4096 (pallas mode — tracing never executes
     TPU code) must produce no [Nq, Nk]-sized intermediate anywhere in
     the jaxpr.  The dense twin would carry a 4096x4096 score matrix."""
-    import os
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "pallas")
+    L, D = 4096, 8
+    q = jax.ShapeDtypeStruct((1, L, D + 1), jnp.float32)
 
-    os.environ["HYPERSPACE_KERNELS"] = "pallas"
-    try:
-        L, D = 4096, 8
-        q = jax.ShapeDtypeStruct((1, L, D + 1), jnp.float32)
+    def loss(q, k, v):
+        return jnp.sum(katt.flash_attention(q, k, v, 1.0) ** 2)
 
-        def loss(q, k, v):
-            return jnp.sum(katt.flash_attention(q, k, v, 1.0) ** 2)
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, q, q)
 
-        jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, q, q)
+    def sizes(jx):
+        for eqn in jx.eqns:
+            for var in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(var, "aval", None)
+                if aval is not None and hasattr(aval, "shape"):
+                    yield int(np.prod(aval.shape)) if aval.shape else 1
+            for param in eqn.params.values():
+                for sub in jax.tree_util.tree_leaves(
+                        param, is_leaf=lambda x: isinstance(
+                            x, jax.extend.core.ClosedJaxpr)):
+                    if isinstance(sub, jax.extend.core.ClosedJaxpr):
+                        yield from sizes(sub.jaxpr)
 
-        def sizes(jx):
-            for eqn in jx.eqns:
-                for var in list(eqn.invars) + list(eqn.outvars):
-                    aval = getattr(var, "aval", None)
-                    if aval is not None and hasattr(aval, "shape"):
-                        yield int(np.prod(aval.shape)) if aval.shape else 1
-                for param in eqn.params.values():
-                    for sub in jax.tree_util.tree_leaves(
-                            param, is_leaf=lambda x: isinstance(
-                                x, jax.extend.core.ClosedJaxpr)):
-                        if isinstance(sub, jax.extend.core.ClosedJaxpr):
-                            yield from sizes(sub.jaxpr)
-
-        biggest = max(sizes(jaxpr.jaxpr))
-        assert biggest < L * L, biggest  # scores would be L*L = 16.8M
-    finally:
-        os.environ.pop("HYPERSPACE_KERNELS", None)
+    biggest = max(sizes(jaxpr.jaxpr))
+    assert biggest < L * L, biggest  # scores would be L*L = 16.8M
