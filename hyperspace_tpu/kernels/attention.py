@@ -342,7 +342,7 @@ def _bwd_blocks(nq, nk, dp):
 
 
 def _bwd_launch(q, k, v, c, beta_b, tau_b, maskf, dsp, lse, di, mode_):
-    """Run both backward kernels; returns (dq, dk, dv, dsg [B], dst [B])."""
+    """Run both backward kernels; returns (dq, dk, dv, dst [B])."""
     b, nq, d = q.shape
     nk = k.shape[1]
     dp = S.round_up(d, 128)

@@ -419,9 +419,11 @@ def _att_bwd(num_segments, agg_dtype, negative_slope, res, g):
     # side d_num|d_den rows are picked from the resident node block, the
     # ones-augmented residual rows stream by chunk, and the per-receiver
     # reduction accumulates in the same walk (kernels/segment.py)
+    # keep the residual stream in its storage dtype (bf16 halves the
+    # [E, F+1] HBM read; the kernel upcasts per tile, and a ones column
+    # is exact in any float dtype)
     h1 = jnp.concatenate(
-        [h_in.astype(jnp.float32), jnp.ones_like(w_in, jnp.float32)[:, None]],
-        axis=1)
+        [h_in, jnp.ones_like(w_in, h_in.dtype)[:, None]], axis=1)
     dpre, d_alpha_r = csr_att_bwd_edges(
         dn_ext, h1, jnp.where(edge_mask, w_in.astype(jnp.float32), 0.0),
         lm, receivers, (pb, pc, pf), num_segments, float(B),
