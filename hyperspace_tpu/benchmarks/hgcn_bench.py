@@ -174,6 +174,11 @@ def run_hgcn_bench(
             "dtype": dtype,
             "agg_dtype": agg_dtype,
             "use_att": use_att,
+            # the config as EXECUTED: attention runs rewrite lr/clip to
+            # the shipped mode defaults, and the clip stage is part of
+            # the timed step — the artifact must say so
+            "lr": cfg.lr,
+            "clip_norm": cfg.clip_norm,
             "step": step,
             # both steps run the training decoder pass through
             # cfg.decoder_dtype (HGCNLinkPred casts z whenever
@@ -275,6 +280,8 @@ def run_realistic_bench(repeats: int = 2, steps_per_repeat: int = 10,
         best, state, loss = time_steps(step_fn, state, steps_per_repeat,
                                        repeats)
         key = "att" if use_att else "mean"
+        out[f"{key}_lr"] = cfg.lr            # the config as EXECUTED
+        out[f"{key}_clip_norm"] = cfg.clip_norm
         out[f"{key}_step_s"] = round(best / steps_per_repeat, 5)
         out[f"{key}_samples_per_s"] = round(
             num_nodes * steps_per_repeat / best, 1)

@@ -80,10 +80,21 @@ def ring_lorentz_attention(
     l0 = jax.lax.pcast(jnp.zeros(q.shape[:-1], q.dtype), axis_name, to="varying")
     s0 = jnp.zeros_like(q)
 
+    def fold(carry, kvm):
+        return _fold_block(q, kvm[0], kvm[1], c, beta, tau, carry,
+                           mask_j=(kvm[2] if k_mask is not None else None))
+
     def body(i, state):
         kvm, carry = state
-        carry = _fold_block(q, kvm[0], kvm[1], c, beta, tau, carry,
-                            mask_j=(kvm[2] if k_mask is not None else None))
+        # remat per hop: reverse-mode AD of the (scan-converted) ring
+        # loop would otherwise SAVE each hop's [Lq_loc, Lk_loc] score
+        # tile — O(L²/n) per device, exactly the memory the ring exists
+        # to avoid.  checkpoint recomputes the tile from (q, kj) in the
+        # backward (the flash-backward recipe), so residual memory stays
+        # O(L·D) and long-context training holds in BOTH directions.
+        # prevent_cse=False: under scan the CSE barriers are documented
+        # unnecessary and would pad every hop with optimization barriers
+        carry = jax.checkpoint(fold, prevent_cse=False)(carry, kvm)
         # rotate KV (+ mask) one hop around the ring (skipped data is
         # re-sent; the last hop's permute is dead code XLA removes when n
         # is static)

@@ -17,9 +17,12 @@ sequence length per hop (vs ring's n hops), at the cost of requiring
 H % n == 0.  On TPU the all_to_all rides the ICI torus; XLA overlaps it
 with the surrounding compute where possible.
 
-Both SP modes wrap the same single-device attention math
-(:func:`hyperspace_tpu.nn.attention.lorentz_attention`), so they are
-numerically interchangeable — the tests assert all three agree.
+Both SP modes compute the same single-device attention math; since r04
+the local op here is the N7 flash kernel
+(:func:`hyperspace_tpu.kernels.attention.flash_attention` — flash in
+both directions on TPU, dense twin elsewhere), so Ulysses long-context
+memory stays per-block like the ring's.  Numerically interchangeable
+with the ring and the dense form — the tests assert all three agree.
 """
 
 from __future__ import annotations
@@ -31,7 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from hyperspace_tpu.manifolds import Lorentz
-from hyperspace_tpu.nn.attention import lorentz_attention
+from hyperspace_tpu.kernels.attention import flash_attention
 
 
 def ulysses_lorentz_attention(
@@ -63,8 +66,13 @@ def ulysses_lorentz_attention(
         # the key-padding mask and broadcast over heads/queries
         mk = jax.lax.all_gather(k_mask, axis_name, axis=-1, tiled=True)
         mask = mk[:, None, None, :]  # [B, 1, 1, L]
-    out = lorentz_attention(qh, kh, vh, manifold, beta=beta, tau=tau,
-                            mask=mask)
+    # the local attention is the N7 flash kernel (r04: flash in BOTH
+    # directions on TPU, dense twin elsewhere) — with head sharding the
+    # per-device score working set is already H/n tiles, and flash keeps
+    # it per-BLOCK instead of per-sequence, so Ulysses long-context holds
+    # forward and backward like the ring does
+    out = flash_attention(qh, kh, vh, manifold.c, beta=beta, tau=tau,
+                          mask=mask)
     # head-sharded -> seq-sharded: split sequence, gather heads
     return jax.lax.all_to_all(out, axis_name=axis_name,
                               split_axis=2, concat_axis=1, tiled=True)

@@ -88,3 +88,40 @@ def test_ring_body_direct_shard_map_unmasked(mesh8):
     dense = lorentz_attention(q, q, q, m)
     np.testing.assert_allclose(np.asarray(run(q)), np.asarray(dense),
                                rtol=1e-9, atol=1e-11)
+
+
+def test_ring_backward_does_not_save_score_tiles(mesh8):
+    """The ring loop remats each hop (r04): reverse-mode AD must not
+    stack per-hop [Lq_loc, Lk_loc] score tiles across the n ring steps —
+    the grad jaxpr may contain nothing of size >= n*Lq_loc*Lk_loc."""
+    mesh = mesh8
+    n = 8
+    L, D = 1024, 8          # Lq_loc = Lk_loc = 128 per device
+    m = Lorentz(1.0)
+    rng = np.random.default_rng(0)
+    sp = rng.standard_normal((1, L, D)).astype(np.float32) * 0.3
+    t = np.sqrt(1.0 + np.sum(sp * sp, axis=-1, keepdims=True))
+    q = jnp.asarray(np.concatenate([t, sp], axis=-1))
+
+    def loss(q):
+        out = ring_attention_sharded(q, q, q, m, mesh, axis="seq")
+        return jnp.sum(out[..., 1:] ** 2)
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss))(q)
+
+    def sizes(jx):
+        for eqn in jx.eqns:
+            for var in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(var, "aval", None)
+                if aval is not None and hasattr(aval, "shape"):
+                    yield int(np.prod(aval.shape)) if aval.shape else 1
+            for param in eqn.params.values():
+                for sub in jax.tree_util.tree_leaves(
+                        param, is_leaf=lambda x: isinstance(
+                            x, jax.extend.core.ClosedJaxpr)):
+                    if isinstance(sub, jax.extend.core.ClosedJaxpr):
+                        yield from sizes(sub.jaxpr)
+
+    lq = L // n
+    biggest = max(sizes(jaxpr.jaxpr))
+    assert biggest < n * lq * lq, biggest  # stacked tiles would be 8*128*128
