@@ -253,6 +253,28 @@ def main() -> None:
                 repeats=max(1, args.repeats - 1))
         except Exception as e:
             result["detail"]["workloads_error"] = repr(e)
+        try:  # the attention arm on the same graph/protocol (VERDICT r3
+            # #1 asks for the --use-att number; it rides in detail so the
+            # plain driver invocation records it every round).  Distinct
+            # key: detail["use_att"] is the headline's config-as-executed
+            # bool and must not be clobbered.  With --use-att the primary
+            # already IS this arm — don't run the multi-minute bench twice.
+            if args.use_att:
+                src = result["detail"]
+            else:
+                src = hgcn_fn(repeats=max(1, args.repeats - 1),
+                              use_att=True)["detail"]
+            result["detail"]["use_att_arm"] = {
+                "step_time_s": src["step_time_s"],
+                "samples_per_s_per_chip": round(
+                    src["num_nodes"] / src["step_time_s"]
+                    / src["devices"], 1),
+                "lr": src["lr"],
+                "clip_norm": src["clip_norm"],
+                "loss": src["loss"],
+            }
+        except Exception as e:
+            result["detail"]["use_att_arm_error"] = repr(e)
     print(json.dumps(result))
     if failed:
         sys.exit(1)
