@@ -1,4 +1,11 @@
-"""Benchmark entry point — prints ONE JSON line for the driver.
+"""Benchmark entry point — the LAST stdout line is a compact JSON headline.
+
+Output contract (VERDICT r4 missing #1): the driver records only the final
+~2000 characters of stdout, so the FINAL line is a compact self-sufficient
+headline record (``compact_headline``, hard-capped at ``COMPACT_LIMIT``
+chars) and the full ever-growing detail record precedes it (and is written
+to ``bench_full.json``).  ``tests/test_bench_cli.py`` asserts the tail
+contract so it cannot regress.
 
 Metrics tracked (BASELINE.json "metric"): HGCN samples/sec/chip on
 ogbn-arxiv-scale graphs, and Poincaré-embedding epoch time.  The primary
@@ -182,6 +189,104 @@ def bench_sampled(repeats: int = 2) -> dict:
     return run_sampled_bench(repeats=repeats)
 
 
+def _get(d, *path):
+    """Nested dict lookup returning None on any missing key."""
+    for k in path:
+        if not isinstance(d, dict) or k not in d:
+            return None
+        d = d[k]
+    return d
+
+
+# compact-headline fields, highest priority first: when the compact line
+# must shrink to fit the tail budget, keys are dropped from the END of
+# this list.  Each entry: (compact_key, path into the full result).
+_COMPACT_FIELDS = (
+    ("step_time_s", ("detail", "step_time_s")),
+    ("frac_hbm_roofline", ("detail", "frac_hbm_roofline")),
+    ("bytes_per_step", ("detail", "bytes_per_step")),
+    ("error", ("detail", "error")),
+    ("failed_benchmark", ("detail", "failed_benchmark")),
+    ("frac_clustered", ("detail", "frac_clustered")),
+    ("num_nodes", ("detail", "num_nodes")),
+    ("devices", ("detail", "devices")),
+    ("backend", ("detail", "backend")),
+    ("use_att", ("detail", "use_att")),
+    ("lr", ("detail", "lr")),
+    ("loss", ("detail", "loss")),
+    ("att_step_s", ("detail", "use_att_arm", "step_time_s")),
+    ("att_samples_per_s_per_chip",
+     ("detail", "use_att_arm", "samples_per_s_per_chip")),
+    ("poincare_epoch_s", ("detail", "poincare_embed_epoch_time_s")),
+    ("sampled_samples_per_s",
+     ("detail", "hgcn_sampled", "supervised_samples_per_s")),
+    ("sampled_incl_samples_per_s",
+     ("detail", "hgcn_sampled", "sampling_inclusive_samples_per_s")),
+    ("realistic_mean_step_s", ("detail", "realistic", "mean_step_s")),
+    ("realistic_att_step_s", ("detail", "realistic", "att_step_s")),
+    ("realistic_frac_clustered", ("detail", "realistic", "frac_clustered")),
+    ("reorder", ("detail", "reorder")),
+    ("source", ("detail", "source")),
+    ("dtype", ("detail", "dtype")),
+    ("step", ("detail", "step")),
+)
+
+# hard byte budget for the LAST stdout line.  The driver records only the
+# final 2000 characters of stdout (BENCH_r04.json was truncated to
+# ``parsed: null`` when the single ever-growing JSON line outgrew that);
+# 1400 leaves headroom for the newline and any driver framing.
+COMPACT_LIMIT = 1400
+
+
+def compact_headline(result: dict, limit: int = COMPACT_LIMIT) -> str:
+    """One SMALL self-sufficient JSON line — always printed LAST.
+
+    Carries metric/value/unit/vs_baseline plus a priority-ordered subset
+    of the detail; guaranteed ≤ ``limit`` characters by dropping
+    lowest-priority detail keys (never the metric/value themselves).
+    """
+    fields = []
+    for key, path in _COMPACT_FIELDS:
+        v = _get(result, *path)
+        if v is not None:
+            if isinstance(v, str) and len(v) > 200:
+                v = v[:200]
+            fields.append((key, v))
+    while True:
+        line = json.dumps({
+            "metric": result.get("metric"),
+            "value": result.get("value"),
+            "unit": result.get("unit"),
+            "vs_baseline": result.get("vs_baseline"),
+            "detail": dict(fields),
+        })
+        if len(line) <= limit or not fields:
+            return line
+        fields.pop()
+
+
+def emit(result: dict) -> None:
+    """Print the full result, then the compact headline as the FINAL line.
+
+    The driver's tail capture (last 2000 chars of stdout) therefore always
+    contains one complete parseable JSON record with the headline metric,
+    regardless of how large the full detail grows.  The full record is
+    also written to ``bench_full.json`` beside this file.
+    """
+    import os
+
+    full_line = json.dumps(result)
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_full.json")
+        with open(path, "w") as f:
+            f.write(full_line + "\n")
+    except OSError:
+        pass  # read-only checkout: stdout still carries everything
+    print(full_line)
+    print(compact_headline(result))
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--metric", choices=["auto", "hgcn", "poincare"], default="auto")
@@ -275,7 +380,7 @@ def main() -> None:
             }
         except Exception as e:
             result["detail"]["use_att_arm_error"] = repr(e)
-    print(json.dumps(result))
+    emit(result)
     if failed:
         sys.exit(1)
 

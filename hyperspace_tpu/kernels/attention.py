@@ -68,7 +68,7 @@ def _t_flash_attention(q, k, v, c, beta, tau, maskf):
 
 
 def _attn_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, o_ref,
-               lse_ref, nrm_ref, m_scr, l_scr, acc_scr, *, bk: int,
+               res_ref, m_scr, l_scr, acc_scr, *, bk: int,
                masked: bool, mask_ref=None):
     ik = pl.program_id(2)
     nk_blocks = pl.num_programs(2)
@@ -123,13 +123,16 @@ def _attn_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (s / (sc * nrm)).astype(o_ref.dtype)
         # backward-pass residuals: log-sum-exp of the score rows (big
         # positive on fully-masked/padded rows so recomputed weights
-        # underflow to 0) and the pre-normalization Minkowski norm
+        # underflow to 0) and the pre-normalization Minkowski norm —
+        # PACKED into one [bq, 128] tile (lane 0 = lse, lanes 1+ = nrm)
+        # so the per-row scalars cost one output stream, not two
         l_row = l_scr[:, :1]
         lse = jnp.where(l_row > 0.0,
                         m_scr[:, :1] + jnp.log(jnp.maximum(l_row, 1e-38)),
                         1e30)
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
-        nrm_ref[0] = jnp.broadcast_to(nrm, nrm_ref.shape[1:])
+        lane_r = jax.lax.broadcasted_iota(jnp.int32, res_ref.shape[1:],
+                                          dimension=1)
+        res_ref[0] = jnp.where(lane_r == 0, lse, nrm)
 
 
 def _launch(q, k, v, c, beta_b, tau_b, maskf, mode_):
@@ -180,20 +183,20 @@ def _launch(q, k, v, c, beta_b, tau_b, maskf, mode_):
         args.append(mp)
 
     def body(*refs):
-        # layout: 4 smem + 3 vmem inputs (+ mask), 3 outs, 3 scratch
+        # layout: 4 smem + 3 vmem inputs (+ mask), 2 outs, 3 scratch
         if masked:
-            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, mk_r, o_r, ls_r, nr_r,
+            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, mk_r, o_r, rs_r,
              m_s, l_s, a_s) = refs
         else:
-            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, o_r, ls_r, nr_r,
+            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, o_r, rs_r,
              m_s, l_s, a_s) = refs
             mk_r = None
-        _attn_body(c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, o_r, ls_r, nr_r,
+        _attn_body(c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, o_r, rs_r,
                    m_s, l_s, a_s, bk=bk, masked=masked, mask_ref=mk_r)
 
     row_spec = pl.BlockSpec((1, bq, 128), lambda ib, iq, ik: (ib, iq, 0),
                             memory_space=pltpu.VMEM)
-    out, lse, nrm = pl.pallas_call(
+    out, res = pl.pallas_call(
         body,
         grid=grid,
         in_specs=in_specs,
@@ -201,11 +204,9 @@ def _launch(q, k, v, c, beta_b, tau_b, maskf, mode_):
             pl.BlockSpec((1, bq, dp), lambda ib, iq, ik: (ib, iq, 0),
                          memory_space=pltpu.VMEM),
             row_spec,
-            row_spec,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, nq_p, dp), q.dtype),
-            jax.ShapeDtypeStruct((b, nq_p, 128), jnp.float32),
             jax.ShapeDtypeStruct((b, nq_p, 128), jnp.float32),
         ],
         scratch_shapes=[
@@ -217,7 +218,7 @@ def _launch(q, k, v, c, beta_b, tau_b, maskf, mode_):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=S.interpret_flag(mode_),
     )(*args)
-    return out[:, :nq, :d], lse[:, :, 0], nrm[:, :, 0]
+    return out[:, :nq, :d], res[:, :, 0], res[:, :, 1]
 
 
 def _scalar_per_batch(x, lead, dtype):
@@ -244,7 +245,7 @@ def _score_tile(c, beta, tau, q, k, nk, ik, bk, masked, mask_ref):
 
 
 def _dq_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, dsp_ref,
-             lse_ref, di_ref, dq_ref, dst_ref, dq_scr, part_scr,
+             ld_ref, dq_ref, dst_ref, dq_scr, part_scr,
              *, bk: int, masked: bool, mask_ref=None):
     ik = pl.program_id(2)
     nk_blocks = pl.num_programs(2)
@@ -262,8 +263,8 @@ def _dq_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, dsp_ref,
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     dsp = dsp_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, :1]
-    di = di_ref[0][:, :1]
+    lse = ld_ref[0][:, :1]       # packed per-row scalars: lane 0 = lse,
+    di = ld_ref[0][:, 1:2]       # lane 1 = di (one stream, not two)
 
     sigma, valid, k_flip = _score_tile(c, beta, tau, q, k, nk, ik, bk,
                                        masked, mask_ref)
@@ -286,7 +287,7 @@ def _dq_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, dsp_ref,
 
 
 def _dkv_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, dsp_ref,
-              lse_ref, di_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+              ld_ref, dk_ref, dv_ref, dk_scr, dv_scr,
               *, bk: int, masked: bool, mask_ref=None):
     iq = pl.program_id(2)
     nq_blocks = pl.num_programs(2)
@@ -305,8 +306,8 @@ def _dkv_body(c_ref, nk_ref, beta_ref, tau_ref, q_ref, k_ref, v_ref, dsp_ref,
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     dsp = dsp_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, :1]
-    di = di_ref[0][:, :1]
+    lse = ld_ref[0][:, :1]       # packed: lane 0 = lse, lane 1 = di
+    di = ld_ref[0][:, 1:2]
 
     sigma, valid, _ = _score_tile(c, beta, tau, q, k, nk, ik, bk,
                                   masked, mask_ref)
@@ -351,11 +352,19 @@ def _bwd_launch(q, k, v, c, beta_b, tau_b, maskf, dsp, lse, di, mode_):
     qp, kp, vp = pad3(q, bq), pad3(k, bk), pad3(v, bk)
     dspp = pad3(dsp, bq)
     nq_p, nk_p = qp.shape[1], kp.shape[1]
-    lse_p = S.pad_axis(lse, -1, bq)[:, :nq_p]
+    # rows the BACKWARD padding adds beyond the forward-padded length
+    # carry the fully-masked 1e30 sentinel (ADVICE r04): lse = 0 there
+    # would make p = exp(sigma - 0) overflow and 0·inf = NaN poison
+    # dk/dv through the column sums; with the sentinel p underflows to 0
+    pad_rows = max(nq_p - lse.shape[1], 0)
+    lse_p = jnp.pad(lse, ((0, 0), (0, pad_rows)),
+                    constant_values=1e30)[:, :nq_p]
     di_p = S.pad_axis(di, -1, bq)[:, :nq_p]
-    # per-row scalars ride as [B, nq_p, 128] lanes (standard TPU layout)
-    lse_b = jnp.broadcast_to(lse_p[..., None], (b, nq_p, 128))
-    di_b = jnp.broadcast_to(di_p[..., None], (b, nq_p, 128))
+    # per-row scalars ride PACKED in one [B, nq_p, 128] stream (lane 0 =
+    # lse, lane 1 = di) — halves the broadcast residual bytes vs two
+    # full-lane arrays (ADVICE r04)
+    lane128 = jnp.arange(128)[None, None, :]
+    ld_b = jnp.where(lane128 == 0, lse_p[..., None], di_p[..., None])
 
     smem = lambda idx: pl.BlockSpec((1, 1), idx, memory_space=pltpu.SMEM)
     per_b = lambda: pl.BlockSpec((b,), lambda ib, i1, i2: (0,),
@@ -378,9 +387,8 @@ def _bwd_launch(q, k, v, c, beta_b, tau_b, maskf, dsp, lse, di, mode_):
         pl.BlockSpec((1, bk, dp), lambda ib, iq, ik: (ib, ik, 0)),
         pl.BlockSpec((1, bq, dp), lambda ib, iq, ik: (ib, iq, 0)),
         pl.BlockSpec((1, bq, 128), lambda ib, iq, ik: (ib, iq, 0)),
-        pl.BlockSpec((1, bq, 128), lambda ib, iq, ik: (ib, iq, 0)),
     ]
-    args = base_args + [qp, kp, vp, dspp, lse_b, di_b]
+    args = base_args + [qp, kp, vp, dspp, ld_b]
     if masked:
         in_specs.append(pl.BlockSpec((1, bq, bk),
                                      lambda ib, iq, ik: (ib, iq, ik)))
@@ -388,13 +396,13 @@ def _bwd_launch(q, k, v, c, beta_b, tau_b, maskf, dsp, lse, di, mode_):
 
     def dq_kernel(*refs):
         if masked:
-            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r, mk_r,
+            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ld_r, mk_r,
              dq_r, st_r, dq_s, pt_s) = refs
         else:
-            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r,
+            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ld_r,
              dq_r, st_r, dq_s, pt_s) = refs
             mk_r = None
-        _dq_body(c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r,
+        _dq_body(c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ld_r,
                  dq_r, st_r, dq_s, pt_s, bk=bk, masked=masked,
                  mask_ref=mk_r)
 
@@ -431,9 +439,8 @@ def _bwd_launch(q, k, v, c, beta_b, tau_b, maskf, dsp, lse, di, mode_):
         pl.BlockSpec((1, bk, dp), lambda ib, ik, iq: (ib, ik, 0)),
         pl.BlockSpec((1, bq, dp), lambda ib, ik, iq: (ib, iq, 0)),
         pl.BlockSpec((1, bq, 128), lambda ib, ik, iq: (ib, iq, 0)),
-        pl.BlockSpec((1, bq, 128), lambda ib, ik, iq: (ib, iq, 0)),
     ]
-    args2 = base_args + [qp, kp, vp, dspp, lse_b, di_b]
+    args2 = base_args + [qp, kp, vp, dspp, ld_b]
     if masked:
         in_specs2.append(pl.BlockSpec((1, bq, bk),
                                       lambda ib, ik, iq: (ib, iq, ik)))
@@ -441,13 +448,13 @@ def _bwd_launch(q, k, v, c, beta_b, tau_b, maskf, dsp, lse, di, mode_):
 
     def dkv_kernel(*refs):
         if masked:
-            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r, mk_r,
+            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ld_r, mk_r,
              dk_r, dv_r, dk_s, dv_s) = refs
         else:
-            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r,
+            (c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ld_r,
              dk_r, dv_r, dk_s, dv_s) = refs
             mk_r = None
-        _dkv_body(c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ls_r, di_r,
+        _dkv_body(c_r, nk_r, be_r, ta_r, q_r, k_r, v_r, ds_r, ld_r,
                   dk_r, dv_r, dk_s, dv_s, bk=bk, masked=masked,
                   mask_ref=mk_r)
 

@@ -58,17 +58,13 @@ class ClusterPlan(NamedTuple):
 
     Items are receiver-block-major; ``first`` marks each rb's first item
     (the kernel zeroes the output tile there).  Every receiver block gets
-    at least one item even if it owns no clustered edge.  ``first_chunk``
-    marks the first item touching each edge CHUNK — the edge-aligned
-    output of :func:`cluster_sddmm` zeroes its chunk block there (a
-    boundary chunk is visited by two pairs and must accumulate).
+    at least one item even if it owns no clustered edge.
     """
 
     rb: np.ndarray     # [T] item -> receiver-block index
     sb: np.ndarray     # [T] item -> sender-block index
     chunk: np.ndarray  # [T] item -> edge-chunk index
     first: np.ndarray  # [T] 1 iff first item of its receiver block
-    first_chunk: np.ndarray  # [T] 1 iff first item of its edge chunk
 
 
 def build_cluster_plan(
@@ -123,10 +119,7 @@ def build_cluster_plan(
     chunk_items = chunk_items[order].astype(np.int32)
     first = np.zeros(len(rb_items), np.int32)
     first[np.flatnonzero(np.r_[True, rb_items[1:] != rb_items[:-1]])] = 1
-    first_chunk = np.zeros(len(chunk_items), np.int32)
-    _, idx0 = np.unique(chunk_items, return_index=True)
-    first_chunk[idx0] = 1
-    return ClusterPlan(rb_items, sb_items, chunk_items, first, first_chunk)
+    return ClusterPlan(rb_items, sb_items, chunk_items, first)
 
 
 def _body(bn: int, bs: int, fast_bf16: bool):
@@ -227,109 +220,369 @@ def cluster_aggregate(
     return out[:num_nodes, :f].astype(h.dtype)
 
 
-# --- cluster SDDMM: per-edge <g[r], h[s]> without [E, F] gathers --------------
+# --- fused in-tile attention: logits computed from VMEM-resident blocks -------
+#
+# r04 measured the attention step's cost to be the COUNT of [E]-length
+# HBM passes (~10–28 ms per 2.4 M-row pass, width-independent), and the
+# r04 weighted cluster path was a wash precisely because routing runtime
+# weights into the cluster layout added [E] gathers back.  The r05 fix:
+# never materialize clustered-edge weights at all.  With both endpoint
+# blocks resident in VMEM, the GAT logit α_s[s_e] + α_r[r_e] is two
+# masked one-hot picks from [bs]/[bn] score vectors, the bounded-logit
+# softmax weight exp(B·tanh(leaky(·)/B)) is VPU math, and the weighted
+# aggregation is the same two-matmul program as the mean kernel — so
+# clustered edges never touch the [E] stream in EITHER direction.  The
+# forward emits unnormalized [num | den] partials ([N, F+1]); the
+# straggler edges run the planned fused path and the division happens
+# once on the combined [N, F+1] (nn/scatter.cluster_att_partial).
+#
+# The backward is one kernel producing dh AND both score gradients, all
+# receiver-block-indexed via the edge involution (the clustered set is
+# reversal-closed):
+#
+#   dh[i]   = Σ_{e: r_e=i} w_rev(e) · d_num[s_e]
+#   dα_r[i] = Σ_{e: r_e=i} dpre_e
+#   dα_s[i] = Σ_{e: r_e=i} dpre_rev(e)
+#
+# with w_rev(e) = f(α_s[r_e] + α_r[s_e]) (the reverse edge's weight —
+# both alphas resident), dw_e = <d_num[r_e], h[s_e]> + d_den[r_e], and
+# dpre = dw · w · f'(pre).  No [E]-aligned array exists anywhere.
 
 
-def _sddmm_body(bn: int, bs: int, fast_bf16: bool):
+def _att_squash(pre, bound, slope):
+    """bounded_att_logits + its derivative, shared by both kernel bodies
+    (mirrors nn.gcn.bounded_att_logits exactly)."""
+    lam = jnp.where(pre >= 0, pre, slope * pre)
+    th = jnp.tanh(lam / bound)
+    w = jnp.exp(bound * th)
+    dpre_factor = w * (1.0 - th * th) * jnp.where(pre >= 0, 1.0, slope)
+    return w, dpre_factor
+
+
+def _pick_grouped(vec_t, idx):
+    """Per-edge pick from a resident score tile in its native layout.
+
+    ``vec_t`` is [G, 128] f32 (a length-G·128 vector as loaded from its
+    (1, G, 128) block), ``idx`` is [128] int32 local indices; returns the
+    [128] picked values, 0 where idx is out of [0, G·128) — masked
+    one-hot reduces only, no cross-lane reshape (Mosaic-safe).
+    """
+    g_idx = idx // 128
+    l_idx = idx % 128
+    rows = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+    sel = rows == l_idx[None, :]
+    out = jnp.zeros((128,), jnp.float32)
+    for g in range(vec_t.shape[0]):
+        v = jnp.sum(jnp.where(sel, vec_t[g][:, None], 0.0), axis=0)
+        out = out + jnp.where(g_idx == g, v, 0.0)
+    return out
+
+
+def _att_fwd_body(bn, bs, f, fp, fp_ext, fast_bf16, bound, slope):
     prec = None if fast_bf16 else jax.lax.Precision.HIGHEST
     dt = jnp.bfloat16 if fast_bf16 else jnp.float32
 
-    def body(rb_ref, sb_ref, chk_ref, firstc_ref, r_ref, s_ref,
-             g_ref, h_ref, o_ref):
+    def body(rb_ref, sb_ref, chk_ref, first_ref, r_ref, s_ref, h_ref,
+             as_ref, ar_ref, o_ref):
         t = pl.program_id(0)
         rb = rb_ref[t]
         sb = sb_ref[t]
 
-        @pl.when(firstc_ref[t] == 1)
+        @pl.when(first_ref[t] == 1)
         def _():
             o_ref[:] = jnp.zeros_like(o_ref)
 
-        r = r_ref[0]                    # [bk//128, 128] int32 (global)
+        r = r_ref[0]                       # [bk//128, 128] int32 (global)
         s = s_ref[0]
-        g_t = g_ref[:].astype(dt)       # [bn, F]
-        h_t = h_ref[:].astype(dt)       # [bs, F]
-        rows_r = jax.lax.broadcasted_iota(jnp.int32, (128, bn), 1)
-        rows_s = jax.lax.broadcasted_iota(jnp.int32, (128, bs), 1)
+        h_t = h_ref[:].astype(dt)          # [bs, fp]
+        a_s_t = as_ref[0]                  # [bs//128, 128] f32 (senders)
+        a_r_t = ar_ref[0]                  # [bn//128, 128] f32 (receivers)
+        acc = jnp.zeros((bn, fp_ext), jnp.float32)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bn, 128), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bs, 128), 0)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, fp_ext), 1)
         for j in range(r.shape[0]):
-            lr = r[j] - rb * bn          # [128]; out-of-range rows -> all-0
-            ls = s[j] - sb * bs
-            a_oh = (rows_r == lr[:, None]).astype(dt)        # [128, bn]
-            b_oh = (rows_s == ls[:, None]).astype(dt)        # [128, bs]
-            ge = jnp.dot(a_oh, g_t, preferred_element_type=jnp.float32,
-                         precision=prec)                     # [128, F]
-            he = jnp.dot(b_oh, h_t, preferred_element_type=jnp.float32,
-                         precision=prec)
-            o_ref[0, j, :] += jnp.sum(ge * he, axis=-1)
+            ls = s[j] - sb * bs            # [128]; out-of-range matches 0
+            lr = r[j] - rb * bn
+            sel_s = cols == ls[None, :]    # [bs, 128]
+            sel_r = rows == lr[None, :]    # [bn, 128]
+            # the in-tile logit: two masked picks + VPU squash (no [E]
+            # stream); out-of-pair lanes (boundary chunks, padding ids)
+            # are killed by the ls validity mask — sel_r alone would let
+            # a same-rb neighbor pair's edge leak into the denominator
+            pre = _pick_grouped(a_s_t, ls) + _pick_grouped(a_r_t, lr)
+            w, _ = _att_squash(pre, bound, slope)
+            w = jnp.where((ls >= 0) & (ls < bs), w, 0.0)
+            tmp = jnp.dot(sel_s.T.astype(dt), h_t,       # [128, fp] picks
+                          preferred_element_type=jnp.float32,
+                          precision=prec)
+            # num|den ride one matmul: a constant-1 column at lane f
+            if fp_ext > fp:
+                extra = (jax.lax.broadcasted_iota(
+                    jnp.int32, (128, fp_ext - fp), 1) == (f - fp)
+                ).astype(jnp.float32)
+                tmp_ext = jnp.concatenate([tmp, extra], axis=1)
+            else:                          # h padding lanes are 0 -> safe
+                tmp_ext = tmp + (lane == f).astype(jnp.float32)
+            a_w = jnp.where(sel_r, w[None, :], 0.0)      # [bn, 128]
+            acc += jnp.dot(a_w.astype(dt), tmp_ext.astype(dt),
+                           preferred_element_type=jnp.float32,
+                           precision=prec)
+        o_ref[:] += acc
 
     return body
 
 
-def cluster_sddmm(
-    g: jax.Array,          # [N, F] cotangent rows (receiver side)
-    h: jax.Array,          # [N, F] node values (sender side)
+def cluster_att_fwd(
+    h: jax.Array,          # [N, F] node values (agg dtype; bf16 = fast path)
+    alpha_s: jax.Array,    # [N] sender attention scores
+    alpha_r: jax.Array,    # [N] receiver attention scores
     receivers: jax.Array,  # [E] int32 global, sorted by (rb, sb)
     senders: jax.Array,    # [E] int32 global, aligned
-    plan: tuple,           # ClusterPlan device arrays (uses first_chunk)
+    plan: tuple,           # ClusterPlan device arrays
     num_nodes: int,
+    negative_slope: float = 0.2,
+    bound: float = 30.0,
     bn: int = _BN,
     bs: int = _BS,
     bk: int = _BK,
 ) -> jax.Array:
-    """Sampled dense-dense matmul on the cluster layout:
-    ``out[e] = <g[receivers_e], h[senders_e]>`` — the attention dw
-    backward — computed per (rb, sb) pair from VMEM-resident tiles (two
-    one-hot MXU matmuls + a row reduce per 128-edge sub-chunk) instead of
-    two [E, F] HBM gathers.  Output is edge-aligned, padded to a ``bk``
-    multiple (padding lanes read 0).  Twin/oracle: the gathered row dot.
-
-    An edge appears in exactly one (rb, sb) pair; a visiting pair that
-    does not own a lane's edge contributes 0 there (its one-hot row is
-    empty), so boundary-chunk accumulation across consecutive pairs is
-    exact.  bf16 inputs take the fast MXU mode: each one-hot matmul is a
-    pure row pick (single-term sums, exact in bf16) and the dot-product
-    reduce accumulates f32.
+    """[N, F+1] f32 unnormalized attention partials over the clustered
+    edges: ``out[r] = Σ_e w_e·[h[s_e] | 1]`` with
+    ``w_e = exp(bounded_att_logits(α_s[s_e]+α_r[r_e]))`` computed
+    IN-TILE.  Twin/oracle: exp/mask/segment-sum of the gathered chain.
     """
+    f = h.shape[-1]
     m = S.mode()
     e = receivers.shape[0]
-    e_pad = S.round_up(max(e, 1), bk)
     if m == "xla" or e == 0:
         if e == 0:
-            return jnp.zeros((e_pad,), jnp.float32)
-        acc = jnp.sum(g[receivers].astype(jnp.float32)
-                      * h[senders].astype(jnp.float32), axis=-1)
-        return jnp.pad(acc, (0, e_pad - e))
-    f = h.shape[-1]
+            return jnp.zeros((num_nodes, f + 1), jnp.float32)
+        pre = (alpha_s.astype(jnp.float32)[senders]
+               + alpha_r.astype(jnp.float32)[receivers])
+        w, _ = _att_squash(pre, bound, negative_slope)
+        w = w.astype(h.dtype).astype(jnp.float32)  # match kernel rounding
+        msgs = jnp.concatenate(
+            [w[:, None] * h[senders].astype(jnp.float32), w[:, None]],
+            axis=1)
+        return jax.ops.segment_sum(msgs, receivers, num_nodes)
     fp = S.round_up(f, 128)
+    fp_ext = S.round_up(f + 1, 128)
     n_pad = S.round_up(num_nodes, max(bn, bs))
-    g_p = S.pad_axis(S.pad_axis(g, -1, 128), 0, max(bn, bs))
     h_p = S.pad_axis(S.pad_axis(h, -1, 128), 0, max(bn, bs))
+    a_s2 = jnp.pad(alpha_s.astype(jnp.float32),
+                   (0, n_pad - num_nodes)).reshape(n_pad // bs,
+                                                   bs // 128, 128)
+    a_r2 = jnp.pad(alpha_r.astype(jnp.float32),
+                   (0, n_pad - num_nodes)).reshape(n_pad // bn,
+                                                   bn // 128, 128)
+    e_pad = S.round_up(e, bk)
     pad_ids = lambda a: jnp.pad(a, (0, e_pad - e), constant_values=n_pad)
     r2d = pad_ids(receivers).reshape(e_pad // bk, bk // 128, 128)
     s2d = pad_ids(senders).reshape(e_pad // bk, bk // 128, 128)
     t = plan[0].shape[0]
-    fast_bf16 = (h.dtype == jnp.bfloat16 and g.dtype == jnp.bfloat16)
+    fast_bf16 = h.dtype == jnp.bfloat16
+    chunk_spec = pl.BlockSpec((1, bk // 128, 128),
+                              lambda t, rb, sb, chk, first: (chk[t], 0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(t,),
         in_specs=[
-            pl.BlockSpec((1, bk // 128, 128),
-                         lambda t, rb, sb, chk, fc: (chk[t], 0, 0)),
-            pl.BlockSpec((1, bk // 128, 128),
-                         lambda t, rb, sb, chk, fc: (chk[t], 0, 0)),
-            pl.BlockSpec((bn, fp), lambda t, rb, sb, chk, fc: (rb[t], 0)),
-            pl.BlockSpec((bs, fp), lambda t, rb, sb, chk, fc: (sb[t], 0)),
+            chunk_spec, chunk_spec,
+            pl.BlockSpec((bs, fp), lambda t, rb, sb, chk, first: (sb[t], 0)),
+            pl.BlockSpec((1, bs // 128, 128),
+                         lambda t, rb, sb, chk, first: (sb[t], 0, 0)),
+            pl.BlockSpec((1, bn // 128, 128),
+                         lambda t, rb, sb, chk, first: (rb[t], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bk // 128, 128),
-                               lambda t, rb, sb, chk, fc: (chk[t], 0, 0)),
+        out_specs=pl.BlockSpec((bn, fp_ext),
+                               lambda t, rb, sb, chk, first: (rb[t], 0)),
     )
     out = pl.pallas_call(
-        _sddmm_body(bn, bs, fast_bf16),
+        _att_fwd_body(bn, bs, f, fp, fp_ext, fast_bf16,
+                      float(bound), float(negative_slope)),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((e_pad // bk, bk // 128, 128),
+        out_shape=jax.ShapeDtypeStruct((S.round_up(n_pad, bn), fp_ext),
                                        jnp.float32),
         interpret=S.interpret_flag(m),
-    )(plan[0], plan[1], plan[2], plan[4], r2d, s2d, g_p, h_p)
-    return out.reshape(e_pad)
+    )(*tuple(plan)[:4], r2d, s2d, h_p, a_s2, a_r2)
+    return out[:num_nodes, : f + 1]
+
+
+def _att_bwd_body(bn, bs, f, fp, fp_ext, fp_out, fast_bf16, bound, slope):
+    prec = None if fast_bf16 else jax.lax.Precision.HIGHEST
+    dt = jnp.bfloat16 if fast_bf16 else jnp.float32
+
+    def body(rb_ref, sb_ref, chk_ref, first_ref, r_ref, s_ref,
+             g_rb_ref, g_sb_ref, h_rb_ref, h_sb_ref,
+             as_rb_ref, as_sb_ref, ar_rb_ref, ar_sb_ref, o_ref):
+        t = pl.program_id(0)
+        rb = rb_ref[t]
+        sb = sb_ref[t]
+
+        @pl.when(first_ref[t] == 1)
+        def _():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        r = r_ref[0]
+        s = s_ref[0]
+        g_rb = g_rb_ref[:].astype(dt)        # [bn, fp_ext] d_num|d_den
+        g_sb = g_sb_ref[:].astype(dt)        # [bs, fp_ext]
+        h_rb = h_rb_ref[:].astype(dt)        # [bn, fp]
+        h_sb = h_sb_ref[:].astype(dt)        # [bs, fp]
+        a_s_rb = as_rb_ref[0]                # [bn//128, 128] f32
+        a_s_sb = as_sb_ref[0]                # [bs//128, 128]
+        a_r_rb = ar_rb_ref[0]
+        a_r_sb = ar_sb_ref[0]
+        acc = jnp.zeros((bn, fp_out), jnp.float32)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bn, 128), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bs, 128), 0)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, fp_out), 1)
+        num_lanes = (jax.lax.broadcasted_iota(jnp.int32, (1, fp), 1)
+                     < f).astype(jnp.float32)
+        for j in range(r.shape[0]):
+            ls = s[j] - sb * bs
+            lr = r[j] - rb * bn
+            sel_s = cols == ls[None, :]      # [bs, 128]
+            sel_r = rows == lr[None, :]      # [bn, 128]
+            valid = ((ls >= 0) & (ls < bs) & (lr >= 0) & (lr < bn)
+                     ).astype(jnp.float32)
+            b_oh = sel_s.T.astype(dt)        # [128, bs]
+            r_oh = sel_r.T.astype(dt)        # [128, bn]
+            gs = jnp.dot(b_oh, g_sb, preferred_element_type=jnp.float32,
+                         precision=prec)     # [128, fp_ext]  rows d[s_e]
+            gr = jnp.dot(r_oh, g_rb, preferred_element_type=jnp.float32,
+                         precision=prec)     # [128, fp_ext]  rows d[r_e]
+            hs = jnp.dot(b_oh, h_sb, preferred_element_type=jnp.float32,
+                         precision=prec)     # [128, fp]      rows h[s_e]
+            hr = jnp.dot(r_oh, h_rb, preferred_element_type=jnp.float32,
+                         precision=prec)     # [128, fp]      rows h[r_e]
+            # dw_e = <d_num[r_e], h[s_e]> + d_den[r_e]; the h padding
+            # lanes are 0, so full-width products exclude lane f safely
+            dw = jnp.sum(gr[:, :fp] * hs, axis=1) + gr[:, f]
+            dw_rev = jnp.sum(gs[:, :fp] * hr, axis=1) + gs[:, f]
+            pre = _pick_grouped(a_s_sb, ls) + _pick_grouped(a_r_rb, lr)
+            pre_rev = (_pick_grouped(a_s_rb, lr)
+                       + _pick_grouped(a_r_sb, ls))
+            w, dfac = _att_squash(pre, bound, slope)
+            w_rev, dfac_rev = _att_squash(pre_rev, bound, slope)
+            dpre = dw * dfac * valid
+            dpre_rev = dw_rev * dfac_rev * valid
+            # dh[r] += w_rev · d_num[s]: mask d_den out of the gs rows,
+            # keep only the first f lanes live
+            gs_num = gs[:, :fp] * num_lanes
+            if fp_out > fp:
+                gs_num = jnp.concatenate(
+                    [gs_num, jnp.zeros((128, fp_out - fp), jnp.float32)],
+                    axis=1)
+            a_w_rev = jnp.where(sel_r, (w_rev * valid)[None, :], 0.0)
+            acc += jnp.dot(a_w_rev.astype(dt), gs_num.astype(dt),
+                           preferred_element_type=jnp.float32,
+                           precision=prec)
+            # score gradients ride lanes f (dα_r) and f+1 (dα_s)
+            da_r = jnp.sum(jnp.where(sel_r, dpre[None, :], 0.0), axis=1)
+            da_s = jnp.sum(jnp.where(sel_r, dpre_rev[None, :], 0.0),
+                           axis=1)
+            acc += (da_r[:, None] * (lane == f)
+                    + da_s[:, None] * (lane == f + 1))
+        o_ref[:] += acc
+
+    return body
+
+
+def cluster_att_bwd(
+    g_ext: jax.Array,      # [N, F+1] f32 cotangent (d_num | d_den)
+    h: jax.Array,          # [N, F] node values (same array as forward)
+    alpha_s: jax.Array,    # [N]
+    alpha_r: jax.Array,    # [N]
+    receivers: jax.Array,  # [E] int32 global, sorted by (rb, sb)
+    senders: jax.Array,    # [E]
+    plan: tuple,
+    num_nodes: int,
+    negative_slope: float = 0.2,
+    bound: float = 30.0,
+    bn: int = _BN,
+    bs: int = _BS,
+    bk: int = _BK,
+):
+    """Backward of :func:`cluster_att_fwd`: returns
+    ``(dh [N, F] f32, d_alpha_s [N] f32, d_alpha_r [N] f32)`` — one
+    kernel, everything receiver-block-indexed via the edge involution
+    (module comment above).  Twin/oracle: jax.vjp of the gathered chain.
+    """
+    f = h.shape[-1]
+    m = S.mode()
+    e = receivers.shape[0]
+    if m == "xla" or e == 0:
+        if e == 0:
+            z = jnp.zeros((num_nodes,), jnp.float32)
+            return jnp.zeros((num_nodes, f), jnp.float32), z, z
+
+        def fwd(hh, a_s, a_r):
+            pre = a_s[senders] + a_r[receivers]
+            w, _ = _att_squash(pre, bound, negative_slope)
+            w = w.astype(hh.dtype).astype(jnp.float32)
+            msgs = jnp.concatenate(
+                [w[:, None] * hh.astype(jnp.float32)[senders], w[:, None]],
+                axis=1)
+            return jax.ops.segment_sum(msgs, receivers, num_nodes)
+
+        _, vjp = jax.vjp(fwd, h, alpha_s.astype(jnp.float32),
+                         alpha_r.astype(jnp.float32))
+        dh, da_s, da_r = vjp(g_ext.astype(jnp.float32))
+        return dh.astype(jnp.float32), da_s, da_r
+    fp = S.round_up(f, 128)
+    fp_ext = S.round_up(f + 1, 128)
+    fp_out = S.round_up(f + 2, 128)
+    n_pad = S.round_up(num_nodes, max(bn, bs))
+    g_p = S.pad_axis(S.pad_axis(g_ext.astype(jnp.float32), -1, 128),
+                     0, max(bn, bs))
+    h_p = S.pad_axis(S.pad_axis(h, -1, 128), 0, max(bn, bs))
+    a_pad = lambda a: jnp.pad(a.astype(jnp.float32), (0, n_pad - num_nodes))
+    a_s_sb = a_pad(alpha_s).reshape(n_pad // bs, bs // 128, 128)
+    a_s_rb = a_pad(alpha_s).reshape(n_pad // bn, bn // 128, 128)
+    a_r_sb = a_pad(alpha_r).reshape(n_pad // bs, bs // 128, 128)
+    a_r_rb = a_pad(alpha_r).reshape(n_pad // bn, bn // 128, 128)
+    e_pad = S.round_up(e, bk)
+    pad_ids = lambda a: jnp.pad(a, (0, e_pad - e), constant_values=n_pad)
+    r2d = pad_ids(receivers).reshape(e_pad // bk, bk // 128, 128)
+    s2d = pad_ids(senders).reshape(e_pad // bk, bk // 128, 128)
+    t = plan[0].shape[0]
+    fast_bf16 = h.dtype == jnp.bfloat16
+    chunk_spec = pl.BlockSpec((1, bk // 128, 128),
+                              lambda t, rb, sb, chk, first: (chk[t], 0, 0))
+    rb_spec = lambda w_: pl.BlockSpec(
+        (bn, w_), lambda t, rb, sb, chk, first: (rb[t], 0))
+    sb_spec = lambda w_: pl.BlockSpec(
+        (bs, w_), lambda t, rb, sb, chk, first: (sb[t], 0))
+    vec_rb = pl.BlockSpec((1, bn // 128, 128),
+                          lambda t, rb, sb, chk, first: (rb[t], 0, 0))
+    vec_sb = pl.BlockSpec((1, bs // 128, 128),
+                          lambda t, rb, sb, chk, first: (sb[t], 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(t,),
+        in_specs=[
+            chunk_spec, chunk_spec,
+            rb_spec(fp_ext), sb_spec(fp_ext),      # g at rb, sb
+            rb_spec(fp), sb_spec(fp),              # h at rb, sb
+            vec_rb, vec_sb,                        # alpha_s at rb, sb
+            vec_rb, vec_sb,                        # alpha_r at rb, sb
+        ],
+        out_specs=pl.BlockSpec((bn, fp_out),
+                               lambda t, rb, sb, chk, first: (rb[t], 0)),
+    )
+    out = pl.pallas_call(
+        _att_bwd_body(bn, bs, f, fp, fp_ext, fp_out, fast_bf16,
+                      float(bound), float(negative_slope)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S.round_up(n_pad, bn), fp_out),
+                                       jnp.float32),
+        interpret=S.interpret_flag(m),
+    )(*tuple(plan)[:4], r2d, s2d, g_p, g_p, h_p, h_p,
+      a_s_rb, a_s_sb, a_r_rb, a_r_sb)
+    return (out[:num_nodes, :f], out[:num_nodes, f + 1],
+            out[:num_nodes, f])
 
 
 # --- host-side split: clustered pairs vs stragglers ---------------------------
@@ -345,15 +598,16 @@ class ClusterSplit(NamedTuple):
     involution backward needs no index lookup (same trick as
     parallel/node_shard.py).
 
-    The ``*_map`` fields route RUNTIME per-edge weights (attention) from
-    the prepare layout into the two split layouts without a scatter:
-    ``w_c = w[c_map]`` etc.  ``c_map_rev = rev_perm[c_map]`` so the
-    involution backward's reversed weights are one more static gather.
-    ``inv_map`` goes the other way — ``dw[e] =
-    concat(dw_c_pad, dw_s, [0])[inv_map[e]]`` reconstitutes a prepare-
-    layout per-edge gradient from the two split-layout pieces with a
-    gather instead of a scatter.  All maps are None when the split was
-    built without ``rev_perm`` (weighted aggregation then unsupported).
+    For attention (nn/scatter.cluster_att_partial) the clustered edges
+    run the in-tile kernels above (which need nothing beyond the ids),
+    and the STRAGGLER edges run the planned fused attention path on
+    their own layout — which needs a self-contained edge involution:
+    ``s_rev_local[i]`` is the straggler-array position of edge i's
+    reverse (the straggler set is reversal-closed because pair (a, b)
+    and its mirror (b, a) always share a density class; padding rows map
+    to themselves).  ``s_mask`` is the bool validity mask of the padded
+    straggler rows.  Both are None when the split was built without
+    ``rev_perm`` (attention-on-cluster then unsupported).
     """
 
     c_recv: np.ndarray   # [Ec] clustered receivers, (rb, sb)-sorted
@@ -367,16 +621,8 @@ class ClusterSplit(NamedTuple):
     s_wb: np.ndarray
     s_plan: tuple        # block-CSR plan for the straggler receivers
     frac_clustered: float
-    c_map: np.ndarray | None = None      # [Ec] prepare-layout edge index
-    c_map_rev: np.ndarray | None = None  # [Ec] index of the reverse edge
-    s_map: np.ndarray | None = None      # [Es] (padding entries -> 0)
-    s_map_rev: np.ndarray | None = None  # [Es]
-    s_valid: np.ndarray | None = None    # [Es] f32 1 on real stragglers
-    inv_map: np.ndarray | None = None    # [E] -> slot in the dw concat
-    # the clustered-dw slot count inv_map was built against; the dw
-    # backward pads/slices cluster_sddmm's output to THIS length so a
-    # split built with a non-default bk can never misalign the concat
-    ec_pad: int = 0
+    s_rev_local: np.ndarray | None = None  # [Es] straggler involution
+    s_mask: np.ndarray | None = None       # [Es] bool, 1 on real rows
 
 
 def build_cluster_split(
@@ -424,25 +670,21 @@ def build_cluster_split(
     s_wb[: len(s_recv)] = 1.0 / d[s_send]
     s_plan = tuple(build_csr_plan(s_recv_p, num_nodes, bn=128, bk=bk))
 
-    # weighted-aggregation routing maps (module doc); need rev_perm so
-    # the backward can gather the reverse edge's weight statically
+    # straggler-local involution (ClusterSplit doc): lets the planned
+    # fused attention path run self-contained on the straggler layout
     maps: dict = {}
     if rev_perm is not None:
         rp = np.asarray(rev_perm)
-        c_map = pos[c_idx].astype(np.int32)
-        s_map = np.zeros(e_s, np.int32)
-        s_map[: len(s_idx)] = pos[s_idx]
-        s_valid = np.zeros(e_s, np.float32)
-        s_valid[: len(s_idx)] = 1.0
-        ec_pad = S.round_up(max(len(c_map), 1), bk)  # kernel output size
-        inv_map = np.full(len(mask), ec_pad + e_s, np.int32)  # zero slot
-        inv_map[pos[c_idx]] = np.arange(len(c_idx), dtype=np.int32)
-        inv_map[pos[s_idx]] = ec_pad + np.arange(len(s_idx), dtype=np.int32)
-        maps = dict(
-            c_map=c_map, c_map_rev=rp[c_map].astype(np.int32),
-            s_map=s_map, s_map_rev=rp[s_map].astype(np.int32) * (
-                s_valid > 0),  # padding rows point at edge 0, masked out
-            s_valid=s_valid, inv_map=inv_map, ec_pad=int(ec_pad))
+        loc = np.full(len(mask), -1, np.int64)   # prepare idx -> slot
+        loc[pos[s_idx]] = np.arange(len(s_idx))
+        s_rev_local = np.arange(e_s, dtype=np.int32)  # padding: self-map
+        s_rev_local[: len(s_idx)] = loc[rp[pos[s_idx]]]
+        if len(s_idx) and s_rev_local[: len(s_idx)].min() < 0:
+            raise AssertionError(
+                "straggler set not closed under edge reversal")
+        s_mask = np.zeros(e_s, bool)
+        s_mask[: len(s_idx)] = True
+        maps = dict(s_rev_local=s_rev_local, s_mask=s_mask)
 
     return ClusterSplit(
         c_recv=c_recv.astype(np.int32), c_send=c_send.astype(np.int32),

@@ -372,7 +372,8 @@ class SampledBatchStream:
 
     def __init__(self, cfg: SampledConfig, task: str, *, num_nodes: int,
                  edges=None, labels=None, train_mask=None, train_pos=None,
-                 chunk_steps: int = 64, depth: int = 2, seed: int = 0):
+                 chunk_steps: int = 64, depth: int = 2, seed: int = 0,
+                 start_chunk: int = 0):
         import queue
         import threading
 
@@ -381,6 +382,11 @@ class SampledBatchStream:
         self.chunk_steps = int(chunk_steps)
         self._seed = int(seed)
         self._num_nodes = int(num_nodes)
+        # resume support (ADVICE r04): a run restored at step R passes
+        # start_chunk = R // chunk_steps so the chunk sequence CONTINUES
+        # instead of replaying chunks 0..R/chunk_steps — the "never a
+        # repeated batch" guarantee holds across restarts
+        self._start_chunk = int(start_chunk)
         if task == "nc":
             self._indptr, self._indices = build_adjacency(edges, num_nodes)
             self._train_nodes = np.flatnonzero(np.asarray(train_mask))
@@ -411,7 +417,7 @@ class SampledBatchStream:
     def _worker(self):
         import queue
 
-        chunk = 0
+        chunk = self._start_chunk
         while not self._stop.is_set():
             try:
                 levels, lab = self._plan(chunk)

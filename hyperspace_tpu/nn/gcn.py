@@ -212,61 +212,55 @@ class HGCConv(nn.Module):
 
         sorted_fast = g.rev_perm is not None
         w_static = False
-        den_planned = False  # planned softmax: denominator folded post-agg
         if self.use_att:
             # GAT-style additive attention in the tangent chart.
             a_s = self.param("att_src", self.kernel_init, (self.features, 1), h.dtype)
             a_r = self.param("att_dst", self.kernel_init, (self.features, 1), h.dtype)
             alpha_s = (h @ a_s)[:, 0]
             alpha_r = (h @ a_r)[:, 0]
-            use_cluster_att = (sorted_fast and g.plan is not None
-                               and g.cluster is not None
-                               and g.cluster.weighted_ok)
-            if sorted_fast and g.plan is not None and not use_cluster_att:
-                # fused planned path (nn/scatter.att_aggregate_planned):
+            if sorted_fast and g.plan is not None:
+                # fused planned path (nn/scatter.att_partial_planned):
                 # the sender pick rides the message gather as an extra
                 # feature column (ONE random [E] gather/layer), bounded-
                 # logit softmax needs no max pass, num/den are one CSR
                 # pass each, and the backward re-uses saved residual rows
                 # instead of re-gathering.  (Row gathers cost ~28 ms per
                 # 2.4 M edges on v5e regardless of width — pass count is
-                # the whole game.)
-                from hyperspace_tpu.nn.scatter import att_aggregate_planned
-
-                agg = att_aggregate_planned(
-                    h, alpha_s, alpha_r, senders, receivers, g.rev_perm,
-                    edge_mask, g.plan, n, self.agg_dtype, 0.2)
-                out = from_tangent0_coords(
-                    m_out, self.activation(agg.astype(h.dtype)))
-                return out, m_out
-            if use_cluster_att:
-                # well-clustered graphs: per-edge weights through the
-                # cluster-pair kernel instead (planned picks feed the
-                # logits; the dw backward is the cluster SDDMM)
+                # the whole game.)  On well-clustered graphs the
+                # clustered edges drop out of the [E] stream entirely:
+                # their logits, weights, aggregation, and whole backward
+                # run in-tile from VMEM-resident blocks
+                # (nn/scatter.cluster_att_partial), and only the
+                # straggler subset pays the planned passes.  The two
+                # [N, F+1] (num | den) partials add and divide ONCE.
                 from hyperspace_tpu.nn.scatter import (
-                    pick_receivers,
-                    pick_senders,
-                    planned_segment_sum_1d,
+                    att_combine,
+                    att_partial_planned,
+                    cluster_att_partial,
                 )
 
-                pb_, pc_, pf_ = g.plan
-                lm = bounded_att_logits(
-                    pick_senders(alpha_s, senders, receivers, g.rev_perm,
-                                 pb_, pc_, pf_, n)
-                    + pick_receivers(alpha_r, receivers, pb_, pc_, pf_, n))
-                maskf = jax.lax.stop_gradient(edge_mask.astype(lm.dtype))
-                # masked lanes: exp(lm) ≤ e^30 is finite, the mask zeroes
-                # them — no -inf fill needed.  The denominator is summed
-                # *after* the agg_dtype cast below so numerator and
-                # denominator see identically-rounded weights.
-                w = jnp.exp(lm) * maskf
-                den_planned = True
-            else:
-                logits = bounded_att_logits(
-                    alpha_s[senders] + alpha_r[receivers])
-                w = segment_softmax(logits, receivers, n, mask=edge_mask,
-                                    indices_are_sorted=sorted_fast)
-                att_den = None
+                cl = g.cluster
+                if cl is not None and cl.att_ok:
+                    h_in = (h if self.agg_dtype is None
+                            else h.astype(self.agg_dtype))
+                    nd = cluster_att_partial(h_in, alpha_s, alpha_r, cl,
+                                             n, 0.2)
+                    nd = nd + att_partial_planned(
+                        h, alpha_s, alpha_r, cl.s_send, cl.s_recv,
+                        cl.s_rev_local, cl.s_mask, cl.s_plan, n,
+                        self.agg_dtype, 0.2)
+                else:
+                    nd = att_partial_planned(
+                        h, alpha_s, alpha_r, senders, receivers,
+                        g.rev_perm, edge_mask, g.plan, n, self.agg_dtype,
+                        0.2)
+                agg = att_combine(nd, h.dtype)
+                out = from_tangent0_coords(m_out, self.activation(agg))
+                return out, m_out
+            logits = bounded_att_logits(
+                alpha_s[senders] + alpha_r[receivers])
+            w = segment_softmax(logits, receivers, n, mask=edge_mask,
+                                indices_are_sorted=sorted_fast)
         elif g.cluster is not None:
             # cluster-pair SpMM kernel (kernels/cluster.py): block-dense
             # edges aggregate as two one-hot MXU matmuls over VMEM tiles
@@ -289,20 +283,9 @@ class HGCConv(nn.Module):
                                           indices_are_sorted=sorted_fast)
             w = ones / jnp.maximum(deg[receivers], 1.0)
             w_static = True
-            att_den = None
         h_in = h if self.agg_dtype is None else h.astype(self.agg_dtype)
         w_in = w if self.agg_dtype is None else w.astype(self.agg_dtype)
-        if den_planned:
-            # attention numerator through the cluster-pair kernel
-            # (runtime weights routed by the static maps; the dw backward
-            # is the cluster SDDMM) — the same [E, F]-round-trip kill the
-            # mean path gets, applied to the quality-frontier arm.  The
-            # denominator runs in the CSR scalar kernel (f32 accumulate).
-            from hyperspace_tpu.nn.scatter import cluster_att_aggregate
-
-            att_den = planned_segment_sum_1d(w_in, receivers, pb_, pc_, pf_, n)
-            agg = cluster_att_aggregate(h_in, w_in, g.cluster, n)
-        elif sorted_fast:
+        if sorted_fast:
             # receiver-sorted scatter in forward AND backward (nn/scatter.py)
             pb, pc, pf = g.plan if g.plan is not None else (None, None, None)
             agg = sym_segment_aggregate(h_in, w_in, senders, receivers,
@@ -313,8 +296,6 @@ class HGCConv(nn.Module):
                 msgs.astype(jnp.promote_types(msgs.dtype, jnp.float32)),
                 receivers, n)
         agg = agg.astype(h.dtype)
-        if att_den is not None:  # softmax denominator folded to per-node
-            agg = agg / jnp.maximum(att_den, 1e-15)[:, None].astype(h.dtype)
 
         out = from_tangent0_coords(m_out, self.activation(agg))
         return out, m_out

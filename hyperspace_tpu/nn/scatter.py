@@ -208,57 +208,53 @@ class ClusterAgg:
 
     Registered as a pytree so it can ride inside DeviceGraph.  Static
     plan shapes are leaves (int32 arrays), nothing auxiliary.  The
-    optional weight-routing maps (attention; see ClusterSplit doc) are
-    None when the split was built without ``rev_perm``.
+    optional straggler involution/mask (attention; see ClusterSplit doc)
+    are None when the split was built without ``rev_perm``.
     """
 
-    # gate for the weighted (attention) cluster path.  Measured r04:
-    # at 8% clustered it is a net loss (0.51 vs 0.50 s att step) AND at
-    # 39% it is still a wash (0.500 vs 0.489) — the weight-routing
-    # gathers + SDDMM + two-path overhead add [E]-passes, and pass count
-    # is what the attention step pays for (28 ms/2.4 M-row gather,
-    # width-independent).  The fused att_aggregate_planned beats both,
-    # so the gate sits above any realistic fraction until the logits
-    # move INSIDE the cluster kernel tiles (future work: alpha tiles are
-    # block-resident, so the pick could be a one-hot matmul there).
-    # The mean path has no such extra machinery and stays on the cluster
-    # kernel at any fraction (its own threshold sweep, r03).
-    WEIGHTED_MIN_FRAC = 0.95
+    # gate for the attention cluster path (cluster_att_partial): the
+    # r04 weight-ROUTING path was a wash at any realistic fraction
+    # because its static gathers added [E] passes back; the r05 in-tile
+    # kernels delete those, so the gate is just "enough clustered edges
+    # to beat the kernel's own grid overhead" — the same shape as the
+    # mean path's min_pair_edges threshold, whose lever starts paying
+    # around the 30–40% fractions the community reorder reaches.
+    # Initial value; tune against the on-chip att-step measurement
+    # (scripts/profile_att_step.py) and record the sweep in
+    # docs/benchmarks.md when it lands.
+    ATT_MIN_FRAC = 0.15
 
     def __init__(self, c_recv, c_send, c_wf, c_wb, c_plan,
                  s_recv, s_send, s_wf, s_wb, s_plan,
-                 c_map=None, c_map_rev=None, s_map=None, s_map_rev=None,
-                 s_valid=None, inv_map=None, use_weighted: bool = False,
-                 ec_pad: int = 0):
+                 s_rev_local=None, s_mask=None, use_att_cluster: bool = False):
         self.c_recv, self.c_send = c_recv, c_send
         self.c_wf, self.c_wb = c_wf, c_wb
         self.c_plan = c_plan
         self.s_recv, self.s_send = s_recv, s_send
         self.s_wf, self.s_wb = s_wf, s_wb
         self.s_plan = s_plan
-        self.c_map, self.c_map_rev = c_map, c_map_rev
-        self.s_map, self.s_map_rev = s_map, s_map_rev
-        self.s_valid, self.inv_map = s_valid, inv_map
-        self.use_weighted = bool(use_weighted)
-        self.ec_pad = int(ec_pad)
+        self.s_rev_local = s_rev_local
+        self.s_mask = s_mask
+        self.use_att_cluster = bool(use_att_cluster)
 
     @property
-    def weighted_ok(self) -> bool:
-        """Whether attention should take the weighted cluster path: maps
-        present AND the clustered fraction clears WEIGHTED_MIN_FRAC
-        (decided host-side at to_device time — static under jit)."""
-        return self.c_map is not None and self.use_weighted
+    def att_ok(self) -> bool:
+        """Whether attention should take the in-tile cluster path:
+        straggler involution present AND the clustered fraction clears
+        ATT_MIN_FRAC (decided host-side at to_device time — static
+        under jit)."""
+        return self.s_rev_local is not None and self.use_att_cluster
 
     def tree_flatten(self):
         return ((self.c_recv, self.c_send, self.c_wf, self.c_wb,
                  tuple(self.c_plan), self.s_recv, self.s_send, self.s_wf,
-                 self.s_wb, tuple(self.s_plan), self.c_map, self.c_map_rev,
-                 self.s_map, self.s_map_rev, self.s_valid, self.inv_map),
-                (self.use_weighted, self.ec_pad))
+                 self.s_wb, tuple(self.s_plan), self.s_rev_local,
+                 self.s_mask),
+                (self.use_att_cluster,))
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        return cls(*leaves, use_weighted=aux[0], ec_pad=aux[1])
+        return cls(*leaves, use_att_cluster=aux[0])
 
     @classmethod
     def from_host(cls, split):
@@ -269,12 +265,9 @@ class ClusterAgg:
                    dev(split.c_wb), tuple(dev(a) for a in split.c_plan),
                    dev(split.s_recv), dev(split.s_send), dev(split.s_wf),
                    dev(split.s_wb), tuple(dev(a) for a in split.s_plan),
-                   dev(split.c_map), dev(split.c_map_rev), dev(split.s_map),
-                   dev(split.s_map_rev), dev(split.s_valid),
-                   dev(split.inv_map),
-                   use_weighted=(split.frac_clustered
-                                 >= cls.WEIGHTED_MIN_FRAC),
-                   ec_pad=split.ec_pad)
+                   dev(split.s_rev_local), dev(split.s_mask),
+                   use_att_cluster=(split.frac_clustered
+                                    >= cls.ATT_MIN_FRAC))
 
 
 jax.tree_util.register_pytree_node(
@@ -331,33 +324,39 @@ cluster_sym_aggregate.defvjp(_ca_fwd, _ca_bwd)
 #   sender pick and the message gather are a single [E, F+1] gather;
 #   logits/exp are one fused elementwise pass (bounded-logit softmax —
 #   no max machinery, see nn.gcn.bounded_att_logits); numerator and
-#   denominator are one block-CSR pass each; the division folds in.
+#   denominator are one block-CSR pass each.
 # - backward: the gathered sender rows are SAVED as residuals (a
 #   sequential [E, F] write+read ≈ 1.6 ms vs a 28 ms random re-gather),
 #   so dw needs no new random gather; the only random backward gather is
 #   d_num[senders] for the involution dh; everything else is static-
 #   permutation gathers, sorted gathers, and CSR scalar reductions.
+#
+# The op is a PARTIAL: it returns the unnormalized [N, F+1] (num | den)
+# sums so a second partial over a different edge subset (the in-tile
+# cluster kernel) can be added before the one division
+# (:func:`att_combine`).  The full-edge-list composition is
+# :func:`att_aggregate_planned`.
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10))
-def att_aggregate_planned(h, alpha_s, alpha_r, senders, receivers, rev_perm,
-                          edge_mask, plan, num_segments: int, agg_dtype,
-                          negative_slope: float):
-    """Softmax-attention neighbor aggregation on the planned layout.
-
-    ``out[r] = Σ_e softmax_r(bounded_logits(α_s[s_e]+α_r[r_e])) h[s_e]``
-    — numerically identical to the unfused pick/exp/den/aggregate chain
-    (the oracle in tests).  ``edge_mask`` is the bool edge-validity mask
-    (a constant of the graph — no cotangent).
+def att_partial_planned(h, alpha_s, alpha_r, senders, receivers, rev_perm,
+                        edge_mask, plan, num_segments: int, agg_dtype,
+                        negative_slope: float):
+    """Unnormalized attention partials on the planned layout:
+    ``out[r] = Σ_e w_e·[h[s_e] | 1]`` (f32 [N, F+1]) with
+    ``w_e = exp(bounded_logits(α_s[s_e]+α_r[r_e]))`` and 0 on masked
+    edges.  ``edge_mask`` is the bool edge-validity mask (a constant of
+    the graph — no cotangent).  Oracle: the unfused pick/exp/segsum
+    chain in tests.
     """
-    out, _ = _att_fwd_impl(h, alpha_s, alpha_r, senders, receivers,
-                           edge_mask, plan, num_segments, agg_dtype,
-                           negative_slope)
-    return out
+    nd, _ = _att_partial_impl(h, alpha_s, alpha_r, senders, receivers,
+                              edge_mask, plan, num_segments, agg_dtype,
+                              negative_slope)
+    return nd
 
 
-def _att_fwd_impl(h, alpha_s, alpha_r, senders, receivers, edge_mask,
-                  plan, num_segments, agg_dtype, negative_slope):
+def _att_partial_impl(h, alpha_s, alpha_r, senders, receivers, edge_mask,
+                      plan, num_segments, agg_dtype, negative_slope):
     from hyperspace_tpu.nn.gcn import bounded_att_logits
 
     pb, pc, pf = plan
@@ -374,41 +373,37 @@ def _att_fwd_impl(h, alpha_s, alpha_r, senders, receivers, edge_mask,
     # constant 1-column, so segsum(w·[h | 1]) = [Σ w·h | Σ w]
     msgs = jnp.concatenate(
         [w_in[:, None] * h_in, w_in[:, None]], axis=1)
-    agg = _sorted_segsum(msgs, receivers, pb, pc, pf,
-                         num_segments).astype(jnp.float32)
-    num, den = agg[:, :f], jnp.maximum(agg[:, f], 1e-15)
-    out = (num / den[:, None]).astype(h.dtype)
-    return out, (h_in, w_in, lm, den, out)
+    nd = _sorted_segsum(msgs, receivers, pb, pc, pf,
+                        num_segments).astype(jnp.float32)
+    return nd, (h_in, w_in, lm)
 
 
-def _att_fwd(h, alpha_s, alpha_r, senders, receivers, rev_perm,
-             edge_mask, plan, num_segments, agg_dtype, negative_slope):
-    out, (h_in, w_in, lm, den, out_sv) = _att_fwd_impl(
+def _att_partial_fwd(h, alpha_s, alpha_r, senders, receivers, rev_perm,
+                     edge_mask, plan, num_segments, agg_dtype,
+                     negative_slope):
+    nd, (h_in, w_in, lm) = _att_partial_impl(
         h, alpha_s, alpha_r, senders, receivers, edge_mask, plan,
         num_segments, agg_dtype, negative_slope)
-    return out, (h_in, w_in, lm, den, out_sv, senders, receivers, rev_perm,
-                 edge_mask, plan, jnp.zeros((0,), h.dtype))
+    return nd, (h_in, w_in, lm, senders, receivers, rev_perm,
+                edge_mask, plan, jnp.zeros((0,), h.dtype))
 
 
-def _att_bwd(num_segments, agg_dtype, negative_slope, res, g):
+def _att_partial_bwd(num_segments, agg_dtype, negative_slope, res, g):
     from hyperspace_tpu.kernels.segment import (
         csr_att_bwd_edges,
         csr_segment_reduce_1d,
     )
     from hyperspace_tpu.nn.gcn import ATT_LOGIT_BOUND as B
 
-    (h_in, w_in, lm, den, out, senders, receivers, rev_perm, edge_mask,
-     plan, h_proto) = res
+    (h_in, w_in, lm, senders, receivers, rev_perm, edge_mask, plan,
+     h_proto) = res
     h_dtype = h_proto.dtype
-    f = out.shape[-1]
+    f = h_in.shape[-1]
     pb, pc, pf = plan
-    g32 = g.astype(jnp.float32)
-    d_num = g32 / den[:, None]                       # [N, F]
-    d_den = -jnp.sum(g32 * out.astype(jnp.float32), axis=-1) / den  # [N]
-
-    # d(num)/d(den) ride together as [N, F+1] so ONE gather serves each
-    # direction (mirrors the forward's fused num|den aggregation)
-    dn_ext = jnp.concatenate([d_num, d_den[:, None]], axis=1)
+    # the cotangent IS the fused d(num)|d(den) block ([N, F+1] f32):
+    # ONE gather serves both directions (mirrors the forward's fused
+    # num|den aggregation)
+    dn_ext = g.astype(jnp.float32)
     dn_dt = dn_ext if agg_dtype is None else dn_ext.astype(agg_dtype)
     dn_s = dn_dt[senders]                # the one random backward gather
     # dh via the involution: sender-scatter becomes a receiver-scatter
@@ -433,68 +428,76 @@ def _att_bwd(num_segments, agg_dtype, negative_slope, res, g):
     return (dh, d_alpha_s, d_alpha_r, None, None, None, None, None)
 
 
-att_aggregate_planned.defvjp(_att_fwd, _att_bwd)
+att_partial_planned.defvjp(_att_partial_fwd, _att_partial_bwd)
 
 
-# --- weighted (attention) aggregation on the cluster split --------------------
-#
-# Same two-path program, but the per-edge weights are RUNTIME values in
-# the prepare layout (exp-ed attention logits).  The static c_map/s_map
-# gathers route them into the split layouts ([E] scalars — cheap); the
-# involution backward's reversed weights are one more static gather
-# (c_map_rev = rev_perm∘c_map).  The dw backward — per-edge <ḡ[r], h[s]>
-# — runs the cluster SDDMM kernel on the clustered set (two one-hot MXU
-# matmuls per sub-chunk from VMEM-resident tiles) and the gathered row
-# dot only on the stragglers, then reconstitutes the prepare-layout [E]
-# gradient with the static inv_map GATHER (no scatter anywhere).
+def att_combine(nd: jax.Array, out_dtype) -> jax.Array:
+    """num/den of an [N, F+1] attention partial sum (the ONE division,
+    applied after all edge-subset partials are added)."""
+    num, den = nd[:, :-1], jnp.maximum(nd[:, -1], 1e-15)
+    return (num / den[:, None]).astype(out_dtype)
 
 
-def _att_two_path(vals, w, agg: ClusterAgg, num_segments: int, rev: bool):
-    from hyperspace_tpu.kernels.cluster import cluster_aggregate
+def att_aggregate_planned(h, alpha_s, alpha_r, senders, receivers, rev_perm,
+                          edge_mask, plan, num_segments: int, agg_dtype,
+                          negative_slope: float):
+    """Softmax-attention neighbor aggregation on the planned layout.
 
-    w = w.astype(jnp.float32)
-    w_c = w[agg.c_map_rev if rev else agg.c_map]
-    w_s = w[agg.s_map_rev if rev else agg.s_map] * agg.s_valid
-    out = cluster_aggregate(vals, w_c, agg.c_recv, agg.c_send,
-                            agg.c_plan, num_segments)
-    msgs = w_s.astype(vals.dtype)[:, None] * vals[agg.s_send]
-    out = out + _sorted_segsum(msgs, agg.s_recv, *agg.s_plan,
-                               num_segments).astype(out.dtype)
-    return out
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def cluster_att_aggregate(h, w, agg: ClusterAgg, num_segments: int):
-    """out[r] = Σ_e w_e · h[senders_e] with runtime per-edge weights
-    ``w`` in the prepare layout (0 on padding edges), through the
-    cluster-pair kernel + straggler CSR.  Requires ``agg.weighted_ok``.
-    Twin/oracle: ``sym_segment_aggregate`` on the same (h, w).
+    ``out[r] = Σ_e softmax_r(bounded_logits(α_s[s_e]+α_r[r_e])) h[s_e]``
+    — numerically identical to the unfused pick/exp/den/aggregate chain
+    (the oracle in tests).  Composition of :func:`att_partial_planned`
+    and :func:`att_combine` — autodiff of the division produces exactly
+    the fused d(num)|d(den) cotangent the partial's VJP consumes.
     """
-    return _att_two_path(h, w, agg, num_segments, rev=False)
+    nd = att_partial_planned(h, alpha_s, alpha_r, senders, receivers,
+                             rev_perm, edge_mask, plan, num_segments,
+                             agg_dtype, negative_slope)
+    return att_combine(nd, h.dtype)
 
 
-def _caa_fwd(h, w, agg, num_segments):
-    return _att_two_path(h, w, agg, num_segments, rev=False), (h, w, agg)
+# --- in-tile attention on the cluster split -----------------------------------
+#
+# Clustered edges run the kernels/cluster.py fused attention kernels —
+# logits, softmax weights, aggregation, and the whole backward computed
+# from VMEM-resident endpoint blocks, so the clustered fraction of the
+# graph never touches an [E]-length HBM stream in either direction.
+# Stragglers run :func:`att_partial_planned` on their own (shorter)
+# layout; the two [N, F+1] partials add and divide once.  This is the
+# r05 replacement for the r04 weight-routing path, which was measured a
+# wash because its static gathers added the [E] passes back.
 
 
-def _caa_bwd(num_segments, res, g):
-    from hyperspace_tpu.kernels.cluster import cluster_sddmm
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def cluster_att_partial(h, alpha_s, alpha_r, agg: ClusterAgg,
+                        num_segments: int, negative_slope: float = 0.2):
+    """[N, F+1] f32 unnormalized attention partials over the CLUSTERED
+    edge subset, logits computed in-tile.  Requires ``agg.att_ok``.
+    Twin/oracle: the gathered exp/segsum chain on (c_send, c_recv).
+    """
+    from hyperspace_tpu.kernels.cluster import cluster_att_fwd
+    from hyperspace_tpu.nn.gcn import ATT_LOGIT_BOUND as B
 
-    h, w, agg = res
-    dh = _att_two_path(g, w, agg, num_segments, rev=True).astype(h.dtype)
-    # dw_e = <ḡ[r_e], h[s_e]>: SDDMM on the clustered set, row dot on
-    # the stragglers, inv_map gather back to the prepare layout.  The
-    # kernel output is padded/sliced to the slot count inv_map was built
-    # against (agg.ec_pad) so a non-default split bk cannot misalign it.
-    dw_c = cluster_sddmm(g, h, agg.c_recv, agg.c_send, agg.c_plan,
-                         num_segments)
-    pad = agg.ec_pad - dw_c.shape[0]
-    dw_c = jnp.pad(dw_c, (0, max(pad, 0)))[: agg.ec_pad]
-    dw_s = jnp.sum(g[agg.s_recv].astype(jnp.float32)
-                   * h[agg.s_send].astype(jnp.float32), axis=-1)
-    dw_all = jnp.concatenate([dw_c, dw_s, jnp.zeros((1,), jnp.float32)])
-    dw = dw_all[agg.inv_map].astype(w.dtype)
-    return dh, dw, None
+    return cluster_att_fwd(h, alpha_s, alpha_r, agg.c_recv, agg.c_send,
+                           agg.c_plan, num_segments, negative_slope,
+                           float(B))
 
 
-cluster_att_aggregate.defvjp(_caa_fwd, _caa_bwd)
+def _cap_fwd(h, alpha_s, alpha_r, agg, num_segments, negative_slope):
+    return (cluster_att_partial(h, alpha_s, alpha_r, agg, num_segments,
+                                negative_slope),
+            (h, alpha_s, alpha_r, agg))
+
+
+def _cap_bwd(num_segments, negative_slope, res, g):
+    from hyperspace_tpu.kernels.cluster import cluster_att_bwd
+    from hyperspace_tpu.nn.gcn import ATT_LOGIT_BOUND as B
+
+    h, alpha_s, alpha_r, agg = res
+    dh, da_s, da_r = cluster_att_bwd(
+        g.astype(jnp.float32), h, alpha_s, alpha_r, agg.c_recv,
+        agg.c_send, agg.c_plan, num_segments, negative_slope, float(B))
+    return (dh.astype(h.dtype), da_s.astype(alpha_s.dtype),
+            da_r.astype(alpha_r.dtype), None)  # agg: graph constant
+
+
+cluster_att_partial.defvjp(_cap_fwd, _cap_bwd)

@@ -88,6 +88,18 @@ class CheckpointManager:
         self.close()
 
 
+def peek_latest_step(directory: str) -> int:
+    """Latest checkpointed step under ``directory``, 0 if none — WITHOUT
+    opening a full manager (no async machinery, nothing created on
+    disk).  Used by the CLI to derive resume offsets (e.g. the sampled
+    stream's starting chunk) before the training loop restores."""
+    d = os.path.abspath(directory)
+    if not os.path.isdir(d):
+        return 0
+    steps = [int(name) for name in os.listdir(d) if name.isdigit()]
+    return max(steps, default=0)
+
+
 def reproject_params(tags, params):
     """Build a ``project`` fn argument from a manifold tag tree: re-projects
     every manifold-tagged leaf, passes Euclidean leaves through."""

@@ -198,7 +198,28 @@ def test_empty_clustered_set_is_safe(rng, interp):
                                rtol=1e-5, atol=1e-5)
 
 
-# --- weighted (attention) path: SDDMM kernel + cluster_att_aggregate ----------
+# --- in-tile attention: cluster_att_fwd / cluster_att_bwd ---------------------
+
+
+def _symmetric_pair_edges(rng, n, e_half):
+    """A reversal-closed random edge set, (rb, sb)-pair-sorted — the
+    closure the in-tile backward's involution identities require."""
+    u = rng.integers(0, n, e_half).astype(np.int32)
+    v = rng.integers(0, n, e_half).astype(np.int32)
+    r = np.concatenate([u, v])
+    s = np.concatenate([v, u])
+    return _sorted_by_pair(r, s, n)
+
+
+def _att_oracle(h, a_s, a_r, r, s, n, slope=0.2, bound=30.0):
+    """Gathered exp/segsum chain — num|den, f32 (the kernel twin)."""
+    pre = a_s[s] + a_r[r]
+    lam = jnp.where(pre >= 0, pre, slope * pre)
+    w = jnp.exp(bound * jnp.tanh(lam / bound))
+    w = w.astype(h.dtype).astype(jnp.float32)  # match kernel rounding
+    msgs = jnp.concatenate(
+        [w[:, None] * h.astype(jnp.float32)[s], w[:, None]], axis=1)
+    return jax.ops.segment_sum(msgs, jnp.asarray(r), n)
 
 
 @pytest.mark.parametrize("n,e,f,dtype", [
@@ -206,29 +227,62 @@ def test_empty_clustered_set_is_safe(rng, interp):
     (700, 4000, 32, "bfloat16"),
     (300, 900, 130, np.float32),   # f > 128 lane padding
     (257, 513, 8, np.float32),     # odd sizes, boundary chunks
+    (300, 900, 128, np.float32),   # f == lane width: den in the ext tile
 ])
-def test_cluster_sddmm_matches_gather_dot(n, e, f, dtype, rng, interp):
-    from hyperspace_tpu.kernels.cluster import cluster_sddmm
+def test_cluster_att_fwd_matches_oracle(n, e, f, dtype, rng, interp):
+    from hyperspace_tpu.kernels.cluster import cluster_att_fwd
 
-    r = rng.integers(0, n, e).astype(np.int32)
-    s = rng.integers(0, n, e).astype(np.int32)
-    r, s = _sorted_by_pair(r, s, n)
-    g = rng.standard_normal((n, f)).astype(np.float32)
+    r, s = _symmetric_pair_edges(rng, n, e // 2)
     h = rng.standard_normal((n, f)).astype(np.float32)
     if dtype == "bfloat16":
-        g = jnp.asarray(g, jnp.bfloat16)
         h = jnp.asarray(h, jnp.bfloat16)
+    a_s = jnp.asarray(rng.standard_normal(n).astype(np.float32) * 0.7)
+    a_r = jnp.asarray(rng.standard_normal(n).astype(np.float32) * 0.7)
     plan = tuple(jnp.asarray(a) for a in build_cluster_plan(r, s, n))
-    got = np.asarray(cluster_sddmm(jnp.asarray(g), jnp.asarray(h),
-                                   jnp.asarray(r), jnp.asarray(s), plan, n))
-    want = np.sum(np.asarray(g, np.float32)[r]
-                  * np.asarray(h, np.float32)[s], axis=-1)
+    got = cluster_att_fwd(jnp.asarray(h), a_s, a_r, jnp.asarray(r),
+                          jnp.asarray(s), plan, n)
+    want = _att_oracle(jnp.asarray(h), a_s, a_r, r, s, n)
     tol = 3e-2 if dtype == "bfloat16" else 1e-4
-    np.testing.assert_allclose(got[:e], want, rtol=tol, atol=tol)
-    assert np.all(got[e:] == 0.0)  # padding lanes
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=tol, atol=tol)
 
 
-def _toy_graph_weighted(n=600, seed=0):
+@pytest.mark.parametrize("n,e,f,dtype", [
+    (700, 4000, 32, np.float32),
+    (700, 4000, 32, "bfloat16"),
+    (300, 900, 128, np.float32),   # f == lane width: alpha lanes at 128/129
+    (257, 513, 8, np.float32),
+])
+def test_cluster_att_bwd_matches_vjp_oracle(n, e, f, dtype, rng, interp):
+    from hyperspace_tpu.kernels.cluster import cluster_att_bwd
+
+    r, s = _symmetric_pair_edges(rng, n, e // 2)
+    h32 = rng.standard_normal((n, f)).astype(np.float32)
+    h = jnp.asarray(h32, jnp.bfloat16) if dtype == "bfloat16" \
+        else jnp.asarray(h32)
+    a_s = jnp.asarray(rng.standard_normal(n).astype(np.float32) * 0.7)
+    a_r = jnp.asarray(rng.standard_normal(n).astype(np.float32) * 0.7)
+    g_ext = jnp.asarray(rng.standard_normal((n, f + 1)).astype(np.float32))
+    plan = tuple(jnp.asarray(a) for a in build_cluster_plan(r, s, n))
+    dh, da_s, da_r = cluster_att_bwd(g_ext, h, a_s, a_r, jnp.asarray(r),
+                                     jnp.asarray(s), plan, n)
+    _, vjp = jax.vjp(
+        lambda hh, as_, ar_: _att_oracle(hh, as_, ar_, r, s, n),
+        jnp.asarray(h32), a_s, a_r)
+    want_dh, want_das, want_dar = vjp(g_ext)
+    # bf16 reference is the f32 chain: the kernel's bf16 weight/row-pick
+    # rounding leaves ~0.01% of elements off by up to ~0.1 at values of
+    # magnitude ~10 (bf16 eps ≈ 0.8%); exactness is proven by f32 cases
+    tol = 2e-1 if dtype == "bfloat16" else 1e-3
+    np.testing.assert_allclose(np.asarray(dh), np.asarray(want_dh),
+                               rtol=tol, atol=tol)
+    np.testing.assert_allclose(np.asarray(da_s), np.asarray(want_das),
+                               rtol=tol, atol=tol)
+    np.testing.assert_allclose(np.asarray(da_r), np.asarray(want_dar),
+                               rtol=tol, atol=tol)
+
+
+def _toy_graph_att(n=600, seed=0):
     from hyperspace_tpu.data import graphs as G
     from hyperspace_tpu.kernels.cluster import build_cluster_split
 
@@ -242,57 +296,68 @@ def _toy_graph_weighted(n=600, seed=0):
     return g
 
 
-def test_cluster_att_aggregate_matches_sym_aggregate(rng):
-    """Runtime-weighted cluster aggregation == sym_segment_aggregate on
-    the same (h, w): values, dh, and dw (the SDDMM backward)."""
+def test_straggler_involution_is_closed():
+    sp = _toy_graph_att().cluster_split
+    rl = np.asarray(sp.s_rev_local)
+    m = np.asarray(sp.s_mask)
+    # an involution that stays inside the straggler set, pairing each
+    # edge with its (recv, send)-swapped mirror; padding self-maps
+    assert np.all(rl[rl] == np.arange(len(rl)))
+    assert np.all(m[rl] == m)
+    np.testing.assert_array_equal(sp.s_recv[rl[m]], sp.s_send[m])
+    np.testing.assert_array_equal(sp.s_send[rl[m]], sp.s_recv[m])
+
+
+def test_cluster_att_partial_matches_full_planned(rng):
+    """cluster partial (in-tile) + straggler planned partial == the
+    full-edge-list planned partial: values and (dh, dα_s, dα_r)."""
     from hyperspace_tpu.data import graphs as G
-    from hyperspace_tpu.nn.scatter import (cluster_att_aggregate,
-                                           sym_segment_aggregate)
+    from hyperspace_tpu.nn.scatter import (att_partial_planned,
+                                           cluster_att_partial)
 
-    g = _toy_graph_weighted()
+    g = _toy_graph_att()
     dg = G.to_device(g)
-    dg.cluster.use_weighted = True  # toy frac may sit under the gate
-    assert dg.cluster.weighted_ok
+    dg.cluster.use_att_cluster = True  # toy frac may sit under the gate
+    assert dg.cluster.att_ok
     n = g.num_nodes
-    e = len(g.senders)
     h = jnp.asarray(rng.standard_normal((n, 16)).astype(np.float32))
-    w = jnp.asarray((rng.random(e).astype(np.float32) + 0.1)
-                    * g.edge_mask)
-    probe = jnp.asarray(rng.standard_normal((n, 16)).astype(np.float32))
-    pb, pc, pf = dg.plan
+    a_s = jnp.asarray(rng.standard_normal(n).astype(np.float32) * 0.7)
+    a_r = jnp.asarray(rng.standard_normal(n).astype(np.float32) * 0.7)
+    probe = jnp.asarray(rng.standard_normal((n, 17)).astype(np.float32))
+    cl = dg.cluster
 
-    def f_att(h, w):
-        return jnp.sum(cluster_att_aggregate(h, w, dg.cluster, n) * probe)
+    def f_split(h, a_s, a_r):
+        nd = cluster_att_partial(h, a_s, a_r, cl, n, 0.2)
+        nd = nd + att_partial_planned(h, a_s, a_r, cl.s_send, cl.s_recv,
+                                      cl.s_rev_local, cl.s_mask,
+                                      cl.s_plan, n, None, 0.2)
+        return jnp.sum(nd * probe)
 
-    def f_ref(h, w):
-        return jnp.sum(sym_segment_aggregate(
-            h, w, dg.senders, dg.receivers, dg.rev_perm, pb, pc, pf, n,
-            True) * probe)
+    def f_full(h, a_s, a_r):
+        return jnp.sum(att_partial_planned(
+            h, a_s, a_r, dg.senders, dg.receivers, dg.rev_perm,
+            dg.edge_mask, dg.plan, n, None, 0.2) * probe)
 
-    np.testing.assert_allclose(float(f_att(h, w)), float(f_ref(h, w)),
-                               rtol=1e-5)
-    ga_h, ga_w = jax.grad(f_att, argnums=(0, 1))(h, w)
-    gr_h, gr_w = jax.grad(f_ref, argnums=(0, 1))(h, w)
-    np.testing.assert_allclose(np.asarray(ga_h), np.asarray(gr_h),
-                               rtol=1e-4, atol=1e-5)
-    # dw on padding edges: both paths may differ there (w=0 either way);
-    # compare on real edges only
-    m = np.asarray(g.edge_mask)
-    np.testing.assert_allclose(np.asarray(ga_w)[m], np.asarray(gr_w)[m],
-                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(f_split(h, a_s, a_r)),
+                               float(f_full(h, a_s, a_r)), rtol=1e-5)
+    gs = jax.grad(f_split, argnums=(0, 1, 2))(h, a_s, a_r)
+    gf = jax.grad(f_full, argnums=(0, 1, 2))(h, a_s, a_r)
+    for a, b in zip(gs, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
 
 
 def test_hgcconv_att_cluster_matches_plain(rng):
     """HGCConv(use_att=True) gives the same output + parameter gradients
-    with and without the weighted cluster split."""
+    with and without the in-tile cluster attention split."""
     from hyperspace_tpu.data import graphs as G
     from hyperspace_tpu.manifolds import Lorentz
     from hyperspace_tpu.nn.gcn import HGCConv
 
-    g = _toy_graph_weighted()
+    g = _toy_graph_att()
     n = g.num_nodes
     dg_c = G.to_device(g)
-    dg_c.cluster.use_weighted = True  # toy frac may sit under the gate
+    dg_c.cluster.use_att_cluster = True  # toy frac may sit under the gate
     dg_p = dg_c._replace(cluster=None)
     m = Lorentz(1.0)
     pts = m.expmap0(jnp.concatenate(

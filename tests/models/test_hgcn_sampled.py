@@ -325,6 +325,38 @@ def test_stream_yields_fresh_deterministic_chunks():
         assert l1.shape == l2.shape
 
 
+def test_stream_start_chunk_continues_sequence():
+    """A resumed stream (start_chunk=k) yields exactly the chunks a
+    fresh stream yields from position k on — no replay (ADVICE r04)."""
+    edges, x, labels, tr, cfg = _stream_setup()
+    kw = dict(num_nodes=200, edges=edges, labels=labels, train_mask=tr,
+              chunk_steps=4, seed=7)
+    with HS.SampledBatchStream(cfg, "nc", **kw) as fresh:
+        _, c1, c2 = fresh.next(), fresh.next(), fresh.next()
+    with HS.SampledBatchStream(cfg, "nc", start_chunk=1, **kw) as resumed:
+        r1, r2 = resumed.next(), resumed.next()
+    for a, b in zip(c1.ids + c2.ids, r1.ids + r2.ids):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resume_chunk_derivation(tmp_path):
+    """CLI resume offset: latest checkpoint step // chunk_steps, without
+    opening a checkpoint manager."""
+    from hyperspace_tpu.cli.train import RunConfig, _resume_chunk
+    from hyperspace_tpu.train.checkpoint import peek_latest_step
+
+    d = tmp_path / "ck"
+    assert peek_latest_step(str(d)) == 0           # nothing there yet
+    (d / "64").mkdir(parents=True)
+    (d / "128").mkdir()
+    (d / "128.orbax-checkpoint-tmp-x").mkdir()     # in-flight: ignored
+    assert peek_latest_step(str(d)) == 128
+    run = RunConfig(steps=256, ckpt_dir=str(d), resume=True)
+    assert _resume_chunk(run, 64) == 2      # exact boundary: continue
+    assert _resume_chunk(run, 100) == 2     # mid-chunk: skip the partial
+    assert _resume_chunk(RunConfig(steps=256), 64) == 0
+
+
 def test_stream_trains_nc_across_chunks():
     edges, x, labels, tr, cfg = _stream_setup()
     model, opt, state = HS.init_sampled_nc(cfg, feat_dim=8, seed=0)

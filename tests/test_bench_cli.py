@@ -1,14 +1,32 @@
-"""bench.py headline-metric contract (VERDICT r2 weak #3 / next #7).
+"""bench.py headline-metric + tail-budget contracts.
 
-Under ``--metric auto`` a failing HGCN benchmark must surface as
-``metric: "error"`` with the traceback — never silently fall through to a
-green Poincaré line about a different metric.
+Headline contract (VERDICT r2 weak #3): under ``--metric auto`` a failing
+HGCN benchmark must surface as ``metric: "error"`` with the traceback —
+never silently fall through to a green Poincaré line about a different
+metric.
+
+Tail contract (VERDICT r4 missing #1): the driver records only the final
+2000 characters of stdout, so the LAST line printed must be a complete,
+parseable JSON record carrying metric/value/unit no matter how large the
+full detail grows.  BENCH_r04.json was lost to this (``parsed: null``).
 """
 
 import json
 import sys
 
 import pytest
+
+
+def _last_json(captured: str) -> dict:
+    """Parse the final stdout line — the driver-facing compact record."""
+    return json.loads(captured.strip().splitlines()[-1])
+
+
+def _tail_json(captured: str, budget: int = 2000) -> dict:
+    """Simulate the driver: keep only the last ``budget`` chars, then
+    parse the last complete line found there."""
+    tail = captured[-budget:]
+    return json.loads(tail.strip().splitlines()[-1])
 
 
 @pytest.fixture()
@@ -40,13 +58,18 @@ def test_auto_hgcn_failure_reports_error(bench_mod, monkeypatch, capsys):
     with pytest.raises(SystemExit) as ei:
         bench_mod.main()
     assert ei.value.code == 1
-    out = json.loads(capsys.readouterr().out.strip())
+    captured = capsys.readouterr().out
+    full = json.loads(captured.strip().splitlines()[0])
+    assert full["metric"] == "error"
+    assert "synthetic hgcn failure" in full["detail"]["error"]
+    assert "RuntimeError" in full["detail"]["traceback"]
+    assert full["detail"]["failed_benchmark"] == "hgcn"
+    # poincare still rides along in detail — available, just not headline
+    assert full["detail"]["poincare_embed_epoch_time_s"] == 0.5
+    # the compact last line carries the error too
+    out = _last_json(captured)
     assert out["metric"] == "error"
     assert "synthetic hgcn failure" in out["detail"]["error"]
-    assert "RuntimeError" in out["detail"]["traceback"]
-    assert out["detail"]["failed_benchmark"] == "hgcn"
-    # poincare still rides along in detail — available, just not headline
-    assert out["detail"]["poincare_embed_epoch_time_s"] == 0.5
 
 
 def test_auto_success_keeps_hgcn_headline(bench_mod, monkeypatch, capsys):
@@ -59,10 +82,17 @@ def test_auto_success_keeps_hgcn_headline(bench_mod, monkeypatch, capsys):
     monkeypatch.setattr(bench_mod, "bench_sampled", _stub_sampled)
     monkeypatch.setattr(sys, "argv", ["bench.py", "--metric", "auto"])
     bench_mod.main()
-    out = json.loads(capsys.readouterr().out.strip())
+    captured = capsys.readouterr().out
+    full = json.loads(captured.strip().splitlines()[0])
+    assert full["metric"] == "hgcn_samples_per_sec_per_chip"
+    assert full["detail"]["poincare_embed_epoch_time_s"] == 0.5
+    assert full["detail"]["hgcn_sampled"]["supervised_samples_per_s"] == 2e5
+    # compact last line: same headline, key legs summarized
+    out = _last_json(captured)
     assert out["metric"] == "hgcn_samples_per_sec_per_chip"
-    assert out["detail"]["poincare_embed_epoch_time_s"] == 0.5
-    assert out["detail"]["hgcn_sampled"]["supervised_samples_per_s"] == 2e5
+    assert out["value"] == 1e6
+    assert out["detail"]["poincare_epoch_s"] == 0.5
+    assert out["detail"]["sampled_samples_per_s"] == 2e5
 
 
 def test_explicit_poincare_failure_is_error(bench_mod, monkeypatch, capsys):
@@ -74,6 +104,77 @@ def test_explicit_poincare_failure_is_error(bench_mod, monkeypatch, capsys):
     with pytest.raises(SystemExit) as ei:
         bench_mod.main()
     assert ei.value.code == 1
-    out = json.loads(capsys.readouterr().out.strip())
+    out = _last_json(capsys.readouterr().out)
     assert out["metric"] == "error"
     assert out["detail"]["failed_benchmark"] == "poincare"
+
+
+# ---------------------------------------------------------------------------
+# tail-budget contract (VERDICT r4 missing #1)
+
+
+def _fat_result():
+    """A result whose full-detail line far exceeds the 2000-char budget —
+    the shape that truncated BENCH_r04.json."""
+    return {
+        "metric": "hgcn_samples_per_sec_per_chip", "value": 1.309e6,
+        "unit": "samples/s/chip", "vs_baseline": None,
+        "detail": {
+            "step_time_s": 0.1293, "num_nodes": 169343, "devices": 1,
+            "backend": "tpu", "use_att": False, "lr": 0.01, "loss": 0.31,
+            "frac_clustered": 0.391, "reorder": "community",
+            "source": "synthetic", "dtype": "float32", "step": "pairs",
+            "poincare_embed_epoch_time_s": 0.174,
+            "poincare": {("k%d" % i): float(i) for i in range(120)},
+            "hgcn_sampled": {"supervised_samples_per_s": 2.7e5,
+                             "sampling_inclusive_samples_per_s": 5.2e4,
+                             **{("s%d" % i): i for i in range(80)}},
+            "realistic": {"mean_step_s": 0.127, "att_step_s": 0.39,
+                          "frac_clustered": 0.300,
+                          **{("r%d" % i): i for i in range(80)}},
+            "use_att_arm": {"step_time_s": 0.391,
+                            "samples_per_s_per_chip": 4.33e5},
+            "workloads": {("w%d" % i): float(i) for i in range(150)},
+        },
+    }
+
+
+def test_compact_headline_fits_budget(bench_mod):
+    res = _fat_result()
+    assert len(json.dumps(res)) > 4000  # the failure precondition is real
+    line = bench_mod.compact_headline(res)
+    assert len(line) <= bench_mod.COMPACT_LIMIT
+    out = json.loads(line)
+    assert out["metric"] == "hgcn_samples_per_sec_per_chip"
+    assert out["value"] == 1.309e6
+    assert out["unit"] == "samples/s/chip"
+    # the highest-priority details survive
+    assert out["detail"]["step_time_s"] == 0.1293
+    assert out["detail"]["att_step_s"] == 0.391
+    assert out["detail"]["sampled_incl_samples_per_s"] == 5.2e4
+    assert out["detail"]["realistic_mean_step_s"] == 0.127
+
+
+def test_compact_headline_drops_detail_before_overflow(bench_mod):
+    # absurdly small limit: metric/value must still emit, detail gives way
+    res = _fat_result()
+    line = bench_mod.compact_headline(res, limit=180)
+    assert len(line) <= 180
+    out = json.loads(line)
+    assert out["metric"] == "hgcn_samples_per_sec_per_chip"
+    assert out["value"] == 1.309e6
+
+
+def test_emit_tail_2000_is_parseable(bench_mod, capsys, monkeypatch, tmp_path):
+    # the end-to-end driver simulation: full line + compact line, then
+    # keep only the last 2000 chars — the headline must parse out of it
+    monkeypatch.setattr(bench_mod, "__file__", str(tmp_path / "bench.py"))
+    bench_mod.emit(_fat_result())
+    captured = capsys.readouterr().out
+    out = _tail_json(captured, budget=2000)
+    assert out["metric"] == "hgcn_samples_per_sec_per_chip"
+    assert out["value"] == 1.309e6
+    assert out["detail"]["step_time_s"] == 0.1293
+    # the full record was preserved to a file beside bench.py
+    full = json.loads((tmp_path / "bench_full.json").read_text())
+    assert full["detail"]["workloads"]["w42"] == 42.0
