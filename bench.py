@@ -23,12 +23,16 @@ import sys
 
 
 def _time_steps(stepper, state, n_steps, repeats):
-    """min-of-repeats wall time for ``n_steps`` calls of ``stepper``
+    """(min_seconds, repeat_spread) for ``n_steps`` calls of ``stepper``
     (the shared harness in benchmarks/hgcn_bench.py — one copy of the
-    device_get-as-completion-barrier rationale)."""
-    from hyperspace_tpu.benchmarks.hgcn_bench import time_steps
+    device_get-as-completion-barrier rationale).  The max/min spread
+    lets callers record chip contention (VERDICT r4 #9: the Poincaré
+    0.174→0.186 drift rode into the artifact with no contention
+    marker)."""
+    from hyperspace_tpu.benchmarks.hgcn_bench import spread, time_steps_all
 
-    return time_steps(stepper, state, n_steps, repeats)[0]
+    times, _, _ = time_steps_all(stepper, state, n_steps, repeats)
+    return min(times), spread(times)
 
 
 def _poincare_steppers(cfg, pairs, plan_steps):
@@ -57,7 +61,8 @@ def _poincare_steppers(cfg, pairs, plan_steps):
 
 
 def _time_planned_scan(cfg, plan, repeats):
-    """Wall time of one scanned planned epoch (all plan rows, one program)."""
+    """(wall, spread) of one scanned planned epoch (all plan rows, one
+    program)."""
     from hyperspace_tpu.models import poincare_embed as pe
 
     state, opt = pe.init_state(cfg)
@@ -100,21 +105,25 @@ def bench_poincare(repeats: int = 3) -> dict:
     steps_per_epoch = max(1, ds.num_pairs // cfg.batch_size)
 
     epochs = {}
+    spreads = {}
     steppers, plan = _poincare_steppers(cfg, pairs, steps_per_epoch)
     for name, (stepper, state) in steppers.items():
-        epochs[name] = round(_time_steps(stepper, state, steps_per_epoch,
-                                         repeats), 4)
+        t, spreads[name] = _time_steps(stepper, state, steps_per_epoch,
+                                       repeats)
+        epochs[name] = round(t, 4)
     # scanned epochs: all steps_per_epoch steps as ONE XLA program
     # (`train_epoch_scan` / `train_epoch_planned_packed`) — at this table
     # size the per-step device work is tiny, so the stepwise timings above
     # are dominated by dispatch latency the scan removes
     state, opt = pe.init_state(cfg)
-    epochs["dense_scan"] = round(_time_steps(
+    t, spreads["dense_scan"] = _time_steps(
         (lambda st, o=opt: pe.train_epoch_scan(cfg, o, st, pairs,
                                                steps_per_epoch)),
-        state, 1, repeats), 4)
-    epochs["planned_scan"] = round(  # plan reused from _poincare_steppers
-        _time_planned_scan(cfg, plan, repeats), 4)
+        state, 1, repeats)
+    epochs["dense_scan"] = round(t, 4)
+    t, spreads["planned_scan"] = (  # plan reused from _poincare_steppers
+        _time_planned_scan(cfg, plan, repeats))
+    epochs["planned_scan"] = round(t, 4)
     update = min(epochs, key=epochs.get)
 
     # arxiv-scale table: dense pays O(N) table+moment traffic per step,
@@ -129,12 +138,10 @@ def bench_poincare(repeats: int = 3) -> dict:
     big_steppers, big_plan = _poincare_steppers(big_cfg, big_pairs,
                                                 n_big_steps)
     for name, (stepper, state) in big_steppers.items():
-        large[f"{name}_step_ms"] = round(
-            _time_steps(stepper, state, n_big_steps, max(2, repeats - 1))
-            / n_big_steps * 1e3, 3)
-    large["planned_scan_step_ms"] = round(
-        _time_planned_scan(big_cfg, big_plan, max(2, repeats - 1))
-        / n_big_steps * 1e3, 3)
+        t, _ = _time_steps(stepper, state, n_big_steps, max(2, repeats - 1))
+        large[f"{name}_step_ms"] = round(t / n_big_steps * 1e3, 3)
+    t, _ = _time_planned_scan(big_cfg, big_plan, max(2, repeats - 1))
+    large["planned_scan_step_ms"] = round(t / n_big_steps * 1e3, 3)
     large["update"] = min(
         ("dense", "sparse", "planned", "planned_scan"),
         key=lambda n: large[f"{n}_step_ms"])
@@ -151,6 +158,9 @@ def bench_poincare(repeats: int = 3) -> dict:
             "batch_size": cfg.batch_size,
             **{f"{k}_epoch_s": v for k, v in epochs.items()},
             "update": update,
+            # max/min over the timing repeats of the winning strategy —
+            # ≫1 marks a contended chip session (VERDICT r4 #9)
+            "repeat_spread": spreads.get(update),
             "large_table": large,
             "backend": jax.default_backend(),
         },
@@ -205,6 +215,7 @@ _COMPACT_FIELDS = (
     ("step_time_s", ("detail", "step_time_s")),
     ("frac_hbm_roofline", ("detail", "frac_hbm_roofline")),
     ("bytes_per_step", ("detail", "bytes_per_step")),
+    ("repeat_spread", ("detail", "repeat_spread")),
     ("error", ("detail", "error")),
     ("failed_benchmark", ("detail", "failed_benchmark")),
     ("frac_clustered", ("detail", "frac_clustered")),

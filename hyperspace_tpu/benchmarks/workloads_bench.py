@@ -17,13 +17,31 @@ def run_workloads_bench(repeats: int = 2, steps: int = 10) -> dict:
     import jax.numpy as jnp
     import numpy as np
 
-    from hyperspace_tpu.benchmarks.hgcn_bench import time_steps
+    from hyperspace_tpu.benchmarks.hgcn_bench import (
+        roofline_fields,
+        spread,
+        step_cost,
+        time_steps_all,
+    )
     from hyperspace_tpu.data.mnist import synthetic_mnist
     from hyperspace_tpu.data.text import synthetic_text
     from hyperspace_tpu.data.wordnet import synthetic_tree
     from hyperspace_tpu.models import hvae, hybonet, product_embed as pe
 
+    # these legs are cheap (ms-scale steps) but the r04 artifact showed
+    # ~50% session-to-session drift vs the docs table — min over MORE
+    # repeats + the recorded spread make contention visible (VERDICT r4
+    # weak #8)
+    repeats = max(repeats, 4)
     out: dict = {"backend": jax.default_backend()}
+
+    def timed_leg(stepper, state, n_steps):
+        """(step_s, roofline dict, state): min-of-repeats + spread +
+        the compiled bytes/flops bounds (VERDICT r4 #6)."""
+        times, state, _ = time_steps_all(stepper, state, n_steps, repeats)
+        step_s = min(times) / n_steps
+        roof = roofline_fields(step_cost(stepper, state), step_s)
+        return step_s, {"repeat_spread": spread(times), **roof}, state
 
     # --- HyboNet (workload 3): transformer classifier, flash attention
     cfg = hybonet.HyboNetConfig(vocab_size=8192, num_classes=8, max_len=128,
@@ -36,17 +54,17 @@ def run_workloads_bench(repeats: int = 2, steps: int = 10) -> dict:
     toks = jnp.asarray(ds.tokens)
     mask = jnp.asarray(ds.mask)
     labels = jnp.asarray(ds.labels)
-    best, state, _ = time_steps(
+    step_s, roof, state = timed_leg(
         lambda st: hybonet.train_step_sampled(model, opt, st, toks, mask,
                                               labels),
-        state, steps, repeats)
-    step_s = best / steps
+        state, steps)
     out["hybonet"] = {
         "step_ms": round(step_s * 1e3, 3),
         "tokens_per_s": round(cfg.batch_size * cfg.max_len / step_s, 1),
         "batch": [cfg.batch_size, cfg.max_len],
         "dim": cfg.dim, "layers": cfg.num_layers,
         "attention_impl": cfg.attention_impl,
+        **roof,
     }
 
     # --- HyboNet long context: 4k tokens fwd+bwd through the flash
@@ -62,15 +80,15 @@ def run_workloads_bench(repeats: int = 2, steps: int = 10) -> dict:
     lt, lm, ll = (jnp.asarray(lds.tokens[: lcfg.batch_size]),
                   jnp.asarray(lds.mask[: lcfg.batch_size]),
                   jnp.asarray(lds.labels[: lcfg.batch_size]))
-    best, lstate, _ = time_steps(
+    step_s, roof, lstate = timed_leg(
         lambda st: hybonet.train_step(lmodel, lopt, st, lt, lm, ll),
-        lstate, max(steps // 2, 3), repeats)
-    step_s = best / max(steps // 2, 3)
+        lstate, max(steps // 2, 3))
     out["hybonet_long"] = {
         "step_ms": round(step_s * 1e3, 3),
         "tokens_per_s": round(lcfg.batch_size * lcfg.max_len / step_s, 1),
         "batch": [lcfg.batch_size, lcfg.max_len],
         "fwd_bwd": "flash both directions",
+        **roof,
     }
 
     # --- HVAE (workload 4)
@@ -84,13 +102,13 @@ def run_workloads_bench(repeats: int = 2, steps: int = 10) -> dict:
                                                       x_all)
         return st, loss
 
-    best, hstate, _ = time_steps(hvae_step, hstate, steps, repeats)
-    step_s = best / steps
+    step_s, roof, hstate = timed_leg(hvae_step, hstate, steps)
     out["hvae"] = {
         "step_ms": round(step_s * 1e3, 3),
         "images_per_s": round(hcfg.batch_size / step_s, 1),
         "batch": [hcfg.batch_size, hcfg.image_size, hcfg.image_size],
         "kind": hcfg.kind,
+        **roof,
     }
 
     # --- product-space embeddings (workload 5): WordNet-noun-scale table
@@ -98,14 +116,14 @@ def run_workloads_bench(repeats: int = 2, steps: int = 10) -> dict:
     pcfg = pe.ProductEmbedConfig(num_nodes=tree.num_nodes, batch_size=1024)
     pstate, curv_opt = pe.init_state(pcfg, seed=0)
     pairs = jnp.asarray(tree.pairs)
-    best, pstate, _ = time_steps(
+    step_s, roof, pstate = timed_leg(
         lambda st: pe.train_step(pcfg, curv_opt, st, pairs),
-        pstate, steps, repeats)
-    step_s = best / steps
+        pstate, steps)
     out["product_embed"] = {
         "step_ms": round(step_s * 1e3, 3),
         "pairs_per_s": round(pcfg.batch_size / step_s, 1),
         "num_nodes": tree.num_nodes,
         "factors": [list(f) for f in pcfg.factors],
+        **roof,
     }
     return out

@@ -299,13 +299,13 @@ def _att_fwd_body(bn, bs, f, fp, fp_ext, fast_bf16, bound, slope):
         a_r_t = ar_ref[0]                  # [bn//128, 128] f32 (receivers)
         acc = jnp.zeros((bn, fp_ext), jnp.float32)
         rows = jax.lax.broadcasted_iota(jnp.int32, (bn, 128), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (bs, 128), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (128, bs), 1)
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, fp_ext), 1)
         for j in range(r.shape[0]):
             ls = s[j] - sb * bs            # [128]; out-of-range matches 0
             lr = r[j] - rb * bn
-            sel_s = cols == ls[None, :]    # [bs, 128]
             sel_r = rows == lr[None, :]    # [bn, 128]
+            b_oh = (cols == ls[:, None]).astype(dt)      # [128, bs]
             # the in-tile logit: two masked picks + VPU squash (no [E]
             # stream); out-of-pair lanes (boundary chunks, padding ids)
             # are killed by the ls validity mask — sel_r alone would let
@@ -313,7 +313,7 @@ def _att_fwd_body(bn, bs, f, fp, fp_ext, fast_bf16, bound, slope):
             pre = _pick_grouped(a_s_t, ls) + _pick_grouped(a_r_t, lr)
             w, _ = _att_squash(pre, bound, slope)
             w = jnp.where((ls >= 0) & (ls < bs), w, 0.0)
-            tmp = jnp.dot(sel_s.T.astype(dt), h_t,       # [128, fp] picks
+            tmp = jnp.dot(b_oh, h_t,                     # [128, fp] picks
                           preferred_element_type=jnp.float32,
                           precision=prec)
             # num|den ride one matmul: a constant-1 column at lane f
@@ -436,19 +436,19 @@ def _att_bwd_body(bn, bs, f, fp, fp_ext, fp_out, fast_bf16, bound, slope):
         a_r_sb = ar_sb_ref[0]
         acc = jnp.zeros((bn, fp_out), jnp.float32)
         rows = jax.lax.broadcasted_iota(jnp.int32, (bn, 128), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (bs, 128), 0)
+        cols_s = jax.lax.broadcasted_iota(jnp.int32, (128, bs), 1)
+        cols_r = jax.lax.broadcasted_iota(jnp.int32, (128, bn), 1)
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, fp_out), 1)
         num_lanes = (jax.lax.broadcasted_iota(jnp.int32, (1, fp), 1)
                      < f).astype(jnp.float32)
         for j in range(r.shape[0]):
             ls = s[j] - sb * bs
             lr = r[j] - rb * bn
-            sel_s = cols == ls[None, :]      # [bs, 128]
             sel_r = rows == lr[None, :]      # [bn, 128]
             valid = ((ls >= 0) & (ls < bs) & (lr >= 0) & (lr < bn)
                      ).astype(jnp.float32)
-            b_oh = sel_s.T.astype(dt)        # [128, bs]
-            r_oh = sel_r.T.astype(dt)        # [128, bn]
+            b_oh = (cols_s == ls[:, None]).astype(dt)   # [128, bs]
+            r_oh = (cols_r == lr[:, None]).astype(dt)   # [128, bn]
             gs = jnp.dot(b_oh, g_sb, preferred_element_type=jnp.float32,
                          precision=prec)     # [128, fp_ext]  rows d[s_e]
             gr = jnp.dot(r_oh, g_rb, preferred_element_type=jnp.float32,

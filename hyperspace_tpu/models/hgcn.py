@@ -479,6 +479,9 @@ def make_node_sharded_step_lp(
     mesh,
     state: TrainState,
     split: graph_data.LinkSplit,
+    halo="auto",  # forwarded to partition_graph ("a2a"/"ppermute" force
+    # that exchange schedule, False forces the all-gather, "auto" picks
+    # by estimated compiled bytes — parallel/node_shard.py doc)
 ):
     """LP train step whose ENCODER work divides across the mesh.
 
@@ -502,7 +505,7 @@ def make_node_sharded_step_lp(
     from hyperspace_tpu.parallel.node_shard import graph_shardings, shard_graph
     from hyperspace_tpu.parallel.tp import state_shardings
 
-    nsg = shard_graph(split.graph, mesh)
+    nsg = shard_graph(split.graph, mesh, halo=halo)
     state_sh = state_shardings(state, state.params, mesh)
     bsh = batch_sharding(mesh, ndim=2)
     constrain = lambda x: jax.lax.with_sharding_constraint(x, bsh)
@@ -522,6 +525,7 @@ def make_node_sharded_step_nc(
     mesh,
     state: TrainState,
     g: graph_data.Graph,
+    halo="auto",
 ):
     """NC twin of `make_node_sharded_step_lp`: node-sharded encoder, with
     labels/train-mask padded to the sharded node count and the per-node
@@ -536,7 +540,7 @@ def make_node_sharded_step_nc(
     )
     from hyperspace_tpu.parallel.tp import state_shardings
 
-    nsg = shard_graph(g, mesh)
+    nsg = shard_graph(g, mesh, halo=halo)
     n_pad = nsg.x.shape[0]
     labels = jnp.asarray(pad_node_array(g.labels, n_pad, 0))
     train_mask = jnp.asarray(pad_node_array(g.train_mask, n_pad, False))

@@ -217,11 +217,12 @@ class ClusterAgg:
     # because its static gathers added [E] passes back; the r05 in-tile
     # kernels delete those, so the gate is just "enough clustered edges
     # to beat the kernel's own grid overhead" — the same shape as the
-    # mean path's min_pair_edges threshold, whose lever starts paying
-    # around the 30–40% fractions the community reorder reaches.
-    # Initial value; tune against the on-chip att-step measurement
-    # (scripts/profile_att_step.py) and record the sweep in
-    # docs/benchmarks.md when it lands.
+    # mean path's min_pair_edges threshold.  Measured r05 on-chip
+    # (docs/benchmarks.md): at the bench graph's 39% clustered fraction
+    # the split path runs the att step at 0.291 s vs 0.390 s without
+    # (−25%); the win scales with the fraction, and the kernel grid is
+    # tiny below ~15%, so the gate sits where the mean-path lever also
+    # starts paying.
     ATT_MIN_FRAC = 0.15
 
     def __init__(self, c_recv, c_send, c_wf, c_wb, c_plan,

@@ -1,15 +1,19 @@
 """Full-scale quality anchor for the neighbor-sampled trainer.
 
-Trains node classification on the arxiv-density synthetic graph
-(169 343 nodes, 40 classes) two ways — the full-graph step and the
-neighbor-sampled minibatch step — evaluating BOTH with the full-graph
-model (the param trees are identical), and records (wall seconds,
-val/test accuracy) curves.  This answers the question the throughput
-number alone cannot: does sampled training reach the same operating
-point, and how fast in wall-clock?
+Trains on the arxiv-density synthetic graph (169 343 nodes) two ways —
+the full-graph step and the neighbor-sampled minibatch step —
+evaluating BOTH with the full-graph model (the param trees are
+identical), and records (wall seconds, quality) curves.  This answers
+the question the throughput number alone cannot: does sampled training
+reach the same operating point, and how fast in wall-clock?
 
-Writes JSONL records to --out (default docs/data/sampled_quality_r03.jsonl)
-and prints a final summary line per arm.  Run on the TPU chip.
+``--task nc`` (default) anchors node classification (val/test acc);
+``--task lp`` anchors the north-star link-prediction task (val/test
+ROC-AUC) — VERDICT r4 #7.
+
+Writes JSONL records to --out (default docs/data/sampled_quality_r03.jsonl;
+use docs/data/sampled_quality_lp_r05.jsonl for the LP run) and prints a
+final summary line per arm.  Run on the TPU chip.
 """
 
 from __future__ import annotations
@@ -22,6 +26,7 @@ import time
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="docs/data/sampled_quality_r03.jsonl")
+    ap.add_argument("--task", choices=["nc", "lp"], default="nc")
     ap.add_argument("--num-nodes", type=int, default=169_343)
     ap.add_argument("--full-steps", type=int, default=800)
     ap.add_argument("--sampled-epochs", type=int, default=24)
@@ -47,11 +52,7 @@ def main() -> None:
     edges, x, labels, ncls = arxiv_scale_graph(n, seed=args.seed)
     tr, va, te = G.node_split_masks(n, seed=args.seed)
     base = hgcn.HGCNConfig(feat_dim=x.shape[1], hidden_dims=(128, 32),
-                           num_classes=ncls)
-    g = G.prepare(edges, n, x, labels=labels, num_classes=ncls,
-                  train_mask=tr, val_mask=va, test_mask=te)
-    ga = G.to_device(g)
-    full_eval_model = hgcn.HGCNNodeClf(base)
+                           num_classes=ncls if args.task == "nc" else 0)
     out = open(args.out, "w")  # one run = one file; re-runs replace, not
     # append — the committed docs/data artifact must match one run
 
@@ -60,6 +61,15 @@ def main() -> None:
         out.write(json.dumps(rec) + "\n")
         out.flush()
         print(json.dumps(rec))
+
+    if args.task == "lp":
+        _run_lp(args, emit, edges, x, n, base, hgcn, HS, G, jax, jnp, np)
+        return
+
+    g = G.prepare(edges, n, x, labels=labels, num_classes=ncls,
+                  train_mask=tr, val_mask=va, test_mask=te)
+    ga = G.to_device(g)
+    full_eval_model = hgcn.HGCNNodeClf(base)
 
     # --- arm 1: full-graph step -------------------------------------------
     model, opt, state = hgcn.init_nc(base, g, seed=args.seed)
@@ -105,6 +115,66 @@ def main() -> None:
         m = hgcn.evaluate_nc(full_eval_model, sstate.params, g, ga=ga)
         emit({"arm": "sampled", "step": (ep + 1) * args.plan_steps,
               "wall_s": round(train_wall, 2), "loss": float(losses[-1]), **m})
+        seg0 = time.perf_counter()
+
+
+def _run_lp(args, emit, edges, x, n, base, hgcn, HS, G, jax, jnp, np):
+    """LP twin of the NC anchor (VERDICT r4 #7): full-graph LP vs
+    sampled-LP to the same ROC-AUC plateau, wall-clock per eval point.
+    Both arms evaluate through the full-graph HGCNLinkPred on identical
+    param trees."""
+    import dataclasses
+
+    split = G.split_edges(edges, n, x, seed=args.seed, pad_multiple=65536)
+    ga = hgcn._device_graph(split.graph)
+    full_model = hgcn.HGCNLinkPred(base)
+
+    def auc(params, which):
+        return hgcn.evaluate_lp(full_model, params, split, which,
+                                ga=ga)["roc_auc"]
+
+    # --- arm 1: full-graph LP step ---------------------------------------
+    model, opt, state = hgcn.init_lp(base, split.graph, seed=args.seed)
+    train_pos = jnp.asarray(split.train_pos)
+    state, loss = hgcn.train_step_lp(model, opt, n, state, ga, train_pos)
+    jax.device_get(loss)  # compile outside the timed region
+    train_wall, seg0 = 0.0, time.perf_counter()
+    for step in range(args.full_steps):
+        state, loss = hgcn.train_step_lp(model, opt, n, state, ga,
+                                         train_pos)
+        if (step + 1) % 100 == 0 or step + 1 == args.full_steps:
+            jax.device_get(loss)
+            train_wall += time.perf_counter() - seg0  # eval excluded
+            emit({"arm": "full_graph", "task": "lp", "step": step + 1,
+                  "wall_s": round(train_wall, 2), "loss": float(loss),
+                  "val_auc": round(auc(state.params, "val"), 4),
+                  "test_auc": round(auc(state.params, "test"), 4)})
+            seg0 = time.perf_counter()
+
+    # --- arm 2: sampled-LP minibatch step --------------------------------
+    sbase = dataclasses.replace(base, lr=args.sampled_lr)
+    scfg = HS.SampledConfig(base=sbase, fanouts=(10, 10), batch_size=512)
+    smodel, sopt, sstate = HS.init_sampled_lp(
+        scfg, feat_dim=x.shape[1], seed=args.seed)
+    lb, ldeg = HS.plan_lp_batches(scfg, split.train_pos, n,
+                                  steps=args.plan_steps, seed=args.seed)
+    xt = jnp.asarray(np.asarray(x, np.float32))
+    sstate, losses = HS.train_epoch_sampled_lp(smodel, sopt, sstate, xt,
+                                               ldeg, lb)
+    jax.device_get(losses[-1])  # compile
+    _, _, sstate = HS.init_sampled_lp(scfg, feat_dim=x.shape[1],
+                                      seed=args.seed)
+    train_wall, seg0 = 0.0, time.perf_counter()
+    for ep in range(args.sampled_epochs):
+        sstate, losses = HS.train_epoch_sampled_lp(smodel, sopt, sstate,
+                                                   xt, ldeg, lb)
+        jax.device_get(losses[-1])
+        train_wall += time.perf_counter() - seg0  # eval excluded
+        emit({"arm": "sampled", "task": "lp",
+              "step": (ep + 1) * args.plan_steps,
+              "wall_s": round(train_wall, 2), "loss": float(losses[-1]),
+              "val_auc": round(auc(sstate.params, "val"), 4),
+              "test_auc": round(auc(sstate.params, "test"), 4)})
         seg0 = time.perf_counter()
 
 

@@ -1,0 +1,75 @@
+"""Measure the host→device transfer floor behind the sampled pipeline.
+
+VERDICT r4 #8 asks for a direct measurement backing the claim that the
+sampling-inclusive throughput gap is the remote-attach tunnel, not the
+pipeline: this probe times raw ``jax.device_put`` of (a) a buffer the
+size of one ``SampledBatchStream`` chunk (~14.7 MB) and (b) a small
+control, reports MB/s, and converts the chunk time into the per-step
+overhead it implies at ``chunk_steps = 64`` — directly comparable to
+the measured device-only vs sampling-inclusive step gap in
+``bench.py``'s ``hgcn_sampled`` detail.
+
+On a directly attached host (or CPU backend) the same probe measures
+GB/s and the implied overhead vanishes — run it both ways to separate
+environment from pipeline.  One JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _put_time(arrs, repeats):
+    import jax
+
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = [jax.device_put(a) for a in arrs]
+        for o in out:
+            jax.device_get(o.ravel()[-1])   # tunnel-safe completion barrier
+        best = min(best, time.perf_counter() - t0)
+        for o in out:
+            o.delete()
+    return best
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--chunk-steps", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=512)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    s, b = args.chunk_steps, args.batch_size
+    # the exact shapes SampledBatchStream ships per NC chunk at the
+    # bench config (fanouts (10, 10)): seeds, two pyramid levels, labels
+    chunk = [np.random.randint(0, 169_343, (s, b), dtype=np.int32),
+             np.random.randint(0, 169_343, (s, b, 10), dtype=np.int32),
+             np.random.randint(0, 169_343, (s, b, 10, 10), dtype=np.int32),
+             np.random.randint(0, 40, (s, b), dtype=np.int32)]
+    nbytes = sum(a.nbytes for a in chunk)
+    t_chunk = _put_time(chunk, args.repeats)
+    small = [np.zeros((8, 128), np.float32)]
+    t_small = _put_time(small, args.repeats)
+
+    per_step_ms = t_chunk / s * 1e3
+    print(json.dumps({
+        "backend": jax.default_backend(),
+        "chunk_mb": round(nbytes / 1e6, 2),
+        "chunk_put_s": round(t_chunk, 4),
+        "mb_per_s": round(nbytes / 1e6 / t_chunk, 1),
+        "small_put_ms": round(t_small * 1e3, 3),
+        "implied_overhead_ms_per_step": round(per_step_ms, 3),
+        "implied_inclusive_samples_per_s_at_2p1ms_device": round(
+            b / (2.1e-3 + per_step_ms / 1e3), 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

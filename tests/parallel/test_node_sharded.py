@@ -33,7 +33,9 @@ def test_partition_covers_every_edge_once():
     _, split, _ = _setup()
     g = split.graph
     ndev = 4
-    hp = NS.partition_graph(g, ndev)
+    # halo=False: this test checks the GLOBAL-id layout invariants (the
+    # halo layout rewrites senders to extended-local ids)
+    hp = NS.partition_graph(g, ndev, halo=False)
     # real (sender, receiver) multiset must be preserved exactly
     mask = g.edge_mask
     want = sorted(zip(g.receivers[mask].tolist(), g.senders[mask].tolist()))
@@ -48,7 +50,7 @@ def test_partition_covers_every_edge_once():
 def test_partition_receivers_local_sorted_and_weights():
     _, split, _ = _setup()
     g = split.graph
-    hp = NS.partition_graph(g, 4)
+    hp = NS.partition_graph(g, 4, halo=False)  # global-id layout
     deg = np.maximum(g.deg, 1.0)
     for k in range(4):
         r = hp.recv[k]
@@ -337,9 +339,13 @@ def test_per_device_cost_scales_to_v5e16_shape():
     assert flops == sorted(flops, reverse=True), f"not monotone: {ratios}"
     dp16 = rec["dp"]["16"]
     assert dp16["flops_ratio"] <= 0.20, dp16
-    # VERDICT r3 #6 criterion: the community locality order (plus the
-    # auto-gated halo exchange where its static volume wins) cuts the
-    # dp=16 byte floor — measured 0.110 here vs 0.154 unordered in r03
+    # VERDICT r3 #6 / r4 #4: the community locality order cuts the
+    # dp=16 byte floor (0.154 unordered r03 → 0.1105 here).  The r05
+    # halo study (docs/benchmarks.md "Halo exchange") measured that in
+    # the XLA compiled-cost metric NO exchange schedule beats the plain
+    # all-gather at the scales this probe can compile — the auto gate
+    # therefore only engages a halo when its need-rows win by
+    # construction, and the floor below is the all-gather's.
     assert dp16["bytes_ratio"] <= 0.12, dp16
 
 
@@ -356,15 +362,17 @@ def _ordered_setup(num_nodes=256, seed=0):
     return split
 
 
-def test_halo_aggregate_matches_allgather_and_dense(rng):
-    """halo=True aggregation == halo=False == the unsharded oracle,
-    values AND gradients (the involution backward over all_to_all)."""
+@pytest.mark.parametrize("kind", ["a2a", "ppermute"])
+def test_halo_aggregate_matches_allgather_and_dense(rng, kind):
+    """halo aggregation (either schedule) == halo=False == the unsharded
+    oracle, values AND gradients (involution backward over the
+    collective)."""
     mesh = _mesh_or_skip({"data": 8})
     split = _ordered_setup()
     g = split.graph
-    nsg_h = NS.to_device_sharded(NS.partition_graph(g, 8, halo=True), mesh)
+    nsg_h = NS.to_device_sharded(NS.partition_graph(g, 8, halo=kind), mesh)
     nsg_a = NS.to_device_sharded(NS.partition_graph(g, 8, halo=False), mesh)
-    assert nsg_h.halo and not nsg_a.halo
+    assert nsg_h.halo and nsg_h.halo_kind == kind and not nsg_a.halo
     n_pad = nsg_h.x.shape[0]
     h = jnp.asarray(rng.standard_normal((n_pad, 16)).astype(np.float32))
     probe = jnp.asarray(rng.standard_normal((n_pad, 16)).astype(np.float32))
@@ -385,13 +393,14 @@ def test_halo_aggregate_matches_allgather_and_dense(rng):
                                np.asarray(want), rtol=1e-5, atol=1e-5)
 
 
-def test_halo_att_aggregate_matches_allgather(rng):
+@pytest.mark.parametrize("kind", ["a2a", "ppermute"])
+def test_halo_att_aggregate_matches_allgather(rng, kind):
     mesh = _mesh_or_skip({"data": 8})
     split = _ordered_setup(seed=1)
     g = split.graph
-    nsg_h = NS.to_device_sharded(NS.partition_graph(g, 8, halo=True), mesh)
+    nsg_h = NS.to_device_sharded(NS.partition_graph(g, 8, halo=kind), mesh)
     nsg_a = NS.to_device_sharded(NS.partition_graph(g, 8, halo=False), mesh)
-    assert nsg_h.halo
+    assert nsg_h.halo and nsg_h.halo_kind == kind
     n_pad = nsg_h.x.shape[0]
     h = jnp.asarray(rng.standard_normal((n_pad, 16)).astype(np.float32))
     a_s = jnp.asarray(rng.standard_normal(n_pad).astype(np.float32))
@@ -432,6 +441,53 @@ def test_halo_auto_engages_on_low_cut_graph():
     g = G.prepare(edges, n, x, pad_multiple=128)
     hp = NS.partition_graph(g, k, halo="auto")
     assert hp.halo and hp.send_idx is not None
-    # and the exchange is genuinely smaller than the all-gather
-    ndev, _, h_max = hp.send_idx.shape
-    assert 2 * ndev * h_max <= hp.n_shard * ndev
+    # and the picked schedule's estimated volume genuinely beats the
+    # all-gather (the gate's own criterion)
+    if hp.halo_kind == "a2a":
+        assert hp.send_idx.ndim == 3
+        assert 2 * k * hp.send_idx.shape[2] <= hp.n_shard * k
+    else:
+        total = sum(hp.halo_sizes)
+        assert hp.send_idx.shape == (k, total)
+        assert (2 + len(hp.halo_dists)) * total <= hp.n_shard * k
+        assert all(1 <= d < k for d in hp.halo_dists)
+    # the ppermute layout exists and is strictly smaller in rows than
+    # the pair-max a2a on this shape (the r05 per-distance win)
+    hp_p = NS.partition_graph(g, k, halo="ppermute")
+    hp_a = NS.partition_graph(g, k, halo="a2a")
+    assert hp_p.halo_kind == "ppermute" and hp_a.halo_kind == "a2a"
+    assert sum(hp_p.halo_sizes) <= k * hp_a.send_idx.shape[2]
+
+
+def test_no_cross_shard_edges_never_halos(rng):
+    """A fully block-diagonal graph (no cross-shard edges) must not
+    engage a halo — the zero-volume 'exchange' would otherwise win the
+    auto gate trivially and crash on empty ppermute chains — and the
+    aggregation still matches the dense oracle."""
+    from hyperspace_tpu.parallel.mesh import make_mesh
+
+    n, k = 256, 4
+    blocks = []
+    for b in range(k):
+        ids = b * (n // k) + np.arange(n // k)
+        u = np.repeat(ids, 3)
+        v = ids[(np.tile(np.arange(3), n // k) + u % 11) % (n // k)]
+        blocks.append(np.stack([u, v], 1))
+    edges = np.concatenate(blocks)
+    edges = edges[edges[:, 0] != edges[:, 1]]
+    x = np.zeros((n, 4), np.float32)
+    g = G.prepare(edges, n, x, pad_multiple=128)
+    for mode in ("auto", True, "ppermute", "a2a"):
+        hp = NS.partition_graph(g, k, halo=mode)
+        assert not hp.halo, mode
+    mesh = make_mesh({"data": k}, devices=jax.devices()[:k])
+    nsg = NS.to_device_sharded(NS.partition_graph(g, k, halo="auto"), mesh)
+    h = jnp.asarray(rng.standard_normal((nsg.x.shape[0], 8)).astype(np.float32))
+    out = NS.node_sharded_aggregate(h, nsg)
+    w = g.edge_mask / np.maximum(g.deg, 1.0)[g.receivers]
+    want = jax.ops.segment_sum(
+        jnp.asarray(np.asarray(w)[:, None] * np.asarray(h)[g.senders],
+                    jnp.float32),
+        jnp.asarray(g.receivers), n)
+    np.testing.assert_allclose(np.asarray(out)[:n], np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
