@@ -365,8 +365,9 @@ def main() -> None:
                 run_workloads_bench,
             )
 
-            result["detail"]["workloads"] = run_workloads_bench(
-                repeats=max(1, args.repeats - 1))
+            # these ms-scale legs keep their own repeats default (4):
+            # min-of-more-repeats is the r04 drift fix (workloads_bench)
+            result["detail"]["workloads"] = run_workloads_bench()
         except Exception as e:
             result["detail"]["workloads_error"] = repr(e)
         try:  # the attention arm on the same graph/protocol (VERDICT r3

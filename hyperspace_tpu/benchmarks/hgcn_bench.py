@@ -78,8 +78,8 @@ def step_cost(stepper, state) -> dict:
         return {
             "flops_per_step": flops,
             "bytes_per_step": byts,
-            "hbm_bound_ms": round(byts / V5E_HBM_BYTES_PER_S * 1e3, 3),
-            "mxu_bound_ms": round(flops / V5E_BF16_FLOPS * 1e3, 3),
+            "hbm_bound_ms": round(byts / V5E_HBM_BYTES_PER_S * 1e3, 6),
+            "mxu_bound_ms": round(flops / V5E_BF16_FLOPS * 1e3, 6),
         }
     except Exception:  # noqa: BLE001 — diagnostic only, never fatal
         return {}

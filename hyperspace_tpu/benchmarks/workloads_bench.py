@@ -12,7 +12,7 @@ criterion).  Rides in bench.py's auto detail as one line per workload.
 from __future__ import annotations
 
 
-def run_workloads_bench(repeats: int = 2, steps: int = 10) -> dict:
+def run_workloads_bench(repeats: int = 4, steps: int = 10) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -28,11 +28,11 @@ def run_workloads_bench(repeats: int = 2, steps: int = 10) -> dict:
     from hyperspace_tpu.data.wordnet import synthetic_tree
     from hyperspace_tpu.models import hvae, hybonet, product_embed as pe
 
-    # these legs are cheap (ms-scale steps) but the r04 artifact showed
-    # ~50% session-to-session drift vs the docs table — min over MORE
-    # repeats + the recorded spread make contention visible (VERDICT r4
-    # weak #8)
-    repeats = max(repeats, 4)
+    # default repeats=4: these legs are cheap (ms-scale steps) but the
+    # r04 artifact showed ~50% session-to-session drift vs the docs
+    # table — min over more repeats + the recorded spread make
+    # contention visible (VERDICT r4 weak #8).  An explicit smaller
+    # value is honored (quick smoke passes).
     out: dict = {"backend": jax.default_backend()}
 
     def timed_leg(stepper, state, n_steps):

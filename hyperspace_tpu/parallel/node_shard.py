@@ -233,6 +233,11 @@ def partition_graph(g: graph_data.Graph, ndev: int,
     # halo schedule must win by construction (strong block structure,
     # e.g. the ring-of-cliques / strongly-communitied DC-SBM shapes),
     # not by a modeling coin-flip.
+    if halo not in (False, True, "auto", "a2a", "ppermute"):
+        raise ValueError(
+            f"halo={halo!r}: want False, True, 'auto', 'a2a' or "
+            "'ppermute' (a typo here would silently measure the "
+            "auto-gated schedule instead of the forced one)")
     use_halo = False
     halo_kind = "a2a"
     send_idx = None

@@ -86,6 +86,10 @@ def main():
     if args.dataset == "realistic":
         from hyperspace_tpu.data import graphs as G
 
+        if args.nodes is not None:
+            raise SystemExit(
+                "--nodes only applies to the synthetic dataset; the "
+                "realistic disk graph has a fixed node count")
         root = HB.ensure_disk_dataset()
         edges, x, labels, ncls, source = G.load_graph("ogbn-arxiv", root)
         edges, x, labels, _ = G.apply_locality_order(edges, x, labels,

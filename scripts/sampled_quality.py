@@ -25,7 +25,9 @@ import time
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="docs/data/sampled_quality_r03.jsonl")
+    ap.add_argument("--out", default=None,
+                    help="default derives from --task so an LP run can "
+                         "never truncate the committed NC artifact")
     ap.add_argument("--task", choices=["nc", "lp"], default="nc")
     ap.add_argument("--num-nodes", type=int, default=169_343)
     ap.add_argument("--full-steps", type=int, default=800)
@@ -38,6 +40,10 @@ def main() -> None:
     ap.add_argument("--sampled-lr", type=float, default=3e-3)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+    if args.out is None:
+        args.out = ("docs/data/sampled_quality_r03.jsonl"
+                    if args.task == "nc"
+                    else "docs/data/sampled_quality_lp_r05.jsonl")
 
     import jax
     import jax.numpy as jnp

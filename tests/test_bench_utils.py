@@ -1,0 +1,54 @@
+"""Roofline / repeat-spread bench helpers (VERDICT r4 #6/#9/#10).
+
+These fields ride in every BENCH_r*.json; a silent breakage would strip
+the artifact of its MFU statement and contention markers, so the helper
+contracts are pinned here (CPU — cost analysis works on any backend).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from hyperspace_tpu.benchmarks.hgcn_bench import (
+    V5E_HBM_BYTES_PER_S,
+    roofline_fields,
+    spread,
+    step_cost,
+    time_steps_all,
+)
+
+
+def _stepper(st):
+    return st @ st, jnp.sum(st)
+
+
+def test_step_cost_reports_flops_and_bounds():
+    c = step_cost(_stepper, jnp.ones((128, 128), jnp.float32))
+    # one 128^3 matmul fwd: flops >= 2*128^3; bytes >= the operand reads
+    assert c["flops_per_step"] >= 2 * 128**3
+    assert c["bytes_per_step"] >= 128 * 128 * 4
+    assert c["hbm_bound_ms"] > 0
+    np.testing.assert_allclose(
+        c["hbm_bound_ms"],
+        round(c["bytes_per_step"] / V5E_HBM_BYTES_PER_S * 1e3, 6))
+
+
+def test_roofline_fields_fraction_and_bound():
+    cost = {"flops_per_step": 1e9, "bytes_per_step": 8.19e6,
+            "hbm_bound_ms": 0.01, "mxu_bound_ms": 0.005}
+    r = roofline_fields(cost, 1e-3)          # measured 1 ms step
+    assert r["frac_hbm_roofline"] == 0.01    # 0.01 ms bound / 1 ms step
+    assert r["bound"] == "hbm"
+    r2 = roofline_fields({**cost, "mxu_bound_ms": 0.02}, 1e-3)
+    assert r2["bound"] == "mxu"
+    assert roofline_fields({}, 1e-3) == {}   # cost-analysis failure: inert
+
+
+def test_step_cost_failure_is_inert():
+    assert step_cost(lambda st: 1 / 0, jnp.ones(3)) == {}
+
+
+def test_time_steps_all_and_spread():
+    times, st, loss = time_steps_all(_stepper, jnp.ones((16, 16)), 2, 3)
+    assert len(times) == 3 and all(t > 0 for t in times)
+    assert spread(times) >= 1.0
+    assert spread([2.0, 1.0]) == 2.0
