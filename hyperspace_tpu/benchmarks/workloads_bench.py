@@ -43,6 +43,26 @@ def run_workloads_bench(repeats: int = 4, steps: int = 10) -> dict:
         roof = roofline_fields(step_cost(stepper, state), step_s)
         return step_s, {"repeat_spread": spread(times), **roof}, state
 
+    def scanned_leg(stepper, state, k=32):
+        """Per-step ms of ONE dispatch running k chained steps under
+        ``lax.scan`` — the fix for dispatch-floor-bound legs: the r05
+        rooflines showed HVAE/product steps pinned at ~7 ms while their
+        HBM bound is 0.3–0.6 ms, i.e. the remote-attach per-dispatch
+        latency, not chip time.  The scan amortizes one dispatch over k
+        steps, exposing the true on-chip step (same lever as the
+        Poincaré epoch scan / CLI ``scan_chunk``)."""
+        def body(st, _):
+            st, loss = stepper(st)
+            return st, loss
+
+        @jax.jit
+        def run(st):
+            st, losses = jax.lax.scan(body, st, None, length=k)
+            return st, losses[-1]
+
+        times, _, _ = time_steps_all(run, state, 1, repeats)
+        return round(min(times) / k * 1e3, 3)
+
     # --- HyboNet (workload 3): transformer classifier, flash attention
     cfg = hybonet.HyboNetConfig(vocab_size=8192, num_classes=8, max_len=128,
                                 dim=128, num_heads=4, num_layers=2,
@@ -103,9 +123,12 @@ def run_workloads_bench(repeats: int = 4, steps: int = 10) -> dict:
         return st, loss
 
     step_s, roof, hstate = timed_leg(hvae_step, hstate, steps)
+    scan_ms = scanned_leg(hvae_step, hstate)
     out["hvae"] = {
         "step_ms": round(step_s * 1e3, 3),
         "images_per_s": round(hcfg.batch_size / step_s, 1),
+        "scan32_step_ms": scan_ms,
+        "scan32_images_per_s": round(hcfg.batch_size / (scan_ms / 1e3), 1),
         "batch": [hcfg.batch_size, hcfg.image_size, hcfg.image_size],
         "kind": hcfg.kind,
         **roof,
@@ -116,12 +139,14 @@ def run_workloads_bench(repeats: int = 4, steps: int = 10) -> dict:
     pcfg = pe.ProductEmbedConfig(num_nodes=tree.num_nodes, batch_size=1024)
     pstate, curv_opt = pe.init_state(pcfg, seed=0)
     pairs = jnp.asarray(tree.pairs)
-    step_s, roof, pstate = timed_leg(
-        lambda st: pe.train_step(pcfg, curv_opt, st, pairs),
-        pstate, steps)
+    p_step = lambda st: pe.train_step(pcfg, curv_opt, st, pairs)
+    step_s, roof, pstate = timed_leg(p_step, pstate, steps)
+    scan_ms = scanned_leg(p_step, pstate)
     out["product_embed"] = {
         "step_ms": round(step_s * 1e3, 3),
         "pairs_per_s": round(pcfg.batch_size / step_s, 1),
+        "scan32_step_ms": scan_ms,
+        "scan32_pairs_per_s": round(pcfg.batch_size / (scan_ms / 1e3), 1),
         "num_nodes": tree.num_nodes,
         "factors": [list(f) for f in pcfg.factors],
         **roof,

@@ -233,7 +233,10 @@ def partition_graph(g: graph_data.Graph, ndev: int,
     # halo schedule must win by construction (strong block structure,
     # e.g. the ring-of-cliques / strongly-communitied DC-SBM shapes),
     # not by a modeling coin-flip.
-    if halo not in (False, True, "auto", "a2a", "ppermute"):
+    # identity/type check, not ==: the int 1 equals True but would take
+    # neither string branch below and build a broken partition
+    if not (halo is False or halo is True
+            or halo in ("auto", "a2a", "ppermute")):
         raise ValueError(
             f"halo={halo!r}: want False, True, 'auto', 'a2a' or "
             "'ppermute' (a typo here would silently measure the "
