@@ -235,7 +235,8 @@ _COMPACT_FIELDS = (
      ("detail", "hgcn_sampled", "sampling_inclusive_samples_per_s")),
     ("realistic_mean_step_s", ("detail", "realistic", "mean_step_s")),
     ("realistic_att_step_s", ("detail", "realistic", "att_step_s")),
-    ("realistic_frac_clustered", ("detail", "realistic", "frac_clustered")),
+    ("realistic_frac_clustered",
+     ("detail", "realistic", "mean_frac_clustered")),
     ("reorder", ("detail", "reorder")),
     ("source", ("detail", "source")),
     ("dtype", ("detail", "dtype")),

@@ -125,7 +125,8 @@ def arxiv_scale_graph(num_nodes: int = ARXIV_NODES, seed: int = 0):
 
 
 def arxiv_scale_split(num_nodes: int = ARXIV_NODES, seed: int = 0,
-                      reorder: str | None = "community"):
+                      reorder: str | None = "community",
+                      cluster_min_pair: int = 256):
     """:func:`arxiv_scale_graph` + its LP split; returns (split, x).
 
     The graph is community-reordered by default: the LPA locality order
@@ -133,6 +134,8 @@ def arxiv_scale_split(num_nodes: int = ARXIV_NODES, seed: int = 0,
     to ~39% (the tree+ancestor structure is there — the generation-order
     ids just hide it), which is the layout the cluster-pair kernels are
     built for.  A pure relabeling: quality metrics are unaffected.
+    ``cluster_min_pair``: 256 for mean aggregation, 128 when attention
+    will run (the r05 per-mode sweep, data.graphs.prepare doc).
     """
     from hyperspace_tpu.data import graphs as G
 
@@ -141,7 +144,8 @@ def arxiv_scale_split(num_nodes: int = ARXIV_NODES, seed: int = 0,
         edges, x, labels, _ = G.apply_locality_order(edges, x, labels,
                                                      method=reorder)
     split = G.split_edges(edges, num_nodes, x, val_frac=0.02, test_frac=0.02,
-                          seed=seed, pad_multiple=65536)
+                          seed=seed, pad_multiple=65536,
+                          cluster_min_pair=cluster_min_pair)
     return split, x
 
 
@@ -168,6 +172,7 @@ def run_hgcn_bench(
     from hyperspace_tpu.data import graphs as G
     from hyperspace_tpu.models import hgcn
 
+    cmp_ = G.cluster_min_pair_for(use_att)
     if data_root is not None:
         edges, x, labels, ncls, source = G.load_graph("ogbn-arxiv", data_root)
         # real citation graphs arrive with arbitrary ids: the BFS locality
@@ -176,9 +181,10 @@ def run_hgcn_bench(
         edges, x, labels, _ = G.apply_locality_order(edges, x, labels)
         num_nodes = x.shape[0]
         split = G.split_edges(edges, num_nodes, x, val_frac=0.02,
-                              test_frac=0.02, seed=0, pad_multiple=65536)
+                              test_frac=0.02, seed=0, pad_multiple=65536,
+                              cluster_min_pair=cmp_)
     else:
-        split, x = arxiv_scale_split(num_nodes)
+        split, x = arxiv_scale_split(num_nodes, cluster_min_pair=cmp_)
         source = "synthetic"
     cfg = hgcn.HGCNConfig(
         feat_dim=x.shape[1], hidden_dims=(128, 32), kind="lorentz",
@@ -310,19 +316,29 @@ def run_realistic_bench(repeats: int = 2, steps_per_repeat: int = 10,
     edges, x, labels, _ = G.apply_locality_order(edges, x, labels,
                                                  method="community")
     num_nodes = x.shape[0]
-    split = G.split_edges(edges, num_nodes, x, val_frac=0.02, test_frac=0.02,
-                          seed=0, pad_multiple=65536)
+    split = G.split_edges(edges, num_nodes, x, val_frac=0.02,
+                          test_frac=0.02, seed=0, pad_multiple=65536)
     out = {
         "source": source,
         "num_nodes": num_nodes,
         "num_edges_padded": int(split.graph.senders.shape[0]),
         "reorder": "community",
-        "frac_clustered": (
-            None if split.graph.cluster_split is None
-            else round(split.graph.cluster_split.frac_clustered, 4)),
         "backend": jax.default_backend(),
     }
     for use_att in (False, True):
+        # per-mode cluster threshold (r05 sweep): only the cluster
+        # split differs between the legs, so rebuild just that piece
+        # instead of re-running the whole host split pipeline
+        from hyperspace_tpu.kernels.cluster import build_cluster_split
+
+        g_ = split.graph
+        g_.cluster_split = build_cluster_split(
+            g_.senders, g_.receivers, g_.edge_mask, g_.deg, num_nodes,
+            min_pair_edges=G.cluster_min_pair_for(use_att),
+            rev_perm=g_.rev_perm)
+        key = "att" if use_att else "mean"
+        out[f"{key}_frac_clustered"] = round(
+            g_.cluster_split.frac_clustered, 4)
         cfg = hgcn.HGCNConfig(
             feat_dim=x.shape[1], hidden_dims=(128, 32), kind="lorentz",
             use_att=use_att, agg_dtype=jnp.bfloat16,
@@ -346,7 +362,6 @@ def run_realistic_bench(repeats: int = 2, steps_per_repeat: int = 10,
             model, opt, num_nodes, st, ga, pos, neg_u, neg_plan)
         best, state, loss = time_steps(step_fn, state, steps_per_repeat,
                                        repeats)
-        key = "att" if use_att else "mean"
         out[f"{key}_lr"] = cfg.lr            # the config as EXECUTED
         out[f"{key}_clip_norm"] = cfg.clip_norm
         out[f"{key}_step_s"] = round(best / steps_per_repeat, 5)

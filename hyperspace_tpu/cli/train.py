@@ -264,7 +264,9 @@ def run_hgcn(run: RunConfig, overrides: dict):
 
     mesh = auto_mesh(run.multihost, tp=run.tp)
     if task == "lp":
-        split = G.split_edges(edges, num_nodes, x, seed=run.seed)
+        split = G.split_edges(
+            edges, num_nodes, x, seed=run.seed,
+            cluster_min_pair=G.cluster_min_pair_for(cfg.use_att))
         if sampled:
             # minibatch LP (models/hgcn_sampled.py): pyramids over the
             # four endpoint chunks; full-graph eval on the shared tree
@@ -316,7 +318,8 @@ def run_hgcn(run: RunConfig, overrides: dict):
     else:
         tr, va, te = G.node_split_masks(num_nodes, seed=run.seed)
         g = G.prepare(edges, num_nodes, x, labels=labels, num_classes=ncls,
-                      train_mask=tr, val_mask=va, test_mask=te)
+                      train_mask=tr, val_mask=va, test_mask=te,
+                      cluster_min_pair=G.cluster_min_pair_for(cfg.use_att))
         if sampled:
             # minibatch trainer (models/hgcn_sampled.py): single-device
             # dense-block steps (a local mesh is simply unused);

@@ -181,6 +181,16 @@ def _check_edge_range(edges, num_nodes: int) -> None:
             f"max {e.max()}")
 
 
+def cluster_min_pair_for(use_att: bool) -> int:
+    """The mode-dependent cluster-pair density threshold — ONE home for
+    the r05 sweep result (docs/benchmarks.md "Per-mode cluster
+    threshold"): mean aggregation wins at 256, attention at 128 (the
+    in-tile attention kernels save enough [E]-stream per clustered edge
+    that sparser pairs still pay).  Re-sweeps update this function only.
+    """
+    return 128 if use_att else 256
+
+
 def prepare(
     edges: np.ndarray,
     num_nodes: int,
@@ -190,6 +200,7 @@ def prepare(
     self_loops: bool = True,
     pad_multiple: int = 1024,
     cluster: str | bool = "auto",
+    cluster_min_pair: int = 256,
     **node_fields,
 ) -> Graph:
     """Symmetrize, add self-loops, dedupe, sort by receiver, pad.
@@ -233,6 +244,13 @@ def prepare(
     # round-trip for block-dense edges.  "auto" builds it only at scales
     # where the aggregation is actually HBM-bound (the one-time host sort
     # is wasted on toy graphs, and small graphs fit the plain path fine).
+    # ``cluster_min_pair``: the (rb, sb)-pair density threshold.  The
+    # r05 same-session sweep (docs/benchmarks.md) found the best value
+    # is MODE-dependent: 256 for mean aggregation (0.1288 vs 0.1314 s
+    # at 128) but 128 for attention (0.2771 vs 0.2898 s) — the in-tile
+    # attention kernels save enough [E]-stream per clustered edge that
+    # sparser pairs still pay; callers that know attention will run
+    # pass 128 (cli.train, run_hgcn_bench use_att).
     split = None
     n_real = int(mask.sum())
     if cluster is True or (cluster == "auto" and n_real >= 200_000):
@@ -240,7 +258,8 @@ def prepare(
             from hyperspace_tpu.kernels.cluster import build_cluster_split
 
             split = build_cluster_split(senders, receivers, mask, deg,
-                                        num_nodes, rev_perm=rev_perm)
+                                        num_nodes, rev_perm=rev_perm,
+                                        min_pair_edges=cluster_min_pair)
 
     return Graph(
         x=np.asarray(x, np.float32),
@@ -268,6 +287,7 @@ def split_edges(
     test_frac: float = 0.10,
     seed: int = 0,
     pad_multiple: int = 1024,
+    cluster_min_pair: int = 256,
     **node_fields,
 ) -> LinkSplit:
     """Hold out edges for LP eval; message passing uses only train edges.
@@ -313,7 +333,8 @@ def split_edges(
         return np.asarray(out, np.int64)
 
     g = prepare(
-        train_pos, num_nodes, x, pad_multiple=pad_multiple, **node_fields
+        train_pos, num_nodes, x, pad_multiple=pad_multiple,
+        cluster_min_pair=cluster_min_pair, **node_fields
     )
     return LinkSplit(
         graph=g,
