@@ -87,6 +87,25 @@ def _toy_graph(n=600, seed=0):
     return g
 
 
+def test_mode_aware_cluster_threshold_plumbs_through():
+    """graphs.cluster_min_pair_for is the ONE home of the r05 per-mode
+    sweep; prepare/split_edges thread it to build_cluster_split (a
+    lower threshold must cluster at least as many edges)."""
+    from hyperspace_tpu.data import graphs as G
+
+    assert G.cluster_min_pair_for(False) == 256
+    assert G.cluster_min_pair_for(True) == 128
+    n = 600
+    edges, x, _, _ = G.synthetic_hierarchy(num_nodes=n, feat_dim=12, seed=0)
+    fracs = {}
+    for mp in (8, 64):
+        g = G.prepare(edges, n, x, cluster=True, pad_multiple=256,
+                      cluster_min_pair=mp)
+        fracs[mp] = g.cluster_split.frac_clustered
+    assert fracs[8] >= fracs[64]
+    assert fracs[8] > 0  # the knob demonstrably reached the split
+
+
 def test_split_covers_every_edge_once_and_is_symmetric():
     g = _toy_graph()
     sp = g.cluster_split
