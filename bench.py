@@ -19,7 +19,87 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import threading
+import time
+
+# wall-clock budget (seconds) for the WHOLE bench run, env-tunable via
+# BENCH_BUDGET_S / --budget-s.  BENCH_r05.json was rc=124 with
+# ``parsed: null`` — the driver's hard timeout killed the process before
+# any JSON landed, losing the whole round's reading.  The default sits
+# well under the 870 s tier-1 timeout: optional legs are skipped once the
+# remaining budget can't fit them, and a last-resort watchdog emits
+# whatever completed and exits 0 instead of dying unparsed.
+DEFAULT_BUDGET_S = 600.0
+
+
+class _BudgetGuard:
+    """Deadline bookkeeping + the emit-once watchdog."""
+
+    def __init__(self, seconds: float):
+        self.budget_s = float(seconds)
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._claimed = False
+        self._timer = None
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def remaining(self) -> float:
+        return self.budget_s - self.elapsed()
+
+    def claim_emit(self) -> bool:
+        """True exactly once — whoever wins prints the artifact."""
+        with self._lock:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
+
+    def arm(self, holder: dict, _exit=os._exit):
+        """Watchdog: at the deadline, emit the best record available —
+        the in-progress result (legs completed so far) or a bare
+        budget_exhausted record — and exit 0.  The main path disarms it
+        after its own emit, so the timer only ever fires on a run that
+        would otherwise die to the driver's hard timeout with nothing
+        parseable on stdout.  (``_exit`` is injectable for tests; the
+        real one skips interpreter teardown, so stdout is flushed here.)"""
+
+        def fire():
+            if not self.claim_emit():
+                return
+            import copy
+
+            fallback = {"metric": "budget_exhausted", "value": 0,
+                        "unit": "", "vs_baseline": None,
+                        "detail": {"budget_s": self.budget_s,
+                                   "budget_exhausted": True,
+                                   "elapsed_s": round(self.elapsed(), 1)}}
+            try:
+                # snapshot: the main thread is still mutating detail (a
+                # leg mid-flight); serializing the live dict could raise
+                # "dictionary changed size during iteration" AFTER the
+                # emit was claimed, losing the artifact entirely
+                result = copy.deepcopy(holder.get("result"))
+                if result is None:
+                    result = fallback
+                result.setdefault("detail", {})
+                result["detail"].update(fallback["detail"])
+                emit(result)
+            except Exception:  # noqa: BLE001 — emit SOMETHING, always
+                print(json.dumps(fallback))
+            sys.stdout.flush()
+            _exit(0)
+
+        self._timer = threading.Timer(max(self.remaining(), 0.001), fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def disarm(self):
+        if self._timer is not None:
+            self._timer.cancel()
 
 
 def _time_steps(stepper, state, n_steps, repeats):
@@ -218,6 +298,8 @@ _COMPACT_FIELDS = (
     ("repeat_spread", ("detail", "repeat_spread")),
     ("error", ("detail", "error")),
     ("failed_benchmark", ("detail", "failed_benchmark")),
+    ("budget_exhausted", ("detail", "budget_exhausted")),
+    ("skipped_legs", ("detail", "skipped_legs")),
     ("frac_clustered", ("detail", "frac_clustered")),
     ("num_nodes", ("detail", "num_nodes")),
     ("devices", ("detail", "devices")),
@@ -237,6 +319,10 @@ _COMPACT_FIELDS = (
     ("realistic_att_step_s", ("detail", "realistic", "att_step_s")),
     ("realistic_frac_clustered",
      ("detail", "realistic", "mean_frac_clustered")),
+    ("hvae_scan_chunk_step_ms",
+     ("detail", "workloads", "hvae", "scan_chunk_step_ms")),
+    ("product_scan_chunk_step_ms",
+     ("detail", "workloads", "product_embed", "scan_chunk_step_ms")),
     ("reorder", ("detail", "reorder")),
     ("source", ("detail", "source")),
     ("dtype", ("detail", "dtype")),
@@ -311,10 +397,23 @@ def main() -> None:
     p.add_argument("--step", choices=["lp", "pairs"], default="pairs")
     p.add_argument("--decoder-dtype", choices=["float32", "bfloat16"],
                    default="bfloat16")
+    p.add_argument("--budget-s", type=float,
+                   default=float(os.environ.get("BENCH_BUDGET_S",
+                                                DEFAULT_BUDGET_S)),
+                   help="wall-clock budget: optional legs are skipped "
+                        "once they can't fit, and a watchdog emits the "
+                        "partial artifact at the deadline")
     args = p.parse_args()
 
     import functools
     import traceback
+
+    guard = _BudgetGuard(args.budget_s)
+    holder: dict = {"result": None}
+    # sub-10 s budgets (tests, smoke) keep the leg-skip behavior but not
+    # the watchdog — a near-zero timer would race the normal emit path
+    if args.budget_s >= 10:
+        guard.arm(holder)
 
     hgcn_fn = functools.partial(bench_hgcn, dtype=args.dtype,
                                 agg_dtype=args.agg_dtype,
@@ -327,73 +426,100 @@ def main() -> None:
     # with the traceback, not papered over with a different green metric
     failed = False
     try:
-        result = primary(repeats=args.repeats)
-    except Exception as e:
-        failed = True
-        result = {"metric": "error", "value": 0, "unit": "",
-                  "vs_baseline": None,
-                  "detail": {"error": repr(e),
-                             "traceback": traceback.format_exc(),
-                             "failed_benchmark": (
-                                 "poincare" if args.metric == "poincare"
-                                 else "hgcn")}}
-    if args.metric == "auto":
-        # both BASELINE metrics in the one JSON line: hgcn stays the
-        # headline (or the error record), the poincare epoch time rides
-        # in detail either way
         try:
-            p = bench_poincare(repeats=max(1, args.repeats - 1))
-            result["detail"]["poincare_embed_epoch_time_s"] = p["value"]
-            result["detail"]["poincare"] = p["detail"]
+            result = primary(repeats=args.repeats)
         except Exception as e:
-            result["detail"]["poincare_error"] = repr(e)
-        try:  # minibatch trainer: supervised samples/s (honest unit)
-            result["detail"]["hgcn_sampled"] = bench_sampled(
-                repeats=max(1, args.repeats - 1))
-        except Exception as e:
-            result["detail"]["hgcn_sampled_error"] = repr(e)
-        try:  # disk → loader → community reorder → cluster levers
-            from hyperspace_tpu.benchmarks.hgcn_bench import (
-                run_realistic_bench,
-            )
+            failed = True
+            result = {"metric": "error", "value": 0, "unit": "",
+                      "vs_baseline": None,
+                      "detail": {"error": repr(e),
+                                 "traceback": traceback.format_exc(),
+                                 "failed_benchmark": (
+                                     "poincare" if args.metric == "poincare"
+                                     else "hgcn")}}
+        holder["result"] = result  # legs below mutate detail in place,
+        skipped: list = []         # so the watchdog emits live progress
 
-            result["detail"]["realistic"] = run_realistic_bench(
-                repeats=max(1, args.repeats - 1))
-        except Exception as e:
-            result["detail"]["realistic_error"] = repr(e)
-        try:  # workloads 3-5 one-liners + the 4k-token flash fwd+bwd leg
-            from hyperspace_tpu.benchmarks.workloads_bench import (
-                run_workloads_bench,
-            )
+        def leg(name: str, min_s: float, fn) -> None:
+            """Run one optional detail leg if the remaining budget can
+            plausibly fit it (``min_s`` — a rough floor, not a promise);
+            skipped legs are listed in the artifact instead of silently
+            missing."""
+            if guard.remaining() < min_s:
+                skipped.append(name)
+                return
+            try:
+                fn(result["detail"])
+            except Exception as e:  # noqa: BLE001 — legs never sink the run
+                result["detail"][f"{name}_error"] = repr(e)
 
-            # these ms-scale legs keep their own repeats default (4):
-            # min-of-more-repeats is the r04 drift fix (workloads_bench)
-            result["detail"]["workloads"] = run_workloads_bench()
-        except Exception as e:
-            result["detail"]["workloads_error"] = repr(e)
-        try:  # the attention arm on the same graph/protocol (VERDICT r3
-            # #1 asks for the --use-att number; it rides in detail so the
-            # plain driver invocation records it every round).  Distinct
-            # key: detail["use_att"] is the headline's config-as-executed
-            # bool and must not be clobbered.  With --use-att the primary
-            # already IS this arm — don't run the multi-minute bench twice.
-            if args.use_att:
-                src = result["detail"]
-            else:
-                src = hgcn_fn(repeats=max(1, args.repeats - 1),
-                              use_att=True)["detail"]
-            result["detail"]["use_att_arm"] = {
-                "step_time_s": src["step_time_s"],
-                "samples_per_s_per_chip": round(
-                    src["num_nodes"] / src["step_time_s"]
-                    / src["devices"], 1),
-                "lr": src["lr"],
-                "clip_norm": src["clip_norm"],
-                "loss": src["loss"],
-            }
-        except Exception as e:
-            result["detail"]["use_att_arm_error"] = repr(e)
-    emit(result)
+        if args.metric == "auto":
+            # both BASELINE metrics in the one JSON line: hgcn stays the
+            # headline (or the error record), the poincare epoch time
+            # rides in detail either way
+            def poincare_leg(d):
+                pr = bench_poincare(repeats=max(1, args.repeats - 1))
+                d["poincare_embed_epoch_time_s"] = pr["value"]
+                d["poincare"] = pr["detail"]
+
+            def sampled_leg(d):  # minibatch trainer (honest unit)
+                d["hgcn_sampled"] = bench_sampled(
+                    repeats=max(1, args.repeats - 1))
+
+            def realistic_leg(d):  # disk → loader → reorder → cluster
+                from hyperspace_tpu.benchmarks.hgcn_bench import (
+                    run_realistic_bench,
+                )
+
+                d["realistic"] = run_realistic_bench(
+                    repeats=max(1, args.repeats - 1))
+
+            def workloads_leg(d):
+                # workloads 3-5 one-liners + the 4k-token flash fwd+bwd
+                # leg; these ms-scale legs keep their own repeats default
+                # (4): min-of-more-repeats is the r04 drift fix
+                from hyperspace_tpu.benchmarks.workloads_bench import (
+                    run_workloads_bench,
+                )
+
+                d["workloads"] = run_workloads_bench()
+
+            def use_att_leg(d):
+                # the attention arm on the same graph/protocol (VERDICT
+                # r3 #1).  Distinct key: detail["use_att"] is the
+                # headline's config-as-executed bool and must not be
+                # clobbered.  With --use-att the primary already IS this
+                # arm — don't run the multi-minute bench twice.
+                src = (d if args.use_att
+                       else hgcn_fn(repeats=max(1, args.repeats - 1),
+                                    use_att=True)["detail"])
+                d["use_att_arm"] = {
+                    "step_time_s": src["step_time_s"],
+                    "samples_per_s_per_chip": round(
+                        src["num_nodes"] / src["step_time_s"]
+                        / src["devices"], 1),
+                    "lr": src["lr"],
+                    "clip_norm": src["clip_norm"],
+                    "loss": src["loss"],
+                }
+
+            # rough per-leg floors (seconds on the usual remote chip) —
+            # generous enough that a leg given the green light normally
+            # finishes well before the watchdog deadline
+            leg("poincare", 60, poincare_leg)
+            leg("hgcn_sampled", 45, sampled_leg)
+            leg("realistic", 150, realistic_leg)
+            leg("workloads", 90, workloads_leg)
+            leg("use_att_arm", 0 if args.use_att else 120, use_att_leg)
+
+        result["detail"]["budget_s"] = args.budget_s
+        result["detail"]["elapsed_s"] = round(guard.elapsed(), 1)
+        if skipped:
+            result["detail"]["skipped_legs"] = skipped
+        if guard.claim_emit():
+            emit(result)
+    finally:
+        guard.disarm()
     if failed:
         sys.exit(1)
 

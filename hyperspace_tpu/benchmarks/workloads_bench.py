@@ -44,24 +44,30 @@ def run_workloads_bench(repeats: int = 4, steps: int = 10) -> dict:
         return step_s, {"repeat_spread": spread(times), **roof}, state
 
     def scanned_leg(stepper, state, k=32):
-        """Per-step ms of ONE dispatch running k chained steps under
-        ``lax.scan`` — the fix for dispatch-floor-bound legs: the r05
-        rooflines showed HVAE/product steps pinned at ~7 ms while their
-        HBM bound is 0.3–0.6 ms, i.e. the remote-attach per-dispatch
-        latency, not chip time.  The scan amortizes one dispatch over k
-        steps, exposing the true on-chip step (same lever as the
-        Poincaré epoch scan / CLI ``scan_chunk``)."""
-        def body(st, _):
-            st, loss = stepper(st)
-            return st, loss
+        """Per-step ms of ONE dispatch running k chained steps — the fix
+        for dispatch-floor-bound legs: the r05 rooflines showed
+        HVAE/product steps pinned at ~7 ms while their HBM bound is
+        0.3–0.6 ms, i.e. the remote-attach per-dispatch latency, not
+        chip time.  Runs the SAME chunked stepper production training
+        uses (train/loop.make_chunked_stepper, the CLI ``scan_chunk``
+        path), so the ``scan_chunk_*`` fields measure the shipped code,
+        not a bench-only twin."""
+        from hyperspace_tpu.train.loop import make_chunked_stepper
 
-        @jax.jit
-        def run(st):
-            st, losses = jax.lax.scan(body, st, None, length=k)
-            return st, losses[-1]
-
+        run = make_chunked_stepper(stepper, k)
         times, _, _ = time_steps_all(run, state, 1, repeats)
         return round(min(times) / k * 1e3, 3)
+
+    def scan_fields(step_s, scan_ms, k=32):
+        """The chunked-dispatch win, quantified per leg: K, per-step ms
+        at K, and the per-step dispatch overhead the chunking removed
+        (stepwise ms − scanned ms)."""
+        return {
+            "scan_chunk_k": k,
+            "scan_chunk_step_ms": scan_ms,
+            "scan_chunk_dispatch_overhead_ms": round(
+                step_s * 1e3 - scan_ms, 3),
+        }
 
     # --- HyboNet (workload 3): transformer classifier, flash attention
     cfg = hybonet.HyboNetConfig(vocab_size=8192, num_classes=8, max_len=128,
@@ -127,8 +133,9 @@ def run_workloads_bench(repeats: int = 4, steps: int = 10) -> dict:
     out["hvae"] = {
         "step_ms": round(step_s * 1e3, 3),
         "images_per_s": round(hcfg.batch_size / step_s, 1),
-        "scan32_step_ms": scan_ms,
-        "scan32_images_per_s": round(hcfg.batch_size / (scan_ms / 1e3), 1),
+        **scan_fields(step_s, scan_ms),
+        "scan_chunk_images_per_s": round(
+            hcfg.batch_size / (scan_ms / 1e3), 1),
         "batch": [hcfg.batch_size, hcfg.image_size, hcfg.image_size],
         "kind": hcfg.kind,
         **roof,
@@ -145,8 +152,9 @@ def run_workloads_bench(repeats: int = 4, steps: int = 10) -> dict:
     out["product_embed"] = {
         "step_ms": round(step_s * 1e3, 3),
         "pairs_per_s": round(pcfg.batch_size / step_s, 1),
-        "scan32_step_ms": scan_ms,
-        "scan32_pairs_per_s": round(pcfg.batch_size / (scan_ms / 1e3), 1),
+        **scan_fields(step_s, scan_ms),
+        "scan_chunk_pairs_per_s": round(
+            pcfg.batch_size / (scan_ms / 1e3), 1),
         "num_nodes": tree.num_nodes,
         "factors": [list(f) for f in pcfg.factors],
         **roof,

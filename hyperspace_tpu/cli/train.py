@@ -45,9 +45,15 @@ class RunConfig:
     multihost: bool = False  # jax.distributed.initialize + host mesh axis
     tp: int = 2  # tensor-parallel degree for HGCN's auto mesh (1 = pure dp)
     # >1: run this many steps per dispatch as one lax.scan program
-    # (poincare only; see models/poincare_embed.train_epoch_scan —
-    # removes per-step launch latency on small-step workloads)
+    # (train/loop.make_chunked_stepper; ALL workloads) — removes the
+    # per-step launch latency that pins small-step workloads at the
+    # dispatch floor (docs/benchmarks.md "chunked dispatch"); the step
+    # budget rounds UP to a chunk multiple, and checkpoints/logs land on
+    # chunk boundaries
     scan_chunk: int = 1
+    # persistent on-disk graph-prep cache (data/prep_cache.py):
+    # auto = cache big graphs only; true/false force on/off
+    graph_cache: str = "auto"
     # >1: accumulate this many microbatch gradients per optimizer update
     # (hybonet/hvae; optax.MultiSteps — `steps` counts microsteps)
     accum: int = 1
@@ -121,6 +127,44 @@ def _reject_accum(run: RunConfig, workload: str):
             "(embeddings), where microbatch accumulation has no meaning")
 
 
+def _graph_cache(run: RunConfig):
+    """RunConfig.graph_cache → the data.graphs ``cache`` argument."""
+    v = run.graph_cache.lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    if v == "auto":
+        return "auto"
+    raise SystemExit(f"graph_cache={run.graph_cache!r}: want auto/true/false")
+
+
+def _chunk_run(run: RunConfig) -> RunConfig:
+    """Round the step budget up to a scan_chunk multiple — every dispatch
+    runs exactly one full chunk, so checkpoint/log step numbers always
+    equal the steps actually taken."""
+    from hyperspace_tpu.train import loop
+
+    rounded = loop.round_steps_to_chunk(run.steps, run.scan_chunk)
+    if rounded != run.steps:
+        print(f"scan_chunk={run.scan_chunk}: step budget rounded up "
+              f"{run.steps} -> {rounded} (every dispatch runs a full "
+              "chunk)", flush=True)
+    return dataclasses.replace(run, steps=rounded)
+
+
+def _chunked(run: RunConfig, step_fn):
+    """(stepper, steps_per_call): ``step_fn`` wrapped for chunked
+    dispatch when ``run.scan_chunk > 1`` (one lax.scan program per
+    ``scan_chunk`` steps, state donated), unchanged otherwise."""
+    k = max(int(run.scan_chunk), 1)
+    if k <= 1:
+        return step_fn, 1
+    from hyperspace_tpu.train import loop
+
+    return loop.make_chunked_stepper(step_fn, k), k
+
+
 def run_poincare(run: RunConfig, overrides: dict):
     _reject_accum(run, "poincare")
     from hyperspace_tpu.data import wordnet
@@ -138,30 +182,17 @@ def run_poincare(run: RunConfig, overrides: dict):
 
     ball = PoincareBall(cfg.c)
     project = lambda st: st._replace(table=ball.proj(st.table))
-    if run.scan_chunk > 1:  # chunked dispatch: scan_chunk steps/program
-        if cfg.sparse:
-            raise SystemExit(
-                "scan_chunk>1 scans the dense step body only — drop "
-                "sparse=true or scan_chunk (the planned-sparse scan lives "
-                "in poincare_embed.train_epoch_planned_packed)")
-        # every dispatch runs exactly scan_chunk steps, so round the
-        # step budget up to a chunk multiple — checkpoint/log step
-        # numbers then always equal the steps actually taken
-        chunks = -(-run.steps // run.scan_chunk)
-        if chunks * run.scan_chunk != run.steps:
-            print(f"scan_chunk={run.scan_chunk}: step budget rounded up "
-                  f"{run.steps} -> {chunks * run.scan_chunk} (every "
-                  "dispatch runs a full chunk)", flush=True)
-        run = dataclasses.replace(run, steps=chunks * run.scan_chunk)
-        stepper = lambda st: pe.train_epoch_scan(cfg, opt, st, pairs,
-                                                 run.scan_chunk)
-        state, _ = _train_loop(run, state, stepper, project=project,
-                               steps_per_call=run.scan_chunk)
-    else:
-        step_fn = pe.make_train_step(cfg)
-        state, _ = _train_loop(run, state,
-                               lambda st: step_fn(cfg, opt, st, pairs),
-                               project=project)
+    if run.scan_chunk > 1 and cfg.sparse:
+        raise SystemExit(
+            "scan_chunk>1 scans the dense step body only — drop "
+            "sparse=true or scan_chunk (the planned-sparse scan lives "
+            "in poincare_embed.train_epoch_planned_packed)")
+    if run.scan_chunk > 1:
+        run = _chunk_run(run)
+    step_fn = pe.make_train_step(cfg)
+    stepper, spc = _chunked(run, lambda st: step_fn(cfg, opt, st, pairs))
+    state, _ = _train_loop(run, state, stepper, project=project,
+                           steps_per_call=spc)
     res = pe.evaluate(state.table, ds.pairs, cfg.c)
     # state.step is the authoritative count (survives resume/chunk
     # rounding — a resumed chunked run can legitimately exceed run.steps)
@@ -169,36 +200,50 @@ def run_poincare(run: RunConfig, overrides: dict):
 
 
 def _resume_chunk(run: RunConfig, chunk_steps: int) -> int:
-    """Starting chunk index for a SampledBatchStream: a run resuming
-    from step R has consumed batches from chunks 0..ceil(R/cs)-1 (the
-    last possibly partially), so the stream skips to the NEXT chunk
-    boundary — restarting at 0 would replay the consumed chunks, and
-    floor division would re-serve the already-started boundary chunk's
-    first R%cs rows (ADVICE r04).  The skipped tail rows of a partial
-    boundary chunk are iid draws that simply never get used; no batch
-    is ever repeated."""
-    if not (run.ckpt_dir and run.resume):
-        return 0
-    from hyperspace_tpu.train.checkpoint import peek_latest_step
+    """Starting chunk index for a SampledBatchStream — ceil(R/cs), see
+    :func:`hyperspace_tpu.train.loop.resume_chunk` (the ONE home of the
+    ceil-not-floor rationale, ADVICE r04)."""
+    from hyperspace_tpu.train import loop
 
-    cs = max(int(chunk_steps), 1)
-    return -(-peek_latest_step(run.ckpt_dir) // cs)
+    return loop.resume_chunk(run.ckpt_dir, run.resume, chunk_steps)
 
 
-def _stream_stepper(stream, step_fn):
+def _sampled_chunk_steps(run: RunConfig, plan_steps: int) -> int:
+    """Stream chunk size for the sampled trainers: ``plan_steps`` caps
+    the device-resident pyramid footprint, the step budget caps it from
+    above; with chunked dispatch the scan must divide the stream chunk so
+    every pull lands on a chunk boundary."""
+    cs = min(run.steps, plan_steps)
+    if run.scan_chunk > 1 and (run.scan_chunk > cs or cs % run.scan_chunk):
+        # never silently exceed the plan_steps footprint cap: a scan
+        # bigger than the stream chunk would force bigger host batches
+        # onto the device, which is exactly what plan_steps bounds
+        raise SystemExit(
+            f"scan_chunk={run.scan_chunk} must divide the sampled "
+            f"stream's chunk size {cs} (= min(steps, plan_steps)) — "
+            "raise plan_steps to a multiple of scan_chunk or lower "
+            "scan_chunk")
+    return cs
+
+
+def _stream_stepper(stream, step_fn, steps_per_call: int = 1):
     """Stepper that pulls a fresh pyramid chunk every ``chunk_steps``
-    calls from a :class:`hgcn_sampled.SampledBatchStream` — long runs
-    never recycle batches (VERDICT r3 #5).  The device step indexes its
-    pyramid row by ``state.step % chunk_steps``; a resume offset only
-    rotates the within-chunk consumption order (batches are iid draws),
-    every row of every chunk is still consumed exactly once.  The CHUNK
-    sequence itself continues across restarts via ``_resume_chunk``."""
-    holder = {"batches": None, "calls": 0}
+    DEVICE steps from a :class:`hgcn_sampled.SampledBatchStream` — long
+    runs never recycle batches (VERDICT r3 #5).  ``step_fn(state,
+    batches)`` may itself run ``steps_per_call`` steps per call (the
+    chunked-dispatch wrapper); the caller guarantees ``chunk_steps %
+    steps_per_call == 0`` so pulls stay on stream-chunk boundaries.  The
+    device step indexes its pyramid row by ``state.step % chunk_steps``;
+    a resume offset only rotates the within-chunk consumption order
+    (batches are iid draws), every row of every chunk is still consumed
+    exactly once.  The CHUNK sequence itself continues across restarts
+    via ``_resume_chunk``."""
+    holder = {"batches": None, "done": 0}
 
     def stepper(st):
-        if holder["calls"] % stream.chunk_steps == 0:
+        if holder["done"] % stream.chunk_steps == 0:
             holder["batches"] = stream.next()
-        holder["calls"] += 1
+        holder["done"] += steps_per_call
         return step_fn(st, holder["batches"])
 
     return stepper
@@ -228,6 +273,9 @@ def hgcn_mode_defaults(base, overrides: dict, sampled: bool):
 
 def run_hgcn(run: RunConfig, overrides: dict):
     _reject_accum(run, "hgcn")
+    if run.scan_chunk > 1:
+        run = _chunk_run(run)
+    gc = _graph_cache(run)
     from hyperspace_tpu.data import graphs as G
     from hyperspace_tpu.models import hgcn
 
@@ -253,7 +301,8 @@ def run_hgcn(run: RunConfig, overrides: dict):
         # locality relabeling: feeds the cluster-pair kernel
         edges, x, labels, _ = G.apply_locality_order(
             edges, x, labels,
-            method="community" if reorder == "community" else "bfs")
+            method="community" if reorder == "community" else "bfs",
+            cache=gc)
     base = hgcn_mode_defaults(
         hgcn.HGCNConfig(feat_dim=x.shape[1],
                         num_classes=ncls if task == "nc" else 0),
@@ -266,7 +315,8 @@ def run_hgcn(run: RunConfig, overrides: dict):
     if task == "lp":
         split = G.split_edges(
             edges, num_nodes, x, seed=run.seed,
-            cluster_min_pair=G.cluster_min_pair_for(cfg.use_att))
+            cluster_min_pair=G.cluster_min_pair_for(cfg.use_att),
+            cache=gc)
         if sampled:
             # minibatch LP (models/hgcn_sampled.py): pyramids over the
             # four endpoint chunks; full-graph eval on the shared tree
@@ -280,16 +330,19 @@ def run_hgcn(run: RunConfig, overrides: dict):
             model_s, opt, state = HS.init_sampled_lp(
                 scfg, feat_dim=x.shape[1], seed=run.seed)
             xt = jnp.asarray(np.asarray(x, np.float32))
-            chunk_steps = min(run.steps, plan_steps)
+            chunk_steps = _sampled_chunk_steps(run, plan_steps)
             with HS.SampledBatchStream(
                     scfg, "lp", num_nodes=num_nodes,
                     train_pos=split.train_pos,
                     chunk_steps=chunk_steps, seed=run.seed,
                     start_chunk=_resume_chunk(run, chunk_steps)) as stream:
-                stepper = _stream_stepper(
-                    stream, lambda st, b: HS.train_step_sampled_lp(
+                chunk_fn, spc = _chunked(
+                    run, lambda st, b: HS.train_step_sampled_lp(
                         model_s, opt, st, xt, stream.deg, b))
-                state, loss = _train_loop(run, state, stepper)
+                stepper = _stream_stepper(stream, chunk_fn,
+                                          steps_per_call=spc)
+                state, loss = _train_loop(run, state, stepper,
+                                          steps_per_call=spc)
             full = hgcn.HGCNLinkPred(cfg)
             res = {"loss": float(loss),
                    **hgcn.evaluate_lp(full, state.params, split, "test")}
@@ -305,21 +358,21 @@ def run_hgcn(run: RunConfig, overrides: dict):
             # attention softmax shard-local)
             step, state, ga_s = hgcn.make_node_sharded_step_lp(
                 model, opt, num_nodes, mesh, state, split)
-            state, loss = _train_loop(
-                run, state, lambda st: step(st, ga_s, train_pos))
+            stepper, spc = _chunked(run, lambda st: step(st, ga_s, train_pos))
         else:
             train_pos = jnp.asarray(split.train_pos)
-            state, loss = _train_loop(
-                run, state,
-                lambda st: hgcn.train_step_lp(model, opt, num_nodes, st, ga,
-                                              train_pos))
+            stepper, spc = _chunked(
+                run, lambda st: hgcn.train_step_lp(model, opt, num_nodes,
+                                                   st, ga, train_pos))
+        state, loss = _train_loop(run, state, stepper, steps_per_call=spc)
         res = {"loss": float(loss),
                **hgcn.evaluate_lp(model, state.params, split, "test", ga=ga)}
     else:
         tr, va, te = G.node_split_masks(num_nodes, seed=run.seed)
         g = G.prepare(edges, num_nodes, x, labels=labels, num_classes=ncls,
                       train_mask=tr, val_mask=va, test_mask=te,
-                      cluster_min_pair=G.cluster_min_pair_for(cfg.use_att))
+                      cluster_min_pair=G.cluster_min_pair_for(cfg.use_att),
+                      cache=gc)
         if sampled:
             # minibatch trainer (models/hgcn_sampled.py): single-device
             # dense-block steps (a local mesh is simply unused);
@@ -336,16 +389,19 @@ def run_hgcn(run: RunConfig, overrides: dict):
             model_s, opt, state = HS.init_sampled_nc(
                 scfg, feat_dim=x.shape[1], seed=run.seed)
             xt = jnp.asarray(np.asarray(x, np.float32))
-            chunk_steps = min(run.steps, plan_steps)
+            chunk_steps = _sampled_chunk_steps(run, plan_steps)
             with HS.SampledBatchStream(
                     scfg, "nc", num_nodes=num_nodes, edges=edges,
                     labels=labels, train_mask=tr,
                     chunk_steps=chunk_steps, seed=run.seed,
                     start_chunk=_resume_chunk(run, chunk_steps)) as stream:
-                stepper = _stream_stepper(
-                    stream, lambda st, b: HS.train_step_sampled_nc(
+                chunk_fn, spc = _chunked(
+                    run, lambda st, b: HS.train_step_sampled_nc(
                         model_s, opt, st, xt, stream.deg, b))
-                state, loss = _train_loop(run, state, stepper)
+                stepper = _stream_stepper(stream, chunk_fn,
+                                          steps_per_call=spc)
+                state, loss = _train_loop(run, state, stepper,
+                                          steps_per_call=spc)
             full = hgcn.HGCNNodeClf(cfg)
             res = {"loss": float(loss),
                    **hgcn.evaluate_nc(full, state.params, g)}
@@ -358,12 +414,13 @@ def run_hgcn(run: RunConfig, overrides: dict):
         if mesh is not None:
             step, state, ga_s, lab_s, mask_s = (
                 hgcn.make_node_sharded_step_nc(model, opt, mesh, state, g))
-            state, loss = _train_loop(
-                run, state, lambda st: step(st, ga_s, lab_s, mask_s))
+            stepper, spc = _chunked(
+                run, lambda st: step(st, ga_s, lab_s, mask_s))
         else:
-            state, loss = _train_loop(
-                run, state,
-                lambda st: hgcn.train_step_nc(model, opt, st, ga, lab, mask))
+            stepper, spc = _chunked(
+                run, lambda st: hgcn.train_step_nc(model, opt, st, ga, lab,
+                                                   mask))
+        state, loss = _train_loop(run, state, stepper, steps_per_call=spc)
         res = {"loss": float(loss),
                **hgcn.evaluate_nc(model, state.params, g, ga=ga)}
     return {"workload": "hgcn", "task": task, "dataset": dataset,
@@ -389,14 +446,17 @@ def run_hybonet(run: RunConfig, overrides: dict):
     from hyperspace_tpu.parallel.mesh import auto_mesh
 
     mesh = auto_mesh(run.multihost)
+    if run.scan_chunk > 1:
+        run = _chunk_run(run)
     if mesh is not None:
         step, state, (toks, mask, labels) = hybonet.make_sharded_step(
             model, opt, mesh, state, toks, mask, labels)
-        stepper = lambda st: step(st, toks, mask, labels)
+        base = lambda st: step(st, toks, mask, labels)
     else:
-        stepper = lambda st: hybonet.train_step_sampled(model, opt, st, toks,
-                                                        mask, labels)
-    state, loss = _train_loop(run, state, stepper)
+        base = lambda st: hybonet.train_step_sampled(model, opt, st, toks,
+                                                     mask, labels)
+    stepper, spc = _chunked(run, base)
+    state, loss = _train_loop(run, state, stepper, steps_per_call=spc)
     res = hybonet.evaluate(model, state.params, te)
     return {"workload": "hybonet", "source": source, "loss": float(loss), **res}
 
@@ -414,6 +474,8 @@ def run_hvae(run: RunConfig, overrides: dict):
     from hyperspace_tpu.parallel.mesh import auto_mesh
 
     mesh = auto_mesh(run.multihost)
+    if run.scan_chunk > 1:
+        run = _chunk_run(run)
     if mesh is not None:
         step, state, x_all = hvae.make_sharded_step(model, opt, mesh, state,
                                                     x_all)
@@ -421,12 +483,18 @@ def run_hvae(run: RunConfig, overrides: dict):
     else:
         fn = lambda st: hvae.train_step_sampled(model, opt, st, x_all)
 
+    chunk_fn, spc = _chunked(run, fn)
+
     def stepper(st):
-        st, loss, recon, kl = fn(st)
+        if spc == 1:
+            st, loss, recon, kl = chunk_fn(st)
+        else:  # scanned chunk: per-step aux stacked [spc]; keep the last
+            st, (loss, recon, kl) = chunk_fn(st)
+            recon, kl = recon[-1], kl[-1]
         metrics["rk"] = (recon, kl)  # device arrays; fetched once at the end
         return st, loss
 
-    state, loss = _train_loop(run, state, stepper)
+    state, loss = _train_loop(run, state, stepper, steps_per_call=spc)
     recon, kl = (float(v) for v in metrics.get("rk", (jnp.nan,) * 2))
     loss = float(loss)
     x = jnp.asarray(ds.images[:256], cfg.dtype)
@@ -450,17 +518,21 @@ def run_product(run: RunConfig, overrides: dict):
     state, curv_opt = pme.init_state(cfg, run.seed)
     pairs = jnp.asarray(ds.pairs)
     mesh = auto_mesh(run.multihost)
+    if run.scan_chunk > 1:
+        run = _chunk_run(run)
     if mesh is not None:
         step = pme.make_sharded_step(cfg, curv_opt, mesh)
-        stepper = lambda st: step(st, pairs)
+        base = lambda st: step(st, pairs)
     else:
-        stepper = lambda st: pme.train_step(cfg, curv_opt, state=st, pairs=pairs)
+        base = lambda st: pme.train_step(cfg, curv_opt, state=st, pairs=pairs)
+    stepper, spc = _chunked(run, base)
     def project(st):
         m = pme.build_manifold(cfg, st.params.c_raw)
         return st._replace(params=st.params._replace(
             table=m.proj(st.params.table)))
 
-    state, _ = _train_loop(run, state, stepper, project=project)
+    state, _ = _train_loop(run, state, stepper, project=project,
+                           steps_per_call=spc)
     res = pme.evaluate(cfg, state.params, ds.pairs)
     return {"workload": "product", **res,
             "curvatures": pme.curvatures(cfg, state.params)}
@@ -478,75 +550,17 @@ WORKLOADS = {
 # --- helpers ------------------------------------------------------------------
 
 
-def _logger(run: RunConfig):
-    from hyperspace_tpu.train.logging import MetricsLogger
-
-    return MetricsLogger(run.log, stdout=False,
-                         tensorboard_dir=run.tensorboard_dir)
-
-
 def _train_loop(run: RunConfig, state, stepper, project=None,
                 steps_per_call=1):
-    """Shared CLI step loop: optional checkpoint/resume + JSONL logging.
+    """The ONE step loop every workload runner goes through — moved to
+    :func:`hyperspace_tpu.train.loop.run_loop` (checkpoint/resume, JSONL
+    logging with boundary-crossing cadence, per-chunk loss accumulation);
+    this thin wrapper keeps the import lazy so ``--help`` never pays for
+    orbax."""
+    from hyperspace_tpu.train.loop import run_loop
 
-    Every workload runner goes through here, so --ckpt-dir / resume work
-    uniformly.  The checkpoint manager is context-managed (its __exit__
-    waits for in-flight async saves and closes background threads, also on
-    the exception path).  Orbax async saves copy device→host synchronously
-    before returning, so saving a state whose buffers the next step's
-    donation invalidates is safe.  ``project`` re-projects restored states
-    onto their manifolds (train/checkpoint.py's restore contract — guards
-    dtype/float drift off the constraint surface).  Returns
-    ``(final_state, final_loss)``; loss is nan when no step ran.
-    """
-    import contextlib
-
-    ck = None
-    start = 0
-    loss = jnp.nan
-    if run.ckpt_dir:
-        from hyperspace_tpu.train.checkpoint import CheckpointManager
-
-        ck = CheckpointManager(run.ckpt_dir,
-                               save_interval_steps=run.ckpt_every)
-    # restore inside the with-block: a corrupt checkpoint raising in
-    # restore() still closes the manager's async machinery on the way out
-    with (ck if ck is not None else contextlib.nullcontext()), \
-            _logger(run) as log:
-        if ck is not None and run.resume and ck.latest_step() is not None:
-            state, start = ck.restore(state, project=project)
-        last_saved = None
-        every = run.eval_every or 50
-        done = start
-        while done < run.steps:
-            state, loss = stepper(state)
-            if jnp.ndim(loss):  # scanned chunk: [steps_per_call] losses
-                loss = loss[-1]
-            # the stepper always executes exactly steps_per_call steps
-            # (the scan length is baked into the program), so the
-            # recorded step count is the TRUE count — never clamped
-            prev, done = done, done + steps_per_call
-            # boundary-crossing gates: with chunked stepping, `done` only
-            # takes chunk multiples, so exact-equality cadence would
-            # degrade to lcm(chunk, interval); fire whenever the chunk
-            # crossed an interval boundary (identical to the old
-            # `done % every == 0` when steps_per_call == 1)
-            if (done // every) > (prev // every):
-                log.log(done, loss=float(loss))
-            # ckpt_every <= 0 = final save only (mirrors eval_every's
-            # "0 = eval only at the end"; orbax's interval gate divides
-            # by the interval, so it never sees a 0)
-            if ck is not None and run.ckpt_every > 0:
-                iv = run.ckpt_every
-                crossed = (done // iv) > (prev // iv)
-                if ck.save(done, state,
-                           force=crossed and steps_per_call > 1):
-                    last_saved = done
-        if ck is not None and start < run.steps and last_saved != done:
-            # the final state must land even when it misses the save
-            # cadence — otherwise resume silently replays a partial chunk
-            ck.save(done, state, force=True)
-    return state, loss
+    return run_loop(run, state, stepper, project=project,
+                    steps_per_call=steps_per_call)
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -181,6 +181,12 @@ def _check_edge_range(edges, num_nodes: int) -> None:
             f"max {e.max()}")
 
 
+# raw-edge-count gate for cache="auto" (data/prep_cache.py): below this
+# the host prep is cheaper than hashing + disk IO, and unit-test graphs
+# must never touch the on-disk cache
+_CACHE_AUTO_MIN_EDGES = 200_000
+
+
 def cluster_min_pair_for(use_att: bool) -> int:
     """The mode-dependent cluster-pair density threshold — ONE home for
     the r05 sweep result (docs/benchmarks.md "Per-mode cluster
@@ -201,6 +207,7 @@ def prepare(
     pad_multiple: int = 1024,
     cluster: str | bool = "auto",
     cluster_min_pair: int = 256,
+    cache: Any = "auto",
     **node_fields,
 ) -> Graph:
     """Symmetrize, add self-loops, dedupe, sort by receiver, pad.
@@ -220,8 +227,44 @@ def prepare(
       schedule for :func:`hyperspace_tpu.kernels.segment.csr_segment_sum`)
       are static per graph, so they are computed here once instead of per
       training step.
+    - The whole edge layout (everything above plus the cluster split) is
+      a pure function of (edges, num_nodes, knobs), so it is served from
+      the persistent :mod:`hyperspace_tpu.data.prep_cache` when ``cache``
+      allows — ``"auto"`` caches big graphs only; pass ``True``/a
+      ``PrepCache`` to force, ``False`` to disable.  ``x`` and the node
+      fields ride outside the cache (they don't shape the edge layout).
     """
     _check_edge_range(edges, num_nodes)
+    from hyperspace_tpu.data import prep_cache
+
+    e_arr = np.asarray(edges)
+    pc = prep_cache.resolve(
+        cache, auto_ok=len(e_arr) >= _CACHE_AUTO_MIN_EDGES)
+    build = lambda: _build_edge_layout(
+        e_arr, num_nodes, symmetrize=symmetrize, self_loops=self_loops,
+        pad_multiple=pad_multiple, cluster=cluster,
+        cluster_min_pair=cluster_min_pair)
+    if pc is not None:
+        layout = pc.get_or_build(
+            "edge-layout",
+            (e_arr.astype(np.int64, copy=False), num_nodes, symmetrize,
+             self_loops, pad_multiple, str(cluster), cluster_min_pair),
+            build)
+    else:
+        layout = build()
+
+    return Graph(
+        x=np.asarray(x, np.float32),
+        num_nodes=num_nodes,
+        **layout,
+        **node_fields,
+    )
+
+
+def _build_edge_layout(edges, num_nodes, *, symmetrize, self_loops,
+                       pad_multiple, cluster, cluster_min_pair) -> dict:
+    """The cacheable core of :func:`prepare`: every edge-derived artifact
+    as a dict of Graph field values (no x/labels/masks)."""
     senders = receivers = mask = rev_perm = deg = None
     try:  # native C++ pipeline; _prepare_edges_numpy is the oracle
         from hyperspace_tpu.data import native
@@ -261,17 +304,14 @@ def prepare(
                                         num_nodes, rev_perm=rev_perm,
                                         min_pair_edges=cluster_min_pair)
 
-    return Graph(
-        x=np.asarray(x, np.float32),
+    return dict(
         senders=senders,
         receivers=receivers,
         edge_mask=mask,
-        num_nodes=num_nodes,
         rev_perm=rev_perm,
         deg=deg,
         csr_plan=tuple(build_csr_plan(receivers, num_nodes)),
         cluster_split=split,
-        **node_fields,
     )
 
 
@@ -288,62 +328,83 @@ def split_edges(
     seed: int = 0,
     pad_multiple: int = 1024,
     cluster_min_pair: int = 256,
+    cache: Any = "auto",
     **node_fields,
 ) -> LinkSplit:
     """Hold out edges for LP eval; message passing uses only train edges.
 
     Negatives are uniform non-edges, the Chami et al. 2019 protocol whose
-    ROC-AUC is the [B] quality target.
+    ROC-AUC is the [B] quality target.  The host split (canonicalized
+    permutation + rejection-sampled negatives) is deterministic in
+    (edges, num_nodes, fracs, seed), so it caches persistently alongside
+    the prepared graph's edge layout (``cache`` — see :func:`prepare`).
     """
-    rng = np.random.default_rng(seed)
     e = np.asarray(edges, np.int64)
-    # undirected canonical form for splitting
-    canon = np.sort(e, axis=1)
-    canon = canon[np.unique(canon[:, 0] * num_nodes + canon[:, 1], return_index=True)[1]]
-    perm = rng.permutation(len(canon))
-    n_val = int(len(canon) * val_frac)
-    n_test = int(len(canon) * test_frac)
-    val_pos = canon[perm[:n_val]]
-    test_pos = canon[perm[n_val : n_val + n_test]]
-    train_pos = canon[perm[n_val + n_test :]]
 
-    def sample_neg(k: int) -> np.ndarray:
-        try:  # native rejection sampler (arxiv-scale edge sets)
-            from hyperspace_tpu.data import native
+    def build() -> dict:
+        # the WHOLE host split lives inside the cached builder — the
+        # O(E log E) canonicalize/sort/dedup/permutation is most of the
+        # cost at arxiv scale, so a cache hit must skip it too, not just
+        # the negative sampling
+        rng = np.random.default_rng(seed)
+        # undirected canonical form for splitting
+        canon = np.sort(e, axis=1)
+        canon = canon[np.unique(canon[:, 0] * num_nodes + canon[:, 1],
+                                return_index=True)[1]]
+        perm = rng.permutation(len(canon))
+        n_val = int(len(canon) * val_frac)
+        n_test = int(len(canon) * test_frac)
+        val_pos = canon[perm[:n_val]]
+        test_pos = canon[perm[n_val : n_val + n_test]]
+        train_pos = canon[perm[n_val + n_test :]]
 
-            neg = native.sample_negative_edges(
-                canon, num_nodes, k, seed=int(rng.integers(2**31)))
-            if len(neg) == k:
-                return neg.astype(np.int64)
-        except (ImportError, OSError):
-            pass
-        edge_set = {(int(u), int(v)) for u, v in canon}
-        out = []
-        while len(out) < k:
-            cand = rng.integers(0, num_nodes, size=(2 * (k - len(out)) + 16, 2))
-            for u, v in cand:
-                if u == v:
-                    continue
-                a, b = (int(u), int(v)) if u < v else (int(v), int(u))
-                if (a, b) in edge_set:
-                    continue
-                out.append((a, b))
-                if len(out) == k:
-                    break
-        return np.asarray(out, np.int64)
+        def sample_neg(k: int) -> np.ndarray:
+            try:  # native rejection sampler (arxiv-scale edge sets)
+                from hyperspace_tpu.data import native
 
+                neg = native.sample_negative_edges(
+                    canon, num_nodes, k, seed=int(rng.integers(2**31)))
+                if len(neg) == k:
+                    return neg.astype(np.int64)
+            except (ImportError, OSError):
+                pass
+            edge_set = {(int(u), int(v)) for u, v in canon}
+            out = []
+            while len(out) < k:
+                cand = rng.integers(0, num_nodes,
+                                    size=(2 * (k - len(out)) + 16, 2))
+                for u, v in cand:
+                    if u == v:
+                        continue
+                    a, b = (int(u), int(v)) if u < v else (int(v), int(u))
+                    if (a, b) in edge_set:
+                        continue
+                    out.append((a, b))
+                    if len(out) == k:
+                        break
+            return np.asarray(out, np.int64)
+
+        return dict(
+            train_pos=train_pos.astype(np.int32),
+            val_pos=val_pos.astype(np.int32),
+            val_neg=sample_neg(len(val_pos)).astype(np.int32),
+            test_pos=test_pos.astype(np.int32),
+            test_neg=sample_neg(len(test_pos)).astype(np.int32),
+        )
+
+    from hyperspace_tpu.data import prep_cache
+
+    pc = prep_cache.resolve(cache, auto_ok=len(e) >= _CACHE_AUTO_MIN_EDGES)
+    if pc is not None:
+        arrs = pc.get_or_build(
+            "lp-split", (e, num_nodes, val_frac, test_frac, seed), build)
+    else:
+        arrs = build()
     g = prepare(
-        train_pos, num_nodes, x, pad_multiple=pad_multiple,
-        cluster_min_pair=cluster_min_pair, **node_fields
+        arrs["train_pos"], num_nodes, x, pad_multiple=pad_multiple,
+        cluster_min_pair=cluster_min_pair, cache=cache, **node_fields
     )
-    return LinkSplit(
-        graph=g,
-        train_pos=train_pos.astype(np.int32),
-        val_pos=val_pos.astype(np.int32),
-        val_neg=sample_neg(len(val_pos)).astype(np.int32),
-        test_pos=test_pos.astype(np.int32),
-        test_neg=sample_neg(len(test_pos)).astype(np.int32),
-    )
+    return LinkSplit(graph=g, **arrs)
 
 
 # --- on-disk loaders ----------------------------------------------------------
@@ -772,22 +833,31 @@ def community_order(edges: np.ndarray, num_nodes: int,
 
 def apply_locality_order(edges: np.ndarray, x: np.ndarray,
                          labels: Optional[np.ndarray] = None,
-                         method: str = "bfs"):
+                         method: str = "bfs", cache: Any = "auto"):
     """Relabel a loaded graph with :func:`locality_order` (``method=
     "bfs"``) or :func:`community_order` (``method="community"`` — better
     block density on community-structured graphs, costlier host prep).
 
     Returns (edges, x, labels, order) with node ``order[rank]`` renamed
     to ``rank``; pass the result straight to :func:`prepare` /
-    :func:`split_edges`.
+    :func:`split_edges`.  The order array is deterministic in (edges, n,
+    method), so it caches persistently (``cache`` — see :func:`prepare`;
+    the community order is ~20 s of host work at arxiv scale).
     """
     n = x.shape[0]
-    if method == "community":
-        order = community_order(edges, n)
-    elif method == "bfs":
-        order = locality_order(edges, n)
-    else:
+    if method not in ("community", "bfs"):
         raise ValueError(f"unknown reorder method {method!r}")
+    from hyperspace_tpu.data import prep_cache
+
+    e_arr = np.asarray(edges, np.int64)
+    pc = prep_cache.resolve(
+        cache, auto_ok=len(e_arr) >= _CACHE_AUTO_MIN_EDGES)
+    build = lambda: (community_order(e_arr, n) if method == "community"
+                     else locality_order(e_arr, n))
+    if pc is not None:
+        order = pc.get_or_build("local-order", (e_arr, n, method), build)
+    else:
+        order = build()
     rank = np.empty(n, np.int64)
     rank[order] = np.arange(n)
     new_edges = rank[np.asarray(edges, np.int64)]

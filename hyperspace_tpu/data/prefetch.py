@@ -1,0 +1,87 @@
+"""Double-buffered host→device prefetch (the overlap half of the
+chunked-dispatch loop).
+
+A chunked training run alternates two kinds of work: device compute (one
+``lax.scan`` dispatch per chunk) and host batch assembly (numpy planning,
+sampling, ``jax.device_put``).  Serializing them wastes whichever is
+cheaper; this module overlaps them with the standard two-slot pipeline:
+a background thread assembles chunk *i+1* (and starts its host→device
+transfer — ``device_put`` in the worker overlaps the copy too) while the
+device trains on chunk *i*, handing finished items over a bounded queue.
+
+:class:`HostPrefetcher` is the generic engine;
+``models/hgcn_sampled.SampledBatchStream`` (the r04 overlap pipeline this
+generalizes) now runs on it, and any runner with host-fed batches can.
+
+Semantics (all load-bearing, mirrored from the stream it replaces):
+
+- **Ordering**: ``next()`` yields ``fn(start)``, ``fn(start+1)``, … in
+  order, exactly once each.
+- **Bounded look-ahead**: at most ``depth`` finished items are ever
+  queued (the worker's put blocks when full), bounding host memory.
+- **Failure**: an exception in ``fn`` is re-raised from ``next()`` with
+  the real traceback as its cause — a dead silent worker would make
+  ``next()`` block forever instead.
+- **Shutdown**: ``close()`` (or the context manager) stops the worker,
+  drains the queue to unblock a put, and joins the thread.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable
+
+
+class HostPrefetcher:
+    """Run ``fn(index)`` for index = start, start+1, … in a background
+    thread, ``depth`` items ahead of the consumer."""
+
+    def __init__(self, fn: Callable[[int], Any], *, depth: int = 2,
+                 start: int = 0):
+        self._fn = fn
+        self._q: Any = queue.Queue(maxsize=int(depth))
+        self._stop = threading.Event()
+        self._start = int(start)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        index = self._start
+        while not self._stop.is_set():
+            try:
+                item = self._fn(index)
+            except BaseException as e:  # noqa: BLE001 — re-raised in next()
+                item = e
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+            if isinstance(item, BaseException):
+                return  # consumer re-raises; producing further items
+            index += 1  # after a failure would hide it
+
+    def next(self) -> Any:
+        """Block until the next item is ready (re-raising worker errors)."""
+        item = self._q.get()
+        if isinstance(item, BaseException):
+            raise RuntimeError(
+                f"{type(self).__name__} worker failed") from item
+        return item
+
+    def close(self):
+        self._stop.set()
+        while not self._q.empty():  # unblock a worker stuck on put
+            try:
+                self._q.get_nowait()
+            except Exception:
+                break
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

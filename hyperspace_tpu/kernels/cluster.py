@@ -637,6 +637,21 @@ def build_cluster_split(
     min_pair_edges: int = 256,
     rev_perm: np.ndarray | None = None,
 ) -> ClusterSplit:
+    if bn != bs:
+        # the straggler/clustered partition is closed under edge reversal
+        # ONLY when receivers and senders use identical blockings: edge
+        # (a, b) lands in pair (a//bn, b//bs) and its mirror (b, a) in
+        # (b//bn, a//bs), which are each other's transposes — hence the
+        # same edge count / density class — iff bn == bs.  The attention
+        # backward's involution identities (s_rev_local, cluster_att_bwd)
+        # require that closure; with bn != bs it fails as an
+        # AssertionError deep inside prepare(), so reject up front.
+        raise ValueError(
+            f"build_cluster_split requires bn == bs (got bn={bn}, "
+            f"bs={bs}): reversal closure of the clustered/straggler "
+            "split — and with it the attention path's straggler "
+            "involution — only holds under identical receiver/sender "
+            "blockings")
     from hyperspace_tpu.kernels.segment import build_csr_plan
 
     mask = np.asarray(edge_mask)

@@ -367,26 +367,24 @@ class SampledBatchStream:
 
     The planner cores are the SAME functions the one-shot planners use
     (`_plan_nc_chunk` / `_plan_lp_chunk`); only the per-chunk seed
-    derivation differs (splitmix64 of (seed, chunk index)).
+    derivation differs (splitmix64 of (seed, chunk index)).  The
+    thread/queue machinery itself is the generic
+    :class:`hyperspace_tpu.data.prefetch.HostPrefetcher` (this stream is
+    the pipeline it was factored out of); this class owns only the
+    planning and the chunk-seed sequence.
     """
 
     def __init__(self, cfg: SampledConfig, task: str, *, num_nodes: int,
                  edges=None, labels=None, train_mask=None, train_pos=None,
                  chunk_steps: int = 64, depth: int = 2, seed: int = 0,
                  start_chunk: int = 0):
-        import queue
-        import threading
+        from hyperspace_tpu.data.prefetch import HostPrefetcher
 
         self.cfg = cfg
         self.task = task
         self.chunk_steps = int(chunk_steps)
         self._seed = int(seed)
         self._num_nodes = int(num_nodes)
-        # resume support (ADVICE r04): a run restored at step R passes
-        # start_chunk = R // chunk_steps so the chunk sequence CONTINUES
-        # instead of replaying chunks 0..R/chunk_steps — the "never a
-        # repeated batch" guarantee holds across restarts
-        self._start_chunk = int(start_chunk)
         if task == "nc":
             self._indptr, self._indices = build_adjacency(edges, num_nodes)
             self._train_nodes = np.flatnonzero(np.asarray(train_mask))
@@ -399,10 +397,14 @@ class SampledBatchStream:
             raise ValueError(f"unknown task {task!r}")
         self.deg = jnp.asarray(
             (self._indptr[1:] - self._indptr[:-1]).astype(np.float32))
-        self._q: Any = queue.Queue(maxsize=int(depth))
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
+        # resume support (ADVICE r04): a run restored at step R passes
+        # start_chunk = ceil(R / chunk_steps) — see train/loop.resume_chunk
+        # (NOT floor: floor would re-serve the partially-consumed boundary
+        # chunk's first R%cs rows, the batch-replay bug) — so the chunk
+        # sequence CONTINUES instead of replaying consumed chunks; the
+        # "never a repeated batch" guarantee holds across restarts
+        self._prefetch = HostPrefetcher(self._make_chunk, depth=depth,
+                                        start=int(start_chunk))
 
     def _plan(self, chunk: int):
         cs = _mix64((self._seed << 20) ^ chunk)
@@ -414,27 +416,13 @@ class SampledBatchStream:
                               self._train_pos, self._num_nodes,
                               self.chunk_steps, cs)
 
-    def _worker(self):
-        import queue
-
-        chunk = self._start_chunk
-        while not self._stop.is_set():
-            try:
-                levels, lab = self._plan(chunk)
-                item = SampledBatches(
-                    tuple(jax.device_put(l) for l in levels),
-                    None if lab is None else jax.device_put(lab))
-            except BaseException as e:  # noqa: BLE001 — re-raised in next()
-                item = e
-            while not self._stop.is_set():
-                try:
-                    self._q.put(item, timeout=0.2)
-                    break
-                except queue.Full:
-                    continue
-            if isinstance(item, BaseException):
-                return  # consumer re-raises; a dead silent thread would
-            chunk += 1  # make next() block forever instead
+    def _make_chunk(self, chunk: int) -> SampledBatches:
+        # device_put in the prefetch worker: the host→device copy of
+        # chunk i+1 overlaps the device's training on chunk i
+        levels, lab = self._plan(chunk)
+        return SampledBatches(
+            tuple(jax.device_put(l) for l in levels),
+            None if lab is None else jax.device_put(lab))
 
     def next(self) -> SampledBatches:
         """Block until the next fresh chunk of pyramids is ready.
@@ -442,19 +430,10 @@ class SampledBatchStream:
         Re-raises any exception the planner thread hit (the run fails
         with the real traceback instead of hanging on an empty queue).
         """
-        item = self._q.get()
-        if isinstance(item, BaseException):
-            raise RuntimeError("SampledBatchStream planner failed") from item
-        return item
+        return self._prefetch.next()
 
     def close(self):
-        self._stop.set()
-        while not self._q.empty():  # unblock a worker stuck on put
-            try:
-                self._q.get_nowait()
-            except Exception:
-                break
-        self._thread.join(timeout=5.0)
+        self._prefetch.close()
 
     def __enter__(self):
         return self

@@ -1,8 +1,10 @@
+from hyperspace_tpu.optim.metrics import ChunkMetrics
 from hyperspace_tpu.optim.radam import riemannian_adam
 from hyperspace_tpu.optim.rsgd import riemannian_sgd
 from hyperspace_tpu.optim.tags import map_tagged, path_contains, tags_from_paths
 
 __all__ = [
+    "ChunkMetrics",
     "riemannian_adam",
     "riemannian_sgd",
     "map_tagged",

@@ -1,0 +1,39 @@
+"""Per-chunk metric accumulation for the chunked-dispatch loop.
+
+A chunked stepper (train/loop.make_chunked_stepper) returns the stacked
+``[K]`` per-step losses of one dispatch.  Fetching each to host per step
+would reintroduce exactly the per-step host round-trip the chunking
+removed, so the loop accumulates the DEVICE arrays and reduces them with
+ONE host fetch per log boundary.  Holding the references is safe: only
+the carried train state is donated; loss outputs are fresh buffers the
+next dispatch never aliases.
+"""
+
+from __future__ import annotations
+
+
+class ChunkMetrics:
+    """Accumulate chunk loss arrays; ``flush()`` = mean since last flush.
+
+    ``add`` takes whatever the stepper returned as its loss — a scalar
+    (K=1) or a stacked ``[K]`` device array — and does NOT synchronize;
+    the one device→host transfer happens in ``flush``.
+    """
+
+    def __init__(self):
+        self._chunks = []
+
+    def add(self, losses) -> None:
+        self._chunks.append(losses)
+
+    def flush(self):
+        """Mean over every step added since the previous flush (one host
+        fetch), or None when nothing was added."""
+        if not self._chunks:
+            return None
+        import numpy as np
+
+        vals = np.concatenate(
+            [np.atleast_1d(np.asarray(c)) for c in self._chunks])
+        self._chunks.clear()
+        return float(vals.mean())

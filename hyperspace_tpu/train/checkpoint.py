@@ -60,8 +60,14 @@ class CheckpointManager:
         step: Optional[int] = None,
         project: Optional[Callable[[Any], Any]] = None,
     ) -> tuple[Any, int]:
-        """Restore (state, step); ``state_like`` supplies structure/shapes."""
-        step = self.latest_step() if step is None else step
+        """Restore (state, step); ``state_like`` supplies structure/shapes.
+
+        With ``step=None`` the target is :meth:`latest_committed_step`,
+        NOT orbax's ``latest_step()`` — orbax trusts any all-digit dir,
+        including an interrupted save's empty one, and restoring that
+        would crash (or worse, desync from the resume-offset accounting
+        ``peek_latest_step`` derived from the committed step)."""
+        step = self.latest_committed_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self._dir}")
         restored = self._mgr.restore(
@@ -72,6 +78,14 @@ class CheckpointManager:
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
+
+    def latest_committed_step(self) -> Optional[int]:
+        """Newest step dir that passes the commit test (the SAME scan
+        ``peek_latest_step`` runs) — the restore target and the CLI's
+        resume-offset source must agree on which step is real, or an
+        interrupted save desyncs stream accounting from the restored
+        step (ADVICE r5)."""
+        return _latest_committed_step(self._dir)
 
     def wait(self):
         """Block until async saves land (call before process exit)."""
@@ -88,16 +102,61 @@ class CheckpointManager:
         self.close()
 
 
+def _step_dir_committed(path: str) -> bool:
+    """Whether a candidate step dir holds a COMMITTED save, judged the
+    way orbax's ``latest_step()`` would: orbax writes into a
+    ``…orbax-checkpoint-tmp…`` staging name and renames on commit, so an
+    interrupted save leaves either no all-digit dir at all or an
+    empty/partial one.  Structural test first (non-empty, no staging
+    markers inside — orbax's own ``is_checkpoint_finalized`` passes an
+    EMPTY dir, which is exactly the interrupted-save shape to reject),
+    then orbax's finalization check on top when the installed version
+    exposes it."""
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return False
+    if not entries or any("orbax-checkpoint-tmp" in e for e in entries):
+        return False
+    try:
+        from orbax.checkpoint import utils as ocp_utils
+
+        return bool(ocp_utils.is_checkpoint_finalized(path))
+    except Exception:  # noqa: BLE001 — version drift: structural verdict
+        return True
+
+
+def _latest_committed_step(directory: str) -> Optional[int]:
+    """Newest all-digit step dir under ``directory`` that passes
+    :func:`_step_dir_committed` — the ONE scan behind both
+    ``peek_latest_step`` (resume-offset accounting) and
+    ``CheckpointManager.latest_committed_step`` (restore target), so the
+    two can never disagree on which step is real."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    for s in sorted((int(n) for n in names if n.isdigit()), reverse=True):
+        if _step_dir_committed(os.path.join(directory, str(s))):
+            return s
+    return None
+
+
 def peek_latest_step(directory: str) -> int:
-    """Latest checkpointed step under ``directory``, 0 if none — WITHOUT
-    opening a full manager (no async machinery, nothing created on
-    disk).  Used by the CLI to derive resume offsets (e.g. the sampled
-    stream's starting chunk) before the training loop restores."""
-    d = os.path.abspath(directory)
-    if not os.path.isdir(d):
-        return 0
-    steps = [int(name) for name in os.listdir(d) if name.isdigit()]
-    return max(steps, default=0)
+    """Latest COMMITTED checkpoint step under ``directory``, 0 if none —
+    WITHOUT opening a full manager (no async machinery, nothing created
+    on disk).  Used by the CLI to derive resume offsets (e.g. the
+    sampled stream's starting chunk) before the training loop restores.
+
+    Candidate all-digit dirs are validated with the same commit test
+    orbax's ``latest_step()`` applies (ADVICE r5): after an interrupted
+    save the newest dir can be uncommitted, and trusting it would derive
+    ``start_chunk`` from a newer step than the one the loop actually
+    restores — chunks skipped, consumed-batch accounting drifting from
+    the restored step.  Uncommitted candidates are skipped in favor of
+    the next older committed one."""
+    step = _latest_committed_step(os.path.abspath(directory))
+    return 0 if step is None else step
 
 
 def reproject_params(tags, params):

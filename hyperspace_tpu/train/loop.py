@@ -1,0 +1,202 @@
+"""Chunked-dispatch training loop — the production home of the r05 fix.
+
+The r05 roofline study (docs/benchmarks.md, BENCH_r05.json) diagnosed the
+small-step workloads (HVAE, product-embed) as pinned at the ~7 ms
+per-dispatch latency floor — 10-20x above their HBM-roofline bounds — and
+proved the fix (K steps per dispatch under ``lax.scan``) inside
+``benchmarks/workloads_bench.py`` only.  This module promotes that bench
+trick to a first-class training-loop feature shared by every CLI runner:
+
+- :func:`make_chunked_stepper` compiles K calls of a single-step function
+  into ONE XLA program (``lax.scan`` over the step body) with the carried
+  train state donated, so a run pays one dispatch per K steps instead of
+  one per step.  With the same step body and the same PRNG stream the
+  chunked trajectory is bitwise the single-step trajectory (the
+  ``train_epoch_scan`` guarantee, now generic).
+- :func:`run_loop` is the ONE step loop every workload runner goes
+  through (moved here from ``cli/train.py``): checkpoint/resume, JSONL
+  logging with boundary-crossing cadence (a chunk that crosses a log or
+  save interval fires it), and per-chunk loss accumulation
+  (:class:`hyperspace_tpu.optim.metrics.ChunkMetrics` — one host fetch
+  per log boundary, never one per step).
+- :func:`resume_chunk` derives the batch-stream resume offset (ceil —
+  see the function doc; floor would replay already-consumed rows).
+
+Chunk size policy: ``K`` trades dispatch amortization against reaction
+latency — checkpoints/logs can only land on chunk boundaries, so keep
+``K`` ≲ the checkpoint cadence.  K=32 recovers the dispatch floor on the
+ms-scale steps (docs/benchmarks.md "chunked dispatch"); K=1 is exactly
+the old loop (steppers are called directly, no scan wrapper).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def make_chunked_stepper(step_fn: Callable, chunk_steps: int):
+    """Compile ``chunk_steps`` calls of ``step_fn`` into one XLA program.
+
+    ``step_fn(state, *args) -> (state, out...)`` must be a traceable
+    single-step body (the jitted per-step train functions qualify: jit
+    inlines under trace).  Returns ``chunk(state, *args)`` — one jitted
+    dispatch running ``chunk_steps`` steps with ``state`` donated —
+    whose outputs are the per-step ``out`` values stacked on a leading
+    ``[chunk_steps]`` axis (a single extra output comes back as one
+    stacked array, several as a tuple of stacked arrays).  ``*args`` are
+    scan-invariant (the same batch/graph arrays feed every step in the
+    chunk; steps that walk a plan index by ``state.step`` advance
+    through it as usual).
+
+    ``chunk_steps <= 1`` returns ``step_fn`` unchanged — the K=1 path is
+    the caller's original stepper, bit-identical by construction.
+    """
+    k = int(chunk_steps)
+    if k <= 1:
+        return step_fn
+
+    def body(state, *args):
+        def one(st, _):
+            res = step_fn(st, *args)
+            out = res[1] if len(res) == 2 else tuple(res[1:])
+            return res[0], out
+
+        return jax.lax.scan(one, state, None, length=k)
+
+    return jax.jit(body, donate_argnums=(0,))
+
+
+def round_steps_to_chunk(steps: int, chunk_steps: int) -> int:
+    """Step budget rounded UP to a chunk multiple: every dispatch runs
+    exactly ``chunk_steps`` steps (the scan length is baked into the
+    program), so checkpoint/log step numbers always equal the steps
+    actually taken — never a clamped lie."""
+    k = max(int(chunk_steps), 1)
+    return -(-int(steps) // k) * k
+
+
+def resume_chunk(ckpt_dir: Optional[str], resume: bool,
+                 chunk_steps: int) -> int:
+    """Starting chunk index for a resuming batch stream (e.g.
+    ``hgcn_sampled.SampledBatchStream``): a run resuming from step R has
+    consumed batches from chunks 0..ceil(R/cs)-1 (the last possibly
+    partially), so the stream skips to the NEXT chunk boundary —
+    restarting at 0 would replay the consumed chunks, and floor division
+    would re-serve the already-started boundary chunk's first R%cs rows
+    (ADVICE r04).  The skipped tail rows of a partial boundary chunk are
+    iid draws that simply never get used; no batch is ever repeated."""
+    if not (ckpt_dir and resume):
+        return 0
+    from hyperspace_tpu.train.checkpoint import peek_latest_step
+
+    cs = max(int(chunk_steps), 1)
+    return -(-peek_latest_step(ckpt_dir) // cs)
+
+
+def _logger(run):
+    from hyperspace_tpu.train.logging import MetricsLogger
+
+    return MetricsLogger(run.log, stdout=False,
+                         tensorboard_dir=run.tensorboard_dir)
+
+
+def run_loop(run, state, stepper, project=None, steps_per_call=1):
+    """Shared step loop: optional checkpoint/resume + JSONL logging.
+
+    ``run`` is duck-typed (``cli.train.RunConfig`` shape): ``steps``,
+    ``eval_every``, ``log``, ``tensorboard_dir``, ``ckpt_dir``,
+    ``ckpt_every``, ``resume``.  Every workload runner goes through
+    here, so --ckpt-dir / resume work uniformly.  The checkpoint manager
+    is context-managed (its __exit__ waits for in-flight async saves and
+    closes background threads, also on the exception path).  Orbax async
+    saves copy device→host synchronously before returning, so saving a
+    state whose buffers the next step's donation invalidates is safe.
+    ``project`` re-projects restored states onto their manifolds
+    (train/checkpoint.py's restore contract — guards dtype/float drift
+    off the constraint surface).  ``steps_per_call`` is the chunk size:
+    the stepper always executes exactly that many steps per call (see
+    :func:`make_chunked_stepper`); chunked steppers return the stacked
+    ``[steps_per_call]`` per-step losses, of which the LAST is the
+    logged/returned loss and the chunk mean rides along as
+    ``loss_mean``.  Returns ``(final_state, final_loss)``; loss is nan
+    when no step ran.
+    """
+    ck = None
+    start = 0
+    loss = jnp.nan
+    if run.ckpt_dir:
+        from hyperspace_tpu.train.checkpoint import CheckpointManager
+
+        ck = CheckpointManager(run.ckpt_dir,
+                               save_interval_steps=run.ckpt_every)
+    acc = None
+    if steps_per_call > 1:
+        from hyperspace_tpu.optim.metrics import ChunkMetrics
+
+        acc = ChunkMetrics()
+    # restore inside the with-block: a corrupt checkpoint raising in
+    # restore() still closes the manager's async machinery on the way out
+    with (ck if ck is not None else contextlib.nullcontext()), \
+            _logger(run) as log:
+        if (ck is not None and run.resume
+                and ck.latest_committed_step() is not None):
+            state, start = ck.restore(state, project=project)
+            # re-materialize the restored pytree before stepping: the
+            # first dispatch DONATES these buffers, and donating arrays
+            # that came out of orbax's restore machinery (rather than out
+            # of a jitted program) has been observed to corrupt resumed
+            # trajectories under a persistent compilation cache; one
+            # device-side copy per resume buys unconditionally safe
+            # donation
+            state = jax.tree_util.tree_map(
+                lambda a: jnp.asarray(a).copy(), state)
+        last_saved = None
+        every = run.eval_every or 50
+        done = start
+        while done < run.steps:
+            state, loss = stepper(state)
+            if acc is not None:
+                acc.add(loss)
+            if jnp.ndim(loss):  # scanned chunk: [steps_per_call] losses
+                loss = loss[-1]
+            # the stepper always executes exactly steps_per_call steps
+            # (the scan length is baked into the program), so the
+            # recorded step count is the TRUE count — never clamped
+            prev, done = done, done + steps_per_call
+            # boundary-crossing gates: with chunked stepping, `done` only
+            # takes chunk multiples, so exact-equality cadence would
+            # degrade to lcm(chunk, interval); fire whenever the chunk
+            # crossed an interval boundary (identical to the old
+            # `done % every == 0` when steps_per_call == 1)
+            if (done // every) > (prev // every):
+                kw = {"loss": float(loss)}
+                if acc is not None:
+                    mean = acc.flush()
+                    if mean is not None:
+                        kw["loss_mean"] = mean
+                log.log(done, **kw)
+            # ckpt_every <= 0 = final save only (mirrors eval_every's
+            # "0 = eval only at the end"; orbax's interval gate divides
+            # by the interval, so it never sees a 0)
+            if ck is not None and run.ckpt_every > 0:
+                iv = run.ckpt_every
+                crossed = (done // iv) > (prev // iv)
+                if ck.save(done, state,
+                           force=crossed and steps_per_call > 1):
+                    last_saved = done
+        if acc is not None and done > start:
+            # chunks past the last crossed log boundary would otherwise
+            # vanish: close the run with a final record so every step's
+            # loss lands in some interval's loss_mean
+            mean = acc.flush()
+            if mean is not None:
+                log.log(done, loss=float(loss), loss_mean=mean)
+        if ck is not None and start < run.steps and last_saved != done:
+            # the final state must land even when it misses the save
+            # cadence — otherwise resume silently replays a partial chunk
+            ck.save(done, state, force=True)
+    return state, loss

@@ -1,0 +1,73 @@
+"""HostPrefetcher contracts (data/prefetch.py): ordering, bounded
+look-ahead, failure propagation, shutdown.
+
+The prefetcher is the overlap half of the chunked-dispatch loop — the
+sampled trainer's batch stream runs on it, so these semantics are
+load-bearing for training correctness, not just throughput."""
+
+import threading
+import time
+
+import pytest
+
+from hyperspace_tpu.data.prefetch import HostPrefetcher
+
+
+def test_yields_in_order_exactly_once():
+    with HostPrefetcher(lambda i: i * 10) as p:
+        assert [p.next() for _ in range(5)] == [0, 10, 20, 30, 40]
+
+
+def test_start_offset_resumes_sequence():
+    # the stream-resume contract: start=k yields fn(k), fn(k+1), ...
+    with HostPrefetcher(lambda i: i, start=3) as p:
+        assert [p.next() for _ in range(3)] == [3, 4, 5]
+
+
+def test_lookahead_is_bounded():
+    calls = []
+    ev = threading.Event()
+
+    def fn(i):
+        calls.append(i)
+        ev.set()
+        return i
+
+    with HostPrefetcher(fn, depth=2):
+        ev.wait(timeout=5.0)
+        deadline = time.monotonic() + 2.0
+        # worker may hold one in-flight item beyond the 2 queued slots,
+        # but must never run ahead unboundedly while nothing consumes
+        while time.monotonic() < deadline and len(calls) < 3:
+            time.sleep(0.01)
+        time.sleep(0.1)
+        assert len(calls) <= 3
+
+
+def test_worker_error_reraises_with_cause():
+    def fn(i):
+        if i == 2:
+            raise ValueError("chunk 2 broke")
+        return i
+
+    with HostPrefetcher(fn) as p:
+        assert p.next() == 0
+        assert p.next() == 1
+        with pytest.raises(RuntimeError) as ei:
+            p.next()
+        assert isinstance(ei.value.__cause__, ValueError)
+        assert "chunk 2 broke" in str(ei.value.__cause__)
+
+
+def test_close_joins_worker_even_when_blocked_on_put():
+    with HostPrefetcher(lambda i: i, depth=1) as p:
+        p.next()  # worker now blocked producing/putting ahead
+    assert not p._thread.is_alive()
+
+
+def test_close_is_idempotent():
+    p = HostPrefetcher(lambda i: i)
+    p.next()
+    p.close()
+    p.close()
+    assert not p._thread.is_alive()

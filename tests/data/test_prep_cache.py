@@ -1,0 +1,144 @@
+"""Persistent graph-prep cache (data/prep_cache.py) + its graphs.py
+integration: hit/miss accounting, invalidation on config change, and —
+the load-bearing contract — bit-identical artifacts on a hit."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.data import graphs as G
+from hyperspace_tpu.data.prep_cache import PrepCache, key_hash
+
+
+@pytest.fixture()
+def cache(tmp_path):
+    return PrepCache(root=str(tmp_path / "prep"))
+
+
+def _edges(seed=0, n=200, e=600):
+    rng = np.random.default_rng(seed)
+    edges = rng.integers(0, n, (e, 2))
+    return edges[edges[:, 0] != edges[:, 1]]
+
+
+def test_get_or_build_counts_and_builds_once(cache):
+    calls = []
+
+    def build():
+        calls.append(1)
+        return {"a": np.arange(5)}
+
+    first = cache.get_or_build("k", (1, "x"), build)
+    second = cache.get_or_build("k", (1, "x"), build)
+    assert len(calls) == 1
+    assert cache.misses == 1 and cache.hits == 1
+    np.testing.assert_array_equal(first["a"], second["a"])
+
+
+def test_key_changes_invalidate(cache):
+    cache.get_or_build("k", (1,), lambda: 1)
+    cache.get_or_build("k", (2,), lambda: 2)     # knob changed → miss
+    cache.get_or_build("other", (1,), lambda: 3)  # kind changed → miss
+    assert cache.misses == 3 and cache.hits == 0
+    # type-tagged hashing: the int 1 and the string "1" must not collide
+    assert key_hash("k", (1,)) != key_hash("k", ("1",))
+
+
+def test_corrupt_entry_rebuilds(cache):
+    cache.get_or_build("k", (1,), lambda: np.arange(3))
+    path = cache._path("k", key_hash("k", (1,)))
+    with open(path, "wb") as f:
+        f.write(b"not a pickle")
+    out = cache.get_or_build("k", (1,), lambda: np.arange(3))
+    np.testing.assert_array_equal(out, np.arange(3))
+    assert cache.misses == 2  # the corrupt read counted as a miss
+
+
+def test_prepare_hit_returns_identical_layout(cache):
+    edges = _edges()
+    g1 = G.prepare(edges, 200, np.ones((200, 4), np.float32),
+                   pad_multiple=128, cache=cache)
+    g2 = G.prepare(edges, 200, np.ones((200, 4), np.float32),
+                   pad_multiple=128, cache=cache)
+    assert cache.misses == 1 and cache.hits == 1
+    for field in ("senders", "receivers", "edge_mask", "rev_perm", "deg"):
+        np.testing.assert_array_equal(getattr(g1, field), getattr(g2, field))
+    for a, b in zip(g1.csr_plan, g2.csr_plan):
+        np.testing.assert_array_equal(a, b)
+    # and the cached layout equals the uncached build exactly
+    g3 = G.prepare(edges, 200, np.ones((200, 4), np.float32),
+                   pad_multiple=128, cache=False)
+    np.testing.assert_array_equal(g2.senders, g3.senders)
+    np.testing.assert_array_equal(g2.receivers, g3.receivers)
+
+
+def test_prepare_knob_change_misses(cache):
+    edges = _edges()
+    x = np.ones((200, 4), np.float32)
+    G.prepare(edges, 200, x, pad_multiple=128, cache=cache)
+    G.prepare(edges, 200, x, pad_multiple=256, cache=cache)
+    G.prepare(edges, 200, x, pad_multiple=128, cluster_min_pair=8,
+              cache=cache)
+    assert cache.hits == 0 and cache.misses == 3
+
+
+def test_prepare_cluster_split_round_trips(cache):
+    # force the cluster split so the pickled payload carries the full
+    # ClusterSplit/ClusterPlan structure
+    edges = _edges(e=2000)
+    x = np.ones((200, 4), np.float32)
+    g1 = G.prepare(edges, 200, x, pad_multiple=128, cluster=True,
+                   cluster_min_pair=2, cache=cache)
+    g2 = G.prepare(edges, 200, x, pad_multiple=128, cluster=True,
+                   cluster_min_pair=2, cache=cache)
+    assert cache.hits == 1
+    assert g1.cluster_split is not None and g2.cluster_split is not None
+    assert g1.cluster_split.frac_clustered == g2.cluster_split.frac_clustered
+    np.testing.assert_array_equal(g1.cluster_split.c_recv,
+                                  g2.cluster_split.c_recv)
+    np.testing.assert_array_equal(g1.cluster_split.s_rev_local,
+                                  g2.cluster_split.s_rev_local)
+
+
+def test_split_edges_hit_identical_split_tensors(cache):
+    edges = _edges(e=800)
+    x = np.ones((200, 4), np.float32)
+    s1 = G.split_edges(edges, 200, x, seed=3, pad_multiple=128, cache=cache)
+    hits_before = cache.hits
+    s2 = G.split_edges(edges, 200, x, seed=3, pad_multiple=128, cache=cache)
+    # both the lp-split entry and the edge-layout entry hit
+    assert cache.hits >= hits_before + 2
+    for f in ("train_pos", "val_pos", "val_neg", "test_pos", "test_neg"):
+        np.testing.assert_array_equal(getattr(s1, f), getattr(s2, f))
+    np.testing.assert_array_equal(s1.graph.senders, s2.graph.senders)
+    # a different seed is a different split → miss
+    misses_before = cache.misses
+    G.split_edges(edges, 200, x, seed=4, pad_multiple=128, cache=cache)
+    assert cache.misses > misses_before
+
+
+def test_apply_locality_order_cached_identical(cache):
+    edges = _edges(e=800)
+    x = np.random.default_rng(0).normal(size=(200, 4)).astype(np.float32)
+    e1, x1, _, o1 = G.apply_locality_order(edges, x, method="bfs",
+                                           cache=cache)
+    e2, x2, _, o2 = G.apply_locality_order(edges, x, method="bfs",
+                                           cache=cache)
+    assert cache.hits == 1
+    np.testing.assert_array_equal(o1, o2)
+    np.testing.assert_array_equal(e1, e2)
+    np.testing.assert_array_equal(x1, x2)
+    # method participates in the key
+    G.apply_locality_order(edges, x, method="community", cache=cache)
+    assert cache.misses == 2
+
+
+def test_auto_gate_skips_cache_for_small_graphs(tmp_path, monkeypatch):
+    # unit-test-sized graphs must never touch the disk under "auto"
+    monkeypatch.setenv("HYPERSPACE_CACHE_DIR", str(tmp_path / "auto"))
+    import hyperspace_tpu.data.prep_cache as pc
+
+    monkeypatch.setattr(pc, "_default", None)
+    G.prepare(_edges(), 200, np.ones((200, 4), np.float32),
+              pad_multiple=128, cache="auto")
+    assert not (tmp_path / "auto").exists()
+    assert pc.stats() == {"hits": 0, "misses": 0}

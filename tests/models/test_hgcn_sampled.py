@@ -345,12 +345,18 @@ def test_resume_chunk_derivation(tmp_path):
     from hyperspace_tpu.cli.train import RunConfig, _resume_chunk
     from hyperspace_tpu.train.checkpoint import peek_latest_step
 
+    def commit(p):  # a committed step dir is non-empty (orbax layout)
+        p.mkdir(parents=True)
+        (p / "_CHECKPOINT_METADATA").write_text("{}")
+
     d = tmp_path / "ck"
     assert peek_latest_step(str(d)) == 0           # nothing there yet
-    (d / "64").mkdir(parents=True)
-    (d / "128").mkdir()
+    commit(d / "64")
+    commit(d / "128")
     (d / "128.orbax-checkpoint-tmp-x").mkdir()     # in-flight: ignored
     assert peek_latest_step(str(d)) == 128
+    (d / "192").mkdir()    # interrupted save: empty dir = uncommitted,
+    assert peek_latest_step(str(d)) == 128  # fall back to the committed one
     run = RunConfig(steps=256, ckpt_dir=str(d), resume=True)
     assert _resume_chunk(run, 64) == 2      # exact boundary: continue
     assert _resume_chunk(run, 100) == 2     # mid-chunk: skip the partial

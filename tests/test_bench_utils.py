@@ -30,6 +30,11 @@ def test_step_cost_reports_flops_and_bounds():
     np.testing.assert_allclose(
         c["hbm_bound_ms"],
         round(c["bytes_per_step"] / V5E_HBM_BYTES_PER_S * 1e3, 6))
+    # the assumed-chip peaks vs the chip that actually ran must both be
+    # in the artifact (ADVICE r5): CPU numbers read as "fraction of a
+    # v5e", never as on-chip truth
+    assert c["roofline_chip"] == "v5e"
+    assert c["device_kind"]  # e.g. "cpu" here, "TPU v5e" on chip
 
 
 def test_roofline_fields_fraction_and_bound():

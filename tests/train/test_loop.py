@@ -1,0 +1,204 @@
+"""Chunked-dispatch training loop (train/loop.py).
+
+The two contracts that make scan_chunk shippable: (1) chunked dispatch
+is the SAME trajectory as single-step dispatch — bitwise, not approx —
+and (2) checkpoint/resume accounting stays truthful when steps arrive K
+at a time (restore mid-run, chunk-boundary saves, ceil-based stream
+chunk resume)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from hyperspace_tpu.cli.train import RunConfig, _stream_stepper
+from hyperspace_tpu.data.wordnet import synthetic_tree
+from hyperspace_tpu.models import poincare_embed as pe
+from hyperspace_tpu.train import loop
+
+_DS = synthetic_tree(depth=3, branching=3)
+
+
+def _cfg(**kw):
+    kw.setdefault("num_nodes", _DS.num_nodes)
+    kw.setdefault("dim", 4)
+    kw.setdefault("batch_size", 16)
+    kw.setdefault("neg_samples", 4)
+    return pe.PoincareEmbedConfig(**kw)
+
+
+def _base_stepper(cfg, opt, pairs):
+    step_fn = pe.make_train_step(cfg)
+    return lambda st: step_fn(cfg, opt, st, pairs)
+
+
+def test_chunked_stepper_matches_stepwise():
+    cfg = _cfg()
+    pairs = jnp.asarray(_DS.pairs)
+    s1, opt = pe.init_state(cfg, 1)
+    s2, _ = pe.init_state(cfg, 1)
+    base = _base_stepper(cfg, opt, pairs)
+    for _ in range(8):
+        s1, _ = base(s1)
+    chunk = loop.make_chunked_stepper(base, 8)
+    s2, losses = chunk(s2)
+    np.testing.assert_array_equal(np.asarray(s1.table), np.asarray(s2.table))
+    assert losses.shape == (8,)
+    assert int(s2.step) == 8
+
+
+def test_chunked_stepper_k1_is_identity():
+    base = _base_stepper(_cfg(), None, None)
+    assert loop.make_chunked_stepper(base, 1) is base
+
+
+def test_chunked_stepper_stacks_multi_output():
+    cfg = _cfg()
+    pairs = jnp.asarray(_DS.pairs)
+    state, opt = pe.init_state(cfg, 2)
+    base = _base_stepper(cfg, opt, pairs)
+
+    def multi(st):  # hvae-shaped stepper: (state, loss, aux, aux)
+        st, loss = base(st)
+        return st, loss, loss * 2.0, loss + 1.0
+
+    st, (loss, twice, plus) = loop.make_chunked_stepper(multi, 4)(state)
+    assert loss.shape == twice.shape == plus.shape == (4,)
+    np.testing.assert_allclose(np.asarray(twice), 2 * np.asarray(loss))
+
+
+def test_run_loop_chunked_equals_single_step():
+    cfg = _cfg()
+    pairs = jnp.asarray(_DS.pairs)
+    run = RunConfig(steps=12, eval_every=0)
+    s1, opt = pe.init_state(cfg, 3)
+    s2, _ = pe.init_state(cfg, 3)
+    base = _base_stepper(cfg, opt, pairs)
+    s1, l1 = loop.run_loop(run, s1, base)
+    s2, l2 = loop.run_loop(run, s2, loop.make_chunked_stepper(base, 4),
+                           steps_per_call=4)
+    np.testing.assert_array_equal(np.asarray(s1.table), np.asarray(s2.table))
+    assert float(l1) == float(l2)
+    assert int(s1.step) == int(s2.step) == 12
+
+
+def test_run_loop_resume_mid_run_chunked(tmp_path):
+    """Interrupted-then-resumed chunked run == uninterrupted chunked run
+    (checkpoints land on chunk boundaries; state carries the PRNG key)."""
+    cfg = _cfg()
+    pairs = jnp.asarray(_DS.pairs)
+    base = None
+
+    def fresh(seed=5):
+        nonlocal base
+        st, opt = pe.init_state(cfg, seed)
+        base = _base_stepper(cfg, opt, pairs)
+        return st
+
+    full = loop.run_loop(RunConfig(steps=16), fresh(),
+                         loop.make_chunked_stepper(base, 4),
+                         steps_per_call=4)[0]
+
+    d = str(tmp_path / "ck")
+    loop.run_loop(RunConfig(steps=8, ckpt_dir=d, ckpt_every=4), fresh(),
+                  loop.make_chunked_stepper(base, 4), steps_per_call=4)
+    resumed = loop.run_loop(
+        RunConfig(steps=16, ckpt_dir=d, ckpt_every=4, resume=True), fresh(),
+        loop.make_chunked_stepper(base, 4), steps_per_call=4)[0]
+    np.testing.assert_array_equal(np.asarray(full.table),
+                                  np.asarray(resumed.table))
+    assert int(resumed.step) == 16
+
+
+def test_run_loop_restore_mid_chunk_boundary(tmp_path):
+    """A checkpoint written at a NON-multiple of the new chunk size (a
+    K=1 run resumed with K=4): the loop steps chunkwise from the restored
+    step — same trajectory as stepping the plain loop to the same total,
+    with the step budget legitimately overshot to the next boundary."""
+    cfg = _cfg()
+    pairs = jnp.asarray(_DS.pairs)
+
+    def fresh(seed=7):
+        st, opt = pe.init_state(cfg, seed)
+        return st, _base_stepper(cfg, opt, pairs)
+
+    st, base = fresh()
+    ref, _ = fresh()
+    for _ in range(14):  # 6 + two chunks of 4
+        ref, _ = base(ref)
+
+    d = str(tmp_path / "ck")
+    st, _ = loop.run_loop(RunConfig(steps=6, ckpt_dir=d, ckpt_every=2), st,
+                          base)
+    st2, _ = fresh()
+    resumed, _ = loop.run_loop(
+        RunConfig(steps=12, ckpt_dir=d, ckpt_every=2, resume=True), st2,
+        loop.make_chunked_stepper(base, 4), steps_per_call=4)
+    assert int(resumed.step) == 14  # 6 restored + 2 full chunks
+    np.testing.assert_array_equal(np.asarray(ref.table),
+                                  np.asarray(resumed.table))
+
+
+def test_stream_stepper_pulls_on_device_step_boundaries():
+    class FakeStream:
+        chunk_steps = 4
+
+        def __init__(self):
+            self.pulls = 0
+
+        def next(self):
+            self.pulls += 1
+            return self.pulls
+
+    stream = FakeStream()
+    seen = []
+    stepper = _stream_stepper(stream,
+                              lambda st, b: (seen.append(b) or (st, 0.0)),
+                              steps_per_call=2)
+    st = 0
+    for _ in range(4):  # 8 device steps = 2 stream chunks
+        st, _ = stepper(st)
+    assert stream.pulls == 2
+    assert seen == [1, 1, 2, 2]
+
+
+def test_chunk_metrics_accumulates_across_chunks():
+    from hyperspace_tpu.optim.metrics import ChunkMetrics
+
+    acc = ChunkMetrics()
+    assert acc.flush() is None
+    acc.add(jnp.asarray([1.0, 2.0, 3.0]))
+    acc.add(jnp.asarray(6.0))  # scalar (K=1 shape) mixes in fine
+    assert acc.flush() == 3.0
+    assert acc.flush() is None  # flush drains
+
+
+def test_run_loop_logs_chunk_mean(tmp_path):
+    from hyperspace_tpu.train.logging import read_jsonl
+
+    cfg = _cfg()
+    pairs = jnp.asarray(_DS.pairs)
+    state, opt = pe.init_state(cfg, 9)
+    base = _base_stepper(cfg, opt, pairs)
+    log = str(tmp_path / "m.jsonl")
+    loop.run_loop(RunConfig(steps=8, eval_every=4, log=log), state,
+                  loop.make_chunked_stepper(base, 4), steps_per_call=4)
+    recs = read_jsonl(log)
+    assert [r["step"] for r in recs] == [4, 8]
+    for r in recs:
+        assert np.isfinite(r["loss"]) and np.isfinite(r["loss_mean"])
+
+
+def test_round_steps_to_chunk():
+    assert loop.round_steps_to_chunk(20, 8) == 24
+    assert loop.round_steps_to_chunk(24, 8) == 24
+    assert loop.round_steps_to_chunk(5, 1) == 5
+
+
+def test_resume_chunk_is_ceil(tmp_path):
+    d = tmp_path / "ck"
+    step_dir = d / "100"
+    step_dir.mkdir(parents=True)
+    (step_dir / "_CHECKPOINT_METADATA").write_text("{}")
+    assert loop.resume_chunk(str(d), True, 64) == 2   # ceil(100/64)
+    assert loop.resume_chunk(str(d), True, 100) == 1  # exact boundary
+    assert loop.resume_chunk(str(d), False, 64) == 0
+    assert loop.resume_chunk(None, True, 64) == 0

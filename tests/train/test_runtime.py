@@ -48,6 +48,24 @@ def test_checkpoint_restore_reprojects(tmp_path):
     np.testing.assert_allclose(np.asarray(restored["dense"]), 1.0)
 
 
+def test_restore_skips_uncommitted_step_dir(tmp_path):
+    """An interrupted save's leftover (empty) step dir must not become
+    the restore target: restore(step=None) and peek_latest_step must
+    agree on the newest COMMITTED step, or the stream resume offset
+    desyncs from the restored state (ADVICE r5)."""
+    from hyperspace_tpu.train.checkpoint import peek_latest_step
+
+    d = tmp_path / "c4"
+    with CheckpointManager(str(d), async_save=False) as mgr:
+        mgr.save(5, {"x": jnp.asarray(5)})
+        mgr.wait()
+        (d / "9").mkdir()  # interrupted save: all-digit but uncommitted
+        assert mgr.latest_committed_step() == 5
+        restored, step = mgr.restore({"x": jnp.asarray(0)})
+    assert step == 5 and int(restored["x"]) == 5
+    assert peek_latest_step(str(d)) == 5  # the two accountings agree
+
+
 def test_checkpoint_interval_and_retention(tmp_path):
     with CheckpointManager(str(tmp_path / "c3"), async_save=False,
                            max_to_keep=2, save_interval_steps=5) as mgr:
