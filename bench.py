@@ -512,6 +512,18 @@ def main() -> None:
             leg("workloads", 90, workloads_leg)
             leg("use_att_arm", 0 if args.use_att else 120, use_att_leg)
 
+        try:
+            # the run's telemetry counters (prep-cache, prefetch,
+            # recompiles — docs/observability.md) ride in the artifact:
+            # cross-round counter drift is a regression signal the
+            # timing numbers alone can't show
+            from hyperspace_tpu.telemetry import registry as _telem
+
+            snap = _telem.snapshot()
+            if snap:
+                result["detail"]["telemetry"] = snap
+        except Exception:  # noqa: BLE001 — diagnostics never sink the bench
+            pass
         result["detail"]["budget_s"] = args.budget_s
         result["detail"]["elapsed_s"] = round(guard.elapsed(), 1)
         if skipped:

@@ -78,10 +78,10 @@ def step_cost(stepper, state) -> dict:
     "fraction of a v5e" instead of silently passing for on-chip truth."""
     import jax
 
+    from hyperspace_tpu.train.profiling import compiled_cost
+
     try:
-        c = jax.jit(stepper).lower(state).compile().cost_analysis()
-        if isinstance(c, (list, tuple)):  # older jax: one dict per program
-            c = c[0]
+        c = compiled_cost(stepper, state)  # ONE home of the list-shape fix
         flops = float(c["flops"])
         byts = float(c["bytes accessed"])
         return {

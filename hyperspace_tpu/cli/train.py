@@ -57,6 +57,23 @@ class RunConfig:
     # >1: accumulate this many microbatch gradients per optimizer update
     # (hybonet/hvae; optax.MultiSteps — `steps` counts microsteps)
     accum: int = 1
+    # --- telemetry (docs/observability.md) -----------------------------
+    # telemetry=1: run manifest as the FIRST JSONL record, span/* host
+    # timings + ctr/* counter snapshots in every log record, and a final
+    # telemetry_summary record.  Off (default) adds no per-step host
+    # sync and no extra dispatches.
+    telemetry: bool = False
+    # write a Chrome/Perfetto trace_events JSON of the host spans here
+    # (implies span recording even without telemetry=1)
+    trace_out: str | None = None
+    # >0: sample the on-device numerical-health stats every N chunks
+    # (telemetry/health.py): ball boundary margin, hyperboloid
+    # constraint residual, nonfinite counts — logged as health/* records
+    # and threshold-checked (warn; health_abort=1 raises instead)
+    health_every: int = 0
+    health_eps: float = 1e-2  # warn when boundary margin drops below
+    health_tol: float = 1e-3  # warn when constraint violation exceeds
+    health_abort: bool = False
     coordinator: str = "127.0.0.1:9357"
     num_processes: int = 1
     process_id: int = 0
@@ -191,9 +208,12 @@ def run_poincare(run: RunConfig, overrides: dict):
         run = _chunk_run(run)
     step_fn = pe.make_train_step(cfg)
     stepper, spc = _chunked(run, lambda st: step_fn(cfg, opt, st, pairs))
+    health_fn = _maybe_health(run, lambda: _make_health(
+        ball, params_of=lambda st: st.table))
     state, _ = _train_loop(run, state, stepper, project=project,
-                           steps_per_call=spc)
-    res = pe.evaluate(state.table, ds.pairs, cfg.c)
+                           steps_per_call=spc, health_fn=health_fn)
+    with _eval_span():
+        res = pe.evaluate(state.table, ds.pairs, cfg.c)
     # state.step is the authoritative count (survives resume/chunk
     # rounding — a resumed chunked run can legitimately exceed run.steps)
     return {"workload": "poincare", "steps": int(state.step), **res}
@@ -341,11 +361,13 @@ def run_hgcn(run: RunConfig, overrides: dict):
                         model_s, opt, st, xt, stream.deg, b))
                 stepper = _stream_stepper(stream, chunk_fn,
                                           steps_per_call=spc)
-                state, loss = _train_loop(run, state, stepper,
-                                          steps_per_call=spc)
+                state, loss = _train_loop(
+                    run, state, stepper, steps_per_call=spc,
+                    health_fn=_maybe_health(run, _make_health))
             full = hgcn.HGCNLinkPred(cfg)
-            res = {"loss": float(loss),
-                   **hgcn.evaluate_lp(full, state.params, split, "test")}
+            with _eval_span():
+                res = {"loss": float(loss), **hgcn.evaluate_lp(
+                    full, state.params, split, "test")}
             return {"workload": "hgcn", "task": "lp", "dataset": dataset,
                     "source": source, "sampled": True, **res}
         model, opt, state = hgcn.init_lp(cfg, split.graph, seed=run.seed)
@@ -364,9 +386,11 @@ def run_hgcn(run: RunConfig, overrides: dict):
             stepper, spc = _chunked(
                 run, lambda st: hgcn.train_step_lp(model, opt, num_nodes,
                                                    st, ga, train_pos))
-        state, loss = _train_loop(run, state, stepper, steps_per_call=spc)
-        res = {"loss": float(loss),
-               **hgcn.evaluate_lp(model, state.params, split, "test", ga=ga)}
+        state, loss = _train_loop(run, state, stepper, steps_per_call=spc,
+                                  health_fn=_maybe_health(run, _make_health))
+        with _eval_span():
+            res = {"loss": float(loss), **hgcn.evaluate_lp(
+                model, state.params, split, "test", ga=ga)}
     else:
         tr, va, te = G.node_split_masks(num_nodes, seed=run.seed)
         g = G.prepare(edges, num_nodes, x, labels=labels, num_classes=ncls,
@@ -400,11 +424,13 @@ def run_hgcn(run: RunConfig, overrides: dict):
                         model_s, opt, st, xt, stream.deg, b))
                 stepper = _stream_stepper(stream, chunk_fn,
                                           steps_per_call=spc)
-                state, loss = _train_loop(run, state, stepper,
-                                          steps_per_call=spc)
+                state, loss = _train_loop(
+                    run, state, stepper, steps_per_call=spc,
+                    health_fn=_maybe_health(run, _make_health))
             full = hgcn.HGCNNodeClf(cfg)
-            res = {"loss": float(loss),
-                   **hgcn.evaluate_nc(full, state.params, g)}
+            with _eval_span():
+                res = {"loss": float(loss),
+                       **hgcn.evaluate_nc(full, state.params, g)}
             return {"workload": "hgcn", "task": "nc", "dataset": dataset,
                     "source": source, "sampled": True, **res}
         model, opt, state = hgcn.init_nc(cfg, g, seed=run.seed)
@@ -420,9 +446,11 @@ def run_hgcn(run: RunConfig, overrides: dict):
             stepper, spc = _chunked(
                 run, lambda st: hgcn.train_step_nc(model, opt, st, ga, lab,
                                                    mask))
-        state, loss = _train_loop(run, state, stepper, steps_per_call=spc)
-        res = {"loss": float(loss),
-               **hgcn.evaluate_nc(model, state.params, g, ga=ga)}
+        state, loss = _train_loop(run, state, stepper, steps_per_call=spc,
+                                  health_fn=_maybe_health(run, _make_health))
+        with _eval_span():
+            res = {"loss": float(loss),
+                   **hgcn.evaluate_nc(model, state.params, g, ga=ga)}
     return {"workload": "hgcn", "task": task, "dataset": dataset,
             "source": source, **res}
 
@@ -456,8 +484,10 @@ def run_hybonet(run: RunConfig, overrides: dict):
         base = lambda st: hybonet.train_step_sampled(model, opt, st, toks,
                                                      mask, labels)
     stepper, spc = _chunked(run, base)
-    state, loss = _train_loop(run, state, stepper, steps_per_call=spc)
-    res = hybonet.evaluate(model, state.params, te)
+    state, loss = _train_loop(run, state, stepper, steps_per_call=spc,
+                              health_fn=_maybe_health(run, _make_health))
+    with _eval_span():
+        res = hybonet.evaluate(model, state.params, te)
     return {"workload": "hybonet", "source": source, "loss": float(loss), **res}
 
 
@@ -494,11 +524,14 @@ def run_hvae(run: RunConfig, overrides: dict):
         metrics["rk"] = (recon, kl)  # device arrays; fetched once at the end
         return st, loss
 
-    state, loss = _train_loop(run, state, stepper, steps_per_call=spc)
+    state, loss = _train_loop(run, state, stepper, steps_per_call=spc,
+                              health_fn=_maybe_health(run, _make_health))
     recon, kl = (float(v) for v in metrics.get("rk", (jnp.nan,) * 2))
     loss = float(loss)
     x = jnp.asarray(ds.images[:256], cfg.dtype)
-    iwae = float(hvae.iwae_bound(model, state.params, x, jax.random.PRNGKey(1), k=16))
+    with _eval_span():
+        iwae = float(hvae.iwae_bound(model, state.params, x,
+                                     jax.random.PRNGKey(1), k=16))
     return {"workload": "hvae", "source": source, "loss": loss, "recon": recon,
             "kl": kl, "iwae": iwae}
 
@@ -531,9 +564,22 @@ def run_product(run: RunConfig, overrides: dict):
         return st._replace(params=st.params._replace(
             table=m.proj(st.params.table)))
 
+    def product_health():
+        # the product manifold is rebuilt from the LEARNED curvatures
+        # each check, so health reflects the geometry as trained
+        from hyperspace_tpu.telemetry.health import health_stats
+
+        def fn(st):
+            m = pme.build_manifold(cfg, st.params.c_raw)
+            return health_stats(st.params.table, m)
+
+        return jax.jit(fn)
+
     state, _ = _train_loop(run, state, stepper, project=project,
-                           steps_per_call=spc)
-    res = pme.evaluate(cfg, state.params, ds.pairs)
+                           steps_per_call=spc,
+                           health_fn=_maybe_health(run, product_health))
+    with _eval_span():
+        res = pme.evaluate(cfg, state.params, ds.pairs)
     return {"workload": "product", **res,
             "curvatures": pme.curvatures(cfg, state.params)}
 
@@ -551,16 +597,36 @@ WORKLOADS = {
 
 
 def _train_loop(run: RunConfig, state, stepper, project=None,
-                steps_per_call=1):
+                steps_per_call=1, health_fn=None):
     """The ONE step loop every workload runner goes through — moved to
     :func:`hyperspace_tpu.train.loop.run_loop` (checkpoint/resume, JSONL
-    logging with boundary-crossing cadence, per-chunk loss accumulation);
-    this thin wrapper keeps the import lazy so ``--help`` never pays for
-    orbax."""
+    logging with boundary-crossing cadence, per-chunk loss accumulation,
+    telemetry spine); this thin wrapper keeps the import lazy so
+    ``--help`` never pays for orbax."""
     from hyperspace_tpu.train.loop import run_loop
 
     return run_loop(run, state, stepper, project=project,
-                    steps_per_call=steps_per_call)
+                    steps_per_call=steps_per_call, health_fn=health_fn)
+
+
+def _maybe_health(run: RunConfig, build):
+    """``build() -> jitted health fn`` only when sampling is on — the
+    health program never compiles for runs that will not use it."""
+    return build() if run.health_every > 0 else None
+
+
+def _make_health(tags=None, params_of=None):
+    from hyperspace_tpu.telemetry.health import make_health_fn
+
+    return make_health_fn(tags, params_of=params_of)
+
+
+def _eval_span():
+    """Trace span around a runner's final evaluation (host timeline
+    completeness: eval time is part of the run artifact)."""
+    from hyperspace_tpu.telemetry.trace import span
+
+    return span("eval")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -590,7 +656,37 @@ def main(argv: list[str] | None = None) -> int:
             coordinator_address=run.coordinator,
             num_processes=run.num_processes,
             process_id=run.process_id)
-    result = WORKLOADS[args.workload](run, wl_overrides)
+    if run.telemetry or run.trace_out:
+        # enable BEFORE the workload runs (not inside run_loop) so host
+        # graph prep / cache misses land in the spans and trace too
+        from hyperspace_tpu.telemetry import registry as telem
+        from hyperspace_tpu.telemetry import trace
+
+        trace.enable(keep_events=bool(run.trace_out))
+        telem.install_jax_monitoring_hook()
+    try:
+        result = WORKLOADS[args.workload](run, wl_overrides)
+    finally:
+        # dump in finally: the trace exists to diagnose where a run went
+        # bad, so a crash (incl. health_abort) must still produce it —
+        # and it then covers everything up to the failure point.  Load
+        # the JSON at https://ui.perfetto.dev (host-level spans; the
+        # XLA-level complement is train/profiling.trace).
+        if run.trace_out:
+            from hyperspace_tpu.telemetry.trace import default_tracer
+
+            try:
+                n = default_tracer().dump_chrome_trace(run.trace_out)
+                print(f"[telemetry] {n} trace events -> {run.trace_out}",
+                      flush=True)
+            except OSError as e:
+                # diagnostics never sink the run — and never mask the
+                # training exception this finally may be unwinding
+                print(f"[telemetry] trace dump failed: {e!r}", flush=True)
+        if run.telemetry or run.trace_out:
+            from hyperspace_tpu.telemetry import trace
+
+            trace.disable()
     print(json.dumps(_json_safe(result)))
     return 0
 

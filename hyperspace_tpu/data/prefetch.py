@@ -24,13 +24,27 @@ Semantics (all load-bearing, mirrored from the stream it replaces):
   ``next()`` block forever instead.
 - **Shutdown**: ``close()`` (or the context manager) stops the worker,
   drains the queue to unblock a put, and joins the thread.
+
+Telemetry (docs/observability.md): every produced item bumps
+``prefetch/produced``, every consumed one ``prefetch/consumed``; a
+``next()`` that finds the queue EMPTY — the device out-running the host,
+i.e. the overlap failing to hide batch assembly — counts a
+``prefetch/stalls`` and accumulates the blocked time into
+``prefetch/stall_s`` (also visible as a ``prefetch_wait`` trace span);
+the post-get queue depth lands in the ``prefetch/queue_depth`` gauge.
+All host-side dict ops on the registry — nothing here touches the
+device or adds a sync.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable
+
+from hyperspace_tpu.telemetry import registry as _telem
+from hyperspace_tpu.telemetry.trace import span as _span
 
 
 class HostPrefetcher:
@@ -56,6 +70,7 @@ class HostPrefetcher:
             while not self._stop.is_set():
                 try:
                     self._q.put(item, timeout=0.2)
+                    _telem.inc("prefetch/produced")
                     break
                 except queue.Full:
                     continue
@@ -65,7 +80,18 @@ class HostPrefetcher:
 
     def next(self) -> Any:
         """Block until the next item is ready (re-raising worker errors)."""
-        item = self._q.get()
+        if self._q.empty():
+            # the device out-ran the host: the wait below is a pipeline
+            # stall, not overlap — count it and time it
+            _telem.inc("prefetch/stalls")
+            t0 = time.perf_counter()
+            with _span("prefetch_wait"):
+                item = self._q.get()
+            _telem.inc("prefetch/stall_s", time.perf_counter() - t0)
+        else:
+            item = self._q.get()
+        _telem.inc("prefetch/consumed")
+        _telem.set_gauge("prefetch/queue_depth", self._q.qsize())
         if isinstance(item, BaseException):
             raise RuntimeError(
                 f"{type(self).__name__} worker failed") from item

@@ -28,8 +28,13 @@ Knobs:
 Call sites (``data/graphs.py``) default to ``cache="auto"``: caching
 engages only at scales where the prep is measurably expensive (the same
 ~200 k-edge gate as the cluster split), so unit-test-sized graphs never
-touch the disk.  Each hit/miss prints one ``[graph-prep-cache]`` line —
-the observable the "second run skips rebuild" contract is tested on.
+touch the disk.  Each hit/miss bumps the telemetry registry
+(``prep_cache/hit`` / ``prep_cache/miss`` — docs/observability.md), so
+the "second run skips rebuild" contract is visible in every JSONL log
+record and bench artifact instead of as a scattered stdout line; each
+lookup/build/store transaction runs under one ``prep`` trace span, so
+cache effectiveness (and a slow cache) shows up as host-timeline time
+in ``trace_out=`` dumps.
 """
 
 from __future__ import annotations
@@ -140,34 +145,39 @@ class PrepCache:
         plain containers of them).  Any storage failure degrades to
         building without caching — the cache can slow nothing down and
         break nothing."""
-        digest = key_hash(kind, key_parts)
-        path = self._path(kind, digest)
-        if os.path.exists(path):
-            try:
-                with open(path, "rb") as f:
-                    payload = pickle.load(f)
-                self.hits += 1
-                print(f"[graph-prep-cache] hit {kind} {digest[:12]} "
-                      f"({path})", flush=True)
-                return payload
-            except Exception:  # noqa: BLE001 — corrupt entry = miss
+        from hyperspace_tpu.telemetry import registry as telem
+        from hyperspace_tpu.telemetry.trace import span
+
+        # ONE span over the whole lookup/build/store: a slow cache (a
+        # multi-hundred-MB pickle.load off slow disk) must be visible
+        # in the host timeline just like the build it replaces
+        with span("prep"):
+            digest = key_hash(kind, key_parts)
+            path = self._path(kind, digest)
+            if os.path.exists(path):
                 try:
-                    os.remove(path)
-                except OSError:
-                    pass
-        payload = builder()
-        self.misses += 1
-        print(f"[graph-prep-cache] miss {kind} {digest[:12]} (built)",
-              flush=True)
-        try:
-            os.makedirs(self.root, exist_ok=True)
-            tmp = path + f".tmp.{os.getpid()}"
-            with open(tmp, "wb") as f:
-                pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-            os.replace(tmp, path)
-        except OSError:
-            pass  # read-only checkout etc.: serve the built value
-        return payload
+                    with open(path, "rb") as f:
+                        payload = pickle.load(f)
+                    self.hits += 1
+                    telem.inc("prep_cache/hit")
+                    return payload
+                except Exception:  # noqa: BLE001 — corrupt entry = miss
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+            payload = builder()
+            self.misses += 1
+            telem.inc("prep_cache/miss")
+            try:
+                os.makedirs(self.root, exist_ok=True)
+                tmp = path + f".tmp.{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+                os.replace(tmp, path)
+            except OSError:
+                pass  # read-only checkout etc.: serve the built value
+            return payload
 
 
 _default: Optional[PrepCache] = None

@@ -19,6 +19,31 @@ import jax.numpy as jnp
 from hyperspace_tpu.manifolds import smath
 
 
+def reduce_health_stats(groups) -> dict:
+    """Combine same-named health stats from several sources (product
+    factors, tagged param leaves) by the suffix convention the telemetry
+    monitor thresholds on: ``*_min`` → min, ``*_mean`` → mean (of
+    means — unweighted, a deliberate approximation), anything else →
+    max.  The ONE implementation shared by ``Product.health_stats`` and
+    ``telemetry.health.health_stats`` so the reduction rules can never
+    drift apart."""
+    agg: dict = {}
+    for stats in groups:
+        for k, v in stats.items():
+            agg.setdefault(k, []).append(v)
+    out = {}
+    for k, vs in agg.items():
+        if len(vs) == 1:
+            out[k] = vs[0]
+        elif k.endswith("_min"):
+            out[k] = jnp.min(jnp.stack(vs))
+        elif k.endswith("_mean"):
+            out[k] = jnp.mean(jnp.stack(vs))
+        else:
+            out[k] = jnp.max(jnp.stack(vs))
+    return out
+
+
 class Manifold(abc.ABC):
     """Abstract Riemannian manifold.
 
@@ -103,6 +128,18 @@ class Manifold(abc.ABC):
     def check_point(self, x: jax.Array) -> jax.Array:
         """Residual of the manifold constraint (0 for on-manifold points)."""
         return jnp.zeros(x.shape[:-1], x.dtype)
+
+    def health_stats(self, x: jax.Array) -> dict:
+        """Numerical-health scalars for a batch of points (jit-safe).
+
+        The telemetry layer samples these on device
+        (``telemetry/health.py``); geometries with a specific blow-up
+        mode override with their leading indicator (ball: distance to
+        boundary; hyperboloid: constraint residual).  The generic
+        default reports the ``check_point`` residual.
+        """
+        v = self.check_point(x)
+        return {"violation_max": jnp.max(v), "violation_mean": jnp.mean(v)}
 
     # The ambient (storage) dimension for an n-dim manifold; Lorentz uses n+1.
     def ambient_dim(self, dim: int) -> int:

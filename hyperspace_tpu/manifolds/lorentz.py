@@ -69,6 +69,22 @@ class Lorentz(Manifold):
         scale = 1.0 / c + smath.sq_norm(x, keepdims=False)
         return jnp.abs(minkowski_dot(x, x, keepdims=False) + 1.0 / c) / scale
 
+    def health_stats(self, x: jax.Array) -> dict:
+        """Constraint-drift indicators (telemetry/health.py samples these).
+
+        The hyperboloid's blow-up mode is ⟨x,x⟩_L drifting off −1/c
+        under low-precision accumulation, which amplifies gradients
+        through every arcosh/dist (Chami et al. 2019); reports the
+        max/mean RELATIVE residual (``check_point``'s normalization —
+        coordinates grow like e^dist, so the raw residual would scale
+        with ‖x‖²) plus the max time coordinate √c·x₀ = cosh(√c·dist0),
+        the cheap proxy for how far out the sheet the batch reaches.
+        """
+        c = self._c(x.dtype)
+        v = self.check_point(x)
+        return {"violation_max": jnp.max(v), "violation_mean": jnp.mean(v),
+                "time_coord_max": jnp.max(smath.sqrt_c(c) * x[..., 0])}
+
     # --- distance -------------------------------------------------------------
 
     def _neg_cdot(self, x: jax.Array, y: jax.Array) -> jax.Array:

@@ -64,6 +64,24 @@ class PoincareBall(Manifold):
         c = self._c(x.dtype)
         return smath.clamp_min(c * smath.sq_norm(x, keepdims=False) - 1.0, 0.0)
 
+    def health_stats(self, x: jax.Array) -> dict:
+        """Boundary-drift indicators (telemetry/health.py samples these).
+
+        The ball's blow-up mode is points drifting to the boundary,
+        where λ_x and every artanh-amplified gradient diverge (Nickel &
+        Kiela 2017).  Reports the scaled radius r = √c‖x‖ ∈ [0, 1)
+        (max/mean over the batch) and the minimum distance-to-boundary
+        margin 1 − r — ``proj`` clamps f32 points to a margin of
+        ``ball_eps(f32) = 4e-3``, so a point pinned at the clamp reads
+        as margin ≈ 4e-3, well under the monitor's default warn
+        threshold of 1e-2.
+        """
+        c = self._c(x.dtype)
+        r = smath.sqrt_c(c) * smath.safe_norm(x, keepdims=False)
+        r_max = jnp.max(r)
+        return {"norm_max": r_max, "norm_mean": jnp.mean(r),
+                "boundary_margin_min": 1.0 - r_max}
+
     # --- Möbius gyrovector ops (reference native kernels N1/N2) ---------------
 
     def mobius_add(self, x: jax.Array, y: jax.Array) -> jax.Array:

@@ -113,6 +113,27 @@ class Product(Manifold):
     def check_point(self, x):
         return sum(m.check_point(xi) for m, xi in zip(self.factors, self.split(x)))
 
+    def health_stats(self, x) -> dict:
+        """Per-factor health merge (telemetry/health.py samples these).
+
+        Each factor's own ``health_stats`` run on its slice, keys
+        prefixed ``f<i>_<name>/`` so a 2-ball product reports both
+        balls separately, PLUS unprefixed worst-case aggregates
+        (min of margins, max of violations/norms, mean of means) so the
+        monitor's suffix-matched thresholds fire without knowing the
+        factor layout.
+        """
+        from hyperspace_tpu.manifolds.base import reduce_health_stats
+
+        out: dict = {}
+        per_factor = []
+        for i, (m, xi) in enumerate(zip(self.factors, self.split(x))):
+            stats = m.health_stats(xi)
+            per_factor.append(stats)
+            out.update({f"f{i}_{m.name}/{k}": v for k, v in stats.items()})
+        out.update(reduce_health_stats(per_factor))
+        return out
+
     def logdetexp(self, x, y):
         """exp on a product is the product of factor exps, so the Jacobian
         determinant factorizes: Σ factor logdetexp."""

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 
 class ChunkMetrics:
-    """Accumulate chunk loss arrays; ``flush()`` = mean since last flush.
+    """Accumulate chunk loss arrays; ``flush()`` = stats since last flush.
 
     ``add`` takes whatever the stepper returned as its loss — a scalar
     (K=1) or a stacked ``[K]`` device array — and does NOT synchronize;
@@ -27,8 +27,13 @@ class ChunkMetrics:
         self._chunks.append(losses)
 
     def flush(self):
-        """Mean over every step added since the previous flush (one host
-        fetch), or None when nothing was added."""
+        """Reduce every step added since the previous flush with ONE
+        host fetch: ``{"loss_mean", "loss_last", "loss_min",
+        "loss_max"}`` over the interval (the JSONL field names), or
+        None when nothing was added.  mean smooths the noisy per-step
+        loss; last is the value a single-step loop would have logged;
+        min/max bound the interval — a spiking max with a flat mean is
+        the early divergence signature the mean alone hides."""
         if not self._chunks:
             return None
         import numpy as np
@@ -36,4 +41,7 @@ class ChunkMetrics:
         vals = np.concatenate(
             [np.atleast_1d(np.asarray(c)) for c in self._chunks])
         self._chunks.clear()
-        return float(vals.mean())
+        return {"loss_mean": float(vals.mean()),
+                "loss_last": float(vals[-1]),
+                "loss_min": float(vals.min()),
+                "loss_max": float(vals.max())}

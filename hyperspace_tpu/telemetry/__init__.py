@@ -1,0 +1,37 @@
+"""Run-wide telemetry: trace spans, counter registry, numerical health.
+
+The observability layer the chunked-dispatch loop (PR 1) made necessary:
+K steps vanish into one ``lax.scan`` dispatch, prefetch and prep-cache
+activity happens on background threads, and the only run artifact is a
+JSONL of losses.  This package adds, with zero per-step host sync and
+~zero cost when disabled (the default):
+
+- :mod:`trace` — nested host wall-clock spans (``span("dispatch")``)
+  aggregated into ``span/*`` JSONL fields per log boundary, plus a
+  Chrome/Perfetto ``trace_events`` dump (``trace_out=`` on the CLI);
+- :mod:`registry` — process-wide named counters/gauges (prep-cache
+  hit/miss, prefetch stalls/queue depth, dispatches, recompiles via
+  ``jax.monitoring``, checkpoint saves/seconds/bytes), snapshotted as
+  ``ctr/*`` into every log record and a final ``telemetry_summary``;
+- :mod:`health` — on-device hyperbolic numerical-health stats (ball
+  boundary margin, hyperboloid constraint residual, nonfinite counts),
+  sampled every ``health_every=`` chunks and threshold-checked.
+
+Catalog + reading guide: docs/observability.md.
+"""
+
+from hyperspace_tpu.telemetry.health import (  # noqa: F401
+    HealthMonitor,
+    health_stats,
+    make_health_fn,
+)
+from hyperspace_tpu.telemetry.registry import (  # noqa: F401
+    Registry,
+    default_registry,
+    install_jax_monitoring_hook,
+)
+from hyperspace_tpu.telemetry.trace import (  # noqa: F401
+    Tracer,
+    default_tracer,
+    span,
+)

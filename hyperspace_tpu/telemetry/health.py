@@ -1,0 +1,173 @@
+"""Hyperbolic numerical-health monitor: catch divergence BEFORE the NaN.
+
+The failure mode is documented from the start of the literature: Poincaré
+embeddings drift toward the ball boundary where the conformal factor (and
+every gradient through artanh) blows up (Nickel & Kiela 2017), and
+Lorentz-model points drift off the hyperboloid constraint surface under
+f32/bf16 accumulation until ⟨x,x⟩_L residuals amplify gradients (Chami et
+al. 2019, HGCN).  Today either surfaces only as a NaN loss many chunks
+after the root cause.  This module computes the leading indicators ON
+DEVICE — one jitted reduction over the state, no per-step host sync —
+and the loop samples it every ``health_every`` chunks:
+
+- :func:`health_stats`: jit-safe dict of device scalars for a param
+  pytree — per-manifold stats (each manifold's ``health_stats`` method:
+  max/mean √c·norm and min distance-to-boundary on the ball, relative
+  ⟨x,x⟩_L constraint residual on the hyperboloid, per-factor merge on
+  products), a global parameter norm, a global nonfinite count, and a
+  global grad/moment norm when a gradient-like tree is supplied (the
+  raw per-step grads never leave the jitted step, so callers pass what
+  they have — e.g. Adam's first-moment EMA — under an honest name).
+- :class:`HealthMonitor`: the host-side sampler run_loop drives —
+  jits the stats fn once, fetches the dict (the ONE host sync, every
+  N chunks only), threshold-checks it (warn at ``boundary_eps`` margin
+  / ``violation_tol`` residual / any nonfinite), logs a ``health/*``
+  record, and optionally hard-aborts the run.
+
+Threshold defaults: ``proj`` clamps f32 ball points to a margin of
+``smath.ball_eps(f32) = 4e-3``, so a point pinned at the clamp sits WELL
+below the default ``boundary_eps = 1e-2`` — an artificially (or
+organically) boundary-clamped embedding flags immediately, while healthy
+mid-ball training (margins ~1) never does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds.base import Manifold
+
+DEFAULT_BOUNDARY_EPS = 1e-2
+DEFAULT_VIOLATION_TOL = 1e-3
+
+
+def health_stats(params: Any, tags: Any = None, grads: Any = None,
+                 grads_name: str = "grad_norm") -> dict:
+    """Device-side health scalars for a parameter pytree (jit-safe).
+
+    ``tags`` is either a single :class:`Manifold` (``params`` is one
+    point array on it), a tag tree matching ``params`` (Manifold or
+    None per leaf — the optim.tags convention), or None (Euclidean:
+    norms + finiteness only).  Same-named stats from several manifold
+    leaves combine via :func:`manifolds.base.reduce_health_stats` (the
+    one suffix-reduction rule set, shared with products).  ``grads``
+    adds a global-norm field named ``grads_name`` — pass the actual
+    gradient tree where available, or a momentum/EMA tree under a name
+    that says so.
+    """
+    from hyperspace_tpu.manifolds.base import reduce_health_stats
+
+    leaves = [l for l in jax.tree_util.tree_leaves(params)
+              if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)]
+    out: dict = {}
+    nonfinite = sum(
+        (jnp.sum(~jnp.isfinite(l)) for l in leaves), jnp.zeros((), jnp.int32))
+    out["nonfinite"] = nonfinite
+    out["param_norm"] = jnp.sqrt(
+        sum((jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves),
+            jnp.zeros(())))
+    collected: list[dict] = []
+    if isinstance(tags, Manifold):
+        collected.append(tags.health_stats(params))
+    elif tags is not None:
+        from hyperspace_tpu.optim.tags import map_tagged
+
+        map_tagged(
+            lambda t, p: collected.append(t.health_stats(p))
+            if t is not None else None, tags, params)
+    out.update(reduce_health_stats(collected))
+    if grads is not None:
+        gl = [g for g in jax.tree_util.tree_leaves(grads)
+              if jnp.issubdtype(jnp.asarray(g).dtype, jnp.inexact)]
+        out[grads_name] = jnp.sqrt(
+            sum((jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gl),
+                jnp.zeros(())))
+    return out
+
+
+def make_health_fn(tags: Any = None, params_of: Optional[Callable] = None,
+                   grads_of: Optional[Callable] = None,
+                   grads_name: str = "grad_norm") -> Callable:
+    """Build the jitted ``fn(state) -> {name: device scalar}`` run_loop
+    samples.  ``params_of`` extracts the parameter tree from the train
+    state (default: ``state.params`` when present, else the state
+    itself); ``grads_of`` optionally extracts a gradient-like tree
+    (reported as ``grads_name``)."""
+
+    def fn(state):
+        params = (params_of(state) if params_of is not None
+                  else getattr(state, "params", state))
+        grads = grads_of(state) if grads_of is not None else None
+        return health_stats(params, tags, grads=grads,
+                            grads_name=grads_name)
+
+    return jax.jit(fn)
+
+
+class HealthMonitor:
+    """Sampled threshold-checker around a health fn (run_loop's hook).
+
+    ``check(state, step, log)`` runs the jitted stats fn, fetches the
+    scalars (the one host sync — callers control cadence), writes one
+    JSONL record carrying ``health/*`` fields plus ``health/ok``, and
+    warns (or raises ``FloatingPointError`` when ``abort=True``) when
+
+    - any value is nonfinite / ``nonfinite > 0``,
+    - any ``*boundary_margin_min`` < ``boundary_eps`` (ball points at
+      the clamp — gradients through artanh are already amplified),
+    - any ``*violation_max`` > ``violation_tol`` (off the hyperboloid).
+    """
+
+    def __init__(self, fn: Callable, *, boundary_eps: float =
+                 DEFAULT_BOUNDARY_EPS,
+                 violation_tol: float = DEFAULT_VIOLATION_TOL,
+                 abort: bool = False):
+        self.fn = fn
+        self.boundary_eps = float(boundary_eps)
+        self.violation_tol = float(violation_tol)
+        self.abort = abort
+        self.checks = 0
+        self.warnings = 0
+
+    def _problems(self, vals: dict) -> list[str]:
+        import math
+
+        probs = []
+        for k, v in vals.items():
+            if not math.isfinite(v):
+                probs.append(f"{k} is {v}")
+            elif k == "nonfinite" and v > 0:
+                probs.append(f"{int(v)} nonfinite values in state")
+            elif k.endswith("boundary_margin_min") and v < self.boundary_eps:
+                probs.append(f"{k}={v:.2e} < boundary_eps="
+                             f"{self.boundary_eps:.0e}")
+            elif k.endswith("violation_max") and v > self.violation_tol:
+                probs.append(f"{k}={v:.2e} > violation_tol="
+                             f"{self.violation_tol:.0e}")
+        return probs
+
+    def check(self, state: Any, step: int, log=None) -> dict:
+        """Sample once; returns the host-side {name: float} dict."""
+        from hyperspace_tpu.telemetry import registry
+
+        device_stats = self.fn(state)
+        vals = {k: float(v) for k, v in
+                jax.device_get(device_stats).items()}
+        self.checks += 1
+        registry.inc("health/checks")
+        problems = self._problems(vals)
+        if log is not None:
+            rec = {f"health/{k}": v for k, v in vals.items()}
+            rec["health/ok"] = not problems
+            log.log(step, **rec)
+        if problems:
+            self.warnings += 1
+            registry.inc("health/warnings")
+            msg = (f"[health] step {step}: " + "; ".join(problems))
+            print(msg, flush=True)
+            if self.abort:
+                raise FloatingPointError(msg)
+        return vals

@@ -1,0 +1,156 @@
+"""Process-wide counter/gauge registry — the ONE home of run counters.
+
+PR 1 left the hot path's bookkeeping scattered: prep-cache hits were a
+``print`` per event, prefetch stalls were invisible, checkpoint cost was
+nowhere, and recompiles only showed up as mysterious wall-clock cliffs.
+This registry replaces the ad-hoc lines with named counters/gauges that
+(1) any module can bump with one cheap dict-op (no device work, no
+host sync — safe on the per-dispatch path), (2) the training loop
+snapshots into every JSONL log record (``ctr/*`` fields) and into one
+final ``telemetry_summary`` record, and (3) the bench can read directly.
+
+The COUNTER CATALOG lives in docs/observability.md; every name
+incremented anywhere in the package must be documented there —
+``scripts/check_telemetry_catalog.py`` (run inside the test suite)
+fails the build otherwise.  Add the doc row when you add the counter.
+
+Counters are monotonic sums (floats allowed: seconds accumulate);
+gauges are last-write-wins levels (queue depth, bytes on disk).  All
+ops are lock-guarded — the prefetch worker thread increments
+concurrently with the training loop.
+
+``install_jax_monitoring_hook`` subscribes to :mod:`jax.monitoring`'s
+duration events and turns backend compiles into ``jax/recompiles`` /
+``jax/compile_s`` — the counter that catches a shape-unstable stepper
+recompiling every chunk (the failure the chunked loop's donation +
+static scan length is supposed to rule out).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+_BACKEND_COMPILE_SUBSTR = "backend_compile"
+
+
+class Registry:
+    """Named monotonic counters + last-write gauges, thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        # gauge -> (value, write seq): the seq lets a per-run snapshot
+        # exclude stale gauges a PRIOR in-process run set (see mark())
+        self._gauges: dict[str, tuple] = {}
+        self._seq = 0
+
+    def inc(self, name: str, value: float = 1) -> None:
+        """Add ``value`` to counter ``name`` (creating it at 0)."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._seq += 1
+            self._gauges[name] = (value, self._seq)
+
+    def get(self, name: str) -> float:
+        """Current counter value (0 if never incremented); gauges via
+        :meth:`snapshot`."""
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def mark(self) -> dict:
+        """Opaque per-run baseline for :meth:`snapshot`: counter values
+        plus the gauge write sequence at capture time.  A consumer
+        reporting per-run numbers from this process-cumulative registry
+        (run_loop in library use) captures one at run start."""
+        with self._lock:
+            return {"counters": dict(self._counters), "seq": self._seq}
+
+    def snapshot(self, prefix: str = "", baseline: Optional[dict] = None
+                 ) -> dict:
+        """One consistent {prefix+name: value} view of every counter and
+        gauge — the dict the loop merges into JSONL records.  With a
+        ``baseline`` (a prior :meth:`mark`) counters are reported as
+        deltas since the capture, and gauges are included only if
+        WRITTEN since it — a stale level from a previous in-process run
+        (e.g. its ``ckpt/bytes``) never masquerades as this run's."""
+        with self._lock:
+            if baseline is None:
+                out = {prefix + k: v for k, v in self._counters.items()}
+                out.update(
+                    (prefix + k, v) for k, (v, _s) in self._gauges.items())
+            else:
+                base_c, base_s = baseline["counters"], baseline["seq"]
+                out = {prefix + k: v - base_c.get(k, 0)
+                       for k, v in self._counters.items()}
+                out.update((prefix + k, v)
+                           for k, (v, s) in self._gauges.items()
+                           if s > base_s)
+        return out
+
+    def reset(self) -> None:
+        """Drop every counter/gauge (tests; a new run in-process)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._seq = 0
+
+
+_default: Optional[Registry] = None
+_default_lock = threading.Lock()
+
+
+def default_registry() -> Registry:
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = Registry()
+    return _default
+
+
+def inc(name: str, value: float = 1) -> None:
+    """Bump a counter on the default registry (the call sites' one-liner)."""
+    default_registry().inc(name, value)
+
+
+def set_gauge(name: str, value: float) -> None:
+    default_registry().set_gauge(name, value)
+
+
+def snapshot(prefix: str = "") -> dict:
+    return default_registry().snapshot(prefix)
+
+
+_hook_installed = False
+
+
+def install_jax_monitoring_hook() -> None:
+    """Route jax's compile-duration events into the default registry.
+
+    Idempotent (one listener per process — jax.monitoring offers no
+    per-listener removal).  The listener resolves ``default_registry()``
+    at event time, so a test that swaps/resets the registry still sees
+    fresh counts.  Counts ``/jax/core/compile/backend_compile_duration``
+    events: one per XLA backend compile, i.e. recompiles once the run's
+    steady state is reached.
+    """
+    global _hook_installed
+    if _hook_installed:
+        return
+    try:
+        import jax.monitoring as _mon
+
+        def _on_duration(event: str, duration: float, **_kw) -> None:
+            if _BACKEND_COMPILE_SUBSTR in event:
+                reg = default_registry()
+                reg.inc("jax/recompiles")
+                reg.inc("jax/compile_s", float(duration))
+
+        _mon.register_event_duration_secs_listener(_on_duration)
+        _hook_installed = True
+    except Exception:  # noqa: BLE001 — telemetry must never sink a run
+        pass

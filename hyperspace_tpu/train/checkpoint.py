@@ -49,9 +49,30 @@ class CheckpointManager:
 
         ``force=True`` bypasses the interval gate — used for the final
         step of a run, which must always land on disk regardless of
-        where it falls in the save cadence."""
-        return self._mgr.save(step, args=ocp.args.StandardSave(state),
-                              force=force)
+        where it falls in the save cadence.  Started saves bump
+        ``ckpt/saves`` and accumulate the BLOCKING portion (orbax's
+        synchronous device→host copy; the disk write is async) into
+        ``ckpt/save_s`` — the number that says how much step time
+        checkpointing steals (docs/observability.md)."""
+        import time
+
+        from hyperspace_tpu.telemetry import registry as telem
+        from hyperspace_tpu.telemetry.trace import default_tracer
+
+        t0 = time.perf_counter()
+        started = self._mgr.save(step, args=ocp.args.StandardSave(state),
+                                 force=force)
+        t1 = time.perf_counter()
+        if started:
+            # counter and span recorded together, and ONLY for saves
+            # that actually started — an interval-gated skip is a no-op
+            # in both metrics, so ckpt/saves and span/ckpt_save_n agree
+            telem.inc("ckpt/saves")
+            telem.inc("ckpt/save_s", t1 - t0)
+            tracer = default_tracer()
+            if tracer.enabled:
+                tracer.record_span("ckpt_save", t0, t1)
+        return started
 
     def restore(
         self,
@@ -88,8 +109,20 @@ class CheckpointManager:
         return _latest_committed_step(self._dir)
 
     def wait(self):
-        """Block until async saves land (call before process exit)."""
+        """Block until async saves land (call before process exit).
+
+        Once everything is on disk, the ``ckpt/bytes`` gauge is set to
+        the directory's total size — bytes are only meaningful after
+        the async writes commit, so this is the one place to count.
+        The recursive size walk only runs while a telemetry run has the
+        tracer enabled; the default (telemetry off) pays nothing."""
         self._mgr.wait_until_finished()
+        from hyperspace_tpu.telemetry.trace import default_tracer
+
+        if default_tracer().enabled:
+            from hyperspace_tpu.telemetry import registry as telem
+
+            telem.set_gauge("ckpt/bytes", dir_bytes(self._dir))
 
     def close(self):
         self._mgr.close()
@@ -100,6 +133,21 @@ class CheckpointManager:
     def __exit__(self, *exc):
         self.wait()
         self.close()
+
+
+def dir_bytes(directory: str) -> int:
+    """Total bytes on disk under ``directory`` (0 on any OS error)."""
+    total = 0
+    try:
+        for root, _dirs, files in os.walk(directory):
+            for name in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, name))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return total
 
 
 def _step_dir_committed(path: str) -> bool:

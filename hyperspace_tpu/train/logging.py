@@ -53,6 +53,9 @@ class MetricsLogger:
     def log(self, step: int, **metrics: Any):
         rec = {"step": int(step), "ts": time.time(), "host": self._host}
         for k, v in metrics.items():
+            if isinstance(v, bool):  # flags (health/ok) stay JSON bools,
+                rec[k] = v           # not 0.0/1.0
+                continue
             try:
                 rec[k] = float(v)
             except (TypeError, ValueError):
@@ -64,6 +67,33 @@ class MetricsLogger:
             for k, v in rec.items():
                 if k not in ("step", "ts", "host") and isinstance(v, float):
                     self._tb.add_scalar(k, v, int(step))
+        if self._stdout:
+            print(line, flush=True)
+
+    def event(self, name: str, **fields: Any):
+        """Write one non-step record ``{"event": name, ...}`` — run
+        manifests, telemetry summaries.  Values pass through as-is
+        (nested dicts like a config allowed; caller keeps them
+        JSON-serializable); non-serializable values degrade to repr
+        rather than killing the run.  JSONL/stdout only — TensorBoard
+        is a scalar sink."""
+        rec = {"event": name, "ts": time.time(), "host": self._host,
+               **fields}
+        try:
+            line = json.dumps(rec)
+        except (TypeError, ValueError):
+            # repr ONLY the offending fields: one bad value must not
+            # flatten the whole record's structured payload to strings
+            safe = {}
+            for k, v in rec.items():
+                try:
+                    json.dumps(v)
+                    safe[k] = v
+                except (TypeError, ValueError):
+                    safe[k] = repr(v)
+            line = json.dumps(safe)
+        if self._f is not None:
+            self._f.write(line + "\n")
         if self._stdout:
             print(line, flush=True)
 
@@ -83,10 +113,25 @@ class MetricsLogger:
 
 
 def read_jsonl(path: str) -> list[dict]:
+    """Parse a JSONL log, tolerating a truncated FINAL line.
+
+    A crashed run's last write can be cut mid-record (line-buffering
+    flushes whole lines, but a hard kill or full disk can still leave a
+    partial tail); the readable prefix is the artifact, so return it
+    instead of raising.  A malformed line with more records AFTER it is
+    real corruption and still raises.
+    """
     out = []
+    pending_error = None
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            if pending_error is not None:
+                raise pending_error  # bad line was NOT the final one
+            try:
                 out.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                pending_error = e
     return out

@@ -21,6 +21,15 @@ trick to a first-class training-loop feature shared by every CLI runner:
   per log boundary, never one per step).
 - :func:`resume_chunk` derives the batch-stream resume offset (ceil —
   see the function doc; floor would replay already-consumed rows).
+- ``run_loop`` is also the telemetry spine (``telemetry=`` on the CLI;
+  docs/observability.md): it writes the run manifest as the FIRST JSONL
+  record, wraps each dispatch/flush/save in trace spans, snapshots the
+  counter registry (``ctr/*``) and span aggregates (``span/*``) into
+  every log record, samples the numerical-health monitor every
+  ``health_every`` chunks, and closes the stream with one
+  ``telemetry_summary`` record.  Disabled (the default) none of that
+  runs: the per-dispatch additions are one registry dict-op and a
+  no-op span check — no host sync, no extra dispatches (tested).
 
 Chunk size policy: ``K`` trades dispatch amortization against reaction
 latency — checkpoints/logs can only land on chunk boundaries, so keep
@@ -104,12 +113,106 @@ def _logger(run):
                          tensorboard_dir=run.tensorboard_dir)
 
 
-def run_loop(run, state, stepper, project=None, steps_per_call=1):
+def run_manifest(run) -> dict:
+    """The run-identity record logged FIRST in every telemetry-enabled
+    JSONL (the acceptance anchor for "which run produced this file"):
+    full run config, device/backend identity, process topology, and the
+    package version."""
+    import dataclasses
+
+    import jax
+
+    import hyperspace_tpu
+
+    try:
+        config = dataclasses.asdict(run)
+    except TypeError:  # duck-typed run object (tests)
+        config = {k: v for k, v in vars(run).items()
+                  if not k.startswith("_")}
+    dev = jax.devices()[0]
+    return {
+        "config": config,
+        "backend": jax.default_backend(),
+        "device_kind": dev.device_kind,
+        "device_count": jax.device_count(),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "version": hyperspace_tpu.__version__,
+    }
+
+
+def _telemetry_setup(run):
+    """(tracer, registry, freshly_enabled) per the run's flags — all
+    None/disabled by default.  Duck-typed getattr so non-CLI callers
+    (tests, benches) opt in by simply having the attributes.
+    ``freshly_enabled`` marks that THIS call turned the process-global
+    tracer on (library use; the CLI enables it earlier, in ``main``, so
+    host prep spans record too) — the loop then turns it back off on
+    exit instead of leaking span recording into later runs."""
+    telemetry_on = bool(getattr(run, "telemetry", False))
+    trace_out = getattr(run, "trace_out", None)
+    tracer = reg = None
+    fresh = False
+    if telemetry_on or trace_out:
+        from hyperspace_tpu.telemetry import registry, trace
+
+        fresh = not trace.default_tracer().enabled
+        tracer = trace.enable(keep_events=bool(trace_out))
+        if fresh:
+            # library use: the tracer was off, so anything it holds is a
+            # PRIOR run's aggregates/events — this run starts clean
+            tracer.reset()
+        registry.install_jax_monitoring_hook()
+        reg = registry.default_registry() if telemetry_on else None
+    return tracer, reg, fresh
+
+
+@contextlib.contextmanager
+def _tracer_guard(tracer, fresh, trace_out=None):
+    """Return the process-global tracer to its pre-run state when this
+    run_loop enabled it: dump the requested trace file (the CLI flow
+    dumps later, in ``main``, so the eval span makes the timeline — a
+    library caller's only dump point is here), drop unflushed boundary
+    aggregates (they would bleed into a later run's first record), and
+    disable recording."""
+    try:
+        yield
+    finally:
+        if tracer is not None and fresh:
+            if trace_out:
+                try:
+                    tracer.dump_chrome_trace(trace_out)
+                except OSError:
+                    pass  # diagnostics never sink (or mask) the run
+            tracer.flush_fields()
+            tracer.enabled = False
+
+
+def _health_monitor(run, health_fn):
+    if health_fn is None or int(getattr(run, "health_every", 0) or 0) <= 0:
+        return None, 0
+    from hyperspace_tpu.telemetry.health import (
+        DEFAULT_BOUNDARY_EPS, DEFAULT_VIOLATION_TOL, HealthMonitor)
+
+    hm = HealthMonitor(
+        health_fn,
+        boundary_eps=float(getattr(run, "health_eps",
+                                   DEFAULT_BOUNDARY_EPS)),
+        violation_tol=float(getattr(run, "health_tol",
+                                    DEFAULT_VIOLATION_TOL)),
+        abort=bool(getattr(run, "health_abort", False)))
+    return hm, int(run.health_every)
+
+
+def run_loop(run, state, stepper, project=None, steps_per_call=1,
+             health_fn=None):
     """Shared step loop: optional checkpoint/resume + JSONL logging.
 
     ``run`` is duck-typed (``cli.train.RunConfig`` shape): ``steps``,
     ``eval_every``, ``log``, ``tensorboard_dir``, ``ckpt_dir``,
-    ``ckpt_every``, ``resume``.  Every workload runner goes through
+    ``ckpt_every``, ``resume``; plus the optional telemetry knobs
+    ``telemetry``, ``trace_out``, ``health_every``/``health_eps``/
+    ``health_abort`` (absent = off).  Every workload runner goes through
     here, so --ckpt-dir / resume work uniformly.  The checkpoint manager
     is context-managed (its __exit__ waits for in-flight async saves and
     closes background threads, also on the exception path).  Orbax async
@@ -121,10 +224,19 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1):
     the stepper always executes exactly that many steps per call (see
     :func:`make_chunked_stepper`); chunked steppers return the stacked
     ``[steps_per_call]`` per-step losses, of which the LAST is the
-    logged/returned loss and the chunk mean rides along as
-    ``loss_mean``.  Returns ``(final_state, final_loss)``; loss is nan
-    when no step ran.
+    logged/returned loss and the chunk mean/last/min/max ride along as
+    ``loss_*`` fields.  ``health_fn`` is a jitted ``state -> {name:
+    device scalar}`` (``telemetry.health.make_health_fn``), sampled
+    every ``run.health_every`` chunks — reading the state between
+    dispatches is safe w.r.t. donation (the read is enqueued before the
+    next dispatch consumes the buffers).  Returns ``(final_state,
+    final_loss)``; loss is nan when no step ran.
     """
+    from hyperspace_tpu.telemetry import registry as telem
+    from hyperspace_tpu.telemetry.trace import span
+
+    tracer, reg, fresh_tracer = _telemetry_setup(run)
+    monitor, health_every = _health_monitor(run, health_fn)
     ck = None
     start = 0
     loss = jnp.nan
@@ -138,10 +250,35 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1):
         from hyperspace_tpu.optim.metrics import ChunkMetrics
 
         acc = ChunkMetrics()
+
+    # per-run counter baseline, mirroring the tracer's fresh/guard
+    # semantics: when THIS run_loop freshly enabled telemetry (library
+    # use — several runs share the process-cumulative registry), report
+    # counters as deltas from loop entry so run 2 never claims run 1's
+    # dispatches.  In the CLI flow telemetry comes up in main() before
+    # graph prep, so no baseline is taken and pre-loop prep/prefetch
+    # counts rightly belong to this run's records.
+    counter_base = (reg.mark()
+                    if (reg is not None and fresh_tracer) else None)
+
+    def record_fields():
+        """Telemetry fields for one JSONL record: span aggregates since
+        the last record + a consistent counter/gauge snapshot."""
+        if reg is None:
+            return {}
+        out = tracer.flush_fields() if tracer is not None else {}
+        out.update(reg.snapshot("ctr/", baseline=counter_base))
+        return out
+
     # restore inside the with-block: a corrupt checkpoint raising in
     # restore() still closes the manager's async machinery on the way out
-    with (ck if ck is not None else contextlib.nullcontext()), \
+    # (tracer guard FIRST so it unwinds last, after the logger closed)
+    with _tracer_guard(tracer, fresh_tracer,
+                       getattr(run, "trace_out", None)), \
+            (ck if ck is not None else contextlib.nullcontext()), \
             _logger(run) as log:
+        if reg is not None:
+            log.event("run_manifest", **run_manifest(run))
         if (ck is not None and run.resume
                 and ck.latest_committed_step() is not None):
             state, start = ck.restore(state, project=project)
@@ -157,8 +294,12 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1):
         last_saved = None
         every = run.eval_every or 50
         done = start
+        chunk_i = 0
         while done < run.steps:
-            state, loss = stepper(state)
+            with span("dispatch"):
+                state, loss = stepper(state)
+            telem.inc("train/dispatches")
+            chunk_i += 1
             if acc is not None:
                 acc.add(loss)
             if jnp.ndim(loss):  # scanned chunk: [steps_per_call] losses
@@ -173,12 +314,21 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1):
             # crossed an interval boundary (identical to the old
             # `done % every == 0` when steps_per_call == 1)
             if (done // every) > (prev // every):
-                kw = {"loss": float(loss)}
-                if acc is not None:
-                    mean = acc.flush()
-                    if mean is not None:
-                        kw["loss_mean"] = mean
-                log.log(done, **kw)
+                # the float(loss) fetch is the interval's real
+                # block-until-device-done (dispatch is async enqueue),
+                # so it must sit INSIDE the span or the wait would show
+                # up nowhere in the span breakdown
+                with span("metrics_flush"):
+                    kw = {"loss": float(loss)}
+                    if acc is not None:
+                        stats = acc.flush()
+                        if stats is not None:
+                            kw.update(stats)
+                log.log(done, **kw, **record_fields())
+            # health sampling rides the chunk cadence, not the log one:
+            # a diverging run should flag BEFORE the next log boundary
+            if monitor is not None and chunk_i % health_every == 0:
+                monitor.check(state, done, log)
             # ckpt_every <= 0 = final save only (mirrors eval_every's
             # "0 = eval only at the end"; orbax's interval gate divides
             # by the interval, so it never sees a 0)
@@ -192,11 +342,20 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1):
             # chunks past the last crossed log boundary would otherwise
             # vanish: close the run with a final record so every step's
             # loss lands in some interval's loss_mean
-            mean = acc.flush()
-            if mean is not None:
-                log.log(done, loss=float(loss), loss_mean=mean)
+            with span("metrics_flush"):
+                stats = acc.flush()
+                final_loss = float(loss)
+            if stats is not None:
+                log.log(done, loss=final_loss, **stats, **record_fields())
         if ck is not None and start < run.steps and last_saved != done:
             # the final state must land even when it misses the save
             # cadence — otherwise resume silently replays a partial chunk
             ck.save(done, state, force=True)
+        if reg is not None:
+            if ck is not None:
+                ck.wait()  # async saves landed → ckpt/bytes gauge is real
+            summary = reg.snapshot("ctr/", baseline=counter_base)
+            if tracer is not None:
+                summary.update(tracer.total_fields())
+            log.event("telemetry_summary", steps=int(done), **summary)
     return state, loss

@@ -23,10 +23,17 @@ def benchmark_step(
     warmup: int = 3,
     iters: int = 20,
 ) -> dict:
-    """Time ``fn()`` (must return jax arrays); returns seconds statistics."""
+    """Time ``fn()`` (must return jax arrays); returns seconds statistics.
+
+    ``warmup=0`` is legal (an intentionally-cold first iteration —
+    compile time lands in ``max_s``): the warmup barrier only runs when
+    a warmup call produced something to wait on.
+    """
+    out = None
     for _ in range(warmup):
         out = fn()
-    jax.block_until_ready(out)
+    if out is not None:
+        jax.block_until_ready(out)
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
@@ -54,14 +61,29 @@ def trace(log_dir: str):
         jax.profiler.stop_trace()
 
 
-def compiled_cost(fn: Callable, *args, **kwargs) -> dict:
-    """Cost analysis of the XLA executable for fn(*args)."""
-    lowered = jax.jit(fn).lower(*args, **kwargs)
-    compiled = lowered.compile()
+def cost_analysis_dict(compiled) -> dict:
+    """``compiled.cost_analysis()`` normalized to ONE flat dict.
+
+    The raw call is backend- and version-shaped: older jax returns a
+    one-element ``[dict]`` per program, some backends raise, some
+    return None.  Every consumer (``compiled_cost``, the bench's
+    ``step_cost``, the profiling scripts) goes through here so the
+    list-shape handling lives in exactly one place; returns {} whenever
+    no analysis is available.
+    """
     try:
         cost = compiled.cost_analysis()
-    except Exception:  # backend without cost analysis
+    except Exception:  # noqa: BLE001 — backend without cost analysis
         return {}
-    if isinstance(cost, list):  # some backends return [dict]
+    if isinstance(cost, (list, tuple)):  # older jax: one dict per program
         cost = cost[0] if cost else {}
+    return dict(cost) if cost else {}
+
+
+def compiled_cost(fn: Callable, *args, **kwargs) -> dict:
+    """flops / bytes-accessed of the XLA executable for fn(*args) —
+    the two keys every roofline consumer wants, {} when the backend
+    offers no analysis."""
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    cost = cost_analysis_dict(compiled)
     return {k: cost[k] for k in ("flops", "bytes accessed") if k in cost}

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Lint: every telemetry counter/gauge incremented in code is documented.
+
+The counter catalog in docs/observability.md is the contract consumers
+(dashboards, the bench, humans reading a JSONL) rely on; an undocumented
+counter is invisible telemetry.  This script scans every ``.py`` under
+``hyperspace_tpu/`` for literal ``inc("name")`` / ``set_gauge("name")``
+calls and fails (exit 1, listing offenders) unless each name appears in
+the catalog doc.  Run by ``tests/telemetry/test_catalog.py`` inside the
+suite, so adding a counter without its doc row fails the build.
+
+Dynamically-built names can't be scanned; keep registry names literal
+(they are today) or add the doc row and a ``# telemetry-catalog: name``
+comment the scanner also picks up.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_CALL = re.compile(r"""\b(?:inc|set_gauge)\(\s*["']([^"']+)["']""")
+_ANNOT = re.compile(r"#\s*telemetry-catalog:\s*(\S+)")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def counters_in_code(pkg_dir: str) -> dict[str, list[str]]:
+    """{counter name: [file:line, ...]} for every literal registry call."""
+    found: dict[str, list[str]] = {}
+    for root, _dirs, files in os.walk(pkg_dir):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    for rx in (_CALL, _ANNOT):
+                        for m in rx.finditer(line):
+                            found.setdefault(m.group(1), []).append(
+                                f"{rel}:{lineno}")
+    return found
+
+
+def documented_names(doc_path: str) -> set[str]:
+    """Names carried in the catalog doc (any backticked token)."""
+    with open(doc_path, encoding="utf-8") as f:
+        text = f.read()
+    return set(re.findall(r"`([^`\s]+)`", text))
+
+
+def main() -> int:
+    root = repo_root()
+    pkg = os.path.join(root, "hyperspace_tpu")
+    doc = os.path.join(root, "docs", "observability.md")
+    if not os.path.exists(doc):
+        print(f"missing catalog doc: {doc}")
+        return 1
+    found = counters_in_code(pkg)
+    documented = documented_names(doc)
+    missing = {k: v for k, v in found.items() if k not in documented}
+    if missing:
+        print("telemetry counters incremented in code but missing from "
+              "docs/observability.md's catalog:")
+        for name in sorted(missing):
+            sites = ", ".join(missing[name][:3])
+            print(f"  {name}  ({sites})")
+        return 1
+    print(f"telemetry catalog OK: {len(found)} names, all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

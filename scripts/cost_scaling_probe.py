@@ -63,9 +63,11 @@ def main() -> None:
     model, opt, state = hgcn.init_lp(cfg, split.graph, seed=0)
     ga = G.to_device(split.graph)
     pairs = jnp.asarray(split.train_pos[:256])
-    single = jax.jit(
+    from hyperspace_tpu.train.profiling import cost_analysis_dict
+
+    single = cost_analysis_dict(jax.jit(
         lambda st, g, p: hgcn._lp_step_impl(model, opt, n, st, g, p)
-    ).lower(state, ga, pairs).compile().cost_analysis()
+    ).lower(state, ga, pairs).compile())
 
     out = {"ndev": args.ndev, "num_nodes": n, "reorder": args.reorder,
            "single_flops": single["flops"],
@@ -78,7 +80,7 @@ def main() -> None:
         tp = jnp.asarray(hgcn.round_up_pairs(split.train_pos[:256], mesh))
         step, state_k, nsg = hgcn.make_node_sharded_step_lp(
             model_k, opt_k, n, mesh, state_k, split)
-        cost = step.lower(state_k, nsg, tp).compile().cost_analysis()
+        cost = cost_analysis_dict(step.lower(state_k, nsg, tp).compile())
         out["dp"][str(dp)] = {
             "halo": bool(nsg.halo),
             "flops_ratio": round(cost["flops"] / single["flops"], 4),

@@ -103,9 +103,10 @@ def main():
           flush=True)
 
     try:
-        cost = jax.jit(lambda st: step(st)).lower(st).compile().cost_analysis()
-        if isinstance(cost, list):
-            cost = cost[0] if cost else {}
+        from hyperspace_tpu.train.profiling import cost_analysis_dict
+
+        cost = cost_analysis_dict(
+            jax.jit(lambda st: step(st)).lower(st).compile())
         print(json.dumps({"probe": "xla_cost",
                           "flops": cost.get("flops"),
                           "bytes": cost.get("bytes accessed")}), flush=True)

@@ -1,0 +1,132 @@
+"""Hyperbolic health monitor (telemetry/health.py + the manifolds'
+``health_stats``): hand-built near-boundary ball points, off-hyperboloid
+Lorentz points, product merging, nonfinite detection, thresholds/abort."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.manifolds import (
+    Euclidean,
+    Lorentz,
+    PoincareBall,
+    Product,
+)
+from hyperspace_tpu.telemetry.health import (
+    HealthMonitor,
+    health_stats,
+    make_health_fn,
+)
+
+
+def _floats(stats):
+    return {k: float(v) for k, v in jax.device_get(stats).items()}
+
+
+def test_poincare_stats_on_hand_built_points():
+    ball = PoincareBall(1.0)
+    x = jnp.asarray([[0.3, 0.0], [0.0, 0.5]], jnp.float32)
+    s = _floats(ball.health_stats(x))
+    assert s["norm_max"] == pytest.approx(0.5, abs=1e-6)
+    assert s["norm_mean"] == pytest.approx(0.4, abs=1e-6)
+    assert s["boundary_margin_min"] == pytest.approx(0.5, abs=1e-6)
+
+
+def test_poincare_clamped_point_flags_below_default_eps():
+    # an artificially boundary-clamped embedding (what proj does to a
+    # diverging row) must read as margin ≈ ball_eps(f32) = 4e-3 < the
+    # monitor's default 1e-2 — the acceptance-criterion scenario
+    ball = PoincareBall(1.0)
+    x = ball.proj(jnp.asarray([[0.9999, 0.0], [0.1, 0.2]], jnp.float32))
+    s = _floats(health_stats(x, ball))
+    assert s["boundary_margin_min"] < 1e-2
+    mon = HealthMonitor(make_health_fn(ball))
+    mon.check(x, step=0)
+    assert mon.warnings == 1
+
+
+def test_poincare_curvature_scales_radius():
+    # c=4 halves the ball radius: ‖x‖=0.4 is √c‖x‖=0.8 of the way out
+    ball = PoincareBall(4.0)
+    s = _floats(ball.health_stats(jnp.asarray([[0.4, 0.0]], jnp.float32)))
+    assert s["norm_max"] == pytest.approx(0.8, abs=1e-5)
+
+
+def test_lorentz_stats_on_and_off_hyperboloid():
+    L = Lorentz(1.0)
+    on = L.proj(jnp.asarray([[0.0, 0.3, -0.2], [0.0, 1.5, 2.0]],
+                            jnp.float32))
+    s_on = _floats(L.health_stats(on))
+    assert s_on["violation_max"] < 1e-5
+    assert s_on["time_coord_max"] >= 1.0  # cosh ≥ 1 on the sheet
+    off = on.at[0, 0].add(0.5)  # perturb the time coordinate
+    s_off = _floats(L.health_stats(off))
+    assert s_off["violation_max"] > 1e-2
+
+
+def test_product_merges_factors_with_aggregates():
+    ball = PoincareBall(1.0)
+    P = Product([ball, Euclidean()], [2, 3])
+    x = jnp.concatenate(
+        [ball.proj(jnp.asarray([[0.999, 0.0]], jnp.float32)),
+         jnp.ones((1, 3), jnp.float32)], axis=-1)
+    s = _floats(P.health_stats(x))
+    assert "f0_poincare/boundary_margin_min" in s
+    assert "f1_euclidean/violation_max" in s
+    # unprefixed worst-case aggregate drives the monitor's thresholds
+    assert s["boundary_margin_min"] == pytest.approx(
+        s["f0_poincare/boundary_margin_min"])
+
+
+def test_nonfinite_counts_across_tree_and_warns():
+    params = {"w": jnp.asarray([1.0, jnp.nan]),
+              "b": jnp.asarray([jnp.inf]),
+              "step": jnp.asarray(3, jnp.int32)}  # ints don't count
+    s = _floats(health_stats(params))
+    assert s["nonfinite"] == 2
+    mon = HealthMonitor(make_health_fn(), abort=False)
+    mon.check(params, step=1)
+    assert mon.warnings == 1
+
+
+def test_grads_tree_adds_named_global_norm():
+    s = _floats(health_stats(
+        {"w": jnp.ones((2, 2))}, grads={"w": 3.0 * jnp.ones((4,))},
+        grads_name="grad_ema_norm"))
+    assert s["grad_ema_norm"] == pytest.approx(6.0)
+
+
+def test_tag_tree_merges_manifold_leaves():
+    ball = PoincareBall(1.0)
+    params = {"emb": ball.proj(jnp.asarray([[0.999, 0.0]], jnp.float32)),
+              "dense": jnp.ones((2, 2))}
+    s = _floats(health_stats(params, {"emb": ball, "dense": None}))
+    assert s["boundary_margin_min"] < 1e-2
+    assert s["nonfinite"] == 0
+
+
+def test_monitor_logs_health_record_and_abort(tmp_path):
+    from hyperspace_tpu.train.logging import MetricsLogger, read_jsonl
+
+    ball = PoincareBall(1.0)
+    bad = ball.proj(jnp.asarray([[0.99999, 0.0]], jnp.float32))
+    path = str(tmp_path / "h.jsonl")
+    with MetricsLogger(path) as log:
+        mon = HealthMonitor(make_health_fn(ball))
+        mon.check(bad, step=8, log=log)
+    (rec,) = read_jsonl(path)
+    assert rec["step"] == 8
+    assert rec["health/ok"] is False
+    assert rec["health/boundary_margin_min"] < 1e-2
+    with pytest.raises(FloatingPointError):
+        HealthMonitor(make_health_fn(ball), abort=True).check(bad, step=9)
+
+
+def test_healthy_state_stays_quiet():
+    ball = PoincareBall(1.0)
+    ok = jnp.asarray(np.full((16, 4), 0.05, np.float32))
+    mon = HealthMonitor(make_health_fn(ball))
+    vals = mon.check(ok, step=0)
+    assert mon.warnings == 0
+    assert vals["boundary_margin_min"] > 0.5

@@ -1,0 +1,100 @@
+"""Counter registry (telemetry/registry.py): increments, gauges,
+snapshot/reset, thread-safety, the jax.monitoring recompile hook."""
+
+import threading
+
+import pytest
+
+from hyperspace_tpu.telemetry.registry import (
+    Registry,
+    default_registry,
+    install_jax_monitoring_hook,
+)
+
+
+@pytest.fixture()
+def reg():
+    return Registry()
+
+
+def test_inc_get_and_float_accumulation(reg):
+    reg.inc("a")
+    reg.inc("a", 2)
+    reg.inc("secs", 0.25)
+    reg.inc("secs", 0.5)
+    assert reg.get("a") == 3
+    assert reg.get("secs") == pytest.approx(0.75)
+    assert reg.get("never") == 0
+
+
+def test_snapshot_prefix_and_gauges(reg):
+    reg.inc("hits", 4)
+    reg.set_gauge("depth", 2)
+    reg.set_gauge("depth", 1)  # last write wins
+    snap = reg.snapshot("ctr/")
+    assert snap == {"ctr/hits": 4, "ctr/depth": 1}
+    # snapshot is a copy — mutating it never leaks back
+    snap["ctr/hits"] = 999
+    assert reg.get("hits") == 4
+
+
+def test_mark_baseline_deltas_counters_and_excludes_stale_gauges(reg):
+    # the per-run baseline contract run_loop relies on in library use:
+    # counters report as deltas, and a gauge set BEFORE the mark (a
+    # previous run's level, e.g. its ckpt/bytes) is excluded entirely
+    reg.inc("a", 5)
+    reg.set_gauge("stale", 7)
+    base = reg.mark()
+    reg.inc("a", 2)
+    reg.set_gauge("fresh", 1)
+    snap = reg.snapshot("ctr/", baseline=base)
+    assert snap["ctr/a"] == 2
+    assert "ctr/stale" not in snap
+    assert snap["ctr/fresh"] == 1
+
+
+def test_reset_drops_everything(reg):
+    reg.inc("x")
+    reg.set_gauge("g", 7)
+    reg.reset()
+    assert reg.snapshot() == {}
+
+
+def test_concurrent_increments_do_not_lose_counts(reg):
+    n, per = 8, 500
+
+    def work():
+        for _ in range(per):
+            reg.inc("shared")
+
+    threads = [threading.Thread(target=work) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.get("shared") == n * per
+
+
+def test_default_registry_is_process_wide():
+    assert default_registry() is default_registry()
+
+
+def test_jax_monitoring_hook_counts_backend_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    install_jax_monitoring_hook()
+    reg = default_registry()
+    before = reg.get("jax/recompiles")
+
+    @jax.jit
+    def f(x):
+        return x * 2 + 1
+
+    f(jnp.arange(7))  # fresh shape+program → one backend compile
+    assert reg.get("jax/recompiles") >= before + 1
+    assert reg.get("jax/compile_s") > 0
+    # cached second call must NOT count
+    mid = reg.get("jax/recompiles")
+    f(jnp.arange(7))
+    assert reg.get("jax/recompiles") == mid

@@ -167,7 +167,9 @@ def test_chunk_metrics_accumulates_across_chunks():
     assert acc.flush() is None
     acc.add(jnp.asarray([1.0, 2.0, 3.0]))
     acc.add(jnp.asarray(6.0))  # scalar (K=1 shape) mixes in fine
-    assert acc.flush() == 3.0
+    stats = acc.flush()  # ONE host fetch for all four statistics
+    assert stats == {"loss_mean": 3.0, "loss_last": 6.0,
+                     "loss_min": 1.0, "loss_max": 6.0}
     assert acc.flush() is None  # flush drains
 
 
@@ -185,6 +187,9 @@ def test_run_loop_logs_chunk_mean(tmp_path):
     assert [r["step"] for r in recs] == [4, 8]
     for r in recs:
         assert np.isfinite(r["loss"]) and np.isfinite(r["loss_mean"])
+        # the interval extremes ride along on the same host fetch
+        assert r["loss_min"] <= r["loss_mean"] <= r["loss_max"]
+        assert r["loss_last"] == r["loss"]
 
 
 def test_round_steps_to_chunk():

@@ -99,6 +99,19 @@ def test_metrics_logger(tmp_path):
     assert all("ts" in r for r in recs)
 
 
+def test_metrics_logger_event_records(tmp_path):
+    p = str(tmp_path / "e.jsonl")
+    with MetricsLogger(p) as log:
+        log.event("run_manifest", config={"steps": 5}, backend="cpu")
+        # one bad field reprs ONLY itself — siblings keep their structure
+        log.event("weird", blob=object(), config={"steps": 7})
+    recs = read_jsonl(p)
+    assert recs[0]["event"] == "run_manifest"
+    assert recs[0]["config"] == {"steps": 5}
+    assert recs[1]["event"] == "weird" and "object" in recs[1]["blob"]
+    assert recs[1]["config"] == {"steps": 7}
+
+
 def test_benchmark_step_runs():
     f = jax.jit(lambda: jnp.ones((8, 8)) @ jnp.ones((8, 8)))
     stats = benchmark_step(f, warmup=1, iters=3)
@@ -106,10 +119,53 @@ def test_benchmark_step_runs():
     assert stats["min_s"] <= stats["mean_s"] <= stats["max_s"]
 
 
+def test_benchmark_step_warmup_zero_regression():
+    # warmup=0 used to hit `out` unbound before block_until_ready
+    # (NameError); an intentionally-cold timing run must just work
+    f = jax.jit(lambda: jnp.ones((4, 4)) * 2)
+    stats = benchmark_step(f, warmup=0, iters=2)
+    assert stats["iters"] == 2 and stats["min_s"] > 0
+
+
 def test_compiled_cost_reports_flops():
     cost = compiled_cost(lambda a, b: a @ b, jnp.ones((16, 16)), jnp.ones((16, 16)))
     if cost:  # backend-dependent; CPU provides it
         assert cost.get("flops", 0) > 0
+
+
+def test_cost_analysis_dict_normalizes_every_backend_shape():
+    # the ONE list-shape handler every consumer (bench step_cost, the
+    # profiling scripts) now routes through
+    from hyperspace_tpu.train.profiling import cost_analysis_dict
+
+    class Fake:
+        def __init__(self, ret=None, raise_=False):
+            self._ret, self._raise = ret, raise_
+
+        def cost_analysis(self):
+            if self._raise:
+                raise RuntimeError("no analysis on this backend")
+            return self._ret
+
+    assert cost_analysis_dict(Fake({"flops": 2.0})) == {"flops": 2.0}
+    assert cost_analysis_dict(Fake([{"flops": 3.0}])) == {"flops": 3.0}
+    assert cost_analysis_dict(Fake([])) == {}
+    assert cost_analysis_dict(Fake(None)) == {}
+    assert cost_analysis_dict(Fake(raise_=True)) == {}
+
+
+def test_read_jsonl_tolerates_truncated_final_line(tmp_path):
+    import pytest
+
+    p = tmp_path / "crashed.jsonl"
+    p.write_text('{"step": 1, "loss": 0.5}\n{"step": 2, "lo')  # hard kill
+    recs = read_jsonl(str(p))
+    assert [r["step"] for r in recs] == [1]
+    # corruption in the MIDDLE is a real error, not a crash artifact
+    p2 = tmp_path / "corrupt.jsonl"
+    p2.write_text('{"step": 1}\nnot json at all\n{"step": 3}\n')
+    with pytest.raises(json.JSONDecodeError):
+        read_jsonl(str(p2))
 
 
 def test_cli_override_coercion():

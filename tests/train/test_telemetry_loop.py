@@ -1,0 +1,266 @@
+"""run_loop telemetry integration (the ISSUE 2 acceptance contracts):
+manifest-first JSONL, span/ctr fields on step records, counters that
+match actual prefetch/prep-cache behavior, telemetry_summary at close,
+and — the no-regression side — telemetry OFF adds nothing to the stream
+and leaves the chunked dispatch count unchanged."""
+
+import dataclasses
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.cli.train import RunConfig
+from hyperspace_tpu.data.wordnet import synthetic_tree
+from hyperspace_tpu.models import poincare_embed as pe
+from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry import trace
+from hyperspace_tpu.train import loop
+from hyperspace_tpu.train.logging import read_jsonl
+
+_DS = synthetic_tree(depth=3, branching=3)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telem.default_registry().reset()
+    t = trace.default_tracer()
+    was = (t.enabled, t.keep_events)
+    t.reset()
+    yield
+    telem.default_registry().reset()
+    t.reset()
+    t.enabled, t.keep_events = was
+
+
+def _cfg():
+    return pe.PoincareEmbedConfig(num_nodes=_DS.num_nodes, dim=4,
+                                  batch_size=16, neg_samples=4)
+
+
+def _stepper(seed=1):
+    cfg = _cfg()
+    pairs = jnp.asarray(_DS.pairs)
+    state, opt = pe.init_state(cfg, seed)
+    step_fn = pe.make_train_step(cfg)
+    return state, (lambda st: step_fn(cfg, opt, st, pairs))
+
+
+def test_manifest_is_first_record_with_shape(tmp_path):
+    state, base = _stepper()
+    log = str(tmp_path / "t.jsonl")
+    run = RunConfig(steps=8, eval_every=4, log=log, telemetry=True)
+    loop.run_loop(run, state, loop.make_chunked_stepper(base, 4),
+                  steps_per_call=4)
+    recs = read_jsonl(log)
+    man = recs[0]
+    assert man["event"] == "run_manifest"
+    assert man["config"]["steps"] == 8 and man["config"]["telemetry"]
+    for key in ("backend", "device_kind", "device_count", "process_index",
+                "process_count", "version"):
+        assert key in man, key
+    assert man["config"] == {**dataclasses.asdict(RunConfig()),
+                             **man["config"]}  # full RunConfig shape
+
+
+def test_step_records_carry_spans_and_counters(tmp_path):
+    state, base = _stepper()
+    log = str(tmp_path / "t.jsonl")
+    run = RunConfig(steps=12, eval_every=4, log=log, telemetry=True)
+    loop.run_loop(run, state, loop.make_chunked_stepper(base, 4),
+                  steps_per_call=4)
+    recs = read_jsonl(log)
+    steps = [r for r in recs if "loss" in r]
+    assert [r["step"] for r in steps] == [4, 8, 12]
+    for i, r in enumerate(steps):
+        assert r["span/dispatch_s"] > 0
+        assert r["ctr/train/dispatches"] == i + 1  # snapshot matches truth
+        for k in ("loss_mean", "loss_last", "loss_min", "loss_max"):
+            assert np.isfinite(r[k])
+    summary = recs[-1]
+    assert summary["event"] == "telemetry_summary"
+    assert summary["ctr/train/dispatches"] == 3
+    assert summary["span/dispatch_n"] == 3
+
+
+def test_disabled_default_adds_nothing_and_same_dispatch_count(tmp_path):
+    state, base = _stepper()
+    log = str(tmp_path / "plain.jsonl")
+    run = RunConfig(steps=12, eval_every=4, log=log)  # telemetry off
+    loop.run_loop(run, state, loop.make_chunked_stepper(base, 4),
+                  steps_per_call=4)
+    recs = read_jsonl(log)
+    assert all("event" not in r for r in recs)
+    assert not any(k.startswith(("ctr/", "span/", "health/"))
+                   for r in recs for k in r)
+    # the chunked dispatch count is IDENTICAL to the telemetry-on run of
+    # the same shape (12 steps / K=4 = 3): enabling telemetry never adds
+    # or removes dispatches, and disabling never skips the accounting
+    assert telem.default_registry().get("train/dispatches") == 3
+    assert not trace.default_tracer().enabled
+
+
+def test_health_records_flag_clamped_embedding(tmp_path):
+    from hyperspace_tpu.manifolds import PoincareBall
+    from hyperspace_tpu.telemetry.health import make_health_fn
+
+    cfg = _cfg()
+    ball = PoincareBall(cfg.c)
+    state, base = _stepper()
+    # artificially clamp one row onto the boundary shell before training
+    bad_table = state.table.at[0].set(
+        ball.proj(jnp.asarray([0.99999] + [0.0] * (cfg.dim - 1))))
+    state = state._replace(table=bad_table)
+    log = str(tmp_path / "h.jsonl")
+    run = RunConfig(steps=8, eval_every=4, log=log, telemetry=True,
+                    health_every=1)
+    loop.run_loop(run, state, loop.make_chunked_stepper(base, 4),
+                  steps_per_call=4,
+                  health_fn=make_health_fn(ball,
+                                           params_of=lambda st: st.table))
+    health = [r for r in read_jsonl(log) if "health/ok" in r]
+    assert len(health) == 2  # every chunk
+    assert health[0]["health/ok"] is False  # the clamped row flags
+    assert health[0]["health/boundary_margin_min"] < 1e-2
+    assert telem.default_registry().get("health/warnings") >= 1
+
+
+def test_health_abort_stops_the_run(tmp_path):
+    from hyperspace_tpu.manifolds import PoincareBall
+    from hyperspace_tpu.telemetry.health import make_health_fn
+
+    cfg = _cfg()
+    ball = PoincareBall(cfg.c)
+    state, base = _stepper()
+    state = state._replace(table=state.table.at[0, 0].set(jnp.nan))
+    run = RunConfig(steps=8, telemetry=True, health_every=1,
+                    health_abort=True)
+    with pytest.raises(FloatingPointError):
+        loop.run_loop(run, state, loop.make_chunked_stepper(base, 4),
+                      steps_per_call=4,
+                      health_fn=make_health_fn(
+                          ball, params_of=lambda st: st.table))
+
+
+def test_second_in_process_run_reports_only_its_own_counts(tmp_path):
+    # library use: two telemetry runs share the process-cumulative
+    # registry/tracer; run 2's records and summary must report ITS
+    # dispatches/spans, not inherit run 1's (per-run baseline + reset)
+    for i, steps in enumerate((8, 12)):
+        state, base = _stepper(seed=i)
+        log = str(tmp_path / f"r{i}.jsonl")
+        run = RunConfig(steps=steps, eval_every=4, log=log, telemetry=True)
+        loop.run_loop(run, state, loop.make_chunked_stepper(base, 4),
+                      steps_per_call=4)
+    recs = read_jsonl(str(tmp_path / "r1.jsonl"))
+    summary = recs[-1]
+    assert summary["ctr/train/dispatches"] == 3  # 12/4, not 2+3
+    assert summary["span/dispatch_n"] == 3
+    first_step = next(r for r in recs if "loss" in r)
+    assert first_step["ctr/train/dispatches"] == 1
+
+
+def test_ckpt_span_counts_only_started_saves(tmp_path):
+    # interval-gated save() calls that orbax skips must be no-ops in
+    # BOTH metrics: ckpt/saves and span/ckpt_save_n stay in agreement
+    state, base = _stepper()
+    log = str(tmp_path / "c.jsonl")
+    run = RunConfig(steps=12, eval_every=4, log=log, telemetry=True,
+                    ckpt_dir=str(tmp_path / "ck"), ckpt_every=8)
+    loop.run_loop(run, state, loop.make_chunked_stepper(base, 4),
+                  steps_per_call=4)  # 3 save() calls, gate passes 2
+    summary = read_jsonl(log)[-1]
+    assert summary["span/ckpt_save_n"] == summary["ctr/ckpt/saves"]
+
+
+def test_library_run_dumps_trace_out(tmp_path):
+    # a non-CLI caller setting trace_out must get the file at that path
+    # (the CLI dumps later, in main, where the eval span exists)
+    state, base = _stepper()
+    out = str(tmp_path / "t.json")
+    run = RunConfig(steps=8, telemetry=True, trace_out=out)
+    loop.run_loop(run, state, loop.make_chunked_stepper(base, 4),
+                  steps_per_call=4)
+    doc = json.loads(open(out).read())
+    assert any(e["name"] == "dispatch" for e in doc["traceEvents"])
+
+
+def test_run_loop_restores_freshly_enabled_tracer():
+    # a library caller's second run must not inherit span recording the
+    # first run's telemetry=1 turned on (process-global tracer leak)
+    state, base = _stepper()
+    run = RunConfig(steps=8, telemetry=True)
+    loop.run_loop(run, state, loop.make_chunked_stepper(base, 4),
+                  steps_per_call=4)
+    assert not trace.default_tracer().enabled
+    assert trace.default_tracer().flush_fields() == {}  # nothing leftover
+
+
+def test_health_tol_flag_plumbs_to_monitor():
+    from hyperspace_tpu.cli.train import split_overrides
+
+    run, _ = split_overrides(["health_tol=0.05", "health_every=2"],
+                             RunConfig())
+    assert run.health_tol == 0.05  # a real RunConfig field, not SystemExit
+    mon, every = loop._health_monitor(run, lambda st: {})
+    assert every == 2 and mon.violation_tol == 0.05
+
+
+def test_trace_dumped_even_when_workload_fails(tmp_path, monkeypatch):
+    # the trace exists to diagnose failures — a health_abort (or any
+    # workload crash) must still produce the trace_out artifact
+    from hyperspace_tpu.cli import train as cli
+
+    def boom(run, overrides):
+        with trace.span("dispatch"):
+            pass
+        raise FloatingPointError("health abort")
+
+    monkeypatch.setitem(cli.WORKLOADS, "poincare", boom)
+    out = str(tmp_path / "t.json")
+    with pytest.raises(FloatingPointError):
+        cli.main(["poincare", "telemetry=1", f"trace_out={out}"])
+    doc = json.loads(open(out).read())
+    assert any(e["name"] == "dispatch" for e in doc["traceEvents"])
+    assert not trace.default_tracer().enabled  # main's finally disabled it
+
+
+def test_prefetch_counters_match_behavior():
+    from hyperspace_tpu.data.prefetch import HostPrefetcher
+
+    reg = telem.default_registry()
+    with HostPrefetcher(lambda i: i * 10, depth=2) as p:
+        got = [p.next() for _ in range(4)]
+    assert got == [0, 10, 20, 30]
+    assert reg.get("prefetch/consumed") == 4
+    assert reg.get("prefetch/produced") >= 4
+    # the very first next() races a cold queue: stalls ≤ consumed
+    assert 0 <= reg.get("prefetch/stalls") <= 4
+
+
+def test_prep_cache_counters_match_behavior(tmp_path):
+    from hyperspace_tpu.data.prep_cache import PrepCache
+
+    reg = telem.default_registry()
+    cache = PrepCache(root=str(tmp_path / "prep"))
+    cache.get_or_build("k", (1,), lambda: np.arange(3))
+    cache.get_or_build("k", (1,), lambda: np.arange(3))
+    assert reg.get("prep_cache/miss") == 1
+    assert reg.get("prep_cache/hit") == 1
+
+
+def test_ckpt_counters_and_summary_bytes(tmp_path):
+    state, base = _stepper()
+    log = str(tmp_path / "c.jsonl")
+    run = RunConfig(steps=8, eval_every=4, log=log, telemetry=True,
+                    ckpt_dir=str(tmp_path / "ck"), ckpt_every=4)
+    loop.run_loop(run, state, loop.make_chunked_stepper(base, 4),
+                  steps_per_call=4)
+    reg = telem.default_registry()
+    assert reg.get("ckpt/saves") >= 2  # steps 4 and 8
+    assert reg.get("ckpt/save_s") > 0
+    summary = read_jsonl(log)[-1]
+    assert summary["event"] == "telemetry_summary"
+    assert summary["ctr/ckpt/bytes"] > 0  # async saves landed first
+    assert summary["span/ckpt_save_n"] >= 2
