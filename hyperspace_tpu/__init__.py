@@ -15,6 +15,7 @@ Layer map (SURVEY.md §1b):
   train/      L4 jitted train loop, Mesh/GSPMD sharding, checkpointing
   models/     L5 the five reference workloads
   data/       loaders (WordNet closure, graphs, MNIST, text)
+  serve/      inference: frozen serving artifacts + batched query engine
 """
 
 __version__ = "0.1.0"

@@ -1,0 +1,240 @@
+"""Serving entry point: ``python -m hyperspace_tpu.cli.serve``.
+
+Three modes, same ``key=value`` override grammar as the train CLI:
+
+    # freeze the newest committed checkpoint step into a serving artifact
+    python -m hyperspace_tpu.cli.serve export \
+        ckpt=runs/poincare/ckpt out=runs/poincare/artifact \
+        workload=poincare c=1.0
+
+    # one-shot queries (tests, smoke checks): prints one JSON line
+    python -m hyperspace_tpu.cli.serve query artifact=runs/poincare/artifact \
+        ids=0,1,2 k=5
+    python -m hyperspace_tpu.cli.serve query artifact=... u=0,1 v=2,3 prob=1
+
+    # stdin/JSONL loop: one request per line, one JSON response per line
+    python -m hyperspace_tpu.cli.serve serve artifact=... telemetry=1
+
+Loop-mode requests:
+
+    {"op": "topk",  "ids": [0, 1, 2], "k": 5}
+    {"op": "score", "u": [0, 1], "v": [2, 3], "prob": true}
+    {"op": "stats"}
+
+Responses mirror the request (``neighbors``/``dists``, ``scores``, or
+the counter snapshot); a bad request yields ``{"error": ...}`` and the
+loop continues — a malformed line must never take the server down.
+Telemetry wiring matches the train CLI: ``telemetry=1`` installs the
+recompile hook and prints a closing summary line to stderr,
+``trace_out=`` dumps the host spans (each batch runs under a ``query``
+span) as Chrome ``trace_events`` JSON in a ``finally``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+import numpy as np
+
+from hyperspace_tpu.cli.train import _json_safe, apply_overrides
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    # shared
+    artifact: str | None = None   # artifact dir (query/serve)
+    telemetry: bool = False
+    trace_out: str | None = None
+    # export
+    ckpt: str | None = None       # CheckpointManager dir
+    out: str | None = None        # artifact dir to write
+    workload: str = "poincare"    # poincare | lorentz | product
+    # curvature the run TRAINED with — required for poincare/lorentz
+    # export (not recoverable from the checkpoint; no silent default)
+    c: str | None = None
+    factors: str = ""             # product factor layout JSON [[kind, dim], ...]
+    step: int = -1                # checkpoint step (-1 = newest committed)
+    overwrite: bool = False
+    # query / serve
+    k: int = 10
+    ids: str = ""                 # comma-separated query ids (one-shot topk)
+    u: str = ""                   # comma-separated endpoints (one-shot score)
+    v: str = ""
+    prob: bool = False            # score as Fermi–Dirac link probability
+    fd_r: float = 2.0
+    fd_t: float = 1.0
+    min_bucket: int = 8
+    max_bucket: int = 1024
+    cache_size: int = 65536
+    chunk_rows: int = 0           # 0 = auto from the tile budget
+
+
+def _ids(s: str, name: str) -> list[int]:
+    try:
+        out = [int(t) for t in s.split(",") if t.strip() != ""]
+    except ValueError:
+        raise SystemExit(f"{name}={s!r}: want comma-separated integers")
+    if not out:
+        raise SystemExit(f"{name}= is required (comma-separated ids)")
+    return out
+
+
+def _build(cfg: ServeConfig):
+    """(engine, batcher) from the committed artifact."""
+    from hyperspace_tpu.serve import (QueryEngine, RequestBatcher,
+                                      load_artifact)
+
+    if not cfg.artifact:
+        raise SystemExit("artifact= is required for query/serve modes")
+    art = load_artifact(cfg.artifact)
+    eng = QueryEngine.from_artifact(art, chunk_rows=cfg.chunk_rows)
+    return eng, RequestBatcher(eng, min_bucket=cfg.min_bucket,
+                               max_bucket=cfg.max_bucket,
+                               cache_size=cfg.cache_size)
+
+
+def run_export(cfg: ServeConfig) -> dict:
+    from hyperspace_tpu.serve import export_from_checkpoint
+
+    if not (cfg.ckpt and cfg.out):
+        raise SystemExit("export needs ckpt= and out=")
+    model_config: dict = {}
+    if cfg.workload in ("poincare", "lorentz"):
+        if cfg.c is None:
+            raise SystemExit(
+                f"export workload={cfg.workload} requires c= (the "
+                "curvature the run trained with — a wrong default would "
+                "freeze the wrong metric into the artifact)")
+        try:
+            model_config["c"] = float(cfg.c)
+        except ValueError:
+            raise SystemExit(f"c={cfg.c!r}: want a float") from None
+    elif cfg.factors:
+        try:
+            model_config["factors"] = json.loads(cfg.factors)
+        except json.JSONDecodeError as e:
+            raise SystemExit(
+                f"factors={cfg.factors!r}: want JSON [[kind, dim], ...] "
+                f"({e})") from None
+    art = export_from_checkpoint(
+        cfg.ckpt, cfg.out, workload=cfg.workload, model_config=model_config,
+        step=None if cfg.step < 0 else cfg.step, overwrite=cfg.overwrite)
+    return {"mode": "export", "out": cfg.out, "workload": cfg.workload,
+            "num_nodes": art.num_nodes, "dim": art.dim, "step": art.step,
+            "fingerprint": art.fingerprint}
+
+
+def run_query(cfg: ServeConfig) -> dict:
+    _eng, batcher = _build(cfg)
+    if cfg.u or cfg.v:
+        scores = batcher.score(_ids(cfg.u, "u"), _ids(cfg.v, "v"),
+                               prob=cfg.prob, fd_r=cfg.fd_r, fd_t=cfg.fd_t)
+        return {"mode": "query", "scores": scores.tolist()}
+    ids = _ids(cfg.ids, "ids")
+    idx, dist = batcher.topk(ids, cfg.k)
+    return {"mode": "query", "ids": ids, "k": cfg.k,
+            "neighbors": idx.tolist(), "dists": dist.tolist()}
+
+
+def _json_bool(req: dict, key: str, default: bool) -> bool:
+    """Strict JSON boolean: the string \"false\" must be an error, not
+    truthy — same reject-don't-coerce policy as the id/k validation."""
+    v = req.get(key, default)
+    if not isinstance(v, bool):
+        raise ValueError(
+            f"{key} must be a JSON boolean, got {type(v).__name__}")
+    return v
+
+
+def _handle(batcher, req: dict) -> dict:
+    op = req.get("op")
+    if op == "topk":
+        # k passes through raw: the batcher rejects non-integers rather
+        # than truncating (a float k must be a client error, not k-1)
+        idx, dist = batcher.topk(
+            req["ids"], req.get("k", 10),
+            exclude_self=_json_bool(req, "exclude_self", True))
+        return {"neighbors": idx.tolist(), "dists": dist.tolist()}
+    if op == "score":
+        scores = batcher.score(req["u"], req["v"],
+                               prob=_json_bool(req, "prob", False),
+                               fd_r=float(req.get("fd_r", 2.0)),
+                               fd_t=float(req.get("fd_t", 1.0)))
+        return {"scores": scores.tolist()}
+    if op == "stats":
+        return batcher.stats()
+    raise ValueError(f"unknown op {op!r} (want topk|score|stats)")
+
+
+def run_serve(cfg: ServeConfig, *, stdin=None, stdout=None) -> dict:
+    """The JSONL loop; returns the closing stats dict (also printed to
+    stderr when telemetry is on).  ``stdin``/``stdout`` injectable for
+    tests."""
+    stdin = sys.stdin if stdin is None else stdin
+    stdout = sys.stdout if stdout is None else stdout
+    _eng, batcher = _build(cfg)
+    served = 0
+    for line in stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+            if not isinstance(req, dict):
+                raise ValueError(
+                    f"request must be a JSON object, got {type(req).__name__}")
+            resp = _handle(batcher, req)
+            served += 1
+        except (ValueError, KeyError, TypeError, OverflowError) as e:
+            # OverflowError: numpy raises it for ints past the cast
+            # width; belt-and-braces with the batcher's own range check
+            resp = {"error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(_json_safe(resp)), file=stdout, flush=True)
+    return {"mode": "serve", "served": served, **batcher.stats()}
+
+
+MODES = {"export": run_export, "query": run_query, "serve": run_serve}
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hyperspace_tpu.cli.serve",
+        description="Export serving artifacts and answer embedding queries.")
+    ap.add_argument("mode", choices=sorted(MODES))
+    ap.add_argument("overrides", nargs="*",
+                    help="key=value overrides (ServeConfig fields)")
+    args = ap.parse_args(argv)
+
+    kv = {}
+    for p in args.overrides:
+        if "=" not in p:
+            raise SystemExit(f"expected key=value, got {p!r}")
+        k, v = p.split("=", 1)
+        kv[k] = v
+    cfg = apply_overrides(ServeConfig(), kv)
+
+    from hyperspace_tpu.telemetry import cli_session
+
+    try:
+        # stream=stderr: in serve mode stdout is the response stream
+        with cli_session(cfg.telemetry, cfg.trace_out, stream=sys.stderr):
+            result = MODES[args.mode](cfg)
+    finally:
+        if cfg.telemetry:
+            from hyperspace_tpu.telemetry import registry as telem
+
+            print(json.dumps({"telemetry_summary":
+                              telem.snapshot("ctr/")}),
+                  file=sys.stderr, flush=True)
+    # serve mode's stdout is the response stream (one line per request,
+    # strictly); its closing stats are diagnostics and go to stderr
+    print(json.dumps(_json_safe(result)),
+          file=sys.stderr if args.mode == "serve" else sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -656,47 +656,30 @@ def main(argv: list[str] | None = None) -> int:
             coordinator_address=run.coordinator,
             num_processes=run.num_processes,
             process_id=run.process_id)
-    if run.telemetry or run.trace_out:
-        # enable BEFORE the workload runs (not inside run_loop) so host
-        # graph prep / cache misses land in the spans and trace too
-        from hyperspace_tpu.telemetry import registry as telem
-        from hyperspace_tpu.telemetry import trace
+    from hyperspace_tpu.telemetry import cli_session
 
-        trace.enable(keep_events=bool(run.trace_out))
-        telem.install_jax_monitoring_hook()
-    try:
+    # enabled BEFORE the workload runs (not inside run_loop) so host
+    # graph prep / cache misses land in the spans and trace too; the
+    # trace dumps in cli_session's finally — a crash (incl. health_abort)
+    # still produces it, covering everything up to the failure point.
+    # Load the JSON at https://ui.perfetto.dev (host-level spans; the
+    # XLA-level complement is train/profiling.trace).
+    with cli_session(run.telemetry, run.trace_out):
         result = WORKLOADS[args.workload](run, wl_overrides)
-    finally:
-        # dump in finally: the trace exists to diagnose where a run went
-        # bad, so a crash (incl. health_abort) must still produce it —
-        # and it then covers everything up to the failure point.  Load
-        # the JSON at https://ui.perfetto.dev (host-level spans; the
-        # XLA-level complement is train/profiling.trace).
-        if run.trace_out:
-            from hyperspace_tpu.telemetry.trace import default_tracer
-
-            try:
-                n = default_tracer().dump_chrome_trace(run.trace_out)
-                print(f"[telemetry] {n} trace events -> {run.trace_out}",
-                      flush=True)
-            except OSError as e:
-                # diagnostics never sink the run — and never mask the
-                # training exception this finally may be unwinding
-                print(f"[telemetry] trace dump failed: {e!r}", flush=True)
-        if run.telemetry or run.trace_out:
-            from hyperspace_tpu.telemetry import trace
-
-            trace.disable()
     print(json.dumps(_json_safe(result)))
     return 0
 
 
 def _json_safe(x):
-    """Non-finite floats → null so the final line is always strict JSON
-    (loss is nan when a resumed run had nothing left to do, or when a run
-    diverged — both must still print parseably)."""
+    """Non-finite floats → null and numpy scalars → Python, so every
+    emitted line is strict JSON (loss is nan when a resumed run had
+    nothing left to do or a run diverged; a NaN table row reaches the
+    serve CLI's response stream the same way — all must print parseably).
+    Shared by the train and serve CLIs."""
     import math
 
+    if isinstance(x, np.generic):
+        x = x.item()
     if isinstance(x, float) and not math.isfinite(x):
         return None
     if isinstance(x, dict):

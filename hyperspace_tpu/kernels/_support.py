@@ -37,6 +37,17 @@ BALL_EPS_F32 = 4e-3
 ARTANH_EPS_F32 = 3e-7
 
 
+def tpu_compiler_params(**kw):
+    """``pltpu.CompilerParams`` across the jax rename: newer jax calls it
+    ``CompilerParams``, 0.4.x ``TPUCompilerParams`` — same fields either
+    way (``dimension_semantics`` etc.).  Kernels must build against
+    both, so this is the one place the name is resolved."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
 def mode() -> str:
     """Resolve the kernel implementation for the current call site."""
     m = os.environ.get("HYPERSPACE_KERNELS", "auto")

@@ -214,7 +214,7 @@ def _launch(q, k, v, c, beta_b, tau_b, maskf, mode_):
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, dp), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=S.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=S.interpret_flag(mode_),
     )(*args)
@@ -423,7 +423,7 @@ def _bwd_launch(q, k, v, c, beta_b, tau_b, maskf, dsp, lse, di, mode_):
             pltpu.VMEM((bq, dp), jnp.float32),
             pltpu.VMEM((8, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=S.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=S.interpret_flag(mode_),
     )(*args)
@@ -474,7 +474,7 @@ def _bwd_launch(q, k, v, c, beta_b, tau_b, maskf, dsp, lse, di, mode_):
             pltpu.VMEM((bk, dp), jnp.float32),
             pltpu.VMEM((bk, dp), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=S.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=S.interpret_flag(mode_),
     )(*args2)

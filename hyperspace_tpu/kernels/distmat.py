@@ -156,3 +156,27 @@ def _make_pdist(twin, body):
 
 poincare_pdist = _make_pdist(_t_poincare_pdist, _poincare_body)
 lorentz_pdist = _make_pdist(_t_lorentz_pdist, _lorentz_body)
+
+_PDIST = {"poincare": poincare_pdist, "lorentz": lorentz_pdist}
+
+
+def pdist(x, y, c, *, manifold: str):
+    """All-pairs distance matrix ``d[i, j] = dist(x[i], y[j])`` — the ONE
+    public entry point for serving/eval code.
+
+    ``x: [n, d]``, ``y: [m, d]`` (ambient coordinates: Lorentz rows carry
+    the time coordinate in lane 0), ``c`` the positive curvature
+    magnitude (scalar; may be traced), ``manifold`` one of ``"poincare"``
+    / ``"lorentz"``.  Dispatches to the fused Pallas TPU kernel on a TPU
+    backend and to the XLA twin (== the closed-form ``PoincareBall.dist``
+    / ``Lorentz.dist`` pairwise) elsewhere, per
+    ``kernels._support.mode()`` — callers never reach for the ``_t_*``
+    twins directly.  Gradients flow through the twin (custom_vjp).
+    """
+    try:
+        op = _PDIST[manifold]
+    except KeyError:
+        raise ValueError(
+            f"pdist: unknown manifold {manifold!r} "
+            f"(want one of {sorted(_PDIST)})") from None
+    return op(x, y, c)

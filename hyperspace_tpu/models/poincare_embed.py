@@ -564,13 +564,13 @@ def init_state(cfg: PoincareEmbedConfig, seed: int = 0) -> tuple[TrainState, opt
 @jax.jit
 def _rank_chunk(table: jax.Array, u_idx: jax.Array, v_idx: jax.Array, c):
     """For each pair (u, v): rank of v among all nodes by distance from u."""
-    from hyperspace_tpu.kernels.distmat import poincare_pdist
+    from hyperspace_tpu.kernels.distmat import pdist
 
     u = table[u_idx]  # [B, d]
     # fused [B, N] distance tile (kernels/distmat.py — one Gram matmul +
     # rank-1 broadcasts per tile, no [B, N, d] difference tensor); the
     # XLA twin == PoincareBall.dist pairwise, parity-tested
-    d_all = poincare_pdist(u, table, c)  # [B, N]
+    d_all = pdist(u, table, c, manifold="poincare")  # [B, N]
     d_pos = jnp.take_along_axis(d_all, v_idx[:, None], axis=1)  # [B, 1]
     # rank = #nodes strictly closer than v (excluding u itself and v)
     closer = (d_all < d_pos).astype(jnp.int32)

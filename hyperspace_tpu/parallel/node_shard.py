@@ -13,7 +13,7 @@ TPU-native analogue of the reference trainer's graph partitioning
   slice); each shard gets its own receiver-local edge list, per-edge mean
   weights, and block-CSR plan, all padded to common static shapes.
 - **Device-side aggregation** (:func:`node_sharded_aggregate`): a
-  ``jax.shard_map`` over the data-like mesh axes.  Each device all-gathers
+  ``shard_map`` over the data-like mesh axes.  Each device all-gathers
   the [N, F] activations over ICI (the one collective; at bf16 this is
   ~N·F·2 bytes, ≪ the E·F gather it feeds), then runs *its shard's*
   gather + block-CSR segment-sum — E/ndev edges and N/ndev output rows
@@ -46,6 +46,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hyperspace_tpu.data import graphs as graph_data
 from hyperspace_tpu.kernels.segment import build_csr_plan, csr_segment_sum
+from hyperspace_tpu.parallel.mesh import shard_map
 
 _BN = 128   # node-block rows (must match kernels.segment._BN tiling)
 _BK = 512   # edge-chunk size (must match kernels.segment._BK)
@@ -439,7 +440,7 @@ def _gather_aggregate(mesh, axes, n_shard, h, w, senders, recv, pb, pc, pf,
             return _local_segsum(msgs, r_l[0], pb_l[0], pc_l[0], pf_l[0],
                                  n_shard)
 
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh,
             in_specs=(spec,) * 7, out_specs=spec, check_vma=False,
         )(h, w, senders, recv, pb, pc, pf)
@@ -453,7 +454,7 @@ def _gather_aggregate(mesh, axes, n_shard, h, w, senders, recv, pb, pc, pf,
         return _local_segsum(msgs, r_l[0], pb_l[0], pc_l[0], pf_l[0],
                              n_shard)
 
-    return jax.shard_map(
+    return shard_map(
         body_halo, mesh=mesh,
         in_specs=(spec,) * 8, out_specs=spec, check_vma=False,
     )(h, w, senders, recv, pb, pc, pf, send_idx)
@@ -580,13 +581,13 @@ def node_sharded_att_aggregate(
     spec = P(axes, None)
     vec = P(axes)
     if g.halo:
-        out = jax.shard_map(
+        out = shard_map(
             body_halo, mesh=mesh,
             in_specs=(spec, vec, vec, spec, spec, spec, spec),
             out_specs=spec, check_vma=False,
         )(h, alpha_s, alpha_r, g.senders, g.recv, g.w_fwd, g.send_idx)
     else:
-        out = jax.shard_map(
+        out = shard_map(
             body, mesh=mesh,
             in_specs=(spec, vec, vec, spec, spec, spec),
             out_specs=spec, check_vma=False,

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hyperspace_tpu.manifolds import Lorentz, smath
+from hyperspace_tpu.parallel.mesh import pcast_varying, shard_map
 from hyperspace_tpu.nn.attention import minkowski_gram
 
 
@@ -75,9 +76,9 @@ def ring_lorentz_attention(
 
     # constants must be marked varying over the ring axis or the fori_loop
     # carry types mismatch under shard_map's manual-axes checking
-    m0 = jax.lax.pcast(jnp.full(q.shape[:-1], -jnp.inf, q.dtype),
-                       axis_name, to="varying")
-    l0 = jax.lax.pcast(jnp.zeros(q.shape[:-1], q.dtype), axis_name, to="varying")
+    # (pcast_varying: version-portable spelling, no-op on 0.4.x)
+    m0 = pcast_varying(jnp.full(q.shape[:-1], -jnp.inf, q.dtype), axis_name)
+    l0 = pcast_varying(jnp.zeros(q.shape[:-1], q.dtype), axis_name)
     s0 = jnp.zeros_like(q)
 
     def fold(carry, kvm):
@@ -130,7 +131,7 @@ def ring_attention_sharded(
     seq_spec = P(*((None,) * (q.ndim - 2) + (axis, None)))
 
     if k_mask is None:
-        @partial(jax.shard_map, mesh=mesh,
+        @partial(shard_map, mesh=mesh,
                  in_specs=(seq_spec, seq_spec, seq_spec), out_specs=seq_spec)
         def run(q, k, v):
             return ring_lorentz_attention(
@@ -139,7 +140,7 @@ def ring_attention_sharded(
         return run(q, k, v)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec, P(None, axis)),
         out_specs=seq_spec,

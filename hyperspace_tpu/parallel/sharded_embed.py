@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from hyperspace_tpu.parallel.mesh import shard_map
+
 
 def table_sharding(mesh: Mesh, axis: str = "model") -> NamedSharding:
     """Rows over ``axis``, features replicated."""
@@ -70,7 +72,7 @@ def sharded_gather(
     axis: str = "model",
 ) -> jax.Array:
     """``table[idx]`` over a row-sharded table; differentiable w.r.t. table."""
-    run = jax.shard_map(
+    run = shard_map(
         partial(_local_gather, n_rows=table.shape[0], axis=axis),
         mesh=mesh,
         in_specs=(P(axis, None), P()),

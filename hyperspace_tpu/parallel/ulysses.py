@@ -35,6 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from hyperspace_tpu.manifolds import Lorentz
 from hyperspace_tpu.kernels.attention import flash_attention
+from hyperspace_tpu.parallel.mesh import shard_map
 
 
 def ulysses_lorentz_attention(
@@ -95,7 +96,7 @@ def ulysses_attention_sharded(
     spec = P(None, None, axis, None)
 
     if k_mask is None:
-        @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                  out_specs=spec)
         def run(q, k, v):
             return ulysses_lorentz_attention(q, k, v, manifold, axis,
@@ -103,7 +104,7 @@ def ulysses_attention_sharded(
 
         return run(q, k, v)
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(spec, spec, spec, P(None, axis)), out_specs=spec)
     def run(q, k, v, mk):
         return ulysses_lorentz_attention(q, k, v, manifold, axis,

@@ -1,0 +1,27 @@
+"""Query-serving subsystem: checkpoint → artifact → batched inference.
+
+The path from a trained Poincaré/Lorentz/product embedding run to
+answering retrieval queries (docs/serving.md):
+
+  artifact.py  frozen params-only serving artifacts (atomic export from
+               a CheckpointManager directory, commit marker, content
+               fingerprint)
+  engine.py    jitted batched k-NN + edge scoring over the frozen table
+               (fused distmat kernels, chunked table walk, compiles
+               keyed on (bucket, k))
+  batcher.py   request micro-batcher: power-of-two bucket padding + LRU
+               result cache, serve/* telemetry counters
+  cli/serve.py the `export` / `query` / `serve` entry points
+"""
+
+from hyperspace_tpu.serve.artifact import (  # noqa: F401
+    ServingArtifact,
+    export_artifact,
+    export_from_checkpoint,
+    is_committed,
+    load_artifact,
+    manifold_from_spec,
+    spec_from_manifold,
+)
+from hyperspace_tpu.serve.batcher import RequestBatcher  # noqa: F401
+from hyperspace_tpu.serve.engine import QueryEngine  # noqa: F401

@@ -1,0 +1,367 @@
+"""Frozen, params-only serving artifacts (docs/serving.md).
+
+A training checkpoint is the wrong thing to serve from: it carries
+optimizer moments (2-3x the bytes of the params at embedding scale),
+its layout is the train-state pytree (restore needs the model/optimizer
+objects that built it), and orbax's directory format is a tree of
+tensorstore shards.  A *serving artifact* is the frozen inference view:
+
+- ``table.npy``   — the [N, D] embedding table, bit-exact (``np.save``);
+- ``artifact.json`` — manifold spec (kind + curvature(s), per-factor for
+  products), the model config as exported, table shape/dtype, a content
+  fingerprint, and the source checkpoint step;
+- ``COMMITTED``   — the commit marker, WRITTEN LAST.
+
+Writes are atomic the same way checkpoints are: everything lands in a
+staging directory (``.<name>.tmp.<pid>`` under the same parent), the
+marker goes in last, and one ``os.rename`` commits.  A crash mid-export
+leaves either a marker-less staging dir (ignored by :func:`load_artifact`
+/ :func:`is_committed`) or nothing at the final name — never a
+half-written artifact that loads.
+
+The **fingerprint** (sha256 over the table bytes + shape + dtype + the
+canonical manifold-spec JSON) names the content, not the path: it keys
+the request batcher's result cache (``serve/batcher.py``), and the
+round-trip lint (``scripts/check_serve_artifact.py``) uses it to assert
+export → load is the identity.
+
+Manifold specs are canonical nested tuples — hashable, so the query
+engine can hang them on ``jax.jit`` static arguments:
+
+    ("poincare", 1.0)
+    ("lorentz", 0.8)
+    ("product", (("poincare", 5, 1.3), ("sphere", 5, 0.9),
+                 ("euclidean", 2, 0.0)))
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+from typing import Any, Optional
+
+import numpy as np
+
+ARTIFACT_VERSION = 1
+COMMIT_MARKER = "COMMITTED"
+META_FILE = "artifact.json"
+TABLE_FILE = "table.npy"
+
+
+# --- manifold specs -----------------------------------------------------------
+
+
+def spec_from_manifold(m) -> tuple:
+    """Canonical spec tuple for a manifold instance (curvatures are read
+    as concrete floats — specs describe FROZEN geometry, so a traced
+    curvature must be materialized by the exporter first)."""
+    from hyperspace_tpu.manifolds import (Euclidean, Lorentz, PoincareBall,
+                                          Product, Sphere)
+
+    if isinstance(m, Product):
+        def fspec(f, d):
+            kind, c = spec_from_manifold(f)
+            return (kind, int(d), c)
+
+        return ("product", tuple(
+            fspec(f, d) for f, d in zip(m.factors, m.dims)))
+    if isinstance(m, PoincareBall):
+        return ("poincare", float(m.c))
+    if isinstance(m, Lorentz):
+        return ("lorentz", float(m.c))
+    if isinstance(m, Sphere):
+        return ("sphere", float(m.c))
+    if isinstance(m, Euclidean):
+        return ("euclidean", 0.0)
+    raise ValueError(f"no serving spec for manifold {type(m).__name__}")
+
+
+def manifold_from_spec(spec: tuple):
+    """Build the manifold a spec names (inverse of
+    :func:`spec_from_manifold`; jit-safe — curvatures are floats)."""
+    from hyperspace_tpu.manifolds import (Euclidean, Lorentz, PoincareBall,
+                                          Product, Sphere)
+
+    kinds = {"poincare": PoincareBall, "lorentz": Lorentz, "sphere": Sphere}
+    kind = spec[0]
+    if kind == "product":
+        factors, dims = [], []
+        for fkind, dim, c in spec[1]:
+            factors.append(Euclidean() if fkind == "euclidean"
+                           else kinds[fkind](float(c)))
+            dims.append(int(dim))
+        return Product(factors, dims)
+    if kind == "euclidean":
+        return Euclidean()
+    if kind in kinds:
+        return kinds[kind](float(spec[1]))
+    raise ValueError(f"unknown manifold spec kind {kind!r}")
+
+
+def spec_to_json(spec: tuple) -> dict:
+    kind = spec[0]
+    if kind == "product":
+        return {"kind": "product", "factors": [
+            {"kind": fk, "dim": int(d), "c": float(c)}
+            for fk, d, c in spec[1]]}
+    return {"kind": kind, "c": float(spec[1])}
+
+
+def spec_from_json(doc: dict) -> tuple:
+    kind = doc["kind"]
+    if kind == "product":
+        return ("product", tuple(
+            (f["kind"], int(f["dim"]), float(f.get("c", 0.0)))
+            for f in doc["factors"]))
+    return (kind, float(doc.get("c", 0.0)))
+
+
+def spec_dim(spec: tuple) -> int:
+    """Ambient (storage) width the spec expects of a table row."""
+    if spec[0] == "product":
+        return sum(int(d) for _k, d, _c in spec[1])
+    return -1  # unconstrained for single-manifold specs
+
+
+# --- fingerprint --------------------------------------------------------------
+
+
+def fingerprint_of(table: np.ndarray, spec: tuple) -> str:
+    """Content identity: sha256 over the table bytes, its shape/dtype,
+    and the canonical spec JSON.  Same table + geometry → same
+    fingerprint, wherever the artifact lives on disk."""
+    table = np.ascontiguousarray(table)
+    h = hashlib.sha256()
+    h.update(json.dumps({"spec": spec_to_json(spec),
+                         "shape": list(table.shape),
+                         "dtype": str(table.dtype)},
+                        sort_keys=True).encode())
+    h.update(table.tobytes())
+    return h.hexdigest()
+
+
+# --- the artifact -------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingArtifact:
+    """A loaded (or about-to-be-written) serving artifact."""
+
+    table: np.ndarray           # [N, D] host array, bit-exact
+    manifold_spec: tuple        # canonical spec tuple (hashable)
+    model_config: dict          # exported model config (JSON-safe)
+    fingerprint: str
+    step: Optional[int] = None  # source checkpoint step, if any
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.table.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return int(self.table.shape[1])
+
+    def manifold(self):
+        return manifold_from_spec(self.manifold_spec)
+
+
+def _make_artifact(table, spec, model_config, step) -> ServingArtifact:
+    table = np.ascontiguousarray(np.asarray(table))
+    if table.ndim != 2:
+        raise ValueError(f"serving table must be [N, D]; got {table.shape}")
+    want = spec_dim(spec)
+    if want >= 0 and table.shape[1] != want:
+        raise ValueError(
+            f"table width {table.shape[1]} != product spec width {want}")
+    return ServingArtifact(
+        table=table, manifold_spec=spec,
+        model_config=dict(model_config or {}),
+        fingerprint=fingerprint_of(table, spec),
+        step=None if step is None else int(step))
+
+
+def export_artifact(directory: str, table, manifold_spec: tuple, *,
+                    model_config: Optional[dict] = None,
+                    step: Optional[int] = None,
+                    overwrite: bool = False) -> ServingArtifact:
+    """Write a serving artifact atomically; returns the artifact written.
+
+    Staging dir + marker-last + one ``os.rename`` (module docstring).
+    An existing COMMITTED artifact at ``directory`` is an error unless
+    ``overwrite=True`` (then it is replaced; the replace itself is
+    rename-then-delete, so a reader holding the old dir open keeps a
+    consistent view).
+    """
+    art = _make_artifact(table, manifold_spec, model_config, step)
+    directory = os.path.abspath(directory)
+    parent = os.path.dirname(directory)
+    os.makedirs(parent, exist_ok=True)
+    if os.path.exists(directory):
+        if not overwrite:
+            raise FileExistsError(
+                f"serving artifact already exists at {directory} "
+                "(pass overwrite=True to replace)")
+    staging = os.path.join(
+        parent, f".{os.path.basename(directory)}.tmp.{os.getpid()}")
+    if os.path.exists(staging):
+        shutil.rmtree(staging)
+    os.makedirs(staging)
+    try:
+        np.save(os.path.join(staging, TABLE_FILE), art.table)
+        meta = {
+            "version": ARTIFACT_VERSION,
+            "manifold": spec_to_json(art.manifold_spec),
+            "model_config": art.model_config,
+            "table": {"shape": list(art.table.shape),
+                      "dtype": str(art.table.dtype)},
+            "fingerprint": art.fingerprint,
+            "step": art.step,
+        }
+        with open(os.path.join(staging, META_FILE), "w") as f:
+            json.dump(meta, f, indent=2, sort_keys=True)
+        # marker LAST: everything before it is on disk when it appears
+        with open(os.path.join(staging, COMMIT_MARKER), "w") as f:
+            f.write(art.fingerprint + "\n")
+        if os.path.exists(directory):  # overwrite=True path
+            old = directory + f".old.{os.getpid()}"
+            if os.path.exists(old):  # pid reuse after a prior crash
+                shutil.rmtree(old)
+            os.rename(directory, old)
+            try:
+                os.rename(staging, directory)
+            except BaseException:
+                # an interrupt between the renames must not strand the
+                # target empty: put the prior committed artifact back
+                os.rename(old, directory)
+                raise
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(staging, directory)
+    except BaseException:
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
+    return art
+
+
+def is_committed(directory: str) -> bool:
+    """Whether ``directory`` holds a committed serving artifact."""
+    return (os.path.isfile(os.path.join(directory, COMMIT_MARKER))
+            and os.path.isfile(os.path.join(directory, META_FILE))
+            and os.path.isfile(os.path.join(directory, TABLE_FILE)))
+
+
+def load_artifact(directory: str) -> ServingArtifact:
+    """Load a committed artifact; verifies the content fingerprint.
+
+    Raises ``FileNotFoundError`` for a missing/uncommitted directory and
+    ``ValueError`` for a fingerprint mismatch (bit rot, or files swapped
+    under the marker) — a serving process must never come up on a table
+    that is not the one the exporter hashed.
+    """
+    directory = os.path.abspath(directory)
+    if not is_committed(directory):
+        raise FileNotFoundError(
+            f"no committed serving artifact at {directory}")
+    with open(os.path.join(directory, META_FILE)) as f:
+        meta = json.load(f)
+    if int(meta.get("version", -1)) != ARTIFACT_VERSION:
+        raise ValueError(
+            f"artifact version {meta.get('version')!r} != "
+            f"{ARTIFACT_VERSION} at {directory}")
+    table = np.load(os.path.join(directory, TABLE_FILE))
+    spec = spec_from_json(meta["manifold"])
+    fp = fingerprint_of(table, spec)
+    if fp != meta["fingerprint"]:
+        raise ValueError(
+            f"artifact fingerprint mismatch at {directory}: "
+            f"meta says {meta['fingerprint'][:12]}…, content is {fp[:12]}…")
+    return ServingArtifact(
+        table=table, manifold_spec=spec,
+        model_config=meta.get("model_config") or {},
+        fingerprint=fp, step=meta.get("step"))
+
+
+# --- checkpoint → artifact ----------------------------------------------------
+
+
+def export_from_checkpoint(ckpt_dir: str, out_dir: str, *,
+                           workload: str,
+                           model_config: Optional[dict] = None,
+                           step: Optional[int] = None,
+                           overwrite: bool = False) -> ServingArtifact:
+    """Export the newest committed checkpoint step as a serving artifact.
+
+    Restores the raw state pytree via
+    :func:`hyperspace_tpu.train.checkpoint.restore_params_only` (no
+    optimizer/model objects) and extracts the embedding table + frozen
+    geometry per workload:
+
+    - ``poincare``: ``tree["table"]`` on ``PoincareBall(c)`` —
+      ``model_config["c"]`` is REQUIRED (the trained curvature is not
+      in the checkpoint; there is deliberately no silent default);
+    - ``lorentz``: ``tree["table"]`` on ``Lorentz(c)`` (same required
+      config key) — for Lorentz-stored embedding tables;
+    - ``product``: ``tree["params"]["table"]`` +
+      ``tree["params"]["c_raw"]``; factor layout from
+      ``model_config["factors"]`` ([(kind, dim), ...] —
+      ``ProductEmbedConfig.factors``; defaults to that config's default)
+      with the LEARNED curvatures ``softplus(c_raw)`` frozen into the
+      spec.
+
+    (HGCN/HyboNet/HVAE checkpoints hold deep parameter trees, not one
+    retrieval table — out of scope for the embedding query engine.)
+    """
+    from hyperspace_tpu.train.checkpoint import restore_params_only
+
+    tree, ck_step = restore_params_only(ckpt_dir, step=step)
+    cfg = dict(model_config or {})
+    if workload in ("poincare", "lorentz"):
+        if "c" not in cfg:
+            # the trained curvature lives only in the (un-checkpointed)
+            # model config — a silent 1.0 default would freeze the WRONG
+            # metric into a committed, fingerprint-valid artifact
+            raise ValueError(
+                f"{workload} export requires model_config['c'] (the "
+                "curvature the run trained with; it is not recoverable "
+                "from the checkpoint state)")
+        spec = (workload, float(cfg["c"]))
+        table = np.asarray(tree["table"])
+    elif workload == "product":
+        factors = cfg.get("factors")
+        if factors is None:
+            from hyperspace_tpu.models.product_embed import ProductEmbedConfig
+
+            factors = list(ProductEmbedConfig.factors)
+        import jax.numpy as jnp
+        from jax.nn import softplus
+
+        # the SAME softplus the live model applies (product_embed.
+        # build_manifold), in c_raw's own stored dtype — not upcast, so
+        # the frozen curvature is bit-wise the one the run trained under
+        curv = np.asarray(softplus(jnp.asarray(
+            np.asarray(tree["params"]["c_raw"]))))
+        factors = [tuple(f) for f in factors]
+        want = sum(1 for kind, _d in factors if kind != "euclidean")
+        if want != curv.shape[0]:  # check BEFORE indexing curv
+            raise ValueError(
+                f"factor layout {factors} expects {want} learned "
+                f"curvatures; checkpoint has {curv.shape[0]}")
+        fspec, i = [], 0
+        for kind, dim in factors:
+            if kind == "euclidean":
+                fspec.append(("euclidean", int(dim), 0.0))
+            else:
+                fspec.append((kind, int(dim), float(curv[i])))
+                i += 1
+        spec = ("product", tuple(fspec))
+        table = np.asarray(tree["params"]["table"])
+        cfg["factors"] = [list(f) for f in factors]
+    else:
+        raise ValueError(
+            f"export_from_checkpoint: unknown workload {workload!r} "
+            "(want poincare|lorentz|product)")
+    return export_artifact(out_dir, table, spec, model_config=cfg,
+                           step=ck_step, overwrite=overwrite)

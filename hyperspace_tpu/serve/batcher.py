@@ -1,0 +1,240 @@
+"""Request micro-batcher: bucket padding + per-query LRU result cache.
+
+The engine compiles one XLA program per (batch size, k); letting raw
+request sizes reach it would compile per request — the classic serving
+failure where p99 latency is the compiler.  The batcher stands between
+requests and the engine:
+
+- **Bucketing.**  Query batches are padded (by repeating the last id —
+  always a valid row) up to the smallest power-of-two bucket that fits,
+  from ``min_bucket`` to ``max_bucket``; bigger requests are split into
+  ``max_bucket`` slabs.  The engine therefore ever sees only
+  ``log2(max/min)+1`` distinct batch shapes: compiles happen once per
+  (bucket, k) at warmup and never again (``jax/recompiles`` is the
+  regression alarm).  Padded slots are real-but-discarded work, counted
+  in ``serve/padded_waste`` so an overly sparse bucket ladder shows up
+  in telemetry rather than in a latency mystery.
+- **Result cache.**  An LRU keyed ``(artifact fingerprint, query id,
+  k)`` holding per-query top-k rows.  The fingerprint key means a
+  reloaded (different) artifact can never serve another table's cached
+  neighbors; per-ID granularity means a request mixing hot and cold ids
+  only computes the cold ones.  ``serve/cache_hit`` / ``serve/cache_miss``
+  count per id; edge scoring is uncached (pairs rarely repeat; the
+  distance gather is already one cheap dispatch).
+
+Every public entry wraps itself in a ``query`` trace span and bumps
+``serve/requests`` — with telemetry enabled (docs/observability.md) a
+serving process's JSONL/trace shows the same spans and counters a
+training run's does.
+
+Thread-safety: the LRU is lock-guarded; engine dispatches are jax-level
+thread-safe.  One batcher serves one engine (one artifact).
+"""
+
+from __future__ import annotations
+
+import collections
+import operator
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from hyperspace_tpu.serve.engine import QueryEngine
+from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry.trace import span
+
+DEFAULT_MIN_BUCKET = 8
+DEFAULT_MAX_BUCKET = 1024
+DEFAULT_CACHE_SIZE = 65536
+
+
+def bucket_sizes(min_bucket: int = DEFAULT_MIN_BUCKET,
+                 max_bucket: int = DEFAULT_MAX_BUCKET) -> tuple:
+    """The power-of-two bucket ladder, smallest to largest."""
+    if min_bucket < 1 or max_bucket < min_bucket:
+        raise ValueError(f"bad bucket range [{min_bucket}, {max_bucket}]")
+    out, b = [], 1
+    while b < min_bucket:
+        b *= 2
+    while b < max_bucket:
+        out.append(b)
+        b *= 2
+    out.append(max_bucket)
+    return tuple(out)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (callers split requests bigger than the top
+    bucket into top-bucket slabs first)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def _checked_ids(ids, name: str, num_nodes: int) -> list[int]:
+    """Validate a request's id list on host BEFORE any dtype cast.
+
+    Every id must be integral — a float like 1.9 must fail, never
+    silently truncate to another node's answer — and in
+    [0, num_nodes), so a huge int can never wrap through the int32
+    device cast into a valid-looking id.  Raises ValueError (the serve
+    loop's per-line error path)."""
+    if isinstance(ids, np.ndarray):
+        ids = ids.reshape(-1).tolist()
+    elif np.isscalar(ids):
+        raise ValueError(f"{name} must be a list of ids")
+    if not len(ids):
+        raise ValueError(f"{name} must be a non-empty id list")
+    out = []
+    for i in ids:
+        if isinstance(i, bool):  # bools index-coerce to 0/1 — reject
+            raise ValueError(f"{name} must be integer ids; got bool")
+        try:
+            i = operator.index(i)
+        except TypeError:
+            raise ValueError(
+                f"{name} must be integer ids; got "
+                f"{type(i).__name__}") from None
+        if not 0 <= i < num_nodes:
+            raise ValueError(f"{name} id {i} out of range [0, {num_nodes})")
+        out.append(i)
+    return out
+
+
+class _LRU:
+    """Tiny lock-guarded LRU: (fingerprint, qid, k) -> (idx row, dist row)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._d: collections.OrderedDict = collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            try:
+                self._d.move_to_end(key)
+                return self._d[key]
+            except KeyError:
+                return None
+
+    def put(self, key, value) -> None:
+        if self.capacity <= 0:
+            return
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.capacity:
+                self._d.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+
+class RequestBatcher:
+    """Pads requests onto the bucket ladder and fronts the LRU cache."""
+
+    def __init__(self, engine: QueryEngine, *,
+                 min_bucket: int = DEFAULT_MIN_BUCKET,
+                 max_bucket: int = DEFAULT_MAX_BUCKET,
+                 cache_size: int = DEFAULT_CACHE_SIZE):
+        self.engine = engine
+        self.buckets = bucket_sizes(min_bucket, max_bucket)
+        self.cache = _LRU(cache_size)
+
+    # --- top-k ----------------------------------------------------------------
+
+    def topk(self, ids, k: int, *, exclude_self: bool = True
+             ) -> tuple[np.ndarray, np.ndarray]:
+        """``(neighbors [B, k] int32, dists [B, k] float)`` in request
+        order; cache-aware, bucket-padded."""
+        with span("query"):
+            telem.inc("serve/requests")
+            ids = _checked_ids(ids, "ids", self.engine.num_nodes)
+            if isinstance(k, bool):  # True would index-coerce to k=1
+                raise ValueError("k must be an integer; got bool")
+            try:  # same reject-don't-truncate policy as the ids
+                k = operator.index(k)
+            except TypeError:
+                raise ValueError(
+                    f"k must be an integer; got {type(k).__name__}"
+                ) from None
+            fp = self.engine.fingerprint
+            # cache keys carry exclude_self too: the same (fp, id, k) has
+            # two distinct answers depending on the flag
+            keyf = lambda qid: (fp, qid, k, exclude_self)
+            rows: dict[int, tuple] = {}
+            misses = []
+            # hit/miss are per UNIQUE id: a duplicate within the request
+            # is one compute (and one counter event), hot or cold
+            for qid in dict.fromkeys(ids):
+                hit = self.cache.get(keyf(qid))
+                if hit is not None:
+                    rows[qid] = hit
+                else:
+                    misses.append(qid)
+            telem.inc("serve/cache_hit", len(rows))
+            telem.inc("serve/cache_miss", len(misses))
+            for s in range(0, len(misses), self.buckets[-1]):
+                slab = misses[s : s + self.buckets[-1]]
+                b = bucket_for(len(slab), self.buckets)
+                telem.inc("serve/padded_waste", b - len(slab))
+                padded = slab + [slab[-1]] * (b - len(slab))
+                idx, dist = self.engine.topk_neighbors(
+                    np.asarray(padded, np.int32), k,
+                    exclude_self=exclude_self)
+                idx = np.asarray(idx)
+                dist = np.asarray(dist)
+                for j, qid in enumerate(slab):
+                    val = (idx[j].copy(), dist[j].copy())
+                    rows[qid] = val
+                    self.cache.put(keyf(qid), val)
+            out_i = np.stack([rows[qid][0] for qid in ids])
+            out_d = np.stack([rows[qid][1] for qid in ids])
+            return out_i, out_d
+
+    # --- edge scores ----------------------------------------------------------
+
+    def score(self, u_ids, v_ids, *, prob: bool = False,
+              fd_r: float = 2.0, fd_t: float = 1.0) -> np.ndarray:
+        """Bucket-padded ``engine.score_edges`` ([B] in request order)."""
+        with span("query"):
+            telem.inc("serve/requests")
+            n = self.engine.num_nodes
+            u = np.asarray(_checked_ids(u_ids, "u", n), np.int64)
+            v = np.asarray(_checked_ids(v_ids, "v", n), np.int64)
+            if u.shape != v.shape:
+                raise ValueError(
+                    f"score: need matching id lists; got "
+                    f"{u.shape} vs {v.shape}")
+            out = np.empty((u.size,), np.float64)
+            top = self.buckets[-1]
+            for s in range(0, u.size, top):
+                su, sv = u[s : s + top], v[s : s + top]
+                b = bucket_for(su.size, self.buckets)
+                telem.inc("serve/padded_waste", b - su.size)
+                pu = np.concatenate([su, np.full(b - su.size, su[-1])])
+                pv = np.concatenate([sv, np.full(b - sv.size, sv[-1])])
+                d = self.engine.score_edges(
+                    pu.astype(np.int32), pv.astype(np.int32),
+                    prob=prob, fd_r=fd_r, fd_t=fd_t)
+                out[s : s + su.size] = np.asarray(d)[: su.size]
+            return out
+
+    # --- introspection --------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Current serve counters + cache occupancy (the `stats` op of
+        the CLI loop)."""
+        reg = telem.default_registry()
+        return {
+            "requests": reg.get("serve/requests"),
+            "cache_hit": reg.get("serve/cache_hit"),
+            "cache_miss": reg.get("serve/cache_miss"),
+            "padded_waste": reg.get("serve/padded_waste"),
+            "cache_entries": len(self.cache),
+            "buckets": list(self.buckets),
+            "fingerprint": self.engine.fingerprint,
+        }

@@ -1,0 +1,217 @@
+"""Jitted batched query engine over a frozen embedding table.
+
+The inference workloads of the paper's retrieval models are two device
+programs over an [N, D] table of manifold points:
+
+- ``topk_neighbors(q_idx, k)`` — the k nearest table rows to each query
+  row under the hyperbolic metric (Poincaré-embedding retrieval à la
+  Nickel & Kiela 2017);
+- ``score_edges(u_idx, v_idx)`` — per-pair distances (optionally pushed
+  through the Fermi–Dirac link decoder) for edge scoring à la the HGCN
+  LP head (Chami et al. 2019).
+
+Mechanics:
+
+- **Distance tiles come from the fused kernels.**  Poincaré/Lorentz
+  tiles go through :func:`hyperspace_tpu.kernels.distmat.pdist` — the
+  Pallas TPU kernel on a TPU backend, the XLA twin on CPU — so a [B, M]
+  tile never materializes a [B, M, D] difference tensor.  Product
+  manifolds use ``Product.dist`` broadcast per tile (exactly the trained
+  geometry, learned curvatures frozen into the spec).
+- **The table is chunked.**  The k-NN scan walks the table
+  ``chunk_rows`` rows at a time, carrying a running top-k, so the live
+  distance working set is one [B, chunk] tile (plus [B, chunk, D] on
+  the product path) regardless of N — ``tile_budget`` picks the chunk.
+  The table is zero-padded ONCE at engine build to a chunk multiple;
+  padded rows are masked to +inf distance by index, so they can never
+  appear in a result.
+- **Compiles are keyed on (bucket, k), never on request.**  The jitted
+  programs hang everything shape-like on static arguments (batch size,
+  k, chunk, N, the manifold spec tuple); the request batcher
+  (``serve/batcher.py``) pads incoming batches to a small set of
+  power-of-two buckets, so the engine compiles once per (bucket, k) and
+  then serves any request size out of the same executable —
+  ``jax/recompiles`` stays flat (the e2e test asserts it).
+
+Determinism: for a fixed (bucket, k, chunk) the program is one fixed
+XLA executable — the same table bytes give bitwise-identical results,
+which is what lets ``scripts/check_serve_artifact.py`` demand
+export → load → query equals the live model bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hyperspace_tpu.serve.artifact import (ServingArtifact, fingerprint_of,
+                                           manifold_from_spec)
+
+# f32 bytes a distance tile may occupy ([B, chunk] on the kernel path,
+# [B, chunk, D] on the product path), per the nominal batch below.
+DEFAULT_TILE_BUDGET = 8 * 1024 * 1024
+# chunk sizing assumes batches up to this (the batcher's default
+# max_bucket); bigger batches just run a proportionally bigger tile.
+NOMINAL_BATCH = 1024
+_ROW_ALIGN = 128
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def auto_chunk_rows(dim: int, spec_kind: str, n: int,
+                    tile_budget: int = DEFAULT_TILE_BUDGET) -> int:
+    """Table-chunk rows that keep one distance tile under the budget."""
+    per_row = 4 * NOMINAL_BATCH * (dim if spec_kind == "product" else 1)
+    chunk = max(_ROW_ALIGN, (tile_budget // per_row) // _ROW_ALIGN * _ROW_ALIGN)
+    return min(chunk, _round_up(max(n, 1), _ROW_ALIGN))
+
+
+def _tile_dist(spec: tuple, q: jax.Array, rows: jax.Array) -> jax.Array:
+    """[B, D] × [M, D] → [B, M] distances under the spec's manifold."""
+    kind = spec[0]
+    if kind in ("poincare", "lorentz"):
+        from hyperspace_tpu.kernels.distmat import pdist
+
+        return pdist(q, rows, spec[1], manifold=kind)
+    m = manifold_from_spec(spec)
+    return m.dist(q[:, None, :], rows[None, :, :])
+
+
+@partial(jax.jit, static_argnames=("spec", "k", "chunk", "n", "exclude_self"))
+def _topk_chunked(table: jax.Array, q_idx: jax.Array, *, spec: tuple,
+                  k: int, chunk: int, n: int, exclude_self: bool):
+    """Running top-k over table chunks; one fixed program per
+    (batch, k, chunk, n, spec)."""
+    q = table[q_idx]  # [B, D]
+    b = q_idx.shape[0]
+    nchunks = table.shape[0] // chunk
+
+    def body(carry, i):
+        best_d, best_i = carry
+        rows = jax.lax.dynamic_slice_in_dim(table, i * chunk, chunk)
+        d = _tile_dist(spec, q, rows)                     # [B, chunk]
+        # pin int32: under x64 the traced chunk offset would promote the
+        # carried index dtype and break the scan carry contract
+        cols = (i * chunk + jnp.arange(chunk)).astype(jnp.int32)
+        mask = cols[None, :] >= n                         # zero-padded rows
+        if exclude_self:
+            mask = mask | (cols[None, :] == q_idx[:, None])
+        d = jnp.where(mask, jnp.inf, d)
+        cat_d = jnp.concatenate([best_d, d], axis=1)
+        cat_i = jnp.concatenate(
+            [best_i, jnp.broadcast_to(cols, d.shape)], axis=1)
+        top_negd, sel = jax.lax.top_k(-cat_d, k)
+        return (-top_negd, jnp.take_along_axis(cat_i, sel, axis=1)), None
+
+    init = (jnp.full((b, k), jnp.inf, table.dtype),
+            jnp.full((b, k), -1, jnp.int32))
+    (dist, idx), _ = jax.lax.scan(body, init, jnp.arange(nchunks))
+    return idx, dist
+
+
+@partial(jax.jit, static_argnames=("spec", "prob"))
+def _edge_dist(table: jax.Array, u_idx: jax.Array, v_idx: jax.Array,
+               fd_r, fd_t, *, spec: tuple, prob: bool) -> jax.Array:
+    m = manifold_from_spec(spec)
+    d = m.dist(table[u_idx], table[v_idx])
+    if prob:
+        # Fermi–Dirac decoder INSIDE the jitted program: one dispatch
+        # per scoring request, not one per arithmetic op (fd_r/fd_t are
+        # traced scalars — changing them never recompiles)
+        d = 1.0 / (jnp.exp((jnp.square(d) - fd_r) / fd_t) + 1.0)
+    return d
+
+
+class QueryEngine:
+    """Batched k-NN / edge-score queries over one frozen table.
+
+    ``table`` is moved to device once (zero-padded to a chunk multiple);
+    every query after that is a single jitted dispatch.  Construct via
+    :meth:`from_artifact` for the serving path, or directly on a live
+    table (tests, the round-trip lint).
+    """
+
+    def __init__(self, table, manifold_spec: tuple, *,
+                 fingerprint: Optional[str] = None,
+                 chunk_rows: int = 0,
+                 tile_budget: int = DEFAULT_TILE_BUDGET):
+        table = np.ascontiguousarray(np.asarray(table))
+        if table.ndim != 2:
+            raise ValueError(f"table must be [N, D]; got {table.shape}")
+        self.num_nodes, self.dim = (int(s) for s in table.shape)
+        self.spec = tuple(manifold_spec)
+        self.fingerprint = fingerprint or fingerprint_of(table, self.spec)
+        chunk_rows = int(chunk_rows)
+        if chunk_rows < 0:
+            # a negative chunk would make the scan run ZERO chunks and
+            # silently answer every query with -1/inf
+            raise ValueError(f"chunk_rows must be >= 0 (0 = auto); "
+                             f"got {chunk_rows}")
+        self.chunk_rows = chunk_rows or auto_chunk_rows(
+            self.dim, self.spec[0], self.num_nodes, tile_budget)
+        padded = _round_up(self.num_nodes, self.chunk_rows)
+        if padded > self.num_nodes:
+            table = np.concatenate(
+                [table, np.zeros((padded - self.num_nodes, self.dim),
+                                 table.dtype)], axis=0)
+        self.table = jnp.asarray(table)  # [padded, D] device-resident
+
+    @classmethod
+    def from_artifact(cls, art: ServingArtifact, **kw) -> "QueryEngine":
+        return cls(art.table, art.manifold_spec,
+                   fingerprint=art.fingerprint, **kw)
+
+    # --- queries --------------------------------------------------------------
+
+    def topk_neighbors(self, q_idx, k: int, *, exclude_self: bool = True):
+        """``(neighbors [B, k] int32, dists [B, k])`` for query row ids.
+
+        Results are sorted ascending by distance.  ``k`` must leave room
+        in the table (``k <= N - exclude_self``); ids are validated on
+        host — a bad id must fail the request, not gather a clipped row.
+        """
+        q_idx = self._check_ids(q_idx, "q_idx")
+        k = int(k)
+        limit = self.num_nodes - (1 if exclude_self else 0)
+        if not 1 <= k <= limit:
+            raise ValueError(
+                f"k={k} out of range [1, {limit}] for a {self.num_nodes}-row "
+                f"table (exclude_self={exclude_self})")
+        idx, dist = _topk_chunked(
+            self.table, q_idx, spec=self.spec, k=k, chunk=self.chunk_rows,
+            n=self.num_nodes, exclude_self=exclude_self)
+        return idx, dist
+
+    def score_edges(self, u_idx, v_idx, *, prob: bool = False,
+                    fd_r: float = 2.0, fd_t: float = 1.0):
+        """Per-pair manifold distances ``d(table[u], table[v])`` ([B]).
+
+        ``prob=True`` maps distances through the Fermi–Dirac link
+        decoder ``1 / (exp((d² − r)/t) + 1)`` (the HGCN LP head's form)
+        — monotone decreasing in distance, so rankings agree.
+        """
+        u_idx = self._check_ids(u_idx, "u_idx")
+        v_idx = self._check_ids(v_idx, "v_idx")
+        if u_idx.shape != v_idx.shape:
+            raise ValueError(
+                f"u_idx {u_idx.shape} and v_idx {v_idx.shape} must match")
+        return _edge_dist(self.table, u_idx, v_idx, fd_r, fd_t,
+                          spec=self.spec, prob=bool(prob))
+
+    def _check_ids(self, ids, name: str) -> jax.Array:
+        arr = np.asarray(ids)
+        if arr.ndim != 1 or arr.size == 0:
+            raise ValueError(f"{name} must be a non-empty 1-D id array")
+        if not np.issubdtype(arr.dtype, np.integer):
+            raise ValueError(f"{name} must be integer ids; got {arr.dtype}")
+        if arr.min() < 0 or arr.max() >= self.num_nodes:
+            raise ValueError(
+                f"{name} out of range [0, {self.num_nodes}): "
+                f"min={arr.min()}, max={arr.max()}")
+        return jnp.asarray(arr, jnp.int32)

@@ -20,11 +20,49 @@ JSONL of losses.  This package adds, with zero per-step host sync and
 Catalog + reading guide: docs/observability.md.
 """
 
+import contextlib
+
 from hyperspace_tpu.telemetry.health import (  # noqa: F401
     HealthMonitor,
     health_stats,
     make_health_fn,
 )
+
+
+@contextlib.contextmanager
+def cli_session(telemetry: bool, trace_out, *, stream=None):
+    """The CLI entry points' shared telemetry bracket (train and serve).
+
+    Enables span recording + the jax recompile hook up front (BEFORE the
+    workload, so host prep lands in the trace), and in a ``finally``
+    dumps the Chrome trace — a crashed run must still produce its trace,
+    and an OSError from the dump must never mask the exception this
+    block may be unwinding — then disables recording.  ``stream`` is
+    where the dump notices print (train: stdout, serve: stderr — serve's
+    stdout is a strict response stream)."""
+    if telemetry or trace_out:
+        from hyperspace_tpu.telemetry import registry as _registry
+        from hyperspace_tpu.telemetry import trace as _trace
+
+        _trace.enable(keep_events=bool(trace_out))
+        _registry.install_jax_monitoring_hook()
+    try:
+        yield
+    finally:
+        if trace_out:
+            from hyperspace_tpu.telemetry.trace import default_tracer
+
+            try:
+                n = default_tracer().dump_chrome_trace(trace_out)
+                print(f"[telemetry] {n} trace events -> {trace_out}",
+                      file=stream, flush=True)
+            except OSError as e:
+                print(f"[telemetry] trace dump failed: {e!r}",
+                      file=stream, flush=True)
+        if telemetry or trace_out:
+            from hyperspace_tpu.telemetry import trace as _trace
+
+            _trace.disable()
 from hyperspace_tpu.telemetry.registry import (  # noqa: F401
     Registry,
     default_registry,

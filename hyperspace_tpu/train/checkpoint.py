@@ -135,15 +135,57 @@ class CheckpointManager:
         self.close()
 
 
+def restore_params_only(directory: str, *, step: Optional[int] = None
+                        ) -> tuple[Any, int]:
+    """Restore a checkpoint's raw state pytree WITHOUT constructing
+    optimizer state — the serving-export path (``serve/artifact.py``).
+
+    With ``step=None`` the target is the newest COMMITTED step (the same
+    scan :meth:`CheckpointManager.latest_committed_step` runs, so an
+    interrupted save's uncommitted dir is never trusted).  The restore
+    goes through orbax's template-free ``StandardRestore``: the caller
+    needs NO ``state_like`` pytree, hence no optimizer/model objects —
+    NamedTuple states come back as plain dicts keyed by field name
+    (``tree["table"]``, ``tree["params"]["c_raw"]``, ...).  Returns
+    ``(tree, step)``.  Raises ``FileNotFoundError`` when no committed
+    checkpoint exists under ``directory``.
+    """
+    directory = os.path.abspath(directory)
+    if step is None:
+        step = _latest_committed_step(directory)
+        if step is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint under {directory}")
+    elif not _step_dir_committed(os.path.join(directory, str(int(step)))):
+        # the never-trust-an-uncommitted-dir rule holds for pinned steps
+        # too — an interrupted save must not become a serving artifact
+        raise FileNotFoundError(
+            f"step {step} under {directory} is missing or uncommitted")
+    mgr = ocp.CheckpointManager(directory)
+    try:
+        tree = mgr.restore(step, args=ocp.args.StandardRestore())
+    finally:
+        mgr.close()
+    return tree, step
+
+
 def dir_bytes(directory: str) -> int:
-    """Total bytes on disk under ``directory`` (0 on any OS error)."""
+    """Total bytes on disk under ``directory`` (0 on any OS error).
+
+    The per-file try/except is load-bearing, not defensive boilerplate:
+    this walks the checkpoint dir WHILE the async save thread is
+    renaming staging dirs and the retention policy is deleting old
+    steps, so a file listed by ``os.walk`` can be gone (or mid-rename)
+    by the time ``getsize`` stats it — ``FileNotFoundError`` (and any
+    other ``OSError``) skips that file instead of sinking the gauge.
+    """
     total = 0
     try:
         for root, _dirs, files in os.walk(directory):
             for name in files:
                 try:
                     total += os.path.getsize(os.path.join(root, name))
-                except OSError:
+                except OSError:  # incl. FileNotFoundError: deleted mid-scan
                     pass
     except OSError:
         pass

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Lint: serving-artifact export → load is the identity, bit for bit.
+
+The serving contract (docs/serving.md) is that an exported artifact
+answers queries EXACTLY like the live params it froze — same bytes in,
+same executable, same bits out.  This script builds a deterministic
+Poincaré table, exports it, loads it back, and runs 10 top-k queries
+(varying batch sizes and k) through engines on the live table and on
+the loaded artifact; any bit difference in neighbors or distances — or
+a fingerprint drift — fails (exit 1).  Run by
+``tests/serve/test_check_script.py`` inside the suite, mirroring the
+telemetry-catalog lint, so a serialization regression fails the build.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable as a plain script from anywhere (the package is not installed)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N, D, C = 97, 8, 1.3
+QUERIES = [  # (q_ids, k) — 10 queries over several buckets and ks
+    ([0, 1, 2], 5),
+    ([3], 1),
+    ([10, 20, 30, 40, 50], 5),
+    ([7, 7, 9], 3),
+    (list(range(16)), 5),
+    ([96, 95], 8),
+    ([11], 5),
+    ([42, 13, 77, 5], 5),
+    (list(range(30, 60)), 2),
+    ([64, 32, 16, 8, 4, 2, 1], 7),
+]
+
+
+def build_table():
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.manifolds import PoincareBall
+
+    v = 0.5 * jax.random.normal(jax.random.PRNGKey(0), (N, D), jnp.float32)
+    return PoincareBall(C).expmap0(v)
+
+
+def main(out_dir: str | None = None) -> int:
+    import numpy as np
+
+    from hyperspace_tpu.serve import (QueryEngine, export_artifact,
+                                      load_artifact)
+
+    table = np.asarray(build_table())
+    spec = ("poincare", C)
+    tmp = None
+    if out_dir is None:
+        tmp = tempfile.TemporaryDirectory()
+        out_dir = os.path.join(tmp.name, "artifact")
+    try:
+        exported = export_artifact(out_dir, table, spec,
+                                   model_config={"c": C}, overwrite=True)
+        loaded = load_artifact(out_dir)
+        if loaded.fingerprint != exported.fingerprint:
+            print(f"FINGERPRINT DRIFT: exported {exported.fingerprint} "
+                  f"!= loaded {loaded.fingerprint}")
+            return 1
+        live = QueryEngine(table, spec)
+        served = QueryEngine.from_artifact(loaded)
+        if live.fingerprint != served.fingerprint:
+            print("FINGERPRINT DRIFT: live engine != loaded engine")
+            return 1
+        for qi, (ids, k) in enumerate(QUERIES):
+            q = np.asarray(ids, np.int32)
+            li, ld = (np.asarray(a) for a in live.topk_neighbors(q, k))
+            si, sd = (np.asarray(a) for a in served.topk_neighbors(q, k))
+            if not np.array_equal(li, si):
+                print(f"query {qi}: neighbor indices differ\n{li}\nvs\n{si}")
+                return 1
+            if not np.array_equal(ld.view(np.uint32), sd.view(np.uint32)):
+                print(f"query {qi}: distances differ bitwise\n{ld}\nvs\n{sd}")
+                return 1
+        print(f"serve artifact round-trip OK: {len(QUERIES)} queries "
+              f"bit-identical (N={N}, D={D}, fingerprint "
+              f"{loaded.fingerprint[:12]}…)")
+        return 0
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))

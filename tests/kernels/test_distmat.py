@@ -60,6 +60,24 @@ def test_twin_matches_manifold_dist(rng):
                                rtol=1e-9, atol=1e-9)
 
 
+def test_public_pdist_wrapper(rng):
+    """The documented entry point dispatches to the same ops as the
+    legacy names (which stay as aliases) and rejects unknown manifolds."""
+    c = 1.0
+    x = _ball_points(rng, (6, 4), c)
+    y = _ball_points(rng, (9, 4), c)
+    np.testing.assert_array_equal(
+        np.asarray(distmat.pdist(x, y, c, manifold="poincare")),
+        np.asarray(distmat.poincare_pdist(x, y, c)))
+    lx = jnp.asarray(_lorentz_points(rng, 5, 4, c), jnp.float32)
+    ly = jnp.asarray(_lorentz_points(rng, 7, 4, c), jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(distmat.pdist(lx, ly, c, manifold="lorentz")),
+        np.asarray(distmat.lorentz_pdist(lx, ly, c)))
+    with pytest.raises(ValueError, match="unknown manifold"):
+        distmat.pdist(x, y, c, manifold="sphere")
+
+
 @pytest.mark.slow
 def test_pdist_gradients(interp, rng):
     c = 1.0

@@ -74,6 +74,7 @@ def test_ring_body_direct_shard_map_unmasked(mesh8):
     varying-type carry mismatch)."""
     from functools import partial as fpartial
 
+    from hyperspace_tpu.parallel.mesh import shard_map
     from hyperspace_tpu.parallel.ring import ring_lorentz_attention
     from jax.sharding import PartitionSpec as P
 
@@ -81,7 +82,7 @@ def test_ring_body_direct_shard_map_unmasked(mesh8):
     q = _pts(jax.random.PRNGKey(6), m, (2, 32, 7))
     spec = P(None, "seq", None)
 
-    @fpartial(jax.shard_map, mesh=mesh8, in_specs=(spec,), out_specs=spec)
+    @fpartial(shard_map, mesh=mesh8, in_specs=(spec,), out_specs=spec)
     def run(q):
         return ring_lorentz_attention(q, q, q, m, "seq")
 

@@ -1,0 +1,170 @@
+"""Serving-artifact format: atomic export, commit marker, fingerprint,
+manifold-spec round trips, checkpoint → artifact extraction."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import (Euclidean, Lorentz, PoincareBall,
+                                      Product, Sphere)
+from hyperspace_tpu.serve import artifact as A
+
+
+def _table(rng, n=20, d=4):
+    return np.asarray(rng.standard_normal((n, d)) * 0.1, np.float32)
+
+
+def test_export_load_round_trip(tmp_path, rng):
+    t = _table(rng)
+    out = str(tmp_path / "art")
+    exported = A.export_artifact(out, t, ("poincare", 1.3),
+                                 model_config={"c": 1.3}, step=7)
+    loaded = A.load_artifact(out)
+    assert loaded.fingerprint == exported.fingerprint
+    assert np.array_equal(loaded.table, t)
+    assert loaded.table.dtype == t.dtype
+    assert loaded.manifold_spec == ("poincare", 1.3)
+    assert loaded.model_config == {"c": 1.3}
+    assert loaded.step == 7
+    assert A.is_committed(out)
+
+
+def test_missing_marker_is_uncommitted(tmp_path, rng):
+    out = str(tmp_path / "art")
+    A.export_artifact(out, _table(rng), ("poincare", 1.0))
+    os.remove(os.path.join(out, A.COMMIT_MARKER))
+    assert not A.is_committed(out)
+    with pytest.raises(FileNotFoundError):
+        A.load_artifact(out)
+
+
+def test_fingerprint_mismatch_refuses_to_load(tmp_path, rng):
+    out = str(tmp_path / "art")
+    A.export_artifact(out, _table(rng), ("poincare", 1.0))
+    # swap the table under the marker: a corrupted artifact must not serve
+    np.save(os.path.join(out, A.TABLE_FILE), _table(rng) + 1.0)
+    with pytest.raises(ValueError, match="fingerprint"):
+        A.load_artifact(out)
+
+
+def test_overwrite_semantics(tmp_path, rng):
+    out = str(tmp_path / "art")
+    t1, t2 = _table(rng), _table(rng)
+    A.export_artifact(out, t1, ("poincare", 1.0))
+    with pytest.raises(FileExistsError):
+        A.export_artifact(out, t2, ("poincare", 1.0))
+    A.export_artifact(out, t2, ("poincare", 1.0), overwrite=True)
+    assert np.array_equal(A.load_artifact(out).table, t2)
+    # no staging/backup leftovers beside the artifact
+    assert os.listdir(tmp_path) == ["art"]
+
+
+def test_fingerprint_covers_spec_and_bytes(rng):
+    t = _table(rng)
+    base = A.fingerprint_of(t, ("poincare", 1.0))
+    assert A.fingerprint_of(t, ("poincare", 2.0)) != base
+    assert A.fingerprint_of(t, ("lorentz", 1.0)) != base
+    t2 = t.copy()
+    t2[0, 0] += 1e-7
+    assert A.fingerprint_of(t2, ("poincare", 1.0)) != base
+    assert A.fingerprint_of(t.copy(), ("poincare", 1.0)) == base
+
+
+@pytest.mark.parametrize("m,spec", [
+    (PoincareBall(1.3), ("poincare", 1.3)),
+    (Lorentz(0.8), ("lorentz", 0.8)),
+    (Product([PoincareBall(1.1), Sphere(0.9), Euclidean()], [3, 3, 2]),
+     ("product", (("poincare", 3, 1.1), ("sphere", 3, 0.9),
+                  ("euclidean", 2, 0.0)))),
+])
+def test_spec_round_trips(m, spec):
+    assert A.spec_from_manifold(m) == spec
+    assert A.spec_from_json(A.spec_to_json(spec)) == spec
+    rebuilt = A.manifold_from_spec(spec)
+    assert A.spec_from_manifold(rebuilt) == spec
+    # JSON path survives an actual serialize/parse
+    assert A.spec_from_json(json.loads(json.dumps(A.spec_to_json(spec)))) == spec
+
+
+def test_product_table_width_validated(tmp_path, rng):
+    spec = ("product", (("poincare", 3, 1.0), ("euclidean", 2, 0.0)))
+    with pytest.raises(ValueError, match="width"):
+        A.export_artifact(str(tmp_path / "a"), _table(rng, d=4), spec)
+
+
+def test_export_from_checkpoint_poincare(tmp_path):
+    from hyperspace_tpu.models import poincare_embed as pe
+    from hyperspace_tpu.train.checkpoint import CheckpointManager
+
+    cfg = pe.PoincareEmbedConfig(num_nodes=12, dim=3)
+    state, _opt = pe.init_state(cfg, 0)
+    ckpt = str(tmp_path / "ckpt")
+    with CheckpointManager(ckpt) as ck:
+        ck.save(3, state, force=True)
+    art = A.export_from_checkpoint(
+        ckpt, str(tmp_path / "art"), workload="poincare",
+        model_config={"c": cfg.c})
+    assert art.step == 3
+    assert art.manifold_spec == ("poincare", 1.0)
+    assert np.array_equal(art.table, np.asarray(state.table))
+
+
+def test_export_from_checkpoint_requires_curvature(tmp_path):
+    """poincare/lorentz export must demand the trained c — a silent 1.0
+    default would freeze the wrong metric into a valid-looking artifact."""
+    from hyperspace_tpu.models import poincare_embed as pe
+    from hyperspace_tpu.train.checkpoint import CheckpointManager
+
+    cfg = pe.PoincareEmbedConfig(num_nodes=8, dim=3)
+    state, _opt = pe.init_state(cfg, 0)
+    ckpt = str(tmp_path / "ckpt")
+    with CheckpointManager(ckpt) as ck:
+        ck.save(1, state, force=True)
+    with pytest.raises(ValueError, match="requires model_config\\['c'\\]"):
+        A.export_from_checkpoint(ckpt, str(tmp_path / "art"),
+                                 workload="poincare")
+
+
+def test_export_from_checkpoint_product_factor_mismatch(tmp_path):
+    """A factors= layout naming MORE curved factors than the checkpoint
+    trained must fail with the diagnostic ValueError (not an IndexError
+    from indexing past c_raw)."""
+    from hyperspace_tpu.models import product_embed as pme
+    from hyperspace_tpu.train.checkpoint import CheckpointManager
+
+    cfg = pme.ProductEmbedConfig(num_nodes=6)  # 2 curved factors
+    state, _opt = pme.init_state(cfg, 0)
+    ckpt = str(tmp_path / "ckpt")
+    with CheckpointManager(ckpt) as ck:
+        ck.save(1, state, force=True)
+    with pytest.raises(ValueError, match="learned"):
+        A.export_from_checkpoint(
+            ckpt, str(tmp_path / "art"), workload="product",
+            model_config={"factors": [["poincare", 4], ["sphere", 4],
+                                      ["poincare", 4]]})
+
+
+def test_export_from_checkpoint_product(tmp_path):
+    from hyperspace_tpu.models import product_embed as pme
+    from hyperspace_tpu.train.checkpoint import CheckpointManager
+
+    cfg = pme.ProductEmbedConfig(num_nodes=10)
+    state, _opt = pme.init_state(cfg, 0)
+    ckpt = str(tmp_path / "ckpt")
+    with CheckpointManager(ckpt) as ck:
+        ck.save(2, state, force=True)
+    art = A.export_from_checkpoint(
+        ckpt, str(tmp_path / "art"), workload="product")
+    assert art.manifold_spec[0] == "product"
+    kinds = [f[0] for f in art.manifold_spec[1]]
+    assert kinds == ["poincare", "sphere", "euclidean"]
+    # learned curvatures frozen as softplus(c_raw)
+    want = np.asarray(jax.nn.softplus(
+        jnp.asarray(state.params.c_raw, jnp.float64)))
+    got = [c for k, _d, c in art.manifold_spec[1] if k != "euclidean"]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    assert np.array_equal(art.table, np.asarray(state.params.table))

@@ -1,0 +1,54 @@
+"""The serving-artifact round-trip lint, run inside the suite: export →
+load → 10 queries must match the live model bit-for-bit
+(scripts/check_serve_artifact.py is the one implementation — this test
+just fails the build when it fails, mirroring the telemetry-catalog
+lint's test)."""
+
+import importlib.util
+import os
+
+
+def _load_checker():
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, "scripts", "check_serve_artifact.py")
+    spec = importlib.util.spec_from_file_location("check_serve_artifact",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_round_trip_lint_passes(tmp_path, capsys):
+    mod = _load_checker()
+    rc = mod.main(str(tmp_path / "artifact"))
+    out = capsys.readouterr().out
+    assert rc == 0, f"serve artifact round-trip lint failed:\n{out}"
+    assert "bit-identical" in out
+
+
+def test_lint_catches_a_poisoned_table(tmp_path, monkeypatch):
+    """The checker itself must keep working: nudge one table entry in
+    the loaded artifact (below any fingerprint re-check the script does
+    on its own meta, but enough to move f32 distance bits) and the lint
+    has to fail."""
+    import numpy as np
+
+    from hyperspace_tpu.serve import artifact as A
+
+    mod = _load_checker()
+    real = A.load_artifact
+
+    def poisoned(directory):
+        art = real(directory)
+        t = art.table.copy()
+        t[0, 0] += np.float32(1e-3)
+        return A.ServingArtifact(
+            table=t, manifold_spec=art.manifold_spec,
+            model_config=art.model_config,
+            fingerprint=art.fingerprint, step=art.step)
+
+    # the script does `from hyperspace_tpu.serve import load_artifact`
+    # inside main(), so the package attribute is the interception point
+    monkeypatch.setattr("hyperspace_tpu.serve.load_artifact", poisoned)
+    assert mod.main(str(tmp_path / "artifact")) == 1

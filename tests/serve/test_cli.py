@@ -1,0 +1,141 @@
+"""The serve CLI: export / one-shot query / JSONL loop."""
+
+import io
+import json
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from hyperspace_tpu.cli import serve as S
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """(cfg, state, ckpt_dir, artifact_dir) — one tiny trained+exported
+    poincare run shared by the CLI tests (module-scoped: the CLI paths
+    under test are read-only against it)."""
+    from hyperspace_tpu.models import poincare_embed as pe
+    from hyperspace_tpu.train.checkpoint import CheckpointManager
+
+    tmp = tmp_path_factory.mktemp("serve_cli")
+    cfg = pe.PoincareEmbedConfig(num_nodes=30, dim=3, batch_size=16,
+                                 neg_samples=4, burnin_steps=0)
+    state, opt = pe.init_state(cfg, seed=0)
+    pairs = jnp.asarray(
+        np.random.default_rng(0).integers(0, 30, (60, 2), np.int64))
+    for _ in range(3):
+        state, _ = pe.train_step(cfg, opt, state, pairs)
+    ckpt = str(tmp / "ckpt")
+    with CheckpointManager(ckpt) as ck:
+        ck.save(3, state, force=True)
+    art = str(tmp / "artifact")
+    rc = S.main(["export", f"ckpt={ckpt}", f"out={art}",
+                 "workload=poincare", "c=1.0"])
+    assert rc == 0
+    return cfg, state, ckpt, art
+
+
+def test_export_wrote_a_committed_artifact(trained, capsys):
+    from hyperspace_tpu.serve import is_committed, load_artifact
+
+    cfg, state, _ckpt, art = trained
+    assert is_committed(art)
+    loaded = load_artifact(art)
+    assert loaded.num_nodes == cfg.num_nodes
+    assert loaded.step == 3
+    assert np.array_equal(loaded.table, np.asarray(state.table))
+
+
+def test_one_shot_topk_query(trained, capsys):
+    _cfg, _state, _ckpt, art = trained
+    rc = S.main(["query", f"artifact={art}", "ids=0,1,2", "k=3"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["mode"] == "query"
+    assert np.asarray(out["neighbors"]).shape == (3, 3)
+    assert np.asarray(out["dists"]).shape == (3, 3)
+
+
+def test_one_shot_score_query(trained, capsys):
+    _cfg, _state, _ckpt, art = trained
+    rc = S.main(["query", f"artifact={art}", "u=0,1", "v=2,3", "prob=1"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(out["scores"]) == 2
+    assert all(0 < s <= 1 for s in out["scores"])
+
+
+def test_jsonl_loop(trained):
+    _cfg, _state, _ckpt, art = trained
+    cfg = S.apply_overrides(S.ServeConfig(), {"artifact": art})
+    lines = "\n".join([
+        json.dumps({"op": "topk", "ids": [0, 1], "k": 2}),
+        json.dumps({"op": "score", "u": [0], "v": [1]}),
+        json.dumps({"op": "nope"}),
+        json.dumps([1, 2]),  # valid JSON, not an object
+        json.dumps({"op": "topk", "ids": [2**33], "k": 2}),  # > int32
+        json.dumps({"op": "topk", "ids": [0.7], "k": 2}),    # float id
+        json.dumps({"op": "score", "u": [0], "v": [1],
+                    "prob": "false"}),  # string boolean
+        json.dumps({"op": "stats"}),
+    ]) + "\n"
+    out = io.StringIO()
+    result = S.run_serve(cfg, stdin=io.StringIO(lines), stdout=out)
+    resp = [json.loads(l) for l in out.getvalue().strip().splitlines()]
+    assert np.asarray(resp[0]["neighbors"]).shape == (2, 2)
+    assert len(resp[1]["scores"]) == 1
+    assert "error" in resp[2]  # bad op answers in-line, loop survives
+    assert "error" in resp[3]  # non-object line too
+    assert "error" in resp[4]  # id past int32: error, not a wrapped id
+    assert "error" in resp[5]  # float id: error, not a truncated id
+    assert "error" in resp[6]  # "false" is not a JSON boolean
+    assert resp[7]["fingerprint"]
+    assert result["served"] == 3  # the bad lines don't count
+
+
+def test_serve_mode_stdout_is_responses_only(trained, capsys, monkeypatch):
+    """main() in serve mode must keep stdout a strict one-line-per-request
+    stream: the closing stats dict goes to stderr."""
+    import io as _io
+    import sys as _sys
+
+    _cfg, _state, _ckpt, art = trained
+    monkeypatch.setattr(
+        _sys, "stdin",
+        _io.StringIO(json.dumps({"op": "topk", "ids": [0], "k": 2}) + "\n"))
+    rc = S.main(["serve", f"artifact={art}"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    out_lines = cap.out.strip().splitlines()
+    assert len(out_lines) == 1  # exactly the one response
+    assert "neighbors" in json.loads(out_lines[0])
+    closing = json.loads(cap.err.strip().splitlines()[-1])
+    assert closing["mode"] == "serve" and closing["served"] == 1
+
+
+def test_bad_overrides_fail_loudly(trained):
+    _cfg, _state, _ckpt, art = trained
+    with pytest.raises(SystemExit):
+        S.main(["query", f"artifact={art}", "ids=a,b", "k=3"])
+    with pytest.raises(SystemExit):
+        S.main(["query", f"artifact={art}"])  # neither ids nor u/v
+    with pytest.raises(SystemExit):
+        S.main(["export", "workload=poincare"])  # missing ckpt/out
+    with pytest.raises(SystemExit):
+        S.main(["query", "bogus_flag=1", f"artifact={art}", "ids=0"])
+
+
+def test_export_requires_explicit_curvature(trained, tmp_path):
+    """CLI export of poincare/lorentz without c= must refuse — the
+    trained curvature is not in the checkpoint and must not default."""
+    _cfg, _state, ckpt, _art = trained
+    with pytest.raises(SystemExit, match="requires c="):
+        S.main(["export", f"ckpt={ckpt}", f"out={tmp_path / 'a'}",
+                "workload=poincare"])
+    with pytest.raises(SystemExit, match="want a float"):
+        S.main(["export", f"ckpt={ckpt}", f"out={tmp_path / 'a'}",
+                "workload=poincare", "c=abc"])
+    with pytest.raises(SystemExit, match="want JSON"):
+        S.main(["export", f"ckpt={ckpt}", f"out={tmp_path / 'a'}",
+                "workload=product", "factors=[[poincare,5]]"])

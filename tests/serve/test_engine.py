@@ -1,0 +1,149 @@
+"""Query-engine goldens: the chunked jitted k-NN agrees with a pure-
+`manifolds` O(N²) reference on every supported manifold — this is the
+test coverage for the CPU/XLA fallback path of the distance kernels the
+engine reuses (ISSUE 3 satellite)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import (Euclidean, Lorentz, PoincareBall,
+                                      Product, Sphere)
+from hyperspace_tpu.serve.artifact import spec_from_manifold
+from hyperspace_tpu.serve.engine import QueryEngine, auto_chunk_rows
+
+
+def _poincare_table(rng, n, d, c):
+    v = jnp.asarray(rng.standard_normal((n, d)) * 0.5, jnp.float32)
+    return np.asarray(PoincareBall(c).expmap0(v)), PoincareBall(c)
+
+
+def _lorentz_table(rng, n, d, c):
+    man = Lorentz(c)
+    v = jnp.asarray(rng.standard_normal((n, d + 1)) * 0.5, jnp.float32)
+    v = v.at[:, 0].set(0.0)
+    return np.asarray(man.expmap0(v)), man
+
+
+def _product_table(rng, n):
+    man = Product([PoincareBall(1.1), Sphere(0.9), Euclidean()], [3, 3, 2])
+    v = jnp.asarray(rng.standard_normal((n, 8)) * 0.3, jnp.float32)
+    pt = man.proj(man.expmap0(man.proju(man.origin((n, 8)), v)))
+    return np.asarray(pt), man
+
+
+def _reference_topk(man, table, q_idx, k):
+    """O(N²) oracle: full f64 distance matrix through the manifold's own
+    ``dist``, self excluded, argsorted."""
+    t64 = jnp.asarray(table, jnp.float64)
+    d = np.array(jax.vmap(lambda x: man.dist(x, t64))(t64[q_idx]))
+    d[np.arange(len(q_idx)), q_idx] = np.inf
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return idx, np.take_along_axis(d, idx, axis=1)
+
+
+@pytest.mark.parametrize("build", [_poincare_table, _lorentz_table],
+                         ids=["poincare", "lorentz"])
+def test_topk_matches_manifold_reference(rng, build):
+    table, man = build(rng, 57, 6, 1.3)
+    eng = QueryEngine(table, spec_from_manifold(man), chunk_rows=128)
+    q = np.asarray([0, 3, 17, 42, 56], np.int32)
+    idx, dist = (np.asarray(a) for a in eng.topk_neighbors(q, 5))
+    ref_idx, ref_dist = _reference_topk(man, table, q, 5)
+    assert np.array_equal(idx, ref_idx)
+    np.testing.assert_allclose(dist, ref_dist, rtol=2e-3, atol=2e-3)
+    # ascending order, ids in range, self excluded
+    assert np.all(np.diff(dist, axis=1) >= 0)
+    assert idx.min() >= 0 and idx.max() < eng.num_nodes
+    assert not np.any(idx == q[:, None])
+
+
+def test_topk_matches_manifold_reference_product(rng):
+    table, man = _product_table(rng, 41)
+    eng = QueryEngine(table, spec_from_manifold(man), chunk_rows=128)
+    q = np.asarray([0, 7, 40], np.int32)
+    idx, dist = (np.asarray(a) for a in eng.topk_neighbors(q, 6))
+    ref_idx, ref_dist = _reference_topk(man, table, q, 6)
+    assert np.array_equal(idx, ref_idx)
+    np.testing.assert_allclose(dist, ref_dist, rtol=2e-3, atol=2e-3)
+
+
+def test_chunking_is_value_invariant(rng):
+    """The running top-k merge over 128-row chunks returns the same
+    neighbors/distances as one chunk covering the whole (padded) table."""
+    table, man = _poincare_table(rng, 300, 5, 1.0)
+    spec = spec_from_manifold(man)
+    q = np.asarray([1, 100, 299], np.int32)
+    small = QueryEngine(table, spec, chunk_rows=128)
+    big = QueryEngine(table, spec, chunk_rows=512)
+    i1, d1 = (np.asarray(a) for a in small.topk_neighbors(q, 7))
+    i2, d2 = (np.asarray(a) for a in big.topk_neighbors(q, 7))
+    assert np.array_equal(i1, i2)
+    np.testing.assert_allclose(d1, d2, rtol=1e-5, atol=1e-6)
+
+
+def test_padded_rows_never_surface(rng):
+    """k = N−1 drains the whole table: every real row shows up exactly
+    once, the zero-padded chunk tail never does."""
+    table, man = _poincare_table(rng, 10, 3, 1.0)
+    eng = QueryEngine(table, spec_from_manifold(man), chunk_rows=128)
+    q = np.asarray([4], np.int32)
+    idx, dist = (np.asarray(a) for a in eng.topk_neighbors(q, 9))
+    assert sorted(idx[0].tolist()) == [i for i in range(10) if i != 4]
+    assert np.all(np.isfinite(dist))
+
+
+def test_exclude_self_flag(rng):
+    table, man = _poincare_table(rng, 12, 3, 1.0)
+    eng = QueryEngine(table, spec_from_manifold(man))
+    q = np.asarray([5], np.int32)
+    idx, dist = eng.topk_neighbors(q, 1, exclude_self=False)
+    assert int(np.asarray(idx)[0, 0]) == 5  # nearest row to itself
+    assert float(np.asarray(dist)[0, 0]) == pytest.approx(0.0, abs=1e-5)
+
+
+def test_score_edges_matches_manifold_dist(rng):
+    table, man = _lorentz_table(rng, 30, 5, 0.8)
+    eng = QueryEngine(table, spec_from_manifold(man))
+    u = np.asarray([0, 5, 9], np.int32)
+    v = np.asarray([1, 7, 20], np.int32)
+    d = np.asarray(eng.score_edges(u, v))
+    ref = np.asarray(man.dist(jnp.asarray(table)[u], jnp.asarray(table)[v]))
+    # same f32 math, but jitted-vs-eager fusion may round differently —
+    # and identical-point pairs sit on arcosh's noise floor, so the pairs
+    # above are all distinct rows
+    np.testing.assert_allclose(d, ref, rtol=1e-5, atol=1e-5)
+    # Fermi–Dirac probabilities: in (0, 1], monotone decreasing in d
+    p = np.asarray(eng.score_edges(u, v, prob=True))
+    assert np.all((p > 0) & (p <= 1))
+    assert np.array_equal(np.argsort(-p), np.argsort(d))
+
+
+def test_validation_errors(rng):
+    table, man = _poincare_table(rng, 8, 3, 1.0)
+    eng = QueryEngine(table, spec_from_manifold(man))
+    # a negative chunk would scan ZERO chunks and answer -1/inf silently
+    with pytest.raises(ValueError, match="chunk_rows"):
+        QueryEngine(table, spec_from_manifold(man), chunk_rows=-5)
+    with pytest.raises(ValueError, match="k="):
+        eng.topk_neighbors(np.asarray([0], np.int32), 8)  # k > N-1
+    with pytest.raises(ValueError, match="out of range"):
+        eng.topk_neighbors(np.asarray([8], np.int32), 2)
+    with pytest.raises(ValueError, match="out of range"):
+        eng.score_edges(np.asarray([-1], np.int32), np.asarray([0], np.int32))
+    with pytest.raises(ValueError, match="must match"):
+        eng.score_edges(np.asarray([0, 1], np.int32),
+                        np.asarray([0], np.int32))
+    with pytest.raises(ValueError, match="non-empty"):
+        eng.topk_neighbors(np.asarray([], np.int32), 2)
+
+
+def test_auto_chunk_rows_budget():
+    # kernel path: rows independent of D; product path shrinks with D
+    assert auto_chunk_rows(10, "poincare", 10_000_000) \
+        == auto_chunk_rows(100, "poincare", 10_000_000)
+    assert auto_chunk_rows(64, "product", 10_000_000) \
+        < auto_chunk_rows(8, "product", 10_000_000)
+    # tiny tables never over-allocate: chunk covers the table once
+    assert auto_chunk_rows(4, "poincare", 40) == 128

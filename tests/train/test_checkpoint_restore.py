@@ -1,0 +1,83 @@
+"""ISSUE 3 satellites on train/checkpoint.py: template-free
+``restore_params_only`` and the ``dir_bytes`` mid-scan-race guard."""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.train import checkpoint as C
+
+
+def _save_tiny(tmp_path, step=5):
+    from hyperspace_tpu.models import poincare_embed as pe
+
+    cfg = pe.PoincareEmbedConfig(num_nodes=8, dim=3)
+    state, _opt = pe.init_state(cfg, seed=0)
+    d = str(tmp_path / "ckpt")
+    with C.CheckpointManager(d) as ck:
+        ck.save(step, state, force=True)
+    return d, state
+
+
+def test_restore_params_only_raw_tree(tmp_path):
+    d, state = _save_tiny(tmp_path)
+    tree, step = C.restore_params_only(d)
+    assert step == 5
+    # NamedTuple state comes back as a plain dict keyed by field name —
+    # no TrainState / optimizer-state objects were constructed
+    assert isinstance(tree, dict)
+    assert set(tree) == {"table", "opt_state", "key", "step"}
+    np.testing.assert_array_equal(
+        np.asarray(tree["table"]), np.asarray(state.table))
+    assert int(tree["step"]) == int(state.step)
+
+
+def test_restore_params_only_skips_uncommitted(tmp_path):
+    d, state = _save_tiny(tmp_path)
+    # an interrupted save's empty all-digit dir must not be trusted
+    os.makedirs(os.path.join(d, "99"))
+    tree, step = C.restore_params_only(d)
+    assert step == 5
+    np.testing.assert_array_equal(
+        np.asarray(tree["table"]), np.asarray(state.table))
+
+
+def test_restore_params_only_explicit_step_and_missing(tmp_path):
+    d, _state = _save_tiny(tmp_path)
+    _tree, step = C.restore_params_only(d, step=5)
+    assert step == 5
+    with pytest.raises(FileNotFoundError):
+        C.restore_params_only(str(tmp_path / "nope"))
+    # the never-trust-uncommitted rule holds for PINNED steps too: an
+    # interrupted save's dir must not restore into a serving artifact
+    os.makedirs(os.path.join(d, "99"))
+    with pytest.raises(FileNotFoundError, match="uncommitted"):
+        C.restore_params_only(d, step=99)
+    with pytest.raises(FileNotFoundError, match="uncommitted"):
+        C.restore_params_only(d, step=7)  # never existed
+
+
+def test_dir_bytes_tolerates_files_deleted_mid_scan(tmp_path, monkeypatch):
+    """The async-save race: a file listed by os.walk is deleted before
+    getsize stats it — dir_bytes must skip it, not raise."""
+    d = tmp_path / "ck"
+    d.mkdir()
+    (d / "a.bin").write_bytes(b"x" * 100)
+    (d / "b.bin").write_bytes(b"y" * 50)
+    doomed = str(d / "a.bin")
+    real = os.path.getsize
+
+    def racy(path):
+        if path == doomed:
+            raise FileNotFoundError(path)  # deleted between walk and stat
+        return real(path)
+
+    monkeypatch.setattr(os.path, "getsize", racy)
+    assert C.dir_bytes(str(d)) == 50
+
+
+def test_dir_bytes_missing_directory_is_zero(tmp_path):
+    assert C.dir_bytes(str(tmp_path / "never")) == 0
