@@ -15,6 +15,9 @@ Three modes, same ``key=value`` override grammar as the train CLI:
     # stdin/JSONL loop: one request per line, one JSON response per line
     python -m hyperspace_tpu.cli.serve serve artifact=... telemetry=1
 
+    # shard the table across the chips (mesh=-1 = all local devices)
+    python -m hyperspace_tpu.cli.serve serve artifact=... mesh=-1
+
 Loop-mode requests:
 
     {"op": "topk",  "ids": [0, 1, 2], "k": 5}
@@ -70,6 +73,11 @@ class ServeConfig:
     max_bucket: int = 1024
     cache_size: int = 65536
     chunk_rows: int = 0           # 0 = auto from the tile budget
+    # devices on the mesh's `model` axis to row-shard the table over:
+    # 0 = single-device (no mesh), -1 = all local devices, N = first N.
+    # A 1-device mesh runs the single-device program (bit-compatible).
+    mesh: int = 0
+    scan_mode: str = "two_stage"  # two_stage | carry (A/B; docs/serving.md)
 
 
 def _ids(s: str, name: str) -> list[int]:
@@ -89,8 +97,20 @@ def _build(cfg: ServeConfig):
 
     if not cfg.artifact:
         raise SystemExit("artifact= is required for query/serve modes")
+    mesh = None
+    if cfg.mesh:
+        from hyperspace_tpu.parallel.mesh import model_mesh
+
+        try:
+            mesh = model_mesh(cfg.mesh)
+        except ValueError as e:
+            raise SystemExit(f"mesh={cfg.mesh}: {e}") from None
     art = load_artifact(cfg.artifact)
-    eng = QueryEngine.from_artifact(art, chunk_rows=cfg.chunk_rows)
+    try:
+        eng = QueryEngine.from_artifact(art, chunk_rows=cfg.chunk_rows,
+                                        mesh=mesh, scan_mode=cfg.scan_mode)
+    except ValueError as e:  # bad scan_mode/chunk_rows: a usage error
+        raise SystemExit(str(e)) from None
     return eng, RequestBatcher(eng, min_bucket=cfg.min_bucket,
                                max_bucket=cfg.max_bucket,
                                cache_size=cfg.cache_size)

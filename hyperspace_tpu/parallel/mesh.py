@@ -122,6 +122,23 @@ def auto_mesh(multihost: bool = False, tp: int = 1) -> Optional[Mesh]:
     return make_mesh({"data": n})
 
 
+def model_mesh(shards: int = -1, *, devices: Optional[Sequence] = None
+               ) -> Mesh:
+    """A pure ``model``-axis mesh over ``shards`` devices (-1 = all) —
+    the layout table-sharded *serving* uses (``serve/engine.py``;
+    training meshes come from :func:`auto_mesh`).  ``shards`` larger
+    than the device count, or 0, is an error — a silent clamp would
+    quietly change the memory-per-chip story the caller sized for."""
+    avail = list(devices if devices is not None else jax.local_devices())
+    if shards == -1:
+        shards = len(avail)
+    if not 1 <= shards <= len(avail):
+        raise ValueError(
+            f"model_mesh: shards={shards} out of range [1, {len(avail)}] "
+            "(-1 = all devices)")
+    return make_mesh({"model": shards}, devices=avail[:shards])
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

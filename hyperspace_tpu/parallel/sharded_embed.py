@@ -46,9 +46,14 @@ def shard_table(table: jax.Array, mesh: Mesh, axis: str = "model") -> jax.Array:
     return jax.device_put(table, table_sharding(mesh, axis))
 
 
-def _local_gather(table_local: jax.Array, idx: jax.Array, n_rows: int,
-                  axis: str):
+def local_gather(table_local: jax.Array, idx: jax.Array, n_rows: int,
+                 axis: str):
     """Per-device body: gather owned rows, zeros elsewhere, psum.
+
+    Public so other ``shard_map`` programs over a row-sharded table can
+    assemble replicated rows inside their own bodies — the serve
+    engine's sharded k-NN (``serve/engine.py``) gathers its query rows
+    this way before scanning the local shard.
 
     Index semantics match dense ``table[idx]``: negatives wrap
     (idx + V) and out-of-range clamps to the last row — without this a
@@ -73,7 +78,7 @@ def sharded_gather(
 ) -> jax.Array:
     """``table[idx]`` over a row-sharded table; differentiable w.r.t. table."""
     run = shard_map(
-        partial(_local_gather, n_rows=table.shape[0], axis=axis),
+        partial(local_gather, n_rows=table.shape[0], axis=axis),
         mesh=mesh,
         in_specs=(P(axis, None), P()),
         out_specs=P(),

@@ -12,8 +12,11 @@ requests and the engine:
   ``log2(max/min)+1`` distinct batch shapes: compiles happen once per
   (bucket, k) at warmup and never again (``jax/recompiles`` is the
   regression alarm).  Padded slots are real-but-discarded work, counted
-  in ``serve/padded_waste`` so an overly sparse bucket ladder shows up
-  in telemetry rather than in a latency mystery.
+  in ``serve/padded_waste`` (with ``serve/slots`` the total dispatched)
+  and summarized as the ``serve/padded_waste_ratio`` gauge, so an overly
+  sparse bucket ladder shows up in telemetry rather than in a latency
+  mystery.  Cache effectiveness is likewise a gauge
+  (``serve/cache_hit_rate``) the bench's ``serve_qps`` leg reads.
 - **Result cache.**  An LRU keyed ``(artifact fingerprint, query id,
   k)`` holding per-query top-k rows.  The fingerprint key means a
   reloaded (different) artifact can never serve another table's cached
@@ -180,6 +183,7 @@ class RequestBatcher:
             for s in range(0, len(misses), self.buckets[-1]):
                 slab = misses[s : s + self.buckets[-1]]
                 b = bucket_for(len(slab), self.buckets)
+                telem.inc("serve/slots", b)
                 telem.inc("serve/padded_waste", b - len(slab))
                 padded = slab + [slab[-1]] * (b - len(slab))
                 idx, dist = self.engine.topk_neighbors(
@@ -191,6 +195,7 @@ class RequestBatcher:
                     val = (idx[j].copy(), dist[j].copy())
                     rows[qid] = val
                     self.cache.put(keyf(qid), val)
+            self._update_gauges()
             out_i = np.stack([rows[qid][0] for qid in ids])
             out_d = np.stack([rows[qid][1] for qid in ids])
             return out_i, out_d
@@ -214,6 +219,7 @@ class RequestBatcher:
             for s in range(0, u.size, top):
                 su, sv = u[s : s + top], v[s : s + top]
                 b = bucket_for(su.size, self.buckets)
+                telem.inc("serve/slots", b)
                 telem.inc("serve/padded_waste", b - su.size)
                 pu = np.concatenate([su, np.full(b - su.size, su[-1])])
                 pv = np.concatenate([sv, np.full(b - sv.size, sv[-1])])
@@ -221,19 +227,41 @@ class RequestBatcher:
                     pu.astype(np.int32), pv.astype(np.int32),
                     prob=prob, fd_r=fd_r, fd_t=fd_t)
                 out[s : s + su.size] = np.asarray(d)[: su.size]
+            self._update_gauges()
             return out
 
     # --- introspection --------------------------------------------------------
 
-    def stats(self) -> dict:
-        """Current serve counters + cache occupancy (the `stats` op of
-        the CLI loop)."""
+    def _update_gauges(self) -> None:
+        """Refresh the ratio gauges from the cumulative counters.
+
+        The raw ``serve/padded_waste`` counter grows forever; the gauge
+        forms (waste / engine slots dispatched, cache hits / lookups)
+        are the levels a dashboard — and the bench's ``serve_qps`` leg —
+        can read directly without differencing counters."""
         reg = telem.default_registry()
+        slots = reg.get("serve/slots")
+        if slots:
+            telem.set_gauge("serve/padded_waste_ratio",
+                            round(reg.get("serve/padded_waste") / slots, 4))
+        lookups = reg.get("serve/cache_hit") + reg.get("serve/cache_miss")
+        if lookups:
+            telem.set_gauge("serve/cache_hit_rate",
+                            round(reg.get("serve/cache_hit") / lookups, 4))
+
+    def stats(self) -> dict:
+        """Current serve counters + ratio gauges + cache occupancy (the
+        `stats` op of the CLI loop)."""
+        reg = telem.default_registry()
+        gauges = reg.snapshot()
         return {
             "requests": reg.get("serve/requests"),
             "cache_hit": reg.get("serve/cache_hit"),
             "cache_miss": reg.get("serve/cache_miss"),
+            "cache_hit_rate": gauges.get("serve/cache_hit_rate", 0.0),
             "padded_waste": reg.get("serve/padded_waste"),
+            "padded_waste_ratio": gauges.get("serve/padded_waste_ratio", 0.0),
+            "slots": reg.get("serve/slots"),
             "cache_entries": len(self.cache),
             "buckets": list(self.buckets),
             "fingerprint": self.engine.fingerprint,

@@ -19,24 +19,50 @@ Mechanics:
   manifolds use ``Product.dist`` broadcast per tile (exactly the trained
   geometry, learned curvatures frozen into the spec).
 - **The table is chunked.**  The k-NN scan walks the table
-  ``chunk_rows`` rows at a time, carrying a running top-k, so the live
-  distance working set is one [B, chunk] tile (plus [B, chunk, D] on
-  the product path) regardless of N — ``tile_budget`` picks the chunk.
-  The table is zero-padded ONCE at engine build to a chunk multiple;
-  padded rows are masked to +inf distance by index, so they can never
-  appear in a result.
+  ``chunk_rows`` rows at a time, so the live distance working set is one
+  [B, chunk] tile (plus [B, chunk, D] on the product path) regardless of
+  N — ``tile_budget`` picks the chunk.  The table is zero-padded ONCE at
+  engine build to a chunk multiple; padded rows are masked to +inf
+  distance by index, so they can never appear in a result.
+- **Two scan strategies** (``scan_mode``).  The default ``two_stage``
+  takes a per-chunk ``lax.top_k`` over the [B, chunk] tile only (k
+  candidates per chunk, stacked by the scan) and merges the
+  [B, nchunks·k] candidate buffer ONCE after the scan — the per-step
+  sort never sees the carried candidates, so each step sorts chunk rows
+  instead of chunk+k.  A running per-row k-th-distance bound lets a tile
+  whose row-minimum already exceeds it skip its sort entirely (the
+  threshold-prune fast path — a big win on locality-ordered tables
+  where late chunks are all far).  ``carry`` is the original variant —
+  the scan carries a running [B, k] top-k and re-sorts [B, chunk+k]
+  every step — kept selectable for A/B timing and as the low-memory
+  fallback when nchunks·k is large.
+- **The table shards across the device mesh** (``mesh=``).  With a mesh
+  whose ``model`` axis has S > 1 devices, the padded table is laid out
+  ``P("model", None)`` (``parallel/sharded_embed.table_sharding``) —
+  each device holds N/S rows, so tables larger than one chip's HBM
+  serve fine and the scan walks only the local shard (per-device work
+  cut by S).  Inside one ``shard_map`` program: query rows are
+  assembled by the same gather-owned-rows + psum trick the training
+  lookup uses, each device runs the chunked scan over its shard with
+  shard-local column offsets, then one all-gather of the per-shard
+  [B, k] candidates and a final merge top-k.  A mesh whose model axis
+  has ONE device falls back to the single-device program — bit-compatible
+  by construction (same executable).
 - **Compiles are keyed on (bucket, k), never on request.**  The jitted
   programs hang everything shape-like on static arguments (batch size,
-  k, chunk, N, the manifold spec tuple); the request batcher
+  k, chunk, N, the manifold spec tuple, the mesh); the request batcher
   (``serve/batcher.py``) pads incoming batches to a small set of
   power-of-two buckets, so the engine compiles once per (bucket, k) and
   then serves any request size out of the same executable —
   ``jax/recompiles`` stays flat (the e2e test asserts it).
 
-Determinism: for a fixed (bucket, k, chunk) the program is one fixed
-XLA executable — the same table bytes give bitwise-identical results,
-which is what lets ``scripts/check_serve_artifact.py`` demand
-export → load → query equals the live model bit-for-bit.
+Determinism: for a fixed (bucket, k, chunk, scan_mode, mesh) the
+program is one fixed XLA executable — the same table bytes give
+bitwise-identical results, which is what lets
+``scripts/check_serve_artifact.py`` demand export → load → query equals
+the live model bit-for-bit.  Across DIFFERENT shardings the distances
+agree but tied distances may order differently (the merge concatenates
+per-shard candidates, not global column order).
 """
 
 from __future__ import annotations
@@ -47,7 +73,10 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
+from hyperspace_tpu.parallel.mesh import shard_map
+from hyperspace_tpu.parallel.sharded_embed import local_gather, table_sharding
 from hyperspace_tpu.serve.artifact import (ServingArtifact, fingerprint_of,
                                            manifold_from_spec)
 
@@ -58,6 +87,8 @@ DEFAULT_TILE_BUDGET = 8 * 1024 * 1024
 # max_bucket); bigger batches just run a proportionally bigger tile.
 NOMINAL_BATCH = 1024
 _ROW_ALIGN = 128
+
+SCAN_MODES = ("two_stage", "carry")
 
 
 def _round_up(n: int, m: int) -> int:
@@ -83,36 +114,139 @@ def _tile_dist(spec: tuple, q: jax.Array, rows: jax.Array) -> jax.Array:
     return m.dist(q[:, None, :], rows[None, :, :])
 
 
-@partial(jax.jit, static_argnames=("spec", "k", "chunk", "n", "exclude_self"))
-def _topk_chunked(table: jax.Array, q_idx: jax.Array, *, spec: tuple,
-                  k: int, chunk: int, n: int, exclude_self: bool):
-    """Running top-k over table chunks; one fixed program per
-    (batch, k, chunk, n, spec)."""
-    q = table[q_idx]  # [B, D]
-    b = q_idx.shape[0]
-    nchunks = table.shape[0] // chunk
+def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
+               n: int, exclude_self: bool, mode: str):
+    """Chunked top-k over ``slab`` rows → ``(dists ascending, ids int32)``,
+    each ``[B, min(k, slab_rows)]`` (a shard narrower than k contributes
+    everything it has; the cross-shard merge restores the full k).
 
-    def body(carry, i):
-        best_d, best_i = carry
-        rows = jax.lax.dynamic_slice_in_dim(table, i * chunk, chunk)
+    ``slab`` is a chunk-multiple row block of the padded table whose
+    global column ids start at ``col0`` (0 on the single-device path,
+    ``axis_index * local_rows`` per shard on the sharded path — may be
+    traced).  Rows at global index >= ``n`` are zero padding and are
+    masked to +inf by index, as is each query's own row under
+    ``exclude_self``.
+    """
+    b = q.shape[0]
+    nchunks = slab.shape[0] // chunk
+    # per-chunk candidate count: a chunk narrower than k keeps ALL its
+    # rows (lax.top_k needs k <= the sorted width)
+    kc = min(k, chunk)
+    # a slab narrower than k (a small shard under a large k) contributes
+    # every row it has; the cross-shard merge restores the full k
+    ko = min(k, nchunks * chunk)
+
+    def masked_tile(i):
+        rows = jax.lax.dynamic_slice_in_dim(slab, i * chunk, chunk)
         d = _tile_dist(spec, q, rows)                     # [B, chunk]
         # pin int32: under x64 the traced chunk offset would promote the
-        # carried index dtype and break the scan carry contract
-        cols = (i * chunk + jnp.arange(chunk)).astype(jnp.int32)
+        # index dtype and break the scan carry/stack contract
+        cols = (col0 + i * chunk + jnp.arange(chunk)).astype(jnp.int32)
         mask = cols[None, :] >= n                         # zero-padded rows
         if exclude_self:
             mask = mask | (cols[None, :] == q_idx[:, None])
-        d = jnp.where(mask, jnp.inf, d)
-        cat_d = jnp.concatenate([best_d, d], axis=1)
-        cat_i = jnp.concatenate(
-            [best_i, jnp.broadcast_to(cols, d.shape)], axis=1)
-        top_negd, sel = jax.lax.top_k(-cat_d, k)
-        return (-top_negd, jnp.take_along_axis(cat_i, sel, axis=1)), None
+        return jnp.where(mask, jnp.inf, d), cols
 
-    init = (jnp.full((b, k), jnp.inf, table.dtype),
-            jnp.full((b, k), -1, jnp.int32))
-    (dist, idx), _ = jax.lax.scan(body, init, jnp.arange(nchunks))
+    if mode == "carry":
+        def body(carry, i):
+            best_d, best_i = carry
+            d, cols = masked_tile(i)
+            cat_d = jnp.concatenate([best_d, d], axis=1)
+            cat_i = jnp.concatenate(
+                [best_i, jnp.broadcast_to(cols, d.shape)], axis=1)
+            top_negd, sel = jax.lax.top_k(-cat_d, ko)
+            return (-top_negd, jnp.take_along_axis(cat_i, sel, axis=1)), None
+
+        init = (jnp.full((b, ko), jnp.inf, slab.dtype),
+                jnp.full((b, ko), -1, jnp.int32))
+        (dist, idx), _ = jax.lax.scan(body, init, jnp.arange(nchunks))
+        return dist, idx
+
+    # two_stage: per-chunk top-kc over [B, chunk] only (never chunk+k),
+    # candidates stacked by the scan, ONE [B, nchunks*kc] merge after it.
+    def body(kth, i):
+        d, cols = masked_tile(i)
+
+        def sort_tile(_):
+            top_negd, sel = jax.lax.top_k(-d, kc)
+            return -top_negd, cols[sel]
+
+        def skip_tile(_):
+            return (jnp.full((b, kc), jnp.inf, d.dtype),
+                    jnp.full((b, kc), -1, jnp.int32))
+
+        # threshold prune: ``kth`` is an upper bound on the true running
+        # k-th distance (the k-th smallest of a union is <= the k-th of
+        # any member chunk), so a tile whose per-row minimum meets it on
+        # EVERY row cannot change the result — skip its sort outright
+        cd, ci = jax.lax.cond(
+            jnp.all(jnp.min(d, axis=1) >= kth), skip_tile, sort_tile, None)
+        if kc == k:  # narrower chunks (kc < k) have no k-th to tighten with
+            kth = jnp.minimum(kth, cd[:, k - 1])  # inf when skipped: no-op
+        return kth, (cd, ci)
+
+    kth0 = jnp.full((b,), jnp.inf, slab.dtype)
+    _, (cd, ci) = jax.lax.scan(body, kth0, jnp.arange(nchunks))
+    cat_d = jnp.moveaxis(cd, 0, 1).reshape(b, nchunks * kc)
+    cat_i = jnp.moveaxis(ci, 0, 1).reshape(b, nchunks * kc)
+    top_negd, sel = jax.lax.top_k(-cat_d, ko)
+    return -top_negd, jnp.take_along_axis(cat_i, sel, axis=1)
+
+
+@partial(jax.jit, static_argnames=("spec", "k", "chunk", "n", "exclude_self",
+                                   "mode"))
+def _topk_chunked(table: jax.Array, q_idx: jax.Array, *, spec: tuple,
+                  k: int, chunk: int, n: int, exclude_self: bool,
+                  mode: str = "two_stage"):
+    """Single-device chunked top-k; one fixed program per
+    (batch, k, chunk, n, spec, mode)."""
+    q = table[q_idx]  # [B, D]
+    dist, idx = _scan_topk(table, q, q_idx, 0, spec=spec, k=k, chunk=chunk,
+                           n=n, exclude_self=exclude_self, mode=mode)
     return idx, dist
+
+
+@partial(jax.jit, static_argnames=("spec", "k", "chunk", "n", "exclude_self",
+                                   "mode", "mesh", "axis"))
+def _topk_sharded(table: jax.Array, q_idx: jax.Array, *, spec: tuple,
+                  k: int, chunk: int, n: int, exclude_self: bool,
+                  mode: str, mesh, axis: str):
+    """Mesh-sharded top-k: per-shard chunked scan + one merge.
+
+    ``table`` is the padded table laid out ``P(axis, None)`` (each of
+    the S devices owns ``padded/S`` rows — a chunk multiple).  Per
+    device: assemble the [B, D] query rows with the gather-owned-rows +
+    psum trick (``parallel/sharded_embed.local_gather`` — one B×D
+    all-reduce), scan the LOCAL shard with shard-local column offsets,
+    then all-gather the per-shard [B, k] winners (S·k·B elements — tiny
+    next to the table) and take the final merge top-k everywhere, so
+    the output is replicated.
+    """
+    npad = table.shape[0]
+
+    def local(tloc, qi):
+        q = local_gather(tloc, qi, npad, axis)            # [B, D]
+        lo = (jax.lax.axis_index(axis) * tloc.shape[0]).astype(jnp.int32)
+        d, i = _scan_topk(tloc, q, qi, lo, spec=spec, k=k, chunk=chunk,
+                          n=n, exclude_self=exclude_self, mode=mode)
+        gd = jax.lax.all_gather(d, axis)                  # [S, B, k]
+        gi = jax.lax.all_gather(i, axis)
+        b = qi.shape[0]
+        cat_d = jnp.moveaxis(gd, 0, 1).reshape(b, -1)     # [B, S*k]
+        cat_i = jnp.moveaxis(gi, 0, 1).reshape(b, -1)
+        top_negd, sel = jax.lax.top_k(-cat_d, k)
+        return jnp.take_along_axis(cat_i, sel, axis=1), -top_negd
+
+    run = shard_map(local, mesh=mesh, in_specs=(P(axis, None), P()),
+                    out_specs=(P(), P()), check_vma=False)
+    return run(table, q_idx)
+
+
+def _fermi_dirac(d: jax.Array, r, t) -> jax.Array:
+    """The HGCN LP head's link decoder — the ONE definition both the
+    single-device and sharded scoring programs trace, so the 1-device
+    bitwise guarantee can never mask a divergence between copies."""
+    return 1.0 / (jnp.exp((jnp.square(d) - r) / t) + 1.0)
 
 
 @partial(jax.jit, static_argnames=("spec", "prob"))
@@ -124,29 +258,73 @@ def _edge_dist(table: jax.Array, u_idx: jax.Array, v_idx: jax.Array,
         # Fermi–Dirac decoder INSIDE the jitted program: one dispatch
         # per scoring request, not one per arithmetic op (fd_r/fd_t are
         # traced scalars — changing them never recompiles)
-        d = 1.0 / (jnp.exp((jnp.square(d) - fd_r) / fd_t) + 1.0)
+        d = _fermi_dirac(d, fd_r, fd_t)
     return d
+
+
+@partial(jax.jit, static_argnames=("spec", "prob", "mesh", "axis"))
+def _edge_dist_sharded(table: jax.Array, u_idx: jax.Array, v_idx: jax.Array,
+                       fd_r, fd_t, *, spec: tuple, prob: bool, mesh,
+                       axis: str) -> jax.Array:
+    """Edge scoring over a row-sharded table: two psum gathers assemble
+    the endpoint rows, then the distance math runs replicated."""
+    npad = table.shape[0]
+
+    def local(tloc, u, v, r, t):
+        xu = local_gather(tloc, u, npad, axis)
+        xv = local_gather(tloc, v, npad, axis)
+        m = manifold_from_spec(spec)
+        d = m.dist(xu, xv)
+        if prob:
+            d = _fermi_dirac(d, r, t)
+        return d
+
+    run = shard_map(local, mesh=mesh,
+                    in_specs=(P(axis, None), P(), P(), P(), P()),
+                    out_specs=P(), check_vma=False)
+    return run(table, u_idx, v_idx, jnp.asarray(fd_r), jnp.asarray(fd_t))
 
 
 class QueryEngine:
     """Batched k-NN / edge-score queries over one frozen table.
 
-    ``table`` is moved to device once (zero-padded to a chunk multiple);
-    every query after that is a single jitted dispatch.  Construct via
-    :meth:`from_artifact` for the serving path, or directly on a live
-    table (tests, the round-trip lint).
+    ``table`` is moved to device once (zero-padded to a chunk multiple;
+    with a ``mesh`` it is row-sharded over ``mesh_axis`` and padded to a
+    chunk-per-shard multiple); every query after that is a single jitted
+    dispatch.  Construct via :meth:`from_artifact` for the serving path,
+    or directly on a live table (tests, the round-trip lint).
+
+    ``scan_mode`` picks the chunk-scan strategy (``"two_stage"``
+    default, ``"carry"`` for the original running-top-k variant — see
+    the module docstring).  ``mesh=None`` (or a mesh whose model axis
+    has one device) runs the single-device program.
     """
 
     def __init__(self, table, manifold_spec: tuple, *,
                  fingerprint: Optional[str] = None,
                  chunk_rows: int = 0,
-                 tile_budget: int = DEFAULT_TILE_BUDGET):
+                 tile_budget: int = DEFAULT_TILE_BUDGET,
+                 mesh=None, mesh_axis: str = "model",
+                 scan_mode: str = "two_stage"):
         table = np.ascontiguousarray(np.asarray(table))
         if table.ndim != 2:
             raise ValueError(f"table must be [N, D]; got {table.shape}")
+        if scan_mode not in SCAN_MODES:
+            raise ValueError(
+                f"scan_mode must be one of {SCAN_MODES}; got {scan_mode!r}")
         self.num_nodes, self.dim = (int(s) for s in table.shape)
         self.spec = tuple(manifold_spec)
+        self.scan_mode = scan_mode
         self.fingerprint = fingerprint or fingerprint_of(table, self.spec)
+        self.mesh, self.mesh_axis = mesh, mesh_axis
+        shards = 1
+        if mesh is not None:
+            if mesh_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"mesh has no {mesh_axis!r} axis (axes: "
+                    f"{mesh.axis_names}); pass mesh_axis=")
+            shards = int(mesh.shape[mesh_axis])
+        self.shards = shards
         chunk_rows = int(chunk_rows)
         if chunk_rows < 0:
             # a negative chunk would make the scan run ZERO chunks and
@@ -155,12 +333,20 @@ class QueryEngine:
                              f"got {chunk_rows}")
         self.chunk_rows = chunk_rows or auto_chunk_rows(
             self.dim, self.spec[0], self.num_nodes, tile_budget)
-        padded = _round_up(self.num_nodes, self.chunk_rows)
+        # each shard's slab must itself be a chunk multiple, so the
+        # padded table is a (chunk × shards) multiple (shards=1: the
+        # original chunk-multiple padding, bit-identical layout)
+        padded = _round_up(self.num_nodes, self.chunk_rows * shards)
         if padded > self.num_nodes:
             table = np.concatenate(
                 [table, np.zeros((padded - self.num_nodes, self.dim),
                                  table.dtype)], axis=0)
-        self.table = jnp.asarray(table)  # [padded, D] device-resident
+        if shards > 1:
+            # [padded, D] row-sharded: each device holds padded/S rows
+            self.table = jax.device_put(
+                table, table_sharding(mesh, mesh_axis))
+        else:
+            self.table = jnp.asarray(table)  # [padded, D] device-resident
 
     @classmethod
     def from_artifact(cls, art: ServingArtifact, **kw) -> "QueryEngine":
@@ -183,9 +369,15 @@ class QueryEngine:
             raise ValueError(
                 f"k={k} out of range [1, {limit}] for a {self.num_nodes}-row "
                 f"table (exclude_self={exclude_self})")
+        if self.shards > 1:
+            return _topk_sharded(
+                self.table, q_idx, spec=self.spec, k=k,
+                chunk=self.chunk_rows, n=self.num_nodes,
+                exclude_self=exclude_self, mode=self.scan_mode,
+                mesh=self.mesh, axis=self.mesh_axis)
         idx, dist = _topk_chunked(
             self.table, q_idx, spec=self.spec, k=k, chunk=self.chunk_rows,
-            n=self.num_nodes, exclude_self=exclude_self)
+            n=self.num_nodes, exclude_self=exclude_self, mode=self.scan_mode)
         return idx, dist
 
     def score_edges(self, u_idx, v_idx, *, prob: bool = False,
@@ -201,6 +393,10 @@ class QueryEngine:
         if u_idx.shape != v_idx.shape:
             raise ValueError(
                 f"u_idx {u_idx.shape} and v_idx {v_idx.shape} must match")
+        if self.shards > 1:
+            return _edge_dist_sharded(self.table, u_idx, v_idx, fd_r, fd_t,
+                                      spec=self.spec, prob=bool(prob),
+                                      mesh=self.mesh, axis=self.mesh_axis)
         return _edge_dist(self.table, u_idx, v_idx, fd_r, fd_t,
                           spec=self.spec, prob=bool(prob))
 

@@ -4,10 +4,14 @@
 The counter catalog in docs/observability.md is the contract consumers
 (dashboards, the bench, humans reading a JSONL) rely on; an undocumented
 counter is invisible telemetry.  This script scans every ``.py`` under
-``hyperspace_tpu/`` for literal ``inc("name")`` / ``set_gauge("name")``
-calls and fails (exit 1, listing offenders) unless each name appears in
-the catalog doc.  Run by ``tests/telemetry/test_catalog.py`` inside the
-suite, so adding a counter without its doc row fails the build.
+``hyperspace_tpu/`` — plus the repo-root ``bench.py``, which reads
+registry names of its own (the ``serve_qps`` leg) — for literal
+``inc("name")`` / ``set_gauge("name")`` calls AND namespaced
+``get("ns/name")`` reads, and fails (exit 1, listing offenders) unless
+each name appears in the catalog doc — so a consumer reading a typo'd
+counter (which silently returns 0) fails the lint too.  Run by
+``tests/telemetry/test_catalog.py`` inside the suite, so adding a
+counter without its doc row fails the build.
 
 Dynamically-built names can't be scanned; keep registry names literal
 (they are today) or add the doc row and a ``# telemetry-catalog: name``
@@ -21,6 +25,11 @@ import re
 import sys
 
 _CALL = re.compile(r"""\b(?:inc|set_gauge)\(\s*["']([^"']+)["']""")
+# registry READS too: get("ns/name") / snapshot-dict .get("ns/name").
+# Requiring a "/" keeps ordinary dict .get("key") calls out — every
+# registry name is namespaced, plain dict keys are not — so a consumer
+# reading a typo'd (hence undocumented) counter name fails the lint.
+_READ = re.compile(r"""\bget\(\s*["']([^"'\s]+/[^"'\s]+)["']""")
 _ANNOT = re.compile(r"#\s*telemetry-catalog:\s*(\S+)")
 
 
@@ -28,21 +37,29 @@ def repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _scan_file(path: str, rel: str, found: dict[str, list[str]]) -> None:
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            for rx in (_CALL, _READ, _ANNOT):
+                for m in rx.finditer(line):
+                    found.setdefault(m.group(1), []).append(f"{rel}:{lineno}")
+
+
 def counters_in_code(pkg_dir: str) -> dict[str, list[str]]:
-    """{counter name: [file:line, ...]} for every literal registry call."""
+    """{counter name: [file:line, ...]} for every literal registry call
+    under the package, plus the repo-root ``bench.py`` (its serve leg
+    participates in the same registry)."""
     found: dict[str, list[str]] = {}
     for root, _dirs, files in os.walk(pkg_dir):
         for name in files:
             if not name.endswith(".py"):
                 continue
             path = os.path.join(root, name)
-            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    for rx in (_CALL, _ANNOT):
-                        for m in rx.finditer(line):
-                            found.setdefault(m.group(1), []).append(
-                                f"{rel}:{lineno}")
+            _scan_file(path, os.path.relpath(path, os.path.dirname(pkg_dir)),
+                       found)
+    bench = os.path.join(os.path.dirname(pkg_dir), "bench.py")
+    if os.path.exists(bench):
+        _scan_file(bench, "bench.py", found)
     return found
 
 

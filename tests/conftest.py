@@ -34,3 +34,29 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def pytest_runtest_protocol(item, nextitem):
+    """Strict single rerun for ``@pytest.mark.flaky`` tests.
+
+    A test carrying the marker gets ONE retry when its first attempt
+    fails (fresh setup/teardown both times); only the final attempt is
+    reported.  Two consecutive failures fail the run exactly like an
+    unmarked test — the marker absorbs a known stochastic threshold
+    (e.g. the sampled-LP AUC-improvement assertion), it does not hide a
+    real regression, which fails twice in a row.  Markers must cite the
+    flake they cover in a comment at the use site."""
+    if item.get_closest_marker("flaky") is None:
+        return None
+    from _pytest.runner import runtestprotocol
+
+    item.ihook.pytest_runtest_logstart(nodeid=item.nodeid,
+                                       location=item.location)
+    reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    if any(r.failed for r in reports):
+        reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    for report in reports:
+        item.ihook.pytest_runtest_logreport(report=report)
+    item.ihook.pytest_runtest_logfinish(nodeid=item.nodeid,
+                                        location=item.location)
+    return True

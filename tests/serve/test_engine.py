@@ -139,6 +139,54 @@ def test_validation_errors(rng):
         eng.topk_neighbors(np.asarray([], np.int32), 2)
 
 
+@pytest.mark.parametrize("build", ["poincare", "lorentz", "product"])
+def test_two_stage_matches_carry_and_oracle(rng, build):
+    """The two-stage scan (per-chunk top-k + one post-scan merge, with
+    the threshold-prune fast path) and the carry scan (running top-k,
+    re-sort [B, chunk+k] per step) agree with each other exactly and
+    with the f64 manifold oracle on every supported spec (ISSUE 4)."""
+    if build == "product":
+        table, man = _product_table(rng, 300)
+        q = np.asarray([0, 7, 150, 299], np.int32)
+    else:
+        table, man = (_poincare_table if build == "poincare"
+                      else _lorentz_table)(rng, 300, 6, 1.3)
+        q = np.asarray([0, 3, 17, 150, 299], np.int32)
+    spec = spec_from_manifold(man)
+    # chunk 128 < N: the scan really runs multiple chunks + a merge
+    two = QueryEngine(table, spec, chunk_rows=128, scan_mode="two_stage")
+    car = QueryEngine(table, spec, chunk_rows=128, scan_mode="carry")
+    i_two, d_two = (np.asarray(a) for a in two.topk_neighbors(q, 7))
+    i_car, d_car = (np.asarray(a) for a in car.topk_neighbors(q, 7))
+    assert np.array_equal(i_two, i_car)
+    np.testing.assert_array_equal(d_two, d_car)
+    ref_idx, ref_dist = _reference_topk(man, table, q, 7)
+    assert np.array_equal(i_two, ref_idx)
+    np.testing.assert_allclose(d_two, ref_dist, rtol=2e-3, atol=2e-3)
+
+
+def test_two_stage_prune_layout_stays_correct(rng):
+    """A norm-sorted table with near-origin queries makes every late
+    chunk prunable (its row-min exceeds the running k-th bound) — the
+    fast path must skip the sorts without changing a single answer."""
+    table, man = _poincare_table(rng, 600, 5, 1.0)
+    order = np.argsort(np.linalg.norm(table, axis=1))
+    table = np.ascontiguousarray(table[order])
+    spec = spec_from_manifold(man)
+    q = np.asarray([0, 1, 5], np.int32)  # nearest-origin rows
+    two = QueryEngine(table, spec, chunk_rows=128, scan_mode="two_stage")
+    i, d = (np.asarray(a) for a in two.topk_neighbors(q, 6))
+    ref_idx, ref_dist = _reference_topk(man, table, q, 6)
+    assert np.array_equal(i, ref_idx)
+    np.testing.assert_allclose(d, ref_dist, rtol=2e-3, atol=2e-3)
+
+
+def test_bad_scan_mode_rejected(rng):
+    table, man = _poincare_table(rng, 8, 3, 1.0)
+    with pytest.raises(ValueError, match="scan_mode"):
+        QueryEngine(table, spec_from_manifold(man), scan_mode="bogus")
+
+
 def test_auto_chunk_rows_budget():
     # kernel path: rows independent of D; product path shrinks with D
     assert auto_chunk_rows(10, "poincare", 10_000_000) \

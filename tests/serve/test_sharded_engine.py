@@ -1,0 +1,112 @@
+"""Sharded-serving equivalence (ISSUE 4 tentpole): the mesh-sharded
+k-NN/scoring programs answer exactly what the single-device engine
+answers — bitwise on a 1-device mesh (the fallback IS the single-device
+program), and up to distance ties on a real multi-device mesh (the
+merge concatenates per-shard candidates, not global column order).
+All meshes here live on the conftest's 8 fake CPU devices."""
+
+import numpy as np
+import pytest
+import jax
+
+from hyperspace_tpu.parallel.mesh import model_mesh
+from hyperspace_tpu.serve.artifact import spec_from_manifold
+from hyperspace_tpu.serve.engine import QueryEngine
+
+from .test_engine import (_lorentz_table, _poincare_table, _product_table,
+                          _reference_topk)
+
+
+def test_one_device_mesh_is_bitwise_single_device(rng):
+    """The documented fallback: a mesh whose model axis has ONE device
+    runs the single-device executable — indices AND distance bits equal."""
+    table, man = _poincare_table(rng, 300, 6, 1.3)
+    spec = spec_from_manifold(man)
+    q = np.asarray([0, 3, 17, 150, 299], np.int32)
+    plain = QueryEngine(table, spec, chunk_rows=128)
+    meshed = QueryEngine(table, spec, chunk_rows=128, mesh=model_mesh(1))
+    assert meshed.shards == 1
+    i1, d1 = (np.asarray(a) for a in plain.topk_neighbors(q, 7))
+    i2, d2 = (np.asarray(a) for a in meshed.topk_neighbors(q, 7))
+    assert np.array_equal(i1, i2)
+    assert np.array_equal(np.asarray(d1).view(np.uint32),
+                          np.asarray(d2).view(np.uint32))  # bitwise
+    s1 = np.asarray(plain.score_edges(q[:-1], q[1:]))
+    s2 = np.asarray(meshed.score_edges(q[:-1], q[1:]))
+    assert np.array_equal(s1.view(np.uint64), s2.view(np.uint64))
+
+
+@pytest.mark.parametrize("build", ["poincare", "lorentz", "product"])
+@pytest.mark.parametrize("mode", ["two_stage", "carry"])
+def test_sharded_matches_single_device(rng, build, mode):
+    """4-way sharded scan + all-gather merge == single device, on every
+    manifold spec and both scan modes (and == the f64 oracle)."""
+    if build == "product":
+        table, man = _product_table(rng, 300)
+        q = np.asarray([0, 7, 150, 299], np.int32)
+    else:
+        table, man = (_poincare_table if build == "poincare"
+                      else _lorentz_table)(rng, 300, 6, 1.3)
+        q = np.asarray([0, 3, 17, 150, 299], np.int32)
+    spec = spec_from_manifold(man)
+    single = QueryEngine(table, spec, chunk_rows=128, scan_mode=mode)
+    shard = QueryEngine(table, spec, chunk_rows=128, scan_mode=mode,
+                        mesh=model_mesh(4))
+    assert shard.shards == 4
+    # padded to a chunk-per-shard multiple: each device owns 128 rows
+    assert shard.table.shape[0] == 512
+    i1, d1 = (np.asarray(a) for a in single.topk_neighbors(q, 7))
+    i2, d2 = (np.asarray(a) for a in shard.topk_neighbors(q, 7))
+    # random tables have no distance ties: indices agree exactly; the
+    # per-element distance math is identical tile math on both layouts
+    assert np.array_equal(i1, i2)
+    np.testing.assert_allclose(d1, d2, rtol=1e-6, atol=1e-7)
+    ref_idx, ref_dist = _reference_topk(man, table, q, 7)
+    assert np.array_equal(i2, ref_idx)
+    np.testing.assert_allclose(d2, ref_dist, rtol=2e-3, atol=2e-3)
+
+
+def test_sharded_drains_table_and_hides_padding(rng):
+    """k = N−1 across 4 shards: every real row surfaces exactly once,
+    none of the 212 zero-padded rows ever does, self stays excluded."""
+    table, man = _poincare_table(rng, 300, 5, 1.0)
+    eng = QueryEngine(table, spec_from_manifold(man), chunk_rows=128,
+                      mesh=model_mesh(4))
+    idx, dist = (np.asarray(a) for a in
+                 eng.topk_neighbors(np.asarray([4], np.int32), 299))
+    assert sorted(idx[0].tolist()) == [i for i in range(300) if i != 4]
+    assert np.all(np.isfinite(dist))
+
+
+def test_sharded_score_edges_matches(rng):
+    table, man = _lorentz_table(rng, 60, 5, 0.8)
+    spec = spec_from_manifold(man)
+    single = QueryEngine(table, spec)
+    shard = QueryEngine(table, spec, mesh=model_mesh(4))
+    u = np.asarray([0, 5, 9, 33], np.int32)
+    v = np.asarray([1, 7, 20, 59], np.int32)
+    np.testing.assert_allclose(np.asarray(single.score_edges(u, v)),
+                               np.asarray(shard.score_edges(u, v)),
+                               rtol=1e-6, atol=1e-7)
+    p1 = np.asarray(single.score_edges(u, v, prob=True, fd_r=1.5, fd_t=0.7))
+    p2 = np.asarray(shard.score_edges(u, v, prob=True, fd_r=1.5, fd_t=0.7))
+    np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-7)
+
+
+def test_mesh_without_model_axis_rejected(rng):
+    from hyperspace_tpu.parallel.mesh import make_mesh
+
+    table, man = _poincare_table(rng, 16, 3, 1.0)
+    data_mesh = make_mesh({"data": 2}, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="model"):
+        QueryEngine(table, spec_from_manifold(man), mesh=data_mesh)
+
+
+def test_model_mesh_validation():
+    n = len(jax.devices())
+    assert model_mesh(-1).shape["model"] == n
+    assert model_mesh(2).shape["model"] == 2
+    with pytest.raises(ValueError, match="out of range"):
+        model_mesh(0)
+    with pytest.raises(ValueError, match="out of range"):
+        model_mesh(n + 1)

@@ -1,0 +1,16 @@
+"""The strict-rerun ``flaky`` marker (tests/conftest.py) really retries:
+this test FAILS its first attempt on purpose and passes the second — a
+broken/removed hook surfaces immediately as a red test, not as a
+silently-flaky tier-1 signal."""
+
+import pytest
+
+_attempts = {"n": 0}
+
+
+@pytest.mark.flaky
+def test_flaky_marker_gives_exactly_one_retry():
+    _attempts["n"] += 1
+    assert _attempts["n"] == 2, (
+        "first attempt fails by design; the strict-rerun hook must run "
+        "the test a second time and report only that attempt")
