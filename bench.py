@@ -363,6 +363,9 @@ def bench_serve(repeats: int = 2) -> dict:
     detail = {
         "num_nodes": n, "dim": dim, "k": k, "buckets": list(bat.buckets),
         "chunk_rows": eng.chunk_rows, "scan_mode": eng.scan_mode,
+        # scan precision + table dtype as executed: BENCH_r* serve_qps
+        # trajectories must be comparable across precision modes
+        "precision": eng.precision, "dtype": str(table.dtype),
         "recompiles_warmup": c1 - c0, "backend": jax.default_backend(),
     }
     best = 0.0
@@ -402,6 +405,81 @@ def bench_serve(repeats: int = 2) -> dict:
             "unit": "queries/s", "vs_baseline": None, "detail": detail}
 
 
+def bench_precision(repeats: int = 2) -> dict:
+    """f32-vs-bf16 timing pairs on the SAME shapes (docs/precision.md).
+
+    Two legs, each run under both precision presets so the pair in one
+    artifact is an apples-to-apples MXU/bandwidth comparison:
+
+    - **train step**: the HVAE sampled step (the policy's biggest train
+      win — the conv/dense stacks are the model's whole MXU mass; the
+      manifold latent stays f32 under both presets);
+    - **serve scan**: one warm ``topk_neighbors`` batch over a synthetic
+      Poincaré table — f32 scan vs bf16-scan + f32-rescore
+      (``serve/engine.py`` precision modes).
+
+    Value = train-step speedup (f32 ms / bf16 ms; > 1 means bf16 wins).
+    On CPU backends bf16 often does NOT win — the pair is recorded
+    either way so the trajectory is honest per backend.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hyperspace_tpu.manifolds import PoincareBall
+    from hyperspace_tpu.models import hvae
+    from hyperspace_tpu.serve.engine import QueryEngine
+
+    rng = np.random.default_rng(0)
+    n_steps = 10
+    images = rng.random((1024, 28, 28)).astype(np.float32)
+    train = {}
+    for name in ("f32", "bf16"):
+        cfg = hvae.HVAEConfig(precision=name, batch_size=256)
+        model, opt, state = hvae.init_model(cfg, seed=0)
+        x_all = jnp.asarray(images, cfg.dtype)
+        t, _ = _time_steps(
+            lambda st: hvae.train_step_sampled(model, opt, st, x_all)[:2],
+            state, n_steps, max(2, repeats))
+        train[name] = round(t / n_steps * 1e3, 3)
+
+    n, dim, k, b = 20_000, 16, 10, 256
+    table = np.asarray(PoincareBall(1.0).expmap0(
+        jnp.asarray(rng.standard_normal((n, dim)) * 0.3, jnp.float32)))
+    q = jnp.asarray(rng.integers(0, n, size=b), jnp.int32)
+    serve = {}
+    for name in ("f32", "bf16"):
+        eng = QueryEngine(table, ("poincare", 1.0), precision=name)
+        _, d = eng.topk_neighbors(q, k)  # compile + warm
+        jax.device_get(d)
+        times = []
+        for _ in range(max(2, repeats)):
+            t0 = time.perf_counter()
+            _, d = eng.topk_neighbors(q, k)
+            jax.device_get(d)
+            times.append(time.perf_counter() - t0)
+        serve[name] = round(min(times) * 1e3, 3)
+
+    return {
+        "metric": "precision_train_speedup",
+        "value": round(train["f32"] / max(train["bf16"], 1e-9), 3),
+        "unit": "x (f32 ms / bf16 ms)",
+        "vs_baseline": None,
+        "detail": {
+            "train_workload": "hvae",
+            "train_batch": 256,
+            "train_step_ms": train,
+            "serve_table": [n, dim],
+            "serve_batch": b,
+            "serve_k": k,
+            "serve_scan_ms": serve,
+            "serve_speedup": round(
+                serve["f32"] / max(serve["bf16"], 1e-9), 3),
+            "backend": jax.default_backend(),
+        },
+    }
+
+
 def _get(d, *path):
     """Nested dict lookup returning None on any missing key."""
     for k in path:
@@ -426,6 +504,8 @@ _COMPACT_FIELDS = (
     ("timed_out_legs", ("detail", "timed_out_legs")),
     ("serve_qps", ("detail", "serve", "qps")),
     ("serve_recompiles_steady", ("detail", "serve", "recompiles_steady")),
+    ("precision_train_ms", ("detail", "precision", "train_step_ms")),
+    ("precision_serve_ms", ("detail", "precision", "serve_scan_ms")),
     ("frac_clustered", ("detail", "frac_clustered")),
     ("num_nodes", ("detail", "num_nodes")),
     ("devices", ("detail", "devices")),
@@ -671,6 +751,11 @@ def main() -> None:
                 r = bench_serve(repeats=max(1, args.repeats - 1))
                 d["serve"] = {"qps": r["value"], **r["detail"]}
 
+            def precision_leg(d):  # f32/bf16 pairs, tracked from PR 5 on
+                r = bench_precision(repeats=max(1, args.repeats - 1))
+                d["precision"] = {"train_speedup": r["value"],
+                                  **r["detail"]}
+
             def use_att_leg(d):
                 # the attention arm on the same graph/protocol (VERDICT
                 # r3 #1).  Distinct key: detail["use_att"] is the
@@ -696,6 +781,7 @@ def main() -> None:
             leg("poincare", 60, poincare_leg)
             leg("hgcn_sampled", 45, sampled_leg)
             leg("serve_qps", 40, serve_leg)
+            leg("precision", 40, precision_leg)
             leg("realistic", 150, realistic_leg)
             leg("workloads", 90, workloads_leg)
             leg("use_att_arm", 0 if args.use_att else 120, use_att_leg)

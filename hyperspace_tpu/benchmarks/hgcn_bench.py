@@ -167,10 +167,10 @@ def run_hgcn_bench(
     data_root: str | None = None,
     num_nodes: int = ARXIV_NODES,
     dtype: str = "float32",
-    agg_dtype: str = "bfloat16",
+    agg_dtype: str = "bfloat16",  # precision-policy: ok (CLI flag name)
     use_att: bool = False,
     step: str = "pairs",  # "lp" | "pairs" (fully-planned decoder scatters)
-    decoder_dtype: str | None = "bfloat16",
+    decoder_dtype: str | None = "bfloat16",  # precision-policy: ok (flag)
 ) -> dict:
     """The default config — pairs step, f32 compute, bf16 edge messages
     and bf16 decoder pass (everything accumulates f32) — is the r02 bench
@@ -197,18 +197,18 @@ def run_hgcn_bench(
     else:
         split, x = arxiv_scale_split(num_nodes, cluster_min_pair=cmp_)
         source = "synthetic"
+    from hyperspace_tpu.precision import parse_dtype
+
     cfg = hgcn.HGCNConfig(
         feat_dim=x.shape[1], hidden_dims=(128, 32), kind="lorentz",
         use_att=use_att,
-        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
+        dtype=parse_dtype(dtype),
         # explicit f32 (not None): "--agg-dtype float32" must force f32
         # messages even when the compute dtype is bf16
-        agg_dtype=jnp.bfloat16 if agg_dtype == "bfloat16" else jnp.float32,
+        agg_dtype=parse_dtype(agg_dtype),
         # like agg_dtype: explicit "float32" must force an f32 decoder
         # pass even when the compute dtype is bf16; None inherits dtype
-        decoder_dtype=(jnp.bfloat16 if decoder_dtype == "bfloat16"
-                       else jnp.float32 if decoder_dtype == "float32"
-                       else None))
+        decoder_dtype=parse_dtype(decoder_dtype))
     if use_att:  # shipped attention-mode defaults (run_realistic_bench note)
         from hyperspace_tpu.cli.train import hgcn_mode_defaults
 
@@ -268,6 +268,9 @@ def run_hgcn_bench(
             # cfg.decoder_dtype (HGCNLinkPred casts z whenever
             # deterministic=False), so the record is the flag as executed
             "decoder_dtype": decoder_dtype,
+            # precision mode as executed, so BENCH_r* trajectories stay
+            # comparable across precision configs (docs/precision.md)
+            "precision": cfg.precision,
         },
     }
 
@@ -356,10 +359,11 @@ def run_realistic_bench(repeats: int = 2, steps_per_repeat: int = 10,
         key = "att" if use_att else "mean"
         out[f"{key}_frac_clustered"] = round(
             g_.cluster_split.frac_clustered, 4)
+        # precision="bf16" maps to the same bf16 agg/decoder lanes via
+        # the policy (HGCNConfig.resolved_*_dtype) — no ad-hoc literals
         cfg = hgcn.HGCNConfig(
             feat_dim=x.shape[1], hidden_dims=(128, 32), kind="lorentz",
-            use_att=use_att, agg_dtype=jnp.bfloat16,
-            decoder_dtype=jnp.bfloat16)
+            use_att=use_att, precision="bf16")
         if use_att:
             # the shipped attention-mode defaults (ONE source of truth —
             # cli.hgcn_mode_defaults): at the full-graph lr=1e-2 the

@@ -90,6 +90,7 @@ def run_workloads_bench(repeats: int = 4, steps: int = 10) -> dict:
         "batch": [cfg.batch_size, cfg.max_len],
         "dim": cfg.dim, "layers": cfg.num_layers,
         "attention_impl": cfg.attention_impl,
+        "precision": cfg.precision,
         **roof,
     }
 
@@ -138,6 +139,7 @@ def run_workloads_bench(repeats: int = 4, steps: int = 10) -> dict:
             hcfg.batch_size / (scan_ms / 1e3), 1),
         "batch": [hcfg.batch_size, hcfg.image_size, hcfg.image_size],
         "kind": hcfg.kind,
+        "precision": hcfg.precision,
         **roof,
     }
 
@@ -157,6 +159,7 @@ def run_workloads_bench(repeats: int = 4, steps: int = 10) -> dict:
             pcfg.batch_size / (scan_ms / 1e3), 1),
         "num_nodes": tree.num_nodes,
         "factors": [list(f) for f in pcfg.factors],
+        "precision": pcfg.precision,
         **roof,
     }
     return out

@@ -78,6 +78,9 @@ class ServeConfig:
     # A 1-device mesh runs the single-device program (bit-compatible).
     mesh: int = 0
     scan_mode: str = "two_stage"  # two_stage | carry (A/B; docs/serving.md)
+    # table-scan precision: f32 (default, bit-identical) | bf16 (scan a
+    # bf16 table copy, rescore candidates in f32 — docs/precision.md)
+    precision: str = "f32"
 
 
 def _ids(s: str, name: str) -> list[int]:
@@ -108,8 +111,9 @@ def _build(cfg: ServeConfig):
     art = load_artifact(cfg.artifact)
     try:
         eng = QueryEngine.from_artifact(art, chunk_rows=cfg.chunk_rows,
-                                        mesh=mesh, scan_mode=cfg.scan_mode)
-    except ValueError as e:  # bad scan_mode/chunk_rows: a usage error
+                                        mesh=mesh, scan_mode=cfg.scan_mode,
+                                        precision=cfg.precision)
+    except ValueError as e:  # bad scan_mode/chunk_rows/precision: usage
         raise SystemExit(str(e)) from None
     return eng, RequestBatcher(eng, min_bucket=cfg.min_bucket,
                                max_bucket=cfg.max_bucket,

@@ -57,6 +57,12 @@ class RunConfig:
     # >1: accumulate this many microbatch gradients per optimizer update
     # (hybonet/hvae; optax.MultiSteps — `steps` counts microsteps)
     accum: int = 1
+    # mixed-precision policy preset (hyperspace_tpu/precision.py,
+    # docs/precision.md): "f32" (default, bit-identical to a pre-policy
+    # build) or "bf16" (compute in bf16; params, manifold boundary math
+    # and reductions stay f32).  Copied into the workload config's own
+    # `precision` field unless that is overridden explicitly.
+    precision: str = "f32"
     # --- telemetry (docs/observability.md) -----------------------------
     # telemetry=1: run manifest as the FIRST JSONL record, span/* host
     # timings + ctr/* counter snapshots in every log record, and a final
@@ -173,13 +179,23 @@ def _chunk_run(run: RunConfig) -> RunConfig:
 def _chunked(run: RunConfig, step_fn):
     """(stepper, steps_per_call): ``step_fn`` wrapped for chunked
     dispatch when ``run.scan_chunk > 1`` (one lax.scan program per
-    ``scan_chunk`` steps, state donated), unchanged otherwise."""
+    ``scan_chunk`` steps, state donated), unchanged otherwise.  The
+    run's precision policy rides into the chunk program (its arg-cast
+    hook is a no-op for the CLI's closure-style steppers, but keeps the
+    contract uniform for library callers — train/loop.py)."""
     k = max(int(run.scan_chunk), 1)
     if k <= 1:
         return step_fn, 1
     from hyperspace_tpu.train import loop
 
-    return loop.make_chunked_stepper(step_fn, k), k
+    return loop.make_chunked_stepper(step_fn, k, policy=run.precision), k
+
+
+def _precision_default(run: RunConfig, overrides: dict) -> dict:
+    """Copy the run-level ``precision=`` into the workload config unless
+    the workload override set it explicitly (explicit wins)."""
+    overrides.setdefault("precision", run.precision)
+    return overrides
 
 
 def run_poincare(run: RunConfig, overrides: dict):
@@ -192,7 +208,8 @@ def run_poincare(run: RunConfig, overrides: dict):
     else:
         ds = wordnet.synthetic_tree(depth=5, branching=4)
     cfg = apply_overrides(
-        pe.PoincareEmbedConfig(num_nodes=ds.num_nodes), overrides)
+        pe.PoincareEmbedConfig(num_nodes=ds.num_nodes),
+        _precision_default(run, overrides))
     state, opt = pe.init_state(cfg, run.seed)
     pairs = jnp.asarray(ds.pairs)
     from hyperspace_tpu.manifolds import PoincareBall
@@ -327,7 +344,7 @@ def run_hgcn(run: RunConfig, overrides: dict):
         hgcn.HGCNConfig(feat_dim=x.shape[1],
                         num_classes=ncls if task == "nc" else 0),
         overrides, sampled)
-    cfg = apply_overrides(base, overrides)
+    cfg = apply_overrides(base, _precision_default(run, overrides))
     num_nodes = x.shape[0]
     from hyperspace_tpu.parallel.mesh import auto_mesh
 
@@ -466,7 +483,7 @@ def run_hybonet(run: RunConfig, overrides: dict):
         hybonet.HyboNetConfig(vocab_size=ds.vocab_size,
                               num_classes=ds.num_classes,
                               max_len=ds.tokens.shape[1]),
-        overrides)
+        _precision_default(run, overrides))
     model, opt, state = hybonet.init_model(cfg, seed=run.seed)
     opt, state = _maybe_accum(run, opt, state)
     toks, mask, labels = (jnp.asarray(tr.tokens), jnp.asarray(tr.mask),
@@ -496,7 +513,8 @@ def run_hvae(run: RunConfig, overrides: dict):
     from hyperspace_tpu.models import hvae
 
     ds, source = M.load_mnist(run.data_root)
-    cfg = apply_overrides(hvae.HVAEConfig(image_size=ds.images.shape[1]), overrides)
+    cfg = apply_overrides(hvae.HVAEConfig(image_size=ds.images.shape[1]),
+                          _precision_default(run, overrides))
     model, opt, state = hvae.init_model(cfg, seed=run.seed)
     opt, state = _maybe_accum(run, opt, state)
     x_all = jnp.asarray(ds.images, cfg.dtype)
@@ -547,7 +565,8 @@ def run_product(run: RunConfig, overrides: dict):
     else:
         ds = wordnet.synthetic_tree(depth=5, branching=3)
     cfg = apply_overrides(
-        pme.ProductEmbedConfig(num_nodes=ds.num_nodes), overrides)
+        pme.ProductEmbedConfig(num_nodes=ds.num_nodes),
+        _precision_default(run, overrides))
     state, curv_opt = pme.init_state(cfg, run.seed)
     pairs = jnp.asarray(ds.pairs)
     mesh = auto_mesh(run.multihost)
@@ -651,6 +670,12 @@ def main(argv: list[str] | None = None) -> int:
     pairs += args.overrides
 
     run, wl_overrides = split_overrides(pairs, RunConfig())
+    from hyperspace_tpu import precision as precision_mod
+
+    try:
+        precision_mod.get_policy(run.precision)
+    except ValueError as e:  # a typo'd preset is a usage error
+        raise SystemExit(str(e)) from None
     if run.multihost:
         jax.distributed.initialize(
             coordinator_address=run.coordinator,

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from hyperspace_tpu import precision as precision_lib
 from hyperspace_tpu.data import graphs as graph_data
 from hyperspace_tpu.nn.decoders import FermiDiracDecoder
 from hyperspace_tpu.nn.gcn import HGCConv, from_tangent0_coords, make_manifold
@@ -71,6 +72,28 @@ class HGCNConfig:
     # [E, F] working set, not the residuals, so this only pays off for
     # DEEP stacks (many layers) or very wide features; off by default.
     remat: bool = False
+    # mixed-precision policy (hyperspace_tpu/precision.py): "bf16" maps
+    # onto this model's quality-validated bf16 lanes — agg_dtype (edge
+    # messages) and decoder_dtype (training pair-distance pass) — while
+    # the encoder compute, every manifold op and all reductions stay
+    # f32 (the docs/benchmarks.md quality-anchor config).  Explicit
+    # agg_dtype/decoder_dtype always win over the policy mapping.
+    precision: str = "f32"
+
+    def resolved_agg_dtype(self):
+        """agg_dtype as executed: the explicit field, else the policy's
+        compute dtype when mixed, else None (= dtype)."""
+        pol = precision_lib.get_policy(self.precision)
+        if self.agg_dtype is not None:
+            return self.agg_dtype
+        return pol.compute if pol.mixed else None
+
+    def resolved_decoder_dtype(self):
+        """decoder_dtype as executed (same resolution rule)."""
+        pol = precision_lib.get_policy(self.precision)
+        if self.decoder_dtype is not None:
+            return self.decoder_dtype
+        return pol.compute if pol.mixed else None
 
 
 class HGCNEncoder(nn.Module):
@@ -97,7 +120,7 @@ class HGCNEncoder(nn.Module):
                 use_att=cfg.use_att,
                 dropout_rate=cfg.dropout,
                 activation=(lambda v: v) if is_last else nn.relu,
-                agg_dtype=cfg.agg_dtype,
+                agg_dtype=cfg.resolved_agg_dtype(),
                 name=f"conv{i}",
             )
             if cfg.remat:
@@ -132,8 +155,9 @@ class HGCNLinkPred(nn.Module):
         z, m = HGCNEncoder(self.cfg, name="encoder")(
             g, deterministic=deterministic
         )
-        if self.cfg.decoder_dtype is not None and not deterministic:
-            z = z.astype(self.cfg.decoder_dtype)  # train only; eval full-prec
+        ddt = self.cfg.resolved_decoder_dtype()
+        if ddt is not None and not deterministic:
+            z = z.astype(ddt)  # train only; eval full-prec
         sq = m.sqdist(z[pairs[:, 0]], z[pairs[:, 1]])
         return FermiDiracDecoder(name="decoder")(sq.astype(self.cfg.dtype))
 
@@ -153,8 +177,9 @@ class HGCNLinkPred(nn.Module):
         z, m = HGCNEncoder(self.cfg, name="encoder")(
             g, deterministic=deterministic
         )
-        if self.cfg.decoder_dtype is not None:
-            z = z.astype(self.cfg.decoder_dtype)
+        ddt = self.cfg.resolved_decoder_dtype()
+        if ddt is not None:
+            z = z.astype(ddt)
         sq_pos = pair_sqdist_planned(
             z, m.c, pos.u, pos.v, *pos.u_plan, pos.v_perm, pos.v_sorted,
             *pos.v_plan, self.cfg.kind)
@@ -185,8 +210,9 @@ class HGCNLinkPred(nn.Module):
         z, m = HGCNEncoder(self.cfg, name="encoder")(
             g, deterministic=deterministic
         )
-        if self.cfg.decoder_dtype is not None:
-            z = z.astype(self.cfg.decoder_dtype)  # train-only method
+        ddt = self.cfg.resolved_decoder_dtype()
+        if ddt is not None:
+            z = z.astype(ddt)  # train-only method
         pb, pc, pf = g.plan if g.plan is not None else (None, None, None)
         sq_pos = graph_edge_sqdist(z, m.c, g.senders, g.receivers, g.rev_perm,
                                    pb, pc, pf, self.cfg.kind)

@@ -204,8 +204,9 @@ class SampledHGCNLinkPred(nn.Module):
 
         z, m = SampledEncoder(self.cfg, name="encoder")(
             levels, n_nbrs, deterministic=deterministic)
-        if self.cfg.decoder_dtype is not None and not deterministic:
-            z = z.astype(self.cfg.decoder_dtype)
+        ddt = self.cfg.resolved_decoder_dtype()
+        if ddt is not None and not deterministic:
+            z = z.astype(ddt)
         p = z.shape[0] // 4
         sq_pos = m.sqdist(z[:p], z[p : 2 * p])
         sq_neg = m.sqdist(z[2 * p : 3 * p], z[3 * p :])

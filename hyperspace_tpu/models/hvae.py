@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from hyperspace_tpu import precision as precision_mod
 from hyperspace_tpu.nn.gcn import make_manifold
 from hyperspace_tpu.nn.wrapped_normal import WrappedNormal
 
@@ -44,6 +45,12 @@ class HVAEConfig:
     batch_size: int = 128
     kl_weight: float = 1.0
     dtype: Any = jnp.float32
+    # mixed-precision policy (hyperspace_tpu/precision.py): "bf16" runs
+    # the Euclidean conv/dense stacks — the model's entire MXU mass — in
+    # bf16 while params, the manifold latent (expmap0/logmap0, the
+    # wrapped-normal densities) and the loss reductions stay f32.
+    # "f32" (default) is bit-identical to the pre-policy model.
+    precision: str = "f32"
 
 
 class Encoder(nn.Module):
@@ -52,16 +59,21 @@ class Encoder(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> WrappedNormal:
         cfg = self.cfg
+        pol = precision_mod.get_policy(cfg.precision)
+        cdt = pol.module_dtype()  # compute dtype when mixed, else None
         m = make_manifold(cfg.kind, cfg.c)
-        h = x[..., None]  # [B, H, W, 1]
+        h = pol.cast_compute(x[..., None])  # [B, H, W, 1]
         for f in cfg.conv_features:
-            h = nn.relu(nn.Conv(f, (3, 3), strides=(2, 2))(h))
+            h = nn.relu(nn.Conv(f, (3, 3), strides=(2, 2), dtype=cdt)(h))
         h = h.reshape(h.shape[0], -1)
-        h = nn.relu(nn.Dense(cfg.hidden)(h))
-        # μ as origin-tangent coords → tangent chart → expmap0
-        mu_t = nn.Dense(cfg.latent_dim, name="mu")(h)
+        h = nn.relu(nn.Dense(cfg.hidden, dtype=cdt)(h))
+        # μ as origin-tangent coords → tangent chart → expmap0 — the
+        # manifold side of the boundary: back to f32 BEFORE expmap0
+        mu_t = pol.cast_boundary(nn.Dense(cfg.latent_dim, name="mu",
+                                          dtype=cdt)(h))
         mu = m.expmap0(m.tangent_from_origin_coords(mu_t))
-        log_sigma = nn.Dense(cfg.latent_dim, name="log_sigma")(h)
+        log_sigma = pol.cast_boundary(
+            nn.Dense(cfg.latent_dim, name="log_sigma", dtype=cdt)(h))
         sigma = jnp.exp(jnp.clip(log_sigma, -6.0, 2.0))
         return WrappedNormal(m, mu, sigma)
 
@@ -72,20 +84,25 @@ class Decoder(nn.Module):
     @nn.compact
     def __call__(self, z: jax.Array) -> jax.Array:
         cfg = self.cfg
+        pol = precision_mod.get_policy(cfg.precision)
+        cdt = pol.module_dtype()
         m = make_manifold(cfg.kind, cfg.c)
-        # leave the manifold once, at the decoder input
-        v = m.origin_coords_from_tangent(m.logmap0(z))
+        # leave the manifold once, at the decoder input (logmap0 in f32);
+        # the Euclidean stack below runs in the compute dtype
+        v = pol.cast_compute(m.origin_coords_from_tangent(m.logmap0(z)))
         s0 = cfg.image_size // (2 ** len(cfg.conv_features))
         f_top = cfg.conv_features[-1]
-        h = nn.relu(nn.Dense(cfg.hidden)(v))
-        h = nn.relu(nn.Dense(s0 * s0 * f_top)(h))
+        h = nn.relu(nn.Dense(cfg.hidden, dtype=cdt)(v))
+        h = nn.relu(nn.Dense(s0 * s0 * f_top, dtype=cdt)(h))
         h = h.reshape(h.shape[:-1] + (s0, s0, f_top))
         for f in reversed(cfg.conv_features[:-1]):
-            h = nn.relu(nn.ConvTranspose(f, (3, 3), strides=(2, 2))(h))
-        h = nn.ConvTranspose(1, (3, 3), strides=(2, 2))(h)
+            h = nn.relu(nn.ConvTranspose(f, (3, 3), strides=(2, 2),
+                                         dtype=cdt)(h))
+        h = nn.ConvTranspose(1, (3, 3), strides=(2, 2), dtype=cdt)(h)
         h = h[..., 0]
-        # crop in case strides overshoot the odd image size
-        return h[..., : cfg.image_size, : cfg.image_size]
+        # crop in case strides overshoot the odd image size; logits leave
+        # in the accumulation dtype — the BCE/ELBO sums never run in bf16
+        return pol.cast_accum(h[..., : cfg.image_size, : cfg.image_size])
 
 
 class HVAE(nn.Module):

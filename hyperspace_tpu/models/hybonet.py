@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from hyperspace_tpu import precision as precision_mod
 from hyperspace_tpu.manifolds import Lorentz
 from hyperspace_tpu.nn.attention import HypMultiHeadAttention
 from hyperspace_tpu.nn.gcn import from_tangent0_coords
@@ -58,6 +59,12 @@ class HyboNetConfig:
     # online-softmax KV scan (the ring-attention per-device body).
     attention_impl: str = "flash"
     dtype: Any = jnp.float32
+    # mixed-precision policy (hyperspace_tpu/precision.py): "bf16" runs
+    # the LorentzLinear / attention-projection matmuls — the model's MXU
+    # mass — in bf16 while params, every time-coordinate reconstruction,
+    # centroids and the MLR head stay f32.  "f32" (default) is
+    # bit-identical to the pre-policy model.
+    precision: str = "f32"
 
 
 class HyboNetBlock(nn.Module):
@@ -67,16 +74,20 @@ class HyboNetBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, mask: jax.Array, *, deterministic=True):
         cfg, m = self.cfg, self.manifold
+        # matmuls run in the policy's compute dtype; centroids and every
+        # time-coordinate reconstruction stay in the storage dtype
+        cdt = precision_mod.get_policy(cfg.precision).module_dtype()
         # self-attention sublayer with padding mask
         att_mask = mask[..., None, :] & mask[..., :, None]  # [B, L, L]
         a = HypMultiHeadAttention(
             dim=cfg.dim, num_heads=cfg.num_heads, manifold=m,
-            impl=cfg.attention_impl, name="mha",
+            impl=cfg.attention_impl, compute_dtype=cdt, name="mha",
         )(x, mask=att_mask)
         x = m.centroid(jnp.stack([x, a], axis=-2))  # hyperbolic residual
         # FFN sublayer: expand (with tangent ReLU on ambient input) → project
-        f = LorentzLinear(cfg.dim * cfg.ffn_mult, m, activation=nn.relu, name="ffn_in")(x)
-        f = LorentzLinear(cfg.dim, m, name="ffn_out")(f)
+        f = LorentzLinear(cfg.dim * cfg.ffn_mult, m, activation=nn.relu,
+                          compute_dtype=cdt, name="ffn_in")(x)
+        f = LorentzLinear(cfg.dim, m, compute_dtype=cdt, name="ffn_out")(f)
         x = m.centroid(jnp.stack([x, f], axis=-2))
         return x
 

@@ -46,6 +46,15 @@ class PoincareEmbedConfig:
     # gathered, updated and scattered back (SURVEY.md §7 hard-part #2) —
     # O(B·(2+K)·d) update work instead of O(N·d)
     sparse: bool = False
+    # mixed-precision policy (hyperspace_tpu/precision.py).  This
+    # workload is ALL boundary-sensitive math: the table is a master
+    # parameter (policy: f32), and the per-step compute is the ball
+    # distance + Riemannian update (policy: boundary/param, f32), so
+    # "bf16" is bit-identical to "f32" here BY DESIGN — regression-
+    # tested, because a bf16 cast creeping into this step is exactly the
+    # failure the policy exists to prevent.  The workload's bf16 win
+    # lives in the serving scan (serve/engine precision="bf16").
+    precision: str = "f32"
 
 
 class TrainState(NamedTuple):
@@ -551,6 +560,9 @@ def init_state(cfg: PoincareEmbedConfig, seed: int = 0) -> tuple[TrainState, opt
     Returned together so opt_state and the transformation can never be
     constructed from diverging configs.
     """
+    from hyperspace_tpu import precision as precision_mod
+
+    precision_mod.get_policy(cfg.precision)  # validate the name early
     key = jax.random.PRNGKey(seed)
     k_init, key = jax.random.split(key)
     table = init_table(cfg, k_init)

@@ -52,6 +52,12 @@ class ProductEmbedConfig:
     burnin_factor: float = 0.05
     init_scale: float = 1e-2
     dtype: Any = jnp.float32
+    # mixed-precision policy (hyperspace_tpu/precision.py).  Like the
+    # Poincaré embedder, this workload is all boundary-sensitive math on
+    # a master-parameter table (plus the learned-curvature softplus), so
+    # "bf16" is bit-identical to "f32" BY DESIGN — the serving scan is
+    # where the bf16 win lives (serve/engine precision="bf16").
+    precision: str = "f32"
 
     @property
     def total_dim(self) -> int:
@@ -87,6 +93,9 @@ class TrainState(NamedTuple):
 
 
 def init_state(cfg: ProductEmbedConfig, seed: int = 0) -> tuple[TrainState, Any]:
+    from hyperspace_tpu import precision as precision_mod
+
+    precision_mod.get_policy(cfg.precision)  # validate the name early
     key = jax.random.PRNGKey(seed)
     k_init, key = jax.random.split(key)
     c_raw = jnp.full((cfg.num_curved,),

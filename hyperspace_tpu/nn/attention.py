@@ -25,7 +25,7 @@ TPU-first structure [PLAN]:
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax
@@ -35,6 +35,7 @@ from hyperspace_tpu.kernels.attention import flash_attention
 from hyperspace_tpu.manifolds import Lorentz
 from hyperspace_tpu.manifolds import smath
 from hyperspace_tpu.nn.layers import LorentzLinear
+from hyperspace_tpu.precision import compute_matmul
 
 
 def minkowski_gram(q: jax.Array, k: jax.Array) -> jax.Array:
@@ -165,6 +166,11 @@ class HypMultiHeadAttention(nn.Module):
     # on TPU (dense twin elsewhere); "scan" = the XLA online-softmax KV
     # scan (lorentz_attention_tiled, the ring-attention per-device body)
     impl: str = "flash"
+    # mixed-precision compute dtype for the Q/K/V projection matmuls and
+    # the output LorentzLinear (the attention's MXU mass); the time-
+    # coordinate reconstructions and the attention body itself stay in
+    # the storage dtype.  None (default) = exact pre-policy module.
+    compute_dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(
@@ -184,9 +190,11 @@ class HypMultiHeadAttention(nn.Module):
 
         def proj(name, x):
             # one LorentzLinear into h stacked head-hyperboloids
-            space = x @ self.param(
+            kernel = self.param(
                 f"{name}_kernel", nn.initializers.glorot_uniform(),
                 (x.shape[-1], h * dh), x.dtype)
+            # matmul on the compute lane, everything after it f32
+            space = compute_matmul(x, kernel, self.compute_dtype)
             space = space.reshape(space.shape[:-1] + (h, dh))
             space = jnp.swapaxes(space, -3, -2)  # [..., h, N, dh]
             c = jnp.asarray(m.c, x.dtype)
@@ -215,4 +223,5 @@ class HypMultiHeadAttention(nn.Module):
         t = smath.safe_sqrt(1.0 / smath.clamp_min(c, smath.min_norm(x_q.dtype))
                             + smath.sq_norm(o_sp))
         merged = jnp.concatenate([t, o_sp], axis=-1)
-        return LorentzLinear(self.dim, m, name="out")(merged)
+        return LorentzLinear(self.dim, m, name="out",
+                             compute_dtype=self.compute_dtype)(merged)

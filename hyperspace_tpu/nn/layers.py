@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from hyperspace_tpu.kernels.hyplinear import hyp_linear
 from hyperspace_tpu.manifolds import Lorentz, PoincareBall
 from hyperspace_tpu.manifolds import smath
+from hyperspace_tpu.precision import compute_matmul
 
 
 class HypLinear(nn.Module):
@@ -75,6 +76,13 @@ class LorentzLinear(nn.Module):
     use_bias: bool = True
     activation: Optional[Callable] = None
     kernel_init: Callable = nn.initializers.glorot_uniform()
+    # mixed-precision compute dtype for the matmul ONLY (the layer's MXU
+    # mass): inputs and kernel are cast to it, the product is cast back
+    # to the storage dtype BEFORE the bias add and the time-coordinate
+    # reconstruction — the hyperboloid constraint math (safe_sqrt of
+    # 1/c + ‖space‖²) always runs full-precision.  None (default) is the
+    # exact pre-policy layer (hyperspace_tpu/precision.py).
+    compute_dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -83,7 +91,7 @@ class LorentzLinear(nn.Module):
         h = x
         if self.activation is not None:
             h = self.activation(h)
-        space = h @ kernel
+        space = compute_matmul(h, kernel, self.compute_dtype)
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros, (self.dim,), x.dtype)
             space = space + bias

@@ -165,9 +165,13 @@ class RequestBatcher:
                     f"k must be an integer; got {type(k).__name__}"
                 ) from None
             fp = self.engine.fingerprint
-            # cache keys carry exclude_self too: the same (fp, id, k) has
-            # two distinct answers depending on the flag
-            keyf = lambda qid: (fp, qid, k, exclude_self)
+            # cache keys carry exclude_self AND the engine's precision
+            # mode: the same (fp, id, k) has distinct answers per flag,
+            # and a bf16-scan engine's rows must never be served back by
+            # an f32 engine over the same table (same fingerprint!) or
+            # vice versa
+            mode = self.engine.precision
+            keyf = lambda qid: (fp, qid, k, exclude_self, mode)
             rows: dict[int, tuple] = {}
             misses = []
             # hit/miss are per UNIQUE id: a duplicate within the request
@@ -265,4 +269,5 @@ class RequestBatcher:
             "cache_entries": len(self.cache),
             "buckets": list(self.buckets),
             "fingerprint": self.engine.fingerprint,
+            "precision": self.engine.precision,
         }

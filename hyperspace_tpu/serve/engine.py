@@ -48,6 +48,14 @@ Mechanics:
   [B, k] candidates and a final merge top-k.  A mesh whose model axis
   has ONE device falls back to the single-device program — bit-compatible
   by construction (same executable).
+- **Optional bf16 table scan** (``precision="bf16"``; docs/precision.md).
+  A bf16 copy of the padded table lives beside the f32 one and the scan
+  runs over THAT (half the HBM traffic of the dominant pass), keeping
+  ``k + max(k, 8)`` candidates; the merged candidates are re-scored
+  with f32 manifold distances against the f32 table before the final
+  top-k, so returned distances are always f32-accurate and rank
+  agreement holds at ordinary point distributions.  ``"f32"`` (default)
+  is the unchanged pre-policy executable.
 - **Compiles are keyed on (bucket, k), never on request.**  The jitted
   programs hang everything shape-like on static arguments (batch size,
   k, chunk, N, the manifold spec tuple, the mesh); the request batcher
@@ -75,6 +83,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from hyperspace_tpu import precision as precision_mod
 from hyperspace_tpu.parallel.mesh import shard_map
 from hyperspace_tpu.parallel.sharded_embed import local_gather, table_sharding
 from hyperspace_tpu.serve.artifact import (ServingArtifact, fingerprint_of,
@@ -89,6 +98,13 @@ NOMINAL_BATCH = 1024
 _ROW_ALIGN = 128
 
 SCAN_MODES = ("two_stage", "carry")
+PRECISIONS = precision_mod.PRESET_NAMES
+
+# extra candidates the bf16 scan keeps beyond the requested k, so a
+# near-tie the low-precision pass mis-ranks at the k-th boundary is still
+# IN the candidate set when the f32 rescore re-ranks it (docs/precision.md
+# "serving": the scan picks candidates, f32 picks the answer)
+_RESCORE_PAD = 8
 
 
 def _round_up(n: int, m: int) -> int:
@@ -242,6 +258,85 @@ def _topk_sharded(table: jax.Array, q_idx: jax.Array, *, spec: tuple,
     return run(table, q_idx)
 
 
+def _rescore_f32(spec: tuple, rows: jax.Array, q: jax.Array,
+                 idx: jax.Array, scan_d: jax.Array) -> jax.Array:
+    """f32 distances for gathered candidate rows ``rows`` [B, K, D]
+    against f32 queries ``q`` [B, D].  Slots the low-precision scan
+    filled with ``-1``/``inf`` (skipped tiles, narrow shards) stay
+    ``+inf`` so they can never outrank a real candidate."""
+    m = manifold_from_spec(spec)
+    d = m.dist(q[:, None, :], rows)                       # [B, K] f32
+    return jnp.where((idx < 0) | ~jnp.isfinite(scan_d), jnp.inf, d)
+
+
+def _merge_rescored(d32: jax.Array, idx: jax.Array, k: int):
+    """Final ranking: top-k of the f32-rescored candidate buffer."""
+    top_negd, sel = jax.lax.top_k(-d32, k)
+    return jnp.take_along_axis(idx, sel, axis=1), -top_negd
+
+
+@partial(jax.jit, static_argnames=("spec", "k", "k_scan", "chunk", "n",
+                                   "exclude_self", "mode"))
+def _topk_chunked_mixed(table: jax.Array, scan_table: jax.Array,
+                        q_idx: jax.Array, *, spec: tuple, k: int,
+                        k_scan: int, chunk: int, n: int,
+                        exclude_self: bool, mode: str):
+    """bf16 table-scan variant of :func:`_topk_chunked`: the chunked scan
+    runs over ``scan_table`` (the low-precision copy — half the HBM
+    traffic of the dominant pass) keeping ``k_scan >= k`` candidates,
+    then the candidates are gathered from the f32 ``table`` and rescored
+    with full-precision manifold distances before the final top-k — so
+    returned distances carry f32 accuracy and the boundary-sensitive
+    math never runs in bf16 on anything that reaches the caller."""
+    q = table[q_idx]                                      # [B, D] f32
+    q_scan = q.astype(scan_table.dtype)
+    sd, sidx = _scan_topk(scan_table, q_scan, q_idx, 0, spec=spec,
+                          k=k_scan, chunk=chunk, n=n,
+                          exclude_self=exclude_self, mode=mode)
+    rows = table[jnp.maximum(sidx, 0)]                    # [B, K, D] f32
+    d32 = _rescore_f32(spec, rows, q, sidx, sd)
+    return _merge_rescored(d32, sidx, k)
+
+
+@partial(jax.jit, static_argnames=("spec", "k", "k_scan", "chunk", "n",
+                                   "exclude_self", "mode", "mesh", "axis"))
+def _topk_sharded_mixed(table: jax.Array, scan_table: jax.Array,
+                        q_idx: jax.Array, *, spec: tuple, k: int,
+                        k_scan: int, chunk: int, n: int,
+                        exclude_self: bool, mode: str, mesh, axis: str):
+    """Mesh-sharded twin of :func:`_topk_chunked_mixed`: per-shard bf16
+    scan over the local low-precision slab, all-gather + merge of the
+    per-shard candidates, then an f32 rescore of the merged ``k_scan``
+    winners (candidate rows assembled from the f32 shards by the same
+    psum gather the query rows use) before the final top-k."""
+    npad = table.shape[0]
+
+    def local(tloc, sloc, qi):
+        q = local_gather(tloc, qi, npad, axis)            # [B, D] f32
+        lo = (jax.lax.axis_index(axis) * tloc.shape[0]).astype(jnp.int32)
+        d, i = _scan_topk(sloc, q.astype(sloc.dtype), qi, lo, spec=spec,
+                          k=k_scan, chunk=chunk, n=n,
+                          exclude_self=exclude_self, mode=mode)
+        gd = jax.lax.all_gather(d, axis)                  # [S, B, <=k_scan]
+        gi = jax.lax.all_gather(i, axis)
+        b = qi.shape[0]
+        cat_d = jnp.moveaxis(gd, 0, 1).reshape(b, -1)
+        cat_i = jnp.moveaxis(gi, 0, 1).reshape(b, -1)
+        km = min(k_scan, cat_d.shape[1])
+        top_negd, sel = jax.lax.top_k(-cat_d, km)
+        sd = -top_negd
+        sidx = jnp.take_along_axis(cat_i, sel, axis=1)    # [B, km]
+        rows = local_gather(tloc, jnp.maximum(sidx, 0), npad, axis)
+        d32 = _rescore_f32(spec, rows, q, sidx, sd)
+        idx, dist = _merge_rescored(d32, sidx, k)
+        return idx, dist
+
+    run = shard_map(local, mesh=mesh,
+                    in_specs=(P(axis, None), P(axis, None), P()),
+                    out_specs=(P(), P()), check_vma=False)
+    return run(table, scan_table, q_idx)
+
+
 def _fermi_dirac(d: jax.Array, r, t) -> jax.Array:
     """The HGCN LP head's link decoder — the ONE definition both the
     single-device and sharded scoring programs trace, so the 1-device
@@ -298,6 +393,17 @@ class QueryEngine:
     default, ``"carry"`` for the original running-top-k variant — see
     the module docstring).  ``mesh=None`` (or a mesh whose model axis
     has one device) runs the single-device program.
+
+    ``precision`` picks the table-scan dtype policy (docs/precision.md):
+    ``"f32"`` (default) is the exact pre-policy program, bit-identical;
+    ``"bf16"`` keeps a bf16 copy of the padded table beside the f32 one
+    and scans THAT (half the HBM traffic of the dominant pass), keeping
+    ``k + max(k, 8)`` candidates which are then rescored with f32
+    manifold distances against the f32 table before the final ranking —
+    returned distances are always f32-accurate, and a near-tie the bf16
+    pass mis-ranks at the k-th boundary is recovered by the over-fetch.
+    Edge scoring (``score_edges``) is always f32: it is two cheap
+    gathers plus one distance per pair, with no table scan to save.
     """
 
     def __init__(self, table, manifold_spec: tuple, *,
@@ -305,16 +411,22 @@ class QueryEngine:
                  chunk_rows: int = 0,
                  tile_budget: int = DEFAULT_TILE_BUDGET,
                  mesh=None, mesh_axis: str = "model",
-                 scan_mode: str = "two_stage"):
+                 scan_mode: str = "two_stage",
+                 precision: str = "f32"):
         table = np.ascontiguousarray(np.asarray(table))
         if table.ndim != 2:
             raise ValueError(f"table must be [N, D]; got {table.shape}")
         if scan_mode not in SCAN_MODES:
             raise ValueError(
                 f"scan_mode must be one of {SCAN_MODES}; got {scan_mode!r}")
+        if precision not in PRECISIONS:
+            raise ValueError(
+                f"precision must be one of {PRECISIONS}; got {precision!r}")
         self.num_nodes, self.dim = (int(s) for s in table.shape)
         self.spec = tuple(manifold_spec)
         self.scan_mode = scan_mode
+        self.precision = precision
+        self._policy = precision_mod.get_policy(precision)
         self.fingerprint = fingerprint or fingerprint_of(table, self.spec)
         self.mesh, self.mesh_axis = mesh, mesh_axis
         shards = 1
@@ -347,6 +459,16 @@ class QueryEngine:
                 table, table_sharding(mesh, mesh_axis))
         else:
             self.table = jnp.asarray(table)  # [padded, D] device-resident
+        # the low-precision scan copy lives beside the f32 table (same
+        # layout/sharding) — built ONCE here, not per query; the f32
+        # policy aliases the table so the default path holds one array
+        if self._policy.mixed:
+            scan_np = table.astype(self._policy.compute)
+            self.scan_table = (
+                jax.device_put(scan_np, table_sharding(mesh, mesh_axis))
+                if shards > 1 else jnp.asarray(scan_np))
+        else:
+            self.scan_table = self.table
 
     @classmethod
     def from_artifact(cls, art: ServingArtifact, **kw) -> "QueryEngine":
@@ -369,6 +491,21 @@ class QueryEngine:
             raise ValueError(
                 f"k={k} out of range [1, {limit}] for a {self.num_nodes}-row "
                 f"table (exclude_self={exclude_self})")
+        if self._policy.mixed:
+            # over-fetch margin: the bf16 scan keeps k_scan candidates so
+            # the f32 rescore can repair k-th-boundary near-ties
+            k_scan = min(k + max(k, _RESCORE_PAD), self.num_nodes)
+            if self.shards > 1:
+                return _topk_sharded_mixed(
+                    self.table, self.scan_table, q_idx, spec=self.spec,
+                    k=k, k_scan=k_scan, chunk=self.chunk_rows,
+                    n=self.num_nodes, exclude_self=exclude_self,
+                    mode=self.scan_mode, mesh=self.mesh,
+                    axis=self.mesh_axis)
+            return _topk_chunked_mixed(
+                self.table, self.scan_table, q_idx, spec=self.spec, k=k,
+                k_scan=k_scan, chunk=self.chunk_rows, n=self.num_nodes,
+                exclude_self=exclude_self, mode=self.scan_mode)
         if self.shards > 1:
             return _topk_sharded(
                 self.table, q_idx, spec=self.spec, k=k,

@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 
 
-def make_chunked_stepper(step_fn: Callable, chunk_steps: int):
+def make_chunked_stepper(step_fn: Callable, chunk_steps: int, policy=None):
     """Compile ``chunk_steps`` calls of ``step_fn`` into one XLA program.
 
     ``step_fn(state, *args) -> (state, out...)`` must be a traceable
@@ -61,18 +61,46 @@ def make_chunked_stepper(step_fn: Callable, chunk_steps: int):
     chunk; steps that walk a plan index by ``state.step`` advance
     through it as usual).
 
-    ``chunk_steps <= 1`` returns ``step_fn`` unchanged — the K=1 path is
-    the caller's original stepper, bit-identical by construction.
+    ``policy`` is an optional mixed-precision policy (a
+    ``hyperspace_tpu.precision`` Policy or preset name).  With a mixed
+    policy the chunk program casts the floating leaves of ``*args`` —
+    the batch data every step in the chunk reads — to the policy's
+    compute dtype ONCE, outside the scan, so a bf16 run pays one host
+    batch downcast per dispatch instead of one per step (integer/bool
+    leaves — ids, masks — pass through untouched; the carried ``state``
+    is never cast: master params stay in the param dtype).  The per-step
+    losses are cast to the accumulation dtype on the way out.  ``None``
+    or the f32 preset changes nothing — bit-identical by construction.
+
+    ``chunk_steps <= 1`` returns ``step_fn`` unchanged (the K=1 path is
+    the caller's original stepper, bit-identical by construction) except
+    under a mixed policy, where a thin wrapper applies the same arg cast
+    per call.
     """
+    from hyperspace_tpu.precision import get_policy
+
+    pol = get_policy(policy)
     k = int(chunk_steps)
     if k <= 1:
-        return step_fn
+        if not pol.mixed:
+            return step_fn
+
+        def one_step(state, *args):
+            # same arg-cast AND accum-cast contract as the scanned path,
+            # so loss dtype never flips with the scan_chunk setting
+            res = step_fn(state, *pol.cast_compute_tree(args))
+            return (res[0],) + tuple(pol.cast_accum(o) for o in res[1:])
+
+        return one_step
 
     def body(state, *args):
+        args = pol.cast_compute_tree(args)  # once per chunk, not per step
+
         def one(st, _):
             res = step_fn(st, *args)
-            out = res[1] if len(res) == 2 else tuple(res[1:])
-            return res[0], out
+            if len(res) == 2:
+                return res[0], pol.cast_accum(res[1])
+            return res[0], tuple(pol.cast_accum(o) for o in res[1:])
 
         return jax.lax.scan(one, state, None, length=k)
 

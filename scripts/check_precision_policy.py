@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Lint: no ad-hoc bf16 casts outside the precision policy.
+
+``hyperspace_tpu/precision.py`` is the ONE place the package is allowed
+to name bf16 (docs/precision.md): consumers take a ``Policy`` and use
+its cast helpers, so every half-precision decision is visible in one
+module and the boundary-sensitive hyperbolic math can't be silently
+downcast by a stray ``astype``.  This script scans every ``.py`` under
+``hyperspace_tpu/`` for bf16 literals in CODE (comments stripped;
+docstrings may *discuss* bf16 freely — only the dtype tokens below
+trigger):
+
+- ``jnp.bfloat16`` / ``jax.numpy.bfloat16`` / ``np.bfloat16``
+- a quoted ``"bfloat16"`` dtype string
+- ``astype(jnp.bfloat16)`` is just the composition of the above
+
+Allowed locations:
+
+- ``hyperspace_tpu/precision.py`` — the policy itself;
+- ``hyperspace_tpu/kernels/`` — the Pallas fast paths (e.g.
+  ``cluster.py``'s single-pass bf16 MXU body) pick dtypes from their
+  INPUT dtype, which the policy already controls upstream;
+- any line carrying a ``# precision-policy: ok`` annotation (use it for
+  CLI dtype-flag *names*, with a reason).
+
+Run by ``tests/test_precision_policy.py`` inside the suite, so an
+ad-hoc cast can't merge.  Exit 0 = clean, 1 = offenders listed.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_BF16 = re.compile(
+    r"(?:\bjnp\.bfloat16\b|\bjax\.numpy\.bfloat16\b|\bnp\.bfloat16\b"
+    r"|[\"']bfloat16[\"'])")
+_ALLOW_ANNOT = "precision-policy: ok"
+_ALLOWED_FILES = ("precision.py",)
+_ALLOWED_DIRS = (os.path.join("hyperspace_tpu", "kernels"),)
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a trailing ``#`` comment (string-aware enough for this
+    codebase: a ``#`` inside quotes would need a quoted bf16 token ON
+    the same line to matter, which the annotation escape covers)."""
+    i = line.find("#")
+    return line if i < 0 else line[:i]
+
+
+def violations_in_text(text: str, rel: str) -> list[str]:
+    """``["path:lineno: line", ...]`` for bf16 literals in code lines."""
+    out = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if _ALLOW_ANNOT in line:
+            continue
+        if _BF16.search(_strip_comment(line)):
+            out.append(f"{rel}:{lineno}: {line.strip()}")
+    return out
+
+
+def _allowed(rel: str) -> bool:
+    if os.path.basename(rel) in _ALLOWED_FILES:
+        return True
+    return any(rel.startswith(d + os.sep) for d in _ALLOWED_DIRS)
+
+
+def scan_package(pkg_dir: str) -> list[str]:
+    root = os.path.dirname(pkg_dir)
+    offenders: list[str] = []
+    for dirpath, _dirs, files in os.walk(pkg_dir):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            if _allowed(rel):
+                continue
+            with open(path, encoding="utf-8") as f:
+                offenders += violations_in_text(f.read(), rel)
+    return offenders
+
+
+def main() -> int:
+    pkg = os.path.join(repo_root(), "hyperspace_tpu")
+    offenders = scan_package(pkg)
+    if offenders:
+        print("ad-hoc bf16 literals outside the precision policy "
+              "(route them through hyperspace_tpu/precision.py, or "
+              f"annotate a flag-name line with `# {_ALLOW_ANNOT} "
+              "(reason)`):")
+        for line in offenders:
+            print(f"  {line}")
+        return 1
+    print("precision policy OK: no ad-hoc bf16 literals outside "
+          "precision.py / kernels/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,6 +65,13 @@ def _stub_serve(repeats=1):
                        "cache": {"cache_hit_rate": 0.9}}}
 
 
+def _stub_precision(repeats=1):
+    return {"metric": "precision_train_speedup", "value": 1.4, "unit": "x",
+            "vs_baseline": None,
+            "detail": {"train_step_ms": {"f32": 2.0, "bf16": 1.4},
+                       "serve_scan_ms": {"f32": 3.0, "bf16": 2.0}}}
+
+
 def test_auto_hgcn_failure_reports_error(bench_mod, monkeypatch, capsys):
     def boom(repeats=1, **kw):
         raise RuntimeError("synthetic hgcn failure")
@@ -73,6 +80,7 @@ def test_auto_hgcn_failure_reports_error(bench_mod, monkeypatch, capsys):
     monkeypatch.setattr(bench_mod, "bench_poincare", _stub_poincare)
     monkeypatch.setattr(bench_mod, "bench_sampled", _stub_sampled)
     monkeypatch.setattr(bench_mod, "bench_serve", _stub_serve)
+    monkeypatch.setattr(bench_mod, "bench_precision", _stub_precision)
     monkeypatch.setattr(sys, "argv", ["bench.py", "--metric", "auto"])
     with pytest.raises(SystemExit) as ei:
         bench_mod.main()
@@ -100,6 +108,7 @@ def test_auto_success_keeps_hgcn_headline(bench_mod, monkeypatch, capsys):
     monkeypatch.setattr(bench_mod, "bench_poincare", _stub_poincare)
     monkeypatch.setattr(bench_mod, "bench_sampled", _stub_sampled)
     monkeypatch.setattr(bench_mod, "bench_serve", _stub_serve)
+    monkeypatch.setattr(bench_mod, "bench_precision", _stub_precision)
     monkeypatch.setattr(sys, "argv", ["bench.py", "--metric", "auto"])
     bench_mod.main()
     captured = capsys.readouterr().out
@@ -112,6 +121,11 @@ def test_auto_success_keeps_hgcn_headline(bench_mod, monkeypatch, capsys):
     assert full["detail"]["serve"]["qps"] == 1234.5
     assert full["detail"]["serve"]["recompiles_steady"] == 0
     assert full["detail"]["serve"]["cache"]["cache_hit_rate"] == 0.9
+    # the precision leg: the f32/bf16 timing PAIRS land in the artifact
+    assert full["detail"]["precision"]["train_step_ms"] == {
+        "f32": 2.0, "bf16": 1.4}
+    assert full["detail"]["precision"]["serve_scan_ms"] == {
+        "f32": 3.0, "bf16": 2.0}
     # compact last line: same headline, key legs summarized
     out = _last_json(captured)
     assert out["metric"] == "hgcn_samples_per_sec_per_chip"
@@ -119,6 +133,7 @@ def test_auto_success_keeps_hgcn_headline(bench_mod, monkeypatch, capsys):
     assert out["detail"]["poincare_epoch_s"] == 0.5
     assert out["detail"]["sampled_samples_per_s"] == 2e5
     assert out["detail"]["serve_qps"] == 1234.5
+    assert out["detail"]["precision_train_ms"] == {"f32": 2.0, "bf16": 1.4}
 
 
 def test_explicit_poincare_failure_is_error(bench_mod, monkeypatch, capsys):
@@ -211,8 +226,8 @@ def test_budget_zero_skips_all_legs_but_emits(bench_mod, monkeypatch, capsys):
     # headline survives; every optional leg is reported skipped, not lost
     assert full["metric"] == "hgcn_samples_per_sec_per_chip"
     assert set(full["detail"]["skipped_legs"]) == {
-        "poincare", "hgcn_sampled", "serve_qps", "realistic", "workloads",
-        "use_att_arm"}
+        "poincare", "hgcn_sampled", "serve_qps", "precision", "realistic",
+        "workloads", "use_att_arm"}
     assert full["detail"]["budget_s"] == 0
     assert _last_json(captured)["metric"] == "hgcn_samples_per_sec_per_chip"
 

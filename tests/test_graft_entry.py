@@ -1,0 +1,70 @@
+"""__graft_entry__ device acquisition: CPU-first, tunnel-proof.
+
+The r05 failure mode: ``_ensure_devices`` probed ``jax.devices()`` —
+initializing the real TPU backend over the tunnel — BEFORE its CPU
+fallback, so a wedged chip/tunnel killed the CPU-only
+``dryrun_multichip`` correctness check outright.  The contract now:
+
+- ``JAX_PLATFORMS=cpu`` (or unset) → straight to virtual CPU devices,
+  the default backend is never touched;
+- the real backend is probed only when explicitly requested
+  (``JAX_PLATFORMS=tpu`` / ``HYPERSPACE_DRYRUN_BACKEND=default``).
+"""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_resolve_prefer_cpu(monkeypatch):
+    import __graft_entry__ as g
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert g._resolve_prefer_cpu() is True
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu,cpu")
+    assert g._resolve_prefer_cpu() is True  # cpu listed → honored
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    assert g._resolve_prefer_cpu() is False  # explicit non-cpu request
+    monkeypatch.delenv("JAX_PLATFORMS")
+    monkeypatch.delenv("HYPERSPACE_DRYRUN_BACKEND", raising=False)
+    assert g._resolve_prefer_cpu() is True  # default: cpu
+    monkeypatch.setenv("HYPERSPACE_DRYRUN_BACKEND", "default")
+    assert g._resolve_prefer_cpu() is False  # explicit opt-in only
+
+
+def test_ensure_devices_cpu_fresh_process():
+    """A fresh process with JAX_PLATFORMS=cpu gets its n virtual CPU
+    devices without XLA_FLAGS pre-set and without the default backend
+    ever being probed (a TPU probe would crash on this host — the test
+    passing IS the proof the probe never ran)."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "HYPERSPACE_DRYRUN_BACKEND")}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g\n"
+         "d = g._ensure_devices(4)\n"
+         "assert len(d) == 4 and d[0].platform == 'cpu', d\n"
+         "print('CPU_OK', len(d))"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CPU_OK 4" in proc.stdout
+
+
+def test_ensure_devices_in_process():
+    """In the test process (8 virtual CPU devices already up) the CPU
+    path serves from the existing backend — no clear_backends churn."""
+    import jax
+
+    import __graft_entry__ as g
+
+    if len(jax.devices()) < 4:
+        import pytest
+
+        pytest.skip("needs 4 virtual devices")
+    before = jax.devices()
+    d = g._ensure_devices(4, prefer_cpu=True)
+    assert len(d) == 4 and all(x.platform == "cpu" for x in d)
+    assert jax.devices() == before  # backend untouched

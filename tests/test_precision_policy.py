@@ -1,0 +1,104 @@
+"""Precision-policy unit contracts (hyperspace_tpu/precision.py) and the
+no-ad-hoc-bf16 lint (scripts/check_precision_policy.py)."""
+
+import importlib.util
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from hyperspace_tpu import precision as P
+
+
+def _lint_mod():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "scripts", "check_precision_policy.py")
+    spec = importlib.util.spec_from_file_location("check_precision_policy",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_presets_and_lookup():
+    assert P.get_policy(None) is P.F32
+    assert P.get_policy("f32") is P.F32
+    assert P.get_policy("bf16") is P.BF16
+    assert P.get_policy(P.BF16) is P.BF16
+    assert not P.F32.mixed
+    assert P.BF16.mixed
+    assert jnp.dtype(P.BF16.compute) == jnp.dtype(jnp.bfloat16)
+    # every non-compute lane of the bf16 preset stays f32: params,
+    # accumulation, and the boundary-sensitive manifold math
+    for dt in (P.BF16.param, P.BF16.accum, P.BF16.boundary):
+        assert jnp.dtype(dt) == jnp.dtype(jnp.float32)
+    with pytest.raises(ValueError, match="unknown precision"):
+        P.get_policy("fp8")
+
+
+def test_f32_cast_helpers_are_identity():
+    """The f32 preset must return the INPUT OBJECT — zero added ops, so
+    precision=f32 is bit-identical to a pre-policy build."""
+    x = jnp.ones((3,), jnp.float32)
+    for fn in (P.F32.cast_compute, P.F32.cast_boundary, P.F32.cast_accum,
+               P.F32.cast_param):
+        assert fn(x) is x
+    tree = {"a": x, "b": jnp.arange(3)}
+    assert P.F32.cast_compute_tree(tree) is tree
+    assert P.F32.module_dtype() is None
+
+
+def test_bf16_casts_floats_only():
+    x32 = jnp.ones((3,), jnp.float32)
+    ints = jnp.arange(3, dtype=jnp.int32)
+    mask = jnp.ones((3,), bool)
+    assert P.BF16.cast_compute(x32).dtype == jnp.dtype(jnp.bfloat16)
+    # ids/masks must never be cast (they'd stop being ids/masks)
+    assert P.BF16.cast_compute(ints) is ints
+    assert P.BF16.cast_compute(mask) is mask
+    tree = P.BF16.cast_compute_tree({"x": x32, "i": ints})
+    assert tree["x"].dtype == jnp.dtype(jnp.bfloat16)
+    assert tree["i"] is ints
+    # the boundary/accum/param casts bring a compute-dtype array BACK
+    xc = P.BF16.cast_compute(x32)
+    assert P.BF16.cast_boundary(xc).dtype == jnp.dtype(jnp.float32)
+    assert P.BF16.cast_accum(xc).dtype == jnp.dtype(jnp.float32)
+    assert P.BF16.cast_param(xc).dtype == jnp.dtype(jnp.float32)
+
+
+def test_parse_dtype():
+    assert jnp.dtype(P.parse_dtype("bfloat16")) == jnp.dtype(jnp.bfloat16)
+    assert jnp.dtype(P.parse_dtype("float32")) == jnp.dtype(jnp.float32)
+    assert P.parse_dtype(None) is None
+    assert P.parse_dtype(None, default="x") == "x"
+    assert P.parse_dtype(jnp.float32) is jnp.float32  # pass-through
+    with pytest.raises(ValueError, match="unknown dtype"):
+        P.parse_dtype("definitely-not-a-dtype")
+
+
+def test_policy_is_hashable_config_material():
+    """Policies ride in frozen dataclass configs used as jit statics."""
+    assert hash(P.BF16) != hash(P.F32)
+    assert P.get_policy("bf16") == P.BF16
+
+
+# --- the lint ---------------------------------------------------------------
+
+
+def test_lint_catches_adhoc_bf16():
+    lint = _lint_mod()
+    bad = "x = y.astype(jnp.bfloat16)\nz = h.astype('bfloat16')\n"
+    hits = lint.violations_in_text(bad, "pkg/mod.py")
+    assert len(hits) == 2 and "pkg/mod.py:1" in hits[0]
+    # comments and the annotation escape do not trigger
+    ok = ("# jnp.bfloat16 is discussed here only\n"
+          'flag: str = "bfloat16"  # precision-policy: ok (CLI flag)\n')
+    assert lint.violations_in_text(ok, "pkg/mod.py") == []
+
+
+def test_package_is_lint_clean(capsys):
+    """The shipped package carries no ad-hoc bf16 literal outside
+    precision.py / kernels/ (run exactly as CI would)."""
+    lint = _lint_mod()
+    rc = lint.main()
+    assert rc == 0, capsys.readouterr().out
