@@ -554,7 +554,7 @@ def _json_default(o):
             return o.item()
         if isinstance(o, np.ndarray):
             return o.tolist()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001  # hyperlint: disable=swallow-base-exception — numpy import failure: degrade to str(o) below
         pass
     return str(o)
 
@@ -796,7 +796,7 @@ def main() -> None:
             snap = _telem.snapshot()
             if snap:
                 result["detail"]["telemetry"] = snap
-        except Exception:  # noqa: BLE001 — diagnostics never sink the bench
+        except Exception:  # noqa: BLE001  # hyperlint: disable=swallow-base-exception — optional diagnostics never sink the bench; the artifact must still emit
             pass
         result["detail"]["budget_s"] = args.budget_s
         result["detail"]["elapsed_s"] = round(guard.elapsed(), 1)

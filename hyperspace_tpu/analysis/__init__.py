@@ -1,0 +1,27 @@
+"""hyperlint — AST-based static analysis for this repo's JAX/TPU hazards.
+
+    python -m hyperspace_tpu.analysis                 # lint the default set
+    python -m hyperspace_tpu.analysis pkg file.py     # lint specific paths
+    python -m hyperspace_tpu.analysis --json          # findings artifact
+    python -m hyperspace_tpu.analysis --list-rules
+
+One parse per file, a Rule registry (docs/static-analysis.md has the
+catalog), per-line ``# hyperlint: disable=<rule> — reason`` suppressions,
+human and JSON output.  The rules encode this repo's own incident
+history: recompile storms, donated-buffer reads, host syncs on the hot
+path, tracer leaks, alarm-swallowing handlers, bf16 policy leaks, and
+catalog/doc drift.  Run by ``tests/analysis/`` inside tier-1, so the
+tree cannot merge dirty.
+"""
+
+from hyperspace_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Report,
+    Rule,
+    default_rules,
+    lint_file,
+    lint_paths,
+    make_context,
+    repo_root,
+)
+from hyperspace_tpu.analysis.rules import ALL_RULES, RULES_BY_ID  # noqa: F401

@@ -1,0 +1,65 @@
+"""``python -m hyperspace_tpu.analysis`` — the hyperlint CLI.
+
+Exit code 0 = clean, 1 = findings (or parse errors).  ``--json`` prints
+the machine-readable findings artifact (file, line, rule, severity) so
+bench/CI rounds can diff finding counts across PRs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from hyperspace_tpu.analysis.core import (lint_paths, repo_root,
+                                          to_json_text)
+from hyperspace_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+
+DEFAULT_TARGETS = ("hyperspace_tpu", "bench.py", "scripts")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hyperspace_tpu.analysis",
+        description="AST lint for this repo's JAX/TPU hazard classes "
+                    "(docs/static-analysis.md).")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: hyperspace_tpu, "
+                         "bench.py, scripts under the repo root)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the JSON findings artifact instead of "
+                         "human-readable lines")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--root", default=None,
+                    help="repo root for relative paths + docs lookups "
+                         "(default: the checkout containing the package)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.id:24} [{cls.severity:7}] {cls.summary}")
+        return 0
+
+    rules = None
+    if args.rules:
+        ids = [t.strip() for t in args.rules.split(",") if t.strip()]
+        unknown = [i for i in ids if i not in RULES_BY_ID]
+        if unknown:
+            ap.error(f"unknown rule id(s): {', '.join(unknown)} "
+                     f"(see --list-rules)")
+        rules = [RULES_BY_ID[i]() for i in ids]
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    paths = args.paths or [os.path.join(root, t) for t in DEFAULT_TARGETS]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        ap.error(f"no such path(s): {', '.join(missing)}")
+    report = lint_paths(paths, root=root, rules=rules)
+    print(to_json_text(report) if args.json else report.human())
+    return report.exit_code()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

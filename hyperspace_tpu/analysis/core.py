@@ -1,0 +1,375 @@
+"""hyperlint core: one AST parse per file, a Rule registry, suppressions.
+
+The shared machinery every rule rides on (docs/static-analysis.md):
+
+- :func:`make_context` parses a file ONCE into a :class:`FileContext`
+  carrying the tree, the raw lines, a parent map, an import-alias table
+  (so ``import jax.numpy as q; q.bfloat16`` resolves the same as
+  ``jnp.bfloat16`` — the aliased-import blind spot of the old regex
+  lints), and the per-line suppression table;
+- :class:`Rule` subclasses implement ``check_file`` (per-file AST walk)
+  and/or ``check_project`` (cross-file contracts: the telemetry catalog,
+  the flag-doc tables);
+- :func:`lint_paths` runs a rule set over a path list and returns a
+  :class:`Report` (human text or the ``--json`` findings artifact).
+
+Suppression grammar — one line, same line as the finding::
+
+    something_hazardous()  # hyperlint: disable=rule-id — why it is fine
+
+Several ids comma-separate; the reason after the id list is free text
+(an em-dash or two spaces separate it).  A suppression names the exact
+rule it silences — there is deliberately no file-level or blanket "all"
+escape, so every accepted hazard is visible at its line.
+
+This module imports nothing outside the stdlib: linting never pays for
+(or depends on) a jax import.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Iterable, Iterator, Optional
+
+SEVERITIES = ("error", "warning", "note")
+
+_SUPPRESS_RX = re.compile(
+    r"#\s*hyperlint:\s*disable=([A-Za-z0-9_.\-]+(?:\s*,\s*[A-Za-z0-9_.\-]+)*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding — the unit of both output formats."""
+
+    rule: str
+    severity: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}/{self.severity}] {self.message}")
+
+
+def _collect_aliases(tree: ast.AST) -> dict[str, str]:
+    """{local name: dotted module/object path} from every import in the
+    file (function-local imports included — this codebase lazy-imports
+    heavily)."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            base = "." * node.level + (node.module or "")
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = (
+                    f"{base}.{a.name}" if base else a.name)
+    return aliases
+
+
+class FileContext:
+    """One parsed file: tree, lines, aliases, parents, suppressions."""
+
+    def __init__(self, path: str, rel: str, text: str, tree: ast.Module):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.tree = tree
+        self.lines = text.splitlines()
+        # directives live in COMMENTS only — a string literal that merely
+        # mentions the grammar (help text, a test asserting on lint
+        # output) must not register a suppression
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            for lineno, line in enumerate(self.lines, 1):
+                if "#" in line:
+                    self.comments[lineno] = line[line.index("#"):]
+        self.suppressions: dict[int, set[str]] = {}
+        for lineno, comment in self.comments.items():
+            m = _SUPPRESS_RX.search(comment)
+            if m:
+                self.suppressions[lineno] = {
+                    t.strip() for t in m.group(1).split(",") if t.strip()}
+        self.aliases = _collect_aliases(tree)
+        self._parents: Optional[dict[int, ast.AST]] = None
+
+    # --- structure helpers ----------------------------------------------------
+
+    @property
+    def parents(self) -> dict[int, ast.AST]:
+        """{id(node): parent node} — built once, on first use."""
+        if self._parents is None:
+            self._parents = {}
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[id(child)] = parent
+        return self._parents
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(id(cur))
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """``a.b.c`` for a Name/Attribute chain, else None."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted name with the head segment expanded through the file's
+        import aliases: ``q.bfloat16`` → ``jax.numpy.bfloat16`` when the
+        file did ``import jax.numpy as q``."""
+        d = self.dotted(node)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        base = self.aliases.get(head, head)
+        return f"{base}.{rest}" if rest else base
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def comment_text(self, lineno: int) -> str:
+        """The comment on ``lineno`` ("" when none) — annotation escapes
+        are matched against this, never against string literals."""
+        return self.comments.get(lineno, "")
+
+    def suppressed(self, rule_id: str, lineno: int) -> bool:
+        return rule_id in self.suppressions.get(lineno, ())
+
+
+class ProjectContext:
+    """The whole lint run: every parsed file plus the repo root (for
+    cross-file rules that read docs)."""
+
+    def __init__(self, root: str, contexts: list[FileContext]):
+        self.root = root
+        self.contexts = contexts
+        self.by_rel = {c.rel: c for c in contexts}
+
+    def get(self, rel: str) -> Optional[FileContext]:
+        return self.by_rel.get(rel.replace(os.sep, "/"))
+
+    def read_doc(self, rel: str) -> Optional[str]:
+        path = os.path.join(self.root, rel)
+        if not os.path.exists(path):
+            return None
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+
+    def doc_texts(self) -> dict[str, str]:
+        """{rel: text} for README.md + every docs/*.md under the root."""
+        out = {}
+        readme = self.read_doc("README.md")
+        if readme is not None:
+            out["README.md"] = readme
+        docs_dir = os.path.join(self.root, "docs")
+        if os.path.isdir(docs_dir):
+            for name in sorted(os.listdir(docs_dir)):
+                if name.endswith(".md"):
+                    out[f"docs/{name}"] = self.read_doc(f"docs/{name}")
+        return out
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``severity``/``summary`` and
+    implement ``check_file`` and/or ``check_project``."""
+
+    id: str = ""
+    severity: str = "warning"
+    summary: str = ""
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, proj: ProjectContext) -> Iterable[Finding]:
+        return ()
+
+    def finding(self, ctx: FileContext, node, message: str,
+                severity: Optional[str] = None) -> Finding:
+        line = getattr(node, "lineno", 0) if not isinstance(node, int) else node
+        col = getattr(node, "col_offset", 0) if not isinstance(node, int) else 0
+        return Finding(rule=self.id, severity=severity or self.severity,
+                       path=ctx.rel, line=line, col=col, message=message)
+
+
+@dataclasses.dataclass
+class Report:
+    findings: list[Finding]
+    parse_errors: list[tuple[str, str]]  # (rel, message)
+    files_scanned: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.parse_errors
+
+    def exit_code(self) -> int:
+        return 0 if self.clean else 1
+
+    def to_json(self) -> dict:
+        counts: dict[str, int] = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return {
+            "version": 1,
+            "files_scanned": self.files_scanned,
+            "findings": [dataclasses.asdict(f) for f in self.findings],
+            "counts": dict(sorted(counts.items())),
+            "parse_errors": [{"path": p, "message": m}
+                             for p, m in self.parse_errors],
+            "clean": self.clean,
+        }
+
+    def human(self) -> str:
+        out = [f.render() for f in self.findings]
+        out += [f"{p}: parse error: {m}" for p, m in self.parse_errors]
+        n = len(self.findings)
+        if self.clean:
+            out.append(f"hyperlint OK: {self.files_scanned} files, "
+                       "0 findings")
+        else:
+            out.append(f"hyperlint: {n} finding{'s' if n != 1 else ''} in "
+                       f"{self.files_scanned} files")
+        return "\n".join(out)
+
+
+# --- runner -------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", ".cache", "_native"}
+
+
+def repo_root() -> str:
+    """The checkout containing this package (analysis/ → package → root)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    seen: set[str] = set()  # overlapping inputs (pkg + pkg/sub) dedupe
+
+    def emit(path: str) -> Iterator[str]:
+        if path not in seen:
+            seen.add(path)
+            yield path
+
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield from emit(p)
+        elif os.path.isdir(p):
+            for dirpath, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield from emit(os.path.join(dirpath, name))
+
+
+def make_context(path: str, rel: Optional[str] = None,
+                 root: Optional[str] = None) -> FileContext:
+    """Parse ``path`` once; raises SyntaxError for unparseable files."""
+    root = root or repo_root()
+    if rel is None:
+        rel = os.path.relpath(os.path.abspath(path), root)
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    return FileContext(path, rel, text, ast.parse(text, filename=path))
+
+
+def context_from_text(text: str, rel: str = "<text>") -> FileContext:
+    """A context for in-memory source (fixtures, the script shims)."""
+    return FileContext(rel, rel, text, ast.parse(text))
+
+
+def default_rules() -> list[Rule]:
+    from hyperspace_tpu.analysis.rules import ALL_RULES
+
+    return [cls() for cls in ALL_RULES]
+
+
+def _filter_suppressed(findings: list[Finding],
+                       by_rel: dict[str, FileContext]) -> list[Finding]:
+    out = []
+    for f in findings:
+        ctx = by_rel.get(f.path)
+        if ctx is not None and ctx.suppressed(f.rule, f.line):
+            continue
+        out.append(f)
+    return out
+
+
+def lint_paths(paths: Iterable[str], root: Optional[str] = None,
+               rules: Optional[list[Rule]] = None) -> Report:
+    """Run ``rules`` (default: all registered) over every ``*.py`` under
+    ``paths``; project rules run once with the full file set."""
+    root = os.path.abspath(root) if root else repo_root()
+    rules = default_rules() if rules is None else rules
+    contexts: list[FileContext] = []
+    parse_errors: list[tuple[str, str]] = []
+    n = 0
+    for path in iter_python_files(paths):
+        n += 1
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            contexts.append(make_context(path, rel=rel, root=root))
+        except SyntaxError as e:
+            parse_errors.append((rel, f"{e.msg} (line {e.lineno})"))
+    findings: list[Finding] = []
+    for ctx in contexts:
+        for rule in rules:
+            findings.extend(rule.check_file(ctx))
+    proj = ProjectContext(root, contexts)
+    for rule in rules:
+        findings.extend(rule.check_project(proj))
+    findings = _filter_suppressed(findings, proj.by_rel)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Report(findings=findings, parse_errors=parse_errors,
+                  files_scanned=n)
+
+
+def lint_file(path: str, rel: Optional[str] = None,
+              root: Optional[str] = None,
+              rules: Optional[list[Rule]] = None) -> Report:
+    """Single-file convenience (fixture tests): ``rel`` overrides the
+    repo-relative path the path-scoped rules see."""
+    rules = default_rules() if rules is None else rules
+    try:
+        ctx = make_context(path, rel=rel, root=root)
+    except SyntaxError as e:
+        return Report(findings=[], files_scanned=1, parse_errors=[
+            (rel or path, f"{e.msg} (line {e.lineno})")])
+    findings = []
+    for rule in rules:
+        findings.extend(rule.check_file(ctx))
+    findings = _filter_suppressed(findings, {ctx.rel: ctx})
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Report(findings=findings, parse_errors=[], files_scanned=1)
+
+
+def to_json_text(report: Report) -> str:
+    return json.dumps(report.to_json(), indent=2, sort_keys=False)

@@ -1,0 +1,27 @@
+"""The hyperlint rule set — one module per rule, registered here.
+
+Each rule is grounded in an incident from this repo's history (see the
+module docstrings and docs/static-analysis.md for the catalog).
+"""
+
+from hyperspace_tpu.analysis.rules.catalog import TelemetryCatalogRule
+from hyperspace_tpu.analysis.rules.donation import DonationHazardRule
+from hyperspace_tpu.analysis.rules.exceptions import SwallowBaseExceptionRule
+from hyperspace_tpu.analysis.rules.flags import FlagDocDriftRule
+from hyperspace_tpu.analysis.rules.hostsync import HostSyncRule
+from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
+from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
+from hyperspace_tpu.analysis.rules.tracerleak import TracerLeakRule
+
+ALL_RULES = (
+    RecompileHazardRule,
+    DonationHazardRule,
+    HostSyncRule,
+    TracerLeakRule,
+    SwallowBaseExceptionRule,
+    PrecisionLiteralRule,
+    TelemetryCatalogRule,
+    FlagDocDriftRule,
+)
+
+RULES_BY_ID = {cls.id: cls for cls in ALL_RULES}

@@ -1,0 +1,137 @@
+"""AST helpers shared by the JAX-hazard rules (jit/scan region finding)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from hyperspace_tpu.analysis.core import FileContext
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                ast.Lambda)
+
+
+def is_jit_name(resolved: Optional[str]) -> bool:
+    """Whether a resolved dotted name is the jax.jit entry point."""
+    return resolved in ("jax.jit", "jax.pjit") or (
+        resolved is not None and resolved.endswith((".jax.jit", ".pjit")))
+
+
+def is_scan_name(resolved: Optional[str]) -> bool:
+    return resolved is not None and (
+        resolved == "jax.lax.scan" or resolved.endswith("lax.scan"))
+
+
+def jit_call_target(ctx: FileContext, call: ast.Call) -> bool:
+    return isinstance(call, ast.Call) and is_jit_name(ctx.resolve(call.func))
+
+
+def partial_jit_decorator(ctx: FileContext, dec: ast.AST) -> Optional[ast.Call]:
+    """The ``partial(jax.jit, ...)`` call node when ``dec`` is one."""
+    if (isinstance(dec, ast.Call) and ctx.resolve(dec.func) in
+            ("functools.partial", "partial") and dec.args
+            and is_jit_name(ctx.resolve(dec.args[0]))):
+        return dec
+    return None
+
+
+def jitted_defs(ctx: FileContext) -> dict[str, ast.FunctionDef]:
+    """{name: def} for functions that become jitted programs: decorated
+    with ``jax.jit`` / ``partial(jax.jit, ...)``, wrapped by name in a
+    ``jax.jit(name, ...)`` call, or passed as a ``lax.scan`` body."""
+    defs: dict[str, ast.FunctionDef] = {}
+    by_name: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+            for dec in node.decorator_list:
+                if (is_jit_name(ctx.resolve(dec))
+                        or partial_jit_decorator(ctx, dec) is not None):
+                    defs[node.name] = node
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = ctx.resolve(node.func)
+        wraps = (is_jit_name(resolved) or is_scan_name(resolved))
+        if wraps and node.args and isinstance(node.args[0], ast.Name):
+            for fd in by_name.get(node.args[0].id, ()):
+                defs[fd.name] = fd
+    return defs
+
+
+def scan_body_nodes(ctx: FileContext) -> list[ast.AST]:
+    """The function bodies (defs or lambdas) passed to ``lax.scan``."""
+    by_name: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+    out: list[ast.AST] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and is_scan_name(ctx.resolve(node.func)) and node.args):
+            continue
+        fn = node.args[0]
+        if isinstance(fn, ast.Lambda):
+            out.append(fn)
+        elif isinstance(fn, ast.Name):
+            out.extend(by_name.get(fn.id, ()))
+    return out
+
+
+def walk_scope(scope: ast.AST) -> Iterator[ast.AST]:
+    """Walk a scope's own statements without descending into nested
+    function/class/lambda scopes (their names are not this scope's)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _SCOPE_NODES):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def scopes(ctx: FileContext) -> Iterator[ast.AST]:
+    """The module plus every function def (scopes for name tracking)."""
+    yield ctx.tree
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def const_str_tuple(node: ast.AST) -> tuple[str, ...]:
+    """String constants inside a tuple/list/single-constant node."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def const_int_tuple(node: ast.AST) -> tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int))
+    return ()
+
+
+UNHASHABLE_LITERALS = (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                       ast.SetComp, ast.DictComp)
+
+
+def unhashable_kind(node: ast.AST) -> Optional[str]:
+    """'dict'/'list'/'set' when ``node`` is an unhashable literal (or a
+    bare dict()/list()/set() constructor call)."""
+    if isinstance(node, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(node, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "set"
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in ("dict", "list", "set")):
+        return node.func.id
+    return None

@@ -1,0 +1,167 @@
+"""telemetry-catalog: every registry name in code is documented.
+
+Migrated from ``scripts/check_telemetry_catalog.py`` (PR 2/PR 4): the
+counter catalog in docs/observability.md is the contract dashboards and
+the bench read; an undocumented counter is invisible telemetry, and a
+typo'd READ (``get("ns/nmae")`` silently returning 0) is worse.  The
+script path remains as a shim over this rule.
+
+AST-accurate version of the same scan, over every package file plus the
+repo-root ``bench.py``:
+
+- writes: ``inc("name")`` / ``set_gauge("name")`` calls (any receiver);
+- reads: ``get("ns/name")`` calls whose literal first argument carries a
+  ``/`` (every registry name is namespaced; plain dict ``.get("key")``
+  stays out);
+- the ``# telemetry-catalog: name`` escape for dynamically-built names.
+
+Each name must appear as a backticked token in docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Optional
+
+from hyperspace_tpu.analysis.core import (FileContext, ProjectContext, Rule,
+                                          make_context)
+
+DOC_REL = "docs/observability.md"
+_ANNOT_RX = re.compile(r"#\s*telemetry-catalog:\s*(\S+)")
+_WRITE_FNS = {"inc", "set_gauge"}
+
+# line-based fallback for text the AST cannot parse (the shim must not
+# silently drop a mid-refactor file's names — the old scanner was
+# line-based and caught them)
+_FALLBACK_WRITE_RX = re.compile(
+    r"\b(?:inc|set_gauge)\(\s*[\"']([^\"']+)[\"']")
+_FALLBACK_READ_RX = re.compile(r"\bget\(\s*[\"']([^\"' ]*/[^\"' ]*)[\"']")
+
+
+def names_in_text(text: str, rel: str) -> dict[str, list[str]]:
+    """Regex scan of raw text — the pre-AST behavior, kept as the
+    unparseable-file fallback for :func:`counters_in_code`."""
+    found: dict[str, list[str]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        for rx in (_FALLBACK_WRITE_RX, _FALLBACK_READ_RX, _ANNOT_RX):
+            for m in rx.finditer(line):
+                found.setdefault(m.group(1), []).append(f"{rel}:{lineno}")
+    return found
+
+
+def _call_fn_name(node: ast.Call) -> Optional[str]:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+def names_in_context(ctx: FileContext) -> dict[str, list[str]]:
+    """{registry name: ["rel:line", ...]} for one parsed file."""
+    found: dict[str, list[str]] = {}
+
+    def add(name: str, lineno: int) -> None:
+        found.setdefault(name, []).append(f"{ctx.rel}:{lineno}")
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue
+        fn = _call_fn_name(node)
+        if fn in _WRITE_FNS:
+            add(first.value, node.lineno)
+        elif (fn == "get" and "/" in first.value
+              and " " not in first.value):
+            add(first.value, node.lineno)
+    for lineno, comment in ctx.comments.items():
+        for m in _ANNOT_RX.finditer(comment):
+            add(m.group(1), lineno)
+    return found
+
+
+def documented_names(doc_text: str) -> set[str]:
+    """Names carried in the catalog doc (any backticked token)."""
+    return set(re.findall(r"`([^`\s]+)`", doc_text))
+
+
+def _merge(into: dict[str, list[str]], more: dict[str, list[str]]) -> None:
+    for k, v in more.items():
+        into.setdefault(k, []).extend(v)
+
+
+class TelemetryCatalogRule(Rule):
+    id = "telemetry-catalog"
+    severity = "error"
+    summary = ("registry counter/gauge names (writes AND namespaced "
+               "reads) missing from docs/observability.md")
+
+    def check_project(self, proj: ProjectContext):
+        # the analysis package is exempt (its docstrings/messages name
+        # the very tokens this rule hunts — same reason scripts/ was
+        # never self-scanned)
+        scanned = [c for c in proj.contexts
+                   if (c.rel.startswith("hyperspace_tpu/")
+                       and not c.rel.startswith("hyperspace_tpu/analysis/"))
+                   or c.rel == "bench.py"]
+        if not scanned:
+            return []
+        doc = proj.read_doc(DOC_REL)
+        if doc is None:
+            return [self.finding(scanned[0], 1,
+                                 f"missing catalog doc: {DOC_REL}")]
+        documented = documented_names(doc)
+        found: dict[str, list[str]] = {}
+        for ctx in scanned:
+            _merge(found, names_in_context(ctx))
+        findings = []
+        by_rel = {c.rel: c for c in scanned}
+        for name in sorted(found):
+            if name in documented:
+                continue
+            rel, _, line = found[name][0].partition(":")
+            ctx = by_rel[rel]
+            findings.append(self.finding(
+                ctx, int(line),
+                f"telemetry name {name!r} is used in code but missing "
+                f"from {DOC_REL}'s catalog — add its row (or the "
+                "`# telemetry-catalog: <name>` escape for dynamic "
+                "names)"))
+        return findings
+
+
+# --- script-shim API (scripts/check_telemetry_catalog.py) --------------------
+
+
+def counters_in_code(pkg_dir: str) -> dict[str, list[str]]:
+    """Legacy contract: scan every .py under ``pkg_dir`` plus the
+    sibling ``bench.py``; rel paths from the package's parent."""
+    root = os.path.dirname(os.path.abspath(pkg_dir))
+    found: dict[str, list[str]] = {}
+    paths = []
+    for dirpath, _dirs, files in os.walk(pkg_dir):
+        if "__pycache__" in dirpath:
+            continue
+        paths += [os.path.join(dirpath, n) for n in sorted(files)
+                  if n.endswith(".py")]
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        paths.append(bench)
+    for path in paths:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        if rel.startswith("hyperspace_tpu/analysis/"):
+            continue  # self-exempt, as check_project (lint code names
+            # the tokens it hunts)
+        try:
+            ctx = make_context(path, root=root)
+        except SyntaxError:
+            with open(path, encoding="utf-8") as f:
+                _merge(found, names_in_text(f.read(), rel))
+            continue
+        _merge(found, names_in_context(ctx))
+    return found

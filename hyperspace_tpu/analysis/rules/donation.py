@@ -1,0 +1,143 @@
+"""donation-hazard: a donated buffer read again after the dispatch.
+
+Historical incident: the PR 1 chunked stepper donates the carried train
+state (``jax.jit(body, donate_argnums=(0,))``) — during that work, code
+that kept using the OLD state object after a dispatch read deallocated
+buffers.  XLA donation invalidates the argument's buffers at dispatch;
+depending on backend/timing that read is an error, garbage, or silently
+stale — the worst kind of bug.
+
+The rule tracks, per scope: callables bound from a ``jax.jit(...)`` call
+carrying ``donate_argnums``/``donate_argnames``, calls to them, and any
+LATER read of a name that was passed in a donated slot without being
+rebound first.  ``state = step(state)`` — the correct idiom — rebinds
+the name at the call line and is clean.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+from hyperspace_tpu.analysis.rules._shared import (
+    const_int_tuple, const_str_tuple, is_jit_name, scopes, walk_scope)
+
+
+def _donation_spec(call: ast.Call):
+    """(argnums, argnames) from a jax.jit call, or None when not donating."""
+    nums: tuple[int, ...] = ()
+    names: tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums = const_int_tuple(kw.value)
+        elif kw.arg == "donate_argnames":
+            names = const_str_tuple(kw.value)
+    return (nums, names) if (nums or names) else None
+
+
+def _donated_arg_names(call: ast.Call, spec) -> list[str]:
+    nums, names = spec
+    out = []
+    for i in nums:
+        if 0 <= i < len(call.args) and isinstance(call.args[i], ast.Name):
+            out.append(call.args[i].id)
+    for kw in call.keywords:
+        if kw.arg in names and isinstance(kw.value, ast.Name):
+            out.append(kw.value.id)
+    return out
+
+
+def _assign_targets(node: ast.AST) -> set[str]:
+    """Names a statement (re)binds."""
+    out: set[str] = set()
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        for n in ast.walk(node.target):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    return out
+
+
+class DonationHazardRule(Rule):
+    id = "donation-hazard"
+    severity = "error"
+    summary = "name passed in a donate_argnums slot is read after dispatch"
+
+    def check_file(self, ctx: FileContext):
+        findings = []
+        for scope in scopes(ctx):
+            nodes = list(walk_scope(scope))
+            # donating callables bound in this scope
+            donors: dict[str, tuple] = {}
+            for node in nodes:
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and is_jit_name(ctx.resolve(node.value.func))):
+                    continue
+                spec = _donation_spec(node.value)
+                if spec is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donors[t.id] = spec
+            # calls through them (plus direct jax.jit(f, donate...)(x))
+            dispatches = []  # (call node, donated names, rebound names)
+            for node in nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                spec = None
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id in donors):
+                    spec = donors[node.func.id]
+                elif (isinstance(node.func, ast.Call)
+                      and is_jit_name(ctx.resolve(node.func.func))):
+                    spec = _donation_spec(node.func)
+                if spec is None:
+                    continue
+                donated = _donated_arg_names(node, spec)
+                if not donated:
+                    continue
+                stmt = node
+                for anc in ctx.ancestors(node):
+                    stmt = anc
+                    if isinstance(anc, ast.stmt):
+                        break
+                dispatches.append((node, donated, _assign_targets(stmt)))
+            if not dispatches:
+                continue
+            # later reads of donated names without an intervening rebind
+            # — (line, col) positions, so `out = step(state); log(state)`
+            # on ONE line is still a read after the dispatch
+            loads: dict[str, list[tuple[int, int]]] = {}
+            stores: dict[str, list[tuple[int, int]]] = {}
+            for node in nodes:
+                if isinstance(node, ast.Name):
+                    d = loads if isinstance(node.ctx, ast.Load) else stores
+                    d.setdefault(node.id, []).append(
+                        (node.lineno, node.col_offset))
+            for call, donated, rebound in dispatches:
+                end = (getattr(call, "end_lineno", call.lineno),
+                       getattr(call, "end_col_offset", 1 << 30))
+                for name in donated:
+                    if name in rebound:
+                        continue
+                    later = sorted(pos for pos in loads.get(name, ())
+                                   if pos > end)
+                    if not later:
+                        continue
+                    first = later[0]
+                    if any(end < pos < first
+                           for pos in stores.get(name, ())):
+                        continue  # rebound before the read
+                    findings.append(self.finding(
+                        ctx, first[0],
+                        f"{name!r} is donated to the dispatch at line "
+                        f"{call.lineno} and read again here — donation "
+                        "invalidates its buffers (the chunked-stepper "
+                        "bug class); rebind the call's result "
+                        f"({name} = ...) or drop the donation"))
+        return findings

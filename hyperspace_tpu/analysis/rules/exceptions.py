@@ -1,0 +1,104 @@
+"""swallow-base-exception: handlers that can eat alarms and errors.
+
+Historical incident: PR 4's bench watchdog.  The per-leg SIGALRM
+deadline raises inside whatever code is running — and the benched code
+is full of defensive ``except Exception`` blocks.  A handler broad
+enough to catch the alarm swallowed it once, and the leg ran unbounded
+with the alarm already spent; ``_LegTimeout`` had to become a
+``BaseException`` subclass to get past them (bench.py).
+
+Two shapes are flagged:
+
+- **error** — ``except BaseException`` or a bare ``except:`` whose body
+  neither re-raises nor uses the caught exception: this swallows
+  ``KeyboardInterrupt``, ``SystemExit``, and the bench's ``_LegTimeout``
+  alarm outright.  Cleanup-and-reraise (``except BaseException: ...;
+  raise``) is the legitimate form and is not flagged.
+- **warning** — ``except Exception`` (or a tuple containing it) whose
+  body is SILENT (only ``pass``/``continue``/``break``): real failures
+  vanish without a trace.  Handlers that log, build an error record, or
+  reference the caught exception are considered handled.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+
+_BROADEST = {"BaseException"}
+_BROAD = {"Exception"}
+
+
+def _caught_names(handler: ast.ExceptHandler) -> set[str]:
+    """Leaf class names this handler catches ('' for a bare except)."""
+    t = handler.type
+    if t is None:
+        return {""}
+    nodes = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = set()
+    for n in nodes:
+        if isinstance(n, ast.Attribute):  # e.g. builtins.BaseException
+            out.add(n.attr)
+        elif isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+def _has_raise(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _uses_caught(handler: ast.ExceptHandler) -> bool:
+    if not handler.name:
+        return False
+    return any(isinstance(n, ast.Name) and n.id == handler.name
+               for stmt in handler.body for n in ast.walk(stmt))
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue  # stray docstring/ellipsis
+        return False
+    return True
+
+
+class SwallowBaseExceptionRule(Rule):
+    id = "swallow-base-exception"
+    severity = "error"
+    summary = ("bare/BaseException handlers without re-raise; silent "
+               "'except Exception: pass'")
+
+    def check_file(self, ctx: FileContext):
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            caught = _caught_names(node)
+            if caught & _BROADEST or "" in caught:
+                if _has_raise(node) or _uses_caught(node):
+                    continue
+                what = ("bare `except:`" if "" in caught
+                        else "`except BaseException`")
+                findings.append(self.finding(
+                    ctx, node,
+                    f"{what} without re-raise swallows KeyboardInterrupt "
+                    "/ SystemExit / the bench's _LegTimeout alarm (the "
+                    "PR 4 watchdog bug class) — catch Exception, or "
+                    "re-raise after cleanup"))
+            elif caught & _BROAD:
+                if _has_raise(node) or _uses_caught(node):
+                    continue
+                if _is_silent(node):
+                    findings.append(self.finding(
+                        ctx, node,
+                        "silent `except Exception: pass` — real failures "
+                        "vanish without a trace; narrow the exception "
+                        "type, log, or re-raise (suppress with a reason "
+                        "when best-effort really is the design)",
+                        severity="warning"))
+        return findings

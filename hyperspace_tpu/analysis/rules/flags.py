@@ -1,0 +1,104 @@
+"""flag-doc-drift: every CLI flag is documented in README/docs.
+
+Same catalog-lint pattern as the telemetry counters (PR 2), applied to
+the user-facing flag surface — the README flag tables are the contract
+users (and the bench driver) read, and a flag that exists only in the
+source is invisible:
+
+- every ``key=`` override field of a dataclass config in
+  ``hyperspace_tpu/cli/`` (RunConfig, ServeConfig — the ``key=value``
+  CLI grammar exposes every public field) must appear as ``key=``
+  somewhere in README.md or docs/*.md;
+- every ``--flag`` registered by ``bench.py``'s argparse must appear as
+  ``--flag`` there too.
+
+Underscore-private fields are skipped.  Dynamically-built flags can't be
+scanned; keep them literal (they are today).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from hyperspace_tpu.analysis.core import FileContext, ProjectContext, Rule
+
+
+def _is_dataclass_decorated(ctx: FileContext, node: ast.ClassDef) -> bool:
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        resolved = ctx.resolve(target) or ""
+        if resolved == "dataclass" or resolved.endswith(".dataclass"):
+            return True
+    return False
+
+
+def config_fields(ctx: FileContext) -> list[tuple[str, int]]:
+    """(field name, line) per public field of each dataclass config."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.ClassDef)
+                and _is_dataclass_decorated(ctx, node)):
+            continue
+        for stmt in node.body:
+            if (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                    and not stmt.target.id.startswith("_")):
+                out.append((stmt.target.id, stmt.lineno))
+    return out
+
+
+def bench_flags(ctx: FileContext) -> list[tuple[str, int]]:
+    """(--flag, line) per argparse add_argument in the file."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument" and node.args):
+            continue
+        first = node.args[0]
+        if (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                and first.value.startswith("--")):
+            out.append((first.value, node.lineno))
+    return out
+
+
+def _kv_documented(name: str, docs: str) -> bool:
+    return re.search(rf"\b{re.escape(name)}=", docs) is not None
+
+
+def _flag_documented(flag: str, docs: str) -> bool:
+    return re.search(rf"{re.escape(flag)}(?![\w-])", docs) is not None
+
+
+class FlagDocDriftRule(Rule):
+    id = "flag-doc-drift"
+    severity = "error"
+    summary = ("CLI key= fields and bench --flags missing from the "
+               "README/docs flag tables")
+
+    def check_project(self, proj: ProjectContext):
+        docs = "\n".join(t for t in proj.doc_texts().values() if t)
+        findings = []
+        if not docs:
+            docs = ""  # every flag is then drift — the right failure
+        for ctx in proj.contexts:
+            if ctx.rel.startswith("hyperspace_tpu/cli/"):
+                for name, line in config_fields(ctx):
+                    if not _kv_documented(name, docs):
+                        findings.append(self.finding(
+                            ctx, line,
+                            f"CLI flag {name}= ({ctx.rel}) has no "
+                            f"`{name}=` row in README.md/docs/*.md — "
+                            "add it to the flag table (the catalog "
+                            "pattern: undocumented flags are invisible)"))
+            elif ctx.rel == "bench.py":
+                for flag, line in bench_flags(ctx):
+                    if not _flag_documented(flag, docs):
+                        findings.append(self.finding(
+                            ctx, line,
+                            f"bench flag {flag} has no mention in "
+                            "README.md/docs/*.md — document it beside "
+                            "the other bench flags"))
+        return findings

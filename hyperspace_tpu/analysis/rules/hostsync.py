@@ -1,0 +1,110 @@
+"""host-sync-in-hot-path: device→host fetches inside the hot regions.
+
+Historical incident: the PR 1/PR 2 loop work exists to keep the host
+OUT of the step path — K steps vanish into one ``lax.scan`` dispatch and
+the loss is fetched once per log boundary, never per step.  A stray
+``.item()`` / ``float()`` / ``jax.device_get`` inside a scan body or a
+trace-span block silently reserializes host and device (or, inside a
+traced scan body, fails outright at trace time).
+
+Hot regions:
+
+- the body of any function (def or lambda) passed to ``lax.scan`` —
+  there ``np.asarray``/``np.array`` are flagged too, because a traced
+  value cannot be materialized at all (ConcretizationTypeError);
+- the body of any ``with span("..."):`` block (``telemetry/trace.py``)
+  — the instrumented dispatch paths (``dispatch``, ``metrics_flush``,
+  ``query``); here only the unambiguous sync markers fire: ``.item()``,
+  ``jax.device_get``, and ``float(x)`` on a non-literal.
+
+The one-per-boundary ``float(loss)`` flush in ``train/loop.py`` is the
+DOCUMENTED sync point and carries an inline suppression — the pattern to
+copy when a sync is the design.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+from hyperspace_tpu.analysis.rules._shared import scan_body_nodes
+
+
+def _span_bodies(ctx: FileContext) -> list[tuple[str, list[ast.stmt]]]:
+    """(span name, body statements) per ``with span("..."):`` block."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            call = item.context_expr
+            if not isinstance(call, ast.Call):
+                continue
+            resolved = ctx.resolve(call.func) or ""
+            if not (resolved == "span" or resolved.endswith(".span")):
+                continue
+            name = ""
+            if call.args and isinstance(call.args[0], ast.Constant):
+                name = str(call.args[0].value)
+            out.append((name, node.body))
+    return out
+
+
+def _sync_kind(ctx: FileContext, node: ast.AST) -> str | None:
+    """'item'/'device_get'/'float'/'asarray' when ``node`` is a host-sync
+    call, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    if (isinstance(node.func, ast.Attribute) and node.func.attr == "item"
+            and not node.args and not node.keywords):
+        return "item"
+    resolved = ctx.resolve(node.func) or ""
+    if resolved == "jax.device_get" or resolved.endswith(".device_get"):
+        return "device_get"
+    if (isinstance(node.func, ast.Name) and node.func.id == "float"
+            and len(node.args) == 1
+            and not isinstance(node.args[0], ast.Constant)):
+        return "float"
+    if resolved in ("numpy.asarray", "numpy.array"):
+        return "asarray"
+    return None
+
+
+class HostSyncRule(Rule):
+    id = "host-sync-in-hot-path"
+    severity = "warning"
+    summary = (".item()/float()/device_get/np.asarray inside lax.scan "
+               "bodies or span(...) dispatch blocks")
+
+    def check_file(self, ctx: FileContext):
+        findings = []
+        seen: set[int] = set()
+
+        def scan_region(root_nodes, where: str, include_asarray: bool):
+            for root in root_nodes:
+                for node in ast.walk(root):
+                    kind = _sync_kind(ctx, node)
+                    if kind is None or id(node) in seen:
+                        continue
+                    if kind == "asarray" and not include_asarray:
+                        continue
+                    seen.add(id(node))
+                    what = {"item": ".item()",
+                            "device_get": "jax.device_get",
+                            "float": "float(...)",
+                            "asarray": "np.asarray/np.array"}[kind]
+                    findings.append(self.finding(
+                        ctx, node,
+                        f"{what} {where} — a device→host sync on the hot "
+                        "path (the per-step fetch the chunked loop "
+                        "exists to remove); batch the fetch at a log "
+                        "boundary, or suppress with a reason if this IS "
+                        "the documented sync point"))
+
+        scan_region(scan_body_nodes(ctx), "inside a lax.scan body",
+                    include_asarray=True)
+        for name, body in _span_bodies(ctx):
+            label = (f"inside the span({name!r}) block" if name
+                     else "inside a span(...) block")
+            scan_region(body, label, include_asarray=False)
+        return findings

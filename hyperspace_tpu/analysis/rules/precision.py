@@ -1,0 +1,158 @@
+"""precision-literal: the bf16 lint, AST-accurate.
+
+Historical incident: PR 5 shipped the mixed-precision policy with a
+regex lint (``scripts/check_precision_policy.py``) because bf16 literals
+kept leaking past the boundary-safety policy during development.  The
+regex misses aliased imports (``import jax.numpy as q; q.bfloat16``),
+``from jax.numpy import bfloat16``, and can false-positive on strings in
+odd positions.  This rule is the AST port — same contract, structural
+matching; the script path remains as a shim over this rule.
+
+Policy (docs/precision.md): ``hyperspace_tpu/precision.py`` is the ONE
+place package code may name bf16; ``hyperspace_tpu/kernels/`` picks
+dtypes from its INPUT dtype and is exempt.  Flagged in any other package
+file:
+
+- any ``<base>.bfloat16`` attribute (``jnp``/``np``/``jax.numpy``/any
+  alias — the base does not matter, there is no legitimate non-dtype
+  ``.bfloat16``);
+- ``from <mod> import bfloat16`` (and uses of the imported name);
+- a string literal equal to ``"bfloat16"`` (dtype strings; docstrings
+  merely *discussing* bf16 never fire — they are not the token).
+
+Escapes: the legacy ``# precision-policy: ok (reason)`` annotation keeps
+working, as does ``# hyperlint: disable=precision-literal``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from hyperspace_tpu.analysis.core import FileContext, Rule, context_from_text
+
+LEGACY_ANNOT = "precision-policy: ok"
+ALLOWED_FILE = "hyperspace_tpu/precision.py"
+ALLOWED_DIR = "hyperspace_tpu/kernels/"
+
+# the legacy regex — kept only as the fallback for unparseable text fed
+# to the script shim's violations_in_text()
+_LEGACY_RX = re.compile(
+    r"(?:\bjnp\.bfloat16\b|\bjax\.numpy\.bfloat16\b|\bnp\.bfloat16\b"
+    r"|[\"']bfloat16[\"'])")
+
+
+def in_scope(rel: str) -> bool:
+    """Whether the policy applies to this repo-relative path.  The
+    analysis package itself is exempt for the same reason scripts/ was
+    never self-scanned: lint code names the tokens it hunts."""
+    rel = rel.replace("\\", "/")
+    if not rel.startswith("hyperspace_tpu/"):
+        return False
+    if rel.startswith("hyperspace_tpu/analysis/"):
+        return False
+    return rel != ALLOWED_FILE and not rel.startswith(ALLOWED_DIR)
+
+
+def _bf16_nodes(ctx: FileContext):
+    """(node, what) per bf16 literal in the tree."""
+    imported_names: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "bfloat16":
+                    imported_names.add(a.asname or a.name)
+                    yield node, f"from-import of bfloat16"
+        elif isinstance(node, ast.Attribute) and node.attr == "bfloat16":
+            base = ctx.dotted(node.value) or "<expr>"
+            yield node, f"{base}.bfloat16"
+        elif (isinstance(node, ast.Constant)
+              and node.value == "bfloat16"):
+            yield node, '"bfloat16" dtype string'
+        elif (isinstance(node, ast.Name) and node.id in imported_names
+              and isinstance(node.ctx, ast.Load)):
+            yield node, f"use of imported {node.id!r}"
+
+
+class PrecisionLiteralRule(Rule):
+    id = "precision-literal"
+    severity = "error"
+    summary = ("ad-hoc bf16 literal outside precision.py/kernels/ "
+               "(AST port of check_precision_policy)")
+
+    def check_file(self, ctx: FileContext):
+        if not in_scope(ctx.rel):
+            return []
+        findings = []
+        for node, what in _bf16_nodes(ctx):
+            line = getattr(node, "lineno", 0)
+            if LEGACY_ANNOT in ctx.comment_text(line):
+                continue
+            findings.append(self.finding(
+                ctx, node,
+                f"{what} outside the precision policy — route the dtype "
+                "decision through hyperspace_tpu/precision.py "
+                "(docs/precision.md), or annotate a flag-name line with "
+                f"`# {LEGACY_ANNOT} (reason)`"))
+        return findings
+
+
+# --- script-shim API (scripts/check_precision_policy.py) ---------------------
+
+
+def violations_in_text(text: str, rel: str) -> list[str]:
+    """Legacy contract: ``["rel:lineno: stripped line", ...]`` for bf16
+    literals in CODE.  AST-based; unparseable text falls back to the old
+    comment-stripped regex so the shim never crashes on a fragment."""
+    try:
+        ctx = context_from_text(text, rel=rel)
+    except SyntaxError:
+        out = []
+        for lineno, line in enumerate(text.splitlines(), 1):
+            if LEGACY_ANNOT in line:
+                continue
+            code = line.split("#", 1)[0]
+            if _LEGACY_RX.search(code):
+                out.append(f"{rel}:{lineno}: {line.strip()}")
+        return out
+    rule = PrecisionLiteralRule()
+    lines_hit: list[int] = []
+    for node, _what in _bf16_nodes(ctx):
+        line = getattr(node, "lineno", 0)
+        if LEGACY_ANNOT in ctx.comment_text(line):
+            continue
+        if rule.id in ctx.suppressions.get(line, ()):
+            continue
+        lines_hit.append(line)
+    return [f"{rel}:{ln}: {ctx.line_text(ln).strip()}"
+            for ln in sorted(set(lines_hit))]
+
+
+def scan_package(pkg_dir: str, root: Optional[str] = None) -> list[str]:
+    """Legacy contract: offenders across every .py under ``pkg_dir``
+    (rel paths taken from the package's parent, as before).  Scope is
+    decided on the path RELATIVE TO ``pkg_dir`` mapped into the package
+    namespace, so any directory tree passed in gets the same exemptions
+    (root ``precision.py``, ``kernels/``, ``analysis/``) instead of a
+    silent all-clean when it does not live at ``hyperspace_tpu/``."""
+    import os
+
+    pkg_abs = os.path.abspath(pkg_dir)
+    root = root or os.path.dirname(pkg_abs)
+    offenders: list[str] = []
+    for dirpath, _dirs, files in os.walk(pkg_abs):
+        if "__pycache__" in dirpath:
+            continue
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            scoped = ("hyperspace_tpu/"
+                      + os.path.relpath(path, pkg_abs).replace(os.sep, "/"))
+            if not in_scope(scoped):
+                continue
+            with open(path, encoding="utf-8") as f:
+                offenders += violations_in_text(f.read(), rel)
+    return offenders

@@ -1,0 +1,158 @@
+"""recompile-hazard: jit wrappers built per call/iteration, and
+unhashable values reaching static args.
+
+Historical incident: the PR 3 serving engine exists because per-request
+recompiles were the serving failure mode — its ``jax/recompiles``
+contract (one compile per (bucket, k), zero steady-state) is tested.
+The hazards this rule catches are the ways that contract quietly breaks:
+
+- ``jax.jit(...)`` inside a ``for``/``while`` loop: a fresh wrapper per
+  iteration — at best a cache lookup per step on the hot path, at worst
+  a recompile per iteration when anything in the closure differs;
+- ``jax.jit(f)(...)`` built and invoked in one expression inside a
+  function: the wrapper is discarded after the call, so every call pays
+  wrapper construction + cache lookup (and recompiles whenever ``f`` is
+  a fresh closure object);
+- a ``static_argnames``/``static_argnums`` parameter whose default (or a
+  call-site value) is a dict/list/set: statics must hash — unhashable
+  values raise, and per-call-distinct hashables retrace every call.
+
+Factory functions that BUILD and RETURN a jitted callable once (the
+``make_*_step`` idiom everywhere in this repo) are fine and not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+from hyperspace_tpu.analysis.rules._shared import (
+    const_int_tuple, const_str_tuple, is_jit_name, partial_jit_decorator,
+    unhashable_kind)
+
+_LOOPS = (ast.For, ast.While, ast.AsyncFor)
+
+
+def _static_kwargs(call: ast.Call) -> tuple[tuple[str, ...], tuple[int, ...]]:
+    names: tuple[str, ...] = ()
+    nums: tuple[int, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names = const_str_tuple(kw.value)
+        elif kw.arg == "static_argnums":
+            nums = const_int_tuple(kw.value)
+    return names, nums
+
+
+def _param_default(fd: ast.FunctionDef, name: str):
+    """The default-value node for parameter ``name``, or None."""
+    args = fd.args
+    pos = args.posonlyargs + args.args
+    n_def = len(args.defaults)
+    for i, a in enumerate(pos):
+        if a.arg == name:
+            j = i - (len(pos) - n_def)
+            return args.defaults[j] if j >= 0 else None
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if a.arg == name:
+            return d
+    return None
+
+
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    severity = "error"
+    summary = ("jax.jit built per call/loop iteration, or unhashable "
+               "dict/list/set values on static args")
+
+    def check_file(self, ctx: FileContext):
+        findings = []
+        defs_by_name = {n.name: n for n in ast.walk(ctx.tree)
+                        if isinstance(n, ast.FunctionDef)}
+        # {callable name: static argnames} for call-site value checks
+        static_fns: dict[str, tuple[str, ...]] = {}
+
+        def check_static_spec(call: ast.Call, fd: ast.FunctionDef | None):
+            names, nums = _static_kwargs(call)
+            if fd is None:
+                return names
+            params = ([a.arg for a in fd.args.posonlyargs + fd.args.args]
+                      + [a.arg for a in fd.args.kwonlyargs])
+            static_names = list(names)
+            for i in nums:
+                if 0 <= i < len(params):
+                    static_names.append(params[i])
+            for p in static_names:
+                kind = unhashable_kind(_param_default(fd, p))
+                if kind is not None:
+                    findings.append(self.finding(
+                        ctx, _param_default(fd, p),
+                        f"static arg {p!r} of {fd.name!r} defaults to a "
+                        f"{kind} — statics must be hashable: every call "
+                        "either raises or retraces (use a tuple or move "
+                        "it out of the statics)"))
+            return tuple(static_names)
+
+        for node in ast.walk(ctx.tree):
+            # decorated defs: @jax.jit / @partial(jax.jit, static_*=...)
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    pj = partial_jit_decorator(ctx, dec)
+                    if pj is not None:
+                        static_fns[node.name] = check_static_spec(pj, node)
+                    elif (isinstance(dec, ast.Call)
+                          and is_jit_name(ctx.resolve(dec.func))):
+                        static_fns[node.name] = check_static_spec(dec, node)
+                continue
+            if not (isinstance(node, ast.Call)
+                    and is_jit_name(ctx.resolve(node.func))):
+                continue
+            # jax.jit(fn, static_*=...) call form
+            fd = None
+            if node.args and isinstance(node.args[0], ast.Name):
+                fd = defs_by_name.get(node.args[0].id)
+            statics = check_static_spec(node, fd)
+            parent = ctx.parents.get(id(node))
+            if (isinstance(parent, ast.Assign)
+                    and len(parent.targets) == 1
+                    and isinstance(parent.targets[0], ast.Name)):
+                static_fns[parent.targets[0].id] = statics
+            # jit under a loop: fresh wrapper per iteration
+            loop = next((a for a in ctx.ancestors(node)
+                         if isinstance(a, _LOOPS)), None)
+            if loop is not None:
+                findings.append(self.finding(
+                    ctx, node,
+                    "jax.jit inside a loop builds a fresh wrapper every "
+                    "iteration (cache lookup per step; recompile when the "
+                    "closure differs) — hoist it to module/__init__ "
+                    "scope or build it once before the loop"))
+                continue
+            # jax.jit(f)(...) immediate invocation inside a function
+            if (isinstance(parent, ast.Call) and parent.func is node
+                    and any(isinstance(a, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))
+                            for a in ctx.ancestors(node))):
+                findings.append(self.finding(
+                    ctx, node,
+                    "jax.jit(f)(...) builds and discards the jitted "
+                    "wrapper on every call — bind it once (module scope "
+                    "or a factory) so the compile cache can do its job",
+                    severity="warning"))
+        # call sites passing unhashable literals for known static args
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in static_fns):
+                continue
+            for kw in node.keywords:
+                if kw.arg in static_fns[node.func.id]:
+                    kind = unhashable_kind(kw.value)
+                    if kind is not None:
+                        findings.append(self.finding(
+                            ctx, kw.value,
+                            f"{kind} passed for static arg {kw.arg!r} of "
+                            f"jitted {node.func.id!r} — unhashable "
+                            "statics raise or retrace per call; pass a "
+                            "tuple"))
+        return findings

@@ -1,0 +1,99 @@
+"""tracer-leak: Python control flow on traced values (heuristic).
+
+Historical incident class: a ``jit``/``scan`` body that branches with
+Python ``if``/``while`` on a traced value raises
+``ConcretizationTypeError`` at best; at worst (when the value happens to
+be concrete at trace time — a closure, a first-call constant) it bakes
+ONE branch into the compiled program and silently serves stale control
+flow forever after.  The scan-carry variant is exactly what ROADMAP's
+pod-scale training multiplies.
+
+Heuristic, deliberately conservative (severity ``note``): inside a
+jitted function or a ``lax.scan`` body, flag
+
+- ``if``/``while`` whose test calls into ``jnp.*``/``jax.*`` (e.g.
+  ``if jnp.any(x > 0):``) or calls a reduction method (``.any()``/
+  ``.all()``/``.item()``) — shape/dtype introspection (``jnp.ndim``,
+  ``jnp.shape``, ``jnp.dtype``, ``jnp.issubdtype``, ...) is static
+  under trace and does NOT fire;
+- ``int(...)``/``bool(...)``/``float(...)`` whose argument contains such
+  a call — host casts that force the tracer concrete.
+
+Use ``jax.lax.cond``/``jnp.where``/``lax.while_loop`` instead, or hoist
+the decision out of the traced region.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+from hyperspace_tpu.analysis.rules._shared import jitted_defs, scan_body_nodes
+
+# static-under-trace introspection: never a tracer leak
+_STATIC_FNS = {"ndim", "shape", "dtype", "issubdtype", "result_type",
+               "iinfo", "finfo", "isdtype", "size"}
+_REDUCTION_METHODS = {"any", "all", "item"}
+
+
+def _traced_value_call(ctx: FileContext, expr: ast.AST) -> ast.AST | None:
+    """A call node inside ``expr`` that plausibly produces/reads a traced
+    value: a non-static ``jnp.*``/``jax.*`` call or an ``.any()``-style
+    reduction method."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REDUCTION_METHODS
+                and not node.args):
+            return node
+        resolved = ctx.resolve(node.func) or ""
+        parts = resolved.split(".")
+        if parts[0] == "jax" or resolved.startswith("jax.numpy"):
+            if parts[-1] not in _STATIC_FNS:
+                return node
+    return None
+
+
+class TracerLeakRule(Rule):
+    id = "tracer-leak"
+    severity = "note"
+    summary = ("Python if/while/int() on traced values inside jit/scan "
+               "regions (heuristic)")
+
+    def check_file(self, ctx: FileContext):
+        findings = []
+        regions: list[ast.AST] = list(jitted_defs(ctx).values())
+        regions += [n for n in scan_body_nodes(ctx) if n not in regions]
+        seen: set[int] = set()
+        for region in regions:
+            for node in ast.walk(region):
+                if id(node) in seen:
+                    continue
+                if isinstance(node, (ast.If, ast.While)):
+                    hit = _traced_value_call(ctx, node.test)
+                    if hit is not None:
+                        seen.add(id(node))
+                        kw = "while" if isinstance(node, ast.While) else "if"
+                        findings.append(self.finding(
+                            ctx, node,
+                            f"Python `{kw}` on a traced value inside a "
+                            "jit/scan region — concretization error or a "
+                            "silently baked-in branch; use lax.cond / "
+                            "jnp.where / lax.while_loop (heuristic: "
+                            "suppress if the value is genuinely static)"))
+                elif (isinstance(node, ast.Call)
+                      and isinstance(node.func, ast.Name)
+                      and node.func.id in ("int", "bool", "float")
+                      and node.args):
+                    hit = _traced_value_call(ctx, node.args[0])
+                    if hit is not None:
+                        seen.add(id(node))
+                        findings.append(self.finding(
+                            ctx, node,
+                            f"{node.func.id}(...) on a traced value "
+                            "inside a jit/scan region forces the tracer "
+                            "concrete — keep it on device (astype / "
+                            "lax ops) or hoist the cast out of the "
+                            "traced region"))
+        return findings

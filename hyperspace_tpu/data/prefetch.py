@@ -102,7 +102,7 @@ class HostPrefetcher:
         while not self._q.empty():  # unblock a worker stuck on put
             try:
                 self._q.get_nowait()
-            except Exception:
+            except queue.Empty:  # raced the worker's last put: done
                 break
         self._thread.join(timeout=5.0)
 

@@ -152,5 +152,5 @@ def install_jax_monitoring_hook() -> None:
 
         _mon.register_event_duration_secs_listener(_on_duration)
         _hook_installed = True
-    except Exception:  # noqa: BLE001 — telemetry must never sink a run
+    except Exception:  # noqa: BLE001  # hyperlint: disable=swallow-base-exception — jax.monitoring absent/renamed: recompile counting is best-effort by contract (telemetry must never sink a run)
         pass

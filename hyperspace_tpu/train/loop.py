@@ -347,7 +347,7 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
                 # so it must sit INSIDE the span or the wait would show
                 # up nowhere in the span breakdown
                 with span("metrics_flush"):
-                    kw = {"loss": float(loss)}
+                    kw = {"loss": float(loss)}  # hyperlint: disable=host-sync-in-hot-path — the documented per-boundary fetch
                     if acc is not None:
                         stats = acc.flush()
                         if stats is not None:
@@ -372,7 +372,7 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
             # loss lands in some interval's loss_mean
             with span("metrics_flush"):
                 stats = acc.flush()
-                final_loss = float(loss)
+                final_loss = float(loss)  # hyperlint: disable=host-sync-in-hot-path — the run-closing boundary fetch
             if stats is not None:
                 log.log(done, loss=final_loss, **stats, **record_fields())
         if ck is not None and start < run.steps and last_saved != done:

@@ -1,89 +1,39 @@
 #!/usr/bin/env python
-"""Lint: no ad-hoc bf16 casts outside the precision policy.
+"""Lint shim: no ad-hoc bf16 casts outside the precision policy.
 
-``hyperspace_tpu/precision.py`` is the ONE place the package is allowed
-to name bf16 (docs/precision.md): consumers take a ``Policy`` and use
-its cast helpers, so every half-precision decision is visible in one
-module and the boundary-sensitive hyperbolic math can't be silently
-downcast by a stray ``astype``.  This script scans every ``.py`` under
-``hyperspace_tpu/`` for bf16 literals in CODE (comments stripped;
-docstrings may *discuss* bf16 freely — only the dtype tokens below
-trigger):
+The implementation moved to the AST rule ``precision-literal`` in
+``hyperspace_tpu/analysis/rules/precision.py`` (docs/static-analysis.md)
+— structural matching catches aliased imports and ``from jax.numpy
+import bfloat16``, and docstrings can discuss bf16 freely.  This script
+keeps the original CLI contract (same args, exit 0 = clean / 1 =
+offenders listed, same helper functions) for
+``tests/test_precision_policy.py`` and any callers of the old path;
+``python -m hyperspace_tpu.analysis --rules precision-literal`` is the
+first-class entry point.
 
-- ``jnp.bfloat16`` / ``jax.numpy.bfloat16`` / ``np.bfloat16``
-- a quoted ``"bfloat16"`` dtype string
-- ``astype(jnp.bfloat16)`` is just the composition of the above
-
-Allowed locations:
-
-- ``hyperspace_tpu/precision.py`` — the policy itself;
-- ``hyperspace_tpu/kernels/`` — the Pallas fast paths (e.g.
-  ``cluster.py``'s single-pass bf16 MXU body) pick dtypes from their
-  INPUT dtype, which the policy already controls upstream;
-- any line carrying a ``# precision-policy: ok`` annotation (use it for
-  CLI dtype-flag *names*, with a reason).
-
-Run by ``tests/test_precision_policy.py`` inside the suite, so an
-ad-hoc cast can't merge.  Exit 0 = clean, 1 = offenders listed.
+Allowed locations (unchanged — docs/precision.md): ``precision.py``
+itself, ``hyperspace_tpu/kernels/``, and any line annotated
+``# precision-policy: ok (reason)``.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-
-_BF16 = re.compile(
-    r"(?:\bjnp\.bfloat16\b|\bjax\.numpy\.bfloat16\b|\bnp\.bfloat16\b"
-    r"|[\"']bfloat16[\"'])")
-_ALLOW_ANNOT = "precision-policy: ok"
-_ALLOWED_FILES = ("precision.py",)
-_ALLOWED_DIRS = (os.path.join("hyperspace_tpu", "kernels"),)
 
 
 def repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _strip_comment(line: str) -> str:
-    """Drop a trailing ``#`` comment (string-aware enough for this
-    codebase: a ``#`` inside quotes would need a quoted bf16 token ON
-    the same line to matter, which the annotation escape covers)."""
-    i = line.find("#")
-    return line if i < 0 else line[:i]
+if repo_root() not in sys.path:  # standalone `python scripts/...` runs
+    sys.path.insert(0, repo_root())
 
-
-def violations_in_text(text: str, rel: str) -> list[str]:
-    """``["path:lineno: line", ...]`` for bf16 literals in code lines."""
-    out = []
-    for lineno, line in enumerate(text.splitlines(), 1):
-        if _ALLOW_ANNOT in line:
-            continue
-        if _BF16.search(_strip_comment(line)):
-            out.append(f"{rel}:{lineno}: {line.strip()}")
-    return out
-
-
-def _allowed(rel: str) -> bool:
-    if os.path.basename(rel) in _ALLOWED_FILES:
-        return True
-    return any(rel.startswith(d + os.sep) for d in _ALLOWED_DIRS)
-
-
-def scan_package(pkg_dir: str) -> list[str]:
-    root = os.path.dirname(pkg_dir)
-    offenders: list[str] = []
-    for dirpath, _dirs, files in os.walk(pkg_dir):
-        for name in sorted(files):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, root)
-            if _allowed(rel):
-                continue
-            with open(path, encoding="utf-8") as f:
-                offenders += violations_in_text(f.read(), rel)
-    return offenders
+from hyperspace_tpu.analysis.rules.precision import (  # noqa: E402,F401
+    LEGACY_ANNOT as _ALLOW_ANNOT,
+    scan_package,
+    violations_in_text,
+)
 
 
 def main() -> int:

@@ -1,73 +1,40 @@
 #!/usr/bin/env python
-"""Lint: every telemetry counter/gauge incremented in code is documented.
+"""Lint shim: every telemetry counter/gauge in code is documented.
 
-The counter catalog in docs/observability.md is the contract consumers
-(dashboards, the bench, humans reading a JSONL) rely on; an undocumented
-counter is invisible telemetry.  This script scans every ``.py`` under
-``hyperspace_tpu/`` — plus the repo-root ``bench.py``, which reads
-registry names of its own (the ``serve_qps`` leg) — for literal
-``inc("name")`` / ``set_gauge("name")`` calls AND namespaced
-``get("ns/name")`` reads, and fails (exit 1, listing offenders) unless
-each name appears in the catalog doc — so a consumer reading a typo'd
-counter (which silently returns 0) fails the lint too.  Run by
-``tests/telemetry/test_catalog.py`` inside the suite, so adding a
-counter without its doc row fails the build.
-
-Dynamically-built names can't be scanned; keep registry names literal
-(they are today) or add the doc row and a ``# telemetry-catalog: name``
-comment the scanner also picks up.
+The implementation moved to the AST rule ``telemetry-catalog`` in
+``hyperspace_tpu/analysis/rules/catalog.py`` (docs/static-analysis.md)
+— structural matching of ``inc``/``set_gauge`` writes and namespaced
+``get("ns/name")`` reads, plus the ``# telemetry-catalog: name`` escape
+for dynamic names.  This script keeps the original CLI contract (same
+scan set — the package plus the repo-root ``bench.py`` — same exit
+codes, same helper functions) for ``tests/telemetry/test_catalog.py``
+and any callers of the old path; ``python -m hyperspace_tpu.analysis
+--rules telemetry-catalog`` is the first-class entry point.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-
-_CALL = re.compile(r"""\b(?:inc|set_gauge)\(\s*["']([^"']+)["']""")
-# registry READS too: get("ns/name") / snapshot-dict .get("ns/name").
-# Requiring a "/" keeps ordinary dict .get("key") calls out — every
-# registry name is namespaced, plain dict keys are not — so a consumer
-# reading a typo'd (hence undocumented) counter name fails the lint.
-_READ = re.compile(r"""\bget\(\s*["']([^"'\s]+/[^"'\s]+)["']""")
-_ANNOT = re.compile(r"#\s*telemetry-catalog:\s*(\S+)")
 
 
 def repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _scan_file(path: str, rel: str, found: dict[str, list[str]]) -> None:
-    with open(path, encoding="utf-8") as f:
-        for lineno, line in enumerate(f, 1):
-            for rx in (_CALL, _READ, _ANNOT):
-                for m in rx.finditer(line):
-                    found.setdefault(m.group(1), []).append(f"{rel}:{lineno}")
+if repo_root() not in sys.path:  # standalone `python scripts/...` runs
+    sys.path.insert(0, repo_root())
 
-
-def counters_in_code(pkg_dir: str) -> dict[str, list[str]]:
-    """{counter name: [file:line, ...]} for every literal registry call
-    under the package, plus the repo-root ``bench.py`` (its serve leg
-    participates in the same registry)."""
-    found: dict[str, list[str]] = {}
-    for root, _dirs, files in os.walk(pkg_dir):
-        for name in files:
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(root, name)
-            _scan_file(path, os.path.relpath(path, os.path.dirname(pkg_dir)),
-                       found)
-    bench = os.path.join(os.path.dirname(pkg_dir), "bench.py")
-    if os.path.exists(bench):
-        _scan_file(bench, "bench.py", found)
-    return found
+from hyperspace_tpu.analysis.rules.catalog import (  # noqa: E402,F401
+    counters_in_code,
+    documented_names as _documented_in_text,
+)
 
 
 def documented_names(doc_path: str) -> set[str]:
     """Names carried in the catalog doc (any backticked token)."""
     with open(doc_path, encoding="utf-8") as f:
-        text = f.read()
-    return set(re.findall(r"`([^`\s]+)`", text))
+        return _documented_in_text(f.read())
 
 
 def main() -> int:

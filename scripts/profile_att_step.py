@@ -69,7 +69,7 @@ def main():
         print(json.dumps({"probe": f"train_step att={use_att}",
                           "time_s": round(best / 10, 5)}), flush=True)
 
-        enc = jax.jit(lambda p, gg: hgcn.HGCNEncoder(cfg).apply(
+        enc = jax.jit(lambda p, gg: hgcn.HGCNEncoder(cfg).apply(  # hyperlint: disable=recompile-hazard — config sweep: each use_att arm IS its own program, by design
             {"params": p["encoder"]}, gg)[0].sum())
         t = timed(enc, st.params, ga)
         print(json.dumps({"probe": f"encoder_fwd att={use_att}",

@@ -1,0 +1,14 @@
+"""Bad fixture: donated buffers read after dispatch (never imported)."""
+import jax
+
+
+def train(state, pairs):
+    step = jax.jit(lambda s, p: s, donate_argnums=(0,))
+    out = step(state, pairs)
+    return state.table, out  # reads donated `state` after the dispatch
+
+
+def train_direct(state):
+    out = jax.jit(lambda s: s, donate_argnums=(0,))(state)
+    print(state)  # donated buffers already invalidated
+    return out

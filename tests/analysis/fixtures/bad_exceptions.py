@@ -1,0 +1,22 @@
+"""Bad fixture: alarm-swallowing handlers (never imported)."""
+
+
+def watchdog(fn):
+    try:
+        fn()
+    except BaseException:  # swallows KeyboardInterrupt / the alarm
+        pass
+
+
+def leg(fn, detail):
+    try:
+        detail["x"] = fn()
+    except Exception:  # silent: the failure vanishes without a trace
+        pass
+
+
+def worst(fn):
+    try:
+        fn()
+    except:  # noqa: E722 — bare
+        pass

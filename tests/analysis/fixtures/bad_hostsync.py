@@ -1,0 +1,22 @@
+"""Bad fixture: host syncs inside the hot regions (never imported)."""
+import jax
+import numpy as np
+
+from hyperspace_tpu.telemetry.trace import span
+
+
+def chunk(state, xs):
+    def body(carry, x):
+        loss = float(carry.sum())  # host sync inside the scan body
+        arr = np.asarray(x)  # concretization inside the scan body
+        return carry, loss + arr.mean()
+
+    return jax.lax.scan(body, state, xs)
+
+
+def dispatch(stepper, state):
+    with span("dispatch"):
+        state, loss = stepper(state)
+        host = loss.item()  # sync inside the dispatch span
+        fetched = jax.device_get(state)  # and a bulk device fetch
+    return state, host, fetched

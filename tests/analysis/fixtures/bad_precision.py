@@ -1,0 +1,10 @@
+"""Bad fixture: bf16 literals the old regex lint missed (never
+imported; linted under a pretend hyperspace_tpu/ rel path)."""
+import jax.numpy as q
+from jax.numpy import bfloat16
+
+
+def cast(x, h):
+    y = x.astype(q.bfloat16)  # aliased import — the regex blind spot
+    z = h.astype("bfloat16")  # dtype string
+    return y.astype(bfloat16), z  # the from-imported name

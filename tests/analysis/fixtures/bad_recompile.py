@@ -1,0 +1,25 @@
+"""Bad fixture: every recompile-hazard shape fires (never imported)."""
+from functools import partial
+
+import jax
+
+
+def hot_loop(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)  # jit built fresh per iteration
+        out.append(f(x))
+    return out
+
+
+def per_call(x):
+    return jax.jit(lambda v: v + 1)(x)  # build-and-discard wrapper
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def step(state, cfg={}):  # unhashable default on a static arg
+    return state
+
+
+def call_site(state):
+    return step(state, cfg={"lr": 1e-2})  # dict passed for a static arg

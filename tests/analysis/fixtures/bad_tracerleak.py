@@ -1,0 +1,26 @@
+"""Bad fixture: Python control flow on traced values (never imported)."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("k",))
+def select(x, k):
+    if jnp.any(x > 0):  # traced value in Python control flow
+        return jax.lax.top_k(x, k)
+    return x, None
+
+
+@jax.jit
+def count(x):
+    return int(jnp.sum(x > 0))  # host cast forces the tracer concrete
+
+
+def scanned(state, xs):
+    def body(carry, x):
+        while jnp.all(carry > 0):  # traced loop condition
+            carry = carry - x
+        return carry, x
+
+    return jax.lax.scan(body, state, xs)

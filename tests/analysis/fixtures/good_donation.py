@@ -1,0 +1,14 @@
+"""Good fixture: the rebind idiom — donation leaves no stale name."""
+import jax
+
+
+def train(state, steps):
+    step = jax.jit(lambda s: s, donate_argnums=(0,))
+    for _ in range(steps):
+        state = step(state)  # rebinds: the old buffers are never read
+    return state
+
+
+def no_donation(state, fn):
+    out = jax.jit(fn)(state)  # hyperlint: disable=recompile-hazard — fixture: no donation, read-after is fine
+    return state, out

@@ -1,0 +1,25 @@
+"""Good fixture: the legitimate broad-handler shapes."""
+import queue
+import shutil
+
+
+def cleanup(fn, staging):
+    try:
+        fn()
+    except BaseException:
+        shutil.rmtree(staging, ignore_errors=True)
+        raise  # cleanup-and-reraise: the alarm still lands
+
+
+def best_effort(fn, log):
+    try:
+        fn()
+    except Exception as e:
+        log.warning(repr(e))  # handled: the failure is visible
+
+
+def narrow(q):
+    try:
+        q.get_nowait()
+    except queue.Empty:  # narrow type: out of this rule's scope
+        pass

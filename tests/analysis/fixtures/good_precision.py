@@ -1,0 +1,8 @@
+"""Good fixture: bfloat16 discussed in prose (this docstring — even
+jnp.bfloat16 spelled out) never fires; code goes through the policy."""
+
+flag: str = "bfloat16"  # precision-policy: ok (CLI flag name)
+
+
+def cast(x, policy):
+    return policy.cast_compute(x)  # the sanctioned path

@@ -1,0 +1,24 @@
+"""Good fixture: the sanctioned jit idioms (never imported)."""
+from functools import partial
+
+import jax
+
+double = jax.jit(lambda v: v * 2)  # module scope: compiled once
+
+
+@partial(jax.jit, static_argnames=("k",))
+def topk(x, k=8):  # hashable static default
+    return jax.lax.top_k(x, k)
+
+
+def make_step(cfg):
+    """Factory: builds the jitted step ONCE and returns it."""
+
+    def body(state):
+        return state
+
+    return jax.jit(body, donate_argnums=(0,))
+
+
+def caller(state):
+    return topk(state, k=4)  # hashable static value at the call site

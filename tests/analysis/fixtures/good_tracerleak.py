@@ -1,0 +1,17 @@
+"""Good fixture: static introspection and device-side branching."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def select(x):
+    if jnp.ndim(x) == 1:  # shape introspection is static under trace
+        x = x[None, :]
+    return jnp.where(x > 0, x, 0.0)  # device-side branch
+
+
+@jax.jit
+def clipped(x, mode="soft"):
+    if mode == "soft":  # Python branch on a static python value
+        return jnp.tanh(x)
+    return jnp.clip(x, -1.0, 1.0)

@@ -1,0 +1,174 @@
+"""hyperlint framework contracts: suppressions, output formats, CLI.
+
+The per-rule good/bad fixtures live in test_rules.py; this file covers
+the machinery every rule rides on (hyperspace_tpu/analysis/core.py).
+"""
+
+import json
+import os
+
+import pytest
+
+from hyperspace_tpu.analysis import __main__ as cli
+from hyperspace_tpu.analysis.core import (Finding, lint_file, lint_paths,
+                                          make_context)
+from hyperspace_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+from hyperspace_tpu.analysis.rules.exceptions import SwallowBaseExceptionRule
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+BAD = """\
+def f(x):
+    try:
+        return x()
+    except BaseException:
+        pass
+"""
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_suppression_silences_exactly_the_named_rule(tmp_path):
+    rule = [SwallowBaseExceptionRule()]
+    path = _write(tmp_path, "bad.py", BAD)
+    assert lint_file(path, rules=rule).findings, "fixture must fire"
+    suppressed = BAD.replace(
+        "except BaseException:",
+        "except BaseException:  # hyperlint: disable=swallow-base-exception"
+        " — fixture reason")
+    path = _write(tmp_path, "ok.py", suppressed)
+    assert lint_file(path, rules=rule).findings == []
+    # a DIFFERENT rule id on the line does not silence this rule
+    wrong = BAD.replace(
+        "except BaseException:",
+        "except BaseException:  # hyperlint: disable=tracer-leak")
+    path = _write(tmp_path, "wrong.py", wrong)
+    assert lint_file(path, rules=rule).findings
+
+
+def test_suppression_takes_comma_separated_ids(tmp_path):
+    rule = [SwallowBaseExceptionRule()]
+    both = BAD.replace(
+        "except BaseException:",
+        "except BaseException:  "
+        "# hyperlint: disable=tracer-leak,swallow-base-exception")
+    path = _write(tmp_path, "both.py", both)
+    assert lint_file(path, rules=rule).findings == []
+
+
+def test_report_json_artifact_shape(tmp_path):
+    path = _write(tmp_path, "bad.py", BAD)
+    report = lint_file(path, rules=[SwallowBaseExceptionRule()])
+    doc = report.to_json()
+    assert doc["version"] == 1 and doc["clean"] is False
+    (f,) = doc["findings"]
+    assert set(f) == {"rule", "severity", "path", "line", "col", "message"}
+    assert f["rule"] == "swallow-base-exception" and f["line"] == 4
+    assert doc["counts"] == {"swallow-base-exception": 1}
+    assert report.exit_code() == 1
+
+
+def test_parse_error_is_reported_not_raised(tmp_path):
+    path = _write(tmp_path, "broken.py", "def f(:\n")
+    report = lint_paths([path], root=str(tmp_path))
+    assert report.findings == [] and len(report.parse_errors) == 1
+    assert report.exit_code() == 1
+
+
+def test_single_parse_alias_resolution(tmp_path):
+    path = _write(tmp_path, "m.py",
+                  "import jax.numpy as q\nimport numpy\n"
+                  "from jax import lax as L\n\n\nx = q.zeros(3)\n")
+    ctx = make_context(path, rel="m.py", root=str(tmp_path))
+    assert ctx.aliases["q"] == "jax.numpy"
+    assert ctx.aliases["L"] == "jax.lax"
+    call = ctx.tree.body[-1].value
+    assert ctx.resolve(call.func) == "jax.numpy.zeros"
+
+
+def test_every_rule_is_registered_with_id_and_summary():
+    assert len(ALL_RULES) >= 8
+    for cls in ALL_RULES:
+        assert cls.id and cls.summary and cls.severity in (
+            "error", "warning", "note")
+    assert len(RULES_BY_ID) == len(ALL_RULES)  # ids are unique
+
+
+def test_cli_list_rules(capsys):
+    assert cli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for cls in ALL_RULES:
+        assert cls.id in out
+
+
+def test_cli_bad_path_and_bad_rule_are_usage_errors(tmp_path):
+    with pytest.raises(SystemExit):
+        cli.main([str(tmp_path / "nope.py")])
+    with pytest.raises(SystemExit):
+        cli.main(["--rules", "not-a-rule", str(tmp_path)])
+
+
+def test_cli_json_on_bad_fixture(capsys):
+    bad = os.path.join(FIXTURES, "bad_exceptions.py")
+    rc = cli.main(["--json", "--rules", "swallow-base-exception", bad])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["clean"] is False
+    assert {f["rule"] for f in doc["findings"]} == {"swallow-base-exception"}
+    assert all(f["path"].startswith("tests/analysis/fixtures/")
+               for f in doc["findings"])
+
+
+def test_cli_human_output_and_exit_zero_on_clean(tmp_path, capsys):
+    path = _write(tmp_path, "fine.py", "x = 1\n")
+    rc = cli.main(["--root", str(tmp_path), str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "hyperlint OK" in out
+
+
+def test_finding_render_is_clickable():
+    f = Finding(rule="r", severity="error", path="a/b.py", line=3, col=7,
+                message="m")
+    assert f.render() == "a/b.py:3:7: [r/error] m"
+
+
+# --- review regressions ------------------------------------------------------
+
+
+def test_overlapping_input_paths_scan_each_file_once(tmp_path):
+    pkg = tmp_path / "pkg"
+    sub = pkg / "sub"
+    sub.mkdir(parents=True)
+    bad = sub / "bad.py"
+    bad.write_text(BAD)
+    report = lint_paths([str(pkg), str(sub), str(bad)],
+                        root=str(tmp_path),
+                        rules=[SwallowBaseExceptionRule()])
+    assert report.files_scanned == 1
+    assert len(report.findings) == 1
+    assert report.to_json()["counts"] == {"swallow-base-exception": 1}
+
+
+def test_directive_inside_string_literal_is_not_a_suppression(tmp_path):
+    """The grammar lives in comments only — help text or a test string
+    QUOTING a disable directive must not silence a finding on its
+    line."""
+    src = BAD.replace(
+        "except BaseException:\n        pass",
+        "except BaseException:"
+        ' x = "# hyperlint: disable=swallow-base-exception"')
+    path = _write(tmp_path, "quoted.py", src)
+    report = lint_file(path, rules=[SwallowBaseExceptionRule()])
+    assert report.findings, "string-literal directive must not suppress"
+    assert {f.line for f in report.findings} == {4}  # directive's own line
+    # and the real comment form still works
+    real = BAD.replace(
+        "except BaseException:",
+        "except BaseException:  # hyperlint: disable="
+        "swallow-base-exception — reason")
+    path = _write(tmp_path, "real.py", real)
+    assert lint_file(path, rules=[SwallowBaseExceptionRule()]).findings == []

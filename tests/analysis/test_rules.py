@@ -1,0 +1,378 @@
+"""Per-rule contracts: each bad fixture fires (nonzero exit), each good
+fixture is clean, and each rule's suppression works on its own line.
+
+Fixture files live in ``fixtures/`` (never imported — parsed only).
+The precision fixtures lint under a pretend ``hyperspace_tpu/`` rel
+path because that rule is package-scoped.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from hyperspace_tpu.analysis.core import lint_file, lint_paths
+from hyperspace_tpu.analysis.rules.catalog import TelemetryCatalogRule
+from hyperspace_tpu.analysis.rules.donation import DonationHazardRule
+from hyperspace_tpu.analysis.rules.exceptions import SwallowBaseExceptionRule
+from hyperspace_tpu.analysis.rules.flags import FlagDocDriftRule
+from hyperspace_tpu.analysis.rules.hostsync import HostSyncRule
+from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
+from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
+from hyperspace_tpu.analysis.rules.tracerleak import TracerLeakRule
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+
+def _lint(name, rule, rel=None):
+    return lint_file(os.path.join(FIXTURES, name), rel=rel, rules=[rule()])
+
+
+# --- suppression works for EVERY per-file rule -------------------------------
+
+_PER_FILE = [
+    ("bad_recompile.py", RecompileHazardRule, None),
+    ("bad_donation.py", DonationHazardRule, None),
+    ("bad_hostsync.py", HostSyncRule, None),
+    ("bad_tracerleak.py", TracerLeakRule, None),
+    ("bad_exceptions.py", SwallowBaseExceptionRule, None),
+    ("bad_precision.py", PrecisionLiteralRule,
+     "hyperspace_tpu/models/bad_precision.py"),
+]
+
+
+@pytest.mark.parametrize("name,rule,rel", _PER_FILE,
+                         ids=[r[1].id for r in _PER_FILE])
+def test_suppressing_every_finding_line_goes_clean(tmp_path, name, rule,
+                                                   rel):
+    """Append `# hyperlint: disable=<rule> — reason` to each finding's
+    line of the bad fixture: the re-lint must be clean."""
+    report = _lint(name, rule, rel=rel)
+    assert report.findings, "the bad fixture must fire to prove anything"
+    with open(os.path.join(FIXTURES, name), encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    for fnd in report.findings:
+        lines[fnd.line - 1] += (f"  # hyperlint: disable={fnd.rule} "
+                                "— fixture: suppression contract")
+    p = tmp_path / name
+    p.write_text("\n".join(lines) + "\n")
+    assert lint_file(str(p), rel=rel, rules=[rule()]).findings == []
+
+
+# --- recompile-hazard ---------------------------------------------------------
+
+
+def test_recompile_bad_fixture_fires_every_shape():
+    report = _lint("bad_recompile.py", RecompileHazardRule)
+    msgs = [f.message for f in report.findings]
+    assert report.exit_code() == 1 and len(report.findings) == 4
+    assert any("inside a loop" in m for m in msgs)
+    assert any("builds and discards" in m for m in msgs)
+    assert any("defaults to a dict" in m for m in msgs)
+    assert any("dict passed for static arg 'cfg'" in m for m in msgs)
+
+
+def test_recompile_good_fixture_is_clean():
+    assert _lint("good_recompile.py", RecompileHazardRule).findings == []
+
+
+# --- donation-hazard ----------------------------------------------------------
+
+
+def test_donation_bad_fixture_fires():
+    report = _lint("bad_donation.py", DonationHazardRule)
+    assert report.exit_code() == 1 and len(report.findings) == 2
+    assert all("'state'" in f.message for f in report.findings)
+
+
+def test_donation_good_fixture_is_clean():
+    assert _lint("good_donation.py", DonationHazardRule).findings == []
+
+
+def test_donation_suppression(tmp_path):
+    src = textwrap.dedent("""\
+        import jax
+
+
+        def t(state):
+            step = jax.jit(lambda s: s, donate_argnums=(0,))
+            out = step(state)
+            return state, out  # hyperlint: disable=donation-hazard — fixture
+    """)
+    p = tmp_path / "d.py"
+    p.write_text(src)
+    assert lint_file(str(p), rules=[DonationHazardRule()]).findings == []
+
+
+# --- host-sync-in-hot-path ----------------------------------------------------
+
+
+def test_hostsync_bad_fixture_fires():
+    report = _lint("bad_hostsync.py", HostSyncRule)
+    msgs = [f.message for f in report.findings]
+    assert report.exit_code() == 1 and len(report.findings) == 4
+    assert any("float(...)" in m and "lax.scan body" in m for m in msgs)
+    assert any("np.asarray" in m for m in msgs)
+    assert any(".item()" in m and "span('dispatch')" in m for m in msgs)
+    assert any("jax.device_get" in m for m in msgs)
+
+
+def test_hostsync_good_fixture_is_clean():
+    assert _lint("good_hostsync.py", HostSyncRule).findings == []
+
+
+# --- tracer-leak --------------------------------------------------------------
+
+
+def test_tracerleak_bad_fixture_fires():
+    report = _lint("bad_tracerleak.py", TracerLeakRule)
+    msgs = [f.message for f in report.findings]
+    assert report.exit_code() == 1 and len(report.findings) == 3
+    assert any("`if`" in m for m in msgs)
+    assert any("`while`" in m for m in msgs)
+    assert any("int(...)" in m for m in msgs)
+    assert all(f.severity == "note" for f in report.findings)
+
+
+def test_tracerleak_good_fixture_is_clean():
+    assert _lint("good_tracerleak.py", TracerLeakRule).findings == []
+
+
+# --- swallow-base-exception ---------------------------------------------------
+
+
+def test_exceptions_bad_fixture_fires():
+    report = _lint("bad_exceptions.py", SwallowBaseExceptionRule)
+    assert report.exit_code() == 1 and len(report.findings) == 3
+    sevs = sorted(f.severity for f in report.findings)
+    assert sevs == ["error", "error", "warning"]  # 2 broadest + 1 silent
+
+
+def test_exceptions_good_fixture_is_clean():
+    assert _lint("good_exceptions.py", SwallowBaseExceptionRule
+                 ).findings == []
+
+
+# --- precision-literal --------------------------------------------------------
+
+
+def test_precision_bad_fixture_fires_under_package_rel():
+    report = _lint("bad_precision.py", PrecisionLiteralRule,
+                   rel="hyperspace_tpu/models/bad_precision.py")
+    assert report.exit_code() == 1 and len(report.findings) >= 4
+    whats = " ".join(f.message for f in report.findings)
+    assert "q.bfloat16" in whats  # the aliased import the regex missed
+    assert '"bfloat16" dtype string' in whats
+    assert "from-import" in whats
+
+
+def test_precision_good_fixture_is_clean_under_package_rel():
+    report = _lint("good_precision.py", PrecisionLiteralRule,
+                   rel="hyperspace_tpu/models/good_precision.py")
+    assert report.findings == []
+
+
+@pytest.mark.parametrize("rel", [
+    "hyperspace_tpu/precision.py",          # the policy itself
+    "hyperspace_tpu/kernels/bad.py",        # kernels are exempt
+    "scripts/bad_precision.py",             # outside the package
+])
+def test_precision_scope_exemptions(rel):
+    report = _lint("bad_precision.py", PrecisionLiteralRule, rel=rel)
+    assert report.findings == []
+
+
+def test_precision_hyperlint_suppression(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text("import jax.numpy as jnp\n"
+                 "DT = jnp.bfloat16  "
+                 "# hyperlint: disable=precision-literal — fixture\n")
+    report = lint_file(str(p), rel="hyperspace_tpu/models/m.py",
+                       rules=[PrecisionLiteralRule()])
+    assert report.findings == []
+
+
+# --- telemetry-catalog (project rule) ----------------------------------------
+
+
+def _catalog_tree(tmp_path, doc_row):
+    pkg = tmp_path / "hyperspace_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'from hyperspace_tpu.telemetry import registry as telem\n\n\n'
+        'def f():\n    telem.inc("foo/undocumented")\n'
+        '    return telem.default_registry().get("bar/read")\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "| name | kind |\n|---|---|\n" + doc_row)
+    return tmp_path
+
+
+def test_catalog_bad_tree_fires(tmp_path):
+    root = _catalog_tree(tmp_path, "| `bar/read` | counter |\n")
+    report = lint_paths([str(root / "hyperspace_tpu")], root=str(root),
+                        rules=[TelemetryCatalogRule()])
+    assert report.exit_code() == 1 and len(report.findings) == 1
+    assert "foo/undocumented" in report.findings[0].message
+    # suppression on the inc() line silences the project-rule finding too
+    mod = root / "hyperspace_tpu" / "mod.py"
+    lines = mod.read_text().splitlines()
+    lines[report.findings[0].line - 1] += (
+        "  # hyperlint: disable=telemetry-catalog — fixture")
+    mod.write_text("\n".join(lines) + "\n")
+    report = lint_paths([str(root / "hyperspace_tpu")], root=str(root),
+                        rules=[TelemetryCatalogRule()])
+    assert report.findings == []
+
+
+def test_catalog_good_tree_is_clean(tmp_path):
+    root = _catalog_tree(
+        tmp_path, "| `bar/read` | counter |\n| `foo/undocumented` | c |\n")
+    report = lint_paths([str(root / "hyperspace_tpu")], root=str(root),
+                        rules=[TelemetryCatalogRule()])
+    assert report.findings == []
+
+
+def test_catalog_namespaced_read_counts_plain_get_does_not(tmp_path):
+    pkg = tmp_path / "hyperspace_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'def f(d, reg):\n'
+        '    d.get("plain_key")\n'          # no "/": a dict get, ignored
+        '    return reg.get("ns/typo")\n')  # namespaced: must be documented
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "observability.md").write_text("nothing\n")
+    report = lint_paths([str(pkg)], root=str(tmp_path),
+                        rules=[TelemetryCatalogRule()])
+    assert [f for f in report.findings if "ns/typo" in f.message]
+    assert not [f for f in report.findings if "plain_key" in f.message]
+
+
+# --- flag-doc-drift (project rule) -------------------------------------------
+
+
+def _flags_tree(tmp_path, readme):
+    cli_dir = tmp_path / "hyperspace_tpu" / "cli"
+    cli_dir.mkdir(parents=True)
+    (cli_dir / "train.py").write_text(textwrap.dedent("""\
+        import dataclasses
+
+
+        @dataclasses.dataclass
+        class RunConfig:
+            steps: int = 500
+            mystery_flag: bool = False
+            _private: int = 0
+    """))
+    (tmp_path / "bench.py").write_text(
+        "import argparse\np = argparse.ArgumentParser()\n"
+        'p.add_argument("--repeats", type=int)\n'
+        'p.add_argument("--wobble", action="store_true")\n')
+    (tmp_path / "README.md").write_text(readme)
+    return tmp_path
+
+
+def test_flags_drift_fires(tmp_path):
+    root = _flags_tree(tmp_path, "`steps=500` and `--repeats N`\n")
+    report = lint_paths([str(root / "hyperspace_tpu"),
+                         str(root / "bench.py")], root=str(root),
+                        rules=[FlagDocDriftRule()])
+    assert report.exit_code() == 1
+    msgs = " ".join(f.message for f in report.findings)
+    assert "mystery_flag=" in msgs and "--wobble" in msgs
+    assert "steps" not in msgs and "_private" not in msgs
+    # suppression on the defining lines silences the drift findings
+    for f in report.findings:
+        path = root / f.path
+        lines = path.read_text().splitlines()
+        lines[f.line - 1] += "  # hyperlint: disable=flag-doc-drift — fixture"
+        path.write_text("\n".join(lines) + "\n")
+    report = lint_paths([str(root / "hyperspace_tpu"),
+                         str(root / "bench.py")], root=str(root),
+                        rules=[FlagDocDriftRule()])
+    assert report.findings == []
+
+
+def test_flags_documented_tree_is_clean(tmp_path):
+    root = _flags_tree(
+        tmp_path,
+        "`steps=500`, `mystery_flag=1`, `--repeats N`, `--wobble`\n")
+    report = lint_paths([str(root / "hyperspace_tpu"),
+                         str(root / "bench.py")], root=str(root),
+                        rules=[FlagDocDriftRule()])
+    assert report.findings == []
+
+
+# --- review regressions ------------------------------------------------------
+
+
+def test_donation_same_line_read_after_dispatch_fires(tmp_path):
+    """The read can share the dispatch's LINE — `out = step(state);
+    log(state)` and `return step(state), state` both touch invalidated
+    buffers and must fire (line-granular filtering missed them)."""
+    src = textwrap.dedent("""\
+        import jax
+
+        def f(step_fn, state, log):
+            step = jax.jit(step_fn, donate_argnums=(0,))
+            out = step(state); log(state)
+            return out
+
+        def g(step_fn, state):
+            step = jax.jit(step_fn, donate_argnums=(0,))
+            return step(state), state
+    """)
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    report = lint_file(str(p), rules=[DonationHazardRule()])
+    assert len(report.findings) == 2
+    assert {f.line for f in report.findings} == {5, 10}
+
+
+def test_donation_rebind_idiom_still_clean(tmp_path):
+    src = textwrap.dedent("""\
+        import jax
+
+        def f(step_fn, state):
+            step = jax.jit(step_fn, donate_argnums=(0,))
+            state = step(state)
+            return state
+    """)
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    assert lint_file(str(p), rules=[DonationHazardRule()]).findings == []
+
+
+def test_precision_scan_package_works_outside_repo(tmp_path):
+    """scan_package on an arbitrary directory tree must still lint it
+    (old-script contract) — only the package-shaped exemptions (root
+    precision.py, kernels/, analysis/) are skipped."""
+    from hyperspace_tpu.analysis.rules.precision import scan_package
+
+    pkg = tmp_path / "otherpkg"
+    (pkg / "kernels").mkdir(parents=True)
+    (pkg / "sub").mkdir()
+    (pkg / "sub" / "m.py").write_text(
+        "import jax.numpy as jnp\nx = jnp.bfloat16\n")
+    (pkg / "precision.py").write_text("y = jnp.bfloat16\n")
+    (pkg / "kernels" / "k.py").write_text("z = jnp.bfloat16\n")
+    offenders = scan_package(str(pkg))
+    assert len(offenders) == 1 and offenders[0].startswith(
+        "otherpkg/sub/m.py:2")
+
+
+def test_catalog_shim_falls_back_on_unparseable_file(tmp_path):
+    """A mid-refactor file with a syntax error must not silently drop
+    its telemetry names from the shim scan — the regex fallback keeps
+    them visible."""
+    from hyperspace_tpu.analysis.rules.catalog import counters_in_code
+
+    pkg = tmp_path / "hyperspace_tpu"
+    pkg.mkdir()
+    (pkg / "good.py").write_text('reg.inc("ns/good")\n')
+    (pkg / "broken.py").write_text(
+        'def f(:\n    reg.inc("ns/broken")\n    reg.get("ns/read")\n')
+    found = counters_in_code(str(pkg))
+    assert {"ns/good", "ns/broken", "ns/read"} <= set(found)

@@ -1,0 +1,39 @@
+"""The CI gate: the shipped tree lints clean under the full rule set.
+
+This is the acceptance contract — ``python -m hyperspace_tpu.analysis
+hyperspace_tpu bench.py scripts`` exits 0 on the final tree — run
+in-process (no subprocess, no jax work) so it rides in tier-1.  Every
+accepted hazard in the tree carries a ``# hyperlint: disable=<rule> —
+reason`` annotation; a new unannotated one fails here.
+"""
+
+import os
+
+from hyperspace_tpu.analysis.core import lint_paths, repo_root
+
+TARGETS = ("hyperspace_tpu", "bench.py", "scripts")
+
+
+def test_tree_lints_clean():
+    root = repo_root()
+    report = lint_paths([os.path.join(root, t) for t in TARGETS],
+                        root=root)
+    assert report.parse_errors == [], report.parse_errors
+    assert report.findings == [], "\n" + "\n".join(
+        f.render() for f in report.findings)
+    # sanity: the run actually covered the tree
+    assert report.files_scanned > 80
+
+
+def test_script_shims_preserve_exit_codes(capsys):
+    """The migrated lint scripts keep their CLI contract (exit 0 clean)
+    — the old tests cover their module APIs; this pins main()."""
+    import importlib.util
+
+    root = repo_root()
+    for name in ("check_precision_policy", "check_telemetry_catalog"):
+        path = os.path.join(root, "scripts", f"{name}.py")
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main() == 0, capsys.readouterr().out
