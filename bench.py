@@ -330,13 +330,16 @@ def bench_serve(repeats: int = 2) -> dict:
     Builds a synthetic Poincaré table, warms one (bucket, k) executable
     per bucket of the request batcher's ladder, then times cache-miss
     batches at each bucket size (min-of-repeats; value = best bucket's
-    queries/s).  Also reported: the recompile count during warmup (one
-    per bucket is the contract) and during the timed phase (0 is the
-    contract — a nonzero means the timings include the compiler), and a
-    cached-batcher pass over a hot id set whose hit/padding ratios —
-    counter deltas over that pass alone, not the warmup-diluted
-    process-cumulative gauges — land in the artifact
-    (docs/benchmarks.md "serve_qps").
+    queries/s).  Also reported: per-bucket **latency percentiles**
+    (p50/p95/p99 of the ``serve/e2e_ms`` request histogram, as a DELTA
+    over each bucket's timed pass alone — ``detail.latency_ms.b<N>``,
+    the SLO contract numbers ROADMAP item 3 will gate on), the
+    recompile count during warmup (one per bucket is the contract) and
+    during the timed phase (0 is the contract — a nonzero means the
+    timings include the compiler), and a cached-batcher pass over a hot
+    id set whose hit/padding ratios — counter deltas over that pass
+    alone, not the warmup-diluted process-cumulative gauges — land in
+    the artifact (docs/benchmarks.md "serve_qps").
     """
     import jax
     import jax.numpy as jnp
@@ -369,8 +372,10 @@ def bench_serve(repeats: int = 2) -> dict:
         "recompiles_warmup": c1 - c0, "backend": jax.default_backend(),
     }
     best = 0.0
+    latency = {}
     for b in bat.buckets:
         times = []
+        lat_base = reg.mark()  # per-bucket latency delta window
         for _ in range(max(2, repeats)):
             ids = rng.integers(0, n, size=b).tolist()
             t0 = time.perf_counter()
@@ -379,6 +384,18 @@ def bench_serve(repeats: int = 2) -> dict:
         qps = b / min(times)
         detail[f"qps_b{b}"] = round(qps, 1)
         best = max(best, qps)
+        # p50/p95/p99 of the batcher's per-request e2e histogram over
+        # THIS bucket's timed requests alone (mark/snapshot delta) —
+        # the per-qps-bucket SLO numbers, sourced from hist/serve/e2e_ms.
+        # "n" is the sample count behind them: at the default repeats
+        # the window holds only a few requests, and a percentile with
+        # its basis hidden would read as sturdier than it is
+        e2e = reg.snapshot(baseline=lat_base).get("hist/serve/e2e_ms")
+        if e2e:
+            latency[f"b{b}"] = {
+                "n": e2e["count"],
+                **{q: e2e[q] for q in ("p50", "p95", "p99")}}
+    detail["latency_ms"] = latency
     detail["recompiles_steady"] = reg.get("jax/recompiles") - c1
     # cache effectiveness: a cached batcher over a small hot id set.
     # The serve counters are process-cumulative and the timed phase
@@ -504,6 +521,11 @@ _COMPACT_FIELDS = (
     ("timed_out_legs", ("detail", "timed_out_legs")),
     ("serve_qps", ("detail", "serve", "qps")),
     ("serve_recompiles_steady", ("detail", "serve", "recompiles_steady")),
+    # per-qps-bucket p50/p95/p99 (ms) from the serve/e2e_ms histogram:
+    # the first path is the auto-mode nested leg, the second fires when
+    # bench_serve IS the headline (--metric serve) and detail is flat
+    ("serve_latency_ms", ("detail", "serve", "latency_ms")),
+    ("latency_ms", ("detail", "latency_ms")),
     ("precision_train_ms", ("detail", "precision", "train_step_ms")),
     ("precision_serve_ms", ("detail", "precision", "serve_scan_ms")),
     ("frac_clustered", ("detail", "frac_clustered")),

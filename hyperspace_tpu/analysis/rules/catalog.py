@@ -9,10 +9,13 @@ script path remains as a shim over this rule.
 AST-accurate version of the same scan, over every package file plus the
 repo-root ``bench.py``:
 
-- writes: ``inc("name")`` / ``set_gauge("name")`` calls (any receiver);
+- writes: ``inc("name")`` / ``set_gauge("name")`` / ``observe("name",
+  v)`` calls (any receiver — ``observe`` is the histogram kind added in
+  PR 7; a ``Histogram().observe(value)`` instance call has no string
+  first argument and stays out);
 - reads: ``get("ns/name")`` calls whose literal first argument carries a
   ``/`` (every registry name is namespaced; plain dict ``.get("key")``
-  stays out);
+  stays out) — including ``hist/<name>`` snapshot-entry reads;
 - the ``# telemetry-catalog: name`` escape for dynamically-built names.
 
 Each name must appear as a backticked token in docs/observability.md.
@@ -30,13 +33,13 @@ from hyperspace_tpu.analysis.core import (FileContext, ProjectContext, Rule,
 
 DOC_REL = "docs/observability.md"
 _ANNOT_RX = re.compile(r"#\s*telemetry-catalog:\s*(\S+)")
-_WRITE_FNS = {"inc", "set_gauge"}
+_WRITE_FNS = {"inc", "set_gauge", "observe"}
 
 # line-based fallback for text the AST cannot parse (the shim must not
 # silently drop a mid-refactor file's names — the old scanner was
 # line-based and caught them)
 _FALLBACK_WRITE_RX = re.compile(
-    r"\b(?:inc|set_gauge)\(\s*[\"']([^\"']+)[\"']")
+    r"\b(?:inc|set_gauge|observe)\(\s*[\"']([^\"']+)[\"']")
 _FALLBACK_READ_RX = re.compile(r"\bget\(\s*[\"']([^\"' ]*/[^\"' ]*)[\"']")
 
 
@@ -98,8 +101,8 @@ def _merge(into: dict[str, list[str]], more: dict[str, list[str]]) -> None:
 class TelemetryCatalogRule(Rule):
     id = "telemetry-catalog"
     severity = "error"
-    summary = ("registry counter/gauge names (writes AND namespaced "
-               "reads) missing from docs/observability.md")
+    summary = ("registry counter/gauge/histogram names (writes AND "
+               "namespaced reads) missing from docs/observability.md")
 
     def check_project(self, proj: ProjectContext):
         # the analysis package is exempt (its docstrings/messages name

@@ -30,7 +30,10 @@ loop continues — a malformed line must never take the server down.
 Telemetry wiring matches the train CLI: ``telemetry=1`` installs the
 recompile hook and prints a closing summary line to stderr,
 ``trace_out=`` dumps the host spans (each batch runs under a ``query``
-span) as Chrome ``trace_events`` JSON in a ``finally``.
+span) as Chrome ``trace_events`` JSON in a ``finally``.  The serve loop
+additionally prints a one-line ``serve/e2e_ms`` latency summary (count,
+p50/p95/p99 — docs/observability.md "Histograms") to stderr on exit and
+alongside every ``stats`` response.
 """
 
 from __future__ import annotations
@@ -163,6 +166,34 @@ def run_query(cfg: ServeConfig) -> dict:
             "neighbors": idx.tolist(), "dists": dist.tolist()}
 
 
+def _latency_line(baseline: dict | None = None) -> str:
+    """One-line ``serve/e2e_ms`` summary (count + p50/p95/p99) from the
+    latency histogram — printed to STDERR on serve-loop exit and per
+    ``stats`` request (stdout stays strictly one response per line).
+    With a ``baseline`` (a registry ``mark()`` from serve-loop start)
+    the distribution is the delta over THIS session, not the process
+    lifetime — an earlier in-process run's requests never inflate it."""
+    from hyperspace_tpu.telemetry import registry as telem
+
+    snap = telem.default_registry().snapshot(baseline=baseline)
+    lat = snap.get("hist/serve/e2e_ms")
+    if not lat or not lat.get("count"):
+        return "[serve] latency e2e_ms: no requests"
+    return ("[serve] latency e2e_ms count=%d p50=%.3f p95=%.3f p99=%.3f"
+            % (lat["count"], lat["p50"], lat["p95"], lat["p99"]))
+
+
+def _print_latency_stderr(baseline: dict | None = None) -> None:
+    """Print the latency one-liner to stderr, OUTSIDE the request
+    try-block and shielded: a consumer closing our stderr mid-serve
+    (BrokenPipeError, or ValueError on a closed file) is a diagnostics
+    loss, never a served-request failure or a loop exit."""
+    try:
+        print(_latency_line(baseline), file=sys.stderr, flush=True)
+    except (OSError, ValueError):
+        pass
+
+
 def _json_bool(req: dict, key: str, default: bool) -> bool:
     """Strict JSON boolean: the string \"false\" must be an error, not
     truthy — same reject-don't-coerce policy as the id/k validation."""
@@ -197,26 +228,43 @@ def run_serve(cfg: ServeConfig, *, stdin=None, stdout=None) -> dict:
     """The JSONL loop; returns the closing stats dict (also printed to
     stderr when telemetry is on).  ``stdin``/``stdout`` injectable for
     tests."""
+    from hyperspace_tpu.telemetry import registry as telem
+
     stdin = sys.stdin if stdin is None else stdin
     stdout = sys.stdout if stdout is None else stdout
     _eng, batcher = _build(cfg)
     served = 0
-    for line in stdin:
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            req = json.loads(line)
-            if not isinstance(req, dict):
-                raise ValueError(
-                    f"request must be a JSON object, got {type(req).__name__}")
-            resp = _handle(batcher, req)
-            served += 1
-        except (ValueError, KeyError, TypeError, OverflowError) as e:
-            # OverflowError: numpy raises it for ints past the cast
-            # width; belt-and-braces with the batcher's own range check
-            resp = {"error": f"{type(e).__name__}: {e}"}
-        print(json.dumps(_json_safe(resp)), file=stdout, flush=True)
+    # session baseline: the latency one-liners report the distribution
+    # of THIS serve loop, not the whole process (library/test reuse)
+    session_mark = telem.default_registry().mark()
+    try:
+        for line in stdin:
+            line = line.strip()
+            if not line:
+                continue
+            is_stats = False
+            try:
+                req = json.loads(line)
+                if not isinstance(req, dict):
+                    raise ValueError(
+                        f"request must be a JSON object, "
+                        f"got {type(req).__name__}")
+                resp = _handle(batcher, req)
+                served += 1
+                is_stats = req.get("op") == "stats"
+            except (ValueError, KeyError, TypeError, OverflowError) as e:
+                # OverflowError: numpy raises it for ints past the cast
+                # width; belt-and-braces with the batcher's own range check
+                resp = {"error": f"{type(e).__name__}: {e}"}
+            print(json.dumps(_json_safe(resp)), file=stdout, flush=True)
+            if is_stats:
+                # the latency one-liner rides on stderr beside the stats
+                # response — stdout stays one response per line
+                _print_latency_stderr(session_mark)
+    finally:
+        # the closing summary must survive an engine-level crash — the
+        # accumulated distribution matters most in a post-mortem
+        _print_latency_stderr(session_mark)
     return {"mode": "serve", "served": served, **batcher.stats()}
 
 

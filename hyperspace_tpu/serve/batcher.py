@@ -25,10 +25,23 @@ requests and the engine:
   count per id; edge scoring is uncached (pairs rarely repeat; the
   distance gather is already one cheap dispatch).
 
-Every public entry wraps itself in a ``query`` trace span and bumps
-``serve/requests`` — with telemetry enabled (docs/observability.md) a
-serving process's JSONL/trace shows the same spans and counters a
-training run's does.
+Every public entry wraps itself in a ``query`` trace span (carrying an
+``args`` payload — op, request/batch sizes, buckets, cache hits — so
+Perfetto correlates spans with load) and bumps ``serve/requests`` —
+with telemetry enabled (docs/observability.md) a serving process's
+JSONL/trace shows the same spans and counters a training run's does.
+
+**Per-request lifecycle** (docs/observability.md "Histograms"): each
+request is stamped with monotonic timestamps at enqueue (entry),
+batch-form (validation + cache pass done, slabs about to dispatch),
+dispatch, and complete, and observes three latency histograms —
+``serve/queue_wait_ms`` (enqueue→batch-form: host-side time before any
+device work; the name anticipates the async front door, where this
+becomes real queueing), ``serve/dispatch_ms`` (engine dispatch + result
+fetch, summed over the request's slabs; only observed when at least one
+slab actually dispatched), and ``serve/e2e_ms`` (enqueue→complete).
+These are what ``bench_serve`` reports p50/p95/p99 per bucket from, and
+what the serve CLI's latency summary line reads.
 
 Thread-safety: the LRU is lock-guarded; engine dispatches are jax-level
 thread-safe.  One batcher serves one engine (one artifact).
@@ -39,13 +52,14 @@ from __future__ import annotations
 import collections
 import operator
 import threading
+import time
 from typing import Optional, Sequence
 
 import numpy as np
 
 from hyperspace_tpu.serve.engine import QueryEngine
 from hyperspace_tpu.telemetry import registry as telem
-from hyperspace_tpu.telemetry.trace import span
+from hyperspace_tpu.telemetry.trace import span, tracing
 
 DEFAULT_MIN_BUCKET = 8
 DEFAULT_MAX_BUCKET = 1024
@@ -136,6 +150,57 @@ class _LRU:
             return len(self._d)
 
 
+class _Lifecycle:
+    """One request's lifecycle stamps + the three ``serve/*`` histograms.
+
+    Shared by ``topk`` and ``score`` so the stamping contract (module
+    docstring, "Per-request lifecycle") lives in exactly one place:
+    construct at enqueue, ``formed()`` once validation + cache pass are
+    done, bracket each slab's device work with ``dispatch_start()`` /
+    ``dispatch_done()`` (the result fetch belongs INSIDE the bracket —
+    dispatch is async enqueue, the fetch is the completion wait), and
+    ``finish()`` to observe.  ``serve/dispatch_ms`` is only observed
+    when a slab actually dispatched, so all-cache-hit requests don't
+    pull it toward zero.  ``info`` is the span's ``args`` dict (None
+    when tracing is off — the disabled hot path stays allocation-free);
+    it is read at span exit, so fields landing after ``span()`` entry
+    still make the trace.
+    """
+
+    __slots__ = ("t_enq", "t_form", "info", "buckets_used",
+                 "dispatch_s", "_t_disp")
+
+    def __init__(self, op: str):
+        self.t_enq = time.perf_counter()
+        self.t_form = self.t_enq
+        self.info: Optional[dict] = {"op": op} if tracing() else None
+        self.buckets_used: list = []
+        self.dispatch_s = 0.0
+
+    def formed(self) -> None:
+        self.t_form = time.perf_counter()
+
+    def slab(self, bucket: int, used: int) -> None:
+        self.buckets_used.append(bucket)
+        telem.inc("serve/slots", bucket)
+        telem.inc("serve/padded_waste", bucket - used)
+
+    def dispatch_start(self) -> None:
+        self._t_disp = time.perf_counter()
+
+    def dispatch_done(self) -> None:
+        self.dispatch_s += time.perf_counter() - self._t_disp
+
+    def finish(self) -> None:
+        if self.info is not None:
+            self.info["buckets"] = self.buckets_used
+        telem.observe("serve/queue_wait_ms", (self.t_form - self.t_enq) * 1e3)
+        if self.buckets_used:
+            telem.observe("serve/dispatch_ms", self.dispatch_s * 1e3)
+        telem.observe("serve/e2e_ms",
+                      (time.perf_counter() - self.t_enq) * 1e3)
+
+
 class RequestBatcher:
     """Pads requests onto the bucket ladder and fronts the LRU cache."""
 
@@ -153,7 +218,8 @@ class RequestBatcher:
              ) -> tuple[np.ndarray, np.ndarray]:
         """``(neighbors [B, k] int32, dists [B, k] float)`` in request
         order; cache-aware, bucket-padded."""
-        with span("query"):
+        life = _Lifecycle("topk")
+        with span("query", args=life.info):
             telem.inc("serve/requests")
             ids = _checked_ids(ids, "ids", self.engine.num_nodes)
             if isinstance(k, bool):  # True would index-coerce to k=1
@@ -184,17 +250,25 @@ class RequestBatcher:
                     misses.append(qid)
             telem.inc("serve/cache_hit", len(rows))
             telem.inc("serve/cache_miss", len(misses))
+            # batch-form stamp: validation + cache pass done, device
+            # work (if any) starts now
+            life.formed()
+            if life.info is not None:
+                life.info.update(requests=len(ids), k=k,
+                                 cache_hits=len(rows),
+                                 cache_misses=len(misses))
             for s in range(0, len(misses), self.buckets[-1]):
                 slab = misses[s : s + self.buckets[-1]]
                 b = bucket_for(len(slab), self.buckets)
-                telem.inc("serve/slots", b)
-                telem.inc("serve/padded_waste", b - len(slab))
+                life.slab(b, len(slab))
                 padded = slab + [slab[-1]] * (b - len(slab))
+                life.dispatch_start()
                 idx, dist = self.engine.topk_neighbors(
                     np.asarray(padded, np.int32), k,
                     exclude_self=exclude_self)
                 idx = np.asarray(idx)
                 dist = np.asarray(dist)
+                life.dispatch_done()
                 for j, qid in enumerate(slab):
                     val = (idx[j].copy(), dist[j].copy())
                     rows[qid] = val
@@ -202,6 +276,7 @@ class RequestBatcher:
             self._update_gauges()
             out_i = np.stack([rows[qid][0] for qid in ids])
             out_d = np.stack([rows[qid][1] for qid in ids])
+            life.finish()
             return out_i, out_d
 
     # --- edge scores ----------------------------------------------------------
@@ -209,7 +284,8 @@ class RequestBatcher:
     def score(self, u_ids, v_ids, *, prob: bool = False,
               fd_r: float = 2.0, fd_t: float = 1.0) -> np.ndarray:
         """Bucket-padded ``engine.score_edges`` ([B] in request order)."""
-        with span("query"):
+        life = _Lifecycle("score")
+        with span("query", args=life.info):
             telem.inc("serve/requests")
             n = self.engine.num_nodes
             u = np.asarray(_checked_ids(u_ids, "u", n), np.int64)
@@ -218,20 +294,25 @@ class RequestBatcher:
                 raise ValueError(
                     f"score: need matching id lists; got "
                     f"{u.shape} vs {v.shape}")
+            life.formed()
+            if life.info is not None:
+                life.info["requests"] = int(u.size)
             out = np.empty((u.size,), np.float64)
             top = self.buckets[-1]
             for s in range(0, u.size, top):
                 su, sv = u[s : s + top], v[s : s + top]
                 b = bucket_for(su.size, self.buckets)
-                telem.inc("serve/slots", b)
-                telem.inc("serve/padded_waste", b - su.size)
+                life.slab(b, su.size)
                 pu = np.concatenate([su, np.full(b - su.size, su[-1])])
                 pv = np.concatenate([sv, np.full(b - sv.size, sv[-1])])
+                life.dispatch_start()
                 d = self.engine.score_edges(
                     pu.astype(np.int32), pv.astype(np.int32),
                     prob=prob, fd_r=fd_r, fd_t=fd_t)
                 out[s : s + su.size] = np.asarray(d)[: su.size]
+                life.dispatch_done()
             self._update_gauges()
+            life.finish()
             return out
 
     # --- introspection --------------------------------------------------------
@@ -255,10 +336,13 @@ class RequestBatcher:
 
     def stats(self) -> dict:
         """Current serve counters + ratio gauges + cache occupancy (the
-        `stats` op of the CLI loop)."""
+        `stats` op of the CLI loop).  ``latency_e2e_ms`` is the
+        process-cumulative ``serve/e2e_ms`` histogram summary
+        (count/sum/min/max/p50..p99) — None before the first request."""
         reg = telem.default_registry()
         gauges = reg.snapshot()
         return {
+            "latency_e2e_ms": gauges.get("hist/serve/e2e_ms"),
             "requests": reg.get("serve/requests"),
             "cache_hit": reg.get("serve/cache_hit"),
             "cache_miss": reg.get("serve/cache_miss"),

@@ -13,6 +13,10 @@ JSONL of losses.  This package adds, with zero per-step host sync and
   hit/miss, prefetch stalls/queue depth, dispatches, recompiles via
   ``jax.monitoring``, checkpoint saves/seconds/bytes), snapshotted as
   ``ctr/*`` into every log record and a final ``telemetry_summary``;
+- :mod:`histogram` — streaming latency histograms (``observe(name,
+  ms)``: fixed log buckets, ~5% quantile error, mergeable snapshots),
+  surfaced as ``hist/*`` entries (count/sum/min/max/p50..p99) in the
+  same snapshots — the p50/p95/p99 layer the serve SLOs stand on;
 - :mod:`health` — on-device hyperbolic numerical-health stats (ball
   boundary margin, hyperboloid constraint residual, nonfinite counts),
   sampled every ``health_every=`` chunks and threshold-checked.
@@ -63,10 +67,15 @@ def cli_session(telemetry: bool, trace_out, *, stream=None):
             from hyperspace_tpu.telemetry import trace as _trace
 
             _trace.disable()
+from hyperspace_tpu.telemetry.histogram import (  # noqa: F401
+    Histogram,
+    HistogramSnapshot,
+)
 from hyperspace_tpu.telemetry.registry import (  # noqa: F401
     Registry,
     default_registry,
     install_jax_monitoring_hook,
+    observe,
 )
 from hyperspace_tpu.telemetry.trace import (  # noqa: F401
     Tracer,

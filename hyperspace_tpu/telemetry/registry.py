@@ -15,9 +15,12 @@ incremented anywhere in the package must be documented there —
 fails the build otherwise.  Add the doc row when you add the counter.
 
 Counters are monotonic sums (floats allowed: seconds accumulate);
-gauges are last-write-wins levels (queue depth, bytes on disk).  All
-ops are lock-guarded — the prefetch worker thread increments
-concurrently with the training loop.
+gauges are last-write-wins levels (queue depth, bytes on disk);
+histograms (:mod:`hyperspace_tpu.telemetry.histogram` — the third
+kind, ``observe(name, value)``) are streaming latency distributions
+surfaced as ``hist/<name>`` snapshot entries with count/sum/min/max
+and p50/p90/p95/p99.  All ops are lock-guarded — the prefetch worker
+thread increments concurrently with the training loop.
 
 ``install_jax_monitoring_hook`` subscribes to :mod:`jax.monitoring`'s
 duration events and turns backend compiles into ``jax/recompiles`` /
@@ -43,6 +46,7 @@ class Registry:
         # gauge -> (value, write seq): the seq lets a per-run snapshot
         # exclude stale gauges a PRIOR in-process run set (see mark())
         self._gauges: dict[str, tuple] = {}
+        self._hists: dict = {}  # name -> histogram.Histogram
         self._seq = 0
 
     def inc(self, name: str, value: float = 1) -> None:
@@ -54,6 +58,22 @@ class Registry:
         with self._lock:
             self._seq += 1
             self._gauges[name] = (value, self._seq)
+
+    def observe(self, name: str, value: float) -> None:
+        """Record ``value`` into streaming histogram ``name`` (created
+        on first observe).  The registry lock only guards the name
+        lookup; the histogram's own lock guards the counts — an
+        ``observe`` never blocks behind a ``snapshot`` of OTHER names.
+        The price: an observe racing :meth:`reset` may land in the
+        cleared epoch and be dropped with it (unlike ``inc``, which is
+        reset-atomic) — fine for reset's tests/new-run use."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                from hyperspace_tpu.telemetry.histogram import Histogram
+
+                h = self._hists[name] = Histogram()
+        h.observe(value)
 
     def get(self, name: str) -> float:
         """Current counter value (0 if never incremented); gauges via
@@ -67,7 +87,13 @@ class Registry:
         reporting per-run numbers from this process-cumulative registry
         (run_loop in library use) captures one at run start."""
         with self._lock:
-            return {"counters": dict(self._counters), "seq": self._seq}
+            counters = dict(self._counters)
+            seq = self._seq
+            hists = dict(self._hists)
+        # histogram snapshots are taken OUTSIDE the registry lock (each
+        # histogram has its own) — same reason observe() releases it
+        return {"counters": counters, "seq": seq,
+                "hists": {k: h.snapshot() for k, h in hists.items()}}
 
     def snapshot(self, prefix: str = "", baseline: Optional[dict] = None
                  ) -> dict:
@@ -76,7 +102,17 @@ class Registry:
         ``baseline`` (a prior :meth:`mark`) counters are reported as
         deltas since the capture, and gauges are included only if
         WRITTEN since it — a stale level from a previous in-process run
-        (e.g. its ``ckpt/bytes``) never masquerades as this run's."""
+        (e.g. its ``ckpt/bytes``) never masquerades as this run's.
+
+        Histograms ride along as ``hist/<name>`` entries (count/sum/
+        min/max/p50..p99 dicts — :meth:`HistogramSnapshot.fields`).
+        They keep the fixed ``hist/`` namespace rather than taking
+        ``prefix`` (the loop's ``ctr/`` prefix means "counter"; these
+        are not), so JSONL records and bench artifacts carry e.g.
+        ``hist/serve/e2e_ms`` verbatim.  With a baseline, each
+        histogram is the DELTA distribution since the mark, and
+        histograms with no observations since it are omitted — the
+        same stale-exclusion contract as gauges."""
         with self._lock:
             if baseline is None:
                 out = {prefix + k: v for k, v in self._counters.items()}
@@ -89,13 +125,26 @@ class Registry:
                 out.update((prefix + k, v)
                            for k, (v, s) in self._gauges.items()
                            if s > base_s)
+            hists = dict(self._hists)
+        base_h = (baseline or {}).get("hists", {})
+        for name, h in hists.items():
+            snap = h.snapshot()
+            if baseline is not None:
+                prior = base_h.get(name)
+                if prior is not None:
+                    snap = snap.since(prior)
+                if snap.count <= 0:
+                    continue
+            out["hist/" + name] = snap.fields()
         return out
 
     def reset(self) -> None:
-        """Drop every counter/gauge (tests; a new run in-process)."""
+        """Drop every counter/gauge/histogram (tests; a new run
+        in-process)."""
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
+            self._hists.clear()
             self._seq = 0
 
 
@@ -119,6 +168,12 @@ def inc(name: str, value: float = 1) -> None:
 
 def set_gauge(name: str, value: float) -> None:
     default_registry().set_gauge(name, value)
+
+
+def observe(name: str, value: float) -> None:
+    """Record one value into histogram ``name`` on the default registry
+    (latencies in ms by call-site convention — telemetry/histogram.py)."""
+    default_registry().observe(name, value)
 
 
 def snapshot(prefix: str = "") -> dict:

@@ -49,20 +49,29 @@ _MAX_EVENTS = 1_000_000
 
 class _Span:
     """The enabled-path context manager (one fresh object per span —
-    spans nest and cross threads, so no singleton here)."""
+    spans nest and cross threads, so no singleton here).
 
-    __slots__ = ("_tracer", "_name", "_t0")
+    ``args`` is an optional metadata dict carried into the Chrome-trace
+    event (batch size, bucket, cache hits, step — docs/observability.md)
+    so Perfetto can correlate spans with load.  The dict is held by
+    REFERENCE and read at ``__exit__``: a call site may create it with
+    what it knows up front and fill in the rest (e.g. cache hits) before
+    the span closes."""
 
-    def __init__(self, tracer: "Tracer", name: str):
+    __slots__ = ("_tracer", "_name", "_t0", "_args")
+
+    def __init__(self, tracer: "Tracer", name: str, args=None):
         self._tracer = tracer
         self._name = name
+        self._args = args
 
     def __enter__(self):
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        self._tracer._record(self._name, self._t0, time.perf_counter())
+        self._tracer._record(self._name, self._t0, time.perf_counter(),
+                             self._args)
         return False
 
 
@@ -78,26 +87,29 @@ class Tracer:
         self._agg_n: dict[str, int] = {}
         self._total: dict[str, float] = {}      # run-cumulative
         self._total_n: dict[str, int] = {}
-        # (name, t0, t1, tid) ring — full, oldest events evict first
+        # (name, t0, t1, tid, args) ring — full, oldest events evict first
         self._events: collections.deque = collections.deque(
             maxlen=_MAX_EVENTS)
         self._dropped = 0
 
     # --- recording ------------------------------------------------------------
 
-    def span(self, name: str):
-        """Context manager timing one ``name`` span; nests freely."""
+    def span(self, name: str, args: Optional[dict] = None):
+        """Context manager timing one ``name`` span; nests freely.
+        ``args`` (optional metadata dict) rides into the Chrome dump."""
         if not self.enabled:
             return _NULL
-        return _Span(self, name)
+        return _Span(self, name, args)
 
-    def record_span(self, name: str, t0: float, t1: float) -> None:
+    def record_span(self, name: str, t0: float, t1: float,
+                    args: Optional[dict] = None) -> None:
         """Record one completed span from explicit timestamps — for call
         sites that only know after the fact whether the work really
         happened (e.g. an interval-gated checkpoint save)."""
-        self._record(name, t0, t1)
+        self._record(name, t0, t1, args)
 
-    def _record(self, name: str, t0: float, t1: float) -> None:
+    def _record(self, name: str, t0: float, t1: float,
+                args: Optional[dict] = None) -> None:
         dur = t1 - t0
         with self._lock:
             self._agg[name] = self._agg.get(name, 0.0) + dur
@@ -107,7 +119,8 @@ class Tracer:
             if self.keep_events:
                 if len(self._events) == self._events.maxlen:
                     self._dropped += 1  # deque evicts the oldest
-                self._events.append((name, t0, t1, threading.get_ident()))
+                self._events.append(
+                    (name, t0, t1, threading.get_ident(), args))
 
     def reset(self) -> None:
         """Drop all aggregates/events (tests; a new run in-process).
@@ -164,13 +177,19 @@ class Tracer:
         pid = os.getpid()
         tids: dict[int, int] = {}
         trace = []
-        for name, t0, t1, ident in events:
+        for name, t0, t1, ident, args in events:
             tid = tids.setdefault(ident, len(tids))
-            trace.append({
+            ev = {
                 "name": name, "ph": "X", "pid": pid, "tid": tid,
                 "ts": round(t0 * 1e6, 3),
                 "dur": round((t1 - t0) * 1e6, 3),
-            })
+            }
+            if args:
+                # the optional metadata payload (batch size, bucket,
+                # step, cache hits) — Perfetto shows it on click, so a
+                # slow span is attributable to its load
+                ev["args"] = args
+            trace.append(ev)
         doc = {"traceEvents": trace, "displayTimeUnit": "ms",
                "otherData": {"source": "hyperspace_tpu.telemetry",
                              "dropped_events": dropped}}
@@ -196,16 +215,27 @@ def default_tracer() -> Tracer:
     return _tracer
 
 
-def span(name: str):
+def tracing() -> bool:
+    """True when the default tracer is recording — the guard hot call
+    sites use to skip building a span-``args`` dict entirely on the
+    disabled path (``span()`` itself is allocation-free when disabled,
+    but a caller-built metadata dict would not be)."""
+    t = _tracer
+    return t is not None and t.enabled
+
+
+def span(name: str, args: Optional[dict] = None):
     """``with span("prep"): ...`` on the default tracer.
 
     Call sites keep this unconditionally: disabled (the default) it
-    returns the shared nullcontext without allocating.
+    returns the shared nullcontext without allocating.  ``args`` is the
+    optional metadata dict for the Chrome dump — held by reference, so
+    a call site may fill it in before the span exits.
     """
     t = _tracer
     if t is None or not t.enabled:
         return _NULL
-    return _Span(t, name)
+    return _Span(t, name, args)
 
 
 def enable(*, keep_events: bool = False) -> Tracer:

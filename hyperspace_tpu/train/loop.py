@@ -41,6 +41,7 @@ the old loop (steppers are called directly, no scan wrapper).
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -261,7 +262,7 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
     final_loss)``; loss is nan when no step ran.
     """
     from hyperspace_tpu.telemetry import registry as telem
-    from hyperspace_tpu.telemetry.trace import span
+    from hyperspace_tpu.telemetry.trace import span, tracing
 
     tracer, reg, fresh_tracer = _telemetry_setup(run)
     monitor, health_every = _health_monitor(run, health_fn)
@@ -324,8 +325,17 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
         done = start
         chunk_i = 0
         while done < run.steps:
-            with span("dispatch"):
+            t_disp = time.perf_counter()
+            # span args: step-at-dispatch + chunk size, so a slow span
+            # in the Perfetto timeline is attributable to its position
+            # (built only while tracing — the disabled hot path stays
+            # allocation-free)
+            args = ({"step": done, "chunk": steps_per_call}
+                    if tracing() else None)
+            with span("dispatch", args=args):
                 state, loss = stepper(state)
+            telem.observe("train/dispatch_ms",
+                          (time.perf_counter() - t_disp) * 1e3)
             telem.inc("train/dispatches")
             chunk_i += 1
             if acc is not None:
@@ -346,12 +356,15 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
                 # block-until-device-done (dispatch is async enqueue),
                 # so it must sit INSIDE the span or the wait would show
                 # up nowhere in the span breakdown
+                t_flush = time.perf_counter()
                 with span("metrics_flush"):
                     kw = {"loss": float(loss)}  # hyperlint: disable=host-sync-in-hot-path — the documented per-boundary fetch
                     if acc is not None:
                         stats = acc.flush()
                         if stats is not None:
                             kw.update(stats)
+                telem.observe("train/metrics_flush_ms",
+                              (time.perf_counter() - t_flush) * 1e3)
                 log.log(done, **kw, **record_fields())
             # health sampling rides the chunk cadence, not the log one:
             # a diverging run should flag BEFORE the next log boundary
@@ -370,9 +383,12 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
             # chunks past the last crossed log boundary would otherwise
             # vanish: close the run with a final record so every step's
             # loss lands in some interval's loss_mean
+            t_flush = time.perf_counter()
             with span("metrics_flush"):
                 stats = acc.flush()
                 final_loss = float(loss)  # hyperlint: disable=host-sync-in-hot-path — the run-closing boundary fetch
+            telem.observe("train/metrics_flush_ms",
+                          (time.perf_counter() - t_flush) * 1e3)
             if stats is not None:
                 log.log(done, loss=final_loss, **stats, **record_fields())
         if ck is not None and start < run.steps and last_saved != done:

@@ -235,6 +235,31 @@ def test_catalog_good_tree_is_clean(tmp_path):
     assert report.findings == []
 
 
+def test_catalog_observe_writes_count_instance_observe_does_not(tmp_path):
+    """PR 7: histogram writes — ``observe("name", v)`` — scan like
+    inc/set_gauge; a ``Histogram().observe(value)`` instance call (no
+    string first arg) stays out."""
+    pkg = tmp_path / "hyperspace_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'from hyperspace_tpu.telemetry import registry as telem\n\n\n'
+        'def f(h, v):\n'
+        '    telem.observe("lat/undoc_ms", v)\n'
+        '    h.observe(v)\n')
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "observability.md").write_text("nothing\n")
+    report = lint_paths([str(pkg)], root=str(tmp_path),
+                        rules=[TelemetryCatalogRule()])
+    assert [f for f in report.findings if "lat/undoc_ms" in f.message]
+    assert len(report.findings) == 1  # the value-only call is silent
+    # documenting the name clears it
+    (tmp_path / "docs" / "observability.md").write_text(
+        "| `lat/undoc_ms` | histogram |\n")
+    report = lint_paths([str(pkg)], root=str(tmp_path),
+                        rules=[TelemetryCatalogRule()])
+    assert report.findings == []
+
+
 def test_catalog_namespaced_read_counts_plain_get_does_not(tmp_path):
     pkg = tmp_path / "hyperspace_tpu"
     pkg.mkdir()
@@ -373,6 +398,7 @@ def test_catalog_shim_falls_back_on_unparseable_file(tmp_path):
     pkg.mkdir()
     (pkg / "good.py").write_text('reg.inc("ns/good")\n')
     (pkg / "broken.py").write_text(
-        'def f(:\n    reg.inc("ns/broken")\n    reg.get("ns/read")\n')
+        'def f(:\n    reg.inc("ns/broken")\n    reg.get("ns/read")\n'
+        '    reg.observe("ns/hist_ms", 1.0)\n')
     found = counters_in_code(str(pkg))
-    assert {"ns/good", "ns/broken", "ns/read"} <= set(found)
+    assert {"ns/good", "ns/broken", "ns/read", "ns/hist_ms"} <= set(found)

@@ -1,0 +1,208 @@
+"""scripts/bench_trend.py: the cross-round trend report + regression
+gate, run (1) against the repo's REAL checked-in BENCH_r01–r05
+artifacts — which must tolerate the r04 ``parsed: null`` and the r05
+rc=124 rows without crashing and still gate green — and (2) against
+synthetic fixtures proving the gate's pass/fail contract."""
+
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+SCRIPT = os.path.join(ROOT, "scripts", "bench_trend.py")
+
+
+def _run(*args, cwd=ROOT):
+    return subprocess.run(
+        [sys.executable, SCRIPT, *args],
+        capture_output=True, text=True, cwd=cwd, timeout=60)
+
+
+def _stage_real_rounds(tmp_path) -> str:
+    """Copy only the CHECKED-IN BENCH_r*.json wrappers into a tmp dir:
+    the working tree's bench_full.json is machine-local (a slower box's
+    fresh bench run must not turn this suite red)."""
+    for p in glob.glob(os.path.join(ROOT, "BENCH_r*.json")):
+        shutil.copy(p, tmp_path / os.path.basename(p))
+    return str(tmp_path)
+
+
+def _wrapper(n, value, metric="hgcn_samples_per_sec_per_chip", rc=0,
+             detail=None):
+    return {"n": n, "cmd": "python bench.py", "rc": rc, "tail": "…",
+            "parsed": {"metric": metric, "value": value,
+                       "unit": "samples/s/chip", "vs_baseline": None,
+                       "detail": detail or (
+                           {"step_time_s": 1.0 / value} if value else {})}}
+
+
+def _write_rounds(tmp_path, values, metric="hgcn_samples_per_sec_per_chip"):
+    for i, v in enumerate(values, 1):
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(
+            json.dumps(_wrapper(i, v, metric=metric)))
+
+
+# --- the checked-in artifacts ------------------------------------------------
+
+
+def test_real_artifacts_emit_parseable_trend_json(tmp_path):
+    res = _run("--dir", _stage_real_rounds(tmp_path), "--json")
+    assert res.returncode == 0, res.stderr
+    report = json.loads(res.stdout)
+    rounds = {r["round"]: r for r in report["rounds"]}
+    # r01–r05 all listed; the two lost rounds are rows, not crashes
+    for r in ("r01", "r02", "r03", "r04", "r05"):
+        assert r in rounds
+    assert rounds["r01"]["parsed"] and rounds["r03"]["parsed"]
+    assert not rounds["r04"]["parsed"]          # rc=0, parsed null
+    assert not rounds["r05"]["parsed"]          # rc=124, no artifact
+    assert rounds["r05"]["rc"] == 124
+    # the headline series exists with the known best
+    s = report["series"]["hgcn_samples_per_sec_per_chip"]
+    assert s["direction"] == "higher"
+    assert s["best"]["value"] == 1244134.8 and s["best"]["round"] == "r03"
+    # workload-shape constants never appear as detail series
+    for noise in ("detail.num_nodes", "detail.devices", "detail.steps"):
+        assert noise not in report["series"], noise
+
+
+def test_real_artifacts_gate_green(tmp_path):
+    res = _run("--dir", _stage_real_rounds(tmp_path), "--gate")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "GATE: ok" in res.stderr
+
+
+def test_real_artifacts_markdown_mode(tmp_path):
+    md_out = str(tmp_path / "trend.md")
+    res = _run("--dir", ROOT, "--out-md", md_out)
+    assert res.returncode == 0, res.stderr
+    md = open(md_out).read()
+    assert "# Bench trend" in md and "r04" in md and "r05" in md
+    assert md == res.stdout  # stdout default is the same markdown
+
+
+# --- synthetic gate fixtures -------------------------------------------------
+
+
+def test_gate_passes_on_improving_series(tmp_path):
+    _write_rounds(tmp_path, [100.0, 110.0, 121.0])
+    res = _run("--dir", str(tmp_path), "--gate")
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_gate_fails_on_regression_past_threshold(tmp_path):
+    # latest 95 vs best 110: 13.6% down on a higher-better metric
+    _write_rounds(tmp_path, [100.0, 110.0, 95.0])
+    res = _run("--dir", str(tmp_path), "--gate")
+    assert res.returncode == 1
+    assert "regressed" in res.stderr
+    # a looser threshold lets the same series through
+    res = _run("--dir", str(tmp_path), "--gate", "--threshold", "0.2")
+    assert res.returncode == 0
+
+
+def test_gate_respects_lower_better_direction(tmp_path):
+    # epoch time growing 1.0 → 1.25 s is the regression direction
+    _write_rounds(tmp_path, [1.0, 1.25],
+                  metric="poincare_embed_epoch_time")
+    res = _run("--dir", str(tmp_path), "--gate")
+    assert res.returncode == 1
+    _write_rounds(tmp_path, [1.25, 1.0],
+                  metric="poincare_embed_epoch_time")
+    assert _run("--dir", str(tmp_path), "--gate").returncode == 0
+
+
+def test_gate_zero_best_still_gates(tmp_path):
+    # a lower-better headline whose best round recorded exactly 0 must
+    # not be exempt: any step away from 0 is an (unboundedly large)
+    # regression — reported with regression_pct null, not skipped
+    _write_rounds(tmp_path, [0.0, 50.0],
+                  metric="poincare_embed_epoch_time")
+    res = _run("--dir", str(tmp_path), "--gate", "--json")
+    assert res.returncode == 1, res.stdout
+    regs = json.loads(res.stdout)["gate"]["regressions"]
+    assert [r["regression_pct"] for r in regs] == [None]
+    # holding at 0 is not a regression
+    _write_rounds(tmp_path, [0.0, 0.0],
+                  metric="poincare_embed_epoch_time")
+    assert _run("--dir", str(tmp_path), "--gate").returncode == 0
+
+
+def test_nested_detail_ms_series_infer_lower_direction(tmp_path):
+    # the dotted detail path ends in '.p99'/'.f32', but the unit lives
+    # in the 'latency_ms'/'train_step_ms' segment — the series this PR
+    # adds must get a direction, not the '—' column
+    for i, (p99, step) in enumerate([(2.0, 700.0), (2.4, 650.0)], 1):
+        detail = {"latency_ms": {"b8": {"n": 4, "p50": 1.0, "p99": p99}},
+                  "precision": {"train_step_ms": {"f32": step}}}
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(
+            json.dumps(_wrapper(i, 100.0 + i, detail=detail)))
+    res = _run("--dir", str(tmp_path), "--json")
+    assert res.returncode == 0, res.stderr
+    series = json.loads(res.stdout)["series"]
+    for key in ("detail.latency_ms.b8.p99",
+                "detail.precision.train_step_ms.f32"):
+        assert series[key]["direction"] == "lower", key
+        assert "best" in series[key]
+    assert series["detail.latency_ms.b8.p99"]["best"]["value"] == 2.0
+    # the sample-count leaf is basis size, not a measurement: never
+    # ranked best-when-smallest
+    assert series["detail.latency_ms.b8.n"]["direction"] is None
+
+
+def test_gate_tolerates_lost_rounds_and_sentinels(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps(_wrapper(1, 100.0)))
+    # the r04 loss mode: rc=0, parsed null
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "cmd": "python bench.py", "rc": 0, "tail": "garbage",
+         "parsed": None}))
+    # the r05 loss mode: driver timeout
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        {"n": 3, "cmd": "python bench.py", "rc": 124, "tail": "",
+         "parsed": None}))
+    # a watchdog sentinel in bench_full.json must not gate (value 0!)
+    (tmp_path / "bench_full.json").write_text(json.dumps(
+        {"metric": "budget_exhausted", "value": 0, "unit": "",
+         "vs_baseline": None, "detail": {"budget_exhausted": True}}))
+    res = _run("--dir", str(tmp_path), "--gate", "--json")
+    assert res.returncode == 0, res.stdout + res.stderr
+    report = json.loads(res.stdout)
+    assert len(report["rounds"]) == 4
+    assert "budget_exhausted" not in report["series"]
+    # the one parseable measurement survives as the series
+    assert report["series"]["hgcn_samples_per_sec_per_chip"][
+        "latest"]["value"] == 100.0
+
+
+def test_bench_full_participates_as_latest_round(tmp_path):
+    _write_rounds(tmp_path, [100.0, 110.0])
+    # a fresh local bench run regressing 20% must trip the gate even
+    # before a driver round records it
+    (tmp_path / "bench_full.json").write_text(json.dumps(
+        _wrapper(0, 88.0)["parsed"]))
+    res = _run("--dir", str(tmp_path), "--gate", "--json")
+    assert res.returncode == 1
+    report = json.loads(res.stdout)
+    s = report["series"]["hgcn_samples_per_sec_per_chip"]
+    assert s["latest"]["round"] == "full"
+    assert report["gate"]["regressions"][0]["regression_pct"] > 10
+
+
+def test_empty_dir_is_a_distinct_error(tmp_path):
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 2
+    assert "no BENCH_r*" in res.stderr
+
+
+def test_unreadable_round_is_a_row_not_a_crash(tmp_path):
+    _write_rounds(tmp_path, [100.0])
+    (tmp_path / "BENCH_r02.json").write_text("{not json")
+    res = _run("--dir", str(tmp_path), "--json")
+    assert res.returncode == 0, res.stderr
+    rounds = {r["round"]: r for r in json.loads(res.stdout)["rounds"]}
+    assert not rounds["r02"]["parsed"] and "error" in rounds["r02"]

@@ -196,3 +196,34 @@ def test_within_bucket_sizes_share_one_compile(rng):
     before = reg.get("jax/recompiles")
     b.topk(list(range(50, 60)), 5)
     assert reg.get("jax/recompiles") == before
+
+
+def test_request_lifecycle_histograms(rng):
+    """Each request observes serve/queue_wait_ms, serve/dispatch_ms and
+    serve/e2e_ms with queue_wait ≤ e2e (the enqueue→batch-form stamp is
+    inside the enqueue→complete window) and nonzero counts after a warm
+    pass; all-cache-hit requests skip the dispatch histogram."""
+    eng = _engine(rng)
+    b = RequestBatcher(eng, min_bucket=8, max_bucket=32)
+    reg = telem.default_registry()
+    base = reg.mark()
+    b.topk([0, 1, 2], 4)          # cold: one engine dispatch
+    b.score([0, 1], [2, 3])       # score path observes too
+    snap = reg.snapshot(baseline=base)
+    qw, disp, e2e = (snap[f"hist/serve/{n}"]
+                     for n in ("queue_wait_ms", "dispatch_ms", "e2e_ms"))
+    assert qw["count"] == 2 and e2e["count"] == 2 and disp["count"] == 2
+    assert qw["max"] <= e2e["max"]      # batch-form precedes complete
+    assert disp["max"] <= e2e["max"]    # dispatch is inside the window
+    assert e2e["p50"] is not None and e2e["p99"] is not None
+    assert e2e["max"] > 0
+    # a fully-cached request observes queue_wait/e2e but NO dispatch
+    base = reg.mark()
+    b.topk([2, 0, 1], 4)  # same ids → all hits
+    snap = reg.snapshot(baseline=base)
+    assert snap["hist/serve/e2e_ms"]["count"] == 1
+    assert snap["hist/serve/queue_wait_ms"]["count"] == 1
+    assert "hist/serve/dispatch_ms" not in snap
+    # the stats() surface carries the cumulative e2e summary
+    lat = b.stats()["latency_e2e_ms"]
+    assert lat["count"] >= 3 and lat["p95"] >= lat["p50"]

@@ -114,6 +114,87 @@ def test_serve_mode_stdout_is_responses_only(trained, capsys, monkeypatch):
     assert closing["mode"] == "serve" and closing["served"] == 1
 
 
+def test_latency_summary_rides_stderr(trained, capsys):
+    """The one-line serve/e2e_ms summary prints to STDERR on loop exit
+    AND beside every stats response; stdout stays strictly responses,
+    and the stats response itself carries the latency distribution."""
+    _cfg, _state, _ckpt, art = trained
+    cfg = S.apply_overrides(S.ServeConfig(), {"artifact": art})
+    lines = "\n".join([
+        json.dumps({"op": "topk", "ids": [0, 1], "k": 2}),
+        json.dumps({"op": "stats"}),
+    ]) + "\n"
+    out = io.StringIO()
+    S.run_serve(cfg, stdin=io.StringIO(lines), stdout=out)
+    err = capsys.readouterr().err
+    # one line per stats request + one on exit
+    summaries = [l for l in err.splitlines()
+                 if l.startswith("[serve] latency e2e_ms")]
+    assert len(summaries) == 2
+    assert "p50=" in summaries[0] and "p99=" in summaries[0]
+    resp = [json.loads(l) for l in out.getvalue().strip().splitlines()]
+    assert len(resp) == 2  # stdout: exactly the two responses
+    lat = resp[1]["latency_e2e_ms"]
+    assert lat["count"] >= 1 and lat["p95"] >= lat["p50"]
+
+
+def test_broken_stderr_never_kills_the_serve_loop(trained, monkeypatch):
+    """A consumer closing our stderr mid-serve loses the latency
+    one-liner, not the server: the stats response still lands on
+    stdout and the loop keeps serving subsequent requests."""
+    import sys as _sys
+
+    class _Broken:
+        def write(self, *_a):
+            raise BrokenPipeError("consumer went away")
+
+        def flush(self):
+            raise BrokenPipeError("consumer went away")
+
+    _cfg, _state, _ckpt, art = trained
+    cfg = S.apply_overrides(S.ServeConfig(), {"artifact": art})
+    lines = "\n".join([
+        json.dumps({"op": "stats"}),
+        json.dumps({"op": "topk", "ids": [0], "k": 2}),
+    ]) + "\n"
+    out = io.StringIO()
+    monkeypatch.setattr(_sys, "stderr", _Broken())
+    result = S.run_serve(cfg, stdin=io.StringIO(lines), stdout=out)
+    resp = [json.loads(l) for l in out.getvalue().strip().splitlines()]
+    assert len(resp) == 2 and result["served"] == 2
+    assert "requests" in resp[0]        # the stats answer, not an error
+    assert "neighbors" in resp[1]       # the loop survived past it
+
+
+def test_crash_still_prints_latency_summary(trained, capsys, monkeypatch):
+    """An engine-level crash (outside the per-line error envelope) must
+    not lose the closing latency one-liner: the accumulated
+    distribution matters most in exactly that post-mortem."""
+    _cfg, _state, _ckpt, art = trained
+    cfg = S.apply_overrides(S.ServeConfig(), {"artifact": art})
+    real_handle = S._handle
+    calls = []
+
+    def _dying_handle(batcher, req):
+        if len(calls) >= 1:
+            raise RuntimeError("device fell over")
+        calls.append(req)
+        return real_handle(batcher, req)
+
+    monkeypatch.setattr(S, "_handle", _dying_handle)
+    lines = "\n".join([
+        json.dumps({"op": "topk", "ids": [0, 1], "k": 2}),
+        json.dumps({"op": "topk", "ids": [2], "k": 2}),
+    ]) + "\n"
+    out = io.StringIO()
+    with pytest.raises(RuntimeError):
+        S.run_serve(cfg, stdin=io.StringIO(lines), stdout=out)
+    err = capsys.readouterr().err
+    summaries = [l for l in err.splitlines()
+                 if l.startswith("[serve] latency e2e_ms")]
+    assert summaries and "count=1" in summaries[-1]
+
+
 def test_bad_overrides_fail_loudly(trained):
     _cfg, _state, _ckpt, art = trained
     with pytest.raises(SystemExit):

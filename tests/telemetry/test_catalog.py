@@ -27,10 +27,13 @@ def test_every_counter_in_code_is_documented(capsys):
 
 def test_checker_finds_the_known_counters():
     # the scanner itself must keep working: it should at minimum see the
-    # core counters the loop/cache/prefetcher increment
+    # core counters the loop/cache/prefetcher increment and (PR 7) the
+    # histogram observes on the serve/train/checkpoint paths
     mod = _load_checker()
     pkg = os.path.join(mod.repo_root(), "hyperspace_tpu")
     found = mod.counters_in_code(pkg)
     for name in ("prep_cache/hit", "prefetch/stalls", "train/dispatches",
-                 "ckpt/saves", "jax/recompiles", "health/warnings"):
+                 "ckpt/saves", "jax/recompiles", "health/warnings",
+                 "serve/e2e_ms", "serve/queue_wait_ms",
+                 "train/dispatch_ms", "ckpt/save_ms"):
         assert name in found, (name, sorted(found))

@@ -1,0 +1,241 @@
+"""Streaming histograms (telemetry/histogram.py): quantile error bound,
+merge associativity, thread-safety, empty-snapshot shape, and the
+registry's ``hist/<name>`` surfacing + baseline-delta mechanics."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry.histogram import (
+    Histogram,
+    HistogramSnapshot,
+    observe,
+)
+from hyperspace_tpu.telemetry.registry import Registry
+
+
+def test_empty_histogram_snapshot_shape():
+    s = Histogram().snapshot()
+    assert s.count == 0 and s.sum == 0.0
+    assert s.quantile(0.5) is None
+    assert s.fields() == {"count": 0, "sum": 0.0, "min": None,
+                          "max": None, "p50": None, "p90": None,
+                          "p95": None, "p99": None}
+
+
+def test_single_value_quantiles_are_exact():
+    h = Histogram()
+    h.observe(3.7)
+    s = h.snapshot()
+    # the estimate clamps to observed min/max, so one value is exact
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert s.quantile(q) == pytest.approx(3.7)
+    f = s.fields()
+    assert f["count"] == 1 and f["min"] == f["max"] == pytest.approx(3.7)
+
+
+def test_quantile_error_bound_vs_numpy_on_log_uniform():
+    """The ~5% relative-error contract (geometric bucket midpoint at
+    growth 1.1 → sqrt(1.1)-1 ≈ 4.9%) against numpy's exact quantiles on
+    log-uniform samples spanning 6 decades."""
+    rng = np.random.default_rng(0)
+    vals = np.exp(rng.uniform(np.log(1e-2), np.log(1e4), 50_000))
+    h = Histogram()
+    for v in vals:
+        h.observe(float(v))
+    s = h.snapshot()
+    for q in (0.5, 0.9, 0.95, 0.99):
+        ref = float(np.quantile(vals, q))
+        est = s.quantile(q)
+        assert abs(est - ref) / ref <= 0.05, (q, est, ref)
+
+
+def test_out_of_range_values_clamp_to_observed_extremes():
+    h = Histogram()
+    h.observe(1e-7)   # under LO → underflow bucket
+    h.observe(1e7)    # past HI → overflow bucket
+    s = h.snapshot()
+    assert s.quantile(0.01) == pytest.approx(1e-7)
+    assert s.quantile(0.99) == pytest.approx(1e7)
+    assert s.count == 2
+
+
+def test_nan_observations_are_dropped():
+    h = Histogram()
+    h.observe(float("nan"))
+    assert h.snapshot().count == 0
+    h.observe(2.0)
+    assert h.snapshot().count == 1
+
+
+def test_merge_is_associative_and_matches_concatenation():
+    rng = np.random.default_rng(1)
+    chunks = [np.exp(rng.uniform(-2, 6, 500)) for _ in range(3)]
+    hists = []
+    for c in chunks:
+        h = Histogram()
+        for v in c:
+            h.observe(float(v))
+        hists.append(h.snapshot())
+    a, b, c = hists
+    left = a.merge(b).merge(c)
+    right = a.merge(b.merge(c))
+    assert left.counts == right.counts
+    assert left.count == right.count == sum(len(x) for x in chunks)
+    assert left.sum == pytest.approx(right.sum)
+    assert left.vmin == right.vmin and left.vmax == right.vmax
+    # merged == one histogram over the concatenated stream
+    whole = Histogram()
+    for v in np.concatenate(chunks):
+        whole.observe(float(v))
+    ws = whole.snapshot()
+    assert ws.counts == left.counts and ws.count == left.count
+    for q in (0.5, 0.95):
+        assert left.quantile(q) == pytest.approx(ws.quantile(q))
+
+
+def test_merge_rejects_scheme_mismatch():
+    a = Histogram().snapshot()
+    b = Histogram(lo=1e-2, hi=1e2, growth=1.5).snapshot()
+    with pytest.raises(ValueError, match="scheme mismatch"):
+        a.merge(b)
+
+
+def test_since_subtracts_a_baseline():
+    h = Histogram()
+    for v in (1.0, 2.0, 4.0):
+        h.observe(v)
+    base = h.snapshot()
+    for v in (8.0, 16.0):
+        h.observe(v)
+    delta = h.snapshot().since(base)
+    assert delta.count == 2
+    assert delta.sum == pytest.approx(24.0)
+    # only the two post-baseline buckets remain populated
+    assert sum(delta.counts) == 2
+
+
+def test_since_window_extremes_exclude_premark_spike():
+    # a pre-mark 1500 ms spike must not surface as every later
+    # interval's min/max: the delta tightens to its bucket envelope
+    h = Histogram()
+    h.observe(1500.0)
+    h.observe(0.5)
+    base = h.snapshot()
+    for v in (3.0, 9.0):
+        h.observe(v)
+    delta = h.snapshot().since(base)
+    # bounds come from the window's buckets (≤ ~10% wide), not lifetime
+    assert delta.vmin is not None and 2.0 <= delta.vmin <= 3.0
+    assert delta.vmax is not None and 9.0 <= delta.vmax <= 10.0
+    # and the window quantiles stay inside the envelope
+    assert delta.quantile(0.99) <= delta.vmax
+    # lifetime extremes still intersect when they fall in the window's
+    # edge buckets: an empty window reports no extremes at all
+    empty = h.snapshot().since(h.snapshot())
+    assert empty.count == 0 and empty.vmin is None and empty.vmax is None
+
+
+def test_since_stale_baseline_never_goes_negative():
+    # library misuse across runs: mark() taken, histograms reset, then
+    # smaller fresh values under the same name — the delta must degrade
+    # to clamped zeros, never emit count > 0 beside a negative sum
+    h = Histogram()
+    for _ in range(5):
+        h.observe(1000.0)
+    stale = h.snapshot()
+    h.reset()
+    for _ in range(6):
+        h.observe(5.0)
+    delta = h.snapshot().since(stale)
+    assert delta.sum >= 0.0
+    for q in (0.5, 0.99):
+        est = delta.quantile(q)
+        assert est is None or est >= 0.0
+    assert all(c >= 0 for c in delta.counts)
+
+
+def test_concurrent_observe_loses_nothing():
+    h = Histogram()
+    n_threads, per = 8, 5_000
+
+    def work(seed):
+        rng = np.random.default_rng(seed)
+        for v in rng.uniform(0.1, 100.0, per):
+            h.observe(float(v))
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    s = h.snapshot()
+    assert s.count == n_threads * per
+    assert sum(s.counts) == n_threads * per
+    assert 0.1 <= s.vmin and s.vmax <= 100.0
+
+
+def test_bad_scheme_rejected():
+    with pytest.raises(ValueError):
+        Histogram(lo=0.0)
+    with pytest.raises(ValueError):
+        Histogram(growth=1.0)
+
+
+# --- registry integration ----------------------------------------------------
+
+
+def test_registry_surfaces_hist_entries_with_fixed_prefix():
+    reg = Registry()
+    reg.observe("lat/e2e_ms", 5.0)
+    reg.observe("lat/e2e_ms", 7.0)
+    reg.inc("reqs")
+    snap = reg.snapshot("ctr/")
+    # counters take the prefix; histograms keep the fixed hist/ space
+    assert snap["ctr/reqs"] == 1
+    ent = snap["hist/lat/e2e_ms"]
+    assert ent["count"] == 2 and ent["sum"] == pytest.approx(12.0)
+    assert ent["min"] == pytest.approx(5.0)
+    assert ent["max"] == pytest.approx(7.0)
+
+
+def test_registry_baseline_reports_delta_and_omits_idle_hists():
+    reg = Registry()
+    reg.observe("busy_ms", 1.0)
+    reg.observe("idle_ms", 1.0)
+    base = reg.mark()
+    reg.observe("busy_ms", 9.0)
+    snap = reg.snapshot(baseline=base)
+    assert snap["hist/busy_ms"]["count"] == 1  # delta, not cumulative
+    assert snap["hist/busy_ms"]["max"] == pytest.approx(9.0)
+    # nothing observed since the mark → omitted (the gauge contract)
+    assert "hist/idle_ms" not in snap
+
+
+def test_registry_reset_drops_hists():
+    reg = Registry()
+    reg.observe("x_ms", 1.0)
+    reg.reset()
+    assert reg.snapshot() == {}
+
+
+def test_module_level_observe_reaches_default_registry():
+    reg = telem.default_registry()
+    base = reg.mark()
+    observe("testonly/obs_ms", 2.5)          # histogram.observe
+    telem.observe("testonly/obs_ms", 3.5)    # registry re-export
+    snap = reg.snapshot(baseline=base)
+    ent = snap["hist/testonly/obs_ms"]
+    assert ent["count"] == 2 and ent["sum"] == pytest.approx(6.0)
+
+
+def test_snapshot_fields_are_json_safe():
+    import json
+
+    h = Histogram()
+    h.observe(1.25)
+    assert json.loads(json.dumps(h.snapshot().fields()))["count"] == 1
+    assert isinstance(h.snapshot(), HistogramSnapshot)

@@ -39,8 +39,8 @@ def test_span_nesting_records_both_levels():
     assert fields["span/outer_n"] == 1 and fields["span/inner_n"] == 1
     # containment: the outer span covers the inner one
     assert fields["span/outer_s"] >= fields["span/inner_s"] > 0
-    (n1, t1a, t1b, _), (n2, t2a, t2b, _) = sorted(t._events,
-                                                  key=lambda e: e[1])
+    (n1, t1a, t1b, _, _), (n2, t2a, t2b, _, _) = sorted(
+        t._events, key=lambda e: e[1])
     assert (n1, n2) == ("outer", "inner")
     assert t1a <= t2a and t2b <= t1b
 
@@ -74,6 +74,25 @@ def test_chrome_dump_is_perfetto_loadable_shape(tmp_path):
         assert ev["dur"] >= 0
     # dump DRAINS: a second run's dump starts from a clean timeline
     assert t.dump_chrome_trace(str(tmp_path / "t2.json")) == 0
+
+
+def test_span_args_land_in_chrome_dump_and_late_fills_count(tmp_path):
+    """The optional args dict rides into the trace event; it is held by
+    REFERENCE so a call site can fill in late-known metadata (cache
+    hits) before the span exits.  Spans without args stay bare."""
+    t = _fresh(keep_events=True)
+    meta = {"batch": 32}
+    with t.span("query", args=meta):
+        meta["cache_hits"] = 7  # filled in mid-span, batcher-style
+    with t.span("plain"):
+        pass
+    t.record_span("ckpt_save", 1.0, 2.0, args={"step": 64})
+    path = str(tmp_path / "trace.json")
+    assert t.dump_chrome_trace(path) == 3
+    evs = {e["name"]: e for e in json.loads(open(path).read())["traceEvents"]}
+    assert evs["query"]["args"] == {"batch": 32, "cache_hits": 7}
+    assert evs["ckpt_save"]["args"] == {"step": 64}
+    assert "args" not in evs["plain"]
 
 
 def test_keep_events_off_aggregates_without_retaining():

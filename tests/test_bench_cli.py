@@ -62,6 +62,8 @@ def _stub_serve(repeats=1):
     return {"metric": "serve_qps", "value": 1234.5, "unit": "queries/s",
             "vs_baseline": None,
             "detail": {"recompiles_steady": 0,
+                       "latency_ms": {"b8": {"n": 2, "p50": 1.2,
+                                             "p95": 2.0, "p99": 2.2}},
                        "cache": {"cache_hit_rate": 0.9}}}
 
 
@@ -121,6 +123,8 @@ def test_auto_success_keeps_hgcn_headline(bench_mod, monkeypatch, capsys):
     assert full["detail"]["serve"]["qps"] == 1234.5
     assert full["detail"]["serve"]["recompiles_steady"] == 0
     assert full["detail"]["serve"]["cache"]["cache_hit_rate"] == 0.9
+    # the per-bucket SLO percentiles ride in detail (PR 7)
+    assert full["detail"]["serve"]["latency_ms"]["b8"]["p99"] == 2.2
     # the precision leg: the f32/bf16 timing PAIRS land in the artifact
     assert full["detail"]["precision"]["train_step_ms"] == {
         "f32": 2.0, "bf16": 1.4}
@@ -133,6 +137,8 @@ def test_auto_success_keeps_hgcn_headline(bench_mod, monkeypatch, capsys):
     assert out["detail"]["poincare_epoch_s"] == 0.5
     assert out["detail"]["sampled_samples_per_s"] == 2e5
     assert out["detail"]["serve_qps"] == 1234.5
+    assert out["detail"]["serve_latency_ms"]["b8"] == {
+        "n": 2, "p50": 1.2, "p95": 2.0, "p99": 2.2}
     assert out["detail"]["precision_train_ms"] == {"f32": 2.0, "bf16": 1.4}
 
 
@@ -178,6 +184,20 @@ def _fat_result():
             "workloads": {("w%d" % i): float(i) for i in range(150)},
         },
     }
+
+
+def test_serve_headline_compact_carries_flat_latency(bench_mod,
+                                                     monkeypatch, capsys):
+    """With --metric serve the bench_serve detail is FLAT (not nested
+    under detail.serve) — the compact line must still carry the
+    per-bucket percentiles via the latency_ms field."""
+    monkeypatch.setattr(bench_mod, "bench_serve", _stub_serve)
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--metric", "serve", "--budget-s", "0"])
+    bench_mod.main()
+    out = _last_json(capsys.readouterr().out)
+    assert out["metric"] == "serve_qps" and out["value"] == 1234.5
+    assert out["detail"]["latency_ms"]["b8"]["p95"] == 2.0
 
 
 def test_compact_headline_fits_budget(bench_mod):
