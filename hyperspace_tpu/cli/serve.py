@@ -64,6 +64,11 @@ class ServeConfig:
     factors: str = ""             # product factor layout JSON [[kind, dim], ...]
     step: int = -1                # checkpoint step (-1 = newest committed)
     overwrite: bool = False
+    # export: build an IVF index (hyperbolic k-means; serve/index.py)
+    # into the artifact.  index=1 with ncells=0 picks ~sqrt(N) cells;
+    # ncells=K alone also implies index=1.
+    index: bool = False
+    ncells: int = 0
     # query / serve
     k: int = 10
     ids: str = ""                 # comma-separated query ids (one-shot topk)
@@ -84,6 +89,11 @@ class ServeConfig:
     # table-scan precision: f32 (default, bit-identical) | bf16 (scan a
     # bf16 table copy, rescore candidates in f32 — docs/precision.md)
     precision: str = "f32"
+    # IVF probing (query/serve): cells probed per query.  0 = exact
+    # scan; needs an artifact exported with an index.  nprobe >= ncells
+    # or a sub-threshold table fall back to the exact program
+    # (docs/serving.md "Approximate retrieval").
+    nprobe: int = 0
 
 
 def _ids(s: str, name: str) -> list[int]:
@@ -115,8 +125,9 @@ def _build(cfg: ServeConfig):
     try:
         eng = QueryEngine.from_artifact(art, chunk_rows=cfg.chunk_rows,
                                         mesh=mesh, scan_mode=cfg.scan_mode,
-                                        precision=cfg.precision)
-    except ValueError as e:  # bad scan_mode/chunk_rows/precision: usage
+                                        precision=cfg.precision,
+                                        nprobe=cfg.nprobe)
+    except ValueError as e:  # bad scan_mode/chunk_rows/precision/nprobe
         raise SystemExit(str(e)) from None
     return eng, RequestBatcher(eng, min_bucket=cfg.min_bucket,
                                max_bucket=cfg.max_bucket,
@@ -146,22 +157,44 @@ def run_export(cfg: ServeConfig) -> dict:
             raise SystemExit(
                 f"factors={cfg.factors!r}: want JSON [[kind, dim], ...] "
                 f"({e})") from None
-    art = export_from_checkpoint(
-        cfg.ckpt, cfg.out, workload=cfg.workload, model_config=model_config,
-        step=None if cfg.step < 0 else cfg.step, overwrite=cfg.overwrite)
-    return {"mode": "export", "out": cfg.out, "workload": cfg.workload,
-            "num_nodes": art.num_nodes, "dim": art.dim, "step": art.step,
-            "fingerprint": art.fingerprint}
+    index_ncells = None
+    if cfg.index or cfg.ncells:
+        if cfg.ncells < 0:
+            raise SystemExit(f"ncells={cfg.ncells}: want 0 (auto) or >= 2")
+        index_ncells = cfg.ncells or -1  # <= 0 = auto (~sqrt(N))
+    try:
+        art = export_from_checkpoint(
+            cfg.ckpt, cfg.out, workload=cfg.workload,
+            model_config=model_config,
+            step=None if cfg.step < 0 else cfg.step,
+            overwrite=cfg.overwrite, index_ncells=index_ncells)
+    except ValueError as e:  # bad ncells for the table size: usage
+        raise SystemExit(str(e)) from None
+    out = {"mode": "export", "out": cfg.out, "workload": cfg.workload,
+           "num_nodes": art.num_nodes, "dim": art.dim, "step": art.step,
+           "fingerprint": art.fingerprint}
+    if art.index is not None:
+        out["index"] = {"ncells": art.index.ncells,
+                        "max_cell": art.index.max_cell,
+                        "fingerprint": art.index.fingerprint}
+    return out
 
 
 def run_query(cfg: ServeConfig) -> dict:
     _eng, batcher = _build(cfg)
-    if cfg.u or cfg.v:
-        scores = batcher.score(_ids(cfg.u, "u"), _ids(cfg.v, "v"),
-                               prob=cfg.prob, fd_r=cfg.fd_r, fd_t=cfg.fd_t)
-        return {"mode": "query", "scores": scores.tolist()}
-    ids = _ids(cfg.ids, "ids")
-    idx, dist = batcher.topk(ids, cfg.k)
+    # request-shaped ValueErrors (k out of range, IVF probe capacity /
+    # under-fill) are usage errors in one-shot mode: clean exit, no
+    # traceback — the serve loop answers the same errors per line
+    try:
+        if cfg.u or cfg.v:
+            scores = batcher.score(_ids(cfg.u, "u"), _ids(cfg.v, "v"),
+                                   prob=cfg.prob, fd_r=cfg.fd_r,
+                                   fd_t=cfg.fd_t)
+            return {"mode": "query", "scores": scores.tolist()}
+        ids = _ids(cfg.ids, "ids")
+        idx, dist = batcher.topk(ids, cfg.k)
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
     return {"mode": "query", "ids": ids, "k": cfg.k,
             "neighbors": idx.tolist(), "dists": dist.tolist()}
 

@@ -5,10 +5,13 @@ answering retrieval queries (docs/serving.md):
 
   artifact.py  frozen params-only serving artifacts (atomic export from
                a CheckpointManager directory, commit marker, content
-               fingerprint)
+               fingerprint, optional IVF index payload)
   engine.py    jitted batched k-NN + edge scoring over the frozen table
                (fused distmat kernels, chunked table walk, compiles
-               keyed on (bucket, k))
+               keyed on (bucket, k, nprobe), optional IVF probing)
+  index.py     offline IVF builder: hyperbolic k-means (geodesic
+               k-means++ seeding, Lorentz-centroid / Fréchet-mean
+               updates) + dense [ncells, max_cell] cell layout
   batcher.py   request micro-batcher: power-of-two bucket padding + LRU
                result cache, serve/* telemetry counters
   cli/serve.py the `export` / `query` / `serve` entry points
@@ -25,3 +28,8 @@ from hyperspace_tpu.serve.artifact import (  # noqa: F401
 )
 from hyperspace_tpu.serve.batcher import RequestBatcher  # noqa: F401
 from hyperspace_tpu.serve.engine import QueryEngine  # noqa: F401
+from hyperspace_tpu.serve.index import (  # noqa: F401
+    ServingIndex,
+    auto_ncells,
+    build_index,
+)

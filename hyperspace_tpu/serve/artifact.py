@@ -10,6 +10,9 @@ tensorstore shards.  A *serving artifact* is the frozen inference view:
 - ``artifact.json`` — manifold spec (kind + curvature(s), per-factor for
   products), the model config as exported, table shape/dtype, a content
   fingerprint, and the source checkpoint step;
+- ``index.npz``   — OPTIONAL: the IVF index arrays (centroids, dense
+  cell layout, counts — ``serve/index.py``), with its own content hash
+  in the meta block and folded into the artifact fingerprint;
 - ``COMMITTED``   — the commit marker, WRITTEN LAST.
 
 Writes are atomic the same way checkpoints are: everything lands in a
@@ -49,6 +52,7 @@ ARTIFACT_VERSION = 1
 COMMIT_MARKER = "COMMITTED"
 META_FILE = "artifact.json"
 TABLE_FILE = "table.npy"
+INDEX_FILE = "index.npz"  # optional IVF index (serve/index.py)
 
 
 # --- manifold specs -----------------------------------------------------------
@@ -129,16 +133,23 @@ def spec_dim(spec: tuple) -> int:
 # --- fingerprint --------------------------------------------------------------
 
 
-def fingerprint_of(table: np.ndarray, spec: tuple) -> str:
+def fingerprint_of(table: np.ndarray, spec: tuple,
+                   index_fingerprint: Optional[str] = None) -> str:
     """Content identity: sha256 over the table bytes, its shape/dtype,
     and the canonical spec JSON.  Same table + geometry → same
-    fingerprint, wherever the artifact lives on disk."""
+    fingerprint, wherever the artifact lives on disk.  An attached IVF
+    index folds its own content hash in (``index_fingerprint``), so an
+    artifact with an index is a DIFFERENT artifact than the bare table
+    — without one the hash is byte-identical to the pre-index format
+    (existing fingerprints stay valid)."""
     table = np.ascontiguousarray(table)
+    doc = {"spec": spec_to_json(spec),
+           "shape": list(table.shape),
+           "dtype": str(table.dtype)}
+    if index_fingerprint is not None:
+        doc["index"] = index_fingerprint
     h = hashlib.sha256()
-    h.update(json.dumps({"spec": spec_to_json(spec),
-                         "shape": list(table.shape),
-                         "dtype": str(table.dtype)},
-                        sort_keys=True).encode())
+    h.update(json.dumps(doc, sort_keys=True).encode())
     h.update(table.tobytes())
     return h.hexdigest()
 
@@ -155,6 +166,7 @@ class ServingArtifact:
     model_config: dict          # exported model config (JSON-safe)
     fingerprint: str
     step: Optional[int] = None  # source checkpoint step, if any
+    index: Optional[object] = None  # ServingIndex (serve/index.py) or None
 
     @property
     def num_nodes(self) -> int:
@@ -168,7 +180,8 @@ class ServingArtifact:
         return manifold_from_spec(self.manifold_spec)
 
 
-def _make_artifact(table, spec, model_config, step) -> ServingArtifact:
+def _make_artifact(table, spec, model_config, step,
+                   index=None) -> ServingArtifact:
     table = np.ascontiguousarray(np.asarray(table))
     if table.ndim != 2:
         raise ValueError(f"serving table must be [N, D]; got {table.shape}")
@@ -176,17 +189,29 @@ def _make_artifact(table, spec, model_config, step) -> ServingArtifact:
     if want >= 0 and table.shape[1] != want:
         raise ValueError(
             f"table width {table.shape[1]} != product spec width {want}")
+    if index is not None:
+        if int(index.num_nodes) != table.shape[0]:
+            raise ValueError(
+                f"index covers {index.num_nodes} rows; table has "
+                f"{table.shape[0]} — rebuild the index for THIS table")
+        if int(index.centroids.shape[1]) != table.shape[1]:
+            raise ValueError(
+                f"index centroid width {index.centroids.shape[1]} != "
+                f"table width {table.shape[1]}")
     return ServingArtifact(
         table=table, manifold_spec=spec,
         model_config=dict(model_config or {}),
-        fingerprint=fingerprint_of(table, spec),
-        step=None if step is None else int(step))
+        fingerprint=fingerprint_of(
+            table, spec, None if index is None else index.fingerprint),
+        step=None if step is None else int(step),
+        index=index)
 
 
 def export_artifact(directory: str, table, manifold_spec: tuple, *,
                     model_config: Optional[dict] = None,
                     step: Optional[int] = None,
-                    overwrite: bool = False) -> ServingArtifact:
+                    overwrite: bool = False,
+                    index=None) -> ServingArtifact:
     """Write a serving artifact atomically; returns the artifact written.
 
     Staging dir + marker-last + one ``os.rename`` (module docstring).
@@ -195,7 +220,7 @@ def export_artifact(directory: str, table, manifold_spec: tuple, *,
     rename-then-delete, so a reader holding the old dir open keeps a
     consistent view).
     """
-    art = _make_artifact(table, manifold_spec, model_config, step)
+    art = _make_artifact(table, manifold_spec, model_config, step, index)
     directory = os.path.abspath(directory)
     parent = os.path.dirname(directory)
     os.makedirs(parent, exist_ok=True)
@@ -220,6 +245,16 @@ def export_artifact(directory: str, table, manifold_spec: tuple, *,
             "fingerprint": art.fingerprint,
             "step": art.step,
         }
+        if art.index is not None:
+            np.savez(os.path.join(staging, INDEX_FILE),
+                     centroids=art.index.centroids, cells=art.index.cells,
+                     counts=art.index.counts)
+            meta["index"] = {
+                "ncells": art.index.ncells, "max_cell": art.index.max_cell,
+                "num_nodes": art.index.num_nodes, "iters": art.index.iters,
+                "seed": art.index.seed,
+                "fingerprint": art.index.fingerprint,
+            }
         with open(os.path.join(staging, META_FILE), "w") as f:
             json.dump(meta, f, indent=2, sort_keys=True)
         # marker LAST: everything before it is on disk when it appears
@@ -273,7 +308,45 @@ def load_artifact(directory: str) -> ServingArtifact:
             f"{ARTIFACT_VERSION} at {directory}")
     table = np.load(os.path.join(directory, TABLE_FILE))
     spec = spec_from_json(meta["manifold"])
-    fp = fingerprint_of(table, spec)
+    index = None
+    if meta.get("index") is not None:
+        # ServingIndex lives in serve/index.py, which imports this
+        # module — resolve it lazily so artifact.py stays import-cycle
+        # free at module load
+        from hyperspace_tpu.serve.index import (ServingIndex,
+                                                index_fingerprint_of)
+
+        imeta = meta["index"]
+        ipath = os.path.join(directory, INDEX_FILE)
+        if not os.path.isfile(ipath):
+            raise ValueError(
+                f"artifact meta names an index but {INDEX_FILE} is "
+                f"missing at {directory}")
+        with np.load(ipath) as z:
+            centroids = np.ascontiguousarray(z["centroids"])
+            cells = np.ascontiguousarray(z["cells"])
+            counts = np.ascontiguousarray(z["counts"])
+        try:
+            imeta = {k: imeta[k] for k in
+                     ("num_nodes", "iters", "seed", "fingerprint")}
+        except KeyError as e:
+            # keep the module's corrupt-artifact convention: every load
+            # failure is a ValueError the CLI turns into a clean exit
+            raise ValueError(
+                f"artifact index meta at {directory} is missing {e}") from None
+        ifp = index_fingerprint_of(
+            centroids, cells, counts, num_nodes=int(imeta["num_nodes"]),
+            iters=int(imeta["iters"]), seed=int(imeta["seed"]))
+        if ifp != imeta["fingerprint"]:
+            raise ValueError(
+                f"index fingerprint mismatch at {directory}: meta says "
+                f"{imeta['fingerprint'][:12]}…, content is {ifp[:12]}…")
+        index = ServingIndex(
+            centroids=centroids, cells=cells, counts=counts,
+            num_nodes=int(imeta["num_nodes"]), iters=int(imeta["iters"]),
+            seed=int(imeta["seed"]), fingerprint=ifp)
+    fp = fingerprint_of(table, spec,
+                        None if index is None else index.fingerprint)
     if fp != meta["fingerprint"]:
         raise ValueError(
             f"artifact fingerprint mismatch at {directory}: "
@@ -281,7 +354,7 @@ def load_artifact(directory: str) -> ServingArtifact:
     return ServingArtifact(
         table=table, manifold_spec=spec,
         model_config=meta.get("model_config") or {},
-        fingerprint=fp, step=meta.get("step"))
+        fingerprint=fp, step=meta.get("step"), index=index)
 
 
 # --- checkpoint → artifact ----------------------------------------------------
@@ -291,7 +364,9 @@ def export_from_checkpoint(ckpt_dir: str, out_dir: str, *,
                            workload: str,
                            model_config: Optional[dict] = None,
                            step: Optional[int] = None,
-                           overwrite: bool = False) -> ServingArtifact:
+                           overwrite: bool = False,
+                           index_ncells: Optional[int] = None
+                           ) -> ServingArtifact:
     """Export the newest committed checkpoint step as a serving artifact.
 
     Restores the raw state pytree via
@@ -313,6 +388,11 @@ def export_from_checkpoint(ckpt_dir: str, out_dir: str, *,
 
     (HGCN/HyboNet/HVAE checkpoints hold deep parameter trees, not one
     retrieval table — out of scope for the embedding query engine.)
+
+    ``index_ncells`` builds an IVF index over the exported table
+    (``serve/index.py``; hyperbolic k-means with that many cells —
+    ``<= 0`` picks ``auto_ncells`` ≈ √N) and ships it inside the
+    artifact — CLI ``export index=1 [ncells=K]``.
     """
     from hyperspace_tpu.train.checkpoint import restore_params_only
 
@@ -363,5 +443,13 @@ def export_from_checkpoint(ckpt_dir: str, out_dir: str, *,
         raise ValueError(
             f"export_from_checkpoint: unknown workload {workload!r} "
             "(want poincare|lorentz|product)")
+    index = None
+    if index_ncells is not None:
+        from hyperspace_tpu.serve.index import auto_ncells, build_index
+
+        ncells = int(index_ncells)
+        if ncells <= 0:
+            ncells = auto_ncells(int(table.shape[0]))
+        index = build_index(table, spec, ncells)
     return export_artifact(out_dir, table, spec, model_config=cfg,
-                           step=ck_step, overwrite=overwrite)
+                           step=ck_step, overwrite=overwrite, index=index)

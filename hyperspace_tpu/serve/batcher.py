@@ -231,13 +231,18 @@ class RequestBatcher:
                     f"k must be an integer; got {type(k).__name__}"
                 ) from None
             fp = self.engine.fingerprint
-            # cache keys carry exclude_self AND the engine's precision
-            # mode: the same (fp, id, k) has distinct answers per flag,
-            # and a bf16-scan engine's rows must never be served back by
-            # an f32 engine over the same table (same fingerprint!) or
-            # vice versa
+            # cache keys carry exclude_self, the engine's precision
+            # mode, AND its scan signature (("exact",) or
+            # ("ivf", nprobe, index fingerprint)): the same (fp, id, k)
+            # has distinct answers per flag, a bf16-scan engine's rows
+            # must never be served back by an f32 engine over the same
+            # table (same fingerprint!), and an approximate probed
+            # answer must never be served back as an exact one (or at a
+            # different nprobe / through a different index) — or vice
+            # versa
             mode = self.engine.precision
-            keyf = lambda qid: (fp, qid, k, exclude_self, mode)
+            scan = self.engine.scan_signature
+            keyf = lambda qid: (fp, qid, k, exclude_self, mode, scan)
             rows: dict[int, tuple] = {}
             misses = []
             # hit/miss are per UNIQUE id: a duplicate within the request
@@ -354,4 +359,8 @@ class RequestBatcher:
             "buckets": list(self.buckets),
             "fingerprint": self.engine.fingerprint,
             "precision": self.engine.precision,
+            # which engine answered: "exact" or "ivf" (+ nprobe) — the
+            # serve CLI stats line must identify an approximate server
+            "scan_strategy": self.engine.scan_strategy,
+            "nprobe": self.engine.nprobe,
         }

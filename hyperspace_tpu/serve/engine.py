@@ -56,7 +56,19 @@ Mechanics:
   top-k, so returned distances are always f32-accurate and rank
   agreement holds at ordinary point distributions.  ``"f32"`` (default)
   is the unchanged pre-policy executable.
-- **Compiles are keyed on (bucket, k), never on request.**  The jitted
+- **Optional IVF probing** (``index=`` + ``nprobe=``; docs/serving.md
+  "Approximate retrieval", built by ``serve/index.py``).  Queries score
+  against the index's hyperbolic-k-means centroids, gather the nearest
+  ``nprobe`` cells' row ids from the dense ``[ncells, max_cell]`` cell
+  layout, and run the SAME two-stage scan (threshold prune, per-chunk
+  top-k, one merge) over the gathered candidates — sub-linear work per
+  query instead of the O(N) slab walk, at a recall cost ``bench_serve``
+  tracks (recall@10 vs the exact engine, qps at recall ≥ 0.99).  The
+  bf16 scan-then-f32-rescore path composes unchanged.  Exact fallback:
+  ``nprobe=0`` / ``nprobe >= ncells`` (degenerate probe = exact answer,
+  served bit-identically by the exact program) / tables under
+  ``IVF_MIN_TABLE_ROWS`` / sharded meshes (probing is single-device).
+- **Compiles are keyed on (bucket, k, nprobe), never on request.**  The jitted
   programs hang everything shape-like on static arguments (batch size,
   k, chunk, N, the manifold spec tuple, the mesh); the request batcher
   (``serve/batcher.py``) pads incoming batches to a small set of
@@ -75,6 +87,7 @@ per-shard candidates, not global column order).
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Optional
 
@@ -88,6 +101,7 @@ from hyperspace_tpu.parallel.mesh import shard_map
 from hyperspace_tpu.parallel.sharded_embed import local_gather, table_sharding
 from hyperspace_tpu.serve.artifact import (ServingArtifact, fingerprint_of,
                                            manifold_from_spec)
+from hyperspace_tpu.telemetry import registry as telem
 
 # f32 bytes a distance tile may occupy ([B, chunk] on the kernel path,
 # [B, chunk, D] on the product path), per the nominal batch below.
@@ -180,12 +194,29 @@ def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
 
     # two_stage: per-chunk top-kc over [B, chunk] only (never chunk+k),
     # candidates stacked by the scan, ONE [B, nchunks*kc] merge after it.
-    def body(kth, i):
+    def tile2d(i):
         d, cols = masked_tile(i)
+        return d, jnp.broadcast_to(cols, d.shape)
+
+    return _two_stage_core(tile2d, b=b, nchunks=nchunks, k=k, kc=kc, ko=ko,
+                           dtype=slab.dtype)
+
+
+def _two_stage_core(masked_tile, *, b: int, nchunks: int, k: int, kc: int,
+                    ko: int, dtype):
+    """The ONE two-stage scan body — shared by the slab walk
+    (:func:`_scan_topk` ``two_stage``) and the IVF candidate scan
+    (:func:`_scan_topk_cand`), which differ only in where a tile's rows
+    come from.  ``masked_tile(i)`` → ``(d [B, chunk], ids [B, chunk]
+    int32)`` with masked slots at ``+inf``.  Returns
+    ``(dists ascending, ids)``, each ``[B, ko]``.
+    """
+    def body(kth, i):
+        d, ids = masked_tile(i)
 
         def sort_tile(_):
             top_negd, sel = jax.lax.top_k(-d, kc)
-            return -top_negd, cols[sel]
+            return -top_negd, jnp.take_along_axis(ids, sel, axis=1)
 
         def skip_tile(_):
             return (jnp.full((b, kc), jnp.inf, d.dtype),
@@ -201,7 +232,7 @@ def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
             kth = jnp.minimum(kth, cd[:, k - 1])  # inf when skipped: no-op
         return kth, (cd, ci)
 
-    kth0 = jnp.full((b,), jnp.inf, slab.dtype)
+    kth0 = jnp.full((b,), jnp.inf, dtype)
     _, (cd, ci) = jax.lax.scan(body, kth0, jnp.arange(nchunks))
     cat_d = jnp.moveaxis(cd, 0, 1).reshape(b, nchunks * kc)
     cat_i = jnp.moveaxis(ci, 0, 1).reshape(b, nchunks * kc)
@@ -337,6 +368,112 @@ def _topk_sharded_mixed(table: jax.Array, scan_table: jax.Array,
     return run(table, scan_table, q_idx)
 
 
+def _cand_dist(spec: tuple, q: jax.Array, rows: jax.Array) -> jax.Array:
+    """[B, D] queries × per-query candidate rows [B, C, D] → [B, C].
+
+    The batched form of the distmat closed expressions
+    (``kernels/distmat.py`` twins — same math as the slab scan's
+    tiles), so the IVF candidate scorer is one einsum Gram plus cheap
+    elementwise work instead of an elementwise Möbius chain over
+    [B, C, D] (measured ~3× on the CPU twin).  Product manifolds use
+    ``Product.dist`` broadcast — the exact trained geometry, like the
+    slab scan's product path."""
+    from hyperspace_tpu.manifolds import smath
+
+    kind = spec[0]
+    prec = jax.lax.Precision.HIGHEST
+    if kind == "poincare":
+        c = jnp.asarray(spec[1], q.dtype)
+        gram = jnp.einsum("bd,bcd->bc", q, rows, precision=prec)
+        xx = smath.sq_norm(q)                             # [B, 1]
+        yy = smath.sq_norm(rows)[..., 0]                  # [B, C]
+        d2 = smath.clamp_min(xx - 2.0 * gram + yy, 0.0)
+        den = smath.clamp_min((1.0 - c * xx) * (1.0 - c * yy),
+                              smath.eps_for(q.dtype))
+        u = 2.0 * c * d2 / den
+        return smath.arcosh1p(u) / smath.clamp_min(
+            smath.sqrt_c(c), smath.min_norm(q.dtype))
+    if kind == "lorentz":
+        c = jnp.asarray(spec[1], q.dtype)
+        gram = (jnp.einsum("bd,bcd->bc", q[:, 1:], rows[..., 1:],
+                           precision=prec)
+                - q[:, :1] * rows[..., 0])                # ⟨x, y⟩_L
+        u = smath.clamp_min(-c * gram - 1.0, 0.0)
+        return smath.arcosh1p(u) / smath.clamp_min(
+            smath.sqrt_c(c), smath.min_norm(q.dtype))
+    return manifold_from_spec(spec).dist(q[:, None, :], rows)
+
+
+def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
+                    q_idx: jax.Array, *, spec: tuple, k: int, chunk: int,
+                    exclude_self: bool):
+    """Chunked top-k over per-query candidate ids — the IVF in-cell
+    scorer.  The two-stage machinery of :func:`_scan_topk` (per-chunk
+    ``lax.top_k`` over the tile only, one post-scan merge, the running
+    k-th-distance threshold prune), re-aimed: instead of walking a
+    shared table slab, each chunk gathers every query's OWN candidate
+    rows (``cand`` [B, C] int32, a chunk multiple wide, ``-1`` =
+    padding) and scores them with :func:`_cand_dist` (per-query rows
+    can't use the shared-row kernel tiles).  Returns
+    ``(dists ascending, ids int32)``, each ``[B, min(k, C)]``; padded /
+    self slots are ``+inf``/``-1`` and can never outrank a real row.
+    """
+    b, ctot = cand.shape
+    nchunks = ctot // chunk
+
+    def masked_tile(i):
+        ids = jax.lax.dynamic_slice_in_dim(cand, i * chunk, chunk, axis=1)
+        rows = scan_table[jnp.maximum(ids, 0)]            # [B, chunk, D]
+        d = _cand_dist(spec, q, rows)                     # [B, chunk]
+        mask = ids < 0
+        if exclude_self:
+            mask = mask | (ids == q_idx[:, None])
+        return jnp.where(mask, jnp.inf, d), ids
+
+    return _two_stage_core(masked_tile, b=b, nchunks=nchunks, k=k,
+                           kc=min(k, chunk), ko=min(k, ctot),
+                           dtype=scan_table.dtype)
+
+
+@partial(jax.jit, static_argnames=("spec", "k", "k_scan", "nprobe", "chunk",
+                                   "exclude_self", "mixed"))
+def _topk_ivf(table: jax.Array, scan_table: jax.Array, centroids: jax.Array,
+              cells: jax.Array, q_idx: jax.Array, *, spec: tuple, k: int,
+              k_scan: int, nprobe: int, chunk: int, exclude_self: bool,
+              mixed: bool):
+    """IVF probing top-k: centroid scoring → nearest-``nprobe`` cell
+    gather → two-stage candidate scan (docs/serving.md "Approximate
+    retrieval").  One executable per (batch, k, nprobe, spec) — same
+    compile contract as the exact programs.
+
+    The candidate scan runs over ``scan_table`` (the bf16 copy when
+    ``mixed``), and the merged ``k_scan`` winners are then rescored
+    with f32 manifold distances against the f32 ``table`` before the
+    final ranking — PR 5's scan-then-rescore, unchanged.  Since the
+    cells partition the table, a probed candidate appears at most once:
+    no dedup pass is needed.  Cells holding fewer than ``k`` reachable
+    rows surface ``-1``/``+inf`` slots rather than wrong neighbors —
+    the engine wrapper (:meth:`QueryEngine._probe_topk`) turns those
+    into a loud ValueError, never a served answer.
+    """
+    q = table[q_idx]                                      # [B, D] f32
+    dc = _tile_dist(spec, q, centroids)                   # [B, ncells]
+    _, cell_sel = jax.lax.top_k(-dc, nprobe)              # [B, nprobe]
+    cand = cells[cell_sel].reshape(q_idx.shape[0], -1)    # [B, nprobe*mc]
+    pad = -cand.shape[1] % chunk
+    if pad:
+        cand = jnp.pad(cand, ((0, 0), (0, pad)), constant_values=-1)
+    qs = q.astype(scan_table.dtype)
+    sd, sidx = _scan_topk_cand(scan_table, qs, cand, q_idx, spec=spec,
+                               k=(k_scan if mixed else k), chunk=chunk,
+                               exclude_self=exclude_self)
+    if not mixed:
+        return sidx, sd
+    rows = table[jnp.maximum(sidx, 0)]                    # [B, K, D] f32
+    d32 = _rescore_f32(spec, rows, q, sidx, sd)
+    return _merge_rescored(d32, sidx, k)
+
+
 def _fermi_dirac(d: jax.Array, r, t) -> jax.Array:
     """The HGCN LP head's link decoder — the ONE definition both the
     single-device and sharded scoring programs trace, so the 1-device
@@ -404,6 +541,21 @@ class QueryEngine:
     pass mis-ranks at the k-th boundary is recovered by the over-fetch.
     Edge scoring (``score_edges``) is always f32: it is two cheap
     gathers plus one distance per pair, with no table scan to save.
+
+    ``index=`` + ``nprobe=`` turn on **IVF probing** (docs/serving.md
+    "Approximate retrieval"): queries score against the index's
+    hyperbolic-k-means centroids, gather the nearest ``nprobe`` cells'
+    rows, and run the two-stage candidate scan (+ f32 rescore under
+    ``precision=bf16``) over those instead of the whole table —
+    sub-linear work per query at a recall cost ``bench_serve`` tracks.
+    Exact-fallback rules (the engine then IS the exact executable):
+    ``nprobe=0``; ``nprobe >= ncells`` (degenerate probe — covering
+    every cell is the exact answer, so the exact program serves it
+    bit-identically); tables under ``IVF_MIN_TABLE_ROWS``; any mesh
+    with >1 shard (probing is single-device — raise ``nprobe=`` there
+    is an error, not a silent slowdown).  ``scan_strategy`` /
+    ``scan_signature`` expose which program answers — the batcher's
+    cache key and ``stats()`` carry them.
     """
 
     def __init__(self, table, manifold_spec: tuple, *,
@@ -412,7 +564,8 @@ class QueryEngine:
                  tile_budget: int = DEFAULT_TILE_BUDGET,
                  mesh=None, mesh_axis: str = "model",
                  scan_mode: str = "two_stage",
-                 precision: str = "f32"):
+                 precision: str = "f32",
+                 index=None, nprobe: int = 0):
         table = np.ascontiguousarray(np.asarray(table))
         if table.ndim != 2:
             raise ValueError(f"table must be [N, D]; got {table.shape}")
@@ -470,8 +623,61 @@ class QueryEngine:
         else:
             self.scan_table = self.table
 
+        # --- IVF probing (docs/serving.md "Approximate retrieval") ---
+        from hyperspace_tpu.serve.index import IVF_MIN_TABLE_ROWS
+        self.index, self.nprobe = index, int(nprobe)
+        if self.nprobe < 0:
+            raise ValueError(f"nprobe must be >= 0; got {nprobe}")
+        if self.nprobe > 0 and index is None:
+            raise ValueError(
+                "nprobe > 0 needs an IVF index (build one with "
+                "serve.index.build_index, or export with index=1)")
+        if index is not None:
+            if int(index.num_nodes) != self.num_nodes:
+                raise ValueError(
+                    f"index was built over {index.num_nodes} rows; "
+                    f"table has {self.num_nodes}")
+            if int(index.centroids.shape[1]) != self.dim:
+                raise ValueError(
+                    f"index centroid width {index.centroids.shape[1]} "
+                    f"!= table width {self.dim}")
+            if self.nprobe > 0 and shards > 1:
+                raise ValueError(
+                    "IVF probing is single-device; drop mesh= or nprobe= "
+                    "(a sharded table answers by exact scan)")
+        self._ivf = (index is not None and 0 < self.nprobe < index.ncells
+                     and self.num_nodes >= IVF_MIN_TABLE_ROWS)
+        if self._ivf:
+            self._centroids = jnp.asarray(index.centroids, jnp.float32)
+            self._cells = jnp.asarray(index.cells, jnp.int32)
+            # candidate chunks gather [B, chunk, D] rows per tile — the
+            # product-path footprint whatever the family — but unlike
+            # the slab scan there is no resident table sharing the
+            # budget, so the tile gets 4× of it; measured sweet spot on
+            # the CPU twin (chunk 512 at D=16: 1.5× over 128)
+            self._cand_chunk = auto_chunk_rows(
+                self.dim, "product", self.nprobe * index.max_cell,
+                4 * tile_budget)
+
+    @property
+    def scan_strategy(self) -> str:
+        """``"ivf"`` when queries probe the index, else ``"exact"``
+        (covers every fallback rule — what `batcher.stats()` reports)."""
+        return "ivf" if self._ivf else "exact"
+
+    @property
+    def scan_signature(self) -> tuple:
+        """Result-identity of the scan path: ``("exact",)`` or
+        ``("ivf", nprobe, index fingerprint)`` — a batcher cache-key
+        element, so exact and probed rows (or rows probed through two
+        different indexes) never cross-contaminate."""
+        if self._ivf:
+            return ("ivf", self.nprobe, self.index.fingerprint)
+        return ("exact",)
+
     @classmethod
     def from_artifact(cls, art: ServingArtifact, **kw) -> "QueryEngine":
+        kw.setdefault("index", art.index)
         return cls(art.table, art.manifold_spec,
                    fingerprint=art.fingerprint, **kw)
 
@@ -491,6 +697,8 @@ class QueryEngine:
             raise ValueError(
                 f"k={k} out of range [1, {limit}] for a {self.num_nodes}-row "
                 f"table (exclude_self={exclude_self})")
+        if self._ivf:
+            return self._probe_topk(q_idx, k, exclude_self=exclude_self)
         if self._policy.mixed:
             # over-fetch margin: the bf16 scan keeps k_scan candidates so
             # the f32 rescore can repair k-th-boundary near-ties
@@ -515,6 +723,47 @@ class QueryEngine:
         idx, dist = _topk_chunked(
             self.table, q_idx, spec=self.spec, k=k, chunk=self.chunk_rows,
             n=self.num_nodes, exclude_self=exclude_self, mode=self.scan_mode)
+        return idx, dist
+
+    def _probe_topk(self, q_idx: jax.Array, k: int, *, exclude_self: bool):
+        """The probing path: validate capacity, dispatch
+        :func:`_topk_ivf`, record the probe telemetry
+        (``serve/index_probe_ms``: host wall-clock around the dispatch —
+        on CPU, execution; ``serve/recall_candidates``: candidate slots
+        gathered, the work the probe actually did vs the exact scan's
+        ``B × N``)."""
+        capacity = self.nprobe * self.index.max_cell
+        if capacity < k:
+            raise ValueError(
+                f"k={k} exceeds the probe capacity nprobe×max_cell = "
+                f"{self.nprobe}×{self.index.max_cell} = {capacity}; "
+                "raise nprobe=")
+        k_scan = k
+        if self._policy.mixed:
+            k_scan = min(k + max(k, _RESCORE_PAD), capacity)
+        t0 = time.perf_counter()
+        idx, dist = _topk_ivf(
+            self.table, self.scan_table, self._centroids, self._cells,
+            q_idx, spec=self.spec, k=k, k_scan=k_scan, nprobe=self.nprobe,
+            chunk=self._cand_chunk, exclude_self=exclude_self,
+            mixed=self._policy.mixed)
+        telem.observe("serve/index_probe_ms",
+                      (time.perf_counter() - t0) * 1e3)
+        telem.inc("serve/recall_candidates", int(q_idx.shape[0]) * capacity)
+        # under-filled probe: some query's nprobe nearest cells held
+        # fewer than k reachable rows, so filler reached the top-k —
+        # not an answer (docs/serving.md), and +inf would break the
+        # serve protocol's JSON.  The distance is the reliable tell
+        # (a padded slot carries -1 OR a masked self id, but always
+        # +inf).  Fail loudly like the capacity check (a scalar fetch;
+        # callers fetch these results next anyway, and the serve loop
+        # isolates it per request)
+        if bool(jax.device_get(jnp.any(jnp.isinf(dist)))):
+            raise ValueError(
+                f"IVF probe under-filled: some query's {self.nprobe} "
+                f"nearest cell(s) hold fewer than k={k} reachable rows "
+                "(sparse/empty cells, or exclude_self masking one) — "
+                "raise nprobe= or rebuild the index with more balance")
         return idx, dist
 
     def score_edges(self, u_idx, v_idx, *, prob: bool = False,

@@ -1,0 +1,408 @@
+"""IVF index for sub-linear hyperbolic retrieval (docs/serving.md).
+
+The exact engine scans every table row per query — O(N) per query, fine
+at bench scale, hopeless at the millions-of-nodes tables the ROADMAP
+north star implies.  This module builds the classic inverted-file (IVF)
+two-level index of Jégou et al. 2011, with *geodesic* geometry
+throughout:
+
+- **Coarse quantizer: hyperbolic k-means.**  ``ncells`` centroids over
+  the table, seeded k-means++-style (D² sampling under the manifold's
+  own geodesic distance), refined by a fixed-iteration jitted Lloyd
+  loop.  The centroid update is exact per manifold family, computed
+  from ONE linear pass because each family has a lift in which the
+  Fréchet-style mean is a normalized sum:
+
+  - *lorentz*: the Lorentz centroid of Law et al. 2019 —
+    ``μ = s / (√c·√(−⟨s,s⟩_L))`` for the per-cell point sum ``s``
+    (``manifolds/lorentz.py:centroid``, reused verbatim);
+  - *poincare*: lift to the hyperboloid (``maps.ball_to_lorentz``),
+    Lorentz centroid there, project back — the two models are isometric
+    so this IS the ball's Law-et-al centroid;
+  - *sphere*: normalized per-cell mean (the spherical Fréchet mean's
+    classical estimator: project the Euclidean mean to the sphere);
+  - *euclidean*: the plain mean;
+  - *product*: per-factor slices, each by its own rule (Gu et al. 2019
+    products are metric products, so the squared-distance objective
+    separates per factor).
+
+  Empty cells keep their previous centroid (a zero sum must never
+  normalize into garbage).
+- **Cell layout: dense, static-shaped.**  Per-cell row ids are packed
+  into a ``[ncells, max_cell]`` int32 array padded with ``-1`` — the
+  CSR idea with a dense pitch, so probing is a fixed-shape gather and
+  the whole query path stays jittable (one executable per
+  (bucket, k, nprobe), same compile contract as the exact engine).
+  Every table row lands in exactly one cell (assignment totality —
+  tested).
+
+The probing query program itself lives in ``serve/engine.py``
+(``_topk_ivf``): score queries against the centroids, take the nearest
+``nprobe`` cells, and run the existing two-stage chunk scan (threshold
+prune + per-chunk ``lax.top_k`` + one merge) over the gathered
+candidate rows — with the bf16-scan + f32-rescore path composing
+unchanged.  ``build_index`` here is the offline half; the index
+serializes into the :class:`~hyperspace_tpu.serve.artifact.ServingArtifact`
+(``index.npz`` + a meta block, covered by the artifact fingerprint).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hyperspace_tpu.manifolds import Lorentz, Sphere
+from hyperspace_tpu.manifolds.maps import ball_to_lorentz, lorentz_to_ball
+from hyperspace_tpu.serve.artifact import manifold_from_spec
+
+INDEX_VERSION = 1
+
+# tables smaller than this answer faster by exact scan than by probing
+# (the gather + centroid pass overhead dominates) — engines fall back
+# to the exact program below it, whatever nprobe says (docs/serving.md
+# "exact-fallback rules")
+IVF_MIN_TABLE_ROWS = 2048
+
+# Lloyd assignment walks the table this many rows at a time so the
+# [chunk, ncells] distance tile (plus [chunk, D] lift) stays bounded
+# whatever N is
+_BUILD_CHUNK = 4096
+
+
+def auto_ncells(n: int) -> int:
+    """Default cell count: ~√N (the classical IVF balance point where
+    centroid scoring and in-cell scanning cost the same), clamped."""
+    return max(2, min(4096, int(round(float(n) ** 0.5))))
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingIndex:
+    """A built (or loaded) IVF index over one frozen table."""
+
+    centroids: np.ndarray  # [ncells, D] f32, rows ON the manifold
+    cells: np.ndarray      # [ncells, max_cell] int32, -1 padded
+    counts: np.ndarray     # [ncells] int32 real rows per cell
+    num_nodes: int         # table rows the index was built over
+    iters: int             # Lloyd iterations used
+    seed: int              # k-means++ seeding RNG seed
+    fingerprint: str       # content hash (arrays + build params)
+
+    @property
+    def ncells(self) -> int:
+        return int(self.cells.shape[0])
+
+    @property
+    def max_cell(self) -> int:
+        return int(self.cells.shape[1])
+
+    @property
+    def dim(self) -> int:
+        return int(self.centroids.shape[1])
+
+
+def index_fingerprint_of(centroids: np.ndarray, cells: np.ndarray,
+                         counts: np.ndarray, *, num_nodes: int,
+                         iters: int, seed: int) -> str:
+    """Content identity of an index: sha256 over the arrays (bytes +
+    shape/dtype) and the build parameters — the batcher's cache key
+    ingredient, so two engines probing DIFFERENT indexes over the same
+    table can never serve each other's rows."""
+    centroids = np.ascontiguousarray(centroids)
+    cells = np.ascontiguousarray(cells)
+    counts = np.ascontiguousarray(counts)
+    h = hashlib.sha256()
+    h.update(json.dumps({
+        "version": INDEX_VERSION,
+        "num_nodes": int(num_nodes), "iters": int(iters), "seed": int(seed),
+        "centroids": [list(centroids.shape), str(centroids.dtype)],
+        "cells": [list(cells.shape), str(cells.dtype)],
+        "counts": [list(counts.shape), str(counts.dtype)],
+    }, sort_keys=True).encode())
+    h.update(centroids.tobytes())
+    h.update(cells.tobytes())
+    h.update(counts.tobytes())
+    return h.hexdigest()
+
+
+# --- per-family centroid lifts ------------------------------------------------
+
+
+def _lift_dim(spec: tuple, dim: int) -> int:
+    """Width of the lifted coordinates (poincare lifts to d+1)."""
+    if spec[0] == "poincare":
+        return dim + 1
+    if spec[0] == "product":
+        return sum(_lift_dim((fk, c), d) for fk, d, c in spec[1])
+    return dim
+
+
+def _lift(spec: tuple, x: jax.Array) -> jax.Array:
+    """Coordinates in which the family's centroid is a normalized SUM."""
+    kind = spec[0]
+    if kind == "poincare":
+        return ball_to_lorentz(x, spec[1])
+    if kind == "product":
+        parts, o = [], 0
+        for fk, d, c in spec[1]:
+            xi = jax.lax.slice_in_dim(x, o, o + d, axis=-1)
+            parts.append(_lift((fk, c), xi))
+            o += d
+        return jnp.concatenate(parts, axis=-1)
+    return x
+
+
+def _unlift(spec: tuple, s: jax.Array, cnt: jax.Array) -> jax.Array:
+    """Per-cell lifted sums ``s`` [ncells, DL] + counts → centroids
+    [ncells, D] (garbage on empty cells — the caller masks those)."""
+    kind = spec[0]
+    denom = jnp.maximum(cnt, 1.0)[:, None]
+    if kind == "lorentz":
+        # Law et al. 2019: normalize the (weighted) sum back onto the
+        # sheet — scale-invariant, so counts drop out
+        return Lorentz(float(spec[1])).centroid(s[:, None, :])
+    if kind == "poincare":
+        mu = Lorentz(float(spec[1])).centroid(s[:, None, :])
+        return lorentz_to_ball(mu, spec[1])
+    if kind == "sphere":
+        return Sphere(float(spec[1])).proj(s / denom)
+    if kind == "euclidean":
+        return s / denom
+    if kind == "product":
+        parts, o = [], 0
+        for fk, d, c in spec[1]:
+            dl = _lift_dim((fk, c), d)
+            si = jax.lax.slice_in_dim(s, o, o + dl, axis=-1)
+            parts.append(_unlift((fk, c), si, cnt))
+            o += dl
+        return jnp.concatenate(parts, axis=-1)
+    raise ValueError(f"no centroid rule for manifold kind {kind!r}")
+
+
+# --- the jitted Lloyd loop ----------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("spec", "chunk", "iters", "ncells"))
+def _lloyd(tpad: jax.Array, cent0: jax.Array, n, *, spec: tuple,
+           chunk: int, iters: int, ncells: int):
+    """Fixed-iteration Lloyd over a chunk-padded table.
+
+    Returns ``(centroids [ncells, D], assign [npad] int32)`` — the
+    assignment is the FINAL pass against the returned centroids, so the
+    cell layout matches them exactly.  Assignment tiles are
+    [chunk, ncells] (via the fused distance kernels where the family
+    has one); the centroid update accumulates per-cell lifted sums with
+    a one-hot matmul per chunk, so the whole loop is one executable and
+    deterministic for a fixed seed/platform.
+    """
+    from hyperspace_tpu.serve.engine import _tile_dist
+
+    nchunks = tpad.shape[0] // chunk
+    dl = _lift_dim(spec, tpad.shape[1])
+
+    def assign_chunk(cent, i):
+        rows = jax.lax.dynamic_slice_in_dim(tpad, i * chunk, chunk)
+        d = _tile_dist(spec, rows, cent)                  # [chunk, ncells]
+        a = jnp.argmin(d, axis=1).astype(jnp.int32)
+        valid = (i * chunk + jnp.arange(chunk)) < n
+        return rows, a, valid
+
+    def iter_body(cent, _):
+        def chunk_body(carry, i):
+            sums, cnts = carry
+            rows, a, valid = assign_chunk(cent, i)
+            oh = ((a[:, None] == jnp.arange(ncells)[None, :])
+                  & valid[:, None]).astype(jnp.float32)   # [chunk, ncells]
+            sums = sums + oh.T @ _lift(spec, rows)
+            cnts = cnts + jnp.sum(oh, axis=0)
+            return (sums, cnts), None
+
+        (sums, cnts), _ = jax.lax.scan(
+            chunk_body,
+            (jnp.zeros((ncells, dl), jnp.float32),
+             jnp.zeros((ncells,), jnp.float32)),
+            jnp.arange(nchunks))
+        new = _unlift(spec, sums, cnts)
+        # empty cells keep their centroid — a zero sum must never
+        # normalize into a garbage point that then captures rows
+        return jnp.where(cnts[:, None] > 0, new, cent), None
+
+    cent, _ = jax.lax.scan(iter_body, cent0, None, length=iters)
+
+    def final_chunk(_, i):
+        _rows, a, valid = assign_chunk(cent, i)
+        return None, jnp.where(valid, a, -1)
+
+    _, assign = jax.lax.scan(final_chunk, None, jnp.arange(nchunks))
+    return cent, assign.reshape(-1)
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _own_dist(rows: jax.Array, cent_rows: jax.Array, *, spec: tuple):
+    """Per-row geodesic distance to the row's OWN centroid ([N])."""
+    return manifold_from_spec(spec).dist(rows, cent_rows)
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _all_cell_dist(rows: jax.Array, cent: jax.Array, *, spec: tuple):
+    """[S, ncells] geodesic distances rows × centroids."""
+    from hyperspace_tpu.serve.engine import _tile_dist
+
+    return _tile_dist(spec, rows, cent)
+
+
+def _spill_balance(table: np.ndarray, centroids: np.ndarray,
+                   assign: np.ndarray, spec: tuple, *,
+                   cap: int) -> np.ndarray:
+    """Cap every cell at ``cap`` rows (module docstring "Balancing").
+
+    Oversized cells keep their ``cap`` closest members (by geodesic
+    distance to the centroid); spilled rows re-assign by **rank
+    rounds**: at round ``j`` every still-unplaced row bids for its
+    ``j``-th-nearest centroid, and each cell grants its remaining room
+    in spilled order — all vectorized, so the host cost is
+    O(rounds × spilled log spilled), not an interpreted
+    O(spilled × ncells) walk.  Deterministic, and total capacity
+    ``ncells × cap >= N`` (``balance >= 1``, validated by the caller)
+    guarantees every row lands: a cell with room left at the end never
+    denied a bid, so no bidder can run out of ranks.  Memory stays
+    bounded by processing spilled rows ``_BUILD_CHUNK`` at a time
+    (the [chunk, ncells] distance tile, like the Lloyd loop).
+    """
+    ncells = int(centroids.shape[0])
+    counts = np.bincount(assign, minlength=ncells)
+    if counts.max() <= cap:
+        return assign
+    cdev = jnp.asarray(centroids)
+    d_own = np.asarray(_own_dist(
+        jnp.asarray(table), cdev[jnp.asarray(assign)], spec=spec))
+    assign = assign.copy()
+    spilled = []
+    for c in np.flatnonzero(counts > cap):
+        members = np.flatnonzero(assign == c)
+        order = members[np.argsort(d_own[members], kind="stable")]
+        spilled.append(order[cap:])
+    spilled = np.concatenate(spilled)
+    room = (cap - np.minimum(counts, cap)).astype(np.int64)
+    bs = _BUILD_CHUNK
+    for s in range(0, len(spilled), bs):
+        rows = spilled[s:s + bs]
+        pd = np.asarray(_all_cell_dist(
+            jnp.asarray(table[rows]), cdev, spec=spec))
+        pref = np.argsort(pd, axis=1, kind="stable")
+        left = np.arange(len(rows))
+        for j in range(ncells):
+            if not left.size:
+                break
+            want = pref[left, j]
+            order = np.argsort(want, kind="stable")  # stable ⇒ spilled order
+            w = want[order]
+            uniq, starts, cnt = np.unique(w, return_index=True,
+                                          return_counts=True)
+            bid_rank = np.arange(len(w)) - np.repeat(starts, cnt)
+            ok = bid_rank < room[w]
+            granted = order[ok]
+            assign[rows[left[granted]]] = want[granted]
+            room -= np.bincount(w[ok], minlength=ncells)
+            keep = np.ones(len(left), bool)
+            keep[granted] = False
+            left = left[keep]
+    return assign
+
+
+def build_index(table, manifold_spec: tuple, ncells: int, *,
+                iters: int = 8, seed: int = 0,
+                chunk: int = _BUILD_CHUNK,
+                balance: float = 2.0) -> ServingIndex:
+    """Offline IVF build: hyperbolic k-means + dense cell layout.
+
+    Deterministic for a fixed ``(table, spec, ncells, iters, seed)`` on
+    a given platform: the seeding RNG is ``np.random.default_rng(seed)``
+    and the Lloyd loop is one fixed-iteration jitted program.
+
+    **Balancing (capacity-capped spill).**  The dense
+    ``[ncells, max_cell]`` cell pitch makes the probe's work
+    ``nprobe × max_cell`` — ONE oversized cell taxes every query,
+    probed or not, and vanilla k-means on cluster-structured tables
+    (i.e. real embedding tables) happily parks one centroid on several
+    true clusters, inflating ``max_cell`` to >10× the mean.  So after
+    Lloyd, cells are capped at ``balance × N/ncells`` rows: an
+    oversized cell keeps its *closest* rows up to the cap and spills
+    the rest, each spilled row re-assigning to its nearest centroid
+    with room (deterministic rank-round bidding — `_spill_balance`).
+    Totality is preserved
+    (every row still lands in exactly one cell), ``max_cell ≤ cap`` by
+    construction, and spilled rows sit in their second-choice cell —
+    which multi-cell probes still find (the recall cost is measured,
+    not assumed: ``bench_serve``'s recall leg).  ``balance=0`` disables
+    the cap.
+    """
+    table = np.ascontiguousarray(np.asarray(table, np.float32))
+    if table.ndim != 2:
+        raise ValueError(f"index table must be [N, D]; got {table.shape}")
+    n, dim = (int(s) for s in table.shape)
+    ncells = int(ncells)
+    if not 2 <= ncells <= n:
+        raise ValueError(
+            f"ncells must be in [2, {n}] for a {n}-row table; got {ncells}")
+    if balance and not balance >= 1.0:
+        # below 1.0 total capacity ncells × cap can undershoot N and the
+        # spill loop could not place every row — the cap guarantee the
+        # docstring promises would silently break
+        raise ValueError(
+            f"balance must be 0 (disabled) or >= 1.0; got {balance}")
+    spec = tuple(manifold_spec)
+    m = manifold_from_spec(spec)
+    tdev = jnp.asarray(table)
+
+    # k-means++ seeding: D² sampling under the geodesic metric — each
+    # new seed is drawn ∝ squared distance to the nearest chosen seed
+    rng = np.random.default_rng(seed)
+    dist_to = jax.jit(lambda t, c: m.dist(t, c[None, :]))
+    chosen = [int(rng.integers(n))]
+    d2 = np.square(np.asarray(dist_to(tdev, tdev[chosen[0]])), dtype=np.float64)
+    for _ in range(ncells - 1):
+        total = d2.sum()
+        if total > 0:
+            pick = int(rng.choice(n, p=d2 / total))
+        else:  # all remaining mass at distance 0 (duplicate points)
+            pick = int(rng.integers(n))
+        chosen.append(pick)
+        d2 = np.minimum(
+            d2, np.square(np.asarray(dist_to(tdev, tdev[pick])),
+                          dtype=np.float64))
+    cent0 = jnp.asarray(table[np.asarray(chosen)])
+
+    npad = -(-n // chunk) * chunk
+    tpad = (jnp.concatenate(
+        [tdev, jnp.zeros((npad - n, dim), jnp.float32)]) if npad > n
+        else tdev)
+    cent, assign = _lloyd(tpad, cent0, jnp.int32(n), spec=spec, chunk=chunk,
+                          iters=int(iters), ncells=ncells)
+    centroids = np.asarray(cent, np.float32)
+    assign = np.asarray(assign)[:n]
+
+    if balance and balance > 0:
+        assign = _spill_balance(table, centroids, assign, spec,
+                                cap=int(np.ceil(float(balance) * n
+                                                / ncells)))
+
+    counts = np.bincount(assign, minlength=ncells).astype(np.int32)
+    max_cell = int(max(counts.max(), 1))
+    cells = np.full((ncells, max_cell), -1, np.int32)
+    order = np.argsort(assign, kind="stable")
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    for c in range(ncells):
+        ids = order[starts[c]:starts[c + 1]]
+        cells[c, :len(ids)] = ids
+
+    fp = index_fingerprint_of(centroids, cells, counts, num_nodes=n,
+                              iters=int(iters), seed=int(seed))
+    return ServingIndex(centroids=centroids, cells=cells, counts=counts,
+                        num_nodes=n, iters=int(iters), seed=int(seed),
+                        fingerprint=fp)

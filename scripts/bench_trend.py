@@ -51,7 +51,7 @@ SENTINEL_METRICS = {"error", "budget_exhausted"}
 _SKIP_DETAIL_KEYS = {"telemetry", "traceback"}
 
 _HIGHER_TOKENS = ("per_s", "per_sec", "qps", "samples", "speedup",
-                  "recall", "rate", "frac", "roofline")
+                  "recall", "rate", "auc", "frac", "roofline")
 _LOWER_TOKENS = ("time", "stall", "waste", "recompile", "epoch_s",
                  "compile")
 _LOWER_SUFFIXES = ("_s", "_ms", "_bytes")

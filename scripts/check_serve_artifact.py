@@ -7,7 +7,10 @@ same executable, same bits out.  This script builds a deterministic
 Poincaré table, exports it, loads it back, and runs 10 top-k queries
 (varying batch sizes and k) through engines on the live table and on
 the loaded artifact; any bit difference in neighbors or distances — or
-a fingerprint drift — fails (exit 1).  Run by
+a fingerprint drift — fails (exit 1).  A second artifact ships an IVF
+index (serve/index.py) and must reproduce its fingerprints, keep
+assignment totality, and answer ``nprobe=ncells`` (the degenerate
+probe) bitwise-identically to the exact engine.  Run by
 ``tests/serve/test_check_script.py`` inside the suite, mirroring the
 telemetry-catalog lint, so a serialization regression fails the build.
 """
@@ -47,6 +50,57 @@ def build_table():
     return PoincareBall(C).expmap0(v)
 
 
+def _check_index_round_trip(table, spec, out_dir: str, live) -> int:
+    """Export-with-index → load → degenerate-probe identity.
+
+    Builds a small IVF index, ships it inside a second artifact, loads
+    it back, and verifies (a) assignment totality survived the round
+    trip (every row id appears in exactly one cell), (b) the index and
+    artifact fingerprints reproduce, and (c) top-k at ``nprobe=ncells``
+    is BITWISE-identical to the exact engine — probing every cell
+    covers every row, so the engine serves the degenerate probe through
+    the exact executable by design (docs/serving.md "Approximate
+    retrieval"); the identity is the cheapest end-to-end check that the
+    index loads, validates against the table, and plugs into the query
+    path.
+    """
+    import numpy as np
+
+    from hyperspace_tpu.serve import (QueryEngine, build_index,
+                                      export_artifact, load_artifact)
+
+    idx = build_index(table, spec, 8, iters=4, seed=0)
+    exported = export_artifact(out_dir, table, spec, index=idx,
+                               overwrite=True)
+    loaded = load_artifact(out_dir)
+    if loaded.index is None or loaded.index.fingerprint != idx.fingerprint:
+        print("INDEX DRIFT: loaded index fingerprint != built index")
+        return 1
+    if loaded.fingerprint != exported.fingerprint:
+        print("FINGERPRINT DRIFT: exported-with-index != loaded")
+        return 1
+    if loaded.fingerprint == live.fingerprint:
+        print("FINGERPRINT BUG: index artifact hashes like the bare table")
+        return 1
+    cell_ids = np.sort(loaded.index.cells[loaded.index.cells >= 0])
+    if not np.array_equal(cell_ids, np.arange(table.shape[0])):
+        print("INDEX TOTALITY BROKEN: cells do not cover each row once")
+        return 1
+    probed = QueryEngine.from_artifact(loaded, nprobe=loaded.index.ncells)
+    if probed.scan_strategy != "exact":
+        print("DEGENERATE PROBE not routed to the exact program")
+        return 1
+    for qi, (ids, k) in enumerate(QUERIES):
+        q = np.asarray(ids, np.int32)
+        li, ld = (np.asarray(a) for a in live.topk_neighbors(q, k))
+        pi, pd = (np.asarray(a) for a in probed.topk_neighbors(q, k))
+        if not np.array_equal(li, pi) or not np.array_equal(
+                ld.view(np.uint32), pd.view(np.uint32)):
+            print(f"index query {qi}: nprobe=ncells differs from exact")
+            return 1
+    return 0
+
+
 def main(out_dir: str | None = None) -> int:
     import numpy as np
 
@@ -82,6 +136,9 @@ def main(out_dir: str | None = None) -> int:
             if not np.array_equal(ld.view(np.uint32), sd.view(np.uint32)):
                 print(f"query {qi}: distances differ bitwise\n{ld}\nvs\n{sd}")
                 return 1
+        rc = _check_index_round_trip(table, spec, out_dir + ".ivf", live)
+        if rc:
+            return rc
         print(f"serve artifact round-trip OK: {len(QUERIES)} queries "
               f"bit-identical (N={N}, D={D}, fingerprint "
               f"{loaded.fingerprint[:12]}…)")

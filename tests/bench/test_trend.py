@@ -206,3 +206,23 @@ def test_unreadable_round_is_a_row_not_a_crash(tmp_path):
     assert res.returncode == 0, res.stderr
     rounds = {r["round"]: r for r in json.loads(res.stdout)["rounds"]}
     assert not rounds["r02"]["parsed"] and "error" in rounds["r02"]
+
+
+def test_direction_quality_metrics_are_higher_better():
+    """Names carrying recall / hit_rate / auc are higher-is-better —
+    the r10 recall@10 contract (and any future quality series) must
+    gate in the right direction, not fall into the `_s`-suffix
+    lower-better bucket or the unknown `—` column."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_trend", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for name in ("recall10", "detail.ivf.probes.np4.recall10",
+                 "detail.serve.ivf.qps_at_recall99",
+                 "detail.serve.cache.cache_hit_rate", "val_auc",
+                 "detail.hgcn.roc_auc"):
+        assert mod.direction(name) == "higher", name
+    # and the lower-better inference stays undisturbed around them
+    assert mod.direction("detail.serve.ivf.build_s") == "lower"
+    assert mod.direction("detail.latency_ms.b8.p99") == "lower"

@@ -94,6 +94,40 @@ def test_padded_rows_never_surface(rng):
     assert np.all(np.isfinite(dist))
 
 
+def test_k_equals_table_rows_without_self_exclusion(rng):
+    """k = N with exclude_self=False drains EVERY row, self first at
+    distance ~0 — the upper edge the IVF degenerate probe leans on."""
+    table, man = _poincare_table(rng, 200, 4, 1.0)
+    eng = QueryEngine(table, spec_from_manifold(man), chunk_rows=128)
+    q = np.asarray([0, 99, 199], np.int32)
+    idx, dist = (np.asarray(a) for a in
+                 eng.topk_neighbors(q, 200, exclude_self=False))
+    for j, qi in enumerate(q):
+        assert sorted(idx[j].tolist()) == list(range(200))
+        assert idx[j, 0] == qi
+    assert np.all(np.isfinite(dist))
+    assert np.all(np.diff(dist, axis=1) >= 0)
+    # k past N must stay an error, not a silent clamp
+    with pytest.raises(ValueError, match="k="):
+        eng.topk_neighbors(q, 201, exclude_self=False)
+
+
+def test_k_drains_table_across_chunk_boundaries(rng):
+    """k = N−1 on a multi-chunk table: every row but self exactly once,
+    with the drain crossing chunk boundaries (not the single-chunk case
+    test_padded_rows_never_surface already covers)."""
+    table, man = _poincare_table(rng, 300, 4, 1.0)
+    eng = QueryEngine(table, spec_from_manifold(man), chunk_rows=128)
+    q = np.asarray([7, 250], np.int32)
+    idx, dist = (np.asarray(a) for a in eng.topk_neighbors(q, 299))
+    for j, qi in enumerate(q):
+        assert sorted(idx[j].tolist()) == [i for i in range(300) if i != qi]
+    assert np.all(np.isfinite(dist))
+    ref_idx, ref_dist = _reference_topk(man, table, q, 299)
+    assert np.array_equal(idx, ref_idx)
+    np.testing.assert_allclose(dist, ref_dist, rtol=2e-3, atol=2e-3)
+
+
 def test_exclude_self_flag(rng):
     table, man = _poincare_table(rng, 12, 3, 1.0)
     eng = QueryEngine(table, spec_from_manifold(man))

@@ -185,8 +185,9 @@ def test_batcher_cache_key_carries_precision(rng):
     b16.topk(ids, K)
     keys32 = {key for key in b32.cache._d}
     keys16 = {key for key in b16.cache._d}
-    assert all(key[-1] == "f32" for key in keys32)
-    assert all(key[-1] == "bf16" for key in keys16)
+    # key layout: (fp, qid, k, exclude_self, precision, scan signature)
+    assert all(key[-2] == "f32" for key in keys32)
+    assert all(key[-2] == "bf16" for key in keys16)
     assert keys32.isdisjoint(keys16)
     assert b32.stats()["precision"] == "f32"
     assert b16.stats()["precision"] == "bf16"

@@ -1,0 +1,439 @@
+"""IVF index (serve/index.py + the engine's probing path): builder
+determinism, assignment totality, recall on a clustered table, the
+degenerate-probe identity on all three manifold specs, cell-layout edge
+cases (empty / single-row / capacity), fallback rules, artifact
+round-trip, and batcher cache-key isolation (ISSUE 8)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from hyperspace_tpu.manifolds import (Euclidean, Lorentz, PoincareBall,
+                                      Product, Sphere)
+from hyperspace_tpu.serve import (QueryEngine, RequestBatcher, build_index,
+                                  export_artifact, load_artifact)
+from hyperspace_tpu.serve.artifact import spec_from_manifold
+from hyperspace_tpu.serve.engine import _topk_ivf
+from hyperspace_tpu.serve.index import (IVF_MIN_TABLE_ROWS, ServingIndex,
+                                        auto_ncells, index_fingerprint_of)
+
+
+def _poincare_table(rng, n, d, c=1.0, scale=0.5):
+    v = jnp.asarray(rng.standard_normal((n, d)) * scale, jnp.float32)
+    return np.asarray(PoincareBall(c).expmap0(v)), PoincareBall(c)
+
+
+def _lorentz_table(rng, n, d, c=0.8):
+    man = Lorentz(c)
+    v = jnp.asarray(rng.standard_normal((n, d + 1)) * 0.5, jnp.float32)
+    v = v.at[:, 0].set(0.0)
+    return np.asarray(man.expmap0(v)), man
+
+
+def _product_table(rng, n):
+    man = Product([PoincareBall(1.1), Sphere(0.9), Euclidean()], [3, 3, 2])
+    v = jnp.asarray(rng.standard_normal((n, 8)) * 0.3, jnp.float32)
+    pt = man.proj(man.expmap0(man.proju(man.origin((n, 8)), v)))
+    return np.asarray(pt), man
+
+
+def _clustered_poincare(rng, n, d, nclusters=64):
+    """Cluster-structured ball table at f32-healthy radii — the regime
+    real embedding tables (trees, communities) live in, and the one an
+    IVF index is FOR."""
+    centers = rng.standard_normal((nclusters, d)) * 0.25
+    v = (centers[rng.integers(0, nclusters, size=n)]
+         + rng.standard_normal((n, d)) * 0.05)
+    table = np.asarray(PoincareBall(1.0).expmap0(
+        jnp.asarray(v, jnp.float32)))
+    return table, PoincareBall(1.0)
+
+
+def _manual_index(centroids, cells, counts, n):
+    cells = np.asarray(cells, np.int32)
+    counts = np.asarray(counts, np.int32)
+    centroids = np.asarray(centroids, np.float32)
+    fp = index_fingerprint_of(centroids, cells, counts, num_nodes=n,
+                              iters=0, seed=0)
+    return ServingIndex(centroids=centroids, cells=cells, counts=counts,
+                        num_nodes=n, iters=0, seed=0, fingerprint=fp)
+
+
+# --- builder ------------------------------------------------------------------
+
+
+def test_builder_deterministic_under_fixed_seed(rng):
+    table, man = _poincare_table(rng, 500, 6)
+    spec = spec_from_manifold(man)
+    a = build_index(table, spec, 16, iters=5, seed=3)
+    b = build_index(table, spec, 16, iters=5, seed=3)
+    assert a.fingerprint == b.fingerprint
+    assert np.array_equal(a.centroids.view(np.uint32),
+                          b.centroids.view(np.uint32))
+    assert np.array_equal(a.cells, b.cells)
+    # a different seed is a different build (seeding really is seeded)
+    c = build_index(table, spec, 16, iters=5, seed=4)
+    assert c.fingerprint != a.fingerprint
+
+
+@pytest.mark.parametrize("build", ["poincare", "lorentz", "product"])
+def test_assignment_totality(rng, build):
+    """Every table row lands in exactly one cell, on every manifold
+    family — the invariant the degenerate-probe identity rests on."""
+    if build == "product":
+        table, man = _product_table(rng, 300)
+    else:
+        table, man = (_poincare_table if build == "poincare"
+                      else _lorentz_table)(rng, 300, 6)
+    idx = build_index(table, spec_from_manifold(man), 8, iters=4, seed=0)
+    ids = np.sort(idx.cells[idx.cells >= 0])
+    assert np.array_equal(ids, np.arange(300))
+    assert int(idx.counts.sum()) == 300
+    assert idx.max_cell == int(idx.counts.max())
+
+
+def test_builder_validation(rng):
+    table, man = _poincare_table(rng, 40, 4)
+    spec = spec_from_manifold(man)
+    with pytest.raises(ValueError, match="ncells"):
+        build_index(table, spec, 1)
+    with pytest.raises(ValueError, match="ncells"):
+        build_index(table, spec, 41)
+    with pytest.raises(ValueError, match=r"\[N, D\]"):
+        build_index(table[0], spec, 4)
+    # balance < 1 undershoots total capacity — the cap guarantee would
+    # silently break, so it must refuse (0 stays the disable switch)
+    with pytest.raises(ValueError, match="balance"):
+        build_index(table, spec, 4, balance=0.5)
+    build_index(table, spec, 4, balance=0)  # disabled: fine
+
+
+def test_balance_caps_the_cell_pitch(rng):
+    """A deliberately skewed table (one dense clump + a thin halo) must
+    come out with max_cell <= ceil(balance*N/ncells) — the dense pitch
+    is the probe's work unit, so one mega-cell taxes every query."""
+    rng2 = np.random.default_rng(7)
+    clump = rng2.standard_normal((900, 4)) * 0.02
+    halo = rng2.standard_normal((100, 4)) * 0.9
+    table = np.asarray(PoincareBall(1.0).expmap0(
+        jnp.asarray(np.concatenate([clump, halo]), jnp.float32)))
+    idx = build_index(table, ("poincare", 1.0), 10, iters=4, seed=0,
+                      balance=2.0)
+    assert idx.max_cell <= -(-2 * 1000 // 10)  # ceil(balance*N/ncells)
+    ids = np.sort(idx.cells[idx.cells >= 0])
+    assert np.array_equal(ids, np.arange(1000))  # spill keeps totality
+
+
+def test_auto_ncells_scales_like_sqrt():
+    assert auto_ncells(4) == 2
+    assert auto_ncells(10_000) == 100
+    assert auto_ncells(50_000_000) == 4096  # clamped
+
+
+# --- probe correctness --------------------------------------------------------
+
+
+@pytest.mark.parametrize("build", ["poincare", "lorentz", "product"])
+def test_full_coverage_probe_is_rank_identical(rng, build):
+    """nprobe=ncells through the REAL probe program covers every row
+    exactly once (totality), so it must return the exact engine's
+    ranking on all three manifold specs — distances through the
+    candidate scorer agree with the slab scan to f32 tolerance."""
+    if build == "product":
+        table, man = _product_table(rng, 300)
+        q = np.asarray([0, 7, 150, 299], np.int32)
+    else:
+        table, man = (_poincare_table if build == "poincare"
+                      else _lorentz_table)(rng, 300, 6)
+        q = np.asarray([0, 3, 17, 150, 299], np.int32)
+    spec = spec_from_manifold(man)
+    idx = build_index(table, spec, 8, iters=4, seed=0)
+    exact = QueryEngine(table, spec, chunk_rows=128)
+    ei, ed = (np.asarray(a) for a in exact.topk_neighbors(q, 7))
+    ii, idd = (np.asarray(a) for a in _topk_ivf(
+        exact.table, exact.scan_table, jnp.asarray(idx.centroids),
+        jnp.asarray(idx.cells), jnp.asarray(q), spec=spec, k=7, k_scan=7,
+        nprobe=idx.ncells, chunk=128, exclude_self=True, mixed=False))
+    assert np.array_equal(ii, ei)
+    np.testing.assert_allclose(idd, ed, rtol=1e-5, atol=1e-5)
+    assert np.all(np.diff(idd, axis=1) >= 0)  # ascending
+
+
+def test_engine_recall_on_clustered_table(rng):
+    """The satellite contract: recall@10 >= 0.95 at nprobe=4/ncells=32
+    on a 5k clustered Poincaré table, through the engine path."""
+    n = 5000
+    table, man = _clustered_poincare(rng, n, 8)
+    spec = spec_from_manifold(man)
+    idx = build_index(table, spec, 32, iters=6, seed=0)
+    exact = QueryEngine(table, spec)
+    ivf = QueryEngine(table, spec, index=idx, nprobe=4)
+    assert ivf.scan_strategy == "ivf"
+    q = rng.integers(0, n, size=128).astype(np.int32)
+    ei, _ = (np.asarray(a) for a in exact.topk_neighbors(q, 10))
+    ii, dd = (np.asarray(a) for a in ivf.topk_neighbors(q, 10))
+    recall = np.mean([len(set(ei[j]) & set(ii[j])) / 10
+                      for j in range(len(q))])
+    assert recall >= 0.95, f"recall@10 = {recall}"
+    # probed results are well-formed: ascending, in range, no self
+    assert np.all(np.diff(dd, axis=1) >= 0)
+    assert ii.min() >= 0 and ii.max() < n
+    assert not np.any(ii == q[:, None])
+
+
+def test_exclude_self_across_cell_boundaries(rng):
+    """exclude_self masks the query's own row wherever its cell lands —
+    including when the probe reaches it through a non-nearest cell —
+    and exclude_self=False returns it first at distance 0."""
+    n = IVF_MIN_TABLE_ROWS
+    table, man = _clustered_poincare(rng, n, 6, nclusters=16)
+    spec = spec_from_manifold(man)
+    idx = build_index(table, spec, 16, iters=5, seed=0)
+    ivf = QueryEngine(table, spec, index=idx, nprobe=4)
+    q = rng.integers(0, n, size=64).astype(np.int32)
+    ii, _ = (np.asarray(a) for a in ivf.topk_neighbors(q, 5))
+    assert not np.any(ii == q[:, None])
+    ji, jd = (np.asarray(a) for a in
+              ivf.topk_neighbors(q, 5, exclude_self=False))
+    assert np.array_equal(ji[:, 0], q)  # own row is the nearest
+    # the matmul-shaped closed form's self-distance sits on the f32
+    # cancellation floor (~sqrt(eps)), not at exactly 0 — same floor
+    # the exact engine's pdist tiles have
+    np.testing.assert_allclose(jd[:, 0], 0.0, atol=2e-3)
+
+
+def test_empty_cells_never_surface(rng):
+    """A cell with zero rows (all -1) contributes nothing — probing it
+    alongside the full cell still returns the exact answer."""
+    table, man = _poincare_table(rng, 64, 4)
+    spec = spec_from_manifold(man)
+    # cell 0 holds every row; cells 1..3 are empty
+    cells = np.full((4, 64), -1, np.int32)
+    cells[0] = np.arange(64)
+    idx = _manual_index(table[:4], cells, [64, 0, 0, 0], 64)
+    exact = QueryEngine(table, spec)
+    ei, ed = (np.asarray(a) for a in
+              exact.topk_neighbors(np.arange(5, dtype=np.int32), 6))
+    ii, idd = (np.asarray(a) for a in _topk_ivf(
+        exact.table, exact.scan_table, jnp.asarray(idx.centroids),
+        jnp.asarray(idx.cells), jnp.arange(5, dtype=jnp.int32), spec=spec,
+        k=6, k_scan=6, nprobe=4, chunk=128, exclude_self=True,
+        mixed=False))
+    assert np.array_equal(ii, ei)
+    np.testing.assert_allclose(idd, ed, rtol=1e-5, atol=1e-5)
+    assert np.all(ii >= 0)
+
+
+def test_single_row_cells(rng):
+    """ncells == N degenerates to one row per cell: probing the p
+    nearest cells IS a p-nearest-centroid search, so top-k over them
+    matches the exact top-k for k <= p."""
+    table, man = _poincare_table(rng, 16, 4, scale=1.2)
+    spec = spec_from_manifold(man)
+    idx = build_index(table, spec, 16, iters=3, seed=0)
+    assert idx.max_cell == 1 and np.all(idx.counts == 1)
+    exact = QueryEngine(table, spec)
+    q = np.asarray([0, 9, 15], np.int32)
+    ei, _ = (np.asarray(a) for a in exact.topk_neighbors(q, 3))
+    ii, _ = (np.asarray(a) for a in _topk_ivf(
+        exact.table, exact.scan_table, jnp.asarray(idx.centroids),
+        jnp.asarray(idx.cells), jnp.asarray(q), spec=spec, k=3, k_scan=3,
+        nprobe=4, chunk=128, exclude_self=True, mixed=False))
+    assert np.array_equal(ii, ei)
+
+
+def test_bf16_probe_rank_agreement(rng):
+    """precision=bf16 composes with probing: same neighbors as the f32
+    probe at ordinary point distributions (the precision contract —
+    docs/precision.md), distances f32-accurate (the rescore ran).  Both
+    engines probe the SAME cells (centroid scoring is f32 either way),
+    so this isolates the in-cell scan dtype."""
+    n = IVF_MIN_TABLE_ROWS
+    table, man = _poincare_table(rng, n, 8)
+    spec = spec_from_manifold(man)
+    idx = build_index(table, spec, 16, iters=5, seed=0)
+    e32 = QueryEngine(table, spec, index=idx, nprobe=6)
+    e16 = QueryEngine(table, spec, index=idx, nprobe=6, precision="bf16")
+    q = rng.integers(0, n, size=64).astype(np.int32)
+    i32, d32 = (np.asarray(a) for a in e32.topk_neighbors(q, 5))
+    i16, d16 = (np.asarray(a) for a in e16.topk_neighbors(q, 5))
+    assert np.array_equal(i32, i16)
+    np.testing.assert_allclose(d32, d16, rtol=1e-5, atol=1e-5)
+
+
+# --- fallback rules and validation --------------------------------------------
+
+
+def test_fallback_rules(rng):
+    table, man = _poincare_table(rng, 300, 4)
+    spec = spec_from_manifold(man)
+    idx = build_index(table, spec, 8, iters=3, seed=0)
+    # nprobe=0: exact, index carried but dormant
+    e = QueryEngine(table, spec, index=idx)
+    assert e.scan_strategy == "exact" and e.scan_signature == ("exact",)
+    # sub-threshold table: exact even with nprobe > 0
+    e = QueryEngine(table, spec, index=idx, nprobe=2)
+    assert 300 < IVF_MIN_TABLE_ROWS and e.scan_strategy == "exact"
+    # nprobe >= ncells: the degenerate probe is served exactly
+    big, _ = _poincare_table(rng, IVF_MIN_TABLE_ROWS, 4)
+    bidx = build_index(big, spec, 8, iters=3, seed=0)
+    e = QueryEngine(big, spec, index=bidx, nprobe=8)
+    assert e.scan_strategy == "exact"
+    e = QueryEngine(big, spec, index=bidx, nprobe=4)
+    assert e.scan_strategy == "ivf"
+    assert e.scan_signature == ("ivf", 4, bidx.fingerprint)
+
+
+def test_validation_errors(rng):
+    table, man = _poincare_table(rng, 300, 4)
+    spec = spec_from_manifold(man)
+    idx = build_index(table, spec, 8, iters=3, seed=0)
+    with pytest.raises(ValueError, match="nprobe"):
+        QueryEngine(table, spec, nprobe=-1)
+    with pytest.raises(ValueError, match="needs an IVF index"):
+        QueryEngine(table, spec, nprobe=2)
+    with pytest.raises(ValueError, match="built over"):
+        QueryEngine(table[:200], spec, index=idx, nprobe=2)
+    other, _ = _poincare_table(rng, 300, 6)
+    with pytest.raises(ValueError, match="width"):
+        QueryEngine(other, spec, index=idx, nprobe=2)
+
+
+def test_k_beyond_probe_capacity_rejected(rng):
+    """nprobe × max_cell bounds what a probe can ever see; a k past it
+    must fail loudly, not return -1 rows — and an UNDER-FILLED probe
+    (enough padded slots, too few reachable rows: sparse cells, or
+    exclude_self masking one) must fail just as loudly, because -1/+inf
+    filler is not an answer and +inf is not JSON."""
+    n = IVF_MIN_TABLE_ROWS
+    table, man = _poincare_table(rng, n, 4)
+    spec = spec_from_manifold(man)
+    cells = np.arange(n, dtype=np.int32).reshape(n // 2, 2)
+    idx = _manual_index(table[:n // 2], cells, np.full(n // 2, 2), n)
+    e = QueryEngine(table, spec, index=idx, nprobe=1)
+    with pytest.raises(ValueError, match="capacity"):
+        e.topk_neighbors(np.asarray([0], np.int32), 3)
+    # at capacity with the self row masked: only 1 reachable row for
+    # k=2 — the under-fill check fires instead of returning a -1 slot
+    with pytest.raises(ValueError, match="under-filled"):
+        e.topk_neighbors(np.asarray([0], np.int32), 2)
+    # keeping the self row fills the cell: both rows come back
+    i, d = e.topk_neighbors(np.asarray([0], np.int32), 2,
+                            exclude_self=False)
+    assert np.asarray(i).shape == (1, 2)
+    assert np.all(np.asarray(i) >= 0) and np.all(np.isfinite(np.asarray(d)))
+
+
+# --- persistence and batcher integration --------------------------------------
+
+
+def test_artifact_round_trip_with_index(rng, tmp_path):
+    n = IVF_MIN_TABLE_ROWS
+    table, man = _clustered_poincare(rng, n, 6, nclusters=16)
+    spec = spec_from_manifold(man)
+    idx = build_index(table, spec, 16, iters=4, seed=0)
+    bare = export_artifact(str(tmp_path / "bare"), table, spec)
+    art = export_artifact(str(tmp_path / "ivf"), table, spec, index=idx)
+    # the fingerprint COVERS the index: same table, different identity
+    assert art.fingerprint != bare.fingerprint
+    loaded = load_artifact(str(tmp_path / "ivf"))
+    assert loaded.fingerprint == art.fingerprint
+    assert loaded.index is not None
+    assert loaded.index.fingerprint == idx.fingerprint
+    assert np.array_equal(loaded.index.cells, idx.cells)
+    assert np.array_equal(loaded.index.centroids.view(np.uint32),
+                          idx.centroids.view(np.uint32))
+    # engine from the loaded artifact probes bitwise like the live one
+    live = QueryEngine(table, spec, index=idx, nprobe=4)
+    served = QueryEngine.from_artifact(loaded, nprobe=4)
+    q = rng.integers(0, n, size=32).astype(np.int32)
+    li, ld = (np.asarray(a) for a in live.topk_neighbors(q, 5))
+    si, sd = (np.asarray(a) for a in served.topk_neighbors(q, 5))
+    assert np.array_equal(li, si)
+    assert np.array_equal(ld.view(np.uint32), sd.view(np.uint32))
+    # a bare artifact still loads with index=None and serves exactly
+    loaded_bare = load_artifact(str(tmp_path / "bare"))
+    assert loaded_bare.index is None
+    e = QueryEngine.from_artifact(loaded_bare)
+    assert e.scan_strategy == "exact"
+
+
+def test_index_tamper_detected(rng, tmp_path):
+    import os
+
+    n = IVF_MIN_TABLE_ROWS
+    table, man = _poincare_table(rng, n, 4)
+    spec = spec_from_manifold(man)
+    idx = build_index(table, spec, 8, iters=3, seed=0)
+    out = str(tmp_path / "art")
+    export_artifact(out, table, spec, index=idx)
+    # swap the index arrays under the marker: load must refuse
+    np.savez(os.path.join(out, "index.npz"), centroids=idx.centroids,
+             cells=np.roll(idx.cells, 1, axis=0), counts=idx.counts)
+    with pytest.raises(ValueError, match="index fingerprint"):
+        load_artifact(out)
+
+
+def test_truncated_index_meta_is_a_value_error(rng, tmp_path):
+    """A hand-edited/truncated index meta block answers the module's
+    corrupt-artifact ValueError (clean CLI exit), not a raw KeyError."""
+    import json
+    import os
+
+    n = IVF_MIN_TABLE_ROWS
+    table, man = _poincare_table(rng, n, 4)
+    spec = spec_from_manifold(man)
+    idx = build_index(table, spec, 8, iters=3, seed=0)
+    out = str(tmp_path / "art")
+    export_artifact(out, table, spec, index=idx)
+    meta_path = os.path.join(out, "artifact.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    del meta["index"]["iters"]
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ValueError, match="missing"):
+        load_artifact(out)
+
+
+def test_batcher_cache_isolates_exact_from_probed(rng):
+    """The LRU key carries the scan signature: an approximate probed
+    row must never answer an exact query over the SAME table (same
+    artifact fingerprint), nor a probe at another nprobe."""
+    n = IVF_MIN_TABLE_ROWS
+    table, man = _clustered_poincare(rng, n, 6, nclusters=16)
+    spec = spec_from_manifold(man)
+    idx = build_index(table, spec, 16, iters=4, seed=0)
+    exact = QueryEngine(table, spec)
+    ivf = QueryEngine(table, spec, index=idx, nprobe=2)
+    assert exact.fingerprint == ivf.fingerprint  # same table bytes
+    b_exact = RequestBatcher(exact)
+    b_ivf = RequestBatcher(ivf)
+    ids = list(range(16))
+    b_exact.topk(ids, 4)
+    b_ivf.topk(ids, 4)
+    assert not ({k for k in b_exact.cache._d}
+                & {k for k in b_ivf.cache._d})
+    assert b_exact.stats()["scan_strategy"] == "exact"
+    assert b_ivf.stats()["scan_strategy"] == "ivf"
+    assert b_ivf.stats()["nprobe"] == 2
+
+
+def test_probe_telemetry_lands(rng):
+    """The probing path observes serve/index_probe_ms and counts
+    serve/recall_candidates (the catalog rows)."""
+    from hyperspace_tpu.telemetry import registry as telem
+
+    n = IVF_MIN_TABLE_ROWS
+    table, man = _clustered_poincare(rng, n, 6, nclusters=16)
+    spec = spec_from_manifold(man)
+    idx = build_index(table, spec, 16, iters=4, seed=0)
+    ivf = QueryEngine(table, spec, index=idx, nprobe=2)
+    reg = telem.default_registry()
+    base = reg.mark()
+    q = np.arange(8, dtype=np.int32)
+    ivf.topk_neighbors(q, 4)
+    delta = reg.snapshot(baseline=base)
+    assert delta.get("serve/recall_candidates") == 8 * 2 * idx.max_cell
+    hist = delta.get("hist/serve/index_probe_ms")
+    assert hist and hist["count"] == 1
