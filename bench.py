@@ -504,6 +504,158 @@ def bench_serve(repeats: int = 2) -> dict:
             "unit": "queries/s", "vs_baseline": None, "detail": detail}
 
 
+def bench_resilience(repeats: int = 1) -> dict:
+    """Chaos recovery + overload shedding (docs/resilience.md).
+
+    Two sub-legs, both assertions-as-measurements — the artifact rows
+    ARE the acceptance evidence the chaos suite gates on:
+
+    - **chaos_train**: a tiny Poincaré run with one seeded NaN fault
+      (``train.step_nan``) under ``rollback=2`` — recovery means the
+      run completes its full step budget with a finite loss and
+      EXACTLY ONE rollback; the row records both.
+    - **overload**: a bounded-queue batcher (``queue_max=4``,
+      ``deadline_ms=250``) hammered by 16 concurrent threads — the
+      shed-rate column, the degradation ladder's peak level and
+      whether it recovered (hysteresis observed), and the p99 of
+      admitted ``serve/e2e_ms`` vs the deadline.
+    """
+    import tempfile
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hyperspace_tpu.manifolds import PoincareBall
+    from hyperspace_tpu.resilience import faults
+    from hyperspace_tpu.serve.batcher import RequestBatcher
+    from hyperspace_tpu.serve.engine import QueryEngine
+    from hyperspace_tpu.serve.errors import ServeError
+    from hyperspace_tpu.telemetry import registry as telem
+
+    detail: dict = {}
+    reg = telem.default_registry()
+
+    # --- chaos train: poisoned chunk -> one rollback -> finite finish
+    from hyperspace_tpu.data.wordnet import synthetic_tree
+    from hyperspace_tpu.models import poincare_embed as pe
+    from hyperspace_tpu.train import loop as train_loop
+
+    ds = synthetic_tree(depth=4, branching=3)
+    cfg = pe.PoincareEmbedConfig(num_nodes=ds.num_nodes, dim=8,
+                                 batch_size=64, neg_samples=8,
+                                 burnin_steps=0)
+    state, opt = pe.init_state(cfg, seed=0)
+    step_fn = pe.make_train_step(cfg)
+    pairs = jnp.asarray(ds.pairs)
+
+    class _Run:  # duck-typed RunConfig (the loop's contract)
+        steps, eval_every, log, tensorboard_dir = 24, 6, None, None
+        ckpt_every, resume = 6, False
+        rollback, rollback_lr_backoff = 2, 0.5
+
+    base = reg.mark()
+    with tempfile.TemporaryDirectory() as tmp:
+        _Run.ckpt_dir = os.path.join(tmp, "ck")
+        faults.install([faults.FaultSpec(site="train.step_nan",
+                                         kind="nan", after=8)])
+        try:
+            state, loss = train_loop.run_loop(
+                _Run(), state, lambda st: step_fn(cfg, opt, st, pairs))
+        finally:
+            faults.clear()
+    delta = reg.snapshot(baseline=base)
+    final_loss = float(loss)
+    detail["chaos_train"] = {
+        "steps": int(state.step),
+        "final_loss": round(final_loss, 4),
+        "final_loss_finite": final_loss == final_loss,
+        "rollbacks": int(delta.get("resilience/rollbacks", 0)),
+        "faults_fired": int(delta.get("fault/fired", 0)),
+        "recovered": (final_loss == final_loss
+                      and delta.get("resilience/rollbacks", 0) == 1),
+    }
+
+    # --- overload: bounded queue + ladder under 16 concurrent threads
+    rng = np.random.default_rng(0)
+    n, dim, k = 20_000, 16, 10
+    deadline_ms, queue_max, workers, per_worker = 250.0, 4, 16, 6
+    table = np.asarray(PoincareBall(1.0).expmap0(
+        jnp.asarray(rng.standard_normal((n, dim)) * 0.3, jnp.float32)))
+    eng = QueryEngine(table, ("poincare", 1.0))
+    # down_after=3: the queue-full shed path must show BEFORE the
+    # ladder degrades (instant cache-only refusals would otherwise
+    # drain the queue so fast it never fills again)
+    bat = RequestBatcher(eng, cache_size=0, queue_max=queue_max,
+                         deadline_ms=deadline_ms, ladder_down_after=3,
+                         ladder_up_after=3)
+    # warm the compile OUTSIDE the deadline (first call pays XLA)
+    bat.topk(rng.integers(0, n, size=64).tolist(), k, deadline_ms=60_000)
+    base = reg.mark()
+    outcomes = {"served": 0, "error": 0}
+    kinds: dict = {}
+    olock = threading.Lock()
+    barrier = threading.Barrier(workers)
+    max_level = {"v": 0}
+
+    def worker(wid):
+        wrng = np.random.default_rng(wid)
+        barrier.wait()
+        for _ in range(per_worker):
+            ids = wrng.integers(0, n, size=64).tolist()
+            try:
+                bat.topk(ids, k)
+                with olock:
+                    outcomes["served"] += 1
+            except ServeError as e:
+                with olock:
+                    outcomes["error"] += 1
+                    kinds[e.kind] = kinds.get(e.kind, 0) + 1
+            with olock:
+                max_level["v"] = max(max_level["v"], bat._ladder.level)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # calm sequential traffic: the ladder must step back up (hysteresis)
+    hot = rng.integers(0, 256, size=8).tolist()
+    for _ in range(12):
+        try:
+            bat.topk(hot, k)
+        except ServeError:
+            pass  # early calm calls may still be cache-only
+        if bat._ladder.level == 0:
+            break
+    delta = reg.snapshot(baseline=base)
+    offered = workers * per_worker
+    shed = int(delta.get("serve/shed", 0))
+    e2e = delta.get("hist/serve/e2e_ms") or {}
+    detail["overload"] = {
+        "offered": offered, "queue_max": queue_max, "workers": workers,
+        "deadline_ms": deadline_ms,
+        "served": outcomes["served"], "errors": kinds,
+        # shed = queue-full refusals (serve/shed); refused_rate adds the
+        # ladder's cache-only refusals — both answer `overloaded`
+        "shed": shed, "shed_rate": round(shed / offered, 3),
+        "refused_rate": round(kinds.get("overloaded", 0) / offered, 3),
+        "deadline_exceeded": int(delta.get("serve/deadline_exceeded", 0)),
+        "degraded": int(delta.get("serve/degraded", 0)),
+        "degrade_recovered": int(delta.get("serve/degrade_recovered", 0)),
+        "degrade_max_level": max_level["v"],
+        "ladder_recovered": bat._ladder.level == 0,
+        "e2e_p99_ms": e2e.get("p99"),
+        "p99_within_deadline": (e2e.get("p99") is not None
+                                and e2e["p99"] <= deadline_ms),
+    }
+    ok = (detail["chaos_train"]["recovered"]
+          and detail["overload"]["ladder_recovered"])
+    return {"metric": "resilience_ok", "value": int(ok), "unit": "bool",
+            "vs_baseline": None, "detail": detail}
+
+
 def bench_precision(repeats: int = 2) -> dict:
     """f32-vs-bf16 timing pairs on the SAME shapes (docs/precision.md).
 
@@ -615,6 +767,11 @@ _COMPACT_FIELDS = (
     ("qps_r99", ("detail", "ivf", "qps_at_recall99")),
     ("precision_train_ms", ("detail", "precision", "train_step_ms")),
     ("precision_serve_ms", ("detail", "precision", "serve_scan_ms")),
+    # failure-domain leg (PR 9): chaos recovery + the shed-rate column
+    ("resilience_ok", ("detail", "resilience", "ok")),
+    ("shed_rate", ("detail", "resilience", "overload", "shed_rate")),
+    ("chaos_rollbacks",
+     ("detail", "resilience", "chaos_train", "rollbacks")),
     ("frac_clustered", ("detail", "frac_clustered")),
     ("num_nodes", ("detail", "num_nodes")),
     ("devices", ("detail", "devices")),
@@ -865,6 +1022,10 @@ def main() -> None:
                 d["precision"] = {"train_speedup": r["value"],
                                   **r["detail"]}
 
+            def resilience_leg(d):  # chaos recovery + shed rate (PR 9)
+                r = bench_resilience()
+                d["resilience"] = {"ok": r["value"], **r["detail"]}
+
             def use_att_leg(d):
                 # the attention arm on the same graph/protocol (VERDICT
                 # r3 #1).  Distinct key: detail["use_att"] is the
@@ -891,6 +1052,7 @@ def main() -> None:
             leg("hgcn_sampled", 45, sampled_leg)
             leg("serve_qps", 40, serve_leg)
             leg("precision", 40, precision_leg)
+            leg("resilience", 25, resilience_leg)
             leg("realistic", 150, realistic_leg)
             leg("workloads", 90, workloads_leg)
             leg("use_att_arm", 0 if args.use_att else 120, use_att_leg)

@@ -11,6 +11,7 @@ from hyperspace_tpu.analysis.rules.flags import FlagDocDriftRule
 from hyperspace_tpu.analysis.rules.hostsync import HostSyncRule
 from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
+from hyperspace_tpu.analysis.rules.retry import UnboundedRetryRule
 from hyperspace_tpu.analysis.rules.tracerleak import TracerLeakRule
 
 ALL_RULES = (
@@ -19,6 +20,7 @@ ALL_RULES = (
     HostSyncRule,
     TracerLeakRule,
     SwallowBaseExceptionRule,
+    UnboundedRetryRule,
     PrecisionLiteralRule,
     TelemetryCatalogRule,
     FlagDocDriftRule,

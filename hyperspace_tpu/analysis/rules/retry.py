@@ -1,0 +1,118 @@
+"""unbounded-retry: sleep-and-retry loops with no exit budget.
+
+Historical incident: PR 9's failure-domain pass added retry-with-
+backoff around checkpoint saves and latency injection at the fault
+sites — exactly the code shape where a `while True: ... time.sleep(...)`
+with no attempt cap or deadline check turns a transient failure into a
+silent hang (the serving analog: a stuck retry holds an admission slot
+forever, and the bounded queue sheds everything behind it).  The
+checkpoint retry is the pattern to copy: ``for attempt in
+range(max_attempts + 1)`` with exponential backoff and a final
+re-raise.
+
+What fires: a loop that cannot exhaust on its own — ``while True:`` /
+``while 1:`` or ``for … in itertools.count(…)`` — whose body calls
+``time.sleep`` and contains NO bound evidence.  Bound evidence (the
+heuristic's escape hatches) is any comparison that either
+
+- names an identifier smelling of a budget (``attempt``, ``retry``,
+  ``retries``, ``tries``, ``max…``, ``budget``, ``deadline``,
+  ``remaining``, ``timeout``, ``elapsed``), or
+- reads a clock (``time.monotonic`` / ``time.time`` /
+  ``time.perf_counter``) — a deadline check.
+
+Bounded ``for`` loops (``range``, a finite iterable) never fire:
+iteration itself is the budget.  Condition-driven ``while`` loops
+(``while not stop.is_set()``) never fire either — something external
+can end them, and flagging every polling loop would bury the signal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+
+_BUDGET_TOKENS = ("attempt", "retry", "retries", "tries", "max",
+                  "budget", "deadline", "remaining", "timeout",
+                  "elapsed")
+_CLOCK_CALLS = ("time.monotonic", "time.time", "time.perf_counter")
+
+
+def _is_constant_true(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def _is_count_iter(ctx: FileContext, node: ast.For) -> bool:
+    call = node.iter
+    if not isinstance(call, ast.Call):
+        return False
+    resolved = ctx.resolve(call.func) or ""
+    return resolved == "itertools.count" or resolved.endswith(".count") \
+        and resolved.startswith("itertools")
+
+
+def _calls_sleep(ctx: FileContext, body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                resolved = ctx.resolve(node.func) or ""
+                if resolved == "time.sleep":
+                    return True
+    return False
+
+
+def _has_bound_evidence(ctx: FileContext, body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Compare):
+                continue
+            for sub in ast.walk(node):
+                name = None
+                if isinstance(sub, ast.Name):
+                    name = sub.id
+                elif isinstance(sub, ast.Attribute):
+                    name = sub.attr
+                if name is not None:
+                    low = name.lower()
+                    if any(t in low for t in _BUDGET_TOKENS):
+                        return True
+                if isinstance(sub, ast.Call):
+                    resolved = ctx.resolve(sub.func) or ""
+                    if resolved in _CLOCK_CALLS:
+                        return True
+    return False
+
+
+class UnboundedRetryRule(Rule):
+    id = "unbounded-retry"
+    severity = "warning"
+    summary = ("while-True / itertools.count loops containing "
+               "time.sleep with no max-attempts bound or deadline "
+               "check")
+
+    def check_file(self, ctx: FileContext):
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.While):
+                if not _is_constant_true(node.test):
+                    continue
+                shape = "while True"
+            elif isinstance(node, ast.For):
+                if not _is_count_iter(ctx, node):
+                    continue
+                shape = "for … in itertools.count()"
+            else:
+                continue
+            if not _calls_sleep(ctx, node.body):
+                continue
+            if _has_bound_evidence(ctx, node.body):
+                continue
+            findings.append(self.finding(
+                ctx, node,
+                f"{shape} loop sleeps with no max-attempts bound or "
+                "deadline check — a transient failure becomes a silent "
+                "hang; bound it like the checkpoint save retry "
+                "(for attempt in range(max_attempts + 1) + backoff + "
+                "re-raise), or check a deadline before sleeping"))
+        return findings

@@ -25,8 +25,14 @@ Loop-mode requests:
     {"op": "stats"}
 
 Responses mirror the request (``neighbors``/``dists``, ``scores``, or
-the counter snapshot); a bad request yields ``{"error": ...}`` and the
-loop continues — a malformed line must never take the server down.
+the counter snapshot); a failed line yields ``{"error": {"kind": ...,
+"message": ...}}`` with a machine-readable kind (``parse`` /
+``validation`` / ``deadline_exceeded`` / ``overloaded`` / ``internal``
+— docs/serving.md "Error taxonomy") and the loop continues — a
+malformed line must never take the server down, and no line is ever
+silently dropped.  ``deadline_ms=``/``queue_max=`` arm per-request
+deadlines and bounded-queue admission control with a degradation
+ladder; SIGTERM drains gracefully (docs/resilience.md).
 Telemetry wiring matches the train CLI: ``telemetry=1`` installs the
 recompile hook and prints a closing summary line to stderr,
 ``trace_out=`` dumps the host spans (each batch runs under a ``query``
@@ -40,7 +46,9 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import io
 import json
+import os
 import sys
 
 import numpy as np
@@ -94,6 +102,20 @@ class ServeConfig:
     # or a sub-threshold table fall back to the exact program
     # (docs/serving.md "Approximate retrieval").
     nprobe: int = 0
+    # --- overload safety (docs/resilience.md) --------------------------
+    # default per-request deadline in ms (0 = none); a request's own
+    # "deadline_ms" field overrides.  Expired requests answer
+    # error.kind=deadline_exceeded — never dispatched late, never
+    # silently dropped.
+    deadline_ms: float = 0.0
+    # bounded admission queue: > N concurrent requests shed with
+    # error.kind=overloaded, and queue pressure drives the degradation
+    # ladder (nprobe steps toward 1, then cache-only).  0 = off.
+    queue_max: int = 0
+    # fault injection (resilience/faults.py), e.g.
+    # chaos=serve.dispatch:latency:ms=50:times=3
+    chaos: str | None = None
+    chaos_seed: int = 0
 
 
 def _ids(s: str, name: str) -> list[int]:
@@ -129,9 +151,15 @@ def _build(cfg: ServeConfig):
                                         nprobe=cfg.nprobe)
     except ValueError as e:  # bad scan_mode/chunk_rows/precision/nprobe
         raise SystemExit(str(e)) from None
-    return eng, RequestBatcher(eng, min_bucket=cfg.min_bucket,
-                               max_bucket=cfg.max_bucket,
-                               cache_size=cfg.cache_size)
+    try:
+        batcher = RequestBatcher(eng, min_bucket=cfg.min_bucket,
+                                 max_bucket=cfg.max_bucket,
+                                 cache_size=cfg.cache_size,
+                                 queue_max=cfg.queue_max,
+                                 deadline_ms=cfg.deadline_ms)
+    except ValueError as e:  # bad queue_max/deadline_ms
+        raise SystemExit(str(e)) from None
+    return eng, batcher
 
 
 def run_export(cfg: ServeConfig) -> dict:
@@ -181,10 +209,13 @@ def run_export(cfg: ServeConfig) -> dict:
 
 
 def run_query(cfg: ServeConfig) -> dict:
+    from hyperspace_tpu.serve.errors import ServeError
+
     _eng, batcher = _build(cfg)
     # request-shaped ValueErrors (k out of range, IVF probe capacity /
-    # under-fill) are usage errors in one-shot mode: clean exit, no
-    # traceback — the serve loop answers the same errors per line
+    # under-fill) and the typed serve errors (deadline/overload) are
+    # usage errors in one-shot mode: clean exit, no traceback — the
+    # serve loop answers the same errors per line
     try:
         if cfg.u or cfg.v:
             scores = batcher.score(_ids(cfg.u, "u"), _ids(cfg.v, "v"),
@@ -193,7 +224,7 @@ def run_query(cfg: ServeConfig) -> dict:
             return {"mode": "query", "scores": scores.tolist()}
         ids = _ids(cfg.ids, "ids")
         idx, dist = batcher.topk(ids, cfg.k)
-    except ValueError as e:
+    except (ValueError, ServeError) as e:
         raise SystemExit(str(e)) from None
     return {"mode": "query", "ids": ids, "k": cfg.k,
             "neighbors": idx.tolist(), "dists": dist.tolist()}
@@ -237,6 +268,19 @@ def _json_bool(req: dict, key: str, default: bool) -> bool:
     return v
 
 
+def _req_deadline(req: dict):
+    """Validate the optional per-request ``deadline_ms`` field (strict:
+    a positive JSON number, not a bool/string) — None means "use the
+    server's default"."""
+    v = req.get("deadline_ms")
+    if v is None:
+        return None
+    if isinstance(v, bool) or not isinstance(v, (int, float)) or v <= 0:
+        raise ValueError(
+            f"deadline_ms must be a positive number, got {v!r}")
+    return float(v)
+
+
 def _handle(batcher, req: dict) -> dict:
     op = req.get("op")
     if op == "topk":
@@ -244,13 +288,15 @@ def _handle(batcher, req: dict) -> dict:
         # than truncating (a float k must be a client error, not k-1)
         idx, dist = batcher.topk(
             req["ids"], req.get("k", 10),
-            exclude_self=_json_bool(req, "exclude_self", True))
+            exclude_self=_json_bool(req, "exclude_self", True),
+            deadline_ms=_req_deadline(req))
         return {"neighbors": idx.tolist(), "dists": dist.tolist()}
     if op == "score":
         scores = batcher.score(req["u"], req["v"],
                                prob=_json_bool(req, "prob", False),
                                fd_r=float(req.get("fd_r", 2.0)),
-                               fd_t=float(req.get("fd_t", 1.0)))
+                               fd_t=float(req.get("fd_t", 1.0)),
+                               deadline_ms=_req_deadline(req))
         return {"scores": scores.tolist()}
     if op == "stats":
         return batcher.stats()
@@ -260,24 +306,57 @@ def _handle(batcher, req: dict) -> dict:
 def run_serve(cfg: ServeConfig, *, stdin=None, stdout=None) -> dict:
     """The JSONL loop; returns the closing stats dict (also printed to
     stderr when telemetry is on).  ``stdin``/``stdout`` injectable for
-    tests."""
+    tests.
+
+    Error taxonomy (docs/serving.md): every failed line answers
+    ``{"error": {"kind": ..., "message": ...}}`` with a machine-readable
+    kind — ``parse`` (not JSON), ``validation`` (bad request),
+    ``deadline_exceeded``, ``overloaded``, ``internal``.  Every read
+    line gets exactly one response line; none is silently dropped.
+
+    SIGTERM triggers **graceful drain**: stop admitting new lines,
+    finish the in-flight request, print the drain notice + latency
+    summary to stderr, and return the closing stats normally.  A real
+    (fileno-backed) stdin is read through a select-polling raw reader
+    (:func:`_poll_lines`) so an IDLE server drains within one poll
+    interval too — a handler that only ran at the next protocol event
+    would make a silent client block shutdown forever.  (From a
+    non-main thread, where signal handlers cannot install, the loop
+    simply runs without drain support; injected test streams without a
+    fileno drain at line boundaries.)"""
+    import signal
+    import threading
+
+    from hyperspace_tpu.serve.errors import ServeError, error_response
     from hyperspace_tpu.telemetry import registry as telem
 
     stdin = sys.stdin if stdin is None else stdin
     stdout = sys.stdout if stdout is None else stdout
     _eng, batcher = _build(cfg)
     served = 0
+    draining = threading.Event()
+    prev_handler = None
+    try:
+        prev_handler = signal.signal(signal.SIGTERM,
+                                     lambda _s, _f: draining.set())
+    except ValueError:
+        pass  # not the main thread: no drain hook, loop still serves
     # session baseline: the latency one-liners report the distribution
     # of THIS serve loop, not the whole process (library/test reuse)
     session_mark = telem.default_registry().mark()
     try:
-        for line in stdin:
+        for line in _line_source(stdin, draining):
+            if draining.is_set():
+                break  # stop admitting; the prior request already flushed
             line = line.strip()
             if not line:
                 continue
             is_stats = False
             try:
-                req = json.loads(line)
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise _ParseError(str(e)) from None
                 if not isinstance(req, dict):
                     raise ValueError(
                         f"request must be a JSON object, "
@@ -285,20 +364,90 @@ def run_serve(cfg: ServeConfig, *, stdin=None, stdout=None) -> dict:
                 resp = _handle(batcher, req)
                 served += 1
                 is_stats = req.get("op") == "stats"
-            except (ValueError, KeyError, TypeError, OverflowError) as e:
+            except _ParseError as e:
+                resp = {"error": {"kind": "parse", "message": str(e)}}
+            except (ServeError, ValueError, KeyError, TypeError,
+                    OverflowError, OSError) as e:
                 # OverflowError: numpy raises it for ints past the cast
-                # width; belt-and-braces with the batcher's own range check
-                resp = {"error": f"{type(e).__name__}: {e}"}
+                # width; belt-and-braces with the batcher's range check.
+                # OSError: a per-request IO failure (incl. the injected
+                # serve.dispatch ioerror chaos fault) answers
+                # error.kind=internal and the loop keeps serving — one
+                # request's IO trouble must not kill the server.
+                # error_response maps ServeError kinds
+                # (deadline_exceeded/overloaded), the stdlib validation
+                # classes, and everything else (-> internal) onto the
+                # taxonomy
+                resp = error_response(e)
             print(json.dumps(_json_safe(resp)), file=stdout, flush=True)
             if is_stats:
                 # the latency one-liner rides on stderr beside the stats
                 # response — stdout stays one response per line
                 _print_latency_stderr(session_mark)
     finally:
+        if prev_handler is not None:
+            signal.signal(signal.SIGTERM, prev_handler)
+        if draining.is_set():
+            try:
+                print(f"[serve] drained: SIGTERM — stopped admitting, "
+                      f"{served} request(s) served", file=sys.stderr,
+                      flush=True)
+            except (OSError, ValueError):
+                pass  # diagnostics never sink the drain
         # the closing summary must survive an engine-level crash — the
         # accumulated distribution matters most in a post-mortem
         _print_latency_stderr(session_mark)
-    return {"mode": "serve", "served": served, **batcher.stats()}
+    return {"mode": "serve", "served": served,
+            "drained": draining.is_set(), **batcher.stats()}
+
+
+class _ParseError(Exception):
+    """Internal marker: the line was not JSON at all (kind=parse)."""
+
+
+def _poll_lines(fd: int, draining):
+    """Line iterator over a raw fd with a drain check every poll tick.
+
+    A plain ``for line in sys.stdin`` blocks in ``readline`` — and
+    PEP 475 retries the read after a signal handler runs, so a SIGTERM
+    to an IDLE server would never drain until the client's next line.
+    Reading the raw fd under a short ``select`` timeout bounds the
+    drain latency at one tick; buffering by hand (rather than through
+    the TextIO layer) avoids the classic select-vs-buffered-reader
+    stall where a burst of lines sits unread in the text buffer while
+    select waits on the drained fd."""
+    import select
+
+    buf = b""
+    while True:
+        nl = buf.find(b"\n")
+        if nl >= 0:
+            line, buf = buf[:nl + 1], buf[nl + 1:]
+            yield line.decode("utf-8", errors="replace")
+            continue
+        if draining.is_set():
+            return
+        ready, _, _ = select.select([fd], [], [], 0.25)
+        if not ready:
+            continue
+        chunk = os.read(fd, 65536)
+        if not chunk:  # EOF; a trailing unterminated line still serves
+            if buf:
+                yield buf.decode("utf-8", errors="replace")
+            return
+        buf += chunk
+
+
+def _line_source(stdin, draining):
+    """The serve loop's line iterator: the polling raw-fd reader for
+    real streams, plain iteration for injected test streams (StringIO
+    and generators have no usable fileno — they drain at line
+    boundaries instead)."""
+    try:
+        fd = stdin.fileno()
+    except (AttributeError, OSError, ValueError, io.UnsupportedOperation):
+        return iter(stdin)
+    return _poll_lines(fd, draining)
 
 
 MODES = {"export": run_export, "query": run_query, "serve": run_serve}
@@ -321,13 +470,24 @@ def main(argv: list[str] | None = None) -> int:
         kv[k] = v
     cfg = apply_overrides(ServeConfig(), kv)
 
+    from hyperspace_tpu.resilience import faults as _faults
     from hyperspace_tpu.telemetry import cli_session
 
+    try:
+        chaos_armed = _faults.install_chaos(cfg.chaos, cfg.chaos_seed)
+    except ValueError as e:  # malformed chaos= grammar is a usage error
+        raise SystemExit(str(e)) from None
     try:
         # stream=stderr: in serve mode stdout is the response stream
         with cli_session(cfg.telemetry, cfg.trace_out, stream=sys.stderr):
             result = MODES[args.mode](cfg)
+        if chaos_armed:
+            result["chaos"] = _faults.stats()
     finally:
+        if chaos_armed:
+            # process-global registry: an in-process caller (tests)
+            # must never inherit this run's faults
+            _faults.clear()
         if cfg.telemetry:
             from hyperspace_tpu.telemetry import registry as telem
 

@@ -80,6 +80,24 @@ class RunConfig:
     health_eps: float = 1e-2  # warn when boundary margin drops below
     health_tol: float = 1e-3  # warn when constraint violation exceeds
     health_abort: bool = False
+    # --- resilience (docs/resilience.md) -------------------------------
+    # chaos=site:kind[:key=value...][,...] arms the seeded fault
+    # registry (resilience/faults.py) — e.g.
+    # chaos=train.step_nan:nan:after=2 poisons one chunk; chaos_seed=
+    # seeds probabilistic specs.  Off (default) every site is one
+    # module-bool read.
+    chaos: str | None = None
+    chaos_seed: int = 0
+    # rollback=N: divergence guard — on non-finite loss or a health
+    # violation, rewind to the last COMMITTED checkpoint (needs
+    # ckpt_dir=), re-seed stream-fed data past the poisoned chunk, and
+    # record the incident; after N rollbacks the run fails loudly.
+    # 0 (default) keeps warn/abort.  lr_backoff^attempt is computed,
+    # recorded, and handed to the on_rollback hook — steppers that can
+    # rebuild their optimizer apply it there (the built-in runners
+    # currently re-seed only; docs/resilience.md).
+    rollback: int = 0
+    rollback_lr_backoff: float = 0.5
     coordinator: str = "127.0.0.1:9357"
     num_processes: int = 1
     process_id: int = 0
@@ -283,6 +301,17 @@ def _stream_stepper(stream, step_fn, steps_per_call: int = 1):
         holder["done"] += steps_per_call
         return step_fn(st, holder["batches"])
 
+    def on_rollback(restored_step, attempt, lr_scale):
+        # divergence rollback (docs/resilience.md): drop the resident
+        # chunk and realign to a chunk boundary so the NEXT call pulls
+        # a FRESH stream chunk — batches are iid draws, so the poisoned
+        # chunk is skipped, never replayed (replaying it would diverge
+        # identically)
+        holder["batches"] = None
+        holder["done"] = 0
+
+    # picked up by run_loop via the runner (`on_rollback=` kwarg)
+    stepper.on_rollback = on_rollback
     return stepper
 
 
@@ -621,11 +650,14 @@ def _train_loop(run: RunConfig, state, stepper, project=None,
     :func:`hyperspace_tpu.train.loop.run_loop` (checkpoint/resume, JSONL
     logging with boundary-crossing cadence, per-chunk loss accumulation,
     telemetry spine); this thin wrapper keeps the import lazy so
-    ``--help`` never pays for orbax."""
+    ``--help`` never pays for orbax.  A stepper carrying an
+    ``on_rollback`` hook (the stream steppers do) hands it to the
+    divergence guard — docs/resilience.md."""
     from hyperspace_tpu.train.loop import run_loop
 
     return run_loop(run, state, stepper, project=project,
-                    steps_per_call=steps_per_call, health_fn=health_fn)
+                    steps_per_call=steps_per_call, health_fn=health_fn,
+                    on_rollback=getattr(stepper, "on_rollback", None))
 
 
 def _maybe_health(run: RunConfig, build):
@@ -676,6 +708,16 @@ def main(argv: list[str] | None = None) -> int:
         precision_mod.get_policy(run.precision)
     except ValueError as e:  # a typo'd preset is a usage error
         raise SystemExit(str(e)) from None
+    if run.rollback > 0 and not run.ckpt_dir:
+        raise SystemExit(
+            "rollback=N needs ckpt_dir= — the divergence guard rewinds "
+            "to the last COMMITTED checkpoint (docs/resilience.md)")
+    from hyperspace_tpu.resilience import faults as _faults
+
+    try:
+        chaos_armed = _faults.install_chaos(run.chaos, run.chaos_seed)
+    except ValueError as e:  # malformed chaos= grammar is a usage error
+        raise SystemExit(str(e)) from None
     if run.multihost:
         jax.distributed.initialize(
             coordinator_address=run.coordinator,
@@ -689,8 +731,16 @@ def main(argv: list[str] | None = None) -> int:
     # still produces it, covering everything up to the failure point.
     # Load the JSON at https://ui.perfetto.dev (host-level spans; the
     # XLA-level complement is train/profiling.trace).
-    with cli_session(run.telemetry, run.trace_out):
-        result = WORKLOADS[args.workload](run, wl_overrides)
+    try:
+        with cli_session(run.telemetry, run.trace_out):
+            result = WORKLOADS[args.workload](run, wl_overrides)
+        if chaos_armed:
+            result["chaos"] = _faults.stats()
+    finally:
+        if chaos_armed:
+            # the registry is process-global: an in-process caller
+            # (tests, benches) must never inherit this run's faults
+            _faults.clear()
     print(json.dumps(_json_safe(result)))
     return 0
 

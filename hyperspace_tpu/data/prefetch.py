@@ -80,6 +80,14 @@ class HostPrefetcher:
 
     def next(self) -> Any:
         """Block until the next item is ready (re-raising worker errors)."""
+        from hyperspace_tpu.resilience import faults
+
+        if faults.active():
+            # the data.next_batch fault site (docs/resilience.md): an
+            # injected IOError/latency lands on the CONSUMER side, where
+            # the training loop's failure handling sees it — a worker-
+            # thread fault would only reach here wrapped anyway
+            faults.hit("data.next_batch")
         if self._q.empty():
             # the device out-ran the host: the wait below is a pipeline
             # stall, not overlap — count it and time it

@@ -162,6 +162,28 @@ class HGCNLinkPred(nn.Module):
         return FermiDiracDecoder(name="decoder")(sq.astype(self.cfg.dtype))
 
     @nn.compact
+    def split_pair_logits(self, g: graph_data.DeviceGraph, pos, neg, *,
+                          deterministic=True):
+        """``(pos_logits, neg_logits)`` with ONE encoder pass and NO
+        concatenation of the two pair batches — the dp×tp-safe form
+        of :meth:`__call__`: this image's jax 0.4.37 GSPMD miscompiles
+        ``concatenate`` when any operand or consumer carries a
+        batch-sharding constraint over a subset of a multi-axis mesh's
+        axes (see ``_lp_step_impl``), so the sharded LP step gathers
+        the two batches separately and combines scalars only."""
+        z, m = HGCNEncoder(self.cfg, name="encoder")(
+            g, deterministic=deterministic
+        )
+        ddt = self.cfg.resolved_decoder_dtype()
+        if ddt is not None and not deterministic:
+            z = z.astype(ddt)  # train only; eval full-prec
+        dec = FermiDiracDecoder(name="decoder")
+        sq_p = m.sqdist(z[pos[:, 0]], z[pos[:, 1]])
+        sq_n = m.sqdist(z[neg[:, 0]], z[neg[:, 1]])
+        return (dec(sq_p.astype(self.cfg.dtype)),
+                dec(sq_n.astype(self.cfg.dtype)))
+
+    @nn.compact
     def pair_logits(self, g: graph_data.DeviceGraph, pos, neg_u, neg_v,
                     neg_plan, *, deterministic=True):
         """Logits for one LP step with every *static* scatter planned:
@@ -281,7 +303,8 @@ def init_lp(cfg: HGCNConfig, g: graph_data.Graph, seed: int = 0):
     return model, opt, state
 
 
-def _lp_step_impl(model, opt, num_nodes, state, g, train_pos, constrain=None):
+def _lp_step_impl(model, opt, num_nodes, state, g, train_pos, constrain=None,
+                  split_pairs=False):
     """Shared LP step body: sample negatives on device, BCE on pos+neg
     logits.  ``constrain`` (optional) pins the supervision batch's sharding
     (GSPMD hint) — the only difference between the single-device and the
@@ -289,12 +312,40 @@ def _lp_step_impl(model, opt, num_nodes, state, g, train_pos, constrain=None):
     key, k_neg, k_drop = jax.random.split(state.key, 3)
     n_neg = train_pos.shape[0] * model.cfg.neg_per_pos
     neg = jax.random.randint(k_neg, (n_neg, 2), 0, num_nodes)
-    if constrain is not None:
-        train_pos = constrain(train_pos)
-        neg = constrain(neg)
 
     def loss_fn(params):
-        pairs = jnp.concatenate([train_pos, neg], axis=0)
+        if constrain is not None and split_pairs:
+            # multi-axis-mesh form: NO concatenate anywhere near the
+            # constrained batch.  This image's jax 0.4.37 GSPMD
+            # miscompiles `concatenate` when any operand — or any
+            # downstream consumer, via backward sharding propagation —
+            # carries a with_sharding_constraint over a proper subset
+            # of a multi-axis mesh's axes (P(("data",), None) on a
+            # dp×tp mesh): the output is assembled from the model-axis
+            # sub-shard with full-width strides, garbling every row's
+            # VALUES, not just their order (root-caused in PR 9;
+            # reduced repro: tests/parallel/test_node_sharded.py::
+            # test_gspmd_concat_constraint_miscompile).  So under such
+            # a mesh the step gathers pos and neg separately (one
+            # encoder pass, no pair concat) and combines scalar sums.
+            # Single-axis (dp-only) meshes partition the concat
+            # correctly and keep the historical form below, unchanged.
+            pos_logit, neg_logit = model.apply(
+                {"params": params}, g,
+                constrain(train_pos), constrain(neg),
+                deterministic=False, rngs={"dropout": k_drop},
+                method=HGCNLinkPred.split_pair_logits,
+            )
+            bce_pos = optax.sigmoid_binary_cross_entropy(
+                pos_logit, jnp.ones_like(pos_logit))
+            bce_neg = optax.sigmoid_binary_cross_entropy(
+                neg_logit, jnp.zeros_like(neg_logit))
+            return ((jnp.sum(bce_pos) + jnp.sum(bce_neg))
+                    / (pos_logit.shape[0] + neg_logit.shape[0]))
+        tp, ng = train_pos, neg
+        if constrain is not None:
+            tp, ng = constrain(tp), constrain(ng)
+        pairs = jnp.concatenate([tp, ng], axis=0)
         logits = model.apply(
             {"params": params}, g, pairs,
             deterministic=False, rngs={"dropout": k_drop},
@@ -445,6 +496,15 @@ def train_step_lp_planned(
     return TrainState(params, opt_state, key, state.step + 1), loss
 
 
+def _concat_hazard(mesh) -> bool:
+    """True when ``mesh`` has a non-trivial axis outside the
+    batch-sharding ("host"/"data") set — the mesh shape under which
+    this image's jax 0.4.37 GSPMD miscompiles a constrained
+    ``concatenate`` (``_lp_step_impl``'s split_pairs rationale)."""
+    return any(int(mesh.shape[a]) > 1 for a in mesh.axis_names
+               if a not in ("host", "data"))
+
+
 def round_up_pairs(pairs: np.ndarray, mesh) -> np.ndarray:
     """Resize a [P, 2] supervision batch to a multiple of the mesh's
     data-axis extent (GSPMD needs the sharded axis divisible).  Repeats
@@ -490,7 +550,8 @@ def make_sharded_step_lp(
     # product_embed.make_sharded_step): a partitioned in_sharding would
     # reject process-local arrays on a multi-host mesh
     step = jax.jit(
-        partial(_lp_step_impl, model, opt, num_nodes, constrain=constrain),
+        partial(_lp_step_impl, model, opt, num_nodes, constrain=constrain,
+                split_pairs=_concat_hazard(mesh)),
         in_shardings=(state_sh, g_sh, replicated(mesh)),
         out_shardings=(state_sh, replicated(mesh)),
         donate_argnums=(0,),
@@ -537,7 +598,8 @@ def make_node_sharded_step_lp(
     constrain = lambda x: jax.lax.with_sharding_constraint(x, bsh)
 
     step = jax.jit(
-        partial(_lp_step_impl, model, opt, num_nodes, constrain=constrain),
+        partial(_lp_step_impl, model, opt, num_nodes, constrain=constrain,
+                split_pairs=_concat_hazard(mesh)),
         in_shardings=(state_sh, graph_shardings(nsg), replicated(mesh)),
         out_shardings=(state_sh, replicated(mesh)),
         donate_argnums=(0,),

@@ -1,0 +1,44 @@
+"""Failure-domain hardening: fault injection, divergence rollback,
+overload-safe serving (docs/resilience.md).
+
+Eight PRs in, the system was fast and observable but brittle by
+construction: a NaN batch poisoned a training run with no rollback, a
+failed checkpoint save had no retry anywhere, and the serve path would
+queue unboundedly rather than shed load.  This package gives every
+failure a *designed* outcome instead of an accidental one:
+
+- :mod:`hyperspace_tpu.resilience.faults` — a process-wide,
+  deterministic (seeded) fault registry.  Tests and the ``chaos=`` CLI
+  flag arm named sites (``ckpt.save``, ``serve.dispatch``,
+  ``data.next_batch``, ``train.step_nan``) with IOError, latency, or
+  NaN payloads; disabled (the default) every site is one module-bool
+  read — the same nullcontext discipline as telemetry.
+- :mod:`hyperspace_tpu.resilience.guard` — the training divergence
+  guard: on non-finite loss or a health-threshold violation the loop
+  rewinds to the last COMMITTED checkpoint, re-seeds the data stream
+  past the poisoned chunk, applies LR backoff under a capped retry
+  budget, and records the incident in the JSONL manifest.
+- :mod:`hyperspace_tpu.resilience.degrade` — the hysteresis-guarded
+  degradation ladder the serve batcher steps down under pressure
+  (IVF ``nprobe`` toward its floor, then cache-only answering).
+"""
+
+from hyperspace_tpu.resilience import faults
+from hyperspace_tpu.resilience.degrade import HysteresisLadder
+from hyperspace_tpu.resilience.faults import (FaultSpec, InjectedCrash,
+                                              InjectedIOError, parse_chaos)
+from hyperspace_tpu.resilience.guard import (DivergenceError,
+                                             RollbackController,
+                                             RollbackExhausted)
+
+__all__ = [
+    "faults",
+    "FaultSpec",
+    "InjectedCrash",
+    "InjectedIOError",
+    "parse_chaos",
+    "DivergenceError",
+    "RollbackController",
+    "RollbackExhausted",
+    "HysteresisLadder",
+]

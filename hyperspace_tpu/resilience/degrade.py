@@ -1,0 +1,83 @@
+"""Hysteresis-guarded degradation ladder (docs/resilience.md).
+
+Under overload a serving system has exactly three honest options: make
+callers wait (queue — bounded by the admission controller), refuse
+(shed — the ``overloaded`` error), or *answer cheaper*.  The ladder is
+the third: a small state machine whose levels order the system's
+quality/cost modes best-first (for the k-NN engine: full ``nprobe``,
+then ``nprobe`` halved toward its floor, then cache-only answering —
+``serve/batcher.py`` owns that mapping; this module owns only the
+level dynamics).
+
+Transitions are hysteresis-guarded so the ladder never flaps at the
+watermark: a step DOWN fires after ``down_after`` consecutive
+observations at/above ``high`` pressure (default 1 — overload reaction
+must be immediate), a step UP only after ``up_after`` consecutive
+observations at/below ``low`` (default 8 — recovery waits for proof).
+Pressure is the caller's normalized load signal in [0, 1] — the serve
+batcher feeds admission-queue occupancy.  Mixed readings between the
+watermarks reset both streaks (neither direction accumulates).
+
+Thread-safe; ``observe`` is a few comparisons under one lock — hot-path
+cheap, and not constructed at all when the feature is off.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+class HysteresisLadder:
+    """Pressure-driven level index in ``[0, levels-1]`` (0 = full
+    quality).  ``on_change(old, new)`` fires outside no lock-ordering
+    hazards (called while holding the ladder's own lock only)."""
+
+    def __init__(self, levels: int, *, high: float = 0.75,
+                 low: float = 0.25, down_after: int = 1,
+                 up_after: int = 8,
+                 on_change: Optional[Callable[[int, int], None]] = None):
+        if levels < 1:
+            raise ValueError(f"levels must be >= 1; got {levels}")
+        if not 0.0 <= low < high <= 1.0:
+            raise ValueError(
+                f"want 0 <= low < high <= 1; got low={low} high={high}")
+        if down_after < 1 or up_after < 1:
+            raise ValueError("down_after/up_after must be >= 1")
+        self.levels = int(levels)
+        self.high, self.low = float(high), float(low)
+        self.down_after, self.up_after = int(down_after), int(up_after)
+        self.on_change = on_change
+        self._lock = threading.Lock()
+        self._level = 0
+        self._hi_streak = 0
+        self._lo_streak = 0
+
+    @property
+    def level(self) -> int:
+        return self._level
+
+    def observe(self, pressure: float) -> int:
+        """Feed one pressure reading; returns the (possibly new) level."""
+        with self._lock:
+            old = self._level
+            if pressure >= self.high:
+                self._hi_streak += 1
+                self._lo_streak = 0
+                if (self._hi_streak >= self.down_after
+                        and self._level < self.levels - 1):
+                    self._level += 1
+                    self._hi_streak = 0
+            elif pressure <= self.low:
+                self._lo_streak += 1
+                self._hi_streak = 0
+                if self._lo_streak >= self.up_after and self._level > 0:
+                    self._level -= 1
+                    self._lo_streak = 0
+            else:
+                # between the watermarks: evidence for neither direction
+                self._hi_streak = self._lo_streak = 0
+            new = self._level
+            if new != old and self.on_change is not None:
+                self.on_change(old, new)
+            return new

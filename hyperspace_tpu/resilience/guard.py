@@ -1,0 +1,129 @@
+"""Training divergence guard: detect, rewind, back off, retry
+(docs/resilience.md "Rollback semantics").
+
+The PR 2 :class:`~hyperspace_tpu.telemetry.health.HealthMonitor` path
+stops at warn-or-abort; this module extends it into *recover*.  When
+the loop sees a non-finite loss at a metrics boundary, or the health
+monitor flags a boundary-margin/constraint violation past tolerance,
+the :class:`RollbackController`:
+
+1. records the incident in the run's JSONL stream (a ``rollback``
+   event: the step it fired at, the step it restored, the reason, the
+   attempt number, the LR backoff scale) and counts
+   ``resilience/rollbacks``;
+2. rewinds the train state to the **last COMMITTED checkpoint** (the
+   same commit test resume trusts — an interrupted save is never a
+   rollback target), waiting out in-flight async saves first so the
+   newest committed step is on disk before the scan;
+3. re-projects the restored params onto their manifolds and copies
+   the restored buffers (the donation-safety rule the resume path
+   already follows);
+4. hands ``(restored_step, attempt, lr_scale)`` to the caller's
+   ``on_rollback`` hook — stream-fed runners re-seed their batch
+   stream there so the poisoned chunk is *skipped*, never replayed,
+   and runners whose optimizer exposes a scale apply the LR backoff
+   (``lr_scale = lr_backoff ** attempt``; the hook receives it either
+   way and the incident record carries it);
+5. enforces the capped retry budget: past ``max_rollbacks`` the
+   controller raises :class:`RollbackExhausted` — persistent
+   divergence must kill the run loudly, not loop forever.
+
+The guard costs nothing it wasn't already paying: detection reads the
+``float(loss)`` the metrics boundary fetches anyway, plus (guard-only)
+one fetch per crossed checkpoint boundary so a poisoned state is never
+saved as a rollback target.  With the guard enabled and no fault, the
+trajectory is bit-identical to an unguarded run (tested).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class DivergenceError(FloatingPointError):
+    """Raised internally when a divergence signal fires with no guard
+    budget left to absorb it (and by callers who want abort semantics)."""
+
+
+class RollbackExhausted(RuntimeError):
+    """Divergence persisted past the capped rollback budget."""
+
+
+class RollbackController:
+    """The run loop's rewind arm (constructed only when ``rollback>0``).
+
+    ``ck`` is the loop's :class:`~hyperspace_tpu.train.checkpoint.
+    CheckpointManager``; ``project`` the manifold re-projection restore
+    applies; ``on_rollback(restored_step, attempt, lr_scale)`` the
+    caller's re-seed/backoff hook (optional).
+    """
+
+    def __init__(self, ck, *, max_rollbacks: int = 1,
+                 lr_backoff: float = 0.5,
+                 project: Optional[Callable] = None,
+                 on_rollback: Optional[Callable[[int, int, float],
+                                               None]] = None):
+        if max_rollbacks < 1:
+            raise ValueError(
+                f"max_rollbacks must be >= 1; got {max_rollbacks}")
+        if not 0.0 < lr_backoff <= 1.0:
+            raise ValueError(
+                f"lr_backoff must be in (0, 1]; got {lr_backoff}")
+        self.ck = ck
+        self.max_rollbacks = int(max_rollbacks)
+        self.lr_backoff = float(lr_backoff)
+        self.project = project
+        self.on_rollback = on_rollback
+        self.rollbacks = 0
+
+    @property
+    def lr_scale(self) -> float:
+        return self.lr_backoff ** self.rollbacks
+
+    def divergent(self, loss_val: float) -> bool:
+        """The loss-side trigger (the boundary's already-fetched float)."""
+        return not math.isfinite(loss_val)
+
+    def rollback(self, state: Any, step: int, log=None,
+                 reason: str = "non-finite loss") -> tuple[Any, int]:
+        """Rewind to the last committed checkpoint; returns
+        ``(restored_state, restored_step)``.  Raises
+        :class:`RollbackExhausted` past the budget and
+        :class:`DivergenceError` when there is no committed step to
+        rewind to."""
+        from hyperspace_tpu.telemetry import registry as telem
+
+        if self.rollbacks >= self.max_rollbacks:
+            raise RollbackExhausted(
+                f"divergence at step {step} persisted after "
+                f"{self.rollbacks} rollback(s): {reason}")
+        self.rollbacks += 1
+        # async saves must land before the committed-step scan, or the
+        # newest real checkpoint might still be a staging dir
+        self.ck.wait()
+        if self.ck.latest_committed_step() is None:
+            raise DivergenceError(
+                f"divergence at step {step} with no committed "
+                f"checkpoint to roll back to: {reason}")
+        state, restored = self.ck.restore(state, project=self.project)
+        # donation-safety copy, same rationale as the resume path: the
+        # next dispatch donates these buffers
+        state = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a).copy(), state)
+        telem.inc("resilience/rollbacks")
+        scale = self.lr_scale
+        msg = (f"[resilience] rollback {self.rollbacks}/"
+               f"{self.max_rollbacks}: step {step} -> {restored} "
+               f"({reason}); lr_scale={scale:g}")
+        print(msg, flush=True)
+        if log is not None:
+            log.event("rollback", step=int(step),
+                      restored_step=int(restored), reason=reason,
+                      attempt=self.rollbacks, lr_scale=scale)
+        if self.on_rollback is not None:
+            self.on_rollback(int(restored), self.rollbacks, scale)
+        return state, int(restored)

@@ -13,7 +13,11 @@ answering retrieval queries (docs/serving.md):
                k-means++ seeding, Lorentz-centroid / Fréchet-mean
                updates) + dense [ncells, max_cell] cell layout
   batcher.py   request micro-batcher: power-of-two bucket padding + LRU
-               result cache, serve/* telemetry counters
+               result cache, serve/* telemetry counters; overload
+               safety — per-request deadlines, bounded admission queue,
+               hysteresis degradation ladder (docs/resilience.md)
+  errors.py    the typed error taxonomy (`error.kind`: parse /
+               validation / deadline_exceeded / overloaded / internal)
   cli/serve.py the `export` / `query` / `serve` entry points
 """
 
@@ -28,6 +32,12 @@ from hyperspace_tpu.serve.artifact import (  # noqa: F401
 )
 from hyperspace_tpu.serve.batcher import RequestBatcher  # noqa: F401
 from hyperspace_tpu.serve.engine import QueryEngine  # noqa: F401
+from hyperspace_tpu.serve.errors import (  # noqa: F401
+    DeadlineExceededError,
+    OverloadedError,
+    ServeError,
+    error_response,
+)
 from hyperspace_tpu.serve.index import (  # noqa: F401
     ServingIndex,
     auto_ncells,

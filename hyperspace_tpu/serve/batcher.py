@@ -43,8 +43,31 @@ slab actually dispatched), and ``serve/e2e_ms`` (enqueue→complete).
 These are what ``bench_serve`` reports p50/p95/p99 per bucket from, and
 what the serve CLI's latency summary line reads.
 
+**Overload safety** (docs/resilience.md): with ``queue_max=N`` the
+batcher fronts a bounded admission counter — a request arriving while
+``N`` are already in flight is SHED with a typed ``overloaded`` error
+(``serve/shed``), never queued unboundedly.  Admission occupancy feeds
+a hysteresis :class:`~hyperspace_tpu.resilience.degrade.
+HysteresisLadder`: under sustained pressure the IVF probe width steps
+down toward its floor of 1 (each step counted in ``serve/degraded``,
+the level in the ``serve/degrade_level`` gauge), then the batcher
+answers **cache-only** (cold ids shed with ``overloaded``); sustained
+calm steps back up (``serve/degrade_recovered``).  Per-request
+``deadline_ms`` is enforced at three points — after the cache pass,
+before each slab dispatch (an expired request is never dispatched
+late), and at completion (a result computed past the deadline is
+answered ``deadline_exceeded``, not returned as if on time) — counted
+in ``serve/deadline_exceeded``.  All of it is **off by default**:
+``queue_max=0`` constructs none of the machinery and the hot path
+gains two attribute checks.  Failed requests (shed/expired) observe no
+latency histograms — ``serve/e2e_ms`` stays the distribution of
+honestly answered requests.
+
 Thread-safety: the LRU is lock-guarded; engine dispatches are jax-level
-thread-safe.  One batcher serves one engine (one artifact).
+thread-safe; the admission counter and ladder carry their own locks
+(concurrent callers — threads today, the async front door next — are
+the population admission control exists for; the blocking CLI loop
+never sheds).  One batcher serves one engine (one artifact).
 """
 
 from __future__ import annotations
@@ -57,13 +80,17 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from hyperspace_tpu.resilience import faults
 from hyperspace_tpu.serve.engine import QueryEngine
+from hyperspace_tpu.serve.errors import (DeadlineExceededError,
+                                         OverloadedError)
 from hyperspace_tpu.telemetry import registry as telem
 from hyperspace_tpu.telemetry.trace import span, tracing
 
 DEFAULT_MIN_BUCKET = 8
 DEFAULT_MAX_BUCKET = 1024
 DEFAULT_CACHE_SIZE = 65536
+_CACHE_ONLY = "cache_only"  # the ladder's terminal level
 
 
 def bucket_sizes(min_bucket: int = DEFAULT_MIN_BUCKET,
@@ -168,17 +195,34 @@ class _Lifecycle:
     """
 
     __slots__ = ("t_enq", "t_form", "info", "buckets_used",
-                 "dispatch_s", "_t_disp")
+                 "dispatch_s", "_t_disp", "t_deadline")
 
-    def __init__(self, op: str):
+    def __init__(self, op: str, deadline_ms: Optional[float] = None):
         self.t_enq = time.perf_counter()
         self.t_form = self.t_enq
         self.info: Optional[dict] = {"op": op} if tracing() else None
         self.buckets_used: list = []
         self.dispatch_s = 0.0
+        # absolute expiry on the same monotonic clock as the stamps;
+        # None = no deadline (the zero-cost default)
+        self.t_deadline = (self.t_enq + deadline_ms / 1e3
+                           if deadline_ms else None)
 
     def formed(self) -> None:
         self.t_form = time.perf_counter()
+
+    def check_deadline(self, where: str) -> None:
+        """Raise ``deadline_exceeded`` when the request's budget is
+        spent — called after the cache pass, before each slab dispatch
+        (never dispatch late), and at completion (never answer a
+        result as if it were on time)."""
+        if (self.t_deadline is not None
+                and time.perf_counter() > self.t_deadline):
+            telem.inc("serve/deadline_exceeded")
+            raise DeadlineExceededError(
+                f"deadline_ms expired {where} "
+                f"({(time.perf_counter() - self.t_enq) * 1e3:.1f} ms "
+                "elapsed)")
 
     def slab(self, bucket: int, used: int) -> None:
         self.buckets_used.append(bucket)
@@ -201,124 +245,301 @@ class _Lifecycle:
                       (time.perf_counter() - self.t_enq) * 1e3)
 
 
+class _Admission:
+    """Bounded in-flight counter: the admission queue's whole state.
+
+    ``try_admit`` returns the post-admit pressure in [0, 1) — the share
+    of the bound OTHER callers hold, ``(inflight − 1) / queue_max`` —
+    or None when full (the caller sheds, observing pressure 1.0).  A
+    lone caller therefore exerts ZERO pressure: the blocking CLI loop
+    (one request in flight, ever) can never walk the ladder down,
+    whatever ``queue_max`` is — only genuine concurrency can."""
+
+    def __init__(self, queue_max: int):
+        self.queue_max = int(queue_max)
+        self.inflight = 0
+        self._lock = threading.Lock()
+
+    def try_admit(self) -> Optional[float]:
+        with self._lock:
+            if self.inflight >= self.queue_max:
+                return None
+            self.inflight += 1
+            return (self.inflight - 1) / self.queue_max
+
+    def release(self) -> None:
+        with self._lock:
+            self.inflight -= 1
+
+
+def _ladder_modes(engine: QueryEngine) -> list:
+    """Quality modes best-first: full (None), IVF probe widths halving
+    toward the floor of 1, then cache-only (docs/resilience.md
+    "Degradation ladder")."""
+    modes: list = [None]
+    if engine.scan_strategy == "ivf":
+        p = engine.nprobe // 2
+        while p >= 1:
+            modes.append(p)
+            p //= 2
+    modes.append(_CACHE_ONLY)
+    return modes
+
+
 class RequestBatcher:
-    """Pads requests onto the bucket ladder and fronts the LRU cache."""
+    """Pads requests onto the bucket ladder and fronts the LRU cache.
+
+    ``queue_max=N`` turns on overload safety (module docstring): the
+    bounded admission counter, the degradation ladder (its hysteresis
+    knobs ``ladder_high``/``ladder_low``/``ladder_down_after``/
+    ``ladder_up_after`` — resilience/degrade.py), and per-request
+    deadlines (``deadline_ms=`` here is the default applied when a
+    request carries none; requests may override per call).  The
+    default ``queue_max=0`` constructs none of it."""
 
     def __init__(self, engine: QueryEngine, *,
                  min_bucket: int = DEFAULT_MIN_BUCKET,
                  max_bucket: int = DEFAULT_MAX_BUCKET,
-                 cache_size: int = DEFAULT_CACHE_SIZE):
+                 cache_size: int = DEFAULT_CACHE_SIZE,
+                 queue_max: int = 0,
+                 deadline_ms: float = 0.0,
+                 ladder_high: float = 0.75, ladder_low: float = 0.25,
+                 ladder_down_after: int = 1, ladder_up_after: int = 8):
         self.engine = engine
         self.buckets = bucket_sizes(min_bucket, max_bucket)
         self.cache = _LRU(cache_size)
+        if queue_max < 0:
+            raise ValueError(f"queue_max must be >= 0; got {queue_max}")
+        if deadline_ms < 0:
+            raise ValueError(
+                f"deadline_ms must be >= 0; got {deadline_ms}")
+        self.default_deadline_ms = float(deadline_ms) or None
+        self._admission = None
+        self._ladder = None
+        self._modes: list = [None]
+        if queue_max > 0:
+            from hyperspace_tpu.resilience.degrade import HysteresisLadder
+
+            self._admission = _Admission(queue_max)
+            self._modes = _ladder_modes(engine)
+            self._ladder = HysteresisLadder(
+                len(self._modes), high=ladder_high, low=ladder_low,
+                down_after=ladder_down_after, up_after=ladder_up_after,
+                on_change=self._on_ladder_change)
+
+    @staticmethod
+    def _on_ladder_change(old: int, new: int) -> None:
+        if new > old:
+            telem.inc("serve/degraded")
+        else:
+            telem.inc("serve/degrade_recovered")
+        telem.set_gauge("serve/degrade_level", new)
+
+    def _admit(self) -> None:
+        """Admission gate: shed with ``overloaded`` when the bounded
+        queue is full; feed the ladder the post-admit occupancy."""
+        if self._admission is None:
+            return
+        occ = self._admission.try_admit()
+        if occ is None:
+            telem.inc("serve/shed")
+            self._ladder.observe(1.0)
+            raise OverloadedError(
+                "admission queue full "
+                f"(queue_max={self._admission.queue_max})")
+        self._ladder.observe(occ)
+
+    def _release(self) -> None:
+        if self._admission is not None:
+            self._admission.release()
+
+    def _mode(self):
+        """Current quality mode: ``None`` (full), an int nprobe
+        override, or ``"cache_only"``."""
+        if self._ladder is None:
+            return None
+        return self._modes[self._ladder.level]
 
     # --- top-k ----------------------------------------------------------------
 
-    def topk(self, ids, k: int, *, exclude_self: bool = True
+    def topk(self, ids, k: int, *, exclude_self: bool = True,
+             deadline_ms: Optional[float] = None
              ) -> tuple[np.ndarray, np.ndarray]:
         """``(neighbors [B, k] int32, dists [B, k] float)`` in request
-        order; cache-aware, bucket-padded."""
-        life = _Lifecycle("topk")
-        with span("query", args=life.info):
-            telem.inc("serve/requests")
-            ids = _checked_ids(ids, "ids", self.engine.num_nodes)
-            if isinstance(k, bool):  # True would index-coerce to k=1
-                raise ValueError("k must be an integer; got bool")
-            try:  # same reject-don't-truncate policy as the ids
-                k = operator.index(k)
-            except TypeError:
-                raise ValueError(
-                    f"k must be an integer; got {type(k).__name__}"
-                ) from None
-            fp = self.engine.fingerprint
-            # cache keys carry exclude_self, the engine's precision
-            # mode, AND its scan signature (("exact",) or
-            # ("ivf", nprobe, index fingerprint)): the same (fp, id, k)
-            # has distinct answers per flag, a bf16-scan engine's rows
-            # must never be served back by an f32 engine over the same
-            # table (same fingerprint!), and an approximate probed
-            # answer must never be served back as an exact one (or at a
-            # different nprobe / through a different index) — or vice
-            # versa
-            mode = self.engine.precision
-            scan = self.engine.scan_signature
-            keyf = lambda qid: (fp, qid, k, exclude_self, mode, scan)
-            rows: dict[int, tuple] = {}
-            misses = []
-            # hit/miss are per UNIQUE id: a duplicate within the request
-            # is one compute (and one counter event), hot or cold
-            for qid in dict.fromkeys(ids):
-                hit = self.cache.get(keyf(qid))
-                if hit is not None:
-                    rows[qid] = hit
-                else:
-                    misses.append(qid)
-            telem.inc("serve/cache_hit", len(rows))
-            telem.inc("serve/cache_miss", len(misses))
-            # batch-form stamp: validation + cache pass done, device
-            # work (if any) starts now
-            life.formed()
-            if life.info is not None:
-                life.info.update(requests=len(ids), k=k,
-                                 cache_hits=len(rows),
-                                 cache_misses=len(misses))
-            for s in range(0, len(misses), self.buckets[-1]):
-                slab = misses[s : s + self.buckets[-1]]
-                b = bucket_for(len(slab), self.buckets)
-                life.slab(b, len(slab))
-                padded = slab + [slab[-1]] * (b - len(slab))
-                life.dispatch_start()
-                idx, dist = self.engine.topk_neighbors(
-                    np.asarray(padded, np.int32), k,
-                    exclude_self=exclude_self)
-                idx = np.asarray(idx)
-                dist = np.asarray(dist)
-                life.dispatch_done()
-                for j, qid in enumerate(slab):
-                    val = (idx[j].copy(), dist[j].copy())
-                    rows[qid] = val
-                    self.cache.put(keyf(qid), val)
-            self._update_gauges()
-            out_i = np.stack([rows[qid][0] for qid in ids])
-            out_d = np.stack([rows[qid][1] for qid in ids])
-            life.finish()
-            return out_i, out_d
+        order; cache-aware, bucket-padded.  ``deadline_ms`` overrides
+        the batcher default for this request (None = the default;
+        module docstring, "Overload safety")."""
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        life = _Lifecycle("topk", deadline_ms)
+        telem.inc("serve/requests")
+        self._admit()
+        try:
+            with span("query", args=life.info):
+                ids = _checked_ids(ids, "ids", self.engine.num_nodes)
+                if isinstance(k, bool):  # True would index-coerce to k=1
+                    raise ValueError("k must be an integer; got bool")
+                try:  # same reject-don't-truncate policy as the ids
+                    k = operator.index(k)
+                except TypeError:
+                    raise ValueError(
+                        f"k must be an integer; got {type(k).__name__}"
+                    ) from None
+                mode = self._mode()
+                nprobe_ov = None
+                if isinstance(mode, int):
+                    # degraded probe width, clamped so the narrowed
+                    # probe can still hold k rows (capacity = p×max_cell)
+                    mc = self.engine.index.max_cell
+                    nprobe_ov = min(max(mode, -(-k // mc)),
+                                    self.engine.nprobe)
+                    if nprobe_ov >= self.engine.nprobe:
+                        nprobe_ov = None  # clamped back to full width
+                fp = self.engine.fingerprint
+                # cache keys carry exclude_self, the engine's precision
+                # mode, AND the EFFECTIVE scan signature (("exact",) or
+                # ("ivf", nprobe, index fingerprint) — the ladder's
+                # narrowed width included): the same (fp, id, k) has
+                # distinct answers per flag, a bf16-scan engine's rows
+                # must never be served back by an f32 engine over the
+                # same table (same fingerprint!), and an approximate
+                # probed answer must never be served back as an exact
+                # one — or at a different width, through a different
+                # index, or vice versa
+                prec = self.engine.precision
+                scan = (("ivf", nprobe_ov, self.engine.index.fingerprint)
+                        if nprobe_ov is not None
+                        else self.engine.scan_signature)
+                keyf = lambda qid: (fp, qid, k, exclude_self, prec, scan)
+                rows: dict[int, tuple] = {}
+                misses = []
+                # hit/miss are per UNIQUE id: a duplicate within the
+                # request is one compute (and one counter event), hot
+                # or cold
+                for qid in dict.fromkeys(ids):
+                    hit = self.cache.get(keyf(qid))
+                    if hit is not None:
+                        rows[qid] = hit
+                    else:
+                        misses.append(qid)
+                telem.inc("serve/cache_hit", len(rows))
+                if mode == _CACHE_ONLY and misses:
+                    # terminal degradation: only the cache answers; a
+                    # cold id is shed (NOT counted as a cache miss —
+                    # nothing was computed) rather than dispatched
+                    raise OverloadedError(
+                        f"cache-only degradation: {len(misses)} cold "
+                        "id(s) in the request")
+                telem.inc("serve/cache_miss", len(misses))
+                # batch-form stamp: validation + cache pass done, device
+                # work (if any) starts now
+                life.formed()
+                life.check_deadline("after the cache pass")
+                if life.info is not None:
+                    life.info.update(requests=len(ids), k=k,
+                                     cache_hits=len(rows),
+                                     cache_misses=len(misses))
+                for s in range(0, len(misses), self.buckets[-1]):
+                    # an expired request is never dispatched late: the
+                    # engine call is the unrecallable cost
+                    life.check_deadline("before dispatch")
+                    slab = misses[s : s + self.buckets[-1]]
+                    b = bucket_for(len(slab), self.buckets)
+                    life.slab(b, len(slab))
+                    padded = slab + [slab[-1]] * (b - len(slab))
+                    if faults.active():
+                        faults.hit("serve.dispatch")  # chaos site
+                    life.dispatch_start()
+                    try:
+                        idx, dist = self.engine.topk_neighbors(
+                            np.asarray(padded, np.int32), k,
+                            exclude_self=exclude_self, nprobe=nprobe_ov)
+                    except ValueError as e:
+                        if (nprobe_ov is not None
+                                and "under-filled" in str(e)):
+                            # the SERVER narrowed the probe, not the
+                            # client: a width that under-fills at the
+                            # degraded level is an overload symptom,
+                            # never a fix-your-request validation error
+                            raise OverloadedError(
+                                f"degraded probe width {nprobe_ov} "
+                                f"under-filled for k={k}; retry later"
+                            ) from e
+                        raise
+                    idx = np.asarray(idx)
+                    dist = np.asarray(dist)
+                    life.dispatch_done()
+                    for j, qid in enumerate(slab):
+                        val = (idx[j].copy(), dist[j].copy())
+                        rows[qid] = val
+                        self.cache.put(keyf(qid), val)
+                self._update_gauges()
+                out_i = np.stack([rows[qid][0] for qid in ids])
+                out_d = np.stack([rows[qid][1] for qid in ids])
+                # a result computed past the deadline is answered
+                # deadline_exceeded, never returned as if on time (the
+                # rows stay cached — the work is not wasted)
+                life.check_deadline("at completion")
+                life.finish()
+                return out_i, out_d
+        finally:
+            self._release()
 
     # --- edge scores ----------------------------------------------------------
 
     def score(self, u_ids, v_ids, *, prob: bool = False,
-              fd_r: float = 2.0, fd_t: float = 1.0) -> np.ndarray:
-        """Bucket-padded ``engine.score_edges`` ([B] in request order)."""
-        life = _Lifecycle("score")
-        with span("query", args=life.info):
-            telem.inc("serve/requests")
-            n = self.engine.num_nodes
-            u = np.asarray(_checked_ids(u_ids, "u", n), np.int64)
-            v = np.asarray(_checked_ids(v_ids, "v", n), np.int64)
-            if u.shape != v.shape:
-                raise ValueError(
-                    f"score: need matching id lists; got "
-                    f"{u.shape} vs {v.shape}")
-            life.formed()
-            if life.info is not None:
-                life.info["requests"] = int(u.size)
-            out = np.empty((u.size,), np.float64)
-            top = self.buckets[-1]
-            for s in range(0, u.size, top):
-                su, sv = u[s : s + top], v[s : s + top]
-                b = bucket_for(su.size, self.buckets)
-                life.slab(b, su.size)
-                pu = np.concatenate([su, np.full(b - su.size, su[-1])])
-                pv = np.concatenate([sv, np.full(b - sv.size, sv[-1])])
-                life.dispatch_start()
-                d = self.engine.score_edges(
-                    pu.astype(np.int32), pv.astype(np.int32),
-                    prob=prob, fd_r=fd_r, fd_t=fd_t)
-                out[s : s + su.size] = np.asarray(d)[: su.size]
-                life.dispatch_done()
-            self._update_gauges()
-            life.finish()
-            return out
+              fd_r: float = 2.0, fd_t: float = 1.0,
+              deadline_ms: Optional[float] = None) -> np.ndarray:
+        """Bucket-padded ``engine.score_edges`` ([B] in request order).
+
+        Same admission/deadline contract as :meth:`topk`; edge scoring
+        is uncached, so the cache-only degradation level sheds every
+        score request (an uncached op has nothing cheaper to serve)."""
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        life = _Lifecycle("score", deadline_ms)
+        telem.inc("serve/requests")
+        self._admit()
+        try:
+            with span("query", args=life.info):
+                if self._mode() == _CACHE_ONLY:
+                    raise OverloadedError(
+                        "cache-only degradation: edge scoring is "
+                        "uncached")
+                n = self.engine.num_nodes
+                u = np.asarray(_checked_ids(u_ids, "u", n), np.int64)
+                v = np.asarray(_checked_ids(v_ids, "v", n), np.int64)
+                if u.shape != v.shape:
+                    raise ValueError(
+                        f"score: need matching id lists; got "
+                        f"{u.shape} vs {v.shape}")
+                life.formed()
+                life.check_deadline("after validation")
+                if life.info is not None:
+                    life.info["requests"] = int(u.size)
+                out = np.empty((u.size,), np.float64)
+                top = self.buckets[-1]
+                for s in range(0, u.size, top):
+                    life.check_deadline("before dispatch")
+                    su, sv = u[s : s + top], v[s : s + top]
+                    b = bucket_for(su.size, self.buckets)
+                    life.slab(b, su.size)
+                    pu = np.concatenate([su, np.full(b - su.size, su[-1])])
+                    pv = np.concatenate([sv, np.full(b - sv.size, sv[-1])])
+                    if faults.active():
+                        faults.hit("serve.dispatch")  # chaos site
+                    life.dispatch_start()
+                    d = self.engine.score_edges(
+                        pu.astype(np.int32), pv.astype(np.int32),
+                        prob=prob, fd_r=fd_r, fd_t=fd_t)
+                    out[s : s + su.size] = np.asarray(d)[: su.size]
+                    life.dispatch_done()
+                self._update_gauges()
+                life.check_deadline("at completion")
+                life.finish()
+                return out
+        finally:
+            self._release()
 
     # --- introspection --------------------------------------------------------
 
@@ -363,4 +584,14 @@ class RequestBatcher:
             # serve CLI stats line must identify an approximate server
             "scan_strategy": self.engine.scan_strategy,
             "nprobe": self.engine.nprobe,
+            # overload safety (docs/resilience.md): queue bound, shed /
+            # deadline counts, and the ladder's current level+mode —
+            # a stats consumer must see a degraded server AS degraded
+            "queue_max": (self._admission.queue_max
+                          if self._admission else 0),
+            "shed": reg.get("serve/shed"),
+            "deadline_exceeded": reg.get("serve/deadline_exceeded"),
+            "degrade_level": (self._ladder.level if self._ladder else 0),
+            "degrade_mode": ("full" if self._mode() is None
+                             else str(self._mode())),
         }

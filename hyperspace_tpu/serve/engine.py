@@ -683,12 +683,24 @@ class QueryEngine:
 
     # --- queries --------------------------------------------------------------
 
-    def topk_neighbors(self, q_idx, k: int, *, exclude_self: bool = True):
+    def topk_neighbors(self, q_idx, k: int, *, exclude_self: bool = True,
+                       nprobe: int | None = None):
         """``(neighbors [B, k] int32, dists [B, k])`` for query row ids.
 
         Results are sorted ascending by distance.  ``k`` must leave room
         in the table (``k <= N - exclude_self``); ids are validated on
         host — a bad id must fail the request, not gather a clipped row.
+
+        ``nprobe`` (probing engines only) overrides the configured probe
+        width for THIS call, within ``[1, self.nprobe]`` — the
+        degradation ladder's lever (docs/resilience.md): under pressure
+        the batcher steps the width down toward its floor without
+        rebuilding the engine.  Each distinct width is one extra
+        compiled program (bounded by the ladder's few levels); answers
+        at a narrower width are coarser, and the batcher's cache key
+        carries the effective width so they never mix with full-width
+        rows.  Exact engines reject an override — a silent ignore would
+        misreport the quality served.
         """
         q_idx = self._check_ids(q_idx, "q_idx")
         k = int(k)
@@ -697,8 +709,13 @@ class QueryEngine:
             raise ValueError(
                 f"k={k} out of range [1, {limit}] for a {self.num_nodes}-row "
                 f"table (exclude_self={exclude_self})")
+        if nprobe is not None and not self._ivf:
+            raise ValueError(
+                "nprobe override needs a probing engine (this one "
+                "answers by exact scan)")
         if self._ivf:
-            return self._probe_topk(q_idx, k, exclude_self=exclude_self)
+            return self._probe_topk(q_idx, k, exclude_self=exclude_self,
+                                    nprobe=nprobe)
         if self._policy.mixed:
             # over-fetch margin: the bf16 scan keeps k_scan candidates so
             # the f32 rescore can repair k-th-boundary near-ties
@@ -725,18 +742,26 @@ class QueryEngine:
             n=self.num_nodes, exclude_self=exclude_self, mode=self.scan_mode)
         return idx, dist
 
-    def _probe_topk(self, q_idx: jax.Array, k: int, *, exclude_self: bool):
+    def _probe_topk(self, q_idx: jax.Array, k: int, *, exclude_self: bool,
+                    nprobe: int | None = None):
         """The probing path: validate capacity, dispatch
         :func:`_topk_ivf`, record the probe telemetry
         (``serve/index_probe_ms``: host wall-clock around the dispatch —
         on CPU, execution; ``serve/recall_candidates``: candidate slots
         gathered, the work the probe actually did vs the exact scan's
-        ``B × N``)."""
-        capacity = self.nprobe * self.index.max_cell
+        ``B × N``).  ``nprobe`` narrows the probe for this call (the
+        ladder's lever; validated against the configured width)."""
+        p = self.nprobe if nprobe is None else int(nprobe)
+        if not 1 <= p <= self.nprobe:
+            raise ValueError(
+                f"nprobe override {p} out of range [1, {self.nprobe}] "
+                "(wider than configured would gather rows the resident "
+                "chunking was not sized for)")
+        capacity = p * self.index.max_cell
         if capacity < k:
             raise ValueError(
                 f"k={k} exceeds the probe capacity nprobe×max_cell = "
-                f"{self.nprobe}×{self.index.max_cell} = {capacity}; "
+                f"{p}×{self.index.max_cell} = {capacity}; "
                 "raise nprobe=")
         k_scan = k
         if self._policy.mixed:
@@ -744,7 +769,7 @@ class QueryEngine:
         t0 = time.perf_counter()
         idx, dist = _topk_ivf(
             self.table, self.scan_table, self._centroids, self._cells,
-            q_idx, spec=self.spec, k=k, k_scan=k_scan, nprobe=self.nprobe,
+            q_idx, spec=self.spec, k=k, k_scan=k_scan, nprobe=p,
             chunk=self._cand_chunk, exclude_self=exclude_self,
             mixed=self._policy.mixed)
         telem.observe("serve/index_probe_ms",
@@ -760,7 +785,7 @@ class QueryEngine:
         # isolates it per request)
         if bool(jax.device_get(jnp.any(jnp.isinf(dist)))):
             raise ValueError(
-                f"IVF probe under-filled: some query's {self.nprobe} "
+                f"IVF probe under-filled: some query's {p} "
                 f"nearest cell(s) hold fewer than k={k} reachable rows "
                 "(sparse/empty cells, or exclude_self masking one) — "
                 "raise nprobe= or rebuild the index with more balance")

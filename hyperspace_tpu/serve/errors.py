@@ -1,0 +1,68 @@
+"""The serve error taxonomy (docs/serving.md "Error taxonomy").
+
+Every failed request must answer with a machine-readable ``error.kind``
+a client can branch on — "retry later" (``overloaded``), "your fault,
+fix the request" (``parse``/``validation``), "give up on this attempt"
+(``deadline_exceeded``), "page someone" (``internal``).  One generic
+error string cannot carry that decision.
+
+Kinds:
+
+==================  ====================================================
+kind                meaning
+==================  ====================================================
+``parse``           the input line is not valid JSON
+``validation``      valid JSON, invalid request (bad op, bad ids/k,
+                    wrong types — the reject-don't-coerce failures)
+``deadline_exceeded``  the request's ``deadline_ms`` expired before a
+                    result could be honestly returned (never silently
+                    dropped, never dispatched late)
+``overloaded``      admission control shed the request (bounded queue
+                    full), or the degradation ladder is answering
+                    cache-only and the request missed
+``internal``        anything else — a server-side bug
+==================  ====================================================
+
+:class:`ServeError` subclasses raise from the batcher with their kind
+attached; the CLI maps stdlib validation exceptions (ValueError & co.)
+onto ``validation`` and JSON decode failures onto ``parse``.
+"""
+
+from __future__ import annotations
+
+ERROR_KINDS = ("parse", "validation", "deadline_exceeded", "overloaded",
+               "internal")
+
+
+class ServeError(Exception):
+    """Base of the typed serve failures; ``kind`` is the wire value."""
+
+    kind = "internal"
+
+    def payload(self) -> dict:
+        """The response-line body: ``{"kind": ..., "message": ...}``."""
+        return {"kind": self.kind, "message": str(self)}
+
+
+class OverloadedError(ServeError):
+    """Admission queue full (shed) or cache-only degradation miss."""
+
+    kind = "overloaded"
+
+
+class DeadlineExceededError(ServeError):
+    """The request's deadline expired before an honest answer existed."""
+
+    kind = "deadline_exceeded"
+
+
+def error_response(exc: BaseException) -> dict:
+    """Map an exception to the one wire shape every failed request
+    answers with: ``{"error": {"kind": ..., "message": ...}}``."""
+    if isinstance(exc, ServeError):
+        return {"error": exc.payload()}
+    if isinstance(exc, (ValueError, KeyError, TypeError, OverflowError)):
+        return {"error": {"kind": "validation",
+                          "message": f"{type(exc).__name__}: {exc}"}}
+    return {"error": {"kind": "internal",
+                      "message": f"{type(exc).__name__}: {exc}"}}

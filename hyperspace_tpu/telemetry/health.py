@@ -132,6 +132,12 @@ class HealthMonitor:
         self.checks = 0
         self.warnings = 0
 
+    def problems(self, vals: dict) -> list[str]:
+        """Public re-check of a sampled dict — the divergence guard
+        (resilience/guard.py) asks "did this sample cross a threshold"
+        without re-running the device fn."""
+        return self._problems(vals)
+
     def _problems(self, vals: dict) -> list[str]:
         import math
 

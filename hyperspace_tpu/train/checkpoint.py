@@ -21,6 +21,7 @@ import os
 from typing import Any, Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
 
@@ -34,15 +35,98 @@ class CheckpointManager:
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
         async_save: bool = True,
+        save_retries: int = 2,
+        retry_backoff_s: float = 0.05,
     ):
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
+        # transient-IO retry policy around save (docs/resilience.md):
+        # save_retries EXTRA attempts, exponential backoff from
+        # retry_backoff_s — always a bounded loop, never sleep-forever
+        self._save_retries = max(int(save_retries), 0)
+        self._retry_backoff_s = float(retry_backoff_s)
+        self._clean_orphans()
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps,
             enable_async_checkpointing=async_save,
         )
         self._mgr = ocp.CheckpointManager(self._dir, options=options)
+
+    def _clean_orphans(self) -> None:
+        """Remove save debris a crashed process left behind: orbax
+        staging dirs (``…orbax-checkpoint-tmp…``) and all-digit step
+        dirs that fail the commit test.  A crash between staging write
+        and the commit rename leaks exactly these shapes FOREVER (the
+        retention policy only rotates committed steps), and an
+        uncommitted dir shadows the resume scan's candidate list every
+        restart.  Runs at init — before this manager has any save in
+        flight; the single-writer assumption (one manager owns a
+        checkpoint dir, as everywhere in this module) makes that safe.
+        Cleanups are counted (``ckpt/orphans_cleaned``) and logged."""
+        import shutil
+
+        try:
+            entries = os.listdir(self._dir)
+        except OSError:
+            return
+        orphans = []
+        for name in entries:
+            path = os.path.join(self._dir, name)
+            if "orbax-checkpoint-tmp" in name:
+                orphans.append(path)
+            elif (name.isdigit() and os.path.isdir(path)
+                    and not _step_dir_committed(path)):
+                orphans.append(path)
+        if not orphans:
+            return
+        from hyperspace_tpu.telemetry import registry as telem
+
+        cleaned = 0
+        for path in orphans:
+            try:
+                if os.path.isdir(path):
+                    shutil.rmtree(path)
+                else:
+                    os.remove(path)
+                cleaned += 1
+            except OSError as e:
+                print(f"[ckpt] failed to clean orphan {path}: {e}",
+                      flush=True)
+        if cleaned:
+            telem.inc("ckpt/orphans_cleaned", cleaned)
+            print(f"[ckpt] cleaned {cleaned} orphaned staging "
+                  f"dir(s) under {self._dir} (crash between staging "
+                  "write and commit rename)", flush=True)
+
+    def _fault_point(self, step: int) -> None:
+        """The ``ckpt.save`` fault site (resilience/faults.py): chaos
+        tests inject a transient IOError (absorbed by the retry loop),
+        latency, or ``crash_staged`` — which materializes the exact
+        on-disk debris a process killed between staging write and
+        commit rename leaves (an uncommitted step dir + a staging dir),
+        then raises InjectedCrash (NOT retried: a kill is not a
+        transient)."""
+        from hyperspace_tpu.resilience import faults
+
+        spec = faults.due("ckpt.save")
+        if spec is None:
+            return
+        if spec.kind == "latency":
+            import time
+
+            time.sleep(spec.ms / 1e3)
+        elif spec.kind == "ioerror":
+            raise faults.InjectedIOError("injected IOError at ckpt.save")
+        elif spec.kind == "crash_staged":
+            partial = os.path.join(self._dir, str(int(step)))
+            os.makedirs(os.path.join(
+                partial, "tmp.orbax-checkpoint-tmp-0"), exist_ok=True)
+            os.makedirs(os.path.join(
+                self._dir, f"{int(step)}.orbax-checkpoint-tmp-0"),
+                exist_ok=True)
+            raise faults.InjectedCrash(
+                "injected crash between staging write and commit rename")
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
         """Maybe-save (interval-gated); returns True if a save started.
@@ -53,15 +137,48 @@ class CheckpointManager:
         ``ckpt/saves`` and accumulate the BLOCKING portion (orbax's
         synchronous device→host copy; the disk write is async) into
         ``ckpt/save_s`` — the number that says how much step time
-        checkpointing steals (docs/observability.md)."""
+        checkpointing steals (docs/observability.md).
+
+        Transient ``OSError`` s (a flaky filesystem; the injected
+        ``ckpt.save`` ioerror fault) are retried up to ``save_retries``
+        extra attempts with exponential backoff (``ckpt/save_retries``
+        counts them); past the budget the last error propagates —
+        bounded by construction, per the ``unbounded-retry`` lint."""
         import time
 
+        from hyperspace_tpu.resilience import faults
         from hyperspace_tpu.telemetry import registry as telem
         from hyperspace_tpu.telemetry.trace import default_tracer
 
         t0 = time.perf_counter()
-        started = self._mgr.save(step, args=ocp.args.StandardSave(state),
-                                 force=force)
+        if not (force or self._mgr.should_save(step)):
+            return False  # interval-gated skip: no copy, no fault point
+        # snapshot the pytree BEFORE handing it to orbax: the async
+        # machinery's device→host copy is NOT reliably complete when
+        # save() returns (observed on this image's orbax 0.7.0 / CPU:
+        # a donated stepper's next dispatch reuses the buffers and a
+        # MID-RUN checkpoint silently holds a LATER step's content —
+        # exactly the corruption a rollback target must never have).
+        # One device copy per STARTED save; interval-gated skips above
+        # pay nothing.
+        state = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a).copy(), state)
+        for attempt in range(self._save_retries + 1):
+            try:
+                if faults.active():
+                    self._fault_point(step)
+                started = self._mgr.save(
+                    step, args=ocp.args.StandardSave(state), force=force)
+                break
+            except OSError as e:
+                if attempt >= self._save_retries:
+                    raise
+                telem.inc("ckpt/save_retries")
+                delay = self._retry_backoff_s * (2 ** attempt)
+                print(f"[ckpt] save step {step} attempt {attempt + 1} "
+                      f"failed ({e}); retrying in {delay:.3g}s",
+                      flush=True)
+                time.sleep(delay)
         t1 = time.perf_counter()
         if started:
             # counter and span recorded together, and ONLY for saves

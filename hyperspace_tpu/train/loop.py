@@ -233,15 +233,54 @@ def _health_monitor(run, health_fn):
     return hm, int(run.health_every)
 
 
+def _poison(state, loss):
+    """Apply the ``train.step_nan`` fault: NaN every inexact leaf of
+    the state and the loss — the device-side shape a poisoned batch
+    leaves behind after one step has propagated it."""
+    def p(a):
+        a = jnp.asarray(a)
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            return a * jnp.asarray(jnp.nan, a.dtype)
+        return a
+
+    return jax.tree_util.tree_map(p, state), p(loss)
+
+
+def _rollback_ctrl(run, ck, project, on_rollback):
+    """RollbackController per the run's ``rollback``/``rollback_lr_backoff``
+    flags (None when off).  ``rollback=N`` needs a checkpoint dir — the
+    rollback target IS the last committed checkpoint."""
+    max_rb = int(getattr(run, "rollback", 0) or 0)
+    if max_rb <= 0:
+        return None
+    if ck is None:
+        raise ValueError(
+            "rollback=N needs ckpt_dir= — the divergence guard rewinds "
+            "to the last COMMITTED checkpoint (docs/resilience.md)")
+    from hyperspace_tpu.resilience.guard import RollbackController
+
+    return RollbackController(
+        ck, max_rollbacks=max_rb,
+        lr_backoff=float(getattr(run, "rollback_lr_backoff", 0.5) or 0.5),
+        project=project, on_rollback=on_rollback)
+
+
 def run_loop(run, state, stepper, project=None, steps_per_call=1,
-             health_fn=None):
+             health_fn=None, on_rollback=None):
     """Shared step loop: optional checkpoint/resume + JSONL logging.
 
     ``run`` is duck-typed (``cli.train.RunConfig`` shape): ``steps``,
     ``eval_every``, ``log``, ``tensorboard_dir``, ``ckpt_dir``,
     ``ckpt_every``, ``resume``; plus the optional telemetry knobs
     ``telemetry``, ``trace_out``, ``health_every``/``health_eps``/
-    ``health_abort`` (absent = off).  Every workload runner goes through
+    ``health_abort`` (absent = off) and the divergence-guard knobs
+    ``rollback`` (max rollbacks; 0 = off) / ``rollback_lr_backoff``
+    (docs/resilience.md).  With the guard on, a non-finite loss at a
+    metrics/save boundary — or a health-threshold violation at the
+    health cadence — rewinds to the last committed checkpoint instead
+    of poisoning the rest of the run; ``on_rollback(restored_step,
+    attempt, lr_scale)`` lets stream-fed callers re-seed past the
+    poisoned chunk and apply the LR backoff.  Every workload runner goes through
     here, so --ckpt-dir / resume work uniformly.  The checkpoint manager
     is context-managed (its __exit__ waits for in-flight async saves and
     closes background threads, also on the exception path).  Orbax async
@@ -261,6 +300,7 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
     next dispatch consumes the buffers).  Returns ``(final_state,
     final_loss)``; loss is nan when no step ran.
     """
+    from hyperspace_tpu.resilience import faults
     from hyperspace_tpu.telemetry import registry as telem
     from hyperspace_tpu.telemetry.trace import span, tracing
 
@@ -274,6 +314,7 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
 
         ck = CheckpointManager(run.ckpt_dir,
                                save_interval_steps=run.ckpt_every)
+    ctrl = _rollback_ctrl(run, ck, project, on_rollback)
     acc = None
     if steps_per_call > 1:
         from hyperspace_tpu.optim.metrics import ChunkMetrics
@@ -289,6 +330,15 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
     # counts rightly belong to this run's records.
     counter_base = (reg.mark()
                     if (reg is not None and fresh_tracer) else None)
+
+    def do_rollback(st, dn, log, reason):
+        """The ONE rollback sequence every trigger funnels through:
+        discard the poisoned interval's chunk-metric accumulation, then
+        rewind — callers rebind (state, done), set loss = nan and
+        continue."""
+        if acc is not None:
+            acc.flush()  # poisoned interval: discard
+        return ctrl.rollback(st, dn, log, reason=reason)
 
     def record_fields():
         """Telemetry fields for one JSONL record: span aggregates since
@@ -320,65 +370,124 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
             # donation
             state = jax.tree_util.tree_map(
                 lambda a: jnp.asarray(a).copy(), state)
+        if ctrl is not None and ck.latest_committed_step() is None:
+            # the guard needs a rollback target from chunk one: without
+            # a committed checkpoint the first divergence would be fatal
+            ck.save(start, state, force=True)
         last_saved = None
         every = run.eval_every or 50
         done = start
         chunk_i = 0
-        while done < run.steps:
-            t_disp = time.perf_counter()
-            # span args: step-at-dispatch + chunk size, so a slow span
-            # in the Perfetto timeline is attributable to its position
-            # (built only while tracing — the disabled hot path stays
-            # allocation-free)
-            args = ({"step": done, "chunk": steps_per_call}
-                    if tracing() else None)
-            with span("dispatch", args=args):
-                state, loss = stepper(state)
-            telem.observe("train/dispatch_ms",
-                          (time.perf_counter() - t_disp) * 1e3)
-            telem.inc("train/dispatches")
-            chunk_i += 1
-            if acc is not None:
-                acc.add(loss)
-            if jnp.ndim(loss):  # scanned chunk: [steps_per_call] losses
-                loss = loss[-1]
-            # the stepper always executes exactly steps_per_call steps
-            # (the scan length is baked into the program), so the
-            # recorded step count is the TRUE count — never clamped
-            prev, done = done, done + steps_per_call
-            # boundary-crossing gates: with chunked stepping, `done` only
-            # takes chunk multiples, so exact-equality cadence would
-            # degrade to lcm(chunk, interval); fire whenever the chunk
-            # crossed an interval boundary (identical to the old
-            # `done % every == 0` when steps_per_call == 1)
-            if (done // every) > (prev // every):
-                # the float(loss) fetch is the interval's real
-                # block-until-device-done (dispatch is async enqueue),
-                # so it must sit INSIDE the span or the wait would show
-                # up nowhere in the span breakdown
-                t_flush = time.perf_counter()
-                with span("metrics_flush"):
-                    kw = {"loss": float(loss)}  # hyperlint: disable=host-sync-in-hot-path — the documented per-boundary fetch
-                    if acc is not None:
-                        stats = acc.flush()
-                        if stats is not None:
-                            kw.update(stats)
-                telem.observe("train/metrics_flush_ms",
-                              (time.perf_counter() - t_flush) * 1e3)
-                log.log(done, **kw, **record_fields())
-            # health sampling rides the chunk cadence, not the log one:
-            # a diverging run should flag BEFORE the next log boundary
-            if monitor is not None and chunk_i % health_every == 0:
-                monitor.check(state, done, log)
-            # ckpt_every <= 0 = final save only (mirrors eval_every's
-            # "0 = eval only at the end"; orbax's interval gate divides
-            # by the interval, so it never sees a 0)
-            if ck is not None and run.ckpt_every > 0:
-                iv = run.ckpt_every
-                crossed = (done // iv) > (prev // iv)
-                if ck.save(done, state,
-                           force=crossed and steps_per_call > 1):
-                    last_saved = done
+        while True:
+            while done < run.steps:
+                t_disp = time.perf_counter()
+                # span args: step-at-dispatch + chunk size, so a slow
+                # span in the Perfetto timeline is attributable to its
+                # position (built only while tracing — the disabled hot
+                # path stays allocation-free)
+                args = ({"step": done, "chunk": steps_per_call}
+                        if tracing() else None)
+                with span("dispatch", args=args):
+                    state, loss = stepper(state)
+                telem.observe("train/dispatch_ms",
+                              (time.perf_counter() - t_disp) * 1e3)
+                telem.inc("train/dispatches")
+                if faults.active() and faults.poison("train.step_nan"):
+                    # chaos: the device-side shape one poisoned batch
+                    # leaves after its step (docs/resilience.md)
+                    state, loss = _poison(state, loss)
+                chunk_i += 1
+                if acc is not None:
+                    acc.add(loss)
+                if jnp.ndim(loss):  # scanned chunk: [spc] losses
+                    loss = loss[-1]
+                # the stepper always executes exactly steps_per_call
+                # steps (the scan length is baked into the program), so
+                # the recorded step count is the TRUE count — never
+                # clamped
+                prev, done = done, done + steps_per_call
+                # boundary-crossing gates: with chunked stepping, `done`
+                # only takes chunk multiples, so exact-equality cadence
+                # would degrade to lcm(chunk, interval); fire whenever
+                # the chunk crossed an interval boundary (identical to
+                # the old `done % every == 0` when steps_per_call == 1)
+                if (done // every) > (prev // every):
+                    # the float(loss) fetch is the interval's real
+                    # block-until-device-done (dispatch is async
+                    # enqueue), so it must sit INSIDE the span or the
+                    # wait would show up nowhere in the span breakdown
+                    t_flush = time.perf_counter()
+                    with span("metrics_flush"):
+                        kw = {"loss": float(loss)}  # hyperlint: disable=host-sync-in-hot-path — the documented per-boundary fetch
+                        if acc is not None:
+                            stats = acc.flush()
+                            if stats is not None:
+                                kw.update(stats)
+                    telem.observe("train/metrics_flush_ms",
+                                  (time.perf_counter() - t_flush) * 1e3)
+                    if ctrl is not None and ctrl.divergent(kw["loss"]):
+                        # the poisoned interval's record is the incident
+                        # event, not a loss row
+                        state, done = do_rollback(
+                            state, done, log,
+                            f"non-finite loss at step {done}")
+                        loss = jnp.nan
+                        continue
+                    log.log(done, **kw, **record_fields())
+                # health sampling rides the chunk cadence, not the log
+                # one: a diverging run should flag BEFORE the next log
+                # boundary
+                if monitor is not None and chunk_i % health_every == 0:
+                    if ctrl is None:
+                        monitor.check(state, done, log)
+                    else:
+                        # guard mode: a threshold violation (or the
+                        # monitor's own abort) is a rollback trigger,
+                        # not a warning/abort — until the budget runs out
+                        try:
+                            bad = monitor.problems(
+                                monitor.check(state, done, log))
+                        except FloatingPointError as e:
+                            bad = [str(e)]
+                        if bad:
+                            state, done = do_rollback(
+                                state, done, log,
+                                "health: " + "; ".join(bad))
+                            loss = jnp.nan
+                            continue
+                # ckpt_every <= 0 = final save only (mirrors
+                # eval_every's "0 = eval only at the end"; orbax's
+                # interval gate divides by the interval, so it never
+                # sees a 0)
+                if ck is not None and run.ckpt_every > 0:
+                    iv = run.ckpt_every
+                    crossed = (done // iv) > (prev // iv)
+                    if ctrl is not None and crossed:
+                        # guard-only fetch: a poisoned state must never
+                        # be saved — it would become the rollback target
+                        lv = float(loss)
+                        if ctrl.divergent(lv):
+                            state, done = do_rollback(
+                                state, done, log,
+                                f"non-finite loss at save boundary, "
+                                f"step {done}")
+                            loss = jnp.nan
+                            continue
+                    if ck.save(done, state,
+                               force=crossed and steps_per_call > 1):
+                        last_saved = done
+            # end-of-run divergence check: a chunk past the last crossed
+            # boundary can still be poisoned — never close (or final-
+            # save) a diverged run while the guard has budget left
+            if ctrl is not None and done > start:
+                lv = float(loss)
+                if ctrl.divergent(lv):
+                    state, done = do_rollback(
+                        state, done, log,
+                        f"non-finite loss at run end, step {done}")
+                    loss = jnp.nan
+                    continue
+            break
         if acc is not None and done > start:
             # chunks past the last crossed log boundary would otherwise
             # vanish: close the run with a final record so every step's

@@ -1,0 +1,26 @@
+"""Fixture: unbounded sleep-and-retry loops (each must fire)."""
+
+import itertools
+import time
+
+
+def retry_forever(op):
+    while True:  # no attempt cap, no deadline: hangs on a hard failure
+        try:
+            return op()
+        except IOError:
+            time.sleep(0.1)
+
+
+def poll_forever(ready):
+    for _ in itertools.count():
+        if ready():
+            break
+        time.sleep(1.0)
+
+
+def spin_forever(flaky):
+    while 1:
+        if flaky():
+            return True
+        time.sleep(0.01)

@@ -1,0 +1,44 @@
+"""Fixture: bounded retry shapes the unbounded-retry rule must pass."""
+
+import time
+
+MAX_ATTEMPTS = 5
+
+
+def bounded_backoff(op, attempts=3):
+    # iteration IS the budget: the checkpoint-save retry pattern
+    for attempt in range(attempts + 1):
+        try:
+            return op()
+        except IOError:
+            if attempt >= attempts:
+                raise
+            time.sleep(0.05 * (2 ** attempt))
+
+
+def deadline_poll(ready):
+    deadline = time.monotonic() + 5.0
+    while True:
+        if ready():
+            return True
+        if time.monotonic() > deadline:
+            raise TimeoutError("gave up")
+        time.sleep(0.05)
+
+
+def counted_spin(flaky):
+    n = 0
+    while True:
+        n += 1
+        if n > MAX_ATTEMPTS:
+            raise RuntimeError("exhausted")
+        if flaky():
+            return True
+        time.sleep(0.01)
+
+
+def condition_driven(stop_event):
+    # condition-driven while loops never fire: something external can
+    # end them (the HostPrefetcher worker's shape)
+    while not stop_event.is_set():
+        time.sleep(0.2)

@@ -19,6 +19,7 @@ from hyperspace_tpu.analysis.rules.flags import FlagDocDriftRule
 from hyperspace_tpu.analysis.rules.hostsync import HostSyncRule
 from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
+from hyperspace_tpu.analysis.rules.retry import UnboundedRetryRule
 from hyperspace_tpu.analysis.rules.tracerleak import TracerLeakRule
 
 FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -37,6 +38,7 @@ _PER_FILE = [
     ("bad_hostsync.py", HostSyncRule, None),
     ("bad_tracerleak.py", TracerLeakRule, None),
     ("bad_exceptions.py", SwallowBaseExceptionRule, None),
+    ("bad_retry.py", UnboundedRetryRule, None),
     ("bad_precision.py", PrecisionLiteralRule,
      "hyperspace_tpu/models/bad_precision.py"),
 ]
@@ -152,6 +154,31 @@ def test_exceptions_bad_fixture_fires():
 def test_exceptions_good_fixture_is_clean():
     assert _lint("good_exceptions.py", SwallowBaseExceptionRule
                  ).findings == []
+
+
+# --- unbounded-retry ----------------------------------------------------------
+
+
+def test_retry_bad_fixture_fires_every_shape():
+    report = _lint("bad_retry.py", UnboundedRetryRule)
+    msgs = [f.message for f in report.findings]
+    assert report.exit_code() == 1 and len(report.findings) == 3
+    assert sum("while True" in m for m in msgs) == 2  # while True + while 1
+    assert any("itertools.count" in m for m in msgs)
+
+
+def test_retry_good_fixture_is_clean():
+    """range-bounded retries, deadline checks, attempt counters and
+    condition-driven polls all pass."""
+    assert _lint("good_retry.py", UnboundedRetryRule).findings == []
+
+
+def test_retry_sleepless_while_true_is_fine(tmp_path):
+    """A while-True with no sleep is a different shape (event loops,
+    generators) — out of this rule's scope."""
+    p = tmp_path / "loop.py"
+    p.write_text("def f(q):\n    while True:\n        q.get()\n")
+    assert lint_file(str(p), rules=[UnboundedRetryRule()]).findings == []
 
 
 # --- precision-literal --------------------------------------------------------

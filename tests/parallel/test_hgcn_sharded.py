@@ -48,17 +48,33 @@ def _run_sharded(cfg, split, steps, axes, train_pos):
 @pytest.mark.parametrize("axes", [
     pytest.param({"data": 8}, marks=pytest.mark.slow),
     pytest.param({"data": 1, "model": 8}, marks=pytest.mark.slow),
-    # dp×tp — the fast-suite representative.  xfail (not strict): this
-    # image's jax 0.4.37 GSPMD partitioner computes the dp×tp program
-    # with a different collective-reduction order/precision than the
-    # single-device step (params drift past tolerance after 5 steps;
-    # first observed when PR 3's jax-shim fixes unmasked the test — it
-    # never ran green at the seed).  dp-only and tp-only meshes agree,
-    # and __graft_entry__.dryrun_multichip asserts the dp×tp step stays
-    # finite; expected to pass again on a jax whose partitioner matches.
+    # dp×tp — the fast-suite representative.  Red from PR 3 to PR 8
+    # under an (incorrect) "partitioner reduction-order drift"
+    # diagnosis; PR 9 bisected the real op-level cause: jax 0.4.37
+    # GSPMD MISCOMPILES `concatenate` whose operands/consumers are
+    # sharded over a subset of a multi-axis mesh's axes — values
+    # garbled, not reordered (minimal repro: tests/parallel/
+    # test_node_sharded.py::test_gspmd_concat_constraint_miscompile).
+    # The supervision-pair concat instance is fixed for every mesh
+    # (hgcn.split_pair_logits: no pair concat under multi-axis meshes —
+    # the node-sharded dp×tp twin now gates green, exact).  THIS legacy
+    # pair-sharded path additionally hits the bug through the Lorentz
+    # time-coordinate concatenates (manifolds/lorentz.py, nn/gcn.py:
+    # `concatenate([t, space], -1)`) when tp column-sharding puts the
+    # model axis on the feature dim — the replicated-graph encoder's
+    # whole hidden state rides through them; bisect evidence: poincare
+    # and euclidean kinds (no time-coord concat) are EXACT on this
+    # exact config, lorentz alone returns garbage (~59 vs 0.54 loss at
+    # identical params).  Rewriting every Lorentz lift as pad+add is
+    # the known dodge; parked until the kernel pass that owns that
+    # surface (ROADMAP 1).  Expected to pass on a jax whose partitioner
+    # assembles sharded concats correctly.
     pytest.param({"data": 4, "model": 2}, marks=pytest.mark.xfail(
-        strict=False, reason="jax 0.4.37 GSPMD dp×tp reduction-order "
-                             "drift — see parametrize comment")),
+        strict=False,
+        reason="jax 0.4.37 GSPMD concatenate miscompile (values "
+               "garbled) via the Lorentz time-coordinate concats under "
+               "model-axis column sharding — see parametrize comment; "
+               "poincare/euclidean are exact on the same mesh")),
     pytest.param({"host": 2, "data": 4}, marks=pytest.mark.slow),
 ])
 def test_sharded_lp_matches_single_device(axes):

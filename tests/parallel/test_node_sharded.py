@@ -159,15 +159,14 @@ def test_aggregate_gradient_matches_dense(rng):
 
 @pytest.mark.parametrize("axes", [
     {"data": 8},
-    pytest.param({"data": 4, "model": 2}, marks=pytest.mark.xfail(
-        strict=False,
-        reason="this image's jax 0.4.37 GSPMD partitioner computes the "
-               "dp×tp program with a different collective-reduction "
-               "order/precision than single-device (params drift past "
-               "tolerance after a few steps); dp-only and tp-only meshes "
-               "agree, and the dry-run asserts the dp×tp step stays "
-               "finite — tracked since PR 3 (CHANGES.md), expected to "
-               "pass again on a jax whose partitioner matches")),
+    # dp×tp: red from PR 3 to PR 8 under an (incorrect) "partitioner
+    # reduction-order drift" diagnosis.  PR 9 root-caused the real
+    # op-level cause — jax 0.4.37 GSPMD miscompiles `concatenate` under
+    # a subset-of-axes sharding constraint (see
+    # test_gspmd_concat_constraint_miscompile below) — and the LP step
+    # now avoids the pattern (hgcn.split_pair_logits), so dp×tp is
+    # exact again and gates like every other mesh.
+    {"data": 4, "model": 2},
 ])
 def test_node_sharded_lp_matches_single_device(axes):
     mesh = _mesh_or_skip(axes)
@@ -194,6 +193,41 @@ def test_node_sharded_lp_matches_single_device(axes):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
         state.params, state2.params)
+
+
+@pytest.mark.xfail(
+    strict=False,
+    reason="jax 0.4.37 GSPMD miscompiles concatenate under a "
+           "subset-of-axes sharding constraint on a multi-axis mesh — "
+           "the minimal repro of the bug that held the dp×tp "
+           "equivalence tests red from PR 3 to PR 8; expected to PASS "
+           "(and this xfail to become an xpass) on a jax whose "
+           "partitioner assembles the concat correctly")
+def test_gspmd_concat_constraint_miscompile():
+    """Reduced repro of the op-level root cause (PR 9 bisect): on a
+    dp×tp mesh, `concatenate([with_sharding_constraint(a, P(("data",),
+    None)), b])` returns GARBLED VALUES — the model-axis sub-shard read
+    with full-width strides (got[i] == [want[2i][0], want[2i+1][0]]) —
+    not a reduction reorder.  dp-only meshes compile the same program
+    correctly.  The production LP step dodges the pattern entirely
+    (hgcn.split_pair_logits); this test documents the jax bug so a
+    fixed jax shows up as an xpass."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from hyperspace_tpu.parallel.mesh import batch_sharding, replicated
+
+    mesh = make_mesh({"data": 4, "model": 2})
+    bsh = batch_sharding(mesh, ndim=2)
+    a = jnp.asarray(np.arange(480 * 2).reshape(480, 2))
+    b = jnp.asarray(10_000 + np.arange(1920 * 2).reshape(1920, 2))
+    want = np.concatenate([np.asarray(a), np.asarray(b)], axis=0)
+
+    def f(a, b):
+        a = jax.lax.with_sharding_constraint(a, bsh)
+        return jnp.concatenate([a, b], axis=0)
+
+    got = np.asarray(jax.jit(f, out_shardings=replicated(mesh))(a, b))
+    np.testing.assert_array_equal(got, want)
 
 
 def test_node_sharded_nc_matches_single_device():

@@ -1,0 +1,160 @@
+"""Checkpoint failure domain: crash-mid-save orphans, init cleanup,
+transient-IO retry, and mid-run save integrity under donation."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.resilience import faults
+from hyperspace_tpu.train.checkpoint import (CheckpointManager,
+                                             peek_latest_step,
+                                             restore_params_only)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _state(step: int):
+    return {"table": jnp.full((4, 3), float(step)),
+            "step": jnp.asarray(step, jnp.int32)}
+
+
+def test_crash_mid_save_is_ignored_then_cleaned(tmp_path):
+    """The satellite contract: kill a save between staging write and
+    commit rename (via the ckpt.save fault site) — resume must ignore
+    the partial step, restore the previous COMMITTED one, and the next
+    manager init must clean the orphan."""
+    d = str(tmp_path / "ck")
+    with CheckpointManager(d) as ck:
+        assert ck.save(5, _state(5), force=True)
+    faults.install([faults.FaultSpec(site="ckpt.save",
+                                     kind="crash_staged")])
+    with CheckpointManager(d) as ck:
+        with pytest.raises(faults.InjectedCrash):
+            ck.save(10, _state(10), force=True)
+        # the crash left the debris shape on disk...
+        names = os.listdir(d)
+        assert any("orbax-checkpoint-tmp" in n for n in names)
+        assert "10" in names
+        # ...which the commit test refuses: resume accounting and the
+        # restore target both stay at the committed step
+        assert ck.latest_committed_step() == 5
+        assert peek_latest_step(d) == 5
+        tree, step = restore_params_only(d)
+        assert step == 5
+        np.testing.assert_array_equal(np.asarray(tree["table"]),
+                                      np.full((4, 3), 5.0))
+    faults.clear()
+
+    from hyperspace_tpu.telemetry import registry as telem
+
+    reg = telem.default_registry()
+    base = reg.mark()
+    with CheckpointManager(d) as ck:  # init cleans the orphans
+        names = os.listdir(d)
+        assert not any("orbax-checkpoint-tmp" in n for n in names)
+        assert "10" not in names and "5" in names
+        assert ck.latest_committed_step() == 5
+        delta = reg.snapshot(baseline=base)
+        assert delta.get("ckpt/orphans_cleaned") == 2
+        # a cleaned dir is save-able again
+        assert ck.save(10, _state(10), force=True)
+    assert peek_latest_step(d) == 10
+
+
+def test_transient_ioerror_is_retried(tmp_path):
+    """Two injected transient IOErrors at ckpt.save: the bounded retry
+    loop absorbs them, the save lands, and ckpt/save_retries counts."""
+    from hyperspace_tpu.telemetry import registry as telem
+
+    d = str(tmp_path / "ck")
+    faults.install([faults.FaultSpec(site="ckpt.save", kind="ioerror",
+                                     times=2)])
+    reg = telem.default_registry()
+    base = reg.mark()
+    with CheckpointManager(d, retry_backoff_s=0.01) as ck:
+        assert ck.save(3, _state(3), force=True)
+    assert peek_latest_step(d) == 3
+    delta = reg.snapshot(baseline=base)
+    assert delta.get("ckpt/save_retries") == 2
+    assert delta.get("fault/fired") == 2
+
+
+def test_retry_budget_is_bounded(tmp_path):
+    """More transient faults than the retry budget: the last error
+    propagates — no unbounded retry, no sleep-forever."""
+    d = str(tmp_path / "ck")
+    faults.install([faults.FaultSpec(site="ckpt.save", kind="ioerror",
+                                     times=0)])
+    with CheckpointManager(d, save_retries=2,
+                           retry_backoff_s=0.01) as ck:
+        with pytest.raises(IOError):
+            ck.save(3, _state(3), force=True)
+    assert faults.stats()["fired"] == 3  # 1 attempt + 2 retries
+
+
+def test_injected_crash_is_not_retried(tmp_path):
+    """crash_staged simulates a process death — the transient-IO retry
+    loop must NOT absorb it (one firing, straight through)."""
+    d = str(tmp_path / "ck")
+    faults.install([faults.FaultSpec(site="ckpt.save",
+                                     kind="crash_staged", times=0)])
+    with CheckpointManager(d, save_retries=5,
+                           retry_backoff_s=0.01) as ck:
+        with pytest.raises(faults.InjectedCrash):
+            ck.save(3, _state(3), force=True)
+    assert faults.stats()["fired"] == 1
+
+
+def test_orphan_cleanup_spares_committed_steps(tmp_path):
+    """Cleanup must only take staging debris — committed steps and
+    unrelated files survive."""
+    d = str(tmp_path / "ck")
+    with CheckpointManager(d) as ck:
+        ck.save(2, _state(2), force=True)
+        ck.save(4, _state(4), force=True)
+    # hand-made debris: a staging dir and an uncommitted step dir
+    os.makedirs(os.path.join(d, "6.orbax-checkpoint-tmp-123"))
+    os.makedirs(os.path.join(d, "6"))
+    with open(os.path.join(d, "notes.txt"), "w") as f:
+        f.write("keep me")
+    with CheckpointManager(d) as ck:
+        assert ck.latest_committed_step() == 4
+    names = set(os.listdir(d))
+    assert "2" in names and "4" in names and "notes.txt" in names
+    assert "6" not in names
+    assert not any("orbax-checkpoint-tmp" in n for n in names)
+
+
+def test_midrun_save_integrity_under_donation(tmp_path):
+    """Regression: orbax's async device→host copy is not reliably
+    complete when save() returns, so a donated stepper's next dispatch
+    could recycle the buffers and a MID-RUN checkpoint silently held a
+    LATER step's content (observed on this image: dir 4 holding step-8
+    values).  The save-side snapshot copy must keep every mid-run dir
+    holding exactly its own step."""
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def bump(s):
+        return {"table": s["table"] + 1.0, "step": s["step"] + 1}
+
+    d = str(tmp_path / "ck")
+    state = {"table": jnp.zeros((64, 8)), "step": jnp.asarray(0, jnp.int32)}
+    with CheckpointManager(d, save_interval_steps=4,
+                           max_to_keep=10) as ck:
+        for _ in range(8):
+            state = bump(state)
+            ck.save(int(state["step"]), state)
+    for step in (4, 8):
+        tree, _ = restore_params_only(d, step=step)
+        assert int(tree["step"]) == step
+        np.testing.assert_array_equal(np.asarray(tree["table"]),
+                                      np.full((64, 8), float(step)))

@@ -1,0 +1,131 @@
+"""The fault registry: determinism, grammar, counters, zero-cost off."""
+
+import time
+
+import pytest
+
+from hyperspace_tpu.resilience import faults
+from hyperspace_tpu.telemetry import registry as telem
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def test_disabled_is_inert():
+    assert not faults.active()
+    faults.hit("ckpt.save")          # no-op, no raise
+    assert not faults.poison("train.step_nan")
+    assert faults.due("anything") is None
+    assert faults.stats() == {}
+
+
+def test_window_scheduling_is_deterministic():
+    spec = faults.FaultSpec(site="s", kind="ioerror", times=2, after=1)
+    faults.install([spec])
+    faults.hit("s")                  # call 0: before the window
+    with pytest.raises(IOError):
+        faults.hit("s")              # calls 1, 2: the window
+    with pytest.raises(IOError):
+        faults.hit("s")
+    faults.hit("s")                  # call 3: past the window
+    assert faults.stats()["fired"] == 2
+
+
+def test_times_zero_fires_every_call():
+    faults.install([faults.FaultSpec(site="s", kind="nan", times=0)])
+    assert all(faults.poison("s") for _ in range(5))
+
+
+def test_prob_stream_reproducible_per_seed():
+    def draws(seed):
+        faults.install(
+            [faults.FaultSpec(site="s", kind="nan", prob=0.5)], seed=seed)
+        return [faults.poison("s") for _ in range(40)]
+
+    a, b, c = draws(7), draws(7), draws(8)
+    assert a == b           # same seed = same schedule: a regression
+    assert a != c           # test, not a dice roll
+    assert any(a) and not all(a)
+
+
+def test_latency_kind_sleeps():
+    faults.install(
+        [faults.FaultSpec(site="s", kind="latency", ms=30.0)])
+    t0 = time.perf_counter()
+    faults.hit("s")
+    assert time.perf_counter() - t0 >= 0.025
+    t0 = time.perf_counter()
+    faults.hit("s")          # window consumed: no delay
+    assert time.perf_counter() - t0 < 0.02
+
+
+def test_counters_armed_and_fired():
+    reg = telem.default_registry()
+    base = reg.mark()
+    faults.install([faults.FaultSpec(site="a", kind="ioerror"),
+                    faults.FaultSpec(site="b", kind="nan")])
+    with pytest.raises(IOError):
+        faults.hit("a")
+    assert faults.poison("b")
+    delta = reg.snapshot(baseline=base)
+    assert delta.get("fault/armed") == 2
+    assert delta.get("fault/fired") == 2
+
+
+def test_chaos_grammar_round_trip():
+    specs = faults.parse_chaos(
+        "ckpt.save:ioerror:times=2,"
+        "serve.dispatch:latency:ms=50:times=3,"
+        "train.step_nan:nan:after=4,"
+        "data.next_batch:ioerror:prob=0.05")
+    assert [s.site for s in specs] == [
+        "ckpt.save", "serve.dispatch", "train.step_nan",
+        "data.next_batch"]
+    assert specs[0].times == 2
+    assert specs[1].ms == 50.0 and specs[1].times == 3
+    assert specs[2].after == 4
+    assert specs[3].prob == 0.05
+
+
+@pytest.mark.parametrize("bad", [
+    "",                       # nothing parsed
+    "siteonly",               # no kind
+    "s:unknown_kind",         # bad kind
+    "s:nan:times",            # key without value
+    "s:nan:bogus=1",          # unknown key
+    "s:latency:ms=-1",        # negative delay
+    "s:nan:prob=2.0",         # prob out of range
+])
+def test_chaos_grammar_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.parse_chaos(bad)
+
+
+def test_install_chaos_cli_helper():
+    assert not faults.install_chaos(None)
+    assert not faults.install_chaos("")
+    assert faults.install_chaos("s:nan")
+    assert faults.active()
+
+
+def test_crash_kind_is_not_an_oserror():
+    # a crash simulation must NOT be absorbed by transient-IO retry
+    # loops (checkpoint.save catches OSError only)
+    assert not issubclass(faults.InjectedCrash, OSError)
+    assert issubclass(faults.InjectedIOError, OSError)
+
+
+def test_data_next_batch_site_in_prefetcher():
+    from hyperspace_tpu.data.prefetch import HostPrefetcher
+
+    faults.install([faults.FaultSpec(site="data.next_batch",
+                                     kind="ioerror", after=1)])
+    with HostPrefetcher(lambda i: i) as pf:
+        assert pf.next() == 0
+        with pytest.raises(IOError):
+            pf.next()
+        assert pf.next() == 1  # transient: the stream continues

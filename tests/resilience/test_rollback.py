@@ -1,0 +1,167 @@
+"""Divergence guard: chaos NaN → rollback → recovery (the acceptance
+chaos suite's training leg), guard-idle bit-identity, budget caps."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.cli.train import RunConfig
+from hyperspace_tpu.data.wordnet import synthetic_tree
+from hyperspace_tpu.models import poincare_embed as pe
+from hyperspace_tpu.resilience import faults
+from hyperspace_tpu.resilience.guard import (RollbackController,
+                                             RollbackExhausted)
+from hyperspace_tpu.train import loop
+from hyperspace_tpu.train.logging import read_jsonl
+
+_DS = synthetic_tree(depth=3, branching=3)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _cfg():
+    return pe.PoincareEmbedConfig(num_nodes=_DS.num_nodes, dim=4,
+                                  batch_size=16, neg_samples=4,
+                                  burnin_steps=0)
+
+
+def _setup(seed=5):
+    cfg = _cfg()
+    pairs = jnp.asarray(_DS.pairs)
+    state, opt = pe.init_state(cfg, seed)
+    step_fn = pe.make_train_step(cfg)
+    return state, (lambda st: step_fn(cfg, opt, st, pairs))
+
+
+def test_chaos_nan_rollback_recovers(tmp_path):
+    """One poisoned chunk: the run rolls back to the last committed
+    checkpoint EXACTLY ONCE (JSONL incident), completes its full step
+    budget, and ends with a finite loss."""
+    log = str(tmp_path / "log.jsonl")
+    run = RunConfig(steps=16, eval_every=4, ckpt_dir=str(tmp_path / "ck"),
+                    ckpt_every=4, rollback=2, log=log)
+    state, stepper = _setup()
+    faults.install([faults.FaultSpec(site="train.step_nan", kind="nan",
+                                     after=5)])
+    state, loss = loop.run_loop(run, state, stepper)
+    assert math.isfinite(float(loss))
+    assert int(state.step) == 16
+    assert not bool(jnp.any(~jnp.isfinite(state.table)))
+    incidents = [r for r in read_jsonl(log)
+                 if r.get("event") == "rollback"]
+    assert len(incidents) == 1
+    inc = incidents[0]
+    # poisoned at step 6, detected at the step-8 boundary, restored to
+    # the last committed save (step 4); the lr backoff scale rides along
+    assert inc["restored_step"] < inc["step"]
+    assert inc["attempt"] == 1 and inc["lr_scale"] == 0.5
+    assert "loss" in inc["reason"]
+    from hyperspace_tpu.telemetry import registry as telem
+
+    assert telem.default_registry().get("resilience/rollbacks") >= 1
+
+
+def test_guard_idle_is_bit_identical(tmp_path):
+    """Guard armed + no fault == unguarded run, bitwise (the chaos
+    acceptance's faults-disabled contract)."""
+    s1, st1 = _setup(seed=9)
+    s2, st2 = _setup(seed=9)
+    plain = RunConfig(steps=12, eval_every=4,
+                      ckpt_dir=str(tmp_path / "a"), ckpt_every=4)
+    guarded = RunConfig(steps=12, eval_every=4,
+                        ckpt_dir=str(tmp_path / "b"), ckpt_every=4,
+                        rollback=2)
+    s1, l1 = loop.run_loop(plain, s1, st1)
+    s2, l2 = loop.run_loop(guarded, s2, st2)
+    np.testing.assert_array_equal(np.asarray(s1.table),
+                                  np.asarray(s2.table))
+    assert float(l1) == float(l2)
+
+
+def test_rollback_budget_exhausted(tmp_path):
+    """Persistent divergence (every chunk poisoned) must exhaust the
+    capped budget and fail LOUDLY, not loop forever."""
+    run = RunConfig(steps=8, eval_every=2, ckpt_dir=str(tmp_path / "ck"),
+                    ckpt_every=2, rollback=1)
+    state, stepper = _setup()
+    faults.install([faults.FaultSpec(site="train.step_nan", kind="nan",
+                                     times=0)])
+    with pytest.raises(RollbackExhausted):
+        loop.run_loop(run, state, stepper)
+
+
+def test_rollback_requires_ckpt_dir():
+    run = RunConfig(steps=4, rollback=1)  # no ckpt_dir
+    state, stepper = _setup()
+    with pytest.raises(ValueError, match="ckpt_dir"):
+        loop.run_loop(run, state, stepper)
+
+
+def test_on_rollback_hook_reseeds(tmp_path):
+    """The hook receives (restored_step, attempt, lr_scale) — the
+    stream re-seed + LR-backoff delivery point."""
+    calls = []
+    run = RunConfig(steps=12, eval_every=4, ckpt_dir=str(tmp_path / "ck"),
+                    ckpt_every=4, rollback=3, rollback_lr_backoff=0.25)
+    state, stepper = _setup()
+    faults.install([faults.FaultSpec(site="train.step_nan", kind="nan",
+                                     after=5)])
+    state, loss = loop.run_loop(
+        run, state, stepper,
+        on_rollback=lambda *a: calls.append(a))
+    assert math.isfinite(float(loss))
+    assert calls == [(4, 1, 0.25)]
+
+
+def test_health_violation_triggers_rollback(tmp_path):
+    """The health-monitor path: a nonfinite state flags at the health
+    cadence (BEFORE any log boundary) and rolls back instead of
+    warn/abort."""
+    from hyperspace_tpu.manifolds import PoincareBall
+    from hyperspace_tpu.telemetry.health import make_health_fn
+
+    log = str(tmp_path / "log.jsonl")
+    run = RunConfig(steps=12, eval_every=50, ckpt_dir=str(tmp_path / "ck"),
+                    ckpt_every=2, rollback=2, health_every=1,
+                    health_abort=True, log=log)
+    state, stepper = _setup()
+    health_fn = make_health_fn(PoincareBall(1.0),
+                               params_of=lambda st: st.table)
+    faults.install([faults.FaultSpec(site="train.step_nan", kind="nan",
+                                     after=4)])
+    state, loss = loop.run_loop(run, state, stepper, health_fn=health_fn)
+    assert math.isfinite(float(loss))
+    incidents = [r for r in read_jsonl(log)
+                 if r.get("event") == "rollback"]
+    assert len(incidents) == 1
+    assert incidents[0]["reason"].startswith("health:")
+
+
+def test_end_of_run_divergence_caught(tmp_path):
+    """A poisoned FINAL chunk (past the last log/save boundary) must
+    still be detected and rolled back — never returned as the result."""
+    run = RunConfig(steps=8, eval_every=50, ckpt_dir=str(tmp_path / "ck"),
+                    ckpt_every=4, rollback=2)
+    state, stepper = _setup()
+    faults.install([faults.FaultSpec(site="train.step_nan", kind="nan",
+                                     after=7)])  # the last chunk
+    state, loss = loop.run_loop(run, state, stepper)
+    assert math.isfinite(float(loss))
+    assert int(state.step) == 8
+
+
+def test_controller_validates_inputs(tmp_path):
+    from hyperspace_tpu.train.checkpoint import CheckpointManager
+
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        with pytest.raises(ValueError, match="max_rollbacks"):
+            RollbackController(ck, max_rollbacks=0)
+        with pytest.raises(ValueError, match="lr_backoff"):
+            RollbackController(ck, lr_backoff=0.0)

@@ -1,0 +1,429 @@
+"""Overload-safe serving: deadlines, bounded admission, the degradation
+ladder, the error taxonomy, and SIGTERM drain (docs/resilience.md)."""
+
+import io
+import json
+import os
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.manifolds import PoincareBall
+from hyperspace_tpu.resilience import faults
+from hyperspace_tpu.serve.batcher import RequestBatcher
+from hyperspace_tpu.serve.engine import QueryEngine
+from hyperspace_tpu.serve.errors import (DeadlineExceededError,
+                                         OverloadedError, error_response)
+from hyperspace_tpu.telemetry import registry as telem
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _engine(n=64, dim=4, seed=0):
+    rng = np.random.default_rng(seed)
+    table = np.asarray(PoincareBall(1.0).expmap0(
+        jnp.asarray(rng.standard_normal((n, dim)) * 0.3, jnp.float32)))
+    return QueryEngine(table, ("poincare", 1.0))
+
+
+# --- deadlines ----------------------------------------------------------------
+
+
+def test_expired_request_is_never_dispatched():
+    bat = RequestBatcher(_engine(), queue_max=8)
+    base = telem.default_registry().mark()
+    with pytest.raises(DeadlineExceededError):
+        bat.topk([1, 2, 3], 4, deadline_ms=1e-4)  # expired on arrival
+    delta = telem.default_registry().snapshot(baseline=base)
+    assert delta.get("serve/deadline_exceeded") == 1
+    # never dispatched late: no engine slots were spent on it
+    assert delta.get("serve/slots", 0) == 0
+    # failed requests observe no latency histograms — serve/e2e_ms
+    # stays the distribution of honestly answered requests
+    assert "hist/serve/e2e_ms" not in delta
+
+
+def test_result_computed_past_deadline_is_not_answered():
+    """A dispatch that overruns the deadline (injected 50 ms latency at
+    serve.dispatch) must answer deadline_exceeded — never hand back the
+    result as if it were on time.  The computed rows stay cached."""
+    bat = RequestBatcher(_engine(), queue_max=8)
+    faults.install([faults.FaultSpec(site="serve.dispatch",
+                                     kind="latency", ms=50.0)])
+    with pytest.raises(DeadlineExceededError, match="at completion"):
+        bat.topk([1, 2], 4, deadline_ms=25.0)
+    faults.clear()
+    # the work was not wasted: the same ids now answer from cache
+    base = telem.default_registry().mark()
+    idx, dist = bat.topk([1, 2], 4, deadline_ms=25.0)
+    assert idx.shape == (2, 4)
+    delta = telem.default_registry().snapshot(baseline=base)
+    assert delta.get("serve/cache_hit") == 2
+
+
+def test_deadline_default_vs_override():
+    bat = RequestBatcher(_engine(), queue_max=8, deadline_ms=1e-4)
+    with pytest.raises(DeadlineExceededError):
+        bat.topk([1], 4)  # server default applies
+    idx, _ = bat.topk([1], 4, deadline_ms=10_000.0)  # override wins
+    assert idx.shape == (1, 4)
+
+
+def test_no_deadline_is_default():
+    bat = RequestBatcher(_engine())
+    idx, _ = bat.topk([1], 4)
+    assert idx.shape == (1, 4)
+
+
+# --- bounded admission --------------------------------------------------------
+
+
+def test_full_queue_sheds_with_overloaded():
+    # down_after=3 keeps the ladder out of this test: one shed alone
+    # must not flip the mode (that interplay has its own test below)
+    bat = RequestBatcher(_engine(), queue_max=2, ladder_down_after=3)
+    # occupy the whole bound (as two in-flight concurrent callers would)
+    assert bat._admission.try_admit() is not None
+    assert bat._admission.try_admit() is not None
+    base = telem.default_registry().mark()
+    with pytest.raises(OverloadedError, match="queue_max=2"):
+        bat.topk([1], 4)
+    delta = telem.default_registry().snapshot(baseline=base)
+    assert delta.get("serve/shed") == 1
+    bat._admission.release()
+    bat._admission.release()
+    idx, _ = bat.topk([1], 4)  # room again: served
+    assert idx.shape == (1, 4)
+
+
+def test_concurrent_overload_sheds_some_serves_rest():
+    """Genuine concurrency: more threads than queue_max — every request
+    gets exactly one outcome (rows or a typed shed), none vanish."""
+    import threading
+
+    eng = _engine(n=256, dim=8)
+    bat = RequestBatcher(eng, queue_max=2, cache_size=0)
+    bat.topk([0], 8)  # warm the compile so in-flight spans overlap
+    results, errors = [], []
+    barrier = threading.Barrier(8)
+
+    def worker(i):
+        barrier.wait()
+        try:
+            results.append(bat.topk([i, i + 8, i + 16], 8))
+        except OverloadedError as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) + len(errors) == 8
+    assert results  # the bounded queue admitted at least one
+    assert bat._admission.inflight == 0  # every slot released
+
+
+# --- degradation ladder -------------------------------------------------------
+
+
+def test_ladder_steps_down_and_recovers_with_hysteresis():
+    eng = _engine()
+    bat = RequestBatcher(eng, queue_max=4, ladder_up_after=2)
+    bat.topk([1], 4)  # warm: id 1 is cache-servable while degraded
+    reg = telem.default_registry()
+    base = reg.mark()
+    # 3 held slots: the next request admits at pressure 3/4 >= high
+    tokens = [bat._admission.try_admit() for _ in range(3)]
+    assert all(t is not None for t in tokens)
+    bat.topk([1], 4)
+    assert bat._ladder.level == 1  # exact engine: level 1 IS cache-only
+    delta = reg.snapshot(baseline=base)
+    assert delta.get("serve/degraded") == 1
+    assert delta.get("serve/degrade_level") == 1
+    # recovery needs ladder_up_after consecutive calm observations
+    for _ in range(3):
+        bat._admission.release()
+    bat.topk([1], 4)              # calm 1 (id 1 is cached — servable
+    assert bat._ladder.level == 1  # even in cache-only mode)
+    bat.topk([1], 4)              # calm 2: recovered
+    assert bat._ladder.level == 0
+    delta = reg.snapshot(baseline=base)
+    assert delta.get("serve/degrade_recovered") == 1
+    assert delta.get("serve/degrade_level") == 0
+
+
+def test_cache_only_serves_hits_sheds_cold():
+    bat = RequestBatcher(_engine(), queue_max=4)
+    idx_full, dist_full = bat.topk([3, 4], 5)      # warm the cache
+    bat._ladder._level = len(bat._modes) - 1       # force terminal level
+    idx, dist = bat.topk([3, 4], 5)                # hits: still served
+    np.testing.assert_array_equal(idx, idx_full)
+    with pytest.raises(OverloadedError, match="cache-only"):
+        bat.topk([9, 10], 5)                       # cold: shed
+    with pytest.raises(OverloadedError, match="uncached"):
+        bat.score([0], [1])                        # score has no cache
+
+
+def test_single_caller_exerts_no_pressure():
+    """The blocking CLI loop (one request in flight, ever) must never
+    degrade, whatever queue_max is: a lone caller's pressure is 0."""
+    bat = RequestBatcher(_engine(), queue_max=1, ladder_down_after=1)
+    for i in range(6):
+        bat.topk([i], 4)
+    assert bat._ladder.level == 0
+
+
+def _clustered_ivf_engine(nprobe=4):
+    from hyperspace_tpu.serve.index import IVF_MIN_TABLE_ROWS, build_index
+
+    n = IVF_MIN_TABLE_ROWS  # smallest table the probe path serves
+    rng = np.random.default_rng(1)
+    centers = rng.standard_normal((32, 4)) * 0.25
+    vv = (centers[rng.integers(0, 32, size=n)]
+          + rng.standard_normal((n, 4)) * 0.05)
+    table = np.asarray(PoincareBall(1.0).expmap0(
+        jnp.asarray(vv, jnp.float32)))
+    idx = build_index(table, ("poincare", 1.0), 16, iters=4, seed=0,
+                      balance=3.0)
+    return QueryEngine(table, ("poincare", 1.0), index=idx, nprobe=nprobe)
+
+
+def test_ivf_ladder_narrows_nprobe_before_cache_only():
+    """On a probing engine the ladder steps the probe width down toward
+    1 before giving up quality entirely — and degraded-width rows never
+    cross-contaminate the full-width cache."""
+    eng = _clustered_ivf_engine(nprobe=4)
+    bat = RequestBatcher(eng, queue_max=4)
+    assert bat._modes == [None, 2, 1, "cache_only"]
+    ids = [5, 17, 40]
+    idx_full, _ = bat.topk(ids, 4)
+    bat._ladder._level = 1  # degraded: effective nprobe 2
+    idx_deg, dist_deg = bat.topk(ids, 4)
+    ref_i, ref_d = (np.asarray(a) for a in
+                    eng.topk_neighbors(np.asarray(ids, np.int32), 4,
+                                       nprobe=2))
+    np.testing.assert_array_equal(idx_deg, ref_i)
+    np.testing.assert_allclose(dist_deg, ref_d)
+    # back at full quality the full-width rows come back — the degraded
+    # rows were cached under their own scan signature
+    bat._ladder._level = 0
+    base = telem.default_registry().mark()
+    idx_back, _ = bat.topk(ids, 4)
+    np.testing.assert_array_equal(idx_back, idx_full)
+    delta = telem.default_registry().snapshot(baseline=base)
+    assert delta.get("serve/cache_hit") == len(ids)  # full rows cached
+
+
+def test_nprobe_override_rejected_on_exact_engine():
+    eng = _engine()
+    with pytest.raises(ValueError, match="exact"):
+        eng.topk_neighbors(np.asarray([0], np.int32), 4, nprobe=2)
+    probing = _clustered_ivf_engine(nprobe=4)
+    with pytest.raises(ValueError, match="out of range"):
+        probing.topk_neighbors(np.asarray([0], np.int32), 4, nprobe=9)
+
+
+# --- error taxonomy + CLI ----------------------------------------------------
+
+
+def test_error_response_mapping():
+    assert error_response(OverloadedError("x"))["error"]["kind"] == \
+        "overloaded"
+    assert error_response(DeadlineExceededError("x"))["error"]["kind"] \
+        == "deadline_exceeded"
+    assert error_response(ValueError("x"))["error"]["kind"] == \
+        "validation"
+    assert error_response(RuntimeError("x"))["error"]["kind"] == \
+        "internal"
+
+
+@pytest.fixture(scope="module")
+def cli_artifact(tmp_path_factory):
+    from hyperspace_tpu.cli import serve as S
+    from hyperspace_tpu.models import poincare_embed as pe
+    from hyperspace_tpu.train.checkpoint import CheckpointManager
+
+    tmp = tmp_path_factory.mktemp("overload_cli")
+    cfg = pe.PoincareEmbedConfig(num_nodes=30, dim=3, batch_size=16,
+                                 neg_samples=4, burnin_steps=0)
+    state, opt = pe.init_state(cfg, seed=0)
+    pairs = jnp.asarray(
+        np.random.default_rng(0).integers(0, 30, (60, 2), np.int64))
+    state, _ = pe.train_step(cfg, opt, state, pairs)
+    ckpt = str(tmp / "ckpt")
+    with CheckpointManager(ckpt) as ck:
+        ck.save(1, state, force=True)
+    art = str(tmp / "artifact")
+    assert S.main(["export", f"ckpt={ckpt}", f"out={art}",
+                   "workload=poincare", "c=1.0"]) == 0
+    return art
+
+
+def test_serve_loop_error_kinds(cli_artifact):
+    """Every failed line answers a typed error.kind; every line gets
+    exactly one response — nothing silently dropped."""
+    from hyperspace_tpu.cli import serve as S
+
+    cfg = S.apply_overrides(S.ServeConfig(),
+                            {"artifact": cli_artifact, "queue_max": "4"})
+    lines = "\n".join([
+        "this is not json",
+        json.dumps({"op": "nope"}),
+        json.dumps({"op": "topk", "ids": [0.7], "k": 2}),
+        json.dumps({"op": "topk", "ids": [0], "k": 2,
+                    "deadline_ms": 1e-4}),
+        json.dumps({"op": "topk", "ids": [0], "k": 2,
+                    "deadline_ms": "soon"}),
+        json.dumps({"op": "topk", "ids": [0, 1], "k": 2}),
+    ]) + "\n"
+    out = io.StringIO()
+    result = S.run_serve(cfg, stdin=io.StringIO(lines), stdout=out)
+    resp = [json.loads(l) for l in out.getvalue().strip().splitlines()]
+    assert len(resp) == 6  # one response per line, exactly
+    kinds = [r["error"]["kind"] for r in resp[:5]]
+    assert kinds == ["parse", "validation", "validation",
+                     "deadline_exceeded", "validation"]
+    assert "neighbors" in resp[5]
+    assert result["served"] == 1
+    assert result["queue_max"] == 4 and result["degrade_mode"] == "full"
+
+
+def test_serve_loop_overloaded_kind(cli_artifact, monkeypatch):
+    from hyperspace_tpu.cli import serve as S
+
+    cfg = S.apply_overrides(S.ServeConfig(), {"artifact": cli_artifact})
+    monkeypatch.setattr(
+        S, "_handle",
+        lambda *_a: (_ for _ in ()).throw(OverloadedError("queue full")))
+    out = io.StringIO()
+    S.run_serve(cfg, stdin=io.StringIO(
+        json.dumps({"op": "topk", "ids": [0], "k": 2}) + "\n"),
+        stdout=out)
+    resp = json.loads(out.getvalue().strip())
+    assert resp["error"]["kind"] == "overloaded"
+
+
+def test_serve_loop_ioerror_answers_internal(cli_artifact):
+    """A per-request IO failure (the injected serve.dispatch ioerror
+    chaos fault) answers error.kind=internal and the loop KEEPS
+    serving — one request's IO trouble must not kill the server."""
+    from hyperspace_tpu.cli import serve as S
+
+    cfg = S.apply_overrides(S.ServeConfig(), {"artifact": cli_artifact})
+    faults.install([faults.FaultSpec(site="serve.dispatch",
+                                     kind="ioerror")])
+    lines = "\n".join([
+        json.dumps({"op": "topk", "ids": [5], "k": 2}),   # fault fires
+        json.dumps({"op": "topk", "ids": [6], "k": 2}),   # loop survives
+    ]) + "\n"
+    out = io.StringIO()
+    result = S.run_serve(cfg, stdin=io.StringIO(lines), stdout=out)
+    resp = [json.loads(l) for l in out.getvalue().strip().splitlines()]
+    assert len(resp) == 2
+    assert resp[0]["error"]["kind"] == "internal"
+    assert "neighbors" in resp[1]
+    assert result["served"] == 1
+
+
+def test_degraded_underfill_is_overloaded(monkeypatch):
+    """An under-filled probe at a SERVER-narrowed width is an overload
+    symptom, not the client's validation error (the taxonomy's whole
+    point: clients branch on kind)."""
+    eng = _clustered_ivf_engine(nprobe=4)
+    bat = RequestBatcher(eng, queue_max=4, cache_size=0)
+    bat._ladder._level = 2  # degraded: effective nprobe 1
+
+    def underfilled(*a, **kw):
+        raise ValueError(
+            "IVF probe under-filled: some query's 1 nearest cell(s) "
+            "hold fewer than k=4 reachable rows")
+
+    monkeypatch.setattr(eng, "topk_neighbors", underfilled)
+    with pytest.raises(OverloadedError, match="degraded probe width"):
+        bat.topk([5, 17], 4)
+
+
+def test_sigterm_drains_idle_server(cli_artifact, capsys):
+    """SIGTERM to a server blocked on a SILENT (but open) stdin pipe
+    must still drain within the poll interval — the select-polling
+    reader exists exactly for this; a plain readline would block until
+    the client's next line (PEP 475 retries the interrupted read)."""
+    import threading
+
+    from hyperspace_tpu.cli import serve as S
+
+    cfg = S.apply_overrides(S.ServeConfig(), {"artifact": cli_artifact})
+    r_fd, w_fd = os.pipe()
+    try:
+        with open(w_fd, "w") as w:
+            w.write(json.dumps({"op": "topk", "ids": [0], "k": 2}) + "\n")
+            w.flush()
+            # the write end STAYS OPEN and silent: no EOF, no next line
+            timer = threading.Timer(
+                1.0, lambda: os.kill(os.getpid(), signal.SIGTERM))
+            timer.start()
+            out = io.StringIO()
+            with open(r_fd, closefd=False) as r:
+                result = S.run_serve(cfg, stdin=r, stdout=out)
+            timer.cancel()
+    finally:
+        os.close(r_fd)
+    resp = [json.loads(l) for l in out.getvalue().strip().splitlines()]
+    assert len(resp) == 1 and "neighbors" in resp[0]
+    assert result["drained"] is True and result["served"] == 1
+    assert "[serve] drained" in capsys.readouterr().err
+
+
+def test_sigterm_drains_gracefully(cli_artifact, capsys):
+    """SIGTERM mid-stream: the in-flight request answers, admission
+    stops (later lines unread), the drain notice hits stderr, and the
+    closing stats return normally."""
+    from hyperspace_tpu.cli import serve as S
+
+    cfg = S.apply_overrides(S.ServeConfig(), {"artifact": cli_artifact})
+
+    def lines():
+        yield json.dumps({"op": "topk", "ids": [0], "k": 2}) + "\n"
+        os.kill(os.getpid(), signal.SIGTERM)
+        yield json.dumps({"op": "topk", "ids": [1], "k": 2}) + "\n"
+        yield json.dumps({"op": "topk", "ids": [2], "k": 2}) + "\n"
+
+    out = io.StringIO()
+    result = S.run_serve(cfg, stdin=lines(), stdout=out)
+    resp = [json.loads(l) for l in out.getvalue().strip().splitlines()]
+    assert len(resp) == 1 and "neighbors" in resp[0]
+    assert result["served"] == 1 and result["drained"] is True
+    assert "[serve] drained" in capsys.readouterr().err
+
+
+def test_cli_flag_validation(cli_artifact):
+    from hyperspace_tpu.cli import serve as S
+
+    with pytest.raises(SystemExit, match="queue_max"):
+        S.main(["query", f"artifact={cli_artifact}", "ids=0", "k=2",
+                "queue_max=-1"])
+    with pytest.raises(SystemExit, match="chaos"):
+        S.main(["query", f"artifact={cli_artifact}", "ids=0", "k=2",
+                "chaos=bogus"])
+
+
+def test_cli_chaos_latency_roundtrip(cli_artifact, capsys):
+    """chaos= on the serve CLI arms the dispatch site; the run reports
+    fired faults and still answers (latency only delays)."""
+    from hyperspace_tpu.cli import serve as S
+
+    rc = S.main(["query", f"artifact={cli_artifact}", "ids=0,1", "k=2",
+                 "chaos=serve.dispatch:latency:ms=5"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["chaos"]["fired"] == 1
+    assert not faults.active()  # cleared on the way out

@@ -74,6 +74,14 @@ def _stub_precision(repeats=1):
                        "serve_scan_ms": {"f32": 3.0, "bf16": 2.0}}}
 
 
+def _stub_resilience(repeats=1):
+    return {"metric": "resilience_ok", "value": 1, "unit": "bool",
+            "vs_baseline": None,
+            "detail": {"chaos_train": {"rollbacks": 1, "recovered": True},
+                       "overload": {"shed_rate": 0.1,
+                                    "ladder_recovered": True}}}
+
+
 def test_auto_hgcn_failure_reports_error(bench_mod, monkeypatch, capsys):
     def boom(repeats=1, **kw):
         raise RuntimeError("synthetic hgcn failure")
@@ -83,6 +91,7 @@ def test_auto_hgcn_failure_reports_error(bench_mod, monkeypatch, capsys):
     monkeypatch.setattr(bench_mod, "bench_sampled", _stub_sampled)
     monkeypatch.setattr(bench_mod, "bench_serve", _stub_serve)
     monkeypatch.setattr(bench_mod, "bench_precision", _stub_precision)
+    monkeypatch.setattr(bench_mod, "bench_resilience", _stub_resilience)
     monkeypatch.setattr(sys, "argv", ["bench.py", "--metric", "auto"])
     with pytest.raises(SystemExit) as ei:
         bench_mod.main()
@@ -111,6 +120,7 @@ def test_auto_success_keeps_hgcn_headline(bench_mod, monkeypatch, capsys):
     monkeypatch.setattr(bench_mod, "bench_sampled", _stub_sampled)
     monkeypatch.setattr(bench_mod, "bench_serve", _stub_serve)
     monkeypatch.setattr(bench_mod, "bench_precision", _stub_precision)
+    monkeypatch.setattr(bench_mod, "bench_resilience", _stub_resilience)
     monkeypatch.setattr(sys, "argv", ["bench.py", "--metric", "auto"])
     bench_mod.main()
     captured = capsys.readouterr().out
@@ -140,6 +150,13 @@ def test_auto_success_keeps_hgcn_headline(bench_mod, monkeypatch, capsys):
     assert out["detail"]["serve_latency_ms"]["b8"] == {
         "n": 2, "p50": 1.2, "p95": 2.0, "p99": 2.2}
     assert out["detail"]["precision_train_ms"] == {"f32": 2.0, "bf16": 1.4}
+    # the resilience leg (PR 9): the recovery verdict + shed-rate
+    # column ride the artifact and the compact line
+    assert full["detail"]["resilience"]["ok"] == 1
+    assert full["detail"]["resilience"]["overload"]["shed_rate"] == 0.1
+    assert out["detail"]["resilience_ok"] == 1
+    assert out["detail"]["shed_rate"] == 0.1
+    assert out["detail"]["chaos_rollbacks"] == 1
 
 
 def test_explicit_poincare_failure_is_error(bench_mod, monkeypatch, capsys):
@@ -246,8 +263,8 @@ def test_budget_zero_skips_all_legs_but_emits(bench_mod, monkeypatch, capsys):
     # headline survives; every optional leg is reported skipped, not lost
     assert full["metric"] == "hgcn_samples_per_sec_per_chip"
     assert set(full["detail"]["skipped_legs"]) == {
-        "poincare", "hgcn_sampled", "serve_qps", "precision", "realistic",
-        "workloads", "use_att_arm"}
+        "poincare", "hgcn_sampled", "serve_qps", "precision",
+        "resilience", "realistic", "workloads", "use_att_arm"}
     assert full["detail"]["budget_s"] == 0
     assert _last_json(captured)["metric"] == "hgcn_samples_per_sec_per_chip"
 
