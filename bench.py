@@ -427,6 +427,62 @@ def bench_serve(repeats: int = 2) -> dict:
             delta.get("serve/padded_waste", 0) / max(slots, 1), 4),
     }
 
+    # --- fused_vs_unfused (r12): the Pallas scan-top-k kernel
+    # (scan_mode=fused, kernels/scan_topk.py — distance tiles in
+    # registers, running top-k in the kernel carry) against the default
+    # two-stage scan: SAME 50k table, SAME bucket ladder, paired ids.
+    # Per-bucket per-mode failure degrades to a detail error (the r10
+    # ivf_error pattern) instead of sinking the leg; the headline
+    # serve_fused_speedup is the largest bucket's fused/two_stage qps
+    # ratio (where the fused kernel matters most).  On CPU both run XLA
+    # (the fused path is the kernel's twin) — the ratio there tracks
+    # the twin's merge loop, not the TPU win (docs/benchmarks.md r12).
+    def _fused_leg():
+        out = {"k": k, "buckets": {}}
+        engines = {}
+        for m in ("two_stage", "fused"):
+            engines[m] = QueryEngine(table, ("poincare", 1.0), scan_mode=m)
+        out["chunk_rows"] = {m: e.chunk_rows for m, e in engines.items()}
+        for b in bat.buckets:
+            ids = rng.integers(0, n, size=b).astype(np.int32)
+            row = {}
+            for m, e in engines.items():
+                try:
+                    _, dd = e.topk_neighbors(ids, k)  # compile + warm
+                    jax.device_get(dd)
+                    ts = []
+                    for _ in range(max(2, repeats)):
+                        t0 = time.perf_counter()
+                        _, dd = e.topk_neighbors(ids, k)
+                        jax.device_get(dd)
+                        ts.append(time.perf_counter() - t0)
+                    row[m] = round(b / min(ts), 1)
+                except Exception as err:  # noqa: BLE001 — one mode
+                    # failing must not discard the other mode's reading
+                    # or the remaining buckets; the deadline _LegTimeout
+                    # is a BaseException and still flies through
+                    row[f"{m}_error"] = repr(err)
+            if row.get("two_stage") and row.get("fused"):
+                row["ratio"] = round(row["fused"] / row["two_stage"], 3)
+            out["buckets"][f"b{b}"] = row
+        # the headline is pinned to the LARGEST bucket (where the fused
+        # kernel matters most) and says so — a failed largest bucket
+        # leaves it absent rather than silently substituting another
+        # bucket's ratio into the gated trend
+        top = bat.buckets[-1]
+        ratio = out["buckets"][f"b{top}"].get("ratio")
+        if ratio is not None:
+            out["serve_fused_speedup"] = ratio
+            out["speedup_bucket"] = top
+        return out
+
+    try:
+        detail["fused_vs_unfused"] = _fused_leg()
+    except Exception as e:  # noqa: BLE001 — the fused A/B must not
+        # sink the serve_qps reading (the deadline _LegTimeout is a
+        # BaseException and still flies through)
+        detail["fused_error"] = repr(e)
+
     # --- IVF recall leg (r10): recall@10 vs the exact engine per
     # nprobe, and the headline **qps at recall@10 >= 0.99** (ROADMAP
     # item 2's contract).  The table here is CLUSTER-STRUCTURED (512
@@ -765,6 +821,13 @@ _COMPACT_FIELDS = (
     # the headline (--metric serve)
     ("serve_qps_r99", ("detail", "serve", "ivf", "qps_at_recall99")),
     ("qps_r99", ("detail", "ivf", "qps_at_recall99")),
+    # fused/two_stage qps ratio at the largest bucket (r12): first path
+    # is auto mode's nested serve leg, second fires when bench_serve IS
+    # the headline (--metric serve)
+    ("serve_fused_speedup",
+     ("detail", "serve", "fused_vs_unfused", "serve_fused_speedup")),
+    ("fused_speedup",
+     ("detail", "fused_vs_unfused", "serve_fused_speedup")),
     ("precision_train_ms", ("detail", "precision", "train_step_ms")),
     ("precision_serve_ms", ("detail", "precision", "serve_scan_ms")),
     # failure-domain leg (PR 9): chaos recovery + the shed-rate column

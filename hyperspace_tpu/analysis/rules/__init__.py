@@ -5,6 +5,7 @@ module docstrings and docs/static-analysis.md for the catalog).
 """
 
 from hyperspace_tpu.analysis.rules.catalog import TelemetryCatalogRule
+from hyperspace_tpu.analysis.rules.distmat import MaterializedDistmatRule
 from hyperspace_tpu.analysis.rules.donation import DonationHazardRule
 from hyperspace_tpu.analysis.rules.exceptions import SwallowBaseExceptionRule
 from hyperspace_tpu.analysis.rules.flags import FlagDocDriftRule
@@ -21,6 +22,7 @@ ALL_RULES = (
     TracerLeakRule,
     SwallowBaseExceptionRule,
     UnboundedRetryRule,
+    MaterializedDistmatRule,
     PrecisionLiteralRule,
     TelemetryCatalogRule,
     FlagDocDriftRule,

@@ -1,0 +1,133 @@
+"""materialized-distmat: ``lax.top_k`` over a materialized distance matrix.
+
+Historical incident: the hazard class PR 10 retired.  Before the fused
+scan-top-k kernel (``kernels/scan_topk.py``), the obvious way to rank
+neighbors was ``d = pdist(q, table, ...); lax.top_k(-d, k)`` — compute
+the full [B, N] distance matrix, write it to HBM, read it back, sort.
+At serve scale that materialization IS the latency (the scan is
+HBM-bandwidth-bound, docs/kernels.md); the engine's chunked scans and
+the fused kernel exist precisely so the full-table distance matrix
+never lands in memory.  A new call site re-growing the pattern outside
+``kernels/`` (where the tiled implementations legitimately sort their
+own in-register tiles) should be caught at lint time.
+
+What fires: a call to ``lax.top_k`` / ``jax.lax.top_k`` whose ranked
+operand (directly, under unary ``-``, or via a name bound from one —
+tracked file-wide in SOURCE order, latest binding before the call
+wins: rebinding the name to anything else clears it) is
+
+- a call to a pairwise-distance-matrix producer: ``pdist`` /
+  ``poincare_pdist`` / ``lorentz_pdist`` / ``cdist`` (import-alias
+  resolved, bare or dotted), or
+- a ``.dist(...)`` call using the O(N²) broadcast idiom — two or more
+  arguments each carrying a ``None``-axis subscript
+  (``x[:, None, :]`` × ``y[None, :, :]``).
+
+Chunked scans stay clean: their ``top_k`` operands come from tile
+closures / stacked candidate buffers, not from a distmat producer.
+Files under ``kernels/`` are out of scope (the fused kernels are the
+sanctioned home of tile-level sorting).  Fix: route the ranking through
+``serve/engine.py``'s chunked scans or ``kernels/scan_topk.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+
+_PRODUCERS = ("pdist", "poincare_pdist", "lorentz_pdist", "cdist")
+_TOPK = ("lax.top_k", "jax.lax.top_k")
+
+
+def _basename(resolved: Optional[str]) -> str:
+    return (resolved or "").rsplit(".", 1)[-1]
+
+
+def _has_none_axis(node: ast.AST) -> bool:
+    """Does the expression carry a ``[..., None, ...]`` subscript — the
+    broadcast half of the pairwise-distance idiom?"""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Subscript):
+            continue
+        sl = sub.slice
+        elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+        for e in elts:
+            if isinstance(e, ast.Constant) and e.value is None:
+                return True
+    return False
+
+
+def _is_distmat_call(ctx: FileContext, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    resolved = ctx.resolve(node.func)
+    if _basename(resolved) in _PRODUCERS:
+        return True
+    # m.dist(x[:, None, :], y[None, :, :]) — the all-pairs broadcast
+    if (isinstance(node.func, ast.Attribute) and node.func.attr == "dist"
+            and sum(1 for a in node.args if _has_none_axis(a)) >= 2):
+        return True
+    return False
+
+
+def _ranked_operand(node: ast.Call) -> Optional[ast.AST]:
+    if not node.args:
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.UnaryOp) and isinstance(arg.op, ast.USub):
+        return arg.operand
+    return arg
+
+
+class MaterializedDistmatRule(Rule):
+    id = "materialized-distmat"
+    severity = "warning"
+    summary = ("lax.top_k over a materialized full-table distance "
+               "matrix (pdist / broadcast .dist) outside kernels/ — "
+               "use the chunked engine scans or kernels/scan_topk.py")
+
+    def check_file(self, ctx: FileContext):
+        rel = ctx.rel.replace("\\", "/")
+        if "/kernels/" in f"/{rel}":
+            return []
+        findings = []
+        # scope = the whole file: taint tracking is per assigned name,
+        # one step deep (d = pdist(...); top_k(-d)) — redefinitions
+        # overwrite, so a name rebound to something else goes clean.
+        # Events are processed in SOURCE order (ast.walk is
+        # breadth-first: a nested function's assigns would otherwise
+        # clear/set taint out of order relative to module-level sites)
+        events = []
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                events.append((node.lineno, node.col_offset, "assign",
+                               node))
+            elif (isinstance(node, ast.Call)
+                  and ctx.resolve(node.func) in _TOPK):
+                events.append((node.lineno, node.col_offset, "topk", node))
+        tainted: dict[str, int] = {}
+        for _, _, kind, node in sorted(events, key=lambda e: (e[0], e[1])):
+            if kind == "assign":
+                tgt = node.targets[0]
+                if _is_distmat_call(ctx, node.value):
+                    tainted[tgt.id] = node.lineno
+                else:
+                    tainted.pop(tgt.id, None)
+                continue
+            arg = _ranked_operand(node)
+            if arg is None:
+                continue
+            hit = _is_distmat_call(ctx, arg) or (
+                isinstance(arg, ast.Name) and arg.id in tainted)
+            if hit:
+                findings.append(self.finding(
+                    ctx, node,
+                    "lax.top_k ranks a materialized full-table distance "
+                    "matrix — the [B, N] tile is written to and re-read "
+                    "from HBM just to be sorted; stream it instead "
+                    "(serve/engine.py chunked scans, or the fused "
+                    "kernels/scan_topk.py kernel)"))
+        return findings

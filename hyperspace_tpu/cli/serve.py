@@ -93,7 +93,9 @@ class ServeConfig:
     # 0 = single-device (no mesh), -1 = all local devices, N = first N.
     # A 1-device mesh runs the single-device program (bit-compatible).
     mesh: int = 0
-    scan_mode: str = "two_stage"  # two_stage | carry (A/B; docs/serving.md)
+    # two_stage | carry | fused (fused = the Pallas scan-top-k kernel,
+    # rank-identical answers; docs/serving.md, docs/kernels.md)
+    scan_mode: str = "two_stage"
     # table-scan precision: f32 (default, bit-identical) | bf16 (scan a
     # bf16 table copy, rescore candidates in f32 — docs/precision.md)
     precision: str = "f32"

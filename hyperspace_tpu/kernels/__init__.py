@@ -16,6 +16,11 @@ from hyperspace_tpu.kernels.distmat import lorentz_pdist, poincare_pdist
 from hyperspace_tpu.kernels.attention import flash_attention
 from hyperspace_tpu.kernels.hyplinear import hyp_linear
 from hyperspace_tpu.kernels.mlr import hyp_mlr
+# the fused scan-top-k lives at hyperspace_tpu.kernels.scan_topk
+# (module-level API: scan_topk / scan_topk_cand / supports /
+# fused_tile_rows) — NOT re-exported here: the entry point shares the
+# module's name, and a function attribute would shadow the submodule
+from hyperspace_tpu.kernels import scan_topk  # noqa: F401 — submodule export
 from hyperspace_tpu.kernels.pointwise import (
     expmap,
     expmap0,
@@ -40,4 +45,5 @@ __all__ = [
     "hyp_mlr",
     "hyp_linear",
     "flash_attention",
+    "scan_topk",
 ]

@@ -46,6 +46,20 @@ class PoincareEmbedConfig:
     # gathered, updated and scattered back (SURVEY.md §7 hard-part #2) —
     # O(B·(2+K)·d) update work instead of O(N·d)
     sparse: bool = False
+    # negative sampling policy for the DENSE step paths:
+    #   "uniform" (default, bit-identical to the pre-mining build) draws
+    #   neg_samples ids uniformly per row;
+    #   "mined" draws a shared candidate pool of mine_pool ids uniformly,
+    #   then keeps each row's neg_samples NEAREST pool members (sampled
+    #   hard-negative mining) via the fused scan-top-k kernel
+    #   (kernels/scan_topk.py; XLA twin on CPU) — the mining distances
+    #   are stop_gradient'ed, so only the loss's own distance terms
+    #   train.  Collisions with the row's u/v are masked by the loss as
+    #   before.  The host-planned sparse paths keep uniform draws (their
+    #   negatives are planned before the embeddings exist).
+    neg_mode: str = "uniform"
+    # candidate-pool size for neg_mode="mined" (0 = max(4*neg_samples, 64))
+    mine_pool: int = 0
     # mixed-precision policy (hyperspace_tpu/precision.py).  This
     # workload is ALL boundary-sensitive math: the table is a master
     # parameter (policy: f32), and the per-step compute is the ball
@@ -122,25 +136,86 @@ def loss_fn(
     return _ranking_loss(u, table[cand], u_idx, v_idx, neg_idx, c)
 
 
+def _mine_negatives(cfg: PoincareEmbedConfig, table: jax.Array,
+                    u_idx: jax.Array, k_neg: jax.Array) -> jax.Array:
+    """Sampled hard-negative mining (``neg_mode="mined"``): draw a
+    shared uniform candidate pool, keep each row's ``neg_samples``
+    nearest pool members under the ball metric — one fused scan-top-k
+    over the pool slab (kernels/scan_topk.py), no [B, pool] distance
+    matrix in HBM on the kernel path.  Everything is stop_gradient'ed:
+    mining picks indices, the loss computes its own distances."""
+    from hyperspace_tpu.kernels import scan_topk as fused_kernel
+
+    pool = cfg.mine_pool or max(4 * cfg.neg_samples, 64)
+    pool_idx = jax.random.randint(k_neg, (pool,), 0, cfg.num_nodes)
+    tbl = jax.lax.stop_gradient(table)
+    _, sel = fused_kernel.scan_topk(
+        tbl[pool_idx], tbl[u_idx], jnp.zeros_like(u_idx), 0,
+        spec=("poincare", cfg.c), k=cfg.neg_samples, n=pool,
+        exclude_self=False)
+    # sel slots are pool positions (always valid: neg_samples <= pool)
+    return pool_idx[sel]                                  # [B, K]
+
+
+def _check_neg_mode(cfg: PoincareEmbedConfig, *, dense: bool):
+    if cfg.neg_mode not in ("uniform", "mined"):
+        raise ValueError(
+            f"neg_mode must be 'uniform' or 'mined'; got {cfg.neg_mode!r}")
+    if cfg.neg_mode == "mined":
+        if not dense:
+            raise ValueError(
+                "neg_mode='mined' needs the dense step paths (mining "
+                "reads the live table; the host-planned sparse paths "
+                "draw their negatives before the embeddings exist) — "
+                "drop sparse=true or neg_mode")
+        if not 0 < cfg.neg_samples <= (cfg.mine_pool
+                                       or max(4 * cfg.neg_samples, 64)):
+            raise ValueError(
+                f"mine_pool={cfg.mine_pool} must hold at least "
+                f"neg_samples={cfg.neg_samples} candidates")
+        # mining has NO two-stage fallback (it IS the fused kernel), so
+        # the kernel's hard caps must fail here, at config time, with a
+        # config-shaped message — not mid-training from inside jit
+        from hyperspace_tpu.kernels import scan_topk as fused_kernel
+
+        if not fused_kernel.supports(("poincare", cfg.c),
+                                     k=cfg.neg_samples, dim=cfg.dim):
+            raise ValueError(
+                f"neg_mode='mined' mines through the fused scan-top-k "
+                f"kernel, which caps neg_samples at "
+                f"{fused_kernel.FUSED_MAX_K} and dim at "
+                f"{fused_kernel.FUSED_MAX_DIM}; got neg_samples="
+                f"{cfg.neg_samples}, dim={cfg.dim} — lower them or "
+                "drop neg_mode")
+
+
 def _dense_step_body(
     cfg: PoincareEmbedConfig,
     opt,
     state: TrainState,
     pairs: jax.Array,
 ) -> tuple[TrainState, jax.Array]:
-    """Un-jitted dense step body: device-side batch + negative sampling,
-    loss, grad, whole-table Riemannian update.  Shared verbatim by
-    :func:`train_step` (one dispatch per step) and
-    :func:`train_epoch_scan` (one dispatch per epoch) so the two
-    trajectories are the same computation."""
+    """Un-jitted dense step body: device-side batch + negative sampling
+    (uniform, or sampled hard-negative mining under ``neg_mode="mined"``
+    — :func:`_mine_negatives`), loss, grad, whole-table Riemannian
+    update.  Shared verbatim by :func:`train_step` (one dispatch per
+    step) and :func:`train_epoch_scan` (one dispatch per epoch) so the
+    two trajectories are the same computation."""
+    # trace-time and free: direct train_step/train_epoch_scan callers
+    # (bench, tests) get the same config-shaped errors make_train_step
+    # raises — a bad mined config must never surface kernel internals
+    _check_neg_mode(cfg, dense=True)
     key, k_batch, k_neg = jax.random.split(state.key, 3)
     num_pairs = pairs.shape[0]
     rows = jax.random.randint(k_batch, (cfg.batch_size,), 0, num_pairs)
     batch = pairs[rows]  # [B, 2]
     u_idx, v_idx = batch[:, 0], batch[:, 1]
-    neg_idx = jax.random.randint(
-        k_neg, (cfg.batch_size, cfg.neg_samples), 0, cfg.num_nodes
-    )
+    if cfg.neg_mode == "mined":
+        neg_idx = _mine_negatives(cfg, state.table, u_idx, k_neg)
+    else:
+        neg_idx = jax.random.randint(
+            k_neg, (cfg.batch_size, cfg.neg_samples), 0, cfg.num_nodes
+        )
     loss, grads = jax.value_and_grad(loss_fn)(state.table, u_idx, v_idx, neg_idx, cfg.c)
     updates, opt_state = opt.update(grads, state.opt_state, state.table)
     table = optax.apply_updates(state.table, updates)
@@ -206,6 +281,9 @@ def train_step_sparse(
     uses the global step count.  For rsgd the sparse step is mathematically
     identical to the dense one (untouched rows: expmap(x, 0) = x).
     """
+    # a mined config reaching the sparse step directly would otherwise
+    # silently train on uniform negatives — reject like make_train_step
+    _check_neg_mode(cfg, dense=False)
     key, k_batch, k_neg = jax.random.split(state.key, 3)
     num_pairs = pairs.shape[0]
     rows_sel = jax.random.randint(k_batch, (cfg.batch_size,), 0, num_pairs)
@@ -261,6 +339,7 @@ def train_step_sparse(
 
 def make_train_step(cfg: PoincareEmbedConfig):
     """The configured step function: ``f(cfg, opt, state, pairs)``."""
+    _check_neg_mode(cfg, dense=not cfg.sparse)
     return train_step_sparse if cfg.sparse else train_step
 
 
@@ -338,6 +417,7 @@ def plan_sparse_steps(cfg: PoincareEmbedConfig, pairs, steps: int,
     """Draw ``steps`` batches + negatives on host and plan their indices."""
     import numpy as np
 
+    _check_neg_mode(cfg, dense=False)
     rng = np.random.default_rng(seed)
     pairs = np.asarray(pairs)
     b, k = cfg.batch_size, cfg.neg_samples

@@ -407,7 +407,7 @@ class RequestBatcher:
                 # one — or at a different width, through a different
                 # index, or vice versa
                 prec = self.engine.precision
-                scan = (("ivf", nprobe_ov, self.engine.index.fingerprint)
+                scan = (self.engine.scan_signature_for(nprobe_ov)
                         if nprobe_ov is not None
                         else self.engine.scan_signature)
                 keyf = lambda qid: (fp, qid, k, exclude_self, prec, scan)
@@ -583,6 +583,7 @@ class RequestBatcher:
             # which engine answered: "exact" or "ivf" (+ nprobe) — the
             # serve CLI stats line must identify an approximate server
             "scan_strategy": self.engine.scan_strategy,
+            "scan_mode": self.engine.scan_mode,
             "nprobe": self.engine.nprobe,
             # overload safety (docs/resilience.md): queue bound, shed /
             # deadline counts, and the ladder's current level+mode —

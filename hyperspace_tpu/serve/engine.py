@@ -24,7 +24,16 @@ Mechanics:
   N — ``tile_budget`` picks the chunk.  The table is zero-padded ONCE at
   engine build to a chunk multiple; padded rows are masked to +inf
   distance by index, so they can never appear in a result.
-- **Two scan strategies** (``scan_mode``).  The default ``two_stage``
+- **Three scan strategies** (``scan_mode``).  ``fused`` dispatches the
+  chunk walk to the Pallas scan-top-k kernel
+  (``kernels/scan_topk.py``; XLA twin on CPU): distance tiles are
+  computed in-register and the running per-row top-k lives in the
+  kernel carry, so the distance matrix never touches HBM and the
+  per-chunk ``lax.top_k`` + post-scan merge disappear — the
+  flash-attention trade applied to retrieval.  Results are
+  rank-identical to the default (tested on every supported spec);
+  product manifolds and oversized k/dim fall back to the two-stage
+  path bit-identically.  The default ``two_stage``
   takes a per-chunk ``lax.top_k`` over the [B, chunk] tile only (k
   candidates per chunk, stacked by the scan) and merges the
   [B, nchunks·k] candidate buffer ONCE after the scan — the per-step
@@ -111,7 +120,7 @@ DEFAULT_TILE_BUDGET = 8 * 1024 * 1024
 NOMINAL_BATCH = 1024
 _ROW_ALIGN = 128
 
-SCAN_MODES = ("two_stage", "carry")
+SCAN_MODES = ("two_stage", "carry", "fused")
 PRECISIONS = precision_mod.PRESET_NAMES
 
 # extra candidates the bf16 scan keeps beyond the requested k, so a
@@ -126,8 +135,27 @@ def _round_up(n: int, m: int) -> int:
 
 
 def auto_chunk_rows(dim: int, spec_kind: str, n: int,
-                    tile_budget: int = DEFAULT_TILE_BUDGET) -> int:
-    """Table-chunk rows that keep one distance tile under the budget."""
+                    tile_budget: int = DEFAULT_TILE_BUDGET, *,
+                    scan_mode: str = "two_stage",
+                    dtype=jnp.float32) -> int:
+    """Table-chunk rows that keep one distance tile under the budget.
+
+    For ``scan_mode="fused"`` on a fused-capable family the chunk IS the
+    kernel's streamed tile height, so sizing delegates to
+    :func:`hyperspace_tpu.kernels.scan_topk.fused_tile_rows` — a
+    VMEM-footprint model over dim × dtype × k (worst-case ``k =
+    FUSED_MAX_K``, so every supported per-call k fits), not the fixed
+    HBM distance-tile byte budget the two-stage scan uses.  Unsupported
+    families keep the default sizing (the engine then IS the default
+    two-stage executable — the bit-identical fallback contract)."""
+    if scan_mode == "fused":
+        from hyperspace_tpu.kernels import scan_topk as fused_kernel
+
+        if (fused_kernel.kind_supported((spec_kind,))
+                and dim <= fused_kernel.FUSED_MAX_DIM):
+            chunk = fused_kernel.fused_tile_rows(
+                dim, dtype, fused_kernel.FUSED_MAX_K)
+            return min(chunk, _round_up(max(n, 1), _ROW_ALIGN))
     per_row = 4 * NOMINAL_BATCH * (dim if spec_kind == "product" else 1)
     chunk = max(_ROW_ALIGN, (tile_budget // per_row) // _ROW_ALIGN * _ROW_ALIGN)
     return min(chunk, _round_up(max(n, 1), _ROW_ALIGN))
@@ -165,6 +193,23 @@ def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
     # a slab narrower than k (a small shard under a large k) contributes
     # every row it has; the cross-shard merge restores the full k
     ko = min(k, nchunks * chunk)
+
+    if mode == "fused":
+        from hyperspace_tpu.kernels import scan_topk as fused_kernel
+
+        if (fused_kernel.supports(spec, k=k, dim=slab.shape[1])
+                and chunk % 128 == 0):
+            # the fused Pallas kernel (XLA twin on CPU): distance tiles
+            # stay in-register, the running top-k lives in the kernel
+            # carry — no [B, chunk] HBM tile, no per-chunk lax.top_k,
+            # no post-scan merge (kernels/scan_topk.py)
+            d, i = fused_kernel.scan_topk(
+                slab, q, q_idx, col0, spec=spec, k=k, n=n,
+                exclude_self=exclude_self, tile_rows=chunk)
+            return d[:, :ko], i[:, :ko]
+        # capability fallback (product spec, oversized k/dim): the
+        # two-stage path below, bit-identical to scan_mode="two_stage"
+        mode = "two_stage"
 
     def masked_tile(i):
         rows = jax.lax.dynamic_slice_in_dim(slab, i * chunk, chunk)
@@ -406,7 +451,7 @@ def _cand_dist(spec: tuple, q: jax.Array, rows: jax.Array) -> jax.Array:
 
 def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
                     q_idx: jax.Array, *, spec: tuple, k: int, chunk: int,
-                    exclude_self: bool):
+                    exclude_self: bool, mode: str = "two_stage"):
     """Chunked top-k over per-query candidate ids — the IVF in-cell
     scorer.  The two-stage machinery of :func:`_scan_topk` (per-chunk
     ``lax.top_k`` over the tile only, one post-scan merge, the running
@@ -420,6 +465,18 @@ def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
     """
     b, ctot = cand.shape
     nchunks = ctot // chunk
+
+    if mode == "fused":
+        from hyperspace_tpu.kernels import scan_topk as fused_kernel
+
+        if fused_kernel.supports_cand(spec, k=k, dim=scan_table.shape[1],
+                                      cand=ctot):
+            d, i = fused_kernel.scan_topk_cand(
+                scan_table, cand, q, q_idx, spec=spec, k=k,
+                exclude_self=exclude_self)
+            ko = min(k, ctot)
+            return d[:, :ko], i[:, :ko]
+        mode = "two_stage"  # capability fallback — bit-identical path
 
     def masked_tile(i):
         ids = jax.lax.dynamic_slice_in_dim(cand, i * chunk, chunk, axis=1)
@@ -436,11 +493,11 @@ def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
 
 
 @partial(jax.jit, static_argnames=("spec", "k", "k_scan", "nprobe", "chunk",
-                                   "exclude_self", "mixed"))
+                                   "exclude_self", "mixed", "mode"))
 def _topk_ivf(table: jax.Array, scan_table: jax.Array, centroids: jax.Array,
               cells: jax.Array, q_idx: jax.Array, *, spec: tuple, k: int,
               k_scan: int, nprobe: int, chunk: int, exclude_self: bool,
-              mixed: bool):
+              mixed: bool, mode: str = "two_stage"):
     """IVF probing top-k: centroid scoring → nearest-``nprobe`` cell
     gather → two-stage candidate scan (docs/serving.md "Approximate
     retrieval").  One executable per (batch, k, nprobe, spec) — same
@@ -466,7 +523,7 @@ def _topk_ivf(table: jax.Array, scan_table: jax.Array, centroids: jax.Array,
     qs = q.astype(scan_table.dtype)
     sd, sidx = _scan_topk_cand(scan_table, qs, cand, q_idx, spec=spec,
                                k=(k_scan if mixed else k), chunk=chunk,
-                               exclude_self=exclude_self)
+                               exclude_self=exclude_self, mode=mode)
     if not mixed:
         return sidx, sd
     rows = table[jnp.maximum(sidx, 0)]                    # [B, K, D] f32
@@ -527,9 +584,12 @@ class QueryEngine:
     or directly on a live table (tests, the round-trip lint).
 
     ``scan_mode`` picks the chunk-scan strategy (``"two_stage"``
-    default, ``"carry"`` for the original running-top-k variant — see
-    the module docstring).  ``mesh=None`` (or a mesh whose model axis
-    has one device) runs the single-device program.
+    default, ``"carry"`` for the original running-top-k variant,
+    ``"fused"`` for the Pallas scan-top-k kernel — rank-identical
+    answers, no HBM distance tiles; unsupported specs/shapes fall back
+    to two-stage bit-identically — see the module docstring).
+    ``mesh=None`` (or a mesh whose model axis has one device) runs the
+    single-device program.
 
     ``precision`` picks the table-scan dtype policy (docs/precision.md):
     ``"f32"`` (default) is the exact pre-policy program, bit-identical;
@@ -596,8 +656,39 @@ class QueryEngine:
             # silently answer every query with -1/inf
             raise ValueError(f"chunk_rows must be >= 0 (0 = auto); "
                              f"got {chunk_rows}")
+        from hyperspace_tpu.kernels import scan_topk as fused_kernel
+
+        # fused-capable = the family/dim the fused kernel can serve; k-
+        # level fallback (oversized k per call) is decided per dispatch.
+        # An engine whose spec is NOT fused-capable keeps the default
+        # two-stage chunk sizing and executable — bit-identical fallback
+        self._fused_kind = (scan_mode == "fused"
+                            and fused_kernel.kind_supported(self.spec)
+                            and self.dim <= fused_kernel.FUSED_MAX_DIM)
+        scan_dtype = (self._policy.compute if self._policy.mixed
+                      else jnp.float32)
         self.chunk_rows = chunk_rows or auto_chunk_rows(
-            self.dim, self.spec[0], self.num_nodes, tile_budget)
+            self.dim, self.spec[0], self.num_nodes, tile_budget,
+            scan_mode=("fused" if self._fused_kind else "two_stage"),
+            dtype=scan_dtype)
+        if self._fused_kind and (
+                self.chunk_rows % 128
+                or self.chunk_rows > fused_kernel.fused_tile_rows(
+                    self.dim, scan_dtype, fused_kernel.FUSED_MAX_K)):
+            # a user chunk_rows off the 128 grid can never stream, and
+            # one past the kernel's VMEM footprint model would compile
+            # only on the CPU twin (Mosaic would reject the tile on a
+            # real chip) — demote the ENGINE: it must advertise itself
+            # as what it actually serves (scan_signature without the
+            # "fused" marker) and dispatch two-stage everywhere, IVF
+            # probes included, not just where a per-call gate happens
+            # to catch it
+            self._fused_kind = False
+        # the mode every dispatch actually uses: a demoted fused engine
+        # IS the two-stage executable (bit-identical fallback contract)
+        self._scan_mode_eff = (scan_mode
+                               if scan_mode != "fused" or self._fused_kind
+                               else "two_stage")
         # each shard's slab must itself be a chunk multiple, so the
         # padded table is a (chunk × shards) multiple (shards=1: the
         # original chunk-multiple padding, bit-identical layout)
@@ -670,10 +761,22 @@ class QueryEngine:
         """Result-identity of the scan path: ``("exact",)`` or
         ``("ivf", nprobe, index fingerprint)`` — a batcher cache-key
         element, so exact and probed rows (or rows probed through two
-        different indexes) never cross-contaminate."""
-        if self._ivf:
-            return ("ivf", self.nprobe, self.index.fingerprint)
-        return ("exact",)
+        different indexes) never cross-contaminate.  A fused-capable
+        engine appends ``"fused"``: fused answers are rank-identical to
+        the two-stage scan but only ulp-close in distance, so its cached
+        rows must never be served back as two-stage rows (or vice
+        versa) over the same table."""
+        sig = (("ivf", self.nprobe, self.index.fingerprint) if self._ivf
+               else ("exact",))
+        return sig + (("fused",) if self._fused_kind else ())
+
+    def scan_signature_for(self, nprobe: int) -> tuple:
+        """The signature :attr:`scan_signature` would have at an
+        overridden probe width — the degradation ladder's cache-key hook
+        (``serve/batcher.py``): narrowed-width rows carry the narrowed
+        signature, fused marker included."""
+        sig = ("ivf", int(nprobe), self.index.fingerprint)
+        return sig + (("fused",) if self._fused_kind else ())
 
     @classmethod
     def from_artifact(cls, art: ServingArtifact, **kw) -> "QueryEngine":
@@ -725,21 +828,22 @@ class QueryEngine:
                     self.table, self.scan_table, q_idx, spec=self.spec,
                     k=k, k_scan=k_scan, chunk=self.chunk_rows,
                     n=self.num_nodes, exclude_self=exclude_self,
-                    mode=self.scan_mode, mesh=self.mesh,
+                    mode=self._scan_mode_eff, mesh=self.mesh,
                     axis=self.mesh_axis)
             return _topk_chunked_mixed(
                 self.table, self.scan_table, q_idx, spec=self.spec, k=k,
                 k_scan=k_scan, chunk=self.chunk_rows, n=self.num_nodes,
-                exclude_self=exclude_self, mode=self.scan_mode)
+                exclude_self=exclude_self, mode=self._scan_mode_eff)
         if self.shards > 1:
             return _topk_sharded(
                 self.table, q_idx, spec=self.spec, k=k,
                 chunk=self.chunk_rows, n=self.num_nodes,
-                exclude_self=exclude_self, mode=self.scan_mode,
+                exclude_self=exclude_self, mode=self._scan_mode_eff,
                 mesh=self.mesh, axis=self.mesh_axis)
         idx, dist = _topk_chunked(
             self.table, q_idx, spec=self.spec, k=k, chunk=self.chunk_rows,
-            n=self.num_nodes, exclude_self=exclude_self, mode=self.scan_mode)
+            n=self.num_nodes, exclude_self=exclude_self,
+            mode=self._scan_mode_eff)
         return idx, dist
 
     def _probe_topk(self, q_idx: jax.Array, k: int, *, exclude_self: bool,
@@ -771,7 +875,7 @@ class QueryEngine:
             self.table, self.scan_table, self._centroids, self._cells,
             q_idx, spec=self.spec, k=k, k_scan=k_scan, nprobe=p,
             chunk=self._cand_chunk, exclude_self=exclude_self,
-            mixed=self._policy.mixed)
+            mixed=self._policy.mixed, mode=self._scan_mode_eff)
         telem.observe("serve/index_probe_ms",
                       (time.perf_counter() - t0) * 1e3)
         telem.inc("serve/recall_candidates", int(q_idx.shape[0]) * capacity)

@@ -194,21 +194,38 @@ def _lloyd(tpad: jax.Array, cent0: jax.Array, n, *, spec: tuple,
 
     Returns ``(centroids [ncells, D], assign [npad] int32)`` — the
     assignment is the FINAL pass against the returned centroids, so the
-    cell layout matches them exactly.  Assignment tiles are
-    [chunk, ncells] (via the fused distance kernels where the family
-    has one); the centroid update accumulates per-cell lifted sums with
+    cell layout matches them exactly.  Assignment is a k=1 fused
+    scan-top-k on kernel backends (kernels/scan_topk.py — no
+    [chunk, ncells] tile in HBM) and the historical [chunk, ncells]
+    argmin on CPU/XLA; the centroid update accumulates per-cell lifted sums with
     a one-hot matmul per chunk, so the whole loop is one executable and
     deterministic for a fixed seed/platform.
     """
+    from hyperspace_tpu.kernels import _support as KS
+    from hyperspace_tpu.kernels import scan_topk as fused_kernel
     from hyperspace_tpu.serve.engine import _tile_dist
 
     nchunks = tpad.shape[0] // chunk
     dl = _lift_dim(spec, tpad.shape[1])
+    # nearest-centroid assignment IS a k=1 scan-top-k with the centroids
+    # as the slab — on a kernel backend the fused Pallas kernel
+    # (kernels/scan_topk.py) serves it without materializing the
+    # [chunk, ncells] distance tile; the CPU/XLA path keeps the exact
+    # historical argmin program (same answers, no behavior drift for
+    # existing builds)
+    use_fused = (KS.mode() != "xla"
+                 and fused_kernel.supports(spec, k=1, dim=tpad.shape[1]))
 
     def assign_chunk(cent, i):
         rows = jax.lax.dynamic_slice_in_dim(tpad, i * chunk, chunk)
-        d = _tile_dist(spec, rows, cent)                  # [chunk, ncells]
-        a = jnp.argmin(d, axis=1).astype(jnp.int32)
+        if use_fused:
+            _, ids = fused_kernel.scan_topk(
+                cent, rows, jnp.zeros((chunk,), jnp.int32), 0, spec=spec,
+                k=1, n=ncells, exclude_self=False)
+            a = ids[:, 0]
+        else:
+            d = _tile_dist(spec, rows, cent)              # [chunk, ncells]
+            a = jnp.argmin(d, axis=1).astype(jnp.int32)
         valid = (i * chunk + jnp.arange(chunk)) < n
         return rows, a, valid
 

@@ -51,9 +51,13 @@ SENTINEL_METRICS = {"error", "budget_exhausted"}
 _SKIP_DETAIL_KEYS = {"telemetry", "traceback"}
 
 _HIGHER_TOKENS = ("per_s", "per_sec", "qps", "samples", "speedup",
-                  "recall", "rate", "auc", "frac", "roofline")
+                  "recall", "rate", "auc", "frac", "roofline", "ratio")
 _LOWER_TOKENS = ("time", "stall", "waste", "recompile", "epoch_s",
                  "compile")
+# lower-better tokens that outrank the higher-better list: "ratio" is
+# generically higher-better (fused/unfused speedup ratios), but a
+# waste ratio is still waste
+_LOWER_PRIORITY_TOKENS = ("waste",)
 _LOWER_SUFFIXES = ("_s", "_ms", "_bytes")
 # leaves that are the size of a measurement's basis, not a measurement
 # — fewer samples is not an improvement
@@ -76,6 +80,8 @@ def direction(key: str) -> Optional[str]:
     k = key.lower()
     if k.rsplit(".", 1)[-1] in _NEUTRAL_LEAVES:
         return None
+    if any(t in k for t in _LOWER_PRIORITY_TOKENS):
+        return "lower"
     if any(t in k for t in _HIGHER_TOKENS):
         return "higher"
     if (any(seg.endswith(_LOWER_SUFFIXES) for seg in k.split("."))

@@ -146,6 +146,28 @@ def main():
                    lambda: cluster_aggregate(h_bf, w_cl, r_cld, s_cld,
                                              cplan, n_cl), tol=2e-2))
 
+    # fused scan-top-k (r12): the twin is bitwise by construction on
+    # CPU-interpret — the chip run is the Mosaic-lowering check the
+    # interpreter can't give (docs/kernels.md "Twin contract").  Compare
+    # distances (f32 contract, tol covers transcendental drift); the
+    # int ids ride along in the distance comparison (a rank flip would
+    # change a distance by a visible gap on this point scale).
+    from hyperspace_tpu.kernels import scan_topk as ST
+
+    st_tab = ball.random_normal(ks[14], (1024, 16), jnp.float32, std=0.3)
+    st_qi = jnp.arange(64, dtype=jnp.int32)
+    st_q = st_tab[st_qi]
+    oks.append(run("scan_topk",
+                   lambda: ST.scan_topk(st_tab, st_q, st_qi, 0,
+                                        spec=("poincare", 1.0), k=10,
+                                        n=1024, exclude_self=True,
+                                        tile_rows=512)[0]))
+    st_cand = jnp.asarray(rng.integers(0, 1024, (64, 256)).astype(np.int32))
+    oks.append(run("scan_topk_cand",
+                   lambda: ST.scan_topk_cand(st_tab, st_cand, st_q, st_qi,
+                                             spec=("poincare", 1.0),
+                                             k=5)[0]))
+
     print(json.dumps({"all_ok": all(oks), "backend": jax.default_backend()}),
           flush=True)
     sys.exit(0 if all(oks) else 1)

@@ -1,0 +1,31 @@
+"""Fixture: materialized-distmat MUST fire on every pattern here."""
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.kernels.distmat import pdist
+
+
+def topk_via_full_distmat(q, table, k):
+    d = pdist(q, table, 1.0, manifold="poincare")   # [B, N] in HBM
+    vals, idx = jax.lax.top_k(-d, k)
+    return idx, -vals
+
+
+def topk_direct(q, table, k):
+    return jax.lax.top_k(-pdist(q, table, 1.0, manifold="lorentz"), k)
+
+
+def topk_broadcast_dist(man, q, table, k):
+    d = man.dist(q[:, None, :], table[None, :, :])   # O(N²) broadcast
+    return jax.lax.top_k(-d, k)
+
+
+def taint_survives_a_later_nested_rebind(q, table, k):
+    d = pdist(q, table, 1.0, manifold="poincare")
+    out = jax.lax.top_k(-d, k)  # must fire: the rebind below is LATER
+
+    def helper():
+        d = jnp.zeros((2, 2))  # source-order taint: this clears d only
+        return d               # for sites after this line
+
+    return out, helper

@@ -1,0 +1,41 @@
+"""Fixture: materialized-distmat must stay CLEAN on the streamed forms."""
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.kernels.distmat import pdist
+
+
+def topk_chunked(q, table, k, chunk):
+    """Per-chunk top-k over the tile only — the engine's two-stage
+    shape: the ranked operand comes from a tile closure (the engine's
+    ``masked_tile``), not from a distmat-producer binding."""
+    def masked_tile(i):
+        rows = jax.lax.dynamic_slice_in_dim(table, i * chunk, chunk)
+        return pdist(q, rows, 1.0, manifold="poincare")  # one tile
+
+    def body(carry, i):
+        d = masked_tile(i)
+        top, sel = jax.lax.top_k(-d, min(k, chunk))
+        return carry, (top, sel)
+
+    _, out = jax.lax.scan(body, None,
+                          jnp.arange(table.shape[0] // chunk))
+    return out
+
+
+def distmat_without_sort(q, table):
+    """Materializing a distmat for something OTHER than top-k (eval
+    metrics) is not this rule's hazard."""
+    return pdist(q, table, 1.0, manifold="poincare").mean()
+
+
+def topk_of_scores(scores, k):
+    """top_k over non-distance data stays clean."""
+    d = scores * 2.0
+    return jax.lax.top_k(d, k)
+
+
+def rebound_name_goes_clean(q, table, k):
+    d = pdist(q, table, 1.0, manifold="poincare")
+    d = jnp.zeros((4, 4))  # rebound: no longer the distmat
+    return jax.lax.top_k(d, k)

@@ -13,6 +13,7 @@ import pytest
 
 from hyperspace_tpu.analysis.core import lint_file, lint_paths
 from hyperspace_tpu.analysis.rules.catalog import TelemetryCatalogRule
+from hyperspace_tpu.analysis.rules.distmat import MaterializedDistmatRule
 from hyperspace_tpu.analysis.rules.donation import DonationHazardRule
 from hyperspace_tpu.analysis.rules.exceptions import SwallowBaseExceptionRule
 from hyperspace_tpu.analysis.rules.flags import FlagDocDriftRule
@@ -39,6 +40,7 @@ _PER_FILE = [
     ("bad_tracerleak.py", TracerLeakRule, None),
     ("bad_exceptions.py", SwallowBaseExceptionRule, None),
     ("bad_retry.py", UnboundedRetryRule, None),
+    ("bad_distmat.py", MaterializedDistmatRule, None),
     ("bad_precision.py", PrecisionLiteralRule,
      "hyperspace_tpu/models/bad_precision.py"),
 ]
@@ -179,6 +181,40 @@ def test_retry_sleepless_while_true_is_fine(tmp_path):
     p = tmp_path / "loop.py"
     p.write_text("def f(q):\n    while True:\n        q.get()\n")
     assert lint_file(str(p), rules=[UnboundedRetryRule()]).findings == []
+
+
+# --- materialized-distmat -----------------------------------------------------
+
+
+def test_distmat_bad_fixture_fires_every_shape():
+    """pdist-via-name, pdist-direct, the broadcast .dist idiom, and a
+    taint that survives a LATER nested-scope rebind (source-order
+    tracking, not ast.walk order) all fire."""
+    report = _lint("bad_distmat.py", MaterializedDistmatRule)
+    assert report.exit_code() == 1 and len(report.findings) == 4
+
+
+def test_distmat_good_fixture_is_clean():
+    """Tile-closure chunked scans, unsorted distmats, non-distance
+    top_k and rebound names all pass."""
+    assert _lint("good_distmat.py", MaterializedDistmatRule).findings == []
+
+
+def test_distmat_kernels_dir_is_out_of_scope(tmp_path):
+    """kernels/ is the sanctioned home of tile-level sorting — the same
+    source that fires elsewhere is clean under a kernels/ rel path."""
+    src = ("import jax\nfrom hyperspace_tpu.kernels.distmat import pdist\n"
+           "def f(q, t, k):\n"
+           "    d = pdist(q, t, 1.0, manifold='poincare')\n"
+           "    return jax.lax.top_k(-d, k)\n")
+    p = tmp_path / "x.py"
+    p.write_text(src)
+    assert lint_file(str(p), rel="hyperspace_tpu/serve/x.py",
+                     rules=[MaterializedDistmatRule()]).findings
+    assert lint_file(str(p), rel="hyperspace_tpu/kernels/x.py",
+                     rules=[MaterializedDistmatRule()]).findings == []
+    assert lint_file(str(p), rel="hyperspace_tpu/kernels/deep/x.py",
+                     rules=[MaterializedDistmatRule()]).findings == []
 
 
 # --- precision-literal --------------------------------------------------------

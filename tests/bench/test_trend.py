@@ -226,3 +226,22 @@ def test_direction_quality_metrics_are_higher_better():
     # and the lower-better inference stays undisturbed around them
     assert mod.direction("detail.serve.ivf.build_s") == "lower"
     assert mod.direction("detail.latency_ms.b8.p99") == "lower"
+
+
+def test_direction_speedup_ratio_are_higher_better():
+    """Names carrying speedup / ratio are higher-is-better — the r12
+    serve_fused_speedup headline and the per-bucket fused/two_stage
+    ratios must gate in the right direction from round one.  The one
+    exception: a *waste* ratio stays lower-better (waste outranks the
+    generic ratio token)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_trend", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for name in ("serve_fused_speedup",
+                 "detail.serve.fused_vs_unfused.serve_fused_speedup",
+                 "detail.serve.fused_vs_unfused.buckets.b64.ratio",
+                 "speedup_at_recall99"):
+        assert mod.direction(name) == "higher", name
+    assert mod.direction("detail.serve.cache.padded_waste_ratio") == "lower"

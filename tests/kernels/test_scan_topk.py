@@ -1,0 +1,218 @@
+"""Fused scan-top-k parity suite (ISSUE 10 tentpole).
+
+Chain of oracles: the Pallas kernel (interpret mode, SURVEY.md §4.4)
+must match the XLA twin **bitwise** (the tightened twin contract: same
+padded block schedule, same shared tile/merge functions), and the twin
+must rank-match a numpy argsort over the masked distances.  Plus the
+deterministic tile-sizing pins for ``fused_tile_rows`` (the
+VMEM-budget-aware sizing satellite)."""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.kernels import scan_topk as F
+from hyperspace_tpu.manifolds import Euclidean, Lorentz, PoincareBall
+
+from .conftest import ball_points
+
+
+def _table(rng, kind, n, d):
+    if kind == "lorentz":
+        man = Lorentz(0.8)
+        v = jnp.asarray(rng.standard_normal((n, d + 1)) * 0.5, jnp.float32)
+        v = v.at[:, 0].set(0.0)
+        return np.asarray(man.expmap0(v)), ("lorentz", 0.8), man
+    if kind == "euclidean":
+        t = rng.standard_normal((n, d)).astype(np.float32)
+        return t, ("euclidean", 0.0), Euclidean()
+    t = np.asarray(ball_points(rng, (n, d), 1.3))
+    return t, ("poincare", 1.3), PoincareBall(1.3)
+
+
+def _ref_topk(man, table, qidx, k, exclude_self):
+    d = np.array(jax.vmap(lambda x: man.dist(x, jnp.asarray(table)))(
+        jnp.asarray(table)[qidx]))
+    if exclude_self:
+        d[np.arange(len(qidx)), qidx] = np.inf
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return idx
+
+
+def _run_both(monkeypatch, fn):
+    """fn() under the twin, then under the interpreter — returns both."""
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "xla")
+    twin = tuple(np.asarray(a) for a in fn())
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "interpret")
+    kern = tuple(np.asarray(a) for a in fn())
+    return twin, kern
+
+
+@pytest.mark.parametrize("kind", ["poincare", "lorentz", "euclidean"])
+@pytest.mark.parametrize("exclude_self", [True, False])
+def test_twin_matches_interpreter_bitwise(rng, monkeypatch, kind,
+                                          exclude_self):
+    """The twin contract: XLA twin == Pallas interpreter, bit for bit
+    (distances via uint32 view), on every supported family."""
+    table, spec, man = _table(rng, kind, 300, 6)
+    qidx = np.asarray([0, 3, 17, 150, 299], np.int32)
+    q = table[qidx]
+    k = 7
+
+    def run():
+        return F.scan_topk(jnp.asarray(table), jnp.asarray(q),
+                           jnp.asarray(qidx), 0, spec=spec, k=k,
+                           n=table.shape[0], exclude_self=exclude_self,
+                           tile_rows=128)
+
+    (td, ti), (kd, ki) = _run_both(monkeypatch, run)
+    assert np.array_equal(ti, ki)
+    assert np.array_equal(td.view(np.uint32), kd.view(np.uint32))
+    # and both rank-match the manifold oracle
+    assert np.array_equal(ti, _ref_topk(man, table, qidx, k, exclude_self))
+    assert np.all(np.diff(td, axis=1) >= 0)
+
+
+def test_k_drain_and_tile_boundaries(rng, monkeypatch):
+    """k = N−1 (self excluded) and k = N (drain) across tile
+    boundaries: every reachable row exactly once, ascending, and the
+    twin/interpreter stay bitwise."""
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "xla")
+    table, spec, man = _table(rng, "poincare", 200, 5)
+    qidx = np.asarray([0, 127, 128, 199], np.int32)
+    q = table[qidx]
+    for k, es in ((1, True), (199, True), (200, False)):
+        d, i = (np.asarray(a) for a in F.scan_topk(
+            jnp.asarray(table), jnp.asarray(q), jnp.asarray(qidx), 0,
+            spec=spec, k=k, n=200, exclude_self=es, tile_rows=128))
+        assert np.array_equal(i, _ref_topk(man, table, qidx, k, es))
+        assert np.all(np.isfinite(d))
+        for j, qi in enumerate(qidx):
+            want = [r for r in range(200) if es is False or r != qi][:200]
+            assert len(set(i[j].tolist())) == k
+            assert set(i[j].tolist()) <= set(want)
+
+
+def test_narrow_slab_pads_with_inf_minus_one(rng, monkeypatch):
+    """A slab narrower than k (the sharded narrow-shard case) fills the
+    tail with (+inf, −1) — never a duplicated or fabricated id."""
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "xla")
+    table, spec, _ = _table(rng, "poincare", 40, 5)
+    qidx = np.asarray([0, 1], np.int32)
+    d, i = (np.asarray(a) for a in F.scan_topk(
+        jnp.asarray(table), jnp.asarray(table[qidx]), jnp.asarray(qidx),
+        0, spec=spec, k=64, n=40, exclude_self=True, tile_rows=128))
+    assert np.all(np.isinf(d[:, 39:]))
+    assert np.all(i[:, 39:] == -1)
+    assert np.all(np.isfinite(d[:, :39]))
+
+
+def test_shard_local_col0_offsets(rng, monkeypatch):
+    """A traced-style col0 offset shifts the returned GLOBAL ids but not
+    the geometry — the _topk_sharded composition contract."""
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "xla")
+    table, spec, _ = _table(rng, "poincare", 150, 5)
+    qidx = np.asarray([3, 70], np.int32)
+    q = table[qidx]
+    d0, i0 = (np.asarray(a) for a in F.scan_topk(
+        jnp.asarray(table), jnp.asarray(q), jnp.asarray(qidx), 0,
+        spec=spec, k=5, n=150, exclude_self=False, tile_rows=128))
+    off = 1000
+    d1, i1 = (np.asarray(a) for a in F.scan_topk(
+        jnp.asarray(table), jnp.asarray(q), jnp.asarray(qidx + off),
+        jnp.int32(off), spec=spec, k=5, n=150 + off,
+        exclude_self=False, tile_rows=128))
+    assert np.array_equal(i0 + off, i1)
+    assert np.array_equal(d0.view(np.uint32), d1.view(np.uint32))
+
+
+def test_bf16_slab_scans_in_f32_registers(rng, monkeypatch):
+    """A bf16 slab streams at half the bytes but computes f32 distances
+    in-register: results are f32 and rank-match the oracle over the
+    QUANTIZED table (the quantization is the only bf16 effect)."""
+    table, spec, man = _table(rng, "poincare", 300, 6)
+    tb = jnp.asarray(table).astype(jnp.bfloat16)
+    qidx = np.asarray([0, 50, 299], np.int32)
+    qb = tb[jnp.asarray(qidx)]
+
+    def run():
+        return F.scan_topk(tb, qb, jnp.asarray(qidx), 0, spec=spec, k=6,
+                           n=300, exclude_self=True, tile_rows=128)
+
+    (td, ti), (kd, ki) = _run_both(monkeypatch, run)
+    assert td.dtype == np.float32
+    assert np.array_equal(ti, ki)
+    assert np.array_equal(td.view(np.uint32), kd.view(np.uint32))
+    tq = np.asarray(tb.astype(jnp.float32))
+    assert np.array_equal(ti, _ref_topk(man, tq, qidx, 6, True))
+
+
+@pytest.mark.parametrize("kind", ["poincare", "lorentz", "euclidean"])
+def test_cand_variant_matches_interpreter_and_oracle(rng, monkeypatch,
+                                                     kind):
+    """The per-query candidate variant (the IVF probing scorer): twin ==
+    interpreter bitwise; ranks == argsort over each query's OWN masked
+    candidate set; −1 padding and exclude_self never surface."""
+    table, spec, man = _table(rng, kind, 120, 6)
+    b, cc, k = 9, 40, 5
+    cand = rng.integers(0, 120, size=(b, cc)).astype(np.int32)
+    cand[:, -3:] = -1                                     # padding slots
+    qidx = rng.integers(0, 120, size=b).astype(np.int32)
+    cand[:, 0] = qidx                                     # self present
+    q = table[qidx]
+
+    def run():
+        return F.scan_topk_cand(jnp.asarray(table), jnp.asarray(cand),
+                                jnp.asarray(q), jnp.asarray(qidx),
+                                spec=spec, k=k, exclude_self=True)
+
+    (td, ti), (kd, ki) = _run_both(monkeypatch, run)
+    assert np.array_equal(ti, ki)
+    assert np.array_equal(td.view(np.uint32), kd.view(np.uint32))
+    # per-query oracle over the candidate multiset
+    t64 = jnp.asarray(table)
+    for j in range(b):
+        ids = [c for c in cand[j] if c >= 0 and c != qidx[j]]
+        dd = np.asarray(man.dist(jnp.asarray(table[qidx[j]])[None, :],
+                                 t64[np.asarray(ids)]))
+        order = np.asarray(ids)[np.argsort(dd, kind="stable")]
+        # candidate ids may repeat (random draw) — compare distance
+        # ranks via the id multiset of the top-k prefix
+        got = ti[j].tolist()
+        assert got == [int(x) for x in order[:k]] or (
+            sorted(got) == sorted(int(x) for x in order[:k]))
+        assert qidx[j] not in got
+        assert -1 not in got
+
+
+def test_fused_tile_rows_pins():
+    """The VMEM-footprint sizing is deterministic in dim × dtype × k —
+    pinned values for known shapes (the auto_chunk_rows satellite)."""
+    assert F.fused_tile_rows(16, jnp.float32, 10) == 512
+    assert F.fused_tile_rows(256, jnp.float32, 10) == 512
+    assert F.fused_tile_rows(256, jnp.float32, 256) == 256
+    assert F.fused_tile_rows(1024, jnp.float32, 10) == 128
+    assert F.fused_tile_rows(1024, jnp.bfloat16, 10) == 256
+    assert F.fused_cand_tile_rows(16, jnp.float32, 10) == 256
+
+
+def test_supports_and_validation(rng):
+    """Capability gates: product / oversized k / oversized dim are
+    unsupported (callers fall back); calling anyway is a loud error."""
+    assert F.supports(("poincare", 1.0), k=1, dim=16)
+    assert F.supports(("euclidean", 0.0), k=F.FUSED_MAX_K, dim=16)
+    assert not F.supports(("product", ()), k=4, dim=16)
+    assert not F.supports(("poincare", 1.0), k=F.FUSED_MAX_K + 1, dim=16)
+    assert not F.supports(("poincare", 1.0), k=4, dim=F.FUSED_MAX_DIM + 1)
+    table, spec, _ = _table(np.random.default_rng(0), "poincare", 20, 4)
+    with pytest.raises(ValueError, match="unsupported"):
+        F.scan_topk(jnp.asarray(table), jnp.asarray(table[:2]),
+                    jnp.zeros((2,), jnp.int32), 0, spec=("product", ()),
+                    k=2, n=20)
+    with pytest.raises(ValueError, match="tile_rows"):
+        F.scan_topk(jnp.asarray(table), jnp.asarray(table[:2]),
+                    jnp.zeros((2,), jnp.int32), 0, spec=spec, k=2, n=20,
+                    tile_rows=100)

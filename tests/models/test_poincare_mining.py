@@ -1,0 +1,95 @@
+"""Sampled hard-negative mining (ISSUE 10: the fused scan-top-k wired
+into the training-side negative path).  ``neg_mode="mined"`` keeps each
+row's K nearest pool candidates; the default stays uniform and
+untouched."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.data.wordnet import synthetic_tree
+from hyperspace_tpu.manifolds import PoincareBall
+from hyperspace_tpu.models import poincare_embed as pe
+
+
+def _cfg(ds, **kw):
+    return pe.PoincareEmbedConfig(num_nodes=ds.num_nodes, dim=8,
+                                  batch_size=32, neg_samples=5,
+                                  burnin_steps=0, **kw)
+
+
+def test_mined_negatives_are_the_nearest_pool_members(rng):
+    """_mine_negatives == numpy argsort of ball distances over the pool
+    (ties none at random init scales)."""
+    ds = synthetic_tree(depth=4, branching=3)
+    cfg = _cfg(ds, neg_mode="mined", mine_pool=64)
+    table = jnp.asarray(
+        np.asarray(PoincareBall(1.0).expmap0(jnp.asarray(
+            rng.standard_normal((ds.num_nodes, 8)) * 0.3, jnp.float32))))
+    u_idx = jnp.asarray(rng.integers(0, ds.num_nodes, 16), jnp.int32)
+    key = jax.random.PRNGKey(7)
+    neg = np.asarray(pe._mine_negatives(cfg, table, u_idx, key))
+    assert neg.shape == (16, cfg.neg_samples)
+    pool = np.asarray(jax.random.randint(key, (64,), 0, cfg.num_nodes))
+    ball = PoincareBall(1.0)
+    d = np.asarray(ball.dist(jnp.asarray(table)[u_idx][:, None, :],
+                             jnp.asarray(table)[jnp.asarray(pool)][None]))
+    want = pool[np.argsort(d, axis=1, kind="stable")[:, :cfg.neg_samples]]
+    assert np.array_equal(neg, want)
+
+
+def test_mined_step_trains_and_is_jittable(rng):
+    ds = synthetic_tree(depth=4, branching=3)
+    cfg = _cfg(ds, neg_mode="mined")
+    state, opt = pe.init_state(cfg, seed=0)
+    step = pe.make_train_step(cfg)
+    pairs = jnp.asarray(ds.pairs)
+    l0 = None
+    for _ in range(10):
+        state, loss = step(cfg, opt, state, pairs)
+        l0 = l0 if l0 is not None else float(loss)
+    assert np.isfinite(float(loss))
+    assert int(state.step) == 10
+    # and the epoch-scan path shares the same body
+    state2, losses = pe.train_epoch_scan(cfg, opt, state, pairs, 3)
+    assert np.all(np.isfinite(np.asarray(losses)))
+
+
+def test_default_uniform_path_is_unchanged(rng):
+    """neg_mode's default draws the identical PRNG stream as the
+    pre-mining build: one explicit-uniform step == one default step,
+    bitwise on the table."""
+    ds = synthetic_tree(depth=3, branching=3)
+    a, b = _cfg(ds), _cfg(ds, neg_mode="uniform")
+    pairs = jnp.asarray(ds.pairs)
+    sa, opt = pe.init_state(a, seed=0)
+    sb, _ = pe.init_state(b, seed=0)
+    sa, la = pe.train_step(a, opt, sa, pairs)
+    sb, lb = pe.train_step(b, opt, sb, pairs)
+    assert np.array_equal(np.asarray(sa.table).view(np.uint32),
+                          np.asarray(sb.table).view(np.uint32))
+
+
+def test_mined_mode_validation():
+    ds = synthetic_tree(depth=3, branching=2)
+    with pytest.raises(ValueError, match="dense"):
+        pe.make_train_step(_cfg(ds, neg_mode="mined", sparse=True))
+    with pytest.raises(ValueError, match="neg_mode"):
+        pe.make_train_step(_cfg(ds, neg_mode="hardest"))
+    with pytest.raises(ValueError, match="mine_pool"):
+        pe.make_train_step(_cfg(ds, neg_mode="mined", mine_pool=2))
+    # the fused kernel's caps fail at CONFIG time, not mid-training
+    with pytest.raises(ValueError, match="caps neg_samples"):
+        big = pe.PoincareEmbedConfig(num_nodes=ds.num_nodes, dim=8,
+                                     batch_size=8, neg_samples=300,
+                                     mine_pool=1200, neg_mode="mined")
+        pe.make_train_step(big)
+    with pytest.raises(ValueError, match="dim"):
+        wide = pe.PoincareEmbedConfig(num_nodes=ds.num_nodes, dim=2000,
+                                      batch_size=8, neg_samples=5,
+                                      neg_mode="mined")
+        pe.make_train_step(wide)
+    with pytest.raises(ValueError, match="dense"):
+        pe.plan_sparse_steps(_cfg(ds, neg_mode="mined"),
+                             np.zeros((4, 2), np.int64), 2)

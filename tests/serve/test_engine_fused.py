@@ -1,0 +1,238 @@
+"""``scan_mode="fused"`` engine contract (ISSUE 10): fused answers are
+rank-identical to the default two-stage scan on every supported spec
+(exact AND IVF), unsupported shapes/specs fall back bit-identically,
+the bf16 scan-then-rescore composes, and the batcher's cache key
+isolates fused rows from two-stage rows over the same table."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from hyperspace_tpu.kernels import scan_topk as fused_kernel
+from hyperspace_tpu.serve.artifact import spec_from_manifold
+from hyperspace_tpu.serve.batcher import RequestBatcher
+from hyperspace_tpu.serve.engine import QueryEngine, auto_chunk_rows
+from hyperspace_tpu.serve.index import IVF_MIN_TABLE_ROWS, build_index
+
+from .test_engine import (_lorentz_table, _poincare_table, _product_table,
+                          _reference_topk)
+
+
+def _pair(table, spec, **kw):
+    return (QueryEngine(table, spec, chunk_rows=128, scan_mode="two_stage",
+                        **kw),
+            QueryEngine(table, spec, chunk_rows=128, scan_mode="fused",
+                        **kw))
+
+
+@pytest.mark.parametrize("build", ["poincare", "lorentz"])
+@pytest.mark.parametrize("exclude_self", [True, False])
+@pytest.mark.parametrize("k", [1, 199, 200])
+def test_fused_matches_two_stage_and_oracle(rng, build, exclude_self, k):
+    """Rank identity across the spec × exclude_self × k grid, k running
+    from 1 through the N−1 / N drains ACROSS the 128-row tile boundary
+    (N = 200 > chunk); distances agree to f32 tolerance and the f64
+    oracle agrees with both."""
+    table, man = (_poincare_table if build == "poincare"
+                  else _lorentz_table)(rng, 200, 6, 1.3)
+    if k == 200 and exclude_self:
+        k = 199  # k = N needs exclude_self=False; fold the duplicate
+    spec = spec_from_manifold(man)
+    two, fus = _pair(table, spec)
+    q = np.asarray([0, 17, 127, 128, 199], np.int32)
+    i1, d1 = (np.asarray(a) for a in
+              two.topk_neighbors(q, k, exclude_self=exclude_self))
+    i2, d2 = (np.asarray(a) for a in
+              fus.topk_neighbors(q, k, exclude_self=exclude_self))
+    assert np.array_equal(i1, i2)
+    np.testing.assert_allclose(d1, d2, rtol=1e-5, atol=1e-6)
+    assert np.all(np.diff(d2, axis=1) >= 0)
+    ref_idx, ref_dist = _reference_topk(man, table, q, k)
+    if exclude_self:
+        assert np.array_equal(i2, ref_idx)
+        np.testing.assert_allclose(d2, ref_dist, rtol=2e-3, atol=2e-3)
+
+
+def test_product_spec_falls_back_bit_identically(rng):
+    """Product manifolds are outside the fused kernel's closed forms —
+    the engine must serve them through the UNCHANGED two-stage
+    executable: indices and distance bits equal."""
+    table, man = _product_table(rng, 300)
+    spec = spec_from_manifold(man)
+    two, fus = _pair(table, spec)
+    assert not fus._fused_kind and fus.scan_signature == ("exact",)
+    q = np.asarray([0, 7, 150, 299], np.int32)
+    i1, d1 = (np.asarray(a) for a in two.topk_neighbors(q, 6))
+    i2, d2 = (np.asarray(a) for a in fus.topk_neighbors(q, 6))
+    assert np.array_equal(i1, i2)
+    assert np.array_equal(np.asarray(d1).view(np.uint32),
+                          np.asarray(d2).view(np.uint32))
+
+
+@pytest.mark.parametrize("chunk", [100, 1024],
+                         ids=["misaligned", "over-vmem-model"])
+def test_bad_chunk_demotes_the_whole_engine(rng, chunk):
+    """A fused engine whose user chunk_rows can never stream (off the
+    128 grid, or past the kernel's VMEM footprint model — which only a
+    real Mosaic compile would reject) is demoted AT BUILD: it must
+    advertise itself as what it actually serves (no "fused" signature
+    element) and dispatch two-stage EVERYWHERE — exact scan AND IVF
+    probe — bitwise with the two_stage engine at the same chunk."""
+    table, man = _poincare_table(rng, 300, 5, 1.0)
+    spec = spec_from_manifold(man)
+    fus = QueryEngine(table, spec, chunk_rows=chunk, scan_mode="fused")
+    assert not fus._fused_kind and fus.scan_signature == ("exact",)
+    assert fus._scan_mode_eff == "two_stage"
+    two = QueryEngine(table, spec, chunk_rows=chunk, scan_mode="two_stage")
+    q = np.asarray([0, 299], np.int32)
+    i1, d1 = (np.asarray(a) for a in two.topk_neighbors(q, 5))
+    i2, d2 = (np.asarray(a) for a in fus.topk_neighbors(q, 5))
+    assert np.array_equal(i1, i2)
+    assert np.array_equal(d1.view(np.uint32), d2.view(np.uint32))
+
+
+def test_demoted_fused_engine_probes_two_stage_bitwise(rng):
+    """The IVF side of the demotion: a demoted fused engine's probe
+    must run the two-stage candidate scan (same signature ⇒ must be
+    the same bits — the cache-isolation contract)."""
+    n = IVF_MIN_TABLE_ROWS
+    table, man = _clustered_poincare(rng, n, 6, 16)
+    spec = spec_from_manifold(man)
+    idx = build_index(table, spec, 16, iters=4, seed=0)
+    two = QueryEngine(table, spec, index=idx, nprobe=2)
+    dem = QueryEngine(table, spec, index=idx, nprobe=2,
+                      scan_mode="fused", chunk_rows=100)
+    assert not dem._fused_kind
+    assert dem.scan_signature == two.scan_signature  # no "fused" marker
+    q = rng.integers(0, n, size=16).astype(np.int32)
+    i1, d1 = (np.asarray(a) for a in two.topk_neighbors(q, 4))
+    i2, d2 = (np.asarray(a) for a in dem.topk_neighbors(q, 4))
+    assert np.array_equal(i1, i2)
+    assert np.array_equal(d1.view(np.uint32), d2.view(np.uint32))
+
+
+def test_oversized_k_falls_back_bit_identically(rng):
+    """k past FUSED_MAX_K is a per-call capability fallback: the fused
+    engine answers through the two-stage path, bitwise."""
+    table, man = _poincare_table(rng, 300, 5, 1.0)
+    spec = spec_from_manifold(man)
+    two, fus = _pair(table, spec)
+    k = fused_kernel.FUSED_MAX_K + 10
+    i1, d1 = (np.asarray(a) for a in two.topk_neighbors(
+        np.asarray([1, 2], np.int32), k))
+    i2, d2 = (np.asarray(a) for a in fus.topk_neighbors(
+        np.asarray([1, 2], np.int32), k))
+    assert np.array_equal(i1, i2)
+    assert np.array_equal(d1.view(np.uint32), d2.view(np.uint32))
+
+
+def test_bf16_fused_scan_rank_agreement(rng):
+    """precision=bf16 + scan_mode=fused: the bf16 fused scan picks the
+    candidates, the f32 rescore ranks them — final answers agree with
+    the f32 default engine and distances come back f32."""
+    table, man = _poincare_table(rng, 300, 6, 1.0)
+    spec = spec_from_manifold(man)
+    base = QueryEngine(table, spec, chunk_rows=128)
+    bf = QueryEngine(table, spec, chunk_rows=128, scan_mode="fused",
+                     precision="bf16")
+    q = np.asarray([0, 3, 17, 150, 299], np.int32)
+    i0, d0 = (np.asarray(a) for a in base.topk_neighbors(q, 7))
+    i1, d1 = (np.asarray(a) for a in bf.topk_neighbors(q, 7))
+    assert np.array_equal(i0, i1)
+    assert d1.dtype == np.float32
+    np.testing.assert_allclose(d0, d1, rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_bf16_fused_composes(rng):
+    """The full stack at once: 4-way mesh × bf16 scan-then-rescore ×
+    fused per-shard kernel — ranks agree with the plain f32 engine and
+    distances come back f32 (the sharded fused-only case rides in
+    test_sharded_engine's mode parametrization)."""
+    from hyperspace_tpu.parallel.mesh import model_mesh
+
+    table, man = _poincare_table(rng, 300, 6, 1.0)
+    spec = spec_from_manifold(man)
+    base = QueryEngine(table, spec, chunk_rows=128)
+    sh = QueryEngine(table, spec, chunk_rows=128, scan_mode="fused",
+                     precision="bf16", mesh=model_mesh(4))
+    q = np.asarray([0, 10, 150, 299], np.int32)
+    i0, _ = base.topk_neighbors(q, 7)
+    i1, d1 = sh.topk_neighbors(q, 7)
+    assert np.array_equal(np.asarray(i0), np.asarray(i1))
+    assert np.asarray(d1).dtype == np.float32
+
+
+def _clustered_poincare(rng, n, d, nclusters):
+    from hyperspace_tpu.manifolds import PoincareBall
+
+    centers = rng.standard_normal((nclusters, d)) * 0.25
+    v = (centers[rng.integers(0, nclusters, size=n)]
+         + rng.standard_normal((n, d)) * 0.05).astype(np.float32)
+    man = PoincareBall(1.0)
+    return np.asarray(man.expmap0(jnp.asarray(v))), man
+
+
+def test_ivf_fused_matches_two_stage_probe(rng):
+    """The fused candidate scan behind the IVF probe: same cells, same
+    ranks as the two-stage probe, and the signature carries both the
+    probe identity AND the fused marker."""
+    n = IVF_MIN_TABLE_ROWS
+    table, man = _clustered_poincare(rng, n, 6, 16)
+    spec = spec_from_manifold(man)
+    idx = build_index(table, spec, 16, iters=4, seed=0)
+    two = QueryEngine(table, spec, index=idx, nprobe=4)
+    fus = QueryEngine(table, spec, index=idx, nprobe=4, scan_mode="fused")
+    assert fus.scan_signature == ("ivf", 4, idx.fingerprint, "fused")
+    assert fus.scan_signature_for(2) == ("ivf", 2, idx.fingerprint, "fused")
+    q = rng.integers(0, n, size=33).astype(np.int32)
+    i1, d1 = (np.asarray(a) for a in two.topk_neighbors(q, 5))
+    i2, d2 = (np.asarray(a) for a in fus.topk_neighbors(q, 5))
+    assert np.array_equal(i1, i2)
+    # the fused candidate Gram reduces in a different f32 order than
+    # _cand_dist's einsum — ranks identical, values a few ulp apart
+    np.testing.assert_allclose(d1, d2, rtol=1e-4, atol=1e-6)
+
+
+def test_batcher_cache_isolates_fused_from_two_stage(rng):
+    """Fused distances are only ulp-close to two-stage distances, so the
+    LRU key must keep the two engines' rows apart over the SAME table
+    (same fingerprint) — the new scan-signature element."""
+    table, man = _poincare_table(rng, 300, 6, 1.0)
+    spec = spec_from_manifold(man)
+    two, fus = _pair(table, spec)
+    assert two.fingerprint == fus.fingerprint
+    assert two.scan_signature == ("exact",)
+    assert fus.scan_signature == ("exact", "fused")
+    b_two = RequestBatcher(two)
+    b_fus = RequestBatcher(fus)
+    ids = list(range(16))
+    b_two.topk(ids, 4)
+    b_fus.topk(ids, 4)
+    assert not ({k for k in b_two.cache._d} & {k for k in b_fus.cache._d})
+    assert b_fus.stats()["scan_mode"] == "fused"
+    assert b_two.stats()["scan_mode"] == "two_stage"
+
+
+def test_auto_chunk_rows_fused_sizing():
+    """scan_mode=fused delegates chunk sizing to the kernel's VMEM
+    footprint model; unsupported kinds keep the default sizing (the
+    bit-identical-fallback contract); pinned values for known shapes."""
+    assert auto_chunk_rows(16, "poincare", 10_000_000,
+                           scan_mode="fused") == 512
+    assert auto_chunk_rows(1024, "poincare", 10_000_000,
+                           scan_mode="fused") == 128
+    # dtype enters the footprint: a bf16 table halves the tile bytes
+    assert auto_chunk_rows(256, "poincare", 10_000_000,
+                           scan_mode="fused") == 256
+    assert auto_chunk_rows(256, "poincare", 10_000_000,
+                           scan_mode="fused", dtype=jnp.bfloat16) == 512
+    # product: fused-unsupported — identical to the default sizing
+    assert auto_chunk_rows(64, "product", 10_000_000, scan_mode="fused") \
+        == auto_chunk_rows(64, "product", 10_000_000)
+    # tiny tables never over-allocate
+    assert auto_chunk_rows(4, "poincare", 40, scan_mode="fused") == 128
+    # engines pick it up: a fused engine's chunk is the kernel tile
+    rng = np.random.default_rng(0)
+    table, man = _poincare_table(rng, 5000, 16, 1.0)
+    e = QueryEngine(table, spec_from_manifold(man), scan_mode="fused")
+    assert e.chunk_rows == 512 and e._fused_kind

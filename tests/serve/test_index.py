@@ -437,3 +437,22 @@ def test_probe_telemetry_lands(rng):
     assert delta.get("serve/recall_candidates") == 8 * 2 * idx.max_cell
     hist = delta.get("hist/serve/index_probe_ms")
     assert hist and hist["count"] == 1
+
+
+def test_lloyd_fused_assignment_matches_argmin(rng, monkeypatch):
+    """On a kernel backend the Lloyd assignment runs the fused k=1
+    scan-top-k (kernels/scan_topk.py) instead of the [chunk, ncells]
+    argmin — the built index must come out the same (well-separated
+    clusters: no boundary ties for ulp differences to flip)."""
+    table, man = _clustered_poincare(rng, 600, 5, nclusters=8)
+    spec = spec_from_manifold(man)
+    import jax
+
+    base = build_index(table, spec, 8, iters=2, seed=0)
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "interpret")
+    jax.clear_caches()  # _lloyd is jitted; the mode is read at trace time
+    fused = build_index(table, spec, 8, iters=2, seed=0)
+    assert np.array_equal(base.cells, fused.cells)
+    assert np.array_equal(base.counts, fused.counts)
+    np.testing.assert_allclose(base.centroids, fused.centroids,
+                               rtol=1e-5, atol=1e-6)

@@ -37,10 +37,13 @@ def test_one_device_mesh_is_bitwise_single_device(rng):
 
 
 @pytest.mark.parametrize("build", ["poincare", "lorentz", "product"])
-@pytest.mark.parametrize("mode", ["two_stage", "carry"])
+@pytest.mark.parametrize("mode", ["two_stage", "carry", "fused"])
 def test_sharded_matches_single_device(rng, build, mode):
     """4-way sharded scan + all-gather merge == single device, on every
-    manifold spec and both scan modes (and == the f64 oracle)."""
+    manifold spec and every scan mode — including ``fused``, whose
+    per-shard scan runs the scan-top-k kernel with shard-local column
+    offsets (product composes through its bit-identical two-stage
+    fallback) — and == the f64 oracle."""
     if build == "product":
         table, man = _product_table(rng, 300)
         q = np.asarray([0, 7, 150, 299], np.int32)
