@@ -560,6 +560,216 @@ def bench_serve(repeats: int = 2) -> dict:
             "unit": "queries/s", "vs_baseline": None, "detail": detail}
 
 
+def open_loop_arrivals(n: int, qps: float, mode: str = "poisson",
+                       seed: int = 0):
+    """Arrival offsets (seconds from start) for ``n`` requests at a
+    fixed OFFERED rate of ``qps`` — the open-loop load model: arrivals
+    are scheduled by the clock, never by the previous response, so a
+    slow server accumulates queueing instead of silently throttling the
+    load (the closed-loop blind spot; docs/benchmarks.md r13).
+    ``mode="poisson"`` draws i.i.d. exponential gaps (memoryless
+    arrivals — the production-traffic null model); ``"even"`` spaces
+    them exactly 1/qps apart (deterministic, for A/B noise control)."""
+    import numpy as np
+
+    if n <= 0 or qps <= 0:
+        raise ValueError(f"need n > 0 and qps > 0; got n={n} qps={qps}")
+    if mode == "even":
+        return np.arange(n) / qps
+    if mode != "poisson":
+        raise ValueError(f"arrivals mode {mode!r} (want poisson|even)")
+    rng = np.random.default_rng(seed)
+    return np.cumsum(rng.exponential(1.0 / qps, size=n))
+
+
+def bench_serve_http(repeats: int = 2, *, qps: float = 120.0,
+                     duration_s: float = 2.0, table_rows: int = 20_000,
+                     arrivals: str = "poisson",
+                     overload_qps: float = 1200.0,
+                     overload_s: float = 0.8) -> dict:
+    """HTTP front-door latency at FIXED OFFERED LOAD (docs/serving.md
+    "HTTP front door", docs/benchmarks.md r13).
+
+    Starts the asyncio server (serve/server.py) over a continuous-
+    batching collator in-process, warms every bucket executable
+    closed-loop, then drives an **open-loop generator** (fixed offered
+    qps, Poisson or evenly-spaced arrivals, one in-process asyncio
+    client connection per request) through ``POST /v1/topk``:
+
+    - ``repeats`` passes per request-size class (1 / 16 / 64 ids — the
+      b8/b16/b64 rungs they pad to when alone), each class reporting
+      p50/p95/p99 of ``serve/e2e_ms`` as a registry mark/snapshot DELTA
+      over its passes (``detail.latency_ms.b<N>``; more repeats = more
+      samples behind the percentiles, the open-loop analog of
+      min-of-N), plus the aggregate distribution across all passes —
+      ``http_p99_ms``, the compact headline;
+    - ``recompiles_steady`` over the timed passes (0 is the contract —
+      the warmup covers the ladder, so collation can never hand the
+      compiler a fresh shape mid-leg);
+    - an **overload pass**: offered load far past capacity into a
+      ``queue_max=8`` bounded batcher — every request is answered and
+      the excess sheds with HTTP 429 (``shed_rate``), never unbounded
+      queueing.
+
+    Value = the aggregate p99 (ms) at the configured offered load.
+    CPU readings are wall-clock noisy; the shed/recompile columns are
+    the stable contract rows.
+    """
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hyperspace_tpu.manifolds import PoincareBall
+    from hyperspace_tpu.serve.batcher import RequestBatcher, bucket_for
+    from hyperspace_tpu.serve.engine import QueryEngine
+    from hyperspace_tpu.serve.server import HttpFrontDoor
+    from hyperspace_tpu.telemetry import registry as telem
+
+    telem.install_jax_monitoring_hook()
+    rng = np.random.default_rng(0)
+    n, dim, k = table_rows, 16, 10
+    table = np.asarray(PoincareBall(1.0).expmap0(
+        jnp.asarray(rng.standard_normal((n, dim)) * 0.3, jnp.float32)))
+    eng = QueryEngine(table, ("poincare", 1.0))
+    # cache OFF so every request exercises the collated device path;
+    # admission bound generous — the timed passes must not shed
+    bat = RequestBatcher(eng, min_bucket=8, max_bucket=64, cache_size=0,
+                         queue_max=256)
+    reg = telem.default_registry()
+
+    async def _post(host, port, payload):
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            body = json.dumps(payload).encode("utf-8")
+            writer.write(
+                (f"POST /v1/topk HTTP/1.1\r\nHost: bench\r\n"
+                 f"Content-Length: {len(body)}\r\n"
+                 "Connection: close\r\n\r\n").encode("latin-1") + body)
+            await writer.drain()
+            data = await reader.read()
+        finally:
+            writer.close()
+        head, _, _body = data.partition(b"\r\n\r\n")
+        return int(head.split(None, 2)[1])
+
+    async def _open_loop(host, port, sizes, pass_qps, n_req, seed):
+        """Fire n_req requests of ``sizes``-id batches at pass_qps;
+        returns {status: count}.  Arrival times come from the clock
+        (open loop), not from responses."""
+        offsets = open_loop_arrivals(n_req, pass_qps, arrivals, seed)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        tasks = []
+        for off in offsets:
+            delay = t0 + float(off) - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            ids = rng.integers(0, n, size=sizes).tolist()
+            tasks.append(asyncio.ensure_future(
+                _post(host, port, {"ids": ids, "k": k})))
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        statuses: dict = {}
+        for r in results:
+            key = (f"error:{type(r).__name__}"
+                   if isinstance(r, BaseException) else str(int(r)))
+            statuses[key] = statuses.get(key, 0) + 1
+        return statuses
+
+    def _percentiles(delta):
+        e2e = delta.get("hist/serve/e2e_ms")
+        if not e2e:
+            return None
+        return {"n": e2e["count"],
+                **{q: e2e[q] for q in ("p50", "p95", "p99")}}
+
+    async def _run():
+        detail = {
+            "num_nodes": n, "dim": dim, "k": k,
+            "buckets": list(bat.buckets), "offered_qps": qps,
+            "arrivals": arrivals, "duration_s": duration_s,
+            "backend": jax.default_backend(),
+        }
+        door = HttpFrontDoor(bat, max_wait_us=2000)
+        await door.start()
+        c0 = reg.get("jax/recompiles")
+        # closed-loop warmup: one compile per (bucket, k) — every rung
+        # of the ladder, so collation can never surface a cold shape
+        # during the timed passes
+        for b in bat.buckets:
+            await _post(door.host, door.port,
+                        {"ids": rng.integers(0, n, size=b).tolist(),
+                         "k": k})
+        c1 = reg.get("jax/recompiles")
+        detail["recompiles_warmup"] = c1 - c0
+
+        latency = {}
+        agg_base = reg.mark()
+        n_req = max(8, int(qps * duration_s))
+        # one size class per ladder region: single-id (the continuous-
+        # batching regime — collation forms its buckets), a mid bucket,
+        # and the top bucket; each pads to a DISTINCT rung when alone.
+        # ``repeats`` open-loop passes per class widen the sample count
+        # behind the percentiles (the open-loop analog of min-of-N).
+        for si, size in enumerate((1, 16, 64)):
+            pass_base = reg.mark()
+            statuses: dict = {}
+            for rep in range(max(1, repeats)):
+                got = await _open_loop(door.host, door.port, size, qps,
+                                       n_req, 16 * si + rep)
+                for key, v in got.items():
+                    statuses[key] = statuses.get(key, 0) + v
+            row = _percentiles(reg.snapshot(baseline=pass_base)) or {}
+            row["statuses"] = statuses
+            latency[f"b{bucket_for(size, bat.buckets)}"] = row
+        detail["latency_ms"] = latency
+        agg = _percentiles(reg.snapshot(baseline=agg_base))
+        if agg is None:
+            # no request observed a latency = none succeeded: the leg
+            # FAILED — never emit p99=0, which the lower-is-better
+            # trend gate would read as the best round ever
+            await door.drain()
+            raise RuntimeError(
+                "serve_http: no successful timed request — statuses "
+                f"{ {k: v['statuses'] for k, v in latency.items()} }")
+        detail["aggregate_ms"] = agg
+        detail["http_p99_ms"] = agg["p99"]
+        detail["recompiles_steady"] = reg.get("jax/recompiles") - c1
+        await door.drain()
+
+        # overload pass: offered load far past capacity into a small
+        # bounded queue — the excess must shed with HTTP 429 (never
+        # queue unboundedly) and EVERY request must still be answered
+        obat = RequestBatcher(eng, min_bucket=8, max_bucket=64,
+                              cache_size=0, queue_max=8,
+                              deadline_ms=1000.0, ladder_down_after=3)
+        odoor = HttpFrontDoor(obat, max_wait_us=2000)
+        await odoor.start()
+        offered = max(16, int(overload_qps * overload_s))
+        statuses = await _open_loop(odoor.host, odoor.port, 1,
+                                    overload_qps, offered, 99)
+        await odoor.drain()
+        answered = sum(v for s, v in statuses.items()
+                       if not s.startswith("error"))
+        shed = statuses.get("429", 0)
+        detail["overload"] = {
+            "offered": offered, "offered_qps": overload_qps,
+            "queue_max": 8, "statuses": statuses,
+            "answered": answered,
+            "shed": shed,
+            "deadline_exceeded": statuses.get("504", 0),
+        }
+        detail["shed_rate"] = round(shed / offered, 3)
+        detail["deadline_rate"] = round(
+            statuses.get("504", 0) / offered, 3)
+        return detail
+
+    detail = asyncio.run(_run())
+    return {"metric": "serve_http_p99_ms", "value": detail["http_p99_ms"],
+            "unit": "ms", "vs_baseline": None, "detail": detail}
+
+
 def bench_resilience(repeats: int = 1) -> dict:
     """Chaos recovery + overload shedding (docs/resilience.md).
 
@@ -828,6 +1038,15 @@ _COMPACT_FIELDS = (
      ("detail", "serve", "fused_vs_unfused", "serve_fused_speedup")),
     ("fused_speedup",
      ("detail", "fused_vs_unfused", "serve_fused_speedup")),
+    # HTTP front door at fixed offered load (r13): aggregate p99 and
+    # the overload pass's 429 shed rate — one path pair per field for
+    # auto mode's nested leg vs --metric serve_http's flat detail.
+    # Lower is better for both; scripts/bench_trend.py registers the
+    # shed/deadline tokens direction-correctly.
+    ("http_p99_ms", ("detail", "serve_http", "http_p99_ms")),
+    ("http_p99_ms", ("detail", "http_p99_ms")),
+    ("http_shed_rate", ("detail", "serve_http", "shed_rate")),
+    ("http_shed_rate", ("detail", "shed_rate")),
     ("precision_train_ms", ("detail", "precision", "train_step_ms")),
     ("precision_serve_ms", ("detail", "precision", "serve_scan_ms")),
     # failure-domain leg (PR 9): chaos recovery + the shed-rate column
@@ -960,7 +1179,9 @@ def emit(result: dict) -> None:
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--metric", choices=["auto", "hgcn", "poincare", "serve"],
+    p.add_argument("--metric",
+                   choices=["auto", "hgcn", "poincare", "serve",
+                            "serve_http"],
                    default="auto")
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
@@ -994,7 +1215,8 @@ def main() -> None:
                                 use_att=args.use_att, step=args.step,
                                 decoder_dtype=args.decoder_dtype)
     primary = {"poincare": bench_poincare,
-               "serve": bench_serve}.get(args.metric, hgcn_fn)
+               "serve": bench_serve,
+               "serve_http": bench_serve_http}.get(args.metric, hgcn_fn)
     primary_name = args.metric if args.metric != "auto" else "hgcn"
 
     # the headline metric NEVER switches silently: a failure of the
@@ -1080,6 +1302,10 @@ def main() -> None:
                 r = bench_serve(repeats=max(1, args.repeats - 1))
                 d["serve"] = {"qps": r["value"], **r["detail"]}
 
+            def serve_http_leg(d):  # open-loop HTTP latency (r13)
+                r = bench_serve_http(repeats=max(1, args.repeats - 1))
+                d["serve_http"] = {"p99_ms": r["value"], **r["detail"]}
+
             def precision_leg(d):  # f32/bf16 pairs, tracked from PR 5 on
                 r = bench_precision(repeats=max(1, args.repeats - 1))
                 d["precision"] = {"train_speedup": r["value"],
@@ -1114,6 +1340,7 @@ def main() -> None:
             leg("poincare", 60, poincare_leg)
             leg("hgcn_sampled", 45, sampled_leg)
             leg("serve_qps", 40, serve_leg)
+            leg("serve_http", 35, serve_http_leg)
             leg("precision", 40, precision_leg)
             leg("resilience", 25, resilience_leg)
             leg("realistic", 150, realistic_leg)

@@ -4,6 +4,7 @@ Each rule is grounded in an incident from this repo's history (see the
 module docstrings and docs/static-analysis.md for the catalog).
 """
 
+from hyperspace_tpu.analysis.rules.asyncblock import BlockingCallInAsyncRule
 from hyperspace_tpu.analysis.rules.catalog import TelemetryCatalogRule
 from hyperspace_tpu.analysis.rules.distmat import MaterializedDistmatRule
 from hyperspace_tpu.analysis.rules.donation import DonationHazardRule
@@ -22,6 +23,7 @@ ALL_RULES = (
     TracerLeakRule,
     SwallowBaseExceptionRule,
     UnboundedRetryRule,
+    BlockingCallInAsyncRule,
     MaterializedDistmatRule,
     PrecisionLiteralRule,
     TelemetryCatalogRule,

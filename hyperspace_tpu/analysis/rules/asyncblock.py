@@ -1,0 +1,112 @@
+"""blocking-call-in-async: synchronous blocking calls on the event loop.
+
+Historical incident (foregrounded by the r13 subsystem this rule ships
+with): the HTTP front door (``serve/server.py`` + ``serve/collator.py``)
+runs EVERY request on one asyncio event loop — the whole point of the
+continuous-batching design is that the loop only ever parks on
+awaitables while device work rides the dispatch executor.  One stray
+``time.sleep`` (or a blocking socket call, or sync file I/O) inside an
+``async def`` freezes every in-flight request for its duration: the
+p99-at-offered-qps headline degrades by exactly that blocking time, and
+under load the bounded admission queue fills and sheds — an outage shape
+that profiles as "the server is slow" rather than "this one line parks
+the loop".
+
+What fires — calls lexically inside an ``async def`` body whose NEAREST
+enclosing function is that ``async def`` (a nested sync ``def`` is a
+helper that may legitimately run on the executor; calls inside it are
+out of scope):
+
+- ``time.sleep(...)`` — the asyncio analog is ``await asyncio.sleep``;
+- blocking ``socket``-module calls (``socket.socket``,
+  ``socket.create_connection``, ``socket.getaddrinfo``, …) — use the
+  loop's ``asyncio.open_connection`` / ``loop.getaddrinfo``;
+- sync file I/O: builtin ``open`` / ``io.open``, ``os.popen``,
+  ``subprocess.run``/``check_output``/``call``, and ``pathlib``-style
+  ``.read_text()`` / ``.write_text()`` / ``.read_bytes()`` /
+  ``.write_bytes()`` attribute calls — push them through
+  ``run_in_executor``.
+
+The escape hatch is the standard suppression grammar, one annotated
+line per accepted call::
+
+    data = path.read_text()  # hyperlint: disable=blocking-call-in-async — startup-only, loop not serving yet
+
+There is deliberately no module-level escape: every accepted block on
+the event loop stays visible at its line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+
+# resolved dotted names that block outright
+_BLOCKING_RESOLVED = {
+    "time.sleep": "time.sleep(...) parks the event loop — use "
+                  "`await asyncio.sleep(...)`",
+    "open": "sync file I/O on the event loop — run it on an executor "
+            "(`loop.run_in_executor`)",
+    "io.open": "sync file I/O on the event loop — run it on an executor",
+    "os.popen": "blocking subprocess pipe on the event loop — use "
+                "`asyncio.create_subprocess_*`",
+    "subprocess.run": "blocking subprocess on the event loop — use "
+                      "`asyncio.create_subprocess_*`",
+    "subprocess.check_output": "blocking subprocess on the event loop — "
+                               "use `asyncio.create_subprocess_*`",
+    "subprocess.call": "blocking subprocess on the event loop — use "
+                       "`asyncio.create_subprocess_*`",
+}
+# any call into the socket module blocks (or hands back an object whose
+# use blocks); asyncio's stream/loop APIs are the non-blocking surface
+_SOCKET_PREFIX = "socket."
+# pathlib-style sync file I/O by method name (receiver type unknowable
+# statically; these names have no common non-blocking homonym)
+_FILE_IO_ATTRS = {"read_text", "write_text", "read_bytes", "write_bytes"}
+
+
+def _async_body_calls(ctx: FileContext):
+    """Call nodes whose nearest enclosing function is an ``async def``."""
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        stack = list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested function: its own scope (async ones
+                # are walked by the outer ast.walk pass)
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+
+class BlockingCallInAsyncRule(Rule):
+    id = "blocking-call-in-async"
+    severity = "error"
+    summary = ("time.sleep / blocking socket calls / sync file I/O "
+               "inside async def bodies")
+
+    def check_file(self, ctx: FileContext):
+        findings = []
+        for call in _async_body_calls(ctx):
+            resolved = ctx.resolve(call.func) or ""
+            why = _BLOCKING_RESOLVED.get(resolved)
+            if why is None and resolved.startswith(_SOCKET_PREFIX):
+                why = (f"`{resolved}` is a blocking socket call — use "
+                       "asyncio streams (`asyncio.open_connection`) or "
+                       "the loop's socket methods")
+            if (why is None and isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _FILE_IO_ATTRS):
+                why = (f".{call.func.attr}() is sync file I/O — run it "
+                       "on an executor (`loop.run_in_executor`)")
+            if why is None:
+                continue
+            findings.append(self.finding(
+                ctx, call,
+                f"blocking call inside an async def: {why}; every "
+                "in-flight request on this event loop stalls for its "
+                "duration — or suppress with a reason if the loop is "
+                "provably not serving here"))
+        return findings

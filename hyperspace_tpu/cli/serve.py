@@ -15,6 +15,11 @@ Three modes, same ``key=value`` override grammar as the train CLI:
     # stdin/JSONL loop: one request per line, one JSON response per line
     python -m hyperspace_tpu.cli.serve serve artifact=... telemetry=1
 
+    # asyncio HTTP front door with continuous batching (port=0 =
+    # ephemeral; the bound port is announced on stderr)
+    python -m hyperspace_tpu.cli.serve serve-http artifact=... \
+        port=8080 max_wait_us=2000 queue_max=64 deadline_ms=50
+
     # shard the table across the chips (mesh=-1 = all local devices)
     python -m hyperspace_tpu.cli.serve serve artifact=... mesh=-1
 
@@ -118,6 +123,14 @@ class ServeConfig:
     # chaos=serve.dispatch:latency:ms=50:times=3
     chaos: str | None = None
     chaos_seed: int = 0
+    # --- HTTP front door (serve-http mode; docs/serving.md) ------------
+    # bind address + port (0 = ephemeral; the bound port is announced
+    # as "[serve-http] listening on HOST:PORT" on stderr)
+    host: str = "127.0.0.1"
+    port: int = 0
+    # continuous-batching max wait: a pending bucket that has not
+    # exactly filled a power-of-two rung flushes after this many µs
+    max_wait_us: float = 2000.0
 
 
 def _ids(s: str, name: str) -> list[int]:
@@ -403,6 +416,44 @@ def run_serve(cfg: ServeConfig, *, stdin=None, stdout=None) -> dict:
             "drained": draining.is_set(), **batcher.stats()}
 
 
+def run_serve_http(cfg: ServeConfig, *, ready=None) -> dict:
+    """The asyncio HTTP front door (serve/server.py): concurrent
+    ``POST /v1/topk`` / ``/v1/score`` / ``/v1/stats`` + ``GET
+    /healthz`` over the continuous-batching collator; SIGTERM drains
+    exactly like the stdin loop (in-flight answered, new connections
+    refused, latency summary on stderr).  ``ready(host, port)`` is
+    called once the listener is bound — the default announces the port
+    on stderr as a parseable ``[serve-http] listening on HOST:PORT``
+    line (port=0 binds an ephemeral port)."""
+    import asyncio
+
+    from hyperspace_tpu.serve.server import run_front_door
+
+    if cfg.max_wait_us < 0:  # usage error BEFORE the artifact load pays
+        raise SystemExit(
+            f"max_wait_us must be >= 0; got {cfg.max_wait_us}")
+    _eng, batcher = _build(cfg)
+
+    def announce(host, port):
+        try:
+            print(f"[serve-http] listening on {host}:{port}",
+                  file=sys.stderr, flush=True)
+        except (OSError, ValueError):
+            pass  # hyperlint: disable=swallow-base-exception — closed stderr: announcement loss only
+        if ready is not None:
+            ready(host, port)
+
+    try:
+        result = asyncio.run(run_front_door(
+            batcher, host=cfg.host, port=cfg.port,
+            max_wait_us=cfg.max_wait_us, ready=announce))
+    except OSError as e:  # bind failure (port in use, bad host): usage
+        raise SystemExit(
+            f"serve-http: cannot bind {cfg.host}:{cfg.port} — {e}"
+        ) from None
+    return {"mode": "serve_http", **result, **batcher.stats()}
+
+
 class _ParseError(Exception):
     """Internal marker: the line was not JSON at all (kind=parse)."""
 
@@ -452,7 +503,8 @@ def _line_source(stdin, draining):
     return _poll_lines(fd, draining)
 
 
-MODES = {"export": run_export, "query": run_query, "serve": run_serve}
+MODES = {"export": run_export, "query": run_query, "serve": run_serve,
+         "serve-http": run_serve_http}
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -497,9 +549,11 @@ def main(argv: list[str] | None = None) -> int:
                               telem.snapshot("ctr/")}),
                   file=sys.stderr, flush=True)
     # serve mode's stdout is the response stream (one line per request,
-    # strictly); its closing stats are diagnostics and go to stderr
+    # strictly) and serve-http's responses ride the sockets; both
+    # modes' closing stats are diagnostics and go to stderr
     print(json.dumps(_json_safe(result)),
-          file=sys.stderr if args.mode == "serve" else sys.stdout)
+          file=(sys.stderr if args.mode in ("serve", "serve-http")
+                else sys.stdout))
     return 0
 
 

@@ -18,7 +18,15 @@ answering retrieval queries (docs/serving.md):
                hysteresis degradation ladder (docs/resilience.md)
   errors.py    the typed error taxonomy (`error.kind`: parse /
                validation / deadline_exceeded / overloaded / internal)
-  cli/serve.py the `export` / `query` / `serve` entry points
+  collator.py  continuous-batching collator: fill a power-of-two bucket
+               or flush at the max-wait deadline, one shared dispatch
+               per flush through a single dispatch executor
+  server.py    asyncio HTTP/1.1 front door (stdlib only): concurrent
+               POST /v1/topk | /v1/score | /v1/stats + /healthz,
+               deadline propagation from socket accept, 429/504 typed
+               errors, SIGTERM drain
+  cli/serve.py the `export` / `query` / `serve` / `serve-http` entry
+               points
 """
 
 from hyperspace_tpu.serve.artifact import (  # noqa: F401
@@ -31,6 +39,7 @@ from hyperspace_tpu.serve.artifact import (  # noqa: F401
     spec_from_manifold,
 )
 from hyperspace_tpu.serve.batcher import RequestBatcher  # noqa: F401
+from hyperspace_tpu.serve.collator import Collator  # noqa: F401
 from hyperspace_tpu.serve.engine import QueryEngine  # noqa: F401
 from hyperspace_tpu.serve.errors import (  # noqa: F401
     DeadlineExceededError,

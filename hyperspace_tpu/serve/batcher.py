@@ -68,6 +68,23 @@ thread-safe; the admission counter and ladder carry their own locks
 (concurrent callers — threads today, the async front door next — are
 the population admission control exists for; the blocking CLI loop
 never sheds).  One batcher serves one engine (one artifact).
+
+**Pipeline stages** (the continuous-batching refactor): ``topk`` is a
+composition of four callable stages — :meth:`RequestBatcher.
+validate_topk_request` (host-side id/k validation), :meth:`~Request
+Batcher.plan_topk` (ladder mode → effective nprobe + the cache key
+function), :meth:`~RequestBatcher.cache_pass` (per-unique-id LRU
+lookup + hit/miss counters + the cache-only shed), and :meth:`~Request
+Batcher.dispatch_topk` (bucket-pad, chaos site, engine call, cache
+put) — so the asyncio collator (``serve/collator.py``) can run the
+same validation/cache/dispatch code with its OWN queueing between the
+cache pass and the dispatch, instead of forking the pipeline.
+``dispatch_topk`` takes a ``lives`` sequence: a collated flush
+attributes the one shared device dispatch to every participating
+request's lifecycle while counting the engine slots exactly once.
+``t_enq=`` on the public entries backdates the lifecycle's enqueue
+stamp (and therefore the deadline origin) to socket-accept time — in
+the HTTP front door, queue time counts against the budget.
 """
 
 from __future__ import annotations
@@ -183,22 +200,30 @@ class _Lifecycle:
     Shared by ``topk`` and ``score`` so the stamping contract (module
     docstring, "Per-request lifecycle") lives in exactly one place:
     construct at enqueue, ``formed()`` once validation + cache pass are
-    done, bracket each slab's device work with ``dispatch_start()`` /
-    ``dispatch_done()`` (the result fetch belongs INSIDE the bracket —
-    dispatch is async enqueue, the fetch is the completion wait), and
-    ``finish()`` to observe.  ``serve/dispatch_ms`` is only observed
-    when a slab actually dispatched, so all-cache-hit requests don't
-    pull it toward zero.  ``info`` is the span's ``args`` dict (None
-    when tracing is off — the disabled hot path stays allocation-free);
-    it is read at span exit, so fields landing after ``span()`` entry
-    still make the trace.
+    done, attribute each slab's device work via ``slab()`` +
+    ``add_dispatch()`` (the result fetch belongs INSIDE the timed
+    window — dispatch is async enqueue, the fetch is the completion
+    wait), and ``finish()`` to observe.  ``serve/dispatch_ms`` is only
+    observed when a slab actually dispatched, so all-cache-hit requests
+    don't pull it toward zero.  ``info`` is the span's ``args`` dict
+    (None when tracing is off — the disabled hot path stays
+    allocation-free); it is read at span exit, so fields landing after
+    ``span()`` entry still make the trace.
+
+    ``t_enq=`` backdates the enqueue stamp (the HTTP front door stamps
+    at socket accept, so collator queue time counts against both the
+    latency histograms and the deadline); the ``serve/slots`` /
+    ``serve/padded_waste`` counters moved to the dispatch helper — a
+    collated flush shared by several lifecycles must count its engine
+    slots exactly once.
     """
 
     __slots__ = ("t_enq", "t_form", "info", "buckets_used",
-                 "dispatch_s", "_t_disp", "t_deadline")
+                 "dispatch_s", "t_deadline")
 
-    def __init__(self, op: str, deadline_ms: Optional[float] = None):
-        self.t_enq = time.perf_counter()
+    def __init__(self, op: str, deadline_ms: Optional[float] = None,
+                 t_enq: Optional[float] = None):
+        self.t_enq = time.perf_counter() if t_enq is None else t_enq
         self.t_form = self.t_enq
         self.info: Optional[dict] = {"op": op} if tracing() else None
         self.buckets_used: list = []
@@ -224,16 +249,11 @@ class _Lifecycle:
                 f"({(time.perf_counter() - self.t_enq) * 1e3:.1f} ms "
                 "elapsed)")
 
-    def slab(self, bucket: int, used: int) -> None:
+    def slab(self, bucket: int) -> None:
         self.buckets_used.append(bucket)
-        telem.inc("serve/slots", bucket)
-        telem.inc("serve/padded_waste", bucket - used)
 
-    def dispatch_start(self) -> None:
-        self._t_disp = time.perf_counter()
-
-    def dispatch_done(self) -> None:
-        self.dispatch_s += time.perf_counter() - self._t_disp
+    def add_dispatch(self, seconds: float) -> None:
+        self.dispatch_s += seconds
 
     def finish(self) -> None:
         if self.info is not None:
@@ -360,77 +380,156 @@ class RequestBatcher:
             return None
         return self._modes[self._ladder.level]
 
+    # --- pipeline stages (module docstring, "Pipeline stages") ---------------
+
+    def validate_topk_request(self, ids, k) -> tuple[list[int], int]:
+        """Host-side request validation: the id list and k, reject-
+        don't-coerce (same policy notes as :func:`_checked_ids`)."""
+        ids = _checked_ids(ids, "ids", self.engine.num_nodes)
+        if isinstance(k, bool):  # True would index-coerce to k=1
+            raise ValueError("k must be an integer; got bool")
+        try:  # same reject-don't-truncate policy as the ids
+            k = operator.index(k)
+        except TypeError:
+            raise ValueError(
+                f"k must be an integer; got {type(k).__name__}") from None
+        return ids, k
+
+    def plan_topk(self, k: int, exclude_self: bool):
+        """``(keyf, nprobe_ov, cache_only)``: the ladder's current
+        quality mode resolved into an effective nprobe override (or
+        None = full width) and the cache key function for this
+        (k, exclude_self) under that mode."""
+        mode = self._mode()
+        nprobe_ov = None
+        if isinstance(mode, int):
+            # degraded probe width, clamped so the narrowed
+            # probe can still hold k rows (capacity = p×max_cell)
+            mc = self.engine.index.max_cell
+            nprobe_ov = min(max(mode, -(-k // mc)), self.engine.nprobe)
+            if nprobe_ov >= self.engine.nprobe:
+                nprobe_ov = None  # clamped back to full width
+        fp = self.engine.fingerprint
+        # cache keys carry exclude_self, the engine's precision
+        # mode, AND the EFFECTIVE scan signature (("exact",) or
+        # ("ivf", nprobe, index fingerprint) — the ladder's
+        # narrowed width included): the same (fp, id, k) has
+        # distinct answers per flag, a bf16-scan engine's rows
+        # must never be served back by an f32 engine over the
+        # same table (same fingerprint!), and an approximate
+        # probed answer must never be served back as an exact
+        # one — or at a different width, through a different
+        # index, or vice versa
+        prec = self.engine.precision
+        scan = (self.engine.scan_signature_for(nprobe_ov)
+                if nprobe_ov is not None
+                else self.engine.scan_signature)
+        keyf = lambda qid: (fp, qid, k, exclude_self, prec, scan)
+        return keyf, nprobe_ov, mode == _CACHE_ONLY
+
+    def cache_pass(self, ids: Sequence[int], keyf,
+                   cache_only: bool) -> tuple[dict, list[int]]:
+        """``(rows, misses)`` over the request's UNIQUE ids — a
+        duplicate within the request is one compute (and one counter
+        event), hot or cold.  Under cache-only degradation a cold id
+        is shed (NOT counted as a cache miss — nothing was computed)
+        rather than dispatched."""
+        rows: dict[int, tuple] = {}
+        misses: list[int] = []
+        for qid in dict.fromkeys(ids):
+            hit = self.cache.get(keyf(qid))
+            if hit is not None:
+                rows[qid] = hit
+            else:
+                misses.append(qid)
+        telem.inc("serve/cache_hit", len(rows))
+        if cache_only and misses:
+            raise OverloadedError(
+                f"cache-only degradation: {len(misses)} cold "
+                "id(s) in the request")
+        telem.inc("serve/cache_miss", len(misses))
+        return rows, misses
+
+    def dispatch_topk(self, misses: Sequence[int], k: int, *,
+                      exclude_self: bool, nprobe_ov, keyf,
+                      lives: Sequence[_Lifecycle],
+                      deadline_life: Optional[_Lifecycle] = None) -> dict:
+        """Dispatch ``misses`` through the engine in bucket-padded
+        slabs; returns ``{qid: (idx row, dist row)}`` (rows also land
+        in the LRU).  The one device dispatch is attributed to EVERY
+        lifecycle in ``lives`` (a collated flush shares it) while the
+        ``serve/slots``/``serve/padded_waste`` counters count each slab
+        once.  ``deadline_life`` (the sync path's own request) enforces
+        the before-dispatch deadline check per slab — an expired
+        request is never dispatched late; a collated flush checks
+        expiry per member at flush time instead, so one member's
+        deadline cannot fail the whole batch."""
+        rows: dict[int, tuple] = {}
+        for s in range(0, len(misses), self.buckets[-1]):
+            if deadline_life is not None:
+                # the engine call is the unrecallable cost
+                deadline_life.check_deadline("before dispatch")
+            slab = list(misses[s : s + self.buckets[-1]])
+            b = bucket_for(len(slab), self.buckets)
+            telem.inc("serve/slots", b)
+            telem.inc("serve/padded_waste", b - len(slab))
+            for life in lives:
+                life.slab(b)
+            padded = slab + [slab[-1]] * (b - len(slab))
+            if faults.active():
+                faults.hit("serve.dispatch")  # chaos site
+            t0 = time.perf_counter()
+            try:
+                idx, dist = self.engine.topk_neighbors(
+                    np.asarray(padded, np.int32), k,
+                    exclude_self=exclude_self, nprobe=nprobe_ov)
+            except ValueError as e:
+                if (nprobe_ov is not None
+                        and "under-filled" in str(e)):
+                    # the SERVER narrowed the probe, not the
+                    # client: a width that under-fills at the
+                    # degraded level is an overload symptom,
+                    # never a fix-your-request validation error
+                    raise OverloadedError(
+                        f"degraded probe width {nprobe_ov} "
+                        f"under-filled for k={k}; retry later"
+                    ) from e
+                raise
+            idx = np.asarray(idx)
+            dist = np.asarray(dist)
+            dt = time.perf_counter() - t0
+            for life in lives:
+                life.add_dispatch(dt)
+            for j, qid in enumerate(slab):
+                val = (idx[j].copy(), dist[j].copy())
+                rows[qid] = val
+                self.cache.put(keyf(qid), val)
+        self._update_gauges()
+        return rows
+
     # --- top-k ----------------------------------------------------------------
 
     def topk(self, ids, k: int, *, exclude_self: bool = True,
-             deadline_ms: Optional[float] = None
+             deadline_ms: Optional[float] = None,
+             t_enq: Optional[float] = None
              ) -> tuple[np.ndarray, np.ndarray]:
         """``(neighbors [B, k] int32, dists [B, k] float)`` in request
         order; cache-aware, bucket-padded.  ``deadline_ms`` overrides
         the batcher default for this request (None = the default;
-        module docstring, "Overload safety")."""
+        module docstring, "Overload safety"); ``t_enq`` backdates the
+        enqueue stamp to an earlier ``time.perf_counter()`` reading
+        (socket-accept time — queue time counts against the deadline)."""
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
-        life = _Lifecycle("topk", deadline_ms)
+        life = _Lifecycle("topk", deadline_ms, t_enq=t_enq)
         telem.inc("serve/requests")
         self._admit()
         try:
             with span("query", args=life.info):
-                ids = _checked_ids(ids, "ids", self.engine.num_nodes)
-                if isinstance(k, bool):  # True would index-coerce to k=1
-                    raise ValueError("k must be an integer; got bool")
-                try:  # same reject-don't-truncate policy as the ids
-                    k = operator.index(k)
-                except TypeError:
-                    raise ValueError(
-                        f"k must be an integer; got {type(k).__name__}"
-                    ) from None
-                mode = self._mode()
-                nprobe_ov = None
-                if isinstance(mode, int):
-                    # degraded probe width, clamped so the narrowed
-                    # probe can still hold k rows (capacity = p×max_cell)
-                    mc = self.engine.index.max_cell
-                    nprobe_ov = min(max(mode, -(-k // mc)),
-                                    self.engine.nprobe)
-                    if nprobe_ov >= self.engine.nprobe:
-                        nprobe_ov = None  # clamped back to full width
-                fp = self.engine.fingerprint
-                # cache keys carry exclude_self, the engine's precision
-                # mode, AND the EFFECTIVE scan signature (("exact",) or
-                # ("ivf", nprobe, index fingerprint) — the ladder's
-                # narrowed width included): the same (fp, id, k) has
-                # distinct answers per flag, a bf16-scan engine's rows
-                # must never be served back by an f32 engine over the
-                # same table (same fingerprint!), and an approximate
-                # probed answer must never be served back as an exact
-                # one — or at a different width, through a different
-                # index, or vice versa
-                prec = self.engine.precision
-                scan = (self.engine.scan_signature_for(nprobe_ov)
-                        if nprobe_ov is not None
-                        else self.engine.scan_signature)
-                keyf = lambda qid: (fp, qid, k, exclude_self, prec, scan)
-                rows: dict[int, tuple] = {}
-                misses = []
-                # hit/miss are per UNIQUE id: a duplicate within the
-                # request is one compute (and one counter event), hot
-                # or cold
-                for qid in dict.fromkeys(ids):
-                    hit = self.cache.get(keyf(qid))
-                    if hit is not None:
-                        rows[qid] = hit
-                    else:
-                        misses.append(qid)
-                telem.inc("serve/cache_hit", len(rows))
-                if mode == _CACHE_ONLY and misses:
-                    # terminal degradation: only the cache answers; a
-                    # cold id is shed (NOT counted as a cache miss —
-                    # nothing was computed) rather than dispatched
-                    raise OverloadedError(
-                        f"cache-only degradation: {len(misses)} cold "
-                        "id(s) in the request")
-                telem.inc("serve/cache_miss", len(misses))
+                ids, k = self.validate_topk_request(ids, k)
+                keyf, nprobe_ov, cache_only = self.plan_topk(
+                    k, exclude_self)
+                rows, misses = self.cache_pass(ids, keyf, cache_only)
                 # batch-form stamp: validation + cache pass done, device
                 # work (if any) starts now
                 life.formed()
@@ -439,41 +538,10 @@ class RequestBatcher:
                     life.info.update(requests=len(ids), k=k,
                                      cache_hits=len(rows),
                                      cache_misses=len(misses))
-                for s in range(0, len(misses), self.buckets[-1]):
-                    # an expired request is never dispatched late: the
-                    # engine call is the unrecallable cost
-                    life.check_deadline("before dispatch")
-                    slab = misses[s : s + self.buckets[-1]]
-                    b = bucket_for(len(slab), self.buckets)
-                    life.slab(b, len(slab))
-                    padded = slab + [slab[-1]] * (b - len(slab))
-                    if faults.active():
-                        faults.hit("serve.dispatch")  # chaos site
-                    life.dispatch_start()
-                    try:
-                        idx, dist = self.engine.topk_neighbors(
-                            np.asarray(padded, np.int32), k,
-                            exclude_self=exclude_self, nprobe=nprobe_ov)
-                    except ValueError as e:
-                        if (nprobe_ov is not None
-                                and "under-filled" in str(e)):
-                            # the SERVER narrowed the probe, not the
-                            # client: a width that under-fills at the
-                            # degraded level is an overload symptom,
-                            # never a fix-your-request validation error
-                            raise OverloadedError(
-                                f"degraded probe width {nprobe_ov} "
-                                f"under-filled for k={k}; retry later"
-                            ) from e
-                        raise
-                    idx = np.asarray(idx)
-                    dist = np.asarray(dist)
-                    life.dispatch_done()
-                    for j, qid in enumerate(slab):
-                        val = (idx[j].copy(), dist[j].copy())
-                        rows[qid] = val
-                        self.cache.put(keyf(qid), val)
-                self._update_gauges()
+                rows.update(self.dispatch_topk(
+                    misses, k, exclude_self=exclude_self,
+                    nprobe_ov=nprobe_ov, keyf=keyf, lives=(life,),
+                    deadline_life=life))
                 out_i = np.stack([rows[qid][0] for qid in ids])
                 out_d = np.stack([rows[qid][1] for qid in ids])
                 # a result computed past the deadline is answered
@@ -487,9 +555,56 @@ class RequestBatcher:
 
     # --- edge scores ----------------------------------------------------------
 
+    def validate_score_request(self, u_ids,
+                               v_ids) -> tuple[np.ndarray, np.ndarray]:
+        """Host-side score validation: matching int id arrays."""
+        n = self.engine.num_nodes
+        u = np.asarray(_checked_ids(u_ids, "u", n), np.int64)
+        v = np.asarray(_checked_ids(v_ids, "v", n), np.int64)
+        if u.shape != v.shape:
+            raise ValueError(
+                f"score: need matching id lists; got "
+                f"{u.shape} vs {v.shape}")
+        return u, v
+
+    def dispatch_score(self, u: np.ndarray, v: np.ndarray, *,
+                       prob: bool, fd_r: float, fd_t: float,
+                       lives: Sequence[_Lifecycle],
+                       deadline_life: Optional[_Lifecycle] = None
+                       ) -> np.ndarray:
+        """Slab-dispatch validated edge pairs (the score analog of
+        :meth:`dispatch_topk`; same slot-counting and lifecycle-
+        attribution contract)."""
+        out = np.empty((u.size,), np.float64)
+        top = self.buckets[-1]
+        for s in range(0, u.size, top):
+            if deadline_life is not None:
+                deadline_life.check_deadline("before dispatch")
+            su, sv = u[s : s + top], v[s : s + top]
+            b = bucket_for(su.size, self.buckets)
+            telem.inc("serve/slots", b)
+            telem.inc("serve/padded_waste", b - su.size)
+            for life in lives:
+                life.slab(b)
+            pu = np.concatenate([su, np.full(b - su.size, su[-1])])
+            pv = np.concatenate([sv, np.full(b - sv.size, sv[-1])])
+            if faults.active():
+                faults.hit("serve.dispatch")  # chaos site
+            t0 = time.perf_counter()
+            d = self.engine.score_edges(
+                pu.astype(np.int32), pv.astype(np.int32),
+                prob=prob, fd_r=fd_r, fd_t=fd_t)
+            out[s : s + su.size] = np.asarray(d)[: su.size]
+            dt = time.perf_counter() - t0
+            for life in lives:
+                life.add_dispatch(dt)
+        self._update_gauges()
+        return out
+
     def score(self, u_ids, v_ids, *, prob: bool = False,
               fd_r: float = 2.0, fd_t: float = 1.0,
-              deadline_ms: Optional[float] = None) -> np.ndarray:
+              deadline_ms: Optional[float] = None,
+              t_enq: Optional[float] = None) -> np.ndarray:
         """Bucket-padded ``engine.score_edges`` ([B] in request order).
 
         Same admission/deadline contract as :meth:`topk`; edge scoring
@@ -497,7 +612,7 @@ class RequestBatcher:
         score request (an uncached op has nothing cheaper to serve)."""
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
-        life = _Lifecycle("score", deadline_ms)
+        life = _Lifecycle("score", deadline_ms, t_enq=t_enq)
         telem.inc("serve/requests")
         self._admit()
         try:
@@ -506,35 +621,14 @@ class RequestBatcher:
                     raise OverloadedError(
                         "cache-only degradation: edge scoring is "
                         "uncached")
-                n = self.engine.num_nodes
-                u = np.asarray(_checked_ids(u_ids, "u", n), np.int64)
-                v = np.asarray(_checked_ids(v_ids, "v", n), np.int64)
-                if u.shape != v.shape:
-                    raise ValueError(
-                        f"score: need matching id lists; got "
-                        f"{u.shape} vs {v.shape}")
+                u, v = self.validate_score_request(u_ids, v_ids)
                 life.formed()
                 life.check_deadline("after validation")
                 if life.info is not None:
                     life.info["requests"] = int(u.size)
-                out = np.empty((u.size,), np.float64)
-                top = self.buckets[-1]
-                for s in range(0, u.size, top):
-                    life.check_deadline("before dispatch")
-                    su, sv = u[s : s + top], v[s : s + top]
-                    b = bucket_for(su.size, self.buckets)
-                    life.slab(b, su.size)
-                    pu = np.concatenate([su, np.full(b - su.size, su[-1])])
-                    pv = np.concatenate([sv, np.full(b - sv.size, sv[-1])])
-                    if faults.active():
-                        faults.hit("serve.dispatch")  # chaos site
-                    life.dispatch_start()
-                    d = self.engine.score_edges(
-                        pu.astype(np.int32), pv.astype(np.int32),
-                        prob=prob, fd_r=fd_r, fd_t=fd_t)
-                    out[s : s + su.size] = np.asarray(d)[: su.size]
-                    life.dispatch_done()
-                self._update_gauges()
+                out = self.dispatch_score(u, v, prob=prob, fd_r=fd_r,
+                                          fd_t=fd_t, lives=(life,),
+                                          deadline_life=life)
                 life.check_deadline("at completion")
                 life.finish()
                 return out

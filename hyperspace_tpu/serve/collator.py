@@ -1,0 +1,290 @@
+"""Continuous-batching collator: fill a bucket or flush at T µs.
+
+The blocking CLI loop feeds the batcher one request at a time, so every
+dispatch carries exactly one request's ids (padded) — concurrency never
+amortizes a device program across callers.  The collator is the piece
+that makes the bucket ladder earn its keep under concurrent load
+(docs/serving.md "HTTP front door"): requests arriving on the asyncio
+event loop run the batcher's validation + cache pass immediately, and
+their cold ids accumulate in a **pending bucket** per
+``(k, exclude_self, effective-nprobe)`` group.  A group flushes when
+
+- its unique pending ids **exactly fill a power-of-two bucket** of the
+  batcher's ladder (zero padding — nothing is gained by waiting, the
+  next arrivals seed the next batch), or reach the top bucket
+  (slab-split handles the rest), or
+- the **max-wait deadline** ``max_wait_us`` expires, counted from the
+  moment the group became non-empty — a lone request is never held
+  longer than T waiting for company.
+
+Whichever comes first.  A flush is one
+:meth:`~hyperspace_tpu.serve.batcher.RequestBatcher.dispatch_topk` call
+on the **single dispatch executor** (a one-worker thread pool): device
+work is serialized — one executable in flight, no device-side
+contention — while independent groups' flushes queue behind each other
+and their member coroutines stay concurrent.  The shared dispatch is
+attributed to every member's lifecycle (``serve/dispatch_ms`` and
+``serve/e2e_ms`` stay honest per request) while engine slots are
+counted once; ``serve/collator_flushes`` counts flushes, so
+``serve/cache_miss / serve/collator_flushes`` is the realized batching
+factor.
+
+**Deadline propagation**: lifecycles are constructed with the caller's
+``t_enq`` (the HTTP server stamps socket-in time), so time spent queued
+in the collator counts against ``deadline_ms``.  At flush time each
+member is re-checked — an expired member answers ``deadline_exceeded``
+and its ids are dropped from the union (never dispatched late), without
+failing the members that still have budget.  A member that expires
+mid-flight (the dispatch outran its remaining budget) still caches its
+rows and answers ``deadline_exceeded`` at completion — the PR 9 batcher
+semantics, through the collated path.
+
+Thread-model: every structure here is touched ONLY on the event loop
+(coroutines + ``call_later`` callbacks) — no locks; the batcher's
+admission counter/ladder/LRU carry their own locks and are shared with
+any sync callers.  Trace spans are NOT opened on this path: spans nest
+per-thread, and interleaved coroutines would corrupt the nesting — the
+latency histograms carry the per-request story instead.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+
+from hyperspace_tpu.serve.batcher import (RequestBatcher, _CACHE_ONLY,
+                                          _Lifecycle, bucket_for)
+from hyperspace_tpu.serve.errors import DeadlineExceededError, OverloadedError
+from hyperspace_tpu.telemetry import registry as telem
+
+# default max-wait before a non-full pending bucket flushes (µs).  Small
+# on purpose: T bounds the latency floor every collated request pays;
+# 2 ms buys collation at a few hundred qps without moving a CPU-scale
+# p50 (an engine dispatch is ≥ that).
+DEFAULT_MAX_WAIT_US = 2000
+
+
+class _Member:
+    """One awaiting topk request's share of a pending bucket."""
+
+    __slots__ = ("fut", "misses", "life")
+
+    def __init__(self, fut: asyncio.Future, misses: list, life: _Lifecycle):
+        self.fut = fut
+        self.misses = misses
+        self.life = life
+
+
+class _Group:
+    """The pending bucket for one (k, exclude_self, nprobe_ov) key."""
+
+    __slots__ = ("members", "pending", "timer", "keyf")
+
+    def __init__(self, keyf):
+        self.members: list[_Member] = []
+        self.pending: set = set()  # unique cold ids across members
+        self.timer = None
+        self.keyf = keyf
+
+
+class Collator:
+    """Continuous batching over a :class:`RequestBatcher` (module
+    docstring).  One collator serves one batcher serves one engine;
+    construct and use it on one event loop."""
+
+    def __init__(self, batcher: RequestBatcher, *,
+                 max_wait_us: float = DEFAULT_MAX_WAIT_US):
+        if max_wait_us < 0:
+            raise ValueError(
+                f"max_wait_us must be >= 0; got {max_wait_us}")
+        self.batcher = batcher
+        self.max_wait_s = float(max_wait_us) / 1e6
+        self._groups: dict[tuple, _Group] = {}
+        # the single dispatch executor: device work serialized, flushes
+        # from independent groups queue here while their member
+        # coroutines stay concurrent
+        self._exec = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="serve-dispatch")
+        self._closed = False
+
+    # --- public ops -----------------------------------------------------------
+
+    async def topk(self, ids, k: int, *, exclude_self: bool = True,
+                   deadline_ms: Optional[float] = None,
+                   t_enq: Optional[float] = None
+                   ) -> tuple[np.ndarray, np.ndarray]:
+        """The batcher's ``topk`` contract, collated: same validation,
+        cache, admission, deadline, and telemetry semantics — but cold
+        ids ride a shared flush with whatever else is pending."""
+        b = self.batcher
+        if deadline_ms is None:
+            deadline_ms = b.default_deadline_ms
+        life = _Lifecycle("topk", deadline_ms, t_enq=t_enq)
+        telem.inc("serve/requests")
+        b._admit()
+        try:
+            ids, k = b.validate_topk_request(ids, k)
+            keyf, nprobe_ov, cache_only = b.plan_topk(k, exclude_self)
+            rows, misses = b.cache_pass(ids, keyf, cache_only)
+            life.check_deadline("after the cache pass")
+            if misses:
+                computed = await self._enqueue(misses, k, exclude_self,
+                                               nprobe_ov, keyf, life)
+                for qid in misses:
+                    rows[qid] = computed[qid]
+            else:
+                # all-hit: the request never queues; batch-form is now
+                life.formed()
+                b._update_gauges()
+            out_i = np.stack([rows[qid][0] for qid in ids])
+            out_d = np.stack([rows[qid][1] for qid in ids])
+            # a result computed past the deadline is answered
+            # deadline_exceeded, never returned as if on time (the
+            # rows stay cached — the work is not wasted)
+            life.check_deadline("at completion")
+            life.finish()
+            return out_i, out_d
+        finally:
+            b._release()
+
+    async def score(self, u_ids, v_ids, *, prob: bool = False,
+                    fd_r: float = 2.0, fd_t: float = 1.0,
+                    deadline_ms: Optional[float] = None,
+                    t_enq: Optional[float] = None) -> np.ndarray:
+        """The batcher's ``score`` contract through the dispatch
+        executor.  Edge scoring is uncached and pairs rarely repeat, so
+        scores are not collated across requests — but they ARE admitted
+        on arrival (the bounded queue sees them immediately, not when
+        the executor gets around to them) and serialized through the
+        same single executor as the topk flushes."""
+        b = self.batcher
+        if deadline_ms is None:
+            deadline_ms = b.default_deadline_ms
+        life = _Lifecycle("score", deadline_ms, t_enq=t_enq)
+        telem.inc("serve/requests")
+        b._admit()
+        try:
+            if b._mode() == _CACHE_ONLY:
+                raise OverloadedError(
+                    "cache-only degradation: edge scoring is uncached")
+            u, v = b.validate_score_request(u_ids, v_ids)
+            life.formed()
+            life.check_deadline("after validation")
+            out = await asyncio.get_running_loop().run_in_executor(
+                self._exec,
+                functools.partial(b.dispatch_score, u, v, prob=prob,
+                                  fd_r=fd_r, fd_t=fd_t, lives=(life,),
+                                  deadline_life=life))
+            life.check_deadline("at completion")
+            life.finish()
+            return out
+        finally:
+            b._release()
+
+    # --- pending-bucket machinery ---------------------------------------------
+
+    def _enqueue(self, misses: list, k: int, exclude_self: bool,
+                 nprobe_ov, keyf, life: _Lifecycle) -> asyncio.Future:
+        loop = asyncio.get_running_loop()
+        key = (k, exclude_self, nprobe_ov)
+        g = self._groups.get(key)
+        if g is None:
+            g = _Group(keyf)
+            self._groups[key] = g
+            # the max-wait clock starts when the group becomes
+            # non-empty — a lone request flushes within T
+            g.timer = loop.call_later(self.max_wait_s, self._flush, key)
+        m = _Member(loop.create_future(), misses, life)
+        g.members.append(m)
+        g.pending.update(misses)
+        n = len(g.pending)
+        # flush policy: an exactly-full power-of-two bucket never waits
+        # (zero padding; more waiting only adds padding to a bigger
+        # bucket), and past the top bucket there is nothing to wait for
+        # (slab split).  A count that skips over a rung (7 → 9) keeps
+        # waiting for the next rung or the deadline, whichever first.
+        if n >= self.batcher.buckets[-1] or n == bucket_for(
+                n, self.batcher.buckets):
+            self._flush(key)
+        return m.fut
+
+    def _flush(self, key: tuple) -> None:
+        """Form and dispatch one group's batch (timer or fill path)."""
+        g = self._groups.pop(key, None)
+        if g is None:
+            return  # already flushed by the other trigger
+        g.timer.cancel()
+        alive: list[_Member] = []
+        ids: list[int] = []
+        seen: set = set()
+        for m in g.members:
+            try:
+                # expired while queued: answered deadline_exceeded,
+                # never dispatched — and never fails the rest
+                m.life.check_deadline("while queued in the collator")
+            except DeadlineExceededError as e:
+                if not m.fut.done():
+                    m.fut.set_exception(e)
+                continue
+            m.life.formed()  # batch-form stamp: the batch exists now
+            alive.append(m)
+            for qid in m.misses:
+                if qid not in seen:
+                    seen.add(qid)
+                    ids.append(qid)
+        if not alive:
+            return
+        if self._closed:
+            # a straggler flush after close (an abandoned connection's
+            # timer firing mid-teardown) must resolve its members, not
+            # die on the shut-down executor leaving futures hanging
+            err = OverloadedError("server draining: dispatch closed")
+            for m in alive:
+                if not m.fut.done():
+                    m.fut.set_exception(err)
+            return
+        telem.inc("serve/collator_flushes")
+        k, exclude_self, nprobe_ov = key
+        lives = [m.life for m in alive]
+        fut = asyncio.get_running_loop().run_in_executor(
+            self._exec,
+            functools.partial(self.batcher.dispatch_topk, ids, k,
+                              exclude_self=exclude_self,
+                              nprobe_ov=nprobe_ov, keyf=g.keyf,
+                              lives=lives))
+        fut.add_done_callback(functools.partial(self._deliver, alive))
+
+    @staticmethod
+    def _deliver(members: list, fut) -> None:
+        exc = None if fut.cancelled() else fut.exception()
+        for m in members:
+            if m.fut.done():
+                continue
+            if fut.cancelled():
+                m.fut.cancel()
+            elif exc is not None:
+                m.fut.set_exception(exc)
+            else:
+                m.fut.set_result(fut.result())
+
+    # --- drain ----------------------------------------------------------------
+
+    def flush_all(self) -> None:
+        """Flush every pending group now (drain: queued work must not
+        wait out its max-wait timer while the server is closing)."""
+        for key in list(self._groups):
+            self._flush(key)
+
+    def close(self, wait: bool = True) -> None:
+        """Release the dispatch executor; idempotent.  Sync callers
+        (tests, the bench) keep the default ``wait=True``; the front
+        door's drain passes ``wait=False`` — joining a running dispatch
+        thread from inside the event loop would block every remaining
+        in-flight response for its duration."""
+        if not self._closed:
+            self._closed = True
+            self._exec.shutdown(wait=wait)

@@ -1,0 +1,460 @@
+"""Asyncio HTTP/1.1 front door over the collator (stdlib only).
+
+The event loop ROADMAP item 2 asked for: concurrent HTTP requests in,
+the continuous-batching collator (``serve/collator.py``) between them
+and the engine, the PR 9 overload machinery enforced per request, and
+the PR 7 latency histograms measuring it all.  Hand-rolled HTTP/1.1
+JSON handling on ``asyncio`` streams — no web framework, no new
+dependencies; the protocol surface is four routes (docs/serving.md
+"HTTP front door"):
+
+====================  ======================================================
+route                 body / answer
+====================  ======================================================
+``POST /v1/topk``     ``{"ids": [...], "k": 5, "exclude_self"?: bool,
+                      "deadline_ms"?: ms}`` → ``{"neighbors": [[...]],
+                      "dists": [[...]]}``
+``POST /v1/score``    ``{"u": [...], "v": [...], "prob"?: bool, "fd_r"?,
+                      "fd_t"?, "deadline_ms"?}`` → ``{"scores": [...]}``
+``GET|POST /v1/stats``  ``batcher.stats()`` + a ``server`` block
+                      (served/inflight/draining) + ``recompiles``
+``GET /healthz``      ``{"ok": true}`` (503 + ``ok: false`` once draining)
+====================  ======================================================
+
+Failed requests answer the SAME typed body as the stdin loop
+(``{"error": {"kind": ..., "message": ...}}`` — docs/serving.md "Error
+taxonomy") with the kind mapped onto the status code: ``parse``/
+``validation`` → 400, ``overloaded`` → **429**, ``deadline_exceeded`` →
+**504**, ``internal`` → 500.  Exactly one response per request; a
+malformed request never takes the connection pool down.
+
+**Deadline propagation starts at socket accept**: the lifecycle's
+``t_enq`` is stamped when the request line arrives on the socket, so
+time spent queued in the collator (and in the dispatch executor) counts
+against the request's ``deadline_ms`` — a 504 can be shed while queued,
+before any device work (the batcher's "never dispatched late" rule,
+now with real queueing in front of it).
+
+**Drain** mirrors the stdin loop's SIGTERM contract: stop accepting
+(listeners closed — new connections are refused at the socket),
+force-flush the collator's pending buckets, answer every in-flight
+request, close keep-alive connections (idle ones immediately — a
+silent client cannot block shutdown), and emit the latency summary.
+
+Concurrency model: one task per connection; requests on one connection
+are sequential (HTTP/1.1 without pipelining), concurrency comes from
+connections.  All blocking work (device dispatch) lives on the
+collator's single dispatch executor — nothing here blocks the loop, and
+the ``blocking-call-in-async`` hyperlint rule keeps it that way.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import signal
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+from hyperspace_tpu.serve.batcher import RequestBatcher
+from hyperspace_tpu.serve.collator import DEFAULT_MAX_WAIT_US, Collator
+from hyperspace_tpu.serve.errors import ServeError, error_response
+from hyperspace_tpu.telemetry import registry as telem
+
+MAX_BODY_BYTES = 8 << 20  # one request's JSON; far past any bucket
+MAX_HEADERS = 128         # header-count cap: no unbounded dict growth
+_STATUS_BY_KIND = {"parse": 400, "validation": 400, "overloaded": 429,
+                   "deadline_exceeded": 504, "internal": 500}
+_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+            405: "Method Not Allowed", 413: "Payload Too Large",
+            429: "Too Many Requests", 500: "Internal Server Error",
+            503: "Service Unavailable", 504: "Gateway Timeout"}
+
+
+def _json_default(o):
+    """numpy scalars/arrays degrade per-value (the bench emit rule)."""
+    if isinstance(o, np.generic):
+        return o.item()
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    return str(o)
+
+
+def _json_bool(req: dict, key: str, default: bool) -> bool:
+    """Strict JSON boolean — the string \"false\" must be an error, not
+    truthy (the stdin loop's reject-don't-coerce policy)."""
+    v = req.get(key, default)
+    if not isinstance(v, bool):
+        raise ValueError(
+            f"{key} must be a JSON boolean, got {type(v).__name__}")
+    return v
+
+
+def _req_deadline(req: dict) -> Optional[float]:
+    """The optional per-request ``deadline_ms`` field, strict: a
+    positive JSON number, not a bool/string; None = server default."""
+    v = req.get("deadline_ms")
+    if v is None:
+        return None
+    if isinstance(v, bool) or not isinstance(v, (int, float)) or v <= 0:
+        raise ValueError(
+            f"deadline_ms must be a positive number, got {v!r}")
+    return float(v)
+
+
+def _req_number(req: dict, key: str, default: float) -> float:
+    v = req.get(key, default)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise ValueError(f"{key} must be a JSON number, got {v!r}")
+    return float(v)
+
+
+class _Request:
+    __slots__ = ("method", "target", "headers", "body", "t_in", "close")
+
+    def __init__(self, method, target, headers, body, t_in, close):
+        self.method = method
+        self.target = target
+        self.headers = headers
+        self.body = body
+        self.t_in = t_in       # socket-in stamp: deadline origin
+        self.close = close     # client asked Connection: close / HTTP/1.0
+
+
+class _BadRequest(Exception):
+    """Protocol-level failure (not a serve op): answered 400 + close."""
+
+
+class _TooLarge(_BadRequest):
+    """Body past MAX_BODY_BYTES: answered 413 + close."""
+
+
+class HttpFrontDoor:
+    """The asyncio HTTP server (module docstring).  Lifecycle:
+    ``await start()`` binds (port 0 = ephemeral; ``.port`` holds the
+    bound port), ``await serve_until_drained()`` installs the SIGTERM
+    handler and blocks until a drain completes, or drive ``drain()``
+    directly (tests, embedded use)."""
+
+    def __init__(self, batcher: RequestBatcher, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_wait_us: float = DEFAULT_MAX_WAIT_US,
+                 collator: Optional[Collator] = None):
+        self.batcher = batcher
+        self.collator = collator or Collator(batcher,
+                                             max_wait_us=max_wait_us)
+        self.host = host
+        self.port = int(port)
+        self.served = 0          # responses written (errors included)
+        self.inflight = 0        # requests currently being handled
+        self.aborted_connections = 0  # abandoned at the drain timeout
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conn_tasks: set = set()
+        self._draining: Optional[asyncio.Event] = None
+        self._drained: Optional[asyncio.Event] = None
+
+    # --- lifecycle ------------------------------------------------------------
+
+    async def start(self) -> tuple[str, int]:
+        self._draining = asyncio.Event()
+        self._drained = asyncio.Event()
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.host, self.port
+
+    async def serve_until_drained(self) -> None:
+        """Install SIGTERM → drain (where signal handlers can install)
+        and block until the drain finishes."""
+        loop = asyncio.get_running_loop()
+        installed = False
+        try:
+            loop.add_signal_handler(
+                signal.SIGTERM,
+                lambda: asyncio.ensure_future(self.drain()))
+            installed = True
+        except (NotImplementedError, RuntimeError, ValueError):
+            pass  # non-main thread / platform without signal support
+        try:
+            await self._drained.wait()
+        finally:
+            if installed:
+                loop.remove_signal_handler(signal.SIGTERM)
+
+    async def drain(self) -> None:
+        """Graceful shutdown: refuse new connections, flush pending
+        collator buckets, answer in-flight requests, close idle
+        connections, release the dispatch executor.  Idempotent."""
+        if self._draining.is_set():
+            await self._drained.wait()
+            return
+        self._draining.set()
+        self._server.close()
+        await self._server.wait_closed()
+        # queued batches must not wait out their max-wait timers while
+        # the listeners are already closed
+        self.collator.flush_all()
+        if self._conn_tasks:
+            # in-flight requests answer; idle keep-alive readers cancel
+            # immediately (the read/drain race in _on_connection).
+            # Connections STILL pending at the timeout are abandoned —
+            # counted, never silently claimed as drained
+            _done, pending = await asyncio.wait(self._conn_tasks,
+                                                timeout=30.0)
+            self.aborted_connections = len(pending)
+        # wait=False: a still-running device dispatch must not block the
+        # event loop from inside this async def (the blocking-call
+        # hazard this PR's own lint rule polices) — the executor thread
+        # finishes on its own and is joined at interpreter exit
+        self.collator.close(wait=False)
+        self._drained.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining is not None and self._draining.is_set()
+
+    # --- connection handling --------------------------------------------------
+
+    async def _on_connection(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
+        try:
+            while not self._draining.is_set():
+                read = asyncio.ensure_future(self._read_request(reader))
+                drainw = asyncio.ensure_future(self._draining.wait())
+                # race the next request against drain: a SIGTERM while
+                # this connection idles must not wait for the client's
+                # next request (the stdin loop's select-poll analog,
+                # event-driven instead of polled)
+                done, _ = await asyncio.wait(
+                    {read, drainw},
+                    return_when=asyncio.FIRST_COMPLETED)
+                drainw.cancel()
+                if read not in done:
+                    read.cancel()
+                    with contextlib.suppress(
+                            asyncio.CancelledError, Exception):
+                        await read  # join the cancelled read
+                    break
+                try:
+                    req = read.result()
+                except _TooLarge as e:
+                    await self._write_response(
+                        writer, 413,
+                        {"error": {"kind": "validation",
+                                   "message": str(e)}},
+                        close=True)
+                    break
+                except _BadRequest as e:
+                    await self._write_response(
+                        writer, 400,
+                        {"error": {"kind": "parse", "message": str(e)}},
+                        close=True)
+                    break
+                except (ConnectionError, asyncio.IncompleteReadError):
+                    break  # peer went away mid-request
+                if req is None:
+                    break  # clean EOF between requests
+                self.inflight += 1
+                try:
+                    status, payload = await self._route(req)
+                finally:
+                    self.inflight -= 1
+                close = req.close or self._draining.is_set()
+                await self._write_response(writer, status, payload,
+                                           close=close)
+                if close:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass  # peer reset under our feet: nothing left to answer
+        finally:
+            self._conn_tasks.discard(task)
+            writer.close()
+            with contextlib.suppress(Exception):
+                await writer.wait_closed()
+
+    @staticmethod
+    async def _read_line(reader) -> bytes:
+        """One protocol line; a line past the StreamReader's buffer
+        limit (64 KiB default) surfaces as ValueError — mapped onto
+        the 400 path, never an unhandled task death (the 'exactly one
+        response per request' contract covers hostile lines too)."""
+        try:
+            return await reader.readline()
+        except ValueError as e:  # LimitOverrunError → ValueError
+            raise _BadRequest(f"protocol line too long ({e})") from None
+
+    async def _read_request(self, reader) -> Optional[_Request]:
+        line = await self._read_line(reader)
+        if not line:
+            return None
+        t_in = time.perf_counter()  # socket-in: the deadline origin
+        parts = line.decode("latin-1").strip().split()
+        if len(parts) != 3 or not parts[2].startswith("HTTP/"):
+            raise _BadRequest(f"malformed request line: {line[:80]!r}")
+        method, target, version = parts
+        headers = {}
+        while True:
+            h = await self._read_line(reader)
+            if h in (b"\r\n", b"\n", b""):
+                break
+            if len(headers) >= MAX_HEADERS:
+                # a protocol-level failure, not an oversized payload:
+                # 400, like any other unparseable-request shape
+                raise _BadRequest(f"more than {MAX_HEADERS} headers")
+            name, sep, val = h.decode("latin-1").partition(":")
+            if sep:
+                headers[name.strip().lower()] = val.strip()
+        body = b""
+        cl = headers.get("content-length")
+        if cl is not None:
+            try:
+                n = int(cl)
+            except ValueError:
+                raise _BadRequest(
+                    f"bad Content-Length: {cl!r}") from None
+            if n < 0:
+                raise _BadRequest(f"negative Content-Length {n}")
+            if n > MAX_BODY_BYTES:
+                raise _TooLarge(
+                    f"Content-Length {n} > {MAX_BODY_BYTES} cap")
+            if n:
+                body = await reader.readexactly(n)
+        close = (headers.get("connection", "").lower() == "close"
+                 or version == "HTTP/1.0")
+        return _Request(method, target, headers, body, t_in, close)
+
+    # --- routing --------------------------------------------------------------
+
+    async def _route(self, req: _Request) -> tuple[int, dict]:
+        target = req.target.split("?", 1)[0]
+        if target == "/healthz":
+            if req.method != "GET":
+                return 405, {"error": {"kind": "validation",
+                                       "message": "/healthz wants GET"}}
+            ok = not self._draining.is_set()
+            return (200 if ok else 503), {"ok": ok,
+                                          "draining": not ok}
+        if target == "/v1/stats":
+            if req.method not in ("GET", "POST"):
+                return 405, {"error": {"kind": "validation",
+                                       "message":
+                                       "/v1/stats wants GET or POST"}}
+            return 200, self._stats()
+        if target not in ("/v1/topk", "/v1/score"):
+            return 404, {"error": {"kind": "validation",
+                                   "message": f"no route {target!r}"}}
+        if req.method != "POST":
+            return 405, {"error": {"kind": "validation",
+                                   "message": f"{target} wants POST"}}
+        try:
+            try:
+                body = json.loads(req.body.decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                return 400, {"error": {"kind": "parse",
+                                       "message": str(e)}}
+            if not isinstance(body, dict):
+                raise ValueError(
+                    f"request body must be a JSON object, got "
+                    f"{type(body).__name__}")
+            if target == "/v1/topk":
+                idx, dist = await self.collator.topk(
+                    body.get("ids"), body.get("k", 10),
+                    exclude_self=_json_bool(body, "exclude_self", True),
+                    deadline_ms=_req_deadline(body), t_enq=req.t_in)
+                resp = {"neighbors": idx.tolist(),
+                        "dists": dist.tolist()}
+            else:
+                scores = await self.collator.score(
+                    body.get("u"), body.get("v"),
+                    prob=_json_bool(body, "prob", False),
+                    fd_r=_req_number(body, "fd_r", 2.0),
+                    fd_t=_req_number(body, "fd_t", 1.0),
+                    deadline_ms=_req_deadline(body), t_enq=req.t_in)
+                resp = {"scores": scores.tolist()}
+        except (ServeError, ValueError, KeyError, TypeError,
+                OverflowError, OSError) as e:
+            # the stdin loop's per-line error classes, mapped onto
+            # status codes; an IO fault (incl. the serve.dispatch
+            # ioerror chaos site) answers 500 and the server survives
+            err = error_response(e)
+            return _STATUS_BY_KIND[err["error"]["kind"]], err
+        return 200, resp
+
+    def _stats(self) -> dict:
+        out = dict(self.batcher.stats())
+        out["server"] = {"served": self.served,
+                         "inflight": self.inflight,
+                         "draining": self.draining,
+                         "max_wait_us": round(
+                             self.collator.max_wait_s * 1e6, 1)}
+        # compile-count beside the serve stats: the smoke/bench contract
+        # is recompiles FLAT across same-bucket requests after warmup
+        out["recompiles"] = telem.default_registry().get("jax/recompiles")
+        out["collator_flushes"] = telem.default_registry().get(
+            "serve/collator_flushes")
+        return out
+
+    # --- response write -------------------------------------------------------
+
+    async def _write_response(self, writer, status: int, payload: dict,
+                              *, close: bool) -> None:
+        body = json.dumps(payload, default=_json_default).encode("utf-8")
+        head = (f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: {'close' if close else 'keep-alive'}\r\n"
+                "\r\n").encode("latin-1")
+        writer.write(head + body)
+        await writer.drain()
+        self.served += 1
+        telem.inc("serve/http_requests")
+
+
+def latency_summary_line(baseline: Optional[dict] = None) -> str:
+    """One-line ``serve/e2e_ms`` summary — the stdin loop's exit line,
+    shared by the serve-http CLI (count + p50/p95/p99, optionally as a
+    delta over a session-start registry mark)."""
+    snap = telem.default_registry().snapshot(baseline=baseline)
+    lat = snap.get("hist/serve/e2e_ms")
+    if not lat or not lat.get("count"):
+        return "[serve] latency e2e_ms: no requests"
+    return ("[serve] latency e2e_ms count=%d p50=%.3f p95=%.3f p99=%.3f"
+            % (lat["count"], lat["p50"], lat["p95"], lat["p99"]))
+
+
+async def run_front_door(batcher: RequestBatcher, *, host: str, port: int,
+                         max_wait_us: float,
+                         ready=None) -> dict:
+    """Start, announce, serve until drained (SIGTERM), summarize.
+
+    ``ready(host, port)`` is called once the listener is bound (the CLI
+    prints the parseable "listening" line there; tests grab the
+    ephemeral port).  Returns the closing stats dict."""
+    door = HttpFrontDoor(batcher, host=host, port=port,
+                         max_wait_us=max_wait_us)
+    session_mark = telem.default_registry().mark()
+    await door.start()
+    if ready is not None:
+        ready(door.host, door.port)
+    await door.serve_until_drained()
+    try:
+        print(f"[serve-http] drained: stopped accepting, "
+              f"{door.served} response(s) sent", file=sys.stderr,
+              flush=True)
+        if door.aborted_connections:
+            # an honest drain never claims requests it abandoned
+            print(f"[serve-http] WARNING: {door.aborted_connections} "
+                  "connection(s) still in flight at the drain timeout "
+                  "were abandoned", file=sys.stderr, flush=True)
+        print(latency_summary_line(session_mark), file=sys.stderr,
+              flush=True)
+    except (OSError, ValueError):
+        pass  # hyperlint: disable=swallow-base-exception — closed stderr: diagnostics loss, never a drain failure
+    return {"served": door.served, "drained": True,
+            "aborted_connections": door.aborted_connections}

@@ -53,11 +53,15 @@ _SKIP_DETAIL_KEYS = {"telemetry", "traceback"}
 _HIGHER_TOKENS = ("per_s", "per_sec", "qps", "samples", "speedup",
                   "recall", "rate", "auc", "frac", "roofline", "ratio")
 _LOWER_TOKENS = ("time", "stall", "waste", "recompile", "epoch_s",
-                 "compile")
+                 "compile", "latency")
 # lower-better tokens that outrank the higher-better list: "ratio" is
 # generically higher-better (fused/unfused speedup ratios), but a
-# waste ratio is still waste
-_LOWER_PRIORITY_TOKENS = ("waste",)
+# waste ratio is still waste; "rate" is generically higher-better
+# (cache_hit_rate, qps_at_recall...), but the r13 HTTP front door's
+# shed_rate / deadline_rate are failure fractions — shedding MORE is
+# never an improvement (latency itself — http_p99_ms and every
+# latency_ms leaf — is already lower-better via the _ms suffix)
+_LOWER_PRIORITY_TOKENS = ("waste", "shed", "deadline")
 _LOWER_SUFFIXES = ("_s", "_ms", "_bytes")
 # leaves that are the size of a measurement's basis, not a measurement
 # — fewer samples is not an improvement

@@ -1,0 +1,41 @@
+"""Fixture: blocking calls inside async defs (each must fire)."""
+
+import io
+import socket
+import subprocess
+import time
+
+
+async def sleepy_handler(request):
+    time.sleep(0.05)  # parks the whole event loop
+    return request
+
+
+async def raw_socket_probe(host, port):
+    s = socket.create_connection((host, port))
+    s.close()
+
+
+async def sync_read(path):
+    with open(path) as f:  # sync file I/O on the loop
+        return f.read()
+
+
+async def sync_io_open(path):
+    return io.open(path).read()
+
+
+async def pathlib_write(p, text):
+    p.write_text(text)
+
+
+async def shell_out(cmd):
+    return subprocess.run(cmd)
+
+
+async def outer_async():
+    async def inner(p):
+        # nested ASYNC def: still event-loop code, still fires
+        time.sleep(0.01)
+
+    await inner(None)

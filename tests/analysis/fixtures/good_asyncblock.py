@@ -1,0 +1,48 @@
+"""Fixture: async shapes the blocking-call-in-async rule must pass."""
+
+import asyncio
+import functools
+import time
+
+
+async def proper_sleep(ms):
+    await asyncio.sleep(ms / 1e3)  # the non-blocking analog
+
+
+async def stream_client(host, port, payload):
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(payload)
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    return data
+
+
+def read_blob(path):
+    # sync helper OUTSIDE any async def: runs wherever it is called
+    with open(path) as f:
+        return f.read()
+
+
+async def offloaded_read(path):
+    # the sanctioned route: blocking work rides an executor
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None,
+                                      functools.partial(read_blob, path))
+
+
+async def nested_sync_helper(items):
+    def prep(batch):
+        # nearest enclosing function is a SYNC def — out of scope (the
+        # helper is handed to an executor by its caller)
+        time.sleep(0.001)
+        return sorted(batch)
+
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, prep, items)
+
+
+async def annotated_startup_read(path):
+    # the escape hatch: visible, per-line, with a reason
+    with open(path) as f:  # hyperlint: disable=blocking-call-in-async — startup-only config read, loop not serving yet
+        return f.read()

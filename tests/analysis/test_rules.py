@@ -12,6 +12,7 @@ import textwrap
 import pytest
 
 from hyperspace_tpu.analysis.core import lint_file, lint_paths
+from hyperspace_tpu.analysis.rules.asyncblock import BlockingCallInAsyncRule
 from hyperspace_tpu.analysis.rules.catalog import TelemetryCatalogRule
 from hyperspace_tpu.analysis.rules.distmat import MaterializedDistmatRule
 from hyperspace_tpu.analysis.rules.donation import DonationHazardRule
@@ -40,6 +41,7 @@ _PER_FILE = [
     ("bad_tracerleak.py", TracerLeakRule, None),
     ("bad_exceptions.py", SwallowBaseExceptionRule, None),
     ("bad_retry.py", UnboundedRetryRule, None),
+    ("bad_asyncblock.py", BlockingCallInAsyncRule, None),
     ("bad_distmat.py", MaterializedDistmatRule, None),
     ("bad_precision.py", PrecisionLiteralRule,
      "hyperspace_tpu/models/bad_precision.py"),
@@ -181,6 +183,53 @@ def test_retry_sleepless_while_true_is_fine(tmp_path):
     p = tmp_path / "loop.py"
     p.write_text("def f(q):\n    while True:\n        q.get()\n")
     assert lint_file(str(p), rules=[UnboundedRetryRule()]).findings == []
+
+
+# --- blocking-call-in-async ---------------------------------------------------
+
+
+def test_asyncblock_bad_fixture_fires_every_shape():
+    """time.sleep, a socket-module call, builtin open, io.open,
+    pathlib-style write_text, subprocess.run, and a NESTED async def's
+    sleep all fire."""
+    report = _lint("bad_asyncblock.py", BlockingCallInAsyncRule)
+    msgs = [f.message for f in report.findings]
+    assert report.exit_code() == 1 and len(report.findings) == 7
+    assert any("asyncio.sleep" in m for m in msgs)
+    assert any("socket.create_connection" in m for m in msgs)
+    assert any("write_text" in m for m in msgs)
+    assert any("subprocess" in m for m in msgs)
+
+
+def test_asyncblock_good_fixture_is_clean():
+    """await asyncio.sleep, asyncio streams, executor offload, a sync
+    helper nested in an async def, sync module-level I/O, and the
+    annotated escape hatch all pass."""
+    assert _lint("good_asyncblock.py", BlockingCallInAsyncRule
+                 ).findings == []
+
+
+def test_asyncblock_sync_def_is_out_of_scope(tmp_path):
+    """The same calls in a plain def never fire — the rule is about the
+    event loop, not about sleeping in general."""
+    p = tmp_path / "sync.py"
+    p.write_text("import time\n"
+                 "def f(path):\n"
+                 "    time.sleep(0.1)\n"
+                 "    return open(path).read()\n")
+    assert lint_file(str(p),
+                     rules=[BlockingCallInAsyncRule()]).findings == []
+
+
+def test_asyncblock_aliased_import_resolves(tmp_path):
+    """`import time as t; t.sleep(...)` inside an async def still fires
+    (the alias-resolution contract every resolved-name rule shares)."""
+    p = tmp_path / "alias.py"
+    p.write_text("import time as t\n"
+                 "async def f():\n"
+                 "    t.sleep(0.1)\n")
+    report = lint_file(str(p), rules=[BlockingCallInAsyncRule()])
+    assert len(report.findings) == 1
 
 
 # --- materialized-distmat -----------------------------------------------------

@@ -1,0 +1,106 @@
+"""bench.py's r13 HTTP front-door leg: the open-loop arrival generator
+and an end-to-end miniature run of ``bench_serve_http`` (in-process
+server + asyncio client, scaled down for tier-1)."""
+
+import numpy as np
+import pytest
+
+
+def _bench():
+    import importlib
+    import os
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sys.path.insert(0, root)
+    return importlib.import_module("bench")
+
+
+# --- open-loop arrival generator ---------------------------------------------
+
+
+def test_even_arrivals_are_exact():
+    mod = _bench()
+    off = mod.open_loop_arrivals(5, 100.0, "even")
+    np.testing.assert_allclose(off, [0.0, 0.01, 0.02, 0.03, 0.04])
+
+
+def test_poisson_arrivals_mean_rate_and_monotonicity():
+    """Exponential gaps: monotone nondecreasing offsets whose mean gap
+    converges on 1/qps (seeded — deterministic draw), and a different
+    seed gives a different draw (the per-pass decorrelation)."""
+    mod = _bench()
+    off = mod.open_loop_arrivals(4000, 200.0, "poisson", seed=3)
+    assert np.all(np.diff(off) >= 0)
+    mean_gap = float(np.mean(np.diff(off)))
+    assert 0.8 / 200.0 < mean_gap < 1.2 / 200.0
+    off2 = mod.open_loop_arrivals(4000, 200.0, "poisson", seed=4)
+    assert not np.array_equal(off, off2)
+
+
+def test_arrivals_validation():
+    mod = _bench()
+    with pytest.raises(ValueError, match="qps"):
+        mod.open_loop_arrivals(0, 10.0)
+    with pytest.raises(ValueError, match="qps"):
+        mod.open_loop_arrivals(5, 0.0)
+    with pytest.raises(ValueError, match="mode"):
+        mod.open_loop_arrivals(5, 10.0, "burst")
+
+
+# --- the leg end-to-end (miniature) ------------------------------------------
+
+
+@pytest.mark.flaky  # wall-clock leg: a starved CI host can wobble it
+def test_bench_serve_http_miniature_run():
+    """The whole leg at reduced scale: per-bucket + aggregate
+    percentiles land, the compact headline value is the aggregate p99,
+    recompiles stay FLAT across the open-loop passes (warmup covers
+    the ladder), and the overload pass answers EVERY request with the
+    excess shed as HTTP 429 — never unbounded queueing."""
+    mod = _bench()
+    r = mod.bench_serve_http(repeats=1, qps=60.0, duration_s=0.5,
+                             table_rows=8192, overload_qps=1500.0,
+                             overload_s=0.4)
+    assert r["metric"] == "serve_http_p99_ms" and r["unit"] == "ms"
+    d = r["detail"]
+    assert r["value"] == d["http_p99_ms"] > 0
+    # per-bucket rows: three distinct size classes, all-200 statuses
+    assert set(d["latency_ms"]) == {"b8", "b16", "b64"}
+    for row in d["latency_ms"].values():
+        assert row["n"] > 0 and row["p50"] <= row["p99"]
+        assert set(row["statuses"]) == {"200"}
+    agg = d["aggregate_ms"]
+    assert agg["n"] == sum(x["n"] for x in d["latency_ms"].values())
+    # the recompile contract: the ladder warmup covers every shape the
+    # collator can form — the timed passes never meet the compiler
+    assert d["recompiles_warmup"] >= 1
+    assert d["recompiles_steady"] == 0
+    # overload: every request answered, the excess shed with 429
+    ov = d["overload"]
+    assert ov["answered"] == ov["offered"]
+    assert ov["shed"] > 0 and d["shed_rate"] > 0
+    assert set(ov["statuses"]) <= {"200", "429", "504"}
+
+
+def test_serve_http_compact_fields():
+    """The compact headline carries http_p99_ms / http_shed_rate both
+    when serve_http IS the headline (flat detail) and when it rides
+    auto mode's nested leg."""
+    import json
+
+    mod = _bench()
+    flat = {"metric": "serve_http_p99_ms", "value": 12.3, "unit": "ms",
+            "vs_baseline": None,
+            "detail": {"http_p99_ms": 12.3, "shed_rate": 0.41}}
+    line = json.loads(mod.compact_headline(flat))
+    assert line["detail"]["http_p99_ms"] == 12.3
+    assert line["detail"]["http_shed_rate"] == 0.41
+    auto = {"metric": "hgcn_samples_per_sec_per_chip", "value": 1.0,
+            "unit": "samples/s/chip", "vs_baseline": None,
+            "detail": {"serve_http": {"http_p99_ms": 9.9,
+                                      "shed_rate": 0.1}}}
+    line = json.loads(mod.compact_headline(auto))
+    assert line["detail"]["http_p99_ms"] == 9.9
+    assert line["detail"]["http_shed_rate"] == 0.1

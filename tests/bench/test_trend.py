@@ -245,3 +245,31 @@ def test_direction_speedup_ratio_are_higher_better():
                  "speedup_at_recall99"):
         assert mod.direction(name) == "higher", name
     assert mod.direction("detail.serve.cache.padded_waste_ratio") == "lower"
+
+
+def test_direction_http_front_door_fields_are_lower_better():
+    """The r13 HTTP front-door compact fields gate in the right
+    direction: http_p99_ms (latency) and shed_rate / deadline_rate
+    (failure fractions — the "shed"/"deadline" tokens outrank the
+    generically-higher-better "rate") are all lower-is-better, at the
+    headline and at every nested detail path."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_trend", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for name in ("http_p99_ms", "detail.serve_http.http_p99_ms",
+                 "serve_http_p99_ms",
+                 "shed_rate", "http_shed_rate",
+                 "detail.serve_http.shed_rate",
+                 "detail.serve_http.deadline_rate",
+                 "detail.serve_http.latency_ms.b8.p99",
+                 "detail.serve_http.aggregate_ms.p99",
+                 "detail.resilience.overload.shed_rate"):
+        assert mod.direction(name) == "lower", name
+    # the rate/ratio families around them keep their directions
+    assert mod.direction("detail.serve.cache.cache_hit_rate") == "higher"
+    assert mod.direction("serve_fused_speedup") == "higher"
+    assert mod.direction("detail.serve.ivf.qps_at_recall99") == "higher"
+    # sample-count leaves stay direction-free
+    assert mod.direction("detail.serve_http.latency_ms.b8.n") is None

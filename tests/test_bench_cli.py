@@ -263,8 +263,9 @@ def test_budget_zero_skips_all_legs_but_emits(bench_mod, monkeypatch, capsys):
     # headline survives; every optional leg is reported skipped, not lost
     assert full["metric"] == "hgcn_samples_per_sec_per_chip"
     assert set(full["detail"]["skipped_legs"]) == {
-        "poincare", "hgcn_sampled", "serve_qps", "precision",
-        "resilience", "realistic", "workloads", "use_att_arm"}
+        "poincare", "hgcn_sampled", "serve_qps", "serve_http",
+        "precision", "resilience", "realistic", "workloads",
+        "use_att_arm"}
     assert full["detail"]["budget_s"] == 0
     assert _last_json(captured)["metric"] == "hgcn_samples_per_sec_per_chip"
 

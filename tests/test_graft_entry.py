@@ -68,3 +68,49 @@ def test_ensure_devices_in_process():
     d = g._ensure_devices(4, prefer_cpu=True)
     assert len(d) == 4 and all(x.platform == "cpu" for x in d)
     assert jax.devices() == before  # backend untouched
+
+
+def test_dryrun_bounded_timeout_emits_parseable_artifact(capsys):
+    """The MULTICHIP r04/r05 fix: a dryrun that outruns its budget
+    emits a parseable budget_exhausted record (bench.py's sentinel
+    shape, so bench_trend and any tail parser read it) and returns
+    False — never a silent rc=124 loss."""
+    import json
+    import time
+
+    import __graft_entry__ as g
+
+    exits = []
+    ok = g.run_dryrun_bounded(4, 0.2, _dryrun=lambda n: time.sleep(5),
+                              _exit=exits.append)
+    assert ok is False
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "budget_exhausted"
+    assert rec["detail"]["lane"] == "dryrun_multichip"
+    assert rec["detail"]["budget_s"] == 0.2
+    assert exits == []  # SIGALRM path won; the watchdog never fired
+
+
+def test_dryrun_bounded_success_emits_nothing(capsys):
+    """A run that finishes inside the budget is transparent: no
+    sentinel line, True back, the alarm disarmed."""
+    import signal
+
+    import __graft_entry__ as g
+
+    ran = []
+    ok = g.run_dryrun_bounded(4, 30.0, _dryrun=ran.append)
+    assert ok is True and ran == [4]
+    assert "budget_exhausted" not in capsys.readouterr().out
+    # the deadline alarm was restored (no timer left pending)
+    assert signal.getitimer(signal.ITIMER_REAL) == (0.0, 0.0)
+
+
+def test_dryrun_budget_env_and_escape_hatch(monkeypatch):
+    """MULTICHIP_BUDGET_S feeds the default; <= 0 runs unbounded."""
+    import __graft_entry__ as g
+
+    ran = []
+    monkeypatch.setenv("MULTICHIP_BUDGET_S", "0")
+    assert g.run_dryrun_bounded(4, _dryrun=ran.append) is True
+    assert ran == [4]  # unbounded escape hatch still runs the lane
