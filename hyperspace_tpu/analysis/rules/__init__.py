@@ -11,6 +11,7 @@ from hyperspace_tpu.analysis.rules.donation import DonationHazardRule
 from hyperspace_tpu.analysis.rules.exceptions import SwallowBaseExceptionRule
 from hyperspace_tpu.analysis.rules.flags import FlagDocDriftRule
 from hyperspace_tpu.analysis.rules.hostsync import HostSyncRule
+from hyperspace_tpu.analysis.rules.jitcache import JitCacheDefeatRule
 from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
 from hyperspace_tpu.analysis.rules.retry import UnboundedRetryRule
@@ -18,6 +19,7 @@ from hyperspace_tpu.analysis.rules.tracerleak import TracerLeakRule
 
 ALL_RULES = (
     RecompileHazardRule,
+    JitCacheDefeatRule,
     DonationHazardRule,
     HostSyncRule,
     TracerLeakRule,

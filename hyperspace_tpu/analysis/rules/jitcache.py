@@ -1,0 +1,154 @@
+"""jit-cache-defeat: fresh function objects reaching ``jax.jit`` per
+call.
+
+Historical incident: the compile-time pillar (PR 13) exists because one
+short run logged ``jax/recompiles=1532`` — and the cheapest way to
+manufacture that number is ``jax.jit`` over a function object that is
+REBUILT on every call.  ``jax.jit``'s dispatch cache lives on the
+wrapper and keys traces on the wrapped callable's identity: a lambda or
+a def created inside the enclosing function body is a NEW object each
+time the enclosing function runs, so every call pays wrapper
+construction + a fresh trace — and even with the persistent
+compilation cache active, a per-call trace still pays tracing, cache-key
+hashing, and a disk read where a warm in-process cache would pay a dict
+lookup.
+
+Flagged (error), when the jit call sits inside a function:
+
+- ``jax.jit`` over a **lambda**;
+- ``jax.jit`` over a **def nested in the enclosing function** (by name
+  or as a decorator on the nested def).
+
+Not flagged:
+
+- module-scope binds (``double = jax.jit(lambda v: v * 2)``): built
+  once per process;
+- **factories** — the jitted callable escapes via ``return`` (bare
+  name or tuple element, or the jit call itself returned): the
+  ``make_*_step`` idiom everywhere in this repo builds once and hands
+  the wrapper to a loop;
+- binds onto ``self``/attributes (one per object construction);
+- AOT pipelines (``jax.jit(f).lower(...).compile()``): explicit
+  compilation never touches the dispatch cache, so there is no cache
+  to defeat.
+
+The recompile-hazard rule covers the adjacent shapes (jit in a loop,
+build-and-discard invocation); this rule covers the function-identity
+class those miss — a jit built once per call OUTSIDE any loop, which
+looks bound but retraces every time.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+from hyperspace_tpu.analysis.rules._shared import (
+    is_jit_name, partial_jit_decorator, walk_scope)
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _escaping_names(encl: ast.AST) -> set[str]:
+    """Names the enclosing function returns AS VALUES (bare name or
+    tuple/list element) — the factory escape.  ``return run(state)`` is
+    NOT an escape: the wrapper is still rebuilt per call."""
+    out: set[str] = set()
+    for node in walk_scope(encl):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        vals = (node.value.elts
+                if isinstance(node.value, (ast.Tuple, ast.List))
+                else [node.value])
+        for v in vals:
+            if isinstance(v, ast.Name):
+                out.add(v.id)
+    return out
+
+
+def _nested_def_names(encl: ast.AST) -> set[str]:
+    """Defs declared directly in this function's scope (fresh objects
+    per call of the enclosing function)."""
+    return {n.name for n in walk_scope(encl) if isinstance(n, _FUNCS)}
+
+
+class JitCacheDefeatRule(Rule):
+    id = "jit-cache-defeat"
+    severity = "error"
+    summary = ("jax.jit over a lambda or nested def — a fresh function "
+               "object per call defeats the jit cache")
+
+    def check_file(self, ctx: FileContext):
+        findings = []
+        # per-enclosing-function caches (built lazily: most files have
+        # no jit calls at all)
+        escapes: dict[int, set] = {}
+        nested: dict[int, set] = {}
+
+        def info(encl):
+            if id(encl) not in escapes:
+                escapes[id(encl)] = _escaping_names(encl)
+                nested[id(encl)] = _nested_def_names(encl)
+            return escapes[id(encl)], nested[id(encl)]
+
+        for node in ast.walk(ctx.tree):
+            # decorated nested defs: @jax.jit / @partial(jax.jit, ...)
+            # on a def inside a function — fresh jitted object per call
+            # of the enclosing function unless the name escapes
+            if isinstance(node, _FUNCS):
+                encl = next((a for a in ctx.ancestors(node)
+                             if isinstance(a, _FUNCS)), None)
+                if encl is None:
+                    continue
+                for dec in node.decorator_list:
+                    if (is_jit_name(ctx.resolve(dec))
+                            or partial_jit_decorator(ctx, dec) is not None):
+                        esc, _nd = info(encl)
+                        if node.name not in esc:
+                            findings.append(self.finding(
+                                ctx, dec,
+                                f"@jax.jit on {node.name!r}, a def nested "
+                                f"inside {encl.name!r}: a fresh jitted "
+                                "function per call — every call retraces; "
+                                "hoist the def to module scope or return "
+                                "the jitted callable (factory idiom)"))
+                continue
+            if not (isinstance(node, ast.Call)
+                    and is_jit_name(ctx.resolve(node.func)) and node.args):
+                continue
+            encl = next((a for a in ctx.ancestors(node)
+                         if isinstance(a, _FUNCS)), None)
+            if encl is None:
+                continue  # module scope: bound once per process
+            parent = ctx.parents.get(id(node))
+            # AOT escape: jax.jit(f).lower(...) — no dispatch cache
+            if isinstance(parent, ast.Attribute) and parent.attr == "lower":
+                continue
+            target = node.args[0]
+            esc, nested_names = info(encl)
+            if isinstance(target, ast.Lambda):
+                what = "a lambda"
+            elif (isinstance(target, ast.Name)
+                  and target.id in nested_names):
+                what = f"nested function {target.id!r}"
+            else:
+                continue  # module-level callables keep their identity
+            # factory exemptions: the wrapper escapes the function
+            if isinstance(parent, ast.Return):
+                continue
+            if isinstance(parent, ast.Assign):
+                tgt_names = [t.id for t in parent.targets
+                             if isinstance(t, ast.Name)]
+                if any(isinstance(t, ast.Attribute)
+                       for t in parent.targets):
+                    continue  # self.fn = jax.jit(...): once per object
+                if any(t in esc for t in tgt_names):
+                    continue  # assigned then returned: factory
+            findings.append(self.finding(
+                ctx, node,
+                f"jax.jit over {what} inside {encl.name!r}: the wrapped "
+                "function is a FRESH object every call, so the jit "
+                "dispatch cache never hits and every call retraces "
+                "(1532-recompiles class) — hoist it to module scope, or "
+                "return the jitted callable once (factory idiom)"))
+        return findings

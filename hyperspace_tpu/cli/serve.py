@@ -131,6 +131,20 @@ class ServeConfig:
     # continuous-batching max wait: a pending bucket that has not
     # exactly filled a power-of-two rung flushes after this many µs
     max_wait_us: float = 2000.0
+    # --- compile-time control (docs/serving.md "Warm starts") ----------
+    # persistent XLA compilation cache (hyperspace_tpu/compile_cache.py):
+    # default ON at <repo>/.cache/jax_compile (HYPERSPACE_COMPILE_CACHE
+    # env overrides); a path points it elsewhere, 0 disables.  A serve
+    # restart then deserializes its executables instead of re-compiling
+    # the whole bucket ladder.
+    compile_cache_dir: str | None = None
+    # startup bucket prewarm: compile the configured bucket ladder
+    # (× the IVF degradation-ladder widths) BEFORE serving traffic —
+    # serve mode warms before reading stdin, serve-http before the
+    # listeners open, so the first real request on every bucket is warm.
+    # 0 (default) = off; 1 = warm k= (the config's k); a comma list
+    # ("5,10") warms those k values.
+    prewarm: str = "0"
 
 
 def _ids(s: str, name: str) -> list[int]:
@@ -175,6 +189,46 @@ def _build(cfg: ServeConfig):
     except ValueError as e:  # bad queue_max/deadline_ms
         raise SystemExit(str(e)) from None
     return eng, batcher
+
+
+def _prewarm_ks(cfg: ServeConfig) -> list[int]:
+    """The ``prewarm=`` flag parsed into the k values to warm ([] = off;
+    docstring on the ServeConfig field).  Malformed values are clean
+    usage errors — a typo'd prewarm silently serving cold would defeat
+    the flag's whole point."""
+    v = cfg.prewarm.strip().lower()
+    if v in ("", "0", "false", "no", "off"):
+        return []
+    if v in ("1", "true", "yes", "on"):
+        return [cfg.k]
+    try:
+        ks = [int(t) for t in v.split(",") if t.strip()]
+    except ValueError:
+        raise SystemExit(
+            f"prewarm={cfg.prewarm!r}: want 0/1 or a comma-separated "
+            "list of k values to warm") from None
+    if not ks or any(k < 1 for k in ks):
+        raise SystemExit(
+            f"prewarm={cfg.prewarm!r}: k values must be >= 1")
+    return ks
+
+
+def _run_prewarm(batcher, ks: list[int]) -> None:
+    """Warm the ladder and announce it on stderr (diagnostics — stdout
+    stays the response stream).  Invalid ks for this table (k past the
+    row count) are usage errors, same class as a bad query k."""
+    if not ks:
+        return
+    try:
+        info = batcher.prewarm(ks)
+    except ValueError as e:
+        raise SystemExit(f"prewarm: {e}") from None
+    try:
+        print(f"[serve] prewarmed {info['programs']} program(s) over "
+              f"buckets {info['buckets']} ks {info['ks']} in "
+              f"{info['seconds']:.2f}s", file=sys.stderr, flush=True)
+    except (OSError, ValueError):
+        pass  # closed stderr: announcement loss only
 
 
 def run_export(cfg: ServeConfig) -> dict:
@@ -348,6 +402,11 @@ def run_serve(cfg: ServeConfig, *, stdin=None, stdout=None) -> dict:
     stdin = sys.stdin if stdin is None else stdin
     stdout = sys.stdout if stdout is None else stdout
     _eng, batcher = _build(cfg)
+    # warm the ladder BEFORE the first line is read — the first real
+    # request on every bucket must be warm (docs/serving.md "Warm
+    # starts"); with the persistent cache on, warming a restarted
+    # server is deserialization, not compilation
+    _run_prewarm(batcher, _prewarm_ks(cfg))
     served = 0
     draining = threading.Event()
     prev_handler = None
@@ -432,6 +491,7 @@ def run_serve_http(cfg: ServeConfig, *, ready=None) -> dict:
     if cfg.max_wait_us < 0:  # usage error BEFORE the artifact load pays
         raise SystemExit(
             f"max_wait_us must be >= 0; got {cfg.max_wait_us}")
+    prewarm_ks = _prewarm_ks(cfg)  # parse errors before the build pays
     _eng, batcher = _build(cfg)
 
     def announce(host, port):
@@ -446,7 +506,10 @@ def run_serve_http(cfg: ServeConfig, *, ready=None) -> dict:
     try:
         result = asyncio.run(run_front_door(
             batcher, host=cfg.host, port=cfg.port,
-            max_wait_us=cfg.max_wait_us, ready=announce))
+            max_wait_us=cfg.max_wait_us, ready=announce,
+            prewarm_ks=prewarm_ks))
+    except ValueError as e:  # prewarm k out of range for this table
+        raise SystemExit(f"prewarm: {e}") from None
     except OSError as e:  # bind failure (port in use, bad host): usage
         raise SystemExit(
             f"serve-http: cannot bind {cfg.host}:{cfg.port} — {e}"
@@ -524,9 +587,24 @@ def main(argv: list[str] | None = None) -> int:
         kv[k] = v
     cfg = apply_overrides(ServeConfig(), kv)
 
+    from hyperspace_tpu import compile_cache
     from hyperspace_tpu.resilience import faults as _faults
     from hyperspace_tpu.telemetry import cli_session
 
+    try:
+        # BEFORE the engine builds: every bucket executable (and the
+        # prewarm pass) should come from / land in the persistent cache
+        compile_cache.activate(cfg.compile_cache_dir)
+    except ValueError as e:  # unusable cache dir is a usage error
+        raise SystemExit(str(e)) from None
+    # the hook is unconditional here (idempotent, ~zero cost): the
+    # serve stats' `recompiles` field is a CONTRACT number (flat once
+    # warm) and must read honestly even with telemetry=0 and the
+    # cache disabled — a counter that silently reads 0 would make
+    # every cold start look warm
+    from hyperspace_tpu.telemetry import registry as _telem_registry
+
+    _telem_registry.install_jax_monitoring_hook()
     try:
         chaos_armed = _faults.install_chaos(cfg.chaos, cfg.chaos_seed)
     except ValueError as e:  # malformed chaos= grammar is a usage error

@@ -63,6 +63,13 @@ class RunConfig:
     # and reductions stay f32).  Copied into the workload config's own
     # `precision` field unless that is overridden explicitly.
     precision: str = "f32"
+    # persistent XLA compilation cache (hyperspace_tpu/compile_cache.py,
+    # docs/observability.md "Compilation cache"): default ON at
+    # <repo>/.cache/jax_compile (HYPERSPACE_COMPILE_CACHE env overrides);
+    # a path points it elsewhere, 0 disables.  Run #2 of the same
+    # program shapes deserializes executables instead of re-invoking XLA
+    # (`jax/compile_cache_hit` counts them).
+    compile_cache_dir: str | None = None
     # --- telemetry (docs/observability.md) -----------------------------
     # telemetry=1: run manifest as the FIRST JSONL record, span/* host
     # timings + ctr/* counter snapshots in every log record, and a final
@@ -702,11 +709,17 @@ def main(argv: list[str] | None = None) -> int:
     pairs += args.overrides
 
     run, wl_overrides = split_overrides(pairs, RunConfig())
-    from hyperspace_tpu import precision as precision_mod
+    from hyperspace_tpu import compile_cache, precision as precision_mod
 
     try:
         precision_mod.get_policy(run.precision)
     except ValueError as e:  # a typo'd preset is a usage error
+        raise SystemExit(str(e)) from None
+    try:
+        # BEFORE any workload compile: every executable this run builds
+        # should land in (or come from) the persistent cache
+        compile_cache.activate(run.compile_cache_dir)
+    except ValueError as e:  # unusable cache dir is a usage error
         raise SystemExit(str(e)) from None
     if run.rollback > 0 and not run.ckpt_dir:
         raise SystemExit(

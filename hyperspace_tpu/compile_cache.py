@@ -1,0 +1,163 @@
+"""Persistent XLA compilation cache wiring (ROADMAP item 5, pillar 1).
+
+Compile time is the dominant unmeasured cost in this stack: one short
+run logged ``jax/recompiles=1532`` with 22.5 s of ``jax/compile_s``,
+every serve (bucket, k, scan_mode, precision, nprobe) combination is a
+fresh executable compiled on first hit, and the historical rc=124
+bench/multichip artifact losses were compile-dominated.  Every one of
+those compiles is deterministic — the same HLO on the same backend
+produces the same executable — so a process restart re-paying them is
+pure waste.  This module points JAX's on-disk compilation cache
+(``jax_compilation_cache_dir``) at a persistent directory so run #2 of
+anything deserializes executables instead of invoking XLA.
+
+**Resolution order** (:func:`resolve_dir`): an explicit
+``compile_cache_dir=`` flag wins; else the ``HYPERSPACE_COMPILE_CACHE``
+env var; else the default ``<repo>/.cache/jax_compile`` beside the
+graph-prep cache.  The cache is **on by default**; the value ``0`` (or
+``false``/``no``/``off``) at either level disables it.  A directory
+that cannot be created or written is a loud :class:`ValueError` (the
+CLIs turn it into a clean usage exit) — a silently-dead cache would
+re-create exactly the cold-start cliff this exists to kill.
+
+**Cache-everything policy**: ``jax_persistent_cache_min_compile_time_
+secs`` is set to 0 and the min-entry-size check is disabled, so even
+the sub-second executables (the serve bucket ladder is made of them)
+persist — disk is cheap next to a p99 cliff.
+
+**Telemetry**: activation installs the shared ``jax.monitoring`` hook
+(:func:`hyperspace_tpu.telemetry.registry.install_jax_monitoring_hook`),
+which counts ``jax/compile_cache_hit`` (executables deserialized from
+the cache — the backend compile never ran) and
+``jax/compile_cache_miss`` (backend compiles while the cache was
+enabled — each writes a new entry).  Both ride into every JSONL record,
+``telemetry_summary``, and bench artifact through the existing
+registry, so cache hit rates are visible for free
+(docs/observability.md).
+
+Wired into ``__graft_entry__.py``, ``cli/train.py``, ``cli/serve.py``
+and ``bench.py`` — the four process entry points whose restarts pay
+cold compiles.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_VAR = "HYPERSPACE_COMPILE_CACHE"
+_OFF_VALUES = ("0", "false", "no", "off")
+
+# activation state: the directory the cache was pointed at (None = not
+# activated / disabled) plus the jax config value activation replaced
+# (tests/conftest.py points the suite at its own cache — deactivate
+# must restore it, not blank it).
+_state: dict = {"dir": None, "prev": None}
+
+
+def default_dir() -> str:
+    """``<repo>/.cache/jax_compile`` — beside the graph-prep cache
+    (``data/prep_cache.py``), under the checkout the artifacts live in."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(pkg), ".cache", "jax_compile")
+
+
+def resolve_dir(flag: Optional[str] = None) -> Optional[str]:
+    """The cache directory to use, or None when disabled.
+
+    ``flag`` is the CLI's ``compile_cache_dir=`` value (None = not
+    given); the env var covers flag-less entry points; the default is
+    ON — persistent caching must not depend on every caller
+    remembering a flag."""
+    v = flag if flag not in (None, "") else os.environ.get(ENV_VAR, "")
+    if v:
+        return None if v.strip().lower() in _OFF_VALUES else v
+    return default_dir()
+
+
+def activate(flag: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at the resolved dir.
+
+    Returns the directory in use, or None when disabled.  Raises
+    :class:`ValueError` for a directory that cannot be created or
+    written (callers map it to a clean usage error).  Idempotent —
+    re-activating with the same resolution is a no-op; a different
+    explicit dir re-points the cache (jax re-reads the config value
+    per compile)."""
+    d = resolve_dir(flag)
+    if d is None:
+        return None
+    d = os.path.abspath(d)
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError as e:
+        raise ValueError(
+            f"compile_cache_dir={d!r}: cannot create the cache "
+            f"directory ({e}) — fix the path or disable with "
+            "compile_cache_dir=0") from None
+    if not os.access(d, os.W_OK):
+        raise ValueError(
+            f"compile_cache_dir={d!r}: directory is not writable — "
+            "fix permissions or disable with compile_cache_dir=0")
+    import jax
+
+    prev_cfg = jax.config.jax_compilation_cache_dir
+    if _state["dir"] is None:
+        _state["prev"] = prev_cfg
+    jax.config.update("jax_compilation_cache_dir", d)
+    if prev_cfg is not None and prev_cfg != d:
+        # a cache was already configured (and possibly initialized) at
+        # another dir in this process: drop the singleton so entries
+        # actually land where the new config points
+        _reset_jax_cache_object()
+    # cache-everything policy (module docstring): the serve ladder is
+    # made of sub-second executables, and those ARE the cold-start cost
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except AttributeError:
+        pass  # older jax without the size gate: nothing to disable
+    _state["dir"] = d
+    # hit/miss counters ride the shared monitoring hook (idempotent)
+    from hyperspace_tpu.telemetry import registry as telem
+
+    telem.install_jax_monitoring_hook()
+    return d
+
+
+def is_enabled() -> bool:
+    """Whether :func:`activate` pointed the cache somewhere this
+    process — the registry hook's miss-attribution gate."""
+    return _state["dir"] is not None
+
+
+def cache_dir() -> Optional[str]:
+    return _state["dir"]
+
+
+def deactivate() -> None:
+    """Restore the pre-activation cache config (tests: jax config is
+    process-global — a test that activated must not leak its dir into
+    the next, nor blank a cache the harness had already pointed)."""
+    if _state["dir"] is None:
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", _state["prev"])
+    _reset_jax_cache_object()
+    _state["dir"] = None
+    _state["prev"] = None
+
+
+def _reset_jax_cache_object() -> None:
+    """Drop jax's in-process file-cache singleton: it is initialized
+    once for the FIRST directory used, so re-pointing the config alone
+    would silently keep writing to the old dir.  Private API —
+    best-effort (a jax without it just keeps the first dir, which only
+    in-process re-activation ever hits)."""
+    try:
+        from jax._src import compilation_cache as _jcc
+
+        _jcc.reset_cache()
+    except Exception:  # noqa: BLE001  # hyperlint: disable=swallow-base-exception — private-API drift: the first-activated dir keeps working, only an in-process re-point degrades
+        pass

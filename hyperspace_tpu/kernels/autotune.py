@@ -1,0 +1,253 @@
+"""Empirical tile autotuner for the fused scan-top-k kernel.
+
+``kernels/scan_topk.py`` sizes its streamed table tile (``bm``) with a
+static VMEM-footprint model (:func:`~hyperspace_tpu.kernels.scan_topk.
+fused_tile_rows`) — a conservative guess at what fits, not a
+measurement of what is fast.  The real optimum depends on the backend's
+memory system (VMEM banking, DMA granularity, the CPU twin's loop
+overhead), which no model on this image can predict.  This module
+closes the loop empirically:
+
+- :func:`measure` times :func:`scan_topk` / :func:`scan_topk_cand` on
+  the **real backend** over candidate ``bm`` tiles (powers of two on
+  the 128 grid, capped by the static footprint model so nothing a real
+  chip's Mosaic would reject is ever timed or stored), per
+  ``(variant, dim, dtype, k)``;
+- :func:`save_table` persists the winners as a **versioned JSON table**
+  keyed ``(variant, dim, dtype, k, device_kind)`` —
+  ``configs/scan_topk_tiles.json`` by default,
+  ``HYPERSPACE_AUTOTUNE_TABLE`` overrides (``0`` disables lookups);
+- :func:`lookup` is the hot-path read ``fused_tile_rows`` /
+  ``fused_cand_tile_rows`` consult: a tuned entry for the current
+  device kind wins, anything else — no table, version mismatch,
+  foreign device kind, an entry off the 128 grid — falls back to the
+  static model.  **Fallback is always silent and always safe**: tile
+  choice is result-invisible (the kernel's merge extracts exact copies
+  with global-column tie-breaks, so every tile size produces bitwise
+  identical results — tested), so a stale or missing table can cost
+  only speed, never correctness.
+
+``scripts/autotune_scan_topk.py`` is the offline driver (run it once
+per device kind; the table is additive — entries for other device
+kinds are preserved).  Format and fallback rules: docs/kernels.md
+"Autotuned tiles".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+TABLE_VERSION = 1
+ENV_TABLE = "HYPERSPACE_AUTOTUNE_TABLE"
+_OFF_VALUES = ("0", "false", "no", "off")
+
+# candidate streamed-tile heights: the 128-grid powers of two the
+# schedule accepts; measure() intersects with the static footprint cap
+CANDIDATE_BM = (128, 256, 512, 1024)
+VARIANTS = ("slab", "cand")
+
+# in-process table cache: {abs path: entries dict}; reset_cache() for
+# tests.  Loaded once per path — lookup sits on the engine-build path.
+_cache: dict = {}
+
+
+def default_table_path() -> str:
+    """``<repo>/configs/scan_topk_tiles.json`` — beside the run
+    configs, so a tuned table ships with a deployment checkout."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg), "configs",
+                        "scan_topk_tiles.json")
+
+
+def table_path() -> Optional[str]:
+    """The table to consult (None = lookups disabled via env ``0``)."""
+    v = os.environ.get(ENV_TABLE, "")
+    if v:
+        return None if v.strip().lower() in _OFF_VALUES else v
+    return default_table_path()
+
+
+def _dtype_name(dtype) -> str:
+    import jax.numpy as jnp
+
+    return jnp.dtype(dtype).name
+
+
+def entry_key(variant: str, dim: int, dtype, k: int,
+              device_kind: str) -> str:
+    """The table's flat entry key."""
+    return f"{variant}|{int(dim)}|{_dtype_name(dtype)}|{int(k)}|{device_kind}"
+
+
+def device_kind() -> str:
+    """The current backend's device kind (e.g. ``cpu``,
+    ``TPU v5e``) — resolved lazily; callers only ask once a table with
+    entries exists, so a pure sizing call never initializes a backend."""
+    import jax
+
+    return str(jax.devices()[0].device_kind)
+
+
+def _valid_bm(bm) -> Optional[int]:
+    """A stored tile is used only if it is a positive multiple of 128
+    within the schedule's range — anything else is a corrupt/foreign
+    entry and falls back to the static model."""
+    if isinstance(bm, bool) or not isinstance(bm, int):
+        return None
+    if bm < 128 or bm > 4096 or bm % 128:
+        return None
+    return bm
+
+
+def load_table(path: str) -> dict:
+    """{entry key: entry dict} from a table file; empty on any problem
+    (missing file, unparseable JSON, version mismatch) — the fallback
+    rule (module docstring)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return {}
+    if not isinstance(doc, dict) or doc.get("version") != TABLE_VERSION:
+        return {}
+    entries = doc.get("entries")
+    return entries if isinstance(entries, dict) else {}
+
+
+def save_table(entries: dict, path: str) -> None:
+    """Write the versioned table (atomic-ish: tmp + rename, so a reader
+    never sees a half-written JSON)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"version": TABLE_VERSION, "entries": entries},
+                  f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def reset_cache() -> None:
+    """Drop the in-process table cache (tests; after a fresh tune)."""
+    _cache.clear()
+
+
+def lookup(variant: str, dim: int, dtype, k: int) -> Optional[int]:
+    """The tuned ``bm`` for this shape on the CURRENT device kind, or
+    None (→ the caller's static model).  Cheap: the table file is read
+    once per process per path, and the backend is only queried when
+    the table actually has entries."""
+    path = table_path()
+    if path is None:
+        return None
+    entries = _cache.get(path)
+    if entries is None:
+        entries = _cache[path] = load_table(path)
+    if not entries:
+        return None
+    e = entries.get(entry_key(variant, dim, dtype, k, device_kind()))
+    if not isinstance(e, dict):
+        return None
+    return _valid_bm(e.get("bm"))
+
+
+# --- offline measurement ------------------------------------------------------
+
+
+def _candidates(variant: str, dim: int, dtype, k: int) -> list[int]:
+    """CANDIDATE_BM capped by the static footprint model — a tile the
+    model rejects would only compile on the CPU twin (Mosaic would
+    refuse it on a real chip), so it is never timed or stored."""
+    from hyperspace_tpu.kernels import scan_topk as K
+
+    # allow_tuned=False: the cap must come from the STATIC model — a
+    # previously-tuned small tile must never shrink the search space of
+    # the next tune (the table would self-lock at its first answer)
+    cap = (K.fused_tile_rows(dim, dtype, k, allow_tuned=False)
+           if variant == "slab"
+           else K.fused_cand_tile_rows(dim, dtype, k, allow_tuned=False))
+    out = [bm for bm in CANDIDATE_BM if bm <= cap]
+    return out or [128]
+
+
+def measure(variant: str, dim: int, dtype, k: int, *,
+            rows: int = 65_536, batch: int = 256, cand: int = 512,
+            repeats: int = 3, candidates=None, seed: int = 0) -> dict:
+    """Time the kernel over candidate tiles on the real backend.
+
+    Returns ``{"bm": best, "ms": best_ms, "timings": {bm: ms}}`` —
+    min-of-``repeats`` wall-clock per candidate after one warm
+    (compile) call, on a synthetic Poincaré slab shaped like the serve
+    workload.  ``variant="cand"`` times the per-query candidate scorer
+    over ``cand`` gathered ids per row instead of the shared slab."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hyperspace_tpu.kernels import scan_topk as K
+
+    if variant not in VARIANTS:
+        raise ValueError(f"variant must be one of {VARIANTS}; got {variant!r}")
+    rng = np.random.default_rng(seed)
+    spec = ("poincare", 1.0)
+    table = np.tanh(rng.standard_normal((rows, dim)) * 0.3).astype(
+        np.float32) * 0.7
+    slab = jnp.asarray(table, jnp.dtype(dtype))
+    q_rows = jnp.asarray(table[: batch], jnp.float32)
+    q_idx = jnp.arange(batch, dtype=jnp.int32)
+    if variant == "cand":
+        cand_ids = jnp.asarray(
+            rng.integers(0, rows, size=(batch, cand)), jnp.int32)
+
+    def run(bm: int):
+        if variant == "slab":
+            return K.scan_topk(slab, q_rows.astype(slab.dtype), q_idx, 0,
+                               spec=spec, k=k, n=rows, tile_rows=bm)
+        return K.scan_topk_cand(slab, cand_ids,
+                                q_rows.astype(slab.dtype), q_idx,
+                                spec=spec, k=k, tile_rows=bm)
+
+    timings: dict[int, float] = {}
+    for bm in (candidates or _candidates(variant, dim, dtype, k)):
+        out = run(bm)  # compile + warm
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            out = run(bm)
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
+        timings[bm] = round(best * 1e3, 4)
+    best_bm = min(timings, key=timings.get)
+    return {"bm": best_bm, "ms": timings[best_bm], "timings": timings}
+
+
+def autotune(dims, dtypes, ks, *, variants=VARIANTS, rows: int = 65_536,
+             batch: int = 256, repeats: int = 3,
+             base_entries: Optional[dict] = None,
+             log=print) -> dict:
+    """Measure a grid and return the merged entries dict (existing
+    entries — other device kinds, other shapes — are preserved; the
+    grid's keys are overwritten with fresh measurements)."""
+    kind = device_kind()
+    entries = dict(base_entries or {})
+    for variant in variants:
+        for dim in dims:
+            for dtype in dtypes:
+                for k in ks:
+                    m = measure(variant, dim, dtype, k, rows=rows,
+                                batch=batch, repeats=repeats)
+                    key = entry_key(variant, dim, dtype, k, kind)
+                    entries[key] = {
+                        "variant": variant, "dim": int(dim),
+                        "dtype": _dtype_name(dtype), "k": int(k),
+                        "device_kind": kind, "bm": m["bm"],
+                        "ms": m["ms"],
+                        "timings": {str(b): t
+                                    for b, t in m["timings"].items()},
+                    }
+                    log(f"[autotune] {key}: bm={m['bm']} "
+                        f"({m['ms']} ms; {m['timings']})")
+    return entries

@@ -126,13 +126,31 @@ def supports_cand(spec: tuple, *, k: int, dim: int, cand: int) -> bool:
 
 def fused_tile_rows(dim: int, dtype, k: int, *,
                     tile_budget: int = S.VMEM_BUDGET,
-                    bq: int = _SLAB_BQ) -> int:
-    """Table-tile rows for the slab kernel, from the dim × dtype × k
-    VMEM footprint (NOT a fixed-byte distance-tile budget: the fused
-    working set is the double-buffered table tile + the query block +
-    the carry + the merge temporaries).  Deterministic and pinned by
-    tests — the engine's ``auto_chunk_rows`` delegates here for
-    ``scan_mode="fused"``."""
+                    bq: int = _SLAB_BQ, allow_tuned: bool = True) -> int:
+    """Table-tile rows for the slab kernel.
+
+    A **tuned entry** for this (dim, dtype, k) on the current device
+    kind wins when one exists (``kernels/autotune.py`` — the empirical
+    table ``scripts/autotune_scan_topk.py`` persists; consulted only at
+    the default budget/bq, since a caller passing its own budget is
+    asking the model a question the table never measured).  Otherwise
+    the static dim × dtype × k VMEM-footprint model below (NOT a
+    fixed-byte distance-tile budget: the fused working set is the
+    double-buffered table tile + the query block + the carry + the
+    merge temporaries) — deterministic and pinned by tests.  Tile
+    choice is result-invisible either way (the merge extracts exact
+    copies with global-column tie-breaks — tested bitwise across
+    tiles), so a missing/stale table costs only speed.  A tuned entry
+    is CLAMPED to the static model's answer: the model is the VMEM-fit
+    bound a real chip's Mosaic enforces, so a stale table (tuned under
+    a looser footprint) can never hand the kernel a tile that only the
+    CPU twin would accept.  The engine's ``auto_chunk_rows`` delegates
+    here for ``scan_mode="fused"``."""
+    tuned = None
+    if allow_tuned and tile_budget == S.VMEM_BUDGET and bq == _SLAB_BQ:
+        from hyperspace_tpu.kernels import autotune
+
+        tuned = autotune.lookup("slab", dim, dtype, k)
     dp = S.round_up(int(dim), 128)
     kp = S.round_up(int(k), 128)
     it = jnp.dtype(dtype).itemsize
@@ -147,14 +165,22 @@ def fused_tile_rows(dim: int, dtype, k: int, *,
     bm = 1024
     while bm > 128 and footprint(bm) > tile_budget:
         bm //= 2
-    return bm
+    return bm if tuned is None else min(tuned, bm)
 
 
 def fused_cand_tile_rows(dim: int, dtype, k: int, *,
                          tile_budget: int = S.VMEM_BUDGET,
-                         bq: int = _CAND_BQ) -> int:
+                         bq: int = _CAND_BQ,
+                         allow_tuned: bool = True) -> int:
     """Candidate-tile rows for the per-query variant: the row block is
-    3-D ``[bq, bm, dp]`` so the footprint scales with bq × bm × dp."""
+    3-D ``[bq, bm, dp]`` so the footprint scales with bq × bm × dp.
+    Tuned-table consultation, static-model clamp and fallback exactly
+    as :func:`fused_tile_rows` (variant ``"cand"``)."""
+    tuned = None
+    if allow_tuned and tile_budget == S.VMEM_BUDGET and bq == _CAND_BQ:
+        from hyperspace_tpu.kernels import autotune
+
+        tuned = autotune.lookup("cand", dim, dtype, k)
     dp = S.round_up(int(dim), 128)
     kp = S.round_up(int(k), 128)
     it = jnp.dtype(dtype).itemsize
@@ -170,7 +196,7 @@ def fused_cand_tile_rows(dim: int, dtype, k: int, *,
     bm = 1024
     while bm > 128 and footprint(bm) > tile_budget:
         bm //= 2
-    return bm
+    return bm if tuned is None else min(tuned, bm)
 
 
 # --- shared tile math (kernel body AND twin run exactly this) -----------------

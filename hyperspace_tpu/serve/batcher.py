@@ -380,6 +380,91 @@ class RequestBatcher:
             return None
         return self._modes[self._ladder.level]
 
+    # --- startup prewarm (docs/serving.md "Warm starts") ----------------------
+
+    def prewarm(self, ks: Sequence[int], *, buckets=None,
+                exclude_self=(True, False)) -> dict:
+        """Compile every (bucket, k, exclude_self, ladder-nprobe)
+        executable BEFORE traffic, so the first real request on every
+        bucket of the ladder is warm — BOTH ``exclude_self`` settings
+        by default, since every serving surface accepts the request
+        flag and a cold variant would re-open the p99 cliff for
+        whichever flavor the warmup skipped — the cold-bucket p99 cliff the
+        PR 7 histograms exposed, closed at startup instead of papered
+        over by bench warmup.  With the persistent compilation cache on
+        (hyperspace_tpu/compile_cache.py) a restarted server's prewarm
+        is deserialization, not compilation — this is the blue-green
+        warm path ROADMAP item 4 flips onto.
+
+        Dispatches go STRAIGHT to the engine: no LRU writes, no
+        request counters, no latency histograms — prewarm traffic must
+        never masquerade as served requests (the only registry marks
+        are ``serve/prewarmed`` — programs warmed — and
+        ``serve/prewarm_s``).  The engine's own scan mode / precision /
+        index are baked into its executables, so a prewarmed bf16 or
+        fused or probing engine is warm for exactly the signature it
+        serves (the batcher cache key's isolation contract, upheld by
+        construction).  The IVF degradation ladder's narrowed widths
+        (``_ladder_modes``) are warmed too — stepping down under
+        pressure must not hand the compiler a fresh program mid-storm.
+
+        ``ks`` are validated against the table like any request's k; an
+        IVF probe combination the index cannot fill raises AFTER its
+        executable compiled — those are swallowed here (the program is
+        warm, which is all prewarm promises).  Returns
+        ``{programs, seconds, buckets, ks}``.
+        """
+        import jax
+
+        eng = self.engine
+        ks = sorted({int(k) for k in ks})
+        limit = eng.num_nodes - (1 if any(exclude_self) else 0)
+        for k in ks:
+            if not 1 <= k <= limit:
+                raise ValueError(
+                    f"prewarm k={k} out of range [1, {limit}] for a "
+                    f"{eng.num_nodes}-row table")
+        # full width (None) plus every ladder override the degradation
+        # path can serve — deduped after the plan_topk clamp rule
+        widths: list = [None]
+        for m in self._modes:
+            if isinstance(m, int) and m not in widths:
+                widths.append(m)
+        buckets = tuple(buckets or self.buckets)
+        t0 = time.perf_counter()
+        warmed = 0
+        for b in buckets:
+            q = np.arange(b, dtype=np.int64) % eng.num_nodes
+            for k in ks:
+                for ex in exclude_self:
+                    seen_p = set()
+                    for p in widths:
+                        if p is not None:
+                            # the ladder's clamp: the narrowed probe
+                            # must still hold k rows (plan_topk)
+                            mc = eng.index.max_cell
+                            p = min(max(p, -(-k // mc)), eng.nprobe)
+                            if p >= eng.nprobe or p in seen_p:
+                                continue
+                            seen_p.add(p)
+                        try:
+                            out = eng.topk_neighbors(
+                                q, k, exclude_self=bool(ex), nprobe=p)
+                            jax.block_until_ready(out)
+                        except ValueError:
+                            # an under-filled probe raises on the
+                            # RESULTS — the executable is already warm,
+                            # which is all prewarm promises; real
+                            # traffic answers the same error per
+                            # request
+                            pass
+                        warmed += 1
+        dt = time.perf_counter() - t0
+        telem.inc("serve/prewarmed", warmed)
+        telem.inc("serve/prewarm_s", dt)
+        return {"programs": warmed, "seconds": dt,
+                "buckets": list(buckets), "ks": ks}
+
     # --- pipeline stages (module docstring, "Pipeline stages") ---------------
 
     def validate_topk_request(self, ids, k) -> tuple[list[int], int]:
@@ -663,6 +748,11 @@ class RequestBatcher:
         gauges = reg.snapshot()
         return {
             "latency_e2e_ms": gauges.get("hist/serve/e2e_ms"),
+            # compile count beside the serve stats (the stdin loop's
+            # analog of the HTTP stats field): the contract every smoke
+            # and bench leg reads is recompiles FLAT once warm
+            "recompiles": reg.get("jax/recompiles"),
+            "prewarmed": reg.get("serve/prewarmed"),
             "requests": reg.get("serve/requests"),
             "cache_hit": reg.get("serve/cache_hit"),
             "cache_miss": reg.get("serve/cache_miss"),

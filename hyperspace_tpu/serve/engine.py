@@ -674,7 +674,12 @@ class QueryEngine:
         if self._fused_kind and (
                 self.chunk_rows % 128
                 or self.chunk_rows > fused_kernel.fused_tile_rows(
-                    self.dim, scan_dtype, fused_kernel.FUSED_MAX_K)):
+                    self.dim, scan_dtype, fused_kernel.FUSED_MAX_K,
+                    allow_tuned=False)):
+            # allow_tuned=False: this check is the VMEM-FIT bound (what
+            # a real chip's Mosaic would accept), not the autotuner's
+            # speed preference — a tuned table picking a SMALLER tile
+            # must not demote an explicit chunk_rows the model fits
             # a user chunk_rows off the 128 grid can never stream, and
             # one past the kernel's VMEM footprint model would compile
             # only on the CPU twin (Mosaic would reject the tile on a

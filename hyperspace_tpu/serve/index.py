@@ -380,7 +380,7 @@ def build_index(table, manifold_spec: tuple, ncells: int, *,
     # k-means++ seeding: D² sampling under the geodesic metric — each
     # new seed is drawn ∝ squared distance to the nearest chosen seed
     rng = np.random.default_rng(seed)
-    dist_to = jax.jit(lambda t, c: m.dist(t, c[None, :]))
+    dist_to = jax.jit(lambda t, c: m.dist(t, c[None, :]))  # hyperlint: disable=jit-cache-defeat — offline builder: one trace per build_index call, amortized over the whole k-means++/Lloyd loop
     chosen = [int(rng.integers(n))]
     d2 = np.square(np.asarray(dist_to(tdev, tdev[chosen[0]])), dtype=np.float64)
     for _ in range(ncells - 1):

@@ -430,15 +430,30 @@ def latency_summary_line(baseline: Optional[dict] = None) -> str:
 
 async def run_front_door(batcher: RequestBatcher, *, host: str, port: int,
                          max_wait_us: float,
-                         ready=None) -> dict:
+                         ready=None, prewarm_ks=None) -> dict:
     """Start, announce, serve until drained (SIGTERM), summarize.
 
     ``ready(host, port)`` is called once the listener is bound (the CLI
     prints the parseable "listening" line there; tests grab the
-    ephemeral port).  Returns the closing stats dict."""
+    ephemeral port).  ``prewarm_ks`` (a list of k values) compiles the
+    whole bucket ladder **before the listeners open** —
+    :meth:`RequestBatcher.prewarm`, docs/serving.md "Warm starts" — so
+    the first request a client can possibly land on any bucket is warm
+    (and ``/healthz`` cannot answer ok while the ladder is still cold).
+    Returns the closing stats dict."""
     door = HttpFrontDoor(batcher, host=host, port=port,
                          max_wait_us=max_wait_us)
     session_mark = telem.default_registry().mark()
+    if prewarm_ks:
+        # deliberately blocking: nothing is listening yet, and a warm
+        # ladder is the precondition for opening the door at all
+        info = batcher.prewarm(prewarm_ks)
+        try:
+            print(f"[serve-http] prewarmed {info['programs']} "
+                  f"program(s) in {info['seconds']:.2f}s",
+                  file=sys.stderr, flush=True)
+        except (OSError, ValueError):
+            pass  # hyperlint: disable=swallow-base-exception — closed stderr: announcement loss only
     await door.start()
     if ready is not None:
         ready(door.host, door.port)

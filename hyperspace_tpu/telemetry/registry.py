@@ -26,7 +26,13 @@ thread increments concurrently with the training loop.
 duration events and turns backend compiles into ``jax/recompiles`` /
 ``jax/compile_s`` — the counter that catches a shape-unstable stepper
 recompiling every chunk (the failure the chunked loop's donation +
-static scan length is supposed to rule out).
+static scan length is supposed to rule out).  With the persistent
+compilation cache active (:mod:`hyperspace_tpu.compile_cache`) the same
+hook also counts ``jax/compile_cache_hit`` (executables deserialized
+from disk — the backend compile never ran) and
+``jax/compile_cache_miss`` (backend compiles while the cache was
+enabled; each writes a new entry), so cache hit rates ride into every
+JSONL record and bench artifact for free.
 """
 
 from __future__ import annotations
@@ -35,6 +41,8 @@ import threading
 from typing import Optional
 
 _BACKEND_COMPILE_SUBSTR = "backend_compile"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 
 
 class Registry:
@@ -191,7 +199,17 @@ def install_jax_monitoring_hook() -> None:
     at event time, so a test that swaps/resets the registry still sees
     fresh counts.  Counts ``/jax/core/compile/backend_compile_duration``
     events: one per XLA backend compile, i.e. recompiles once the run's
-    steady state is reached.
+    steady state is reached.  The persistent-cache counters (module
+    docstring) come from the cache's own explicit events: a
+    ``cache_hits`` event is an executable deserialized from disk, a
+    ``cache_misses`` event a compile the cache could not serve.  NOTE
+    on this jax's accounting: a persistent-cache HIT still fires the
+    ``backend_compile`` duration event (it times the deserialization),
+    so ``jax/recompiles`` counts executable *materializations* either
+    way — the cache's win reads in ``jax/compile_s`` collapsing (~20×
+    on this image) and in the hit counter, not in a lower recompile
+    count.  In-process warm executables fire nothing, so the flat-once-
+    warm contracts are unchanged.
     """
     global _hook_installed
     if _hook_installed:
@@ -205,7 +223,14 @@ def install_jax_monitoring_hook() -> None:
                 reg.inc("jax/recompiles")
                 reg.inc("jax/compile_s", float(duration))
 
+        def _on_event(event: str, **_kw) -> None:
+            if event == _CACHE_HIT_EVENT:
+                default_registry().inc("jax/compile_cache_hit")
+            elif event == _CACHE_MISS_EVENT:
+                default_registry().inc("jax/compile_cache_miss")
+
         _mon.register_event_duration_secs_listener(_on_duration)
+        _mon.register_event_listener(_on_event)
         _hook_installed = True
     except Exception:  # noqa: BLE001  # hyperlint: disable=swallow-base-exception — jax.monitoring absent/renamed: recompile counting is best-effort by contract (telemetry must never sink a run)
         pass

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Offline fused-kernel tile autotuner (docs/kernels.md "Autotuned
+tiles").
+
+Times candidate ``bm`` tiles for the fused scan-top-k kernel
+(``kernels/scan_topk.py``) on THIS process's backend over a
+``(variant, dim, dtype, k)`` grid and persists the winners into the
+versioned JSON table ``kernels/autotune.py`` consults — the static
+VMEM-footprint model stays the fallback for every shape the table does
+not cover.  The table is additive: entries for other device kinds and
+shapes are preserved, the tuned grid's keys are overwritten.
+
+    # tune the serve-shaped defaults on the current backend
+    python scripts/autotune_scan_topk.py
+
+    # a custom grid, somewhere else
+    python scripts/autotune_scan_topk.py --dims 16,32 --ks 10,128 \
+        --dtypes float32,bfloat16 --variants slab --rows 100000 \
+        --out /tmp/tiles.json
+
+Run on the deployment backend: a table tuned on the CPU twin says
+nothing about a TPU (entries are keyed by device kind, so a foreign
+table simply never matches — the fallback rule).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# runnable as a plain script from anywhere (the package is not installed)
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _ints(s: str) -> list[int]:
+    return [int(t) for t in s.split(",") if t.strip()]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="autotune_scan_topk",
+        description="Tune fused scan-top-k tile sizes on the current "
+                    "backend and persist the table.")
+    ap.add_argument("--dims", default="16,32,64",
+                    help="comma list of feature dims to tune")
+    ap.add_argument("--ks", default="10,100,256",
+                    help="comma list of k values to tune (256 = the "
+                         "engine's worst-case sizing key)")
+    ap.add_argument("--dtypes", default="float32,bfloat16",
+                    help="comma list of table dtypes")
+    ap.add_argument("--variants", default="slab,cand",
+                    help="comma list of kernel variants (slab,cand)")
+    ap.add_argument("--rows", type=int, default=65_536,
+                    help="synthetic table rows per timing run")
+    ap.add_argument("--batch", type=int, default=256,
+                    help="query batch per timing run")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="timing repeats per candidate (min wins)")
+    ap.add_argument("--out", default=None,
+                    help="table path (default: the consulted table — "
+                         "HYPERSPACE_AUTOTUNE_TABLE or "
+                         "configs/scan_topk_tiles.json)")
+    args = ap.parse_args(argv)
+
+    from hyperspace_tpu.kernels import autotune
+
+    out = args.out or autotune.table_path() or autotune.default_table_path()
+    variants = tuple(v.strip() for v in args.variants.split(",") if v.strip())
+    for v in variants:
+        if v not in autotune.VARIANTS:
+            raise SystemExit(
+                f"--variants {v!r}: want a subset of {autotune.VARIANTS}")
+    try:
+        dims, ks = _ints(args.dims), _ints(args.ks)
+    except ValueError as e:
+        raise SystemExit(f"bad grid list: {e}") from None
+    dtypes = [t.strip() for t in args.dtypes.split(",") if t.strip()]
+
+    entries = autotune.autotune(
+        dims, dtypes, ks, variants=variants, rows=args.rows,
+        batch=args.batch, repeats=args.repeats,
+        base_entries=autotune.load_table(out))
+    autotune.save_table(entries, out)
+    autotune.reset_cache()  # this process sees its own fresh answers
+    print(f"[autotune] {len(entries)} entr{'y' if len(entries) == 1 else 'ies'} "
+          f"-> {out} (device_kind={autotune.device_kind()!r})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,7 +23,12 @@ Unparseable rounds (r04's null, r05's rc=124) are listed, never fatal:
 a lost artifact must not hide the rounds around it.  Sentinel records
 (``metric`` of ``error`` / ``budget_exhausted``) appear in the rounds
 table but are excluded from series and gate — a watchdog's value=0 is
-an incident marker, not a measurement.
+an incident marker, not a measurement.  The same holds for a
+**budget-exhausted primary**: a record whose metric is real but whose
+``detail.budget_exhausted`` is set was cut short by the watchdog (the
+checked-in 1-second-budget ``bench_full.json`` test artifact is the
+standing example) — its numbers are partial, so it is a rounds row but
+never a series point or gate candidate.
 
 Better/worse per metric is inferred from the name (queries/s and
 samples/s up, seconds and milliseconds down — ``direction()``);
@@ -53,7 +58,7 @@ _SKIP_DETAIL_KEYS = {"telemetry", "traceback"}
 _HIGHER_TOKENS = ("per_s", "per_sec", "qps", "samples", "speedup",
                   "recall", "rate", "auc", "frac", "roofline", "ratio")
 _LOWER_TOKENS = ("time", "stall", "waste", "recompile", "epoch_s",
-                 "compile", "latency")
+                 "compile", "latency", "ttfq")
 # lower-better tokens that outrank the higher-better list: "ratio" is
 # generically higher-better (fused/unfused speedup ratios), but a
 # waste ratio is still waste; "rate" is generically higher-better
@@ -180,6 +185,12 @@ def build_series(rounds: list[dict]) -> dict:
         metric = rec.get("metric")
         if metric in SENTINEL_METRICS or not metric:
             continue
+        det = rec.get("detail")
+        if isinstance(det, dict) and det.get("budget_exhausted"):
+            # a watchdog-cut partial artifact (real metric, truncated
+            # legs): a rounds-table row, never a series point — it must
+            # not gate as the 'full' round nor set a phantom best
+            continue
         value = rec.get("value")
         if isinstance(value, (int, float)) and not isinstance(value, bool):
             headline.setdefault(metric, []).append(
@@ -252,9 +263,14 @@ def gate(series: dict, threshold: float) -> dict:
 def build_report(root: str, threshold: float) -> dict:
     rounds = load_rounds(root)
     series = build_series(rounds)
+    def _cut_short(rec) -> bool:
+        det = (rec or {}).get("detail")
+        return bool(isinstance(det, dict) and det.get("budget_exhausted"))
+
     public_rounds = [{k: v for k, v in r.items() if k != "record"}
                      | {"metric": (r["record"] or {}).get("metric"),
-                        "value": (r["record"] or {}).get("value")}
+                        "value": (r["record"] or {}).get("value"),
+                        "budget_exhausted": _cut_short(r["record"])}
                      for r in rounds]
     return {"rounds": public_rounds, "series": series,
             "gate": gate(series, threshold)}

@@ -1,14 +1,17 @@
 #!/usr/bin/env python
 """Smoke lint: the HTTP front door round trip, as a real subprocess.
 
-export → ``serve-http`` on an ephemeral port → healthz → warm same-
-bucket queries → stats → score → a malformed request → SIGTERM drain.
-Asserted (exit 1 on any miss):
+export → ``serve-http`` with ``prewarm=1`` on an ephemeral port →
+healthz → stats → same-bucket queries → stats → score → a malformed
+request → SIGTERM drain.  Asserted (exit 1 on any miss):
 
 - exactly one response per request (none dropped, none duplicated);
-- ``jax/recompiles`` FLAT across same-bucket requests after the first
-  (the stats endpoint carries the counter — the compile-once-per-bucket
-  contract through the socket path);
+- with ``prewarm=1`` the bucket ladder is compiled BEFORE the
+  listeners open, so ``jax/recompiles`` is FLAT from the **first**
+  request — the stats endpoint is read before any topk, and again
+  after them (docs/serving.md "Warm starts"; before PR 13 this script
+  could only assert flatness across same-bucket repeats AFTER a
+  warmup request);
 - the served top-k matches a live engine on the same table bit-for-bit;
 - a malformed request answers 400 with a typed kind and the server
   keeps serving;
@@ -153,7 +156,8 @@ def main(out_dir: str | None = None) -> int:
         proc = subprocess.Popen(
             [sys.executable, "-m", "hyperspace_tpu.cli.serve",
              "serve-http", f"artifact={out_dir}", "port=0",
-             "host=127.0.0.1", "max_wait_us=1000", "telemetry=1"],
+             "host=127.0.0.1", "max_wait_us=1000", "telemetry=1",
+             "prewarm=1", f"k={K}"],
             cwd=ROOT, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True)
         pump = _StderrPump(proc)
@@ -168,15 +172,23 @@ def main(out_dir: str | None = None) -> int:
             print(f"HEALTHZ BROKEN: {status} {health}")
             return 1
 
-        # warm the (bucket, k) executable, then hold the bucket: every
-        # later 3-id request pads to the same rung
+        # recompile count BEFORE any topk: prewarm=1 compiled the whole
+        # ladder before the listener opened, so the FIRST real request
+        # must find its executable warm (stats itself compiles nothing)
+        status, stats0 = _post(host, port, "/v1/stats", {})
+        sent += 1
+        answered += 1
+        if status != 200 or stats0.get("prewarmed", 0) <= 0:
+            print(f"PREWARM DID NOT RUN: {status} {stats0.get('prewarmed')}")
+            return 1
+
         ids0 = [0, 1, 2]
         status, first = _post(host, port, "/v1/topk",
                               {"ids": ids0, "k": K})
         sent += 1
         answered += 1
         if status != 200:
-            print(f"WARM QUERY FAILED: {status} {first}")
+            print(f"FIRST QUERY FAILED: {status} {first}")
             return 1
         li, ld = (np.asarray(a) for a in live.topk_neighbors(
             np.asarray(ids0, np.int32), K))
@@ -208,6 +220,14 @@ def main(out_dir: str | None = None) -> int:
         if stats2["recompiles"] != stats1["recompiles"]:
             print(f"RECOMPILES NOT FLAT across same-bucket requests: "
                   f"{stats1['recompiles']} -> {stats2['recompiles']}")
+            return 1
+        # the prewarm contract: flat from the FIRST request, not merely
+        # across repeats after a warmup — the pre-first-query reading
+        # equals the post-queries reading
+        if stats2["recompiles"] != stats0["recompiles"]:
+            print(f"RECOMPILES NOT FLAT FROM THE FIRST REQUEST despite "
+                  f"prewarm=1: {stats0['recompiles']} -> "
+                  f"{stats2['recompiles']}")
             return 1
 
         status, r = _post(host, port, "/v1/score",

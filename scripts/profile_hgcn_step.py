@@ -45,9 +45,9 @@ def main():
     n_pairs = train_pos.shape[0]
     pairs2 = jnp.concatenate([train_pos, train_pos], axis=0)
 
-    enc = jax.jit(lambda p, g: hgcn.HGCNEncoder(cfg).apply(
+    enc = jax.jit(lambda p, g: hgcn.HGCNEncoder(cfg).apply(  # hyperlint: disable=jit-cache-defeat — one-shot profiler: main runs once per process
         {"params": p["encoder"]}, g)[0].sum())
-    fwd = jax.jit(lambda p, g, pr: model.apply({"params": p}, g, pr).sum())
+    fwd = jax.jit(lambda p, g, pr: model.apply({"params": p}, g, pr).sum())  # hyperlint: disable=jit-cache-defeat — one-shot profiler: main runs once per process
 
     def loss_fn(p, g, pr):
         logits = model.apply({"params": p}, g, pr)
@@ -55,7 +55,7 @@ def main():
             [jnp.ones(n_pairs), jnp.zeros(n_pairs)]).astype(logits.dtype)
         return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
 
-    @jax.jit
+    @jax.jit  # hyperlint: disable=jit-cache-defeat — one-shot profiler: main runs once per process
     def grad(p, g, pr):
         # return a scalar depending on every grad leaf so nothing is DCE'd
         l, gr = jax.value_and_grad(loss_fn)(p, g, pr)
@@ -67,7 +67,7 @@ def main():
     w0 = ga.edge_mask.astype(jnp.float32)
     pb, pc, pf = ga.plan
 
-    @jax.jit
+    @jax.jit  # hyperlint: disable=jit-cache-defeat — one-shot profiler: main runs once per process
     def agg_fwd_bwd(h):
         def f(hh):
             out = sym_segment_aggregate(hh, w0, ga.senders, ga.receivers,

@@ -1,0 +1,28 @@
+"""jit-cache-defeat shapes: fresh function objects reaching jax.jit
+per call — every call retraces (parsed by tests, never imported)."""
+import jax
+
+
+def serve_request(q):
+    score = jax.jit(lambda v: v * 2)  # lambda: fresh object per call
+    return score(q)
+
+
+def dispatch(state):
+    def step(s):
+        return s + 1
+
+    run = jax.jit(step)  # nested def, used locally: rebuilt per call
+    return run(state)
+
+
+def answer(x):
+    return jax.jit(lambda v: v - 1)(x)  # returned INVOCATION, not the fn
+
+
+def outer(x):
+    @jax.jit
+    def inner(v):  # decorated nested def: fresh jitted per outer() call
+        return v + 1
+
+    return inner(x)

@@ -1,0 +1,35 @@
+"""jit-cache-defeat clean shapes: module binds, factories, attribute
+binds, AOT lowering (parsed by tests, never imported)."""
+import jax
+
+double = jax.jit(lambda v: v * 2)  # module scope: bound once
+
+
+def make_step(opt):
+    def step(s):
+        return s - opt
+
+    return jax.jit(step)  # factory: built once, handed to the loop
+
+
+def make_pair(opt):
+    def step(s):
+        return s * opt
+
+    step_j = jax.jit(step)
+    return step_j, opt  # escapes via the return tuple: factory
+
+
+class Engine:
+    def __init__(self, table):
+        def scan(q):
+            return q @ table
+
+        self._scan = jax.jit(scan)  # once per object construction
+
+
+def probe_cost(state):
+    def step(s):
+        return s + 1
+
+    return jax.jit(step).lower(state).compile()  # AOT: no dispatch cache

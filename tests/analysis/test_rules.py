@@ -19,6 +19,7 @@ from hyperspace_tpu.analysis.rules.donation import DonationHazardRule
 from hyperspace_tpu.analysis.rules.exceptions import SwallowBaseExceptionRule
 from hyperspace_tpu.analysis.rules.flags import FlagDocDriftRule
 from hyperspace_tpu.analysis.rules.hostsync import HostSyncRule
+from hyperspace_tpu.analysis.rules.jitcache import JitCacheDefeatRule
 from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
 from hyperspace_tpu.analysis.rules.retry import UnboundedRetryRule
@@ -36,6 +37,7 @@ def _lint(name, rule, rel=None):
 
 _PER_FILE = [
     ("bad_recompile.py", RecompileHazardRule, None),
+    ("bad_jitcache.py", JitCacheDefeatRule, None),
     ("bad_donation.py", DonationHazardRule, None),
     ("bad_hostsync.py", HostSyncRule, None),
     ("bad_tracerleak.py", TracerLeakRule, None),
@@ -81,6 +83,45 @@ def test_recompile_bad_fixture_fires_every_shape():
 
 def test_recompile_good_fixture_is_clean():
     assert _lint("good_recompile.py", RecompileHazardRule).findings == []
+
+
+# --- jit-cache-defeat ---------------------------------------------------------
+
+
+def test_jitcache_bad_fixture_fires_every_shape():
+    report = _lint("bad_jitcache.py", JitCacheDefeatRule)
+    msgs = [f.message for f in report.findings]
+    assert report.exit_code() == 1 and len(report.findings) == 4
+    assert sum("a lambda" in m for m in msgs) == 2
+    assert any("nested function 'step'" in m for m in msgs)
+    assert any("@jax.jit on 'inner'" in m for m in msgs)
+
+
+def test_jitcache_good_fixture_is_clean():
+    """Module binds, factories (direct return AND assigned-then-
+    returned tuple), attribute binds, and AOT `.lower` pipelines are
+    all exempt."""
+    assert _lint("good_jitcache.py", JitCacheDefeatRule).findings == []
+
+
+def test_jitcache_returned_invocation_still_fires(tmp_path):
+    """`return jax.jit(fn)(x)` returns the RESULT, not the wrapper —
+    the per-call rebuild is intact and must fire (the Return exemption
+    covers only an escaping callable)."""
+    src = textwrap.dedent("""\
+        import jax
+
+
+        def answer(x):
+            def fn(v):
+                return v
+
+            return jax.jit(fn)(x)
+    """)
+    p = tmp_path / "j.py"
+    p.write_text(src)
+    report = lint_file(str(p), rules=[JitCacheDefeatRule()])
+    assert len(report.findings) == 1
 
 
 # --- donation-hazard ----------------------------------------------------------

@@ -247,6 +247,55 @@ def test_direction_speedup_ratio_are_higher_better():
     assert mod.direction("detail.serve.cache.padded_waste_ratio") == "lower"
 
 
+def test_budget_exhausted_primary_never_gates(tmp_path):
+    """A record whose metric is real but whose detail carries
+    budget_exhausted (the watchdog's partial artifact — the checked-in
+    1-second-budget bench_full.json class) is a rounds row, never a
+    series point: it must not gate as the 'full' round nor set a
+    phantom best."""
+    _write_rounds(tmp_path, [100.0, 110.0])
+    rec = _wrapper(0, 50.0)["parsed"]  # a 55% "regression"…
+    rec["detail"]["budget_exhausted"] = True  # …from a cut-short run
+    (tmp_path / "bench_full.json").write_text(json.dumps(rec))
+    res = _run("--dir", str(tmp_path), "--gate", "--json")
+    assert res.returncode == 0, res.stdout + res.stderr
+    report = json.loads(res.stdout)
+    rounds = {r["round"]: r for r in report["rounds"]}
+    assert rounds["full"]["parsed"] and rounds["full"]["budget_exhausted"]
+    s = report["series"]["hgcn_samples_per_sec_per_chip"]
+    assert s["latest"]["round"] == "r02"  # the partial never entered
+    # and a cut-short BEST is equally excluded: a lucky partial must
+    # not raise the bar the honest rounds gate against
+    rec["value"] = 500.0
+    (tmp_path / "bench_full.json").write_text(json.dumps(rec))
+    res = _run("--dir", str(tmp_path), "--gate", "--json")
+    assert res.returncode == 0
+    s = json.loads(res.stdout)["series"]["hgcn_samples_per_sec_per_chip"]
+    assert s["best"]["value"] == 110.0
+
+
+def test_direction_compile_and_ttfq_lower_better():
+    """The r14 cold-start / compile-cache fields gate lower-is-better:
+    cold_ttfq_ms at headline and nested paths, the compile counters,
+    and every recompiles* token."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_trend", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for name in ("cold_ttfq_ms", "detail.cold_start.cold_ttfq_ms",
+                 "detail.cold_start.warm_cache.ttfq_ms",
+                 "detail.cold_start.cache_off.ttfq_ms",
+                 "cold_recompiles_steady",
+                 "detail.cold_start.warm_prewarm.recompiles_first",
+                 "compile_s", "detail.serve.recompiles_warmup",
+                 "recompiles_steady", "serve_recompiles_steady"):
+        assert mod.direction(name) == "lower", name
+    # neighbors keep their directions
+    assert mod.direction("detail.serve.ivf.qps_at_recall99") == "higher"
+    assert mod.direction("detail.cold_start.warm_prewarm.n") is None
+
+
 def test_direction_http_front_door_fields_are_lower_better():
     """The r13 HTTP front-door compact fields gate in the right
     direction: http_p99_ms (latency) and shed_rate / deadline_rate

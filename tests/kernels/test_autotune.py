@@ -1,0 +1,234 @@
+"""Empirical tile autotuner (`kernels/autotune.py` — ISSUE 13 pillar 3).
+
+Contracts: table round trip + lookup keyed by (variant, dim, dtype, k,
+device_kind); `fused_tile_rows`/`fused_cand_tile_rows` consult a tuned
+entry and fall back to the static model on ANY problem (no table,
+version mismatch, foreign device kind, off-grid bm); and the tile
+choice is **result-invisible** — bitwise identical scan results across
+tile sizes, through the raw kernel AND a tuned engine."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.kernels import autotune, scan_topk as K
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache(monkeypatch):
+    autotune.reset_cache()
+    yield
+    autotune.reset_cache()
+
+
+def _write_table(path, entries):
+    autotune.save_table(entries, str(path))
+
+
+def _entry(variant, dim, dtype, k, bm, kind=None):
+    kind = kind or autotune.device_kind()
+    return {autotune.entry_key(variant, dim, dtype, k, kind):
+            {"variant": variant, "dim": dim, "dtype": dtype, "k": k,
+             "device_kind": kind, "bm": bm, "ms": 1.0}}
+
+
+def test_lookup_round_trip(tmp_path, monkeypatch):
+    import jax.numpy as jnp
+
+    p = tmp_path / "tiles.json"
+    _write_table(p, _entry("slab", 16, "float32", 10, 256))
+    monkeypatch.setenv(autotune.ENV_TABLE, str(p))
+    autotune.reset_cache()
+    assert autotune.lookup("slab", 16, "float32", 10) == 256
+    assert autotune.lookup("slab", 16, jnp.float32, 10) == 256  # dtype objs
+    assert autotune.lookup("cand", 16, "float32", 10) is None  # variant keyed
+    assert autotune.lookup("slab", 32, "float32", 10) is None
+
+
+def test_fused_tile_rows_consults_tuned_then_falls_back(tmp_path,
+                                                        monkeypatch):
+    import jax.numpy as jnp
+
+    static = K.fused_tile_rows(16, jnp.float32, 10, allow_tuned=False)
+    assert K.fused_tile_rows(16, jnp.float32, 10) == static  # no table
+    p = tmp_path / "tiles.json"
+    _write_table(p, {**_entry("slab", 16, "float32", 10, 256),
+                     **_entry("cand", 16, "float32", 10, 128)})
+    monkeypatch.setenv(autotune.ENV_TABLE, str(p))
+    autotune.reset_cache()
+    assert K.fused_tile_rows(16, jnp.float32, 10) == 256
+    assert K.fused_cand_tile_rows(16, jnp.float32, 10) == 128
+    # a non-default budget asks the MODEL a question the table never
+    # measured: tuned entries are not consulted
+    assert K.fused_tile_rows(16, jnp.float32, 10,
+                             tile_budget=1 << 20) != 256 or static == 256
+    # the untuned shape keeps the static answer
+    assert (K.fused_tile_rows(16, jnp.bfloat16, 10)
+            == K.fused_tile_rows(16, jnp.bfloat16, 10, allow_tuned=False))
+
+
+@pytest.mark.parametrize("corrupt", [
+    "not json", json.dumps({"version": 999, "entries": {}}),
+    json.dumps({"entries": "nope"}), json.dumps([1, 2, 3])])
+def test_bad_tables_fall_back_silently(tmp_path, monkeypatch, corrupt):
+    import jax.numpy as jnp
+
+    p = tmp_path / "tiles.json"
+    p.write_text(corrupt)
+    monkeypatch.setenv(autotune.ENV_TABLE, str(p))
+    autotune.reset_cache()
+    assert autotune.lookup("slab", 16, "float32", 10) is None
+    assert (K.fused_tile_rows(16, jnp.float32, 10)
+            == K.fused_tile_rows(16, jnp.float32, 10, allow_tuned=False))
+
+
+def test_invalid_bm_and_foreign_device_kind_ignored(tmp_path, monkeypatch):
+    p = tmp_path / "tiles.json"
+    _write_table(p, {
+        # off the 128 grid / absurd / wrong type: all rejected
+        **_entry("slab", 8, "float32", 4, 100),
+        **_entry("slab", 8, "float32", 5, 128 * 1000),
+        **_entry("slab", 8, "float32", 6, True),
+        # a DIFFERENT device kind's tuning must never apply here
+        **_entry("slab", 8, "float32", 7, 256, kind="TPU v9"),
+    })
+    monkeypatch.setenv(autotune.ENV_TABLE, str(p))
+    autotune.reset_cache()
+    for k in (4, 5, 6, 7):
+        assert autotune.lookup("slab", 8, "float32", k) is None, k
+
+
+def test_tuned_bm_clamped_to_static_vmem_model(tmp_path, monkeypatch):
+    """A stale table tuned under a looser footprint model must never
+    hand the kernel a tile the CURRENT static model rejects — tuned
+    values clamp to the model's answer (the 'stale table costs only
+    speed, never correctness' guarantee; a real chip's Mosaic enforces
+    the VMEM bound the model approximates)."""
+    import jax.numpy as jnp
+
+    static = K.fused_tile_rows(1024, jnp.float32, 256, allow_tuned=False)
+    assert static < 1024  # the premise: this shape's cap is tight
+    p = tmp_path / "tiles.json"
+    _write_table(p, _entry("slab", 1024, "float32", 256, 1024))
+    monkeypatch.setenv(autotune.ENV_TABLE, str(p))
+    autotune.reset_cache()
+    assert K.fused_tile_rows(1024, jnp.float32, 256) == static
+
+
+def test_env_zero_disables_lookups(tmp_path, monkeypatch):
+    monkeypatch.setenv(autotune.ENV_TABLE, "0")
+    autotune.reset_cache()
+    assert autotune.table_path() is None
+    assert autotune.lookup("slab", 16, "float32", 10) is None
+
+
+def _bits(a):
+    return np.asarray(a).view(np.uint32)
+
+
+def test_tile_choice_is_result_invisible_raw_kernel():
+    """The bitwise-twin contract extended across tuned tiles: every
+    128-grid tile height gives bitwise identical (dists, ids) — the
+    merge extracts exact copies with global-column tie-breaks, so the
+    tiling can only reorder WORK, never results."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    tab = (np.tanh(rng.standard_normal((700, 8)) * 0.3) * 0.7).astype(
+        np.float32)
+    q = jnp.asarray(tab[:40])
+    qi = jnp.arange(40, dtype=jnp.int32)
+    base = None
+    for bm in (128, 256, 512, 1024):
+        d, i = K.scan_topk(jnp.asarray(tab), q, qi, 0,
+                           spec=("poincare", 1.0), k=9, n=700,
+                           exclude_self=True, tile_rows=bm)
+        got = (_bits(d), np.asarray(i))
+        if base is None:
+            base = got
+        else:
+            assert np.array_equal(got[0], base[0]), bm
+            assert np.array_equal(got[1], base[1]), bm
+
+
+def test_tuned_engine_bitwise_matches_fallback_engine(tmp_path,
+                                                      monkeypatch):
+    """An engine built while a tuned table is active (different chunk =
+    different tile height) answers bitwise like the static-model
+    engine — tuning must be invisible to results end to end."""
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.manifolds import PoincareBall
+    from hyperspace_tpu.serve.engine import QueryEngine
+
+    rng = np.random.default_rng(1)
+    table = np.asarray(PoincareBall(1.0).expmap0(
+        jnp.asarray(rng.standard_normal((600, 8)) * 0.3, jnp.float32)))
+    ref = QueryEngine(table, ("poincare", 1.0), scan_mode="fused")
+    ids = np.asarray(rng.integers(0, 600, size=16), np.int32)
+    ri, rd = ref.topk_neighbors(ids, 7)
+
+    p = tmp_path / "tiles.json"
+    # tune the engine's sizing key (k = FUSED_MAX_K) to a small tile
+    _write_table(p, _entry("slab", 8, "float32", K.FUSED_MAX_K, 128))
+    monkeypatch.setenv(autotune.ENV_TABLE, str(p))
+    autotune.reset_cache()
+    tuned = QueryEngine(table, ("poincare", 1.0), scan_mode="fused")
+    assert tuned.chunk_rows == 128 != ref.chunk_rows
+    assert tuned.scan_signature == ref.scan_signature  # same identity
+    ti, td = tuned.topk_neighbors(ids, 7)
+    assert np.array_equal(np.asarray(ti), np.asarray(ri))
+    assert np.array_equal(_bits(td), _bits(rd))
+
+
+def test_measure_and_autotune_roundtrip(tmp_path, monkeypatch):
+    """A miniature real tune: measure a tiny grid on this backend,
+    persist, and watch the sizing functions pick the tuned answer up."""
+    import jax.numpy as jnp
+
+    m = autotune.measure("slab", 8, "float32", 4, rows=1024, batch=16,
+                         repeats=1, candidates=(128, 256))
+    assert m["bm"] in (128, 256) and set(m["timings"]) == {128, 256}
+    entries = autotune.autotune(
+        [8], ["float32"], [4], variants=("slab",), rows=1024, batch=16,
+        repeats=1, log=lambda *_a: None)
+    p = tmp_path / "tiles.json"
+    autotune.save_table(entries, str(p))
+    monkeypatch.setenv(autotune.ENV_TABLE, str(p))
+    autotune.reset_cache()
+    tuned = autotune.lookup("slab", 8, "float32", 4)
+    assert tuned is not None
+    assert K.fused_tile_rows(8, jnp.float32, 4) == tuned
+    # additive merge: re-tuning preserves foreign entries
+    entries2 = autotune.autotune(
+        [8], ["float32"], [4], variants=("slab",), rows=1024, batch=16,
+        repeats=1, base_entries={**entries,
+                                 **_entry("slab", 64, "float32", 4, 512,
+                                          kind="TPU v9")},
+        log=lambda *_a: None)
+    assert any("TPU v9" in k for k in entries2)
+
+
+def test_autotune_script_smoke(tmp_path):
+    """The offline driver end-to-end on a tiny grid (in-process: jax is
+    already loaded; the script is import-safe)."""
+    import importlib.util
+
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "scripts",
+        "autotune_scan_topk.py")
+    spec = importlib.util.spec_from_file_location("autotune_script", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = str(tmp_path / "tiles.json")
+    rc = mod.main(["--dims", "8", "--ks", "4", "--dtypes", "float32",
+                   "--variants", "slab", "--rows", "1024", "--batch", "16",
+                   "--repeats", "1", "--out", out])
+    assert rc == 0
+    doc = json.loads(open(out).read())
+    assert doc["version"] == autotune.TABLE_VERSION
+    assert len(doc["entries"]) == 1
+    (entry,) = doc["entries"].values()
+    assert entry["bm"] % 128 == 0 and entry["device_kind"]

@@ -82,6 +82,14 @@ def _stub_resilience(repeats=1):
                                     "ladder_recovered": True}}}
 
 
+def _stub_cold_start(repeats=1):
+    # the real leg spawns serve-CLI subprocesses — never in tier-1
+    return {"metric": "cold_ttfq_ms", "value": 850.0, "unit": "ms",
+            "vs_baseline": None,
+            "detail": {"cold_ttfq_ms": 850.0, "recompiles_steady": 0,
+                       "warm_cache": {"ttfq_ms": 900.0}}}
+
+
 def test_auto_hgcn_failure_reports_error(bench_mod, monkeypatch, capsys):
     def boom(repeats=1, **kw):
         raise RuntimeError("synthetic hgcn failure")
@@ -92,6 +100,7 @@ def test_auto_hgcn_failure_reports_error(bench_mod, monkeypatch, capsys):
     monkeypatch.setattr(bench_mod, "bench_serve", _stub_serve)
     monkeypatch.setattr(bench_mod, "bench_precision", _stub_precision)
     monkeypatch.setattr(bench_mod, "bench_resilience", _stub_resilience)
+    monkeypatch.setattr(bench_mod, "bench_cold_start", _stub_cold_start)
     monkeypatch.setattr(sys, "argv", ["bench.py", "--metric", "auto"])
     with pytest.raises(SystemExit) as ei:
         bench_mod.main()
@@ -121,6 +130,7 @@ def test_auto_success_keeps_hgcn_headline(bench_mod, monkeypatch, capsys):
     monkeypatch.setattr(bench_mod, "bench_serve", _stub_serve)
     monkeypatch.setattr(bench_mod, "bench_precision", _stub_precision)
     monkeypatch.setattr(bench_mod, "bench_resilience", _stub_resilience)
+    monkeypatch.setattr(bench_mod, "bench_cold_start", _stub_cold_start)
     monkeypatch.setattr(sys, "argv", ["bench.py", "--metric", "auto"])
     bench_mod.main()
     captured = capsys.readouterr().out
@@ -157,6 +167,11 @@ def test_auto_success_keeps_hgcn_headline(bench_mod, monkeypatch, capsys):
     assert out["detail"]["resilience_ok"] == 1
     assert out["detail"]["shed_rate"] == 0.1
     assert out["detail"]["chaos_rollbacks"] == 1
+    # the cold-start leg (r14): restart TTFQ + recompile contract ride
+    # the artifact and the compact line
+    assert full["detail"]["cold_start"]["cold_ttfq_ms"] == 850.0
+    assert out["detail"]["cold_ttfq_ms"] == 850.0
+    assert out["detail"]["cold_recompiles_steady"] == 0
 
 
 def test_explicit_poincare_failure_is_error(bench_mod, monkeypatch, capsys):
@@ -264,8 +279,8 @@ def test_budget_zero_skips_all_legs_but_emits(bench_mod, monkeypatch, capsys):
     assert full["metric"] == "hgcn_samples_per_sec_per_chip"
     assert set(full["detail"]["skipped_legs"]) == {
         "poincare", "hgcn_sampled", "serve_qps", "serve_http",
-        "precision", "resilience", "realistic", "workloads",
-        "use_att_arm"}
+        "cold_start", "precision", "resilience", "realistic",
+        "workloads", "use_att_arm"}
     assert full["detail"]["budget_s"] == 0
     assert _last_json(captured)["metric"] == "hgcn_samples_per_sec_per_chip"
 
