@@ -11,6 +11,8 @@ from hyperspace_tpu.analysis.rules.donation import DonationHazardRule
 from hyperspace_tpu.analysis.rules.exceptions import SwallowBaseExceptionRule
 from hyperspace_tpu.analysis.rules.flags import FlagDocDriftRule
 from hyperspace_tpu.analysis.rules.hostsync import HostSyncRule
+from hyperspace_tpu.analysis.rules.hosttable import (
+    FullTableMaterializationRule)
 from hyperspace_tpu.analysis.rules.jitcache import JitCacheDefeatRule
 from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
@@ -27,6 +29,7 @@ ALL_RULES = (
     UnboundedRetryRule,
     BlockingCallInAsyncRule,
     MaterializedDistmatRule,
+    FullTableMaterializationRule,
     PrecisionLiteralRule,
     TelemetryCatalogRule,
     FlagDocDriftRule,

@@ -1,0 +1,125 @@
+"""full-table-materialization: device transfer of a host master table.
+
+Historical incident class this PR makes structural: the beyond-HBM
+story (ROADMAP item 3, ``parallel/host_table.py``) rests on ONE
+invariant — the master embedding table lives in host memory and visits
+the device only as bounded blocks (the hot-row cache's bucketed
+uploads, the streamed index builder's ``[chunk, D]`` tiles).  A single
+``jnp.asarray(master.to_array())`` in a hot path silently re-caps the
+whole design at one chip's HBM — and it compiles, runs, and passes
+small-table tests, which is exactly the kind of hazard this suite
+exists to catch at lint time.
+
+What fires (error): a call to ``jax.device_put`` / ``jnp.asarray``
+(import-alias resolved) whose transferred operand is
+
+- a ``HostEmbedTable`` construction — ``HostEmbedTable(...)`` or its
+  classmethod constructors (``from_array`` / ``build`` /
+  ``load_sharded``), bare or dotted;
+- a ``.to_array()`` call — :meth:`HostEmbedTable.to_array` is the
+  sanctioned full-table materializer for small-table eval paths, and
+  shipping its result to device is the whole-table transfer;
+- a name bound from either (one-step taint, tracked file-wide in
+  SOURCE order like the materialized-distmat rule: latest binding
+  before the call wins, rebinding to anything else clears it).
+
+What stays clean: streamed blocks (``iter_chunks`` tiles,
+``gather``-ed row batches) — bounded by construction — and everything
+inside ``parallel/host_table.py`` itself, the one sanctioned home of
+master→device transfers (the hot-row cache's uploads live there).
+
+Fix: route rows through ``DeviceHotCache.ensure`` (training) or
+``HostEmbedTable.iter_chunks`` (streaming builds); a deliberate
+small-table exit documents itself with the per-line suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+
+_TRANSFERS = ("jax.device_put", "jnp.asarray", "jax.numpy.asarray")
+_CONSTRUCTORS = ("from_array", "build", "load_sharded")
+
+# the hot-cache module: the one file allowed to move master rows to
+# device (bucketed, bounded) — and the table class's own home
+_EXEMPT_SUFFIX = "parallel/host_table.py"
+
+
+def _basename(resolved: Optional[str]) -> str:
+    return (resolved or "").rsplit(".", 1)[-1]
+
+
+def _is_master_source(ctx: FileContext, node: ast.AST) -> bool:
+    """A HostEmbedTable construction, or a ``.to_array()`` call."""
+    if not isinstance(node, ast.Call):
+        return False
+    resolved = ctx.resolve(node.func) or ""
+    parts = resolved.split(".")
+    if "HostEmbedTable" in parts:
+        # HostEmbedTable(...) or HostEmbedTable.from_array/... — both
+        # hand back the host master object
+        return parts[-1] == "HostEmbedTable" or parts[-1] in _CONSTRUCTORS
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "to_array":
+        return True
+    return False
+
+
+def _transferred_operand(node: ast.Call) -> Optional[ast.AST]:
+    if node.args:
+        return node.args[0]
+    return None
+
+
+class FullTableMaterializationRule(Rule):
+    id = "full-table-materialization"
+    severity = "error"
+    summary = ("jax.device_put / jnp.asarray of a host master table "
+               "(HostEmbedTable / .to_array()) outside "
+               "parallel/host_table.py — the beyond-HBM invariant: "
+               "stream chunks or go through DeviceHotCache")
+
+    def check_file(self, ctx: FileContext):
+        rel = ctx.rel.replace("\\", "/")
+        if rel.endswith(_EXEMPT_SUFFIX):
+            return []
+        findings = []
+        # one-step name taint in SOURCE order (the materialized-distmat
+        # pass structure: ast.walk is breadth-first, so events must be
+        # re-sorted or a nested function's later rebind would clear a
+        # module-level taint out of order)
+        events = []
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                events.append((node.lineno, node.col_offset, "assign",
+                               node))
+            elif (isinstance(node, ast.Call)
+                  and ctx.resolve(node.func) in _TRANSFERS):
+                events.append((node.lineno, node.col_offset, "xfer", node))
+        tainted: dict[str, int] = {}
+        for _, _, kind, node in sorted(events, key=lambda e: (e[0], e[1])):
+            if kind == "assign":
+                tgt = node.targets[0]
+                if _is_master_source(ctx, node.value):
+                    tainted[tgt.id] = node.lineno
+                else:
+                    tainted.pop(tgt.id, None)
+                continue
+            arg = _transferred_operand(node)
+            if arg is None:
+                continue
+            hit = _is_master_source(ctx, arg) or (
+                isinstance(arg, ast.Name) and arg.id in tainted)
+            if hit:
+                findings.append(self.finding(
+                    ctx, node,
+                    "host master table shipped to device whole — the "
+                    "beyond-HBM design caps device residency at the "
+                    "hot-row cache / streamed chunks; use "
+                    "DeviceHotCache.ensure or iter_chunks "
+                    "(parallel/host_table.py), or suppress a "
+                    "documented small-table exit"))
+        return findings

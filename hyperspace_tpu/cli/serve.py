@@ -102,7 +102,10 @@ class ServeConfig:
     # rank-identical answers; docs/serving.md, docs/kernels.md)
     scan_mode: str = "two_stage"
     # table-scan precision: f32 (default, bit-identical) | bf16 (scan a
-    # bf16 table copy, rescore candidates in f32 — docs/precision.md)
+    # bf16 table copy, rescore candidates in f32 — docs/precision.md) |
+    # int8 (per-row symmetric quantized scan copy at a quarter of the
+    # table bytes, same f32 rescore — docs/serving.md "Quantized scan
+    # lane")
     precision: str = "f32"
     # IVF probing (query/serve): cells probed per query.  0 = exact
     # scan; needs an artifact exported with an index.  nprobe >= ncells

@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 from typing import Any
 
@@ -63,6 +64,25 @@ class RunConfig:
     # and reductions stay f32).  Copied into the workload config's own
     # `precision` field unless that is overridden explicitly.
     precision: str = "f32"
+    # --- beyond-HBM host-resident table (poincare; docs/serving.md
+    # "Beyond-HBM tables", train/host_embed.py) ------------------------
+    # host_table=1: keep the packed embedding table (+ optimizer
+    # moments) in HOST memory and train through a device hot-row cache
+    # — per-chunk unique-id gather, one planned-sparse dispatch per
+    # chunk, write-back at each chunk boundary.  Bitwise-identical to
+    # the in-HBM planned-packed trainer on tables that fit (tested).
+    host_table: bool = False
+    # device hot-row cache capacity in rows (0 = the chunk's worst-case
+    # working set, capped at the table)
+    hot_rows: int = 0
+    # planned steps per host chunk (one device dispatch each)
+    host_chunk_steps: int = 8
+    # overlap upcoming chunks' master-row gathers with the current
+    # chunk's device work: an evicted-and-retouched row may be read up
+    # to prefetch_depth+1 = 3 chunks stale (the prefetcher runs that
+    # far ahead of the write-back; bounded-staleness trade — the
+    # default synchronous gather keeps the bitwise contract)
+    host_gather_ahead: bool = False
     # persistent XLA compilation cache (hyperspace_tpu/compile_cache.py,
     # docs/observability.md "Compilation cache"): default ON at
     # <repo>/.cache/jax_compile (HYPERSPACE_COMPILE_CACHE env overrides);
@@ -241,6 +261,36 @@ def run_poincare(run: RunConfig, overrides: dict):
 
     ball = PoincareBall(cfg.c)
     project = lambda st: st._replace(table=ball.proj(st.table))
+    if run.host_table:
+        # beyond-HBM path (train/host_embed.py): host master + device
+        # hot-row cache, one planned-sparse dispatch per chunk
+        from hyperspace_tpu.train import host_embed as he
+
+        if cfg.sparse or run.scan_chunk > 1:
+            raise SystemExit(
+                "host_table=1 IS the planned-sparse chunked path — drop "
+                "sparse=true / scan_chunk (chunking is host_chunk_steps=)")
+        trainer = he.HostPlannedTrainer.from_state(
+            cfg, opt, state, chunk_steps=run.host_chunk_steps,
+            hot_rows=run.hot_rows, seed=run.seed,
+            gather_ahead=run.host_gather_ahead)
+        trainer.run(ds.pairs, run.steps)
+        if run.ckpt_dir:
+            # sharded master save: one bounded block per shard, never
+            # the full table in one array (parallel/host_table.py)
+            trainer.master.save_sharded(
+                os.path.join(run.ckpt_dir, "host_table"))
+        if cfg.num_nodes > he.EVAL_MAX_ROWS:
+            # materializing the table for eval would defeat the
+            # beyond-HBM design at exactly the scale it exists for —
+            # the sharded master (+ the serve lanes) is the product
+            return {"workload": "poincare", "steps": int(trainer.step),
+                    "host_table": True, "eval_skipped": "beyond-hbm"}
+        state = project(trainer.to_state())
+        with _eval_span():
+            res = pe.evaluate(state.table, ds.pairs, cfg.c)
+        return {"workload": "poincare", "steps": int(state.step),
+                "host_table": True, **res}
     if run.scan_chunk > 1 and cfg.sparse:
         raise SystemExit(
             "scan_chunk>1 scans the dense step body only — drop "

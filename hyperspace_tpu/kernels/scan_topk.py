@@ -41,6 +41,14 @@ in VMEM) to distance-scan-top-k:
   in-register, so the scan's *arithmetic* is f32 either way (the
   low-precision cost is the table quantization only — the engine's
   f32 rescore repairs k-th-boundary near-ties, docs/precision.md).
+- **int8 tables** (``scale=``; serve/quant.py) stream at a QUARTER of
+  the f32 bytes: the slab is the per-row symmetric int8 code and the
+  companion per-row f32 scale rides beside it as one extra streamed
+  block per tile ([bm, 1] lanes against the [bm, dp] rows).  Tiles
+  dequantize in-register (``rows.astype(f32) * scale``) before the
+  identical distance math — same f32 arithmetic, same carry, same twin
+  contract; only the table bytes shrink.  ``scale=None`` (default) is
+  byte-for-byte the pre-int8 program.
 
 **Twin contract** (the ``kernels/distmat.py`` convention, tightened):
 the XLA twin is not merely value-close — it executes the *same padded
@@ -153,10 +161,18 @@ def fused_tile_rows(dim: int, dtype, k: int, *,
         tuned = autotune.lookup("slab", dim, dtype, k)
     dp = S.round_up(int(dim), 128)
     kp = S.round_up(int(k), 128)
-    it = jnp.dtype(dtype).itemsize
+    dt = jnp.dtype(dtype)
+    it = dt.itemsize
+    # int8 slabs stream a companion [bm, 128] f32 per-row-scale block
+    # per tile (double-buffered like the slab) — at dim <= 128 that is
+    # 4× the int8 tile bytes, so the fit model MUST count it: this
+    # model is the VMEM bound the engine's fused demotion check and
+    # the autotune clamp both trust
+    scale_bytes = (2 * 128 * 4) if dt.kind == "i" else 0
 
     def footprint(bm: int) -> int:
         return (2 * bm * dp * it          # double-buffered table tile
+                + bm * scale_bytes        # int8: streamed scale block
                 + bq * dp * 4             # query block (f32 compute copy)
                 + bq * 128 * 4            # q_idx block
                 + 2 * bq * kp * 4         # carry scratch (dists + ids)
@@ -183,10 +199,15 @@ def fused_cand_tile_rows(dim: int, dtype, k: int, *,
         tuned = autotune.lookup("cand", dim, dtype, k)
     dp = S.round_up(int(dim), 128)
     kp = S.round_up(int(k), 128)
-    it = jnp.dtype(dtype).itemsize
+    dt = jnp.dtype(dtype)
+    it = dt.itemsize
+    # int8 candidates gather a [bq, bm] f32 scale block per tile
+    # (double-buffered) — counted for the same reason as the slab model
+    scale_bytes = (2 * 4) if dt.kind == "i" else 0
 
     def footprint(bm: int) -> int:
         return (2 * bq * bm * dp * it     # double-buffered row block
+                + bq * bm * scale_bytes   # int8: gathered scale block
                 + bq * bm * dp * 4        # f32 compute copy
                 + bq * dp * 4 + bq * 128 * 4
                 + 2 * bq * kp * 4         # carry scratch
@@ -351,9 +372,27 @@ def _slab_pad(slab, q, q_idx, bq, bm):
     return yp, qp, qip
 
 
-def _slab_body(kind: str, k: int, bm: int, exclude_self: bool):
+def _scale_pad(scale, bm):
+    """Shared per-row-scale padding (int8 slabs): [M] / [M, 1] f32 →
+    a [mp, 128] lane-aligned block, rows zero-padded to the tile grid
+    (a zero scale dequantizes padding rows to zero — masked anyway,
+    identically in kernel and twin)."""
+    s = jnp.asarray(scale, jnp.float32)
+    if s.ndim == 1:
+        s = s[:, None]
+    if s.ndim != 2 or s.shape[1] != 1:
+        raise ValueError(f"scale must be [M] or [M, 1]; got {s.shape}")
+    return S.pad_rows_lanes(s, rows_to=bm)
+
+
+def _slab_body(kind: str, k: int, bm: int, exclude_self: bool,
+               quant: bool = False):
     def body(c_ref, col0_ref, n_ref, nloc_ref, q_ref, qi_ref, y_ref,
-             od_ref, oi_ref, cd_scr, ci_scr):
+             *rest):
+        if quant:  # int8 slab: the per-row scale block rides after it
+            s_ref, od_ref, oi_ref, cd_scr, ci_scr = rest
+        else:
+            od_ref, oi_ref, cd_scr, ci_scr = rest
         jt = pl.program_id(1)
 
         @pl.when(jt == 0)
@@ -368,6 +407,11 @@ def _slab_body(kind: str, k: int, bm: int, exclude_self: bool):
         q = q_ref[:].astype(jnp.float32)
         qi = qi_ref[:, :1]
         rows = y_ref[:].astype(jnp.float32)
+        if quant:
+            # in-register dequantize: the ONLY int8-vs-float difference
+            # on the whole path (serve/quant.py) — one multiply before
+            # the shared tile math
+            rows = rows * s_ref[:, :1]
         d, gids = _slab_tile(kind, exclude_self, c, n, nloc, col0,
                              jt * bm, q, qi, rows)
         skip = _prune(cd_scr[:], d, k)
@@ -387,7 +431,7 @@ def _slab_body(kind: str, k: int, bm: int, exclude_self: bool):
 
 
 def _launch_slab(slab, q, q_idx, col0, *, kind, c, k, n, bm, exclude_self,
-                 mode_):
+                 mode_, scale=None):
     b = q.shape[0]
     bq, dp, kp, bm = _slab_schedule(b, q.shape[1], k, bm)
     nloc = slab.shape[0]
@@ -397,18 +441,25 @@ def _launch_slab(slab, q, q_idx, col0, *, kind, c, k, n, bm, exclude_self,
     smem = lambda: pl.BlockSpec((1, 1), lambda iq, jt: (0, 0),
                                 memory_space=pltpu.SMEM)
     i32 = lambda v: jnp.asarray(v, jnp.int32).reshape(1, 1)
+    in_specs = [
+        smem(), smem(), smem(), smem(),
+        pl.BlockSpec((bq, dp), lambda iq, jt: (iq, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((bq, 128), lambda iq, jt: (iq, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((bm, dp), lambda iq, jt: (jt, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [S.c_smem(c), i32(col0), i32(n), i32(nloc), qp, qip, yp]
+    if scale is not None:
+        # the per-row scale streams tile-aligned with the slab
+        in_specs.append(pl.BlockSpec((bm, 128), lambda iq, jt: (jt, 0),
+                                     memory_space=pltpu.VMEM))
+        operands.append(_scale_pad(scale, bm))
     od, oi = pl.pallas_call(
-        _slab_body(kind, k, bm, exclude_self),
+        _slab_body(kind, k, bm, exclude_self, quant=scale is not None),
         grid=grid,
-        in_specs=[
-            smem(), smem(), smem(), smem(),
-            pl.BlockSpec((bq, dp), lambda iq, jt: (iq, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bq, 128), lambda iq, jt: (iq, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm, dp), lambda iq, jt: (jt, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((bq, kp), lambda iq, jt: (iq, 0),
                          memory_space=pltpu.VMEM),
@@ -426,11 +477,12 @@ def _launch_slab(slab, q, q_idx, col0, *, kind, c, k, n, bm, exclude_self,
         compiler_params=S.tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=S.interpret_flag(mode_),
-    )(S.c_smem(c), i32(col0), i32(n), i32(nloc), qp, qip, yp)
+    )(*operands)
     return od[:b, :k], oi[:b, :k]
 
 
-def _t_scan_topk(slab, q, q_idx, col0, *, kind, c, k, n, bm, exclude_self):
+def _t_scan_topk(slab, q, q_idx, col0, *, kind, c, k, n, bm, exclude_self,
+                 scale=None):
     """XLA twin: the SAME padded block schedule as the Pallas launcher,
     folded with the same shared tile/merge functions — bitwise-identical
     to interpreter mode on CPU (tested).  Runs the per-query-block walk
@@ -439,6 +491,7 @@ def _t_scan_topk(slab, q, q_idx, col0, *, kind, c, k, n, bm, exclude_self):
     bq, dp, kp, bm = _slab_schedule(b, q.shape[1], k, bm)
     nloc = jnp.int32(slab.shape[0])
     yp, qp, qip = _slab_pad(slab, q, q_idx, bq, bm)
+    sp = None if scale is None else _scale_pad(scale, bm)
     ntiles = yp.shape[0] // bm
     c32 = jnp.asarray(c, jnp.float32)
     col0_ = jnp.asarray(col0, jnp.int32)
@@ -452,6 +505,10 @@ def _t_scan_topk(slab, q, q_idx, col0, *, kind, c, k, n, bm, exclude_self):
             cd, ci = carry
             rows = jax.lax.dynamic_slice_in_dim(
                 yp, jt * bm, bm).astype(jnp.float32)
+            if sp is not None:
+                # the kernel body's in-register dequantize, same op
+                rows = rows * jax.lax.dynamic_slice_in_dim(
+                    sp, jt * bm, bm)[:, :1]
             d, gids = _slab_tile(kind, exclude_self, c32, n_, nloc, col0_,
                                  jt * bm, qb, qib, rows)
             return _fold(cd, ci, d, gids, k)
@@ -468,7 +525,7 @@ def _t_scan_topk(slab, q, q_idx, col0, *, kind, c, k, n, bm, exclude_self):
 
 
 def scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, n: int,
-              exclude_self: bool = False, tile_rows: int = 0):
+              exclude_self: bool = False, tile_rows: int = 0, scale=None):
     """Streaming top-k of ``q`` [B, D] against the shared row block
     ``slab`` [M, D] → ``(dists ascending f32 [B, k], ids int32 [B, k])``.
 
@@ -478,6 +535,12 @@ def scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, n: int,
     ``q_idx`` [B] int32 — pass zeros when unused).  Slots beyond the
     reachable candidates are ``(+inf, −1)``.  ``tile_rows`` (multiple of
     128; 0 = :func:`fused_tile_rows`) is the streamed tile height.
+
+    ``scale`` (the int8 lane, serve/quant.py): per-row f32 dequant
+    scales ([M] or [M, 1]) for an int8 ``slab`` — each streamed tile is
+    dequantized in-register (``rows.astype(f32) * scale``) before the
+    shared distance math, so results are those of the DEQUANTIZED table
+    at f32 arithmetic, at a quarter of the table bytes.
 
     Dispatch follows ``kernels._support.mode()``: the Pallas kernel on
     TPU, the bitwise-identical XLA twin elsewhere.  Callers gate shapes
@@ -493,10 +556,11 @@ def scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, n: int,
     m_ = S.mode()
     if m_ == "xla":
         return _t_scan_topk(slab, q, q_idx, col0, kind=kind, c=c, k=int(k),
-                            n=int(n), bm=bm, exclude_self=bool(exclude_self))
+                            n=int(n), bm=bm, exclude_self=bool(exclude_self),
+                            scale=scale)
     return _launch_slab(slab, q, q_idx, col0, kind=kind, c=c, k=int(k),
                         n=int(n), bm=bm, exclude_self=bool(exclude_self),
-                        mode_=m_)
+                        mode_=m_, scale=scale)
 
 
 # --- per-query candidate variant (the IVF probing scorer) ---------------------
@@ -542,9 +606,13 @@ def _cand_pad(rows, ids, q, q_idx, bq, bm):
     return rp, ip, qp, qip
 
 
-def _cand_body(kind: str, k: int, exclude_self: bool):
-    def body(c_ref, q_ref, qi_ref, r_ref, id_ref, od_ref, oi_ref,
-             cd_scr, ci_scr):
+def _cand_body(kind: str, k: int, exclude_self: bool,
+               quant: bool = False):
+    def body(c_ref, q_ref, qi_ref, r_ref, id_ref, *rest):
+        if quant:  # int8 rows: the gathered per-row scale block follows
+            s_ref, od_ref, oi_ref, cd_scr, ci_scr = rest
+        else:
+            od_ref, oi_ref, cd_scr, ci_scr = rest
         jt = pl.program_id(1)
 
         @pl.when(jt == 0)
@@ -556,6 +624,8 @@ def _cand_body(kind: str, k: int, exclude_self: bool):
         q = q_ref[:].astype(jnp.float32)
         qi = qi_ref[:, :1]
         rows = r_ref[:].astype(jnp.float32)
+        if quant:
+            rows = rows * s_ref[:][..., None]
         ids = id_ref[:]
         d, ids = _cand_tile(kind, exclude_self, c, q, qi, rows, ids)
         skip = _prune(cd_scr[:], d, k)
@@ -575,27 +645,36 @@ def _cand_body(kind: str, k: int, exclude_self: bool):
 
 
 def _launch_cand(rows, ids, q, q_idx, *, kind, c, k, exclude_self, bm,
-                 mode_):
+                 mode_, sc=None):
     b, cc = ids.shape
     bq, dp, kp, bm = _cand_schedule(q.shape[1], k, cc, rows.dtype, bm)
     rp, ip, qp, qip = _cand_pad(rows, ids, q, q_idx, bq, bm)
     bp, cp = ip.shape
     grid = (bp // bq, cp // bm)
+    in_specs = [
+        pl.BlockSpec((1, 1), lambda iq, jt: (0, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((bq, dp), lambda iq, jt: (iq, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((bq, 128), lambda iq, jt: (iq, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((bq, bm, dp), lambda iq, jt: (iq, jt, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((bq, bm), lambda iq, jt: (iq, jt),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [S.c_smem(c), qp, qip, rp, ip]
+    if sc is not None:
+        # gathered per-candidate dequant scales, blocked like the ids
+        scp = jnp.zeros((bp, cp), jnp.float32)
+        scp = scp.at[:b, :cc].set(jnp.asarray(sc, jnp.float32))
+        in_specs.append(pl.BlockSpec((bq, bm), lambda iq, jt: (iq, jt),
+                                     memory_space=pltpu.VMEM))
+        operands.append(scp)
     od, oi = pl.pallas_call(
-        _cand_body(kind, k, exclude_self),
+        _cand_body(kind, k, exclude_self, quant=sc is not None),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda iq, jt: (0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((bq, dp), lambda iq, jt: (iq, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bq, 128), lambda iq, jt: (iq, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bq, bm, dp), lambda iq, jt: (iq, jt, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bq, bm), lambda iq, jt: (iq, jt),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((bq, kp), lambda iq, jt: (iq, 0),
                          memory_space=pltpu.VMEM),
@@ -613,12 +692,12 @@ def _launch_cand(rows, ids, q, q_idx, *, kind, c, k, exclude_self, bm,
         compiler_params=S.tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=S.interpret_flag(mode_),
-    )(S.c_smem(c), qp, qip, rp, ip)
+    )(*operands)
     return od[:b, :k], oi[:b, :k]
 
 
 def _t_scan_topk_cand(scan_table, cand, q, q_idx, *, kind, c, k,
-                      exclude_self, bm):
+                      exclude_self, bm, scale=None):
     """XLA twin of the candidate kernel: gathers each tile's rows from
     ``scan_table`` on the fly (a gather is value-exact, so this matches
     the kernel's pre-gathered stream bitwise) and folds with the shared
@@ -627,6 +706,8 @@ def _t_scan_topk_cand(scan_table, cand, q, q_idx, *, kind, c, k,
     bq, dp, kp, bm = _cand_schedule(q.shape[1], k, cc, scan_table.dtype, bm)
     # pad the table's feature lanes exactly like the kernel's row stream
     tp = S.pad_axis(scan_table, -1, 128)
+    sf = None if scale is None else jnp.asarray(scale,
+                                                jnp.float32).reshape(-1)
     ip, qp, qip = _cand_pad_idq(cand, q, q_idx, bq, bm)
     bp, cp = ip.shape
     c32 = jnp.asarray(c, jnp.float32)
@@ -641,6 +722,10 @@ def _t_scan_topk_cand(scan_table, cand, q, q_idx, *, kind, c, k,
             cd, ci = carry
             ids = jax.lax.dynamic_slice_in_dim(idsb, jt * bm, bm, axis=1)
             rows = tp[jnp.maximum(ids, 0)].astype(jnp.float32)
+            if sf is not None:
+                # same gather + in-register dequantize as the launcher's
+                # pre-gathered scale stream (masked slots never read)
+                rows = rows * sf[jnp.maximum(ids, 0)][..., None]
             d, ids = _cand_tile(kind, exclude_self, c32, qb, qib, rows, ids)
             return _fold(cd, ci, d, ids, k)
 
@@ -656,14 +741,17 @@ def _t_scan_topk_cand(scan_table, cand, q, q_idx, *, kind, c, k,
 
 
 def scan_topk_cand(scan_table, cand, q, q_idx, *, spec: tuple, k: int,
-                   exclude_self: bool = False, tile_rows: int = 0):
+                   exclude_self: bool = False, tile_rows: int = 0,
+                   scale=None):
     """Per-query-candidate streaming top-k (the IVF probing scorer):
     ``cand`` [B, C] int32 row ids into ``scan_table`` [N, D] (−1 =
     padding), ``q`` [B, D] → ``(dists f32 [B, k], ids int32 [B, k])``
     where ids are TABLE row ids.  Same carry/merge/prune machinery and
     twin contract as :func:`scan_topk`; the kernel path pre-gathers the
     [B, C, D] candidate rows (``supports_cand`` caps that footprint),
-    the twin gathers per tile."""
+    the twin gathers per tile.  ``scale`` ([N] / [N, 1] f32): per-row
+    dequant scales for an int8 ``scan_table`` — gathered with the rows
+    and applied in-register (the int8 lane, serve/quant.py)."""
     if not supports_cand(spec, k=k, dim=scan_table.shape[1],
                          cand=cand.shape[1]):
         raise ValueError(
@@ -677,10 +765,12 @@ def scan_topk_cand(scan_table, cand, q, q_idx, *, spec: tuple, k: int,
         return _t_scan_topk_cand(scan_table, cand, q, q_idx, kind=kind,
                                  c=c, k=int(k),
                                  exclude_self=bool(exclude_self),
-                                 bm=int(tile_rows))
-    rows = S.pad_axis(scan_table, -1, 128)[jnp.maximum(
-        jnp.asarray(cand, jnp.int32), 0)]
+                                 bm=int(tile_rows), scale=scale)
+    safe = jnp.maximum(jnp.asarray(cand, jnp.int32), 0)
+    rows = S.pad_axis(scan_table, -1, 128)[safe]
+    sc = (None if scale is None
+          else jnp.asarray(scale, jnp.float32).reshape(-1)[safe])
     return _launch_cand(rows, jnp.asarray(cand, jnp.int32), q, q_idx,
                         kind=kind, c=c, k=int(k),
                         exclude_self=bool(exclude_self),
-                        bm=int(tile_rows), mode_=m_)
+                        bm=int(tile_rows), mode_=m_, sc=sc)

@@ -383,10 +383,10 @@ class SparsePlan(NamedTuple):
     seg_sorted: jax.Array  # [S, U] = inv_map[order] (ascending)
 
 
-def plan_from_indices(cfg: PoincareEmbedConfig, u_idx, v_idx,
-                      neg_idx) -> SparsePlan:
-    """Build the per-step index plans for explicit [S, B] / [S, B, K]
-    batches — one vectorized numpy pass, ~milliseconds per epoch-chunk."""
+def plan_arrays_np(cfg: PoincareEmbedConfig, u_idx, v_idx, neg_idx):
+    """The numpy planning pass behind :func:`plan_from_indices` —
+    returns the seven plan arrays as HOST numpy (the host-resident
+    trainer keeps them on host to union/remap before any transfer)."""
     import numpy as np
 
     steps = u_idx.shape[0]
@@ -407,9 +407,15 @@ def plan_from_indices(cfg: PoincareEmbedConfig, u_idx, v_idx,
     uniq[s_grid, seg_sorted[new_seg]] = sorted_ids[new_seg]
     inv_map = np.empty_like(seg_sorted)
     np.put_along_axis(inv_map, order, seg_sorted, axis=1)
+    return u_idx, v_idx, neg_idx, uniq, inv_map, order, seg_sorted
+
+
+def plan_from_indices(cfg: PoincareEmbedConfig, u_idx, v_idx,
+                      neg_idx) -> SparsePlan:
+    """Build the per-step index plans for explicit [S, B] / [S, B, K]
+    batches — one vectorized numpy pass, ~milliseconds per epoch-chunk."""
     return SparsePlan(*(jnp.asarray(a) for a in
-                        (u_idx, v_idx, neg_idx, uniq, inv_map, order,
-                         seg_sorted)))
+                        plan_arrays_np(cfg, u_idx, v_idx, neg_idx)))
 
 
 def plan_sparse_steps(cfg: PoincareEmbedConfig, pairs, steps: int,
@@ -561,9 +567,15 @@ def _packed_row_body(
     opt,
     state: PackedState,
     row: SparsePlan,  # single-step slices: [B], [B], [B, K], [U] ×4
+    sorted_indices: bool = True,
 ) -> tuple[PackedState, jax.Array]:
     """Un-jitted packed-planned step body on one plan row; shared by
-    :func:`train_step_planned_packed` and :func:`train_epoch_planned_packed`."""
+    :func:`train_step_planned_packed` and :func:`train_epoch_planned_packed`
+    (``sorted_indices=True`` — per-step uniq rows are ascending) and by
+    :func:`train_epoch_planned_hosted` (``False`` — the host-resident
+    trainer remaps rows to device hot-cache SLOTS, which are arbitrary
+    after the first eviction; same math, the scatter just loses its
+    sortedness hint)."""
     u_idx, v_idx, neg_idx, uniq, inv_map, order, seg_sorted = row
     b, d = cfg.batch_size, cfg.dim
     n_slots = uniq.shape[0]
@@ -593,7 +605,7 @@ def _packed_row_body(
         new_all = optax.apply_updates(rows, updates)
     packed = state.packed.at[uniq].set(
         new_all.astype(state.packed.dtype),
-        mode="drop", indices_are_sorted=True)  # ONE scatter
+        mode="drop", indices_are_sorted=sorted_indices)  # ONE scatter
     return PackedState(packed, aux, key_after(state.key), state.step + 1), loss
 
 
@@ -630,6 +642,28 @@ def train_epoch_planned_packed(
 
     def body(st, row):
         return _packed_row_body(cfg, opt, st, row)
+
+    return jax.lax.scan(body, state, plan)
+
+
+@partial(jax.jit, static_argnames=("cfg", "opt"), donate_argnames=("state",))
+def train_epoch_planned_hosted(
+    cfg: PoincareEmbedConfig,
+    opt,
+    state: PackedState,
+    plan: SparsePlan,
+) -> tuple[PackedState, jax.Array]:
+    """:func:`train_epoch_planned_packed` for the host-resident trainer
+    (train/host_embed.py): ``state.packed`` is the device HOT-ROW CACHE
+    (``[C, W]``, ``parallel/host_table.DeviceHotCache``) and the plan's
+    ``uniq`` rows are remapped to cache slots — arbitrary order, so the
+    scatter drops its sortedness hint; ``cfg.num_nodes`` must be the
+    cache capacity C (the remapped sentinel).  Mathematically the same
+    per-row computation as the in-HBM program — the host path is
+    bitwise-identical to it on small tables (tested)."""
+
+    def body(st, row):
+        return _packed_row_body(cfg, opt, st, row, sorted_indices=False)
 
     return jax.lax.scan(body, state, plan)
 

@@ -1,0 +1,468 @@
+"""Beyond-HBM embedding tables: a host-resident master with a device
+hot-row cache (ROADMAP item 3; docs/serving.md sizes the serve side,
+this module is the TRAINING side).
+
+One chip's HBM caps the in-HBM trainers at a few hundred thousand rows
+(the largest benched table is 597k); the millions-of-users north star
+needs tables that live in host DRAM and visit the device only as the
+rows a chunk of steps actually touches.  The design:
+
+- :class:`HostEmbedTable` — the master ``[N, W]`` table in host memory,
+  stored as a LIST of row-range shards (never one monolithic array):
+  cross-shard ``gather``/``write_back`` by id, a sharded Orbax
+  save/restore that moves one shard at a time (restoring into a
+  DIFFERENT shard count re-slices shard-by-shard — no full-table
+  materialization on one host, instrumented by the
+  ``host_table/io_rows_peak`` gauge), and a chunk iterator for
+  streaming consumers (the scalable IVF builder, the synthetic
+  big-table generator).
+- :class:`DeviceHotCache` — a fixed-capacity device-resident ``[C, W]``
+  row cache with a host-side id→slot map and chunk-granular LRU
+  eviction.  ``ensure(ids)`` uploads only the MISSING rows (one
+  bucketed ``device_put`` + one scatter per chunk — power-of-two
+  bucketed so the executable count stays bounded), hands back the slot
+  of every requested id, and leaves hits untouched: a row that stays
+  hot across chunks never crosses the PCIe/ICI link again.  The
+  training chunk program updates the cache array IN PLACE (donated);
+  ``fetch(slots)`` reads rows back for the chunk-boundary write-back.
+
+The trainer protocol (``train/host_embed.py``) per chunk: unique-id
+union on host → ``ensure`` → run the planned-sparse chunk program over
+the cache (plan indices remapped to cache slots) → ``fetch`` +
+``write_back`` at the chunk boundary, so the master is current before
+the next chunk's gather.  Synchronous gathers make the whole path
+bitwise-identical to the in-HBM packed trainer (tested); the
+``gather_ahead`` overlap mode relaxes that to a documented bounded
+staleness (≤ prefetch_depth + 1 chunks — train/host_embed.py).
+
+This module is the ONE sanctioned home of host-master → device
+transfers: the ``full-table-materialization`` hyperlint rule errors on
+``jax.device_put`` / ``jnp.asarray`` of a :class:`HostEmbedTable` (or
+its shards) anywhere else — the table being host-resident is a
+capacity INVARIANT, and one stray ``jnp.asarray(master.to_array())``
+in a hot path would silently re-cap the design at HBM size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hyperspace_tpu.telemetry import registry as _telem
+
+MANIFEST = "host_table.json"
+FORMAT_VERSION = 1
+
+# ensure()/fetch() pad their row counts to power-of-two buckets (floor
+# at this) so the insert/gather executables stay one-per-bucket, not
+# one-per-chunk — the serve batcher's compile contract applied to the
+# cache maintenance programs
+_MIN_BUCKET = 256
+
+
+# largest single array save_sharded/load_sharded has moved this process
+# (also surfaced as the host_table/io_rows_peak gauge): the "never
+# materializes the full table on one host" invariant is testable as
+# reset_io_peak(); <round trip>; io_rows_peak() <= N/shards (+ pad)
+_io_rows_peak = 0
+
+
+def io_rows_peak() -> int:
+    return _io_rows_peak
+
+
+def reset_io_peak() -> None:
+    global _io_rows_peak
+    _io_rows_peak = 0
+    _telem.set_gauge("host_table/io_rows_peak", 0)
+
+
+def _track_io_rows(rows: int) -> None:
+    global _io_rows_peak
+    if rows > _io_rows_peak:
+        _io_rows_peak = rows
+        _telem.set_gauge("host_table/io_rows_peak", rows)
+
+
+def _shard_bounds(num_rows: int, shards: int) -> np.ndarray:
+    """Row-range starts (len shards+1): near-equal contiguous ranges."""
+    base, extra = divmod(num_rows, shards)
+    sizes = [base + (1 if i < extra else 0) for i in range(shards)]
+    return np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+
+
+class HostEmbedTable:
+    """Host-resident ``[N, W]`` master table as contiguous row shards."""
+
+    def __init__(self, shards: Sequence[np.ndarray]):
+        if not shards:
+            raise ValueError("HostEmbedTable needs at least one shard")
+        widths = {int(s.shape[1]) for s in shards}
+        if len(widths) != 1:
+            raise ValueError(f"shard widths differ: {sorted(widths)}")
+        # writable host copies: np.asarray of a device array hands back
+        # a READ-ONLY view, and the master must accept write_back
+        self._shards = [
+            s if isinstance(s, np.ndarray) and s.flags.writeable
+            and s.flags.c_contiguous else np.array(s)
+            for s in shards]
+        self._starts = np.concatenate(
+            [[0], np.cumsum([s.shape[0] for s in self._shards])]
+        ).astype(np.int64)
+        self.num_rows = int(self._starts[-1])
+        self.width = widths.pop()
+        self.dtype = self._shards[0].dtype
+        # gather/write_back atomicity: the gather_ahead overlap mode
+        # (train/host_embed.py) gathers from a PREFETCH thread while
+        # the main thread writes the previous chunk back — without the
+        # lock a row touched by both could be read mid-copy (half new,
+        # half old: a vector that never existed at ANY step).  The lock
+        # rounds that down to the documented whole-row bounded
+        # staleness; its cost is one uncontended acquire per chunk-
+        # granular bulk op, not per row
+        self._lock = threading.Lock()
+
+    # --- construction ---------------------------------------------------------
+
+    @classmethod
+    def from_array(cls, arr: np.ndarray, shards: int = 1) -> "HostEmbedTable":
+        """Split an in-memory ``[N, W]`` array into ``shards`` row
+        ranges (views — no copy; the table takes ownership)."""
+        arr = np.asarray(arr)
+        if arr.ndim != 2:
+            raise ValueError(f"want [N, W]; got {arr.shape}")
+        b = _shard_bounds(arr.shape[0], int(shards))
+        return cls([arr[b[i]:b[i + 1]] for i in range(len(b) - 1)])
+
+    @classmethod
+    def build(cls, num_rows: int, width: int,
+              fill: Callable[[int, int], np.ndarray], *,
+              shard_rows: int = 1 << 20,
+              dtype=np.float32) -> "HostEmbedTable":
+        """Generate a table shard-by-shard: ``fill(start, rows)`` must
+        return the ``[rows, width]`` block for that row range — the
+        10M-row synthetic bench table is built this way, so no caller
+        ever holds (or transfers) the whole table at once."""
+        b = _shard_bounds(int(num_rows), max(1, -(-num_rows // shard_rows)))
+        shards = []
+        for i in range(len(b) - 1):
+            rows = int(b[i + 1] - b[i])
+            blk = np.asarray(fill(int(b[i]), rows), dtype)
+            if blk.shape != (rows, width):
+                raise ValueError(
+                    f"fill({b[i]}, {rows}) returned {blk.shape}; "
+                    f"want ({rows}, {width})")
+            shards.append(blk)
+        return cls(shards)
+
+    # --- host-side access -----------------------------------------------------
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._shards)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(s.nbytes for s in self._shards)
+
+    def _locate(self, ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        si = np.searchsorted(self._starts, ids, side="right") - 1
+        return si, ids - self._starts[si]
+
+    def gather(self, ids) -> np.ndarray:
+        """``table[ids]`` across shards → a new ``[len(ids), W]`` array."""
+        ids = np.asarray(ids, np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= self.num_rows):
+            raise ValueError(
+                f"ids out of range [0, {self.num_rows}): "
+                f"min={ids.min()}, max={ids.max()}")
+        out = np.empty((len(ids), self.width), self.dtype)
+        si, local = self._locate(ids)
+        with self._lock:
+            for s in np.unique(si):
+                m = si == s
+                out[m] = self._shards[s][local[m]]
+        _telem.inc("host_table/gather_rows", int(len(ids)))
+        return out
+
+    def write_back(self, ids, rows: np.ndarray) -> None:
+        """Scatter updated ``rows`` back into the master at ``ids``."""
+        ids = np.asarray(ids, np.int64)
+        rows = np.asarray(rows)
+        if rows.shape != (len(ids), self.width):
+            raise ValueError(
+                f"rows {rows.shape} must be ({len(ids)}, {self.width})")
+        si, local = self._locate(ids)
+        with self._lock:
+            for s in np.unique(si):
+                m = si == s
+                self._shards[s][local[m]] = rows[m]
+        _telem.inc("host_table/writeback_rows", int(len(ids)))
+
+    def iter_chunks(self, chunk: int) -> Iterator[tuple[int, np.ndarray]]:
+        """Yield ``(row_start, block)`` host views covering the table in
+        order, each at most ``chunk`` rows and never crossing a shard
+        boundary — the streaming consumers' read path (no copies)."""
+        for s, arr in enumerate(self._shards):
+            start = int(self._starts[s])
+            for lo in range(0, arr.shape[0], chunk):
+                yield start + lo, arr[lo:lo + chunk]
+
+    def to_array(self) -> np.ndarray:
+        """Materialize the FULL table on this host — tests and
+        small-table eval only; never call this on a beyond-HBM path
+        (the hyperlint rule flags device transfers of the result)."""
+        return np.concatenate(self._shards, axis=0)
+
+    # --- sharded Orbax save / restore ----------------------------------------
+
+    def save_sharded(self, directory: str,
+                     shards: Optional[int] = None) -> None:
+        """Write the table as ``shards`` per-range Orbax items plus a
+        JSON manifest.  Re-slicing to a different shard count than the
+        in-memory layout streams one bounded block per saved shard —
+        the largest array touched is max(in-memory shard, saved shard)
+        rows (``host_table/io_rows_peak``)."""
+        import orbax.checkpoint as ocp
+
+        shards = int(shards or self.num_shards)
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1; got {shards}")
+        os.makedirs(directory, exist_ok=True)
+        bounds = _shard_bounds(self.num_rows, shards)
+        ck = ocp.StandardCheckpointer()
+        for i in range(shards):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            blk = self._slice_rows(lo, hi)
+            _track_io_rows(blk.shape[0])
+            path = os.path.join(os.path.abspath(directory), f"shard_{i:05d}")
+            ck.save(path, {"rows": blk}, force=True)
+        ck.wait_until_finished()
+        with open(os.path.join(directory, MANIFEST), "w",
+                  encoding="utf-8") as f:
+            json.dump({
+                "version": FORMAT_VERSION,
+                "num_rows": self.num_rows, "width": self.width,
+                "dtype": str(np.dtype(self.dtype)), "shards": shards,
+                "bounds": [int(b) for b in bounds],
+            }, f)
+
+    def _slice_rows(self, lo: int, hi: int) -> np.ndarray:
+        """Rows [lo, hi) as one array — a view when the range sits in
+        one shard, a bounded copy when it straddles shards."""
+        si = int(np.searchsorted(self._starts, lo, side="right") - 1)
+        if hi <= self._starts[si + 1]:
+            s0 = int(self._starts[si])
+            return self._shards[si][lo - s0:hi - s0]
+        return self.gather(np.arange(lo, hi, dtype=np.int64))
+
+    @classmethod
+    def load_sharded(cls, directory: str,
+                     shards: Optional[int] = None) -> "HostEmbedTable":
+        """Restore into ``shards`` row ranges (default: as saved).
+        Every saved shard is read ONCE, in order, and copied into the
+        overlapping destination shards — per-host array sizes stay
+        bounded by max(saved shard, destination shard) rows whatever
+        the two shard counts are."""
+        import orbax.checkpoint as ocp
+
+        with open(os.path.join(directory, MANIFEST), encoding="utf-8") as f:
+            meta = json.load(f)
+        if meta.get("version") != FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported host-table format {meta.get('version')!r}")
+        n, w = int(meta["num_rows"]), int(meta["width"])
+        dtype = np.dtype(meta["dtype"])
+        saved = np.asarray(meta["bounds"], np.int64)
+        new = _shard_bounds(n, int(shards or meta["shards"]))
+        dest = [np.empty((int(new[i + 1] - new[i]), w), dtype)
+                for i in range(len(new) - 1)]
+        ck = ocp.StandardCheckpointer()
+        for i in range(len(saved) - 1):
+            lo, hi = int(saved[i]), int(saved[i + 1])
+            path = os.path.join(os.path.abspath(directory), f"shard_{i:05d}")
+            blk = ck.restore(path)["rows"]
+            _track_io_rows(blk.shape[0])
+            # copy this saved range into every overlapping new shard
+            for j in range(len(dest)):
+                a, b = max(lo, int(new[j])), min(hi, int(new[j + 1]))
+                if a < b:
+                    dest[j][a - int(new[j]):b - int(new[j])] = \
+                        blk[a - lo:b - lo]
+            del blk
+        return cls(dest)
+
+
+def _next_bucket(n: int, cap: int) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+@jax.jit
+def _cache_insert(cache: jax.Array, rows: jax.Array, slots: jax.Array):
+    """Scatter uploaded rows into their cache slots (padded slots carry
+    an out-of-range index and drop)."""
+    return cache.at[slots].set(rows, mode="drop")
+
+
+@jax.jit
+def _cache_gather(cache: jax.Array, slots: jax.Array) -> jax.Array:
+    return cache[jnp.minimum(slots, cache.shape[0] - 1)]
+
+
+class DeviceHotCache:
+    """Fixed-capacity device cache of hot master-table rows.
+
+    ``capacity`` bounds the device footprint (``C × W`` elements); the
+    id→slot map, LRU order and free list live on host.  Rows are
+    uploaded on miss (``ensure``), read back for write-back (``fetch``),
+    and updated in place by the training chunk program via the
+    :attr:`array` property (hand the donated output back).
+
+    Eviction is chunk-granular: ``ensure(ids)`` evicts
+    least-recently-used ids NOT in ``ids`` when it needs slots.  The
+    trainer writes every touched row back to the master at each chunk
+    boundary, so an evicted row never holds the only copy of an update
+    — eviction is free, and a cache hit means the device copy IS the
+    master's current value.
+    """
+
+    def __init__(self, master: HostEmbedTable, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1; got {capacity}")
+        self._master = master
+        self.capacity = int(min(capacity, master.num_rows))
+        # sanctioned host→device transfer: the cache starts empty (the
+        # zeros block is the cache's own buffer, not the master table)
+        self._arr = jnp.zeros((self.capacity, master.width),
+                              jnp.dtype(master.dtype))
+        # vectorized bookkeeping — at 100k-row working sets a per-id
+        # Python dict walk WAS the host-resident step time (measured
+        # ~20× the in-HBM step before this layout): id → slot (−1 =
+        # absent), slot → id (−1 = free), and a per-slot chunk tick for
+        # chunk-granular LRU
+        self._slot_of = np.full(master.num_rows, -1, np.int32)
+        self._slot_id = np.full(self.capacity, -1, np.int64)
+        self._last_used = np.zeros(self.capacity, np.int64)
+        self._tick = 0
+        _telem.set_gauge("host_table/cache_capacity", self.capacity)
+
+    @property
+    def array(self) -> jax.Array:
+        """The device ``[C, W]`` cache — hand to the chunk program."""
+        return self._arr
+
+    @array.setter
+    def array(self, new: jax.Array) -> None:
+        if new.shape != (self.capacity, self._master.width):
+            raise ValueError(
+                f"cache array {new.shape} must be "
+                f"({self.capacity}, {self._master.width})")
+        self._arr = new
+
+    def ensure(self, ids: np.ndarray) -> np.ndarray:
+        """Make every id resident; return its slot ([len(ids)] int32).
+
+        ``ids`` must be UNIQUE (the trainer hands the chunk's unique-id
+        union).  Misses are gathered from the master and uploaded as
+        ONE power-of-two-bucketed transfer + scatter; hits cost a
+        vectorized lookup.  Raises when ``ids`` alone exceed the
+        capacity — a chunk's working set must fit, or ``hot_rows`` is
+        undersized.
+        """
+        ids = self._check_ids(ids)
+        miss = self._slot_of[ids] < 0
+        rows = self._master.gather(ids[miss]) if miss.any() else None
+        return self._ensure_rows(ids, rows)
+
+    # split so the gather_ahead overlap mode (train/host_embed.py) can
+    # hand PRE-FETCHED rows in — same insert path, stale by <= 1 chunk
+    def ensure_with_rows(self, ids: np.ndarray, miss_rows,
+                         miss_mask: np.ndarray) -> np.ndarray:
+        """``ensure`` with the miss rows already gathered (the overlap
+        mode's entry): ``miss_rows`` must align with ``miss_mask`` —
+        positions of ``ids`` that were misses AT GATHER TIME.  Ids that
+        became resident since are NOT overwritten (their cached value
+        is at least as fresh, and re-inserting the stale gather would
+        LOSE the newer value — so those rows are dropped)."""
+        ids = self._check_ids(ids)
+        still_miss = self._slot_of[ids] < 0
+        keep = still_miss[miss_mask]  # rows whose id is still a miss
+        rows = np.asarray(miss_rows)[keep] if miss_rows is not None else None
+        return self._ensure_rows(ids, rows)
+
+    def _check_ids(self, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        if len(ids) > self.capacity:
+            raise ValueError(
+                f"chunk working set ({len(ids)} unique rows) exceeds the "
+                f"hot-row cache capacity {self.capacity} — raise hot_rows= "
+                "or lower chunk_steps/batch_size")
+        if len(np.unique(ids)) != len(ids):
+            raise ValueError("ensure() ids must be unique (pass the "
+                             "chunk's unique-id union)")
+        return ids
+
+    def _ensure_rows(self, ids: np.ndarray,
+                     miss_rows: Optional[np.ndarray]) -> np.ndarray:
+        self._tick += 1
+        slots = self._slot_of[ids].copy()
+        miss = slots < 0
+        self._last_used[slots[~miss]] = self._tick  # refresh hit recency
+        nmiss = int(miss.sum())
+        _telem.inc("host_table/cache_hits", len(ids) - nmiss)
+        _telem.inc("host_table/cache_misses", nmiss)
+        if not nmiss:
+            return slots
+        if miss_rows is None or len(miss_rows) != nmiss:
+            raise ValueError(
+                f"need {nmiss} miss rows; got "
+                f"{0 if miss_rows is None else len(miss_rows)}")
+        free = np.flatnonzero(self._slot_id < 0)
+        if len(free) < nmiss:
+            # evict least-recently-used slots OUTSIDE this request set
+            # (this chunk's hits just got stamped with the new tick)
+            need = nmiss - len(free)
+            occ = np.flatnonzero((self._slot_id >= 0)
+                                 & (self._last_used < self._tick))
+            order = np.argsort(self._last_used[occ], kind="stable")[:need]
+            evict = occ[order]
+            self._slot_of[self._slot_id[evict]] = -1
+            self._slot_id[evict] = -1
+            _telem.inc("host_table/cache_evictions", need)
+            free = np.concatenate([free, evict])
+        mslots = free[:nmiss].astype(np.int32)
+        miss_ids = ids[miss]
+        self._slot_of[miss_ids] = mslots
+        self._slot_id[mslots] = miss_ids
+        self._last_used[mslots] = self._tick
+        slots[miss] = mslots
+        # ONE bucketed upload + scatter (pad slots out of range: drop)
+        b = _next_bucket(nmiss, self.capacity)
+        rows_b = np.zeros((b, self._master.width), self._master.dtype)
+        rows_b[:nmiss] = miss_rows
+        slots_b = np.full(b, self.capacity, np.int32)
+        slots_b[:nmiss] = mslots
+        self._arr = _cache_insert(self._arr, jnp.asarray(rows_b),
+                                  jnp.asarray(slots_b))
+        _telem.inc("host_table/upload_rows", nmiss)
+        _telem.inc("host_table/upload_bytes", int(rows_b[:nmiss].nbytes))
+        return slots
+
+    def fetch(self, slots: np.ndarray) -> np.ndarray:
+        """Read cache rows back to host (the chunk-boundary write-back
+        read) — one bucketed device gather + one transfer."""
+        slots = np.asarray(slots, np.int32)
+        b = _next_bucket(len(slots), self.capacity)
+        slots_b = np.zeros(b, np.int32)
+        slots_b[:len(slots)] = slots
+        out = np.asarray(_cache_gather(self._arr, jnp.asarray(slots_b)))
+        return out[:len(slots)]

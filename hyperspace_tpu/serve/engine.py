@@ -65,6 +65,19 @@ Mechanics:
   top-k, so returned distances are always f32-accurate and rank
   agreement holds at ordinary point distributions.  ``"f32"`` (default)
   is the unchanged pre-policy executable.
+- **Optional int8 table scan** (``precision="int8"``; docs/serving.md
+  "Quantized scan lane") — the same scan-then-rescore shape at a
+  QUARTER of the table bytes: a per-row symmetric int8 code + per-row
+  f32 scale (``serve/quant.py``) live beside the f32 table, the coarse
+  scan dequantizes tiles in-register (``q8.astype(f32) * scale`` —
+  arithmetic stays f32) keeping ``k + max(4k, 32)`` candidates (a wider
+  over-fetch than bf16: the quantization step is coarser), and the
+  merged candidates are rescored with f32 manifold distances against
+  the f32 master before the final top-k.  Queries are NOT quantized —
+  they are f32 rows of the master table.  Composes with IVF probing,
+  the fused kernel (int8 slabs stream at quarter bytes through the
+  same carry), and mesh sharding; the scan signature and the batcher
+  cache key carry the lane, so f32/bf16/int8 rows never cross.
 - **Optional IVF probing** (``index=`` + ``nprobe=``; docs/serving.md
   "Approximate retrieval", built by ``serve/index.py``).  Queries score
   against the index's hyperbolic-k-means centroids, gather the nearest
@@ -121,13 +134,22 @@ NOMINAL_BATCH = 1024
 _ROW_ALIGN = 128
 
 SCAN_MODES = ("two_stage", "carry", "fused")
-PRECISIONS = precision_mod.PRESET_NAMES
+# the serve table-scan lanes: the precision-policy presets plus the
+# serve-only int8 quantized lane (serve/quant.py — not a training
+# policy, so it lives here rather than in precision.PRESET_NAMES)
+PRECISIONS = precision_mod.PRESET_NAMES + ("int8",)
 
 # extra candidates the bf16 scan keeps beyond the requested k, so a
 # near-tie the low-precision pass mis-ranks at the k-th boundary is still
 # IN the candidate set when the f32 rescore re-ranks it (docs/precision.md
 # "serving": the scan picks candidates, f32 picks the answer)
 _RESCORE_PAD = 8
+# the int8 lane's wider over-fetch: a quantization step is ~2⁻⁸ of the
+# row's dynamic range (vs bf16's ~2⁻⁸ RELATIVE per element — similar
+# magnitude but correlated per row), so the coarse ranking is noisier
+# and the rescore margin scales with k (k + max(4k, 32) candidates)
+_QUANT_RESCORE_MIN = 32
+_QUANT_RESCORE_MULT = 4
 
 
 def _round_up(n: int, m: int) -> int:
@@ -173,7 +195,7 @@ def _tile_dist(spec: tuple, q: jax.Array, rows: jax.Array) -> jax.Array:
 
 
 def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
-               n: int, exclude_self: bool, mode: str):
+               n: int, exclude_self: bool, mode: str, scale=None):
     """Chunked top-k over ``slab`` rows → ``(dists ascending, ids int32)``,
     each ``[B, min(k, slab_rows)]`` (a shard narrower than k contributes
     everything it has; the cross-shard merge restores the full k).
@@ -184,6 +206,11 @@ def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
     traced).  Rows at global index >= ``n`` are zero padding and are
     masked to +inf by index, as is each query's own row under
     ``exclude_self``.
+
+    ``scale`` (the int8 lane): per-row [rows, 1] f32 dequant scales for
+    an int8 ``slab`` — each tile dequantizes in-register before the
+    distance math, so the scan's arithmetic stays f32 and only the
+    table bytes shrink (serve/quant.py).
     """
     b = q.shape[0]
     nchunks = slab.shape[0] // chunk
@@ -193,6 +220,9 @@ def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
     # a slab narrower than k (a small shard under a large k) contributes
     # every row it has; the cross-shard merge restores the full k
     ko = min(k, nchunks * chunk)
+    # distances of a quantized scan are f32 (dequantize-then-f32-math);
+    # float slabs keep their own dtype (the bf16 scan's tiles are bf16)
+    ddt = jnp.float32 if scale is not None else slab.dtype
 
     if mode == "fused":
         from hyperspace_tpu.kernels import scan_topk as fused_kernel
@@ -205,7 +235,7 @@ def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
             # no post-scan merge (kernels/scan_topk.py)
             d, i = fused_kernel.scan_topk(
                 slab, q, q_idx, col0, spec=spec, k=k, n=n,
-                exclude_self=exclude_self, tile_rows=chunk)
+                exclude_self=exclude_self, tile_rows=chunk, scale=scale)
             return d[:, :ko], i[:, :ko]
         # capability fallback (product spec, oversized k/dim): the
         # two-stage path below, bit-identical to scan_mode="two_stage"
@@ -213,6 +243,9 @@ def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
 
     def masked_tile(i):
         rows = jax.lax.dynamic_slice_in_dim(slab, i * chunk, chunk)
+        if scale is not None:
+            rows = rows.astype(jnp.float32) * jax.lax.dynamic_slice_in_dim(
+                scale, i * chunk, chunk)
         d = _tile_dist(spec, q, rows)                     # [B, chunk]
         # pin int32: under x64 the traced chunk offset would promote the
         # index dtype and break the scan carry/stack contract
@@ -232,7 +265,7 @@ def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
             top_negd, sel = jax.lax.top_k(-cat_d, ko)
             return (-top_negd, jnp.take_along_axis(cat_i, sel, axis=1)), None
 
-        init = (jnp.full((b, ko), jnp.inf, slab.dtype),
+        init = (jnp.full((b, ko), jnp.inf, ddt),
                 jnp.full((b, ko), -1, jnp.int32))
         (dist, idx), _ = jax.lax.scan(body, init, jnp.arange(nchunks))
         return dist, idx
@@ -244,7 +277,7 @@ def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
         return d, jnp.broadcast_to(cols, d.shape)
 
     return _two_stage_core(tile2d, b=b, nchunks=nchunks, k=k, kc=kc, ko=ko,
-                           dtype=slab.dtype)
+                           dtype=ddt)
 
 
 def _two_stage_core(masked_tile, *, b: int, nchunks: int, k: int, kc: int,
@@ -354,21 +387,26 @@ def _merge_rescored(d32: jax.Array, idx: jax.Array, k: int):
 @partial(jax.jit, static_argnames=("spec", "k", "k_scan", "chunk", "n",
                                    "exclude_self", "mode"))
 def _topk_chunked_mixed(table: jax.Array, scan_table: jax.Array,
-                        q_idx: jax.Array, *, spec: tuple, k: int,
-                        k_scan: int, chunk: int, n: int,
+                        scan_scale, q_idx: jax.Array, *, spec: tuple,
+                        k: int, k_scan: int, chunk: int, n: int,
                         exclude_self: bool, mode: str):
-    """bf16 table-scan variant of :func:`_topk_chunked`: the chunked scan
-    runs over ``scan_table`` (the low-precision copy — half the HBM
-    traffic of the dominant pass) keeping ``k_scan >= k`` candidates,
-    then the candidates are gathered from the f32 ``table`` and rescored
-    with full-precision manifold distances before the final top-k — so
-    returned distances carry f32 accuracy and the boundary-sensitive
-    math never runs in bf16 on anything that reaches the caller."""
+    """Low-precision table-scan variant of :func:`_topk_chunked`: the
+    chunked scan runs over ``scan_table`` (the bf16 copy, or the int8
+    code when ``scan_scale`` is its per-row dequant scale — half /
+    a quarter of the HBM traffic of the dominant pass) keeping
+    ``k_scan >= k`` candidates, then the candidates are gathered from
+    the f32 ``table`` and rescored with full-precision manifold
+    distances before the final top-k — so returned distances carry f32
+    accuracy and the boundary-sensitive math never runs in low
+    precision on anything that reaches the caller."""
     q = table[q_idx]                                      # [B, D] f32
-    q_scan = q.astype(scan_table.dtype)
+    # int8 scans keep f32 queries (the table is quantized, not the
+    # query rows); the bf16 scan casts them to the scan dtype
+    q_scan = q if scan_scale is not None else q.astype(scan_table.dtype)
     sd, sidx = _scan_topk(scan_table, q_scan, q_idx, 0, spec=spec,
                           k=k_scan, chunk=chunk, n=n,
-                          exclude_self=exclude_self, mode=mode)
+                          exclude_self=exclude_self, mode=mode,
+                          scale=scan_scale)
     rows = table[jnp.maximum(sidx, 0)]                    # [B, K, D] f32
     d32 = _rescore_f32(spec, rows, q, sidx, sd)
     return _merge_rescored(d32, sidx, k)
@@ -377,22 +415,25 @@ def _topk_chunked_mixed(table: jax.Array, scan_table: jax.Array,
 @partial(jax.jit, static_argnames=("spec", "k", "k_scan", "chunk", "n",
                                    "exclude_self", "mode", "mesh", "axis"))
 def _topk_sharded_mixed(table: jax.Array, scan_table: jax.Array,
-                        q_idx: jax.Array, *, spec: tuple, k: int,
-                        k_scan: int, chunk: int, n: int,
+                        scan_scale, q_idx: jax.Array, *, spec: tuple,
+                        k: int, k_scan: int, chunk: int, n: int,
                         exclude_self: bool, mode: str, mesh, axis: str):
-    """Mesh-sharded twin of :func:`_topk_chunked_mixed`: per-shard bf16
-    scan over the local low-precision slab, all-gather + merge of the
-    per-shard candidates, then an f32 rescore of the merged ``k_scan``
-    winners (candidate rows assembled from the f32 shards by the same
-    psum gather the query rows use) before the final top-k."""
+    """Mesh-sharded twin of :func:`_topk_chunked_mixed`: per-shard
+    low-precision scan over the local slab (bf16 copy, or int8 code +
+    per-row scale — both laid out ``P(axis, None)`` like the master),
+    all-gather + merge of the per-shard candidates, then an f32 rescore
+    of the merged ``k_scan`` winners (candidate rows assembled from the
+    f32 shards by the same psum gather the query rows use) before the
+    final top-k."""
     npad = table.shape[0]
 
-    def local(tloc, sloc, qi):
+    def local_body(tloc, sloc, scl, qi):
         q = local_gather(tloc, qi, npad, axis)            # [B, D] f32
         lo = (jax.lax.axis_index(axis) * tloc.shape[0]).astype(jnp.int32)
-        d, i = _scan_topk(sloc, q.astype(sloc.dtype), qi, lo, spec=spec,
+        qs = q if scl is not None else q.astype(sloc.dtype)
+        d, i = _scan_topk(sloc, qs, qi, lo, spec=spec,
                           k=k_scan, chunk=chunk, n=n,
-                          exclude_self=exclude_self, mode=mode)
+                          exclude_self=exclude_self, mode=mode, scale=scl)
         gd = jax.lax.all_gather(d, axis)                  # [S, B, <=k_scan]
         gi = jax.lax.all_gather(i, axis)
         b = qi.shape[0]
@@ -407,10 +448,17 @@ def _topk_sharded_mixed(table: jax.Array, scan_table: jax.Array,
         idx, dist = _merge_rescored(d32, sidx, k)
         return idx, dist
 
-    run = shard_map(local, mesh=mesh,
-                    in_specs=(P(axis, None), P(axis, None), P()),
+    if scan_scale is None:
+        run = shard_map(lambda t, s, qi: local_body(t, s, None, qi),
+                        mesh=mesh,
+                        in_specs=(P(axis, None), P(axis, None), P()),
+                        out_specs=(P(), P()), check_vma=False)
+        return run(table, scan_table, q_idx)
+    run = shard_map(local_body, mesh=mesh,
+                    in_specs=(P(axis, None), P(axis, None),
+                              P(axis, None), P()),
                     out_specs=(P(), P()), check_vma=False)
-    return run(table, scan_table, q_idx)
+    return run(table, scan_table, scan_scale, q_idx)
 
 
 def _cand_dist(spec: tuple, q: jax.Array, rows: jax.Array) -> jax.Array:
@@ -451,7 +499,8 @@ def _cand_dist(spec: tuple, q: jax.Array, rows: jax.Array) -> jax.Array:
 
 def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
                     q_idx: jax.Array, *, spec: tuple, k: int, chunk: int,
-                    exclude_self: bool, mode: str = "two_stage"):
+                    exclude_self: bool, mode: str = "two_stage",
+                    scale=None):
     """Chunked top-k over per-query candidate ids — the IVF in-cell
     scorer.  The two-stage machinery of :func:`_scan_topk` (per-chunk
     ``lax.top_k`` over the tile only, one post-scan merge, the running
@@ -473,14 +522,18 @@ def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
                                       cand=ctot):
             d, i = fused_kernel.scan_topk_cand(
                 scan_table, cand, q, q_idx, spec=spec, k=k,
-                exclude_self=exclude_self)
+                exclude_self=exclude_self, scale=scale)
             ko = min(k, ctot)
             return d[:, :ko], i[:, :ko]
         mode = "two_stage"  # capability fallback — bit-identical path
 
     def masked_tile(i):
         ids = jax.lax.dynamic_slice_in_dim(cand, i * chunk, chunk, axis=1)
-        rows = scan_table[jnp.maximum(ids, 0)]            # [B, chunk, D]
+        safe = jnp.maximum(ids, 0)
+        rows = scan_table[safe]                           # [B, chunk, D]
+        if scale is not None:
+            # int8 lane: gather each candidate's dequant scale with it
+            rows = rows.astype(jnp.float32) * scale[safe]
         d = _cand_dist(spec, q, rows)                     # [B, chunk]
         mask = ids < 0
         if exclude_self:
@@ -489,15 +542,17 @@ def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
 
     return _two_stage_core(masked_tile, b=b, nchunks=nchunks, k=k,
                            kc=min(k, chunk), ko=min(k, ctot),
-                           dtype=scan_table.dtype)
+                           dtype=(jnp.float32 if scale is not None
+                                  else scan_table.dtype))
 
 
 @partial(jax.jit, static_argnames=("spec", "k", "k_scan", "nprobe", "chunk",
                                    "exclude_self", "mixed", "mode"))
-def _topk_ivf(table: jax.Array, scan_table: jax.Array, centroids: jax.Array,
+def _topk_ivf(table: jax.Array, scan_table: jax.Array,
+              centroids: jax.Array,
               cells: jax.Array, q_idx: jax.Array, *, spec: tuple, k: int,
               k_scan: int, nprobe: int, chunk: int, exclude_self: bool,
-              mixed: bool, mode: str = "two_stage"):
+              mixed: bool, mode: str = "two_stage", scan_scale=None):
     """IVF probing top-k: centroid scoring → nearest-``nprobe`` cell
     gather → two-stage candidate scan (docs/serving.md "Approximate
     retrieval").  One executable per (batch, k, nprobe, spec) — same
@@ -520,10 +575,12 @@ def _topk_ivf(table: jax.Array, scan_table: jax.Array, centroids: jax.Array,
     pad = -cand.shape[1] % chunk
     if pad:
         cand = jnp.pad(cand, ((0, 0), (0, pad)), constant_values=-1)
-    qs = q.astype(scan_table.dtype)
+    # int8 scans keep f32 queries (rows dequantize in the scorer)
+    qs = q if scan_scale is not None else q.astype(scan_table.dtype)
     sd, sidx = _scan_topk_cand(scan_table, qs, cand, q_idx, spec=spec,
                                k=(k_scan if mixed else k), chunk=chunk,
-                               exclude_self=exclude_self, mode=mode)
+                               exclude_self=exclude_self, mode=mode,
+                               scale=scan_scale)
     if not mixed:
         return sidx, sd
     rows = table[jnp.maximum(sidx, 0)]                    # [B, K, D] f32
@@ -599,7 +656,12 @@ class QueryEngine:
     manifold distances against the f32 table before the final ranking —
     returned distances are always f32-accurate, and a near-tie the bf16
     pass mis-ranks at the k-th boundary is recovered by the over-fetch.
-    Edge scoring (``score_edges``) is always f32: it is two cheap
+    ``"int8"`` is the same shape at a quarter of the table bytes: a
+    per-row symmetric int8 code + per-row f32 scale (``serve/quant.py``)
+    replace the scan copy, tiles dequantize in-register, and the coarse
+    pass keeps ``k + max(4k, 32)`` candidates for the f32 rescore
+    (docs/serving.md "Quantized scan lane").  Edge scoring
+    (``score_edges``) is always f32: it is two cheap
     gathers plus one distance per pair, with no table scan to save.
 
     ``index=`` + ``nprobe=`` turn on **IVF probing** (docs/serving.md
@@ -639,7 +701,12 @@ class QueryEngine:
         self.spec = tuple(manifold_spec)
         self.scan_mode = scan_mode
         self.precision = precision
-        self._policy = precision_mod.get_policy(precision)
+        # int8 is a serve-only scan lane (serve/quant.py), not a
+        # precision-policy preset: the policy object stays f32 (master
+        # table, rescore math) and the quantized copy rides beside it
+        self._quant = precision == "int8"
+        self._policy = precision_mod.get_policy(
+            "f32" if self._quant else precision)
         self.fingerprint = fingerprint or fingerprint_of(table, self.spec)
         self.mesh, self.mesh_axis = mesh, mesh_axis
         shards = 1
@@ -665,7 +732,8 @@ class QueryEngine:
         self._fused_kind = (scan_mode == "fused"
                             and fused_kernel.kind_supported(self.spec)
                             and self.dim <= fused_kernel.FUSED_MAX_DIM)
-        scan_dtype = (self._policy.compute if self._policy.mixed
+        scan_dtype = (jnp.int8 if self._quant
+                      else self._policy.compute if self._policy.mixed
                       else jnp.float32)
         self.chunk_rows = chunk_rows or auto_chunk_rows(
             self.dim, self.spec[0], self.num_nodes, tile_budget,
@@ -711,7 +779,21 @@ class QueryEngine:
         # the low-precision scan copy lives beside the f32 table (same
         # layout/sharding) — built ONCE here, not per query; the f32
         # policy aliases the table so the default path holds one array
-        if self._policy.mixed:
+        self.scan_scale = None
+        if self._quant:
+            from hyperspace_tpu.serve.quant import quantize_rows
+
+            # quantize the PADDED table: zero padding rows get scale 0
+            # and dequantize to exact zeros, like the f32 padding
+            q8, sc = quantize_rows(table)
+            if shards > 1:
+                put = lambda a: jax.device_put(
+                    a, table_sharding(mesh, mesh_axis))
+                self.scan_table, self.scan_scale = put(q8), put(sc)
+            else:
+                self.scan_table = jnp.asarray(q8)
+                self.scan_scale = jnp.asarray(sc)
+        elif self._policy.mixed:
             scan_np = table.astype(self._policy.compute)
             self.scan_table = (
                 jax.device_put(scan_np, table_sharding(mesh, mesh_axis))
@@ -773,15 +855,34 @@ class QueryEngine:
         versa) over the same table."""
         sig = (("ivf", self.nprobe, self.index.fingerprint) if self._ivf
                else ("exact",))
-        return sig + (("fused",) if self._fused_kind else ())
+        return sig + self._lane_markers()
 
     def scan_signature_for(self, nprobe: int) -> tuple:
         """The signature :attr:`scan_signature` would have at an
         overridden probe width — the degradation ladder's cache-key hook
         (``serve/batcher.py``): narrowed-width rows carry the narrowed
-        signature, fused marker included."""
+        signature, fused and lane markers included."""
         sig = ("ivf", int(nprobe), self.index.fingerprint)
-        return sig + (("fused",) if self._fused_kind else ())
+        return sig + self._lane_markers()
+
+    def _lane_markers(self) -> tuple:
+        """Result-identity suffixes shared by every signature variant:
+        ``"fused"`` (rank-identical but only ulp-close distances) and
+        the ``"int8"`` scan lane (different candidate sets than the f32
+        or bf16 scans — quantized rows must never be served back as
+        full-precision rows, whatever else the cache key carries)."""
+        return ((("fused",) if self._fused_kind else ())
+                + (("int8",) if self._quant else ()))
+
+    def _k_scan(self, k: int, cap: int) -> int:
+        """Over-fetch width of the low-precision coarse scan: the f32
+        rescore can only repair a k-th-boundary mis-rank that is IN the
+        candidate set.  int8 gets a wider margin than bf16 — its
+        quantization step is coarser (docs/serving.md)."""
+        if self._quant:
+            return min(k + max(_QUANT_RESCORE_MULT * k,
+                               _QUANT_RESCORE_MIN), cap)
+        return min(k + max(k, _RESCORE_PAD), cap)
 
     @classmethod
     def from_artifact(cls, art: ServingArtifact, **kw) -> "QueryEngine":
@@ -824,19 +925,22 @@ class QueryEngine:
         if self._ivf:
             return self._probe_topk(q_idx, k, exclude_self=exclude_self,
                                     nprobe=nprobe)
-        if self._policy.mixed:
-            # over-fetch margin: the bf16 scan keeps k_scan candidates so
-            # the f32 rescore can repair k-th-boundary near-ties
-            k_scan = min(k + max(k, _RESCORE_PAD), self.num_nodes)
+        if self._policy.mixed or self._quant:
+            # over-fetch margin: the low-precision scan keeps k_scan
+            # candidates so the f32 rescore can repair k-th-boundary
+            # near-ties (wider for int8 — coarser quantization)
+            k_scan = self._k_scan(k, self.num_nodes)
             if self.shards > 1:
                 return _topk_sharded_mixed(
-                    self.table, self.scan_table, q_idx, spec=self.spec,
-                    k=k, k_scan=k_scan, chunk=self.chunk_rows,
+                    self.table, self.scan_table, self.scan_scale, q_idx,
+                    spec=self.spec, k=k, k_scan=k_scan,
+                    chunk=self.chunk_rows,
                     n=self.num_nodes, exclude_self=exclude_self,
                     mode=self._scan_mode_eff, mesh=self.mesh,
                     axis=self.mesh_axis)
             return _topk_chunked_mixed(
-                self.table, self.scan_table, q_idx, spec=self.spec, k=k,
+                self.table, self.scan_table, self.scan_scale, q_idx,
+                spec=self.spec, k=k,
                 k_scan=k_scan, chunk=self.chunk_rows, n=self.num_nodes,
                 exclude_self=exclude_self, mode=self._scan_mode_eff)
         if self.shards > 1:
@@ -873,14 +977,16 @@ class QueryEngine:
                 f"{p}×{self.index.max_cell} = {capacity}; "
                 "raise nprobe=")
         k_scan = k
-        if self._policy.mixed:
-            k_scan = min(k + max(k, _RESCORE_PAD), capacity)
+        if self._policy.mixed or self._quant:
+            k_scan = self._k_scan(k, capacity)
         t0 = time.perf_counter()
         idx, dist = _topk_ivf(
-            self.table, self.scan_table, self._centroids, self._cells,
+            self.table, self.scan_table,
+            self._centroids, self._cells,
             q_idx, spec=self.spec, k=k, k_scan=k_scan, nprobe=p,
             chunk=self._cand_chunk, exclude_self=exclude_self,
-            mixed=self._policy.mixed, mode=self._scan_mode_eff)
+            mixed=self._policy.mixed or self._quant,
+            mode=self._scan_mode_eff, scan_scale=self.scan_scale)
         telem.observe("serve/index_probe_ms",
                       (time.perf_counter() - t0) * 1e3)
         telem.inc("serve/recall_candidates", int(q_idx.shape[0]) * capacity)

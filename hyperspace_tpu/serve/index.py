@@ -74,6 +74,22 @@ IVF_MIN_TABLE_ROWS = 2048
 # whatever N is
 _BUILD_CHUNK = 4096
 
+# at or above this many rows the builder switches to the HOST-STREAMED
+# path (also forced for a HostEmbedTable source): the table never sits
+# device-resident — the device sees one [_BUILD_CHUNK, D] block at a
+# time (index/build_device_rows_peak gauge), and k-means++ seeding runs
+# on a bounded uniform subsample (`seed_sample`).  Below it the
+# fully-resident build keeps its structure and seeding stream; note
+# that r15 ALSO sped up both paths' shared assignment/fold numerics
+# (reduced argmin key, segment-sum folds), so rebuilt indexes can
+# differ from pre-r15 artifacts at floating-point near-ties — builds
+# stay deterministic per (inputs, platform, version).
+HOST_BUILD_ROWS = 1 << 20
+# default seeding-subsample cap for the streamed path; D² seeding is
+# O(ncells · sample) distance evals, so an unbounded sample at 10M rows
+# would dominate the whole build
+SEED_SAMPLE_DEFAULT = 1 << 17
+
 
 def auto_ncells(n: int) -> int:
     """Default cell count: ~√N (the classical IVF balance point where
@@ -187,6 +203,64 @@ def _unlift(spec: tuple, s: jax.Array, cnt: jax.Array) -> jax.Array:
 # --- the jitted Lloyd loop ----------------------------------------------------
 
 
+def _nearest_centroid(cent: jax.Array, rows: jax.Array, *, spec: tuple,
+                      ncells: int) -> jax.Array:
+    """Per-row nearest-centroid id [rows] int32 — nearest-centroid
+    assignment IS a k=1 scan-top-k with the centroids as the slab: on a
+    kernel backend the fused Pallas kernel (kernels/scan_topk.py)
+    serves it without materializing the [chunk, ncells] distance tile.
+    The XLA path argmins a **monotone-reduced distance key** instead of
+    the full geodesic chain: for a fixed query row, dropping strictly
+    increasing maps (arcosh1p, /√c) and POSITIVE per-row factors
+    preserves the argmin —
+
+    - poincare:  argmin_y  d²(x,y) / (1 − c‖y‖²)   (the (1 − c‖x‖²)
+      factor is a per-row positive constant);
+    - lorentz:   argmin_y  −⟨x, y⟩_L ;
+    - euclidean: argmin_y  ‖x − y‖² ;
+    - others (sphere, product): the full :func:`_tile_dist`.
+
+    At 10M × 1024 cells the arcosh/rsqrt elementwise chain over the
+    [chunk, ncells] tile WAS the build (measured ~5× of the Gram on
+    the CPU twin); the reduced key keeps the Gram and drops the chain.
+    Assignments can differ from the full-distance argmin only at
+    floating-point near-ties (harmless to k-means; builds stay
+    deterministic per platform).  The ONE assignment body the resident
+    Lloyd loop, the host-streamed loop and the final passes all trace.
+    """
+    from hyperspace_tpu.kernels import _support as KS
+    from hyperspace_tpu.kernels import scan_topk as fused_kernel
+    from hyperspace_tpu.manifolds import smath
+    from hyperspace_tpu.serve.engine import _tile_dist
+
+    if (KS.mode() != "xla"
+            and fused_kernel.supports(spec, k=1, dim=rows.shape[1])):
+        _, ids = fused_kernel.scan_topk(
+            cent, rows, jnp.zeros((rows.shape[0],), jnp.int32), 0,
+            spec=spec, k=1, n=ncells, exclude_self=False)
+        return ids[:, 0]
+    kind = spec[0]
+    prec = jax.lax.Precision.HIGHEST
+    if kind in ("poincare", "euclidean"):
+        gram = jnp.einsum("rd,cd->rc", rows, cent, precision=prec)
+        xx = smath.sq_norm(rows)                          # [rows, 1]
+        yy = smath.sq_norm(cent)[:, 0][None, :]           # [1, ncells]
+        d2 = smath.clamp_min(xx - 2.0 * gram + yy, 0.0)
+        if kind == "poincare":
+            c = jnp.asarray(spec[1], rows.dtype)
+            den_y = smath.clamp_min(1.0 - c * yy,
+                                    smath.eps_for(rows.dtype))
+            d2 = d2 / den_y
+        key = d2
+    elif kind == "lorentz":
+        lane0 = jnp.concatenate(
+            [-cent[:, :1], cent[:, 1:]], axis=1)          # flip time
+        key = -jnp.einsum("rd,cd->rc", rows, lane0, precision=prec)
+    else:
+        key = _tile_dist(spec, rows, cent)                # [rows, ncells]
+    return jnp.argmin(key, axis=1).astype(jnp.int32)
+
+
 @partial(jax.jit, static_argnames=("spec", "chunk", "iters", "ncells"))
 def _lloyd(tpad: jax.Array, cent0: jax.Array, n, *, spec: tuple,
            chunk: int, iters: int, ncells: int):
@@ -201,31 +275,12 @@ def _lloyd(tpad: jax.Array, cent0: jax.Array, n, *, spec: tuple,
     a one-hot matmul per chunk, so the whole loop is one executable and
     deterministic for a fixed seed/platform.
     """
-    from hyperspace_tpu.kernels import _support as KS
-    from hyperspace_tpu.kernels import scan_topk as fused_kernel
-    from hyperspace_tpu.serve.engine import _tile_dist
-
     nchunks = tpad.shape[0] // chunk
     dl = _lift_dim(spec, tpad.shape[1])
-    # nearest-centroid assignment IS a k=1 scan-top-k with the centroids
-    # as the slab — on a kernel backend the fused Pallas kernel
-    # (kernels/scan_topk.py) serves it without materializing the
-    # [chunk, ncells] distance tile; the CPU/XLA path keeps the exact
-    # historical argmin program (same answers, no behavior drift for
-    # existing builds)
-    use_fused = (KS.mode() != "xla"
-                 and fused_kernel.supports(spec, k=1, dim=tpad.shape[1]))
 
     def assign_chunk(cent, i):
         rows = jax.lax.dynamic_slice_in_dim(tpad, i * chunk, chunk)
-        if use_fused:
-            _, ids = fused_kernel.scan_topk(
-                cent, rows, jnp.zeros((chunk,), jnp.int32), 0, spec=spec,
-                k=1, n=ncells, exclude_self=False)
-            a = ids[:, 0]
-        else:
-            d = _tile_dist(spec, rows, cent)              # [chunk, ncells]
-            a = jnp.argmin(d, axis=1).astype(jnp.int32)
+        a = _nearest_centroid(cent, rows, spec=spec, ncells=ncells)
         valid = (i * chunk + jnp.arange(chunk)) < n
         return rows, a, valid
 
@@ -233,10 +288,14 @@ def _lloyd(tpad: jax.Array, cent0: jax.Array, n, *, spec: tuple,
         def chunk_body(carry, i):
             sums, cnts = carry
             rows, a, valid = assign_chunk(cent, i)
-            oh = ((a[:, None] == jnp.arange(ncells)[None, :])
-                  & valid[:, None]).astype(jnp.float32)   # [chunk, ncells]
-            sums = sums + oh.T @ _lift(spec, rows)
-            cnts = cnts + jnp.sum(oh, axis=0)
+            # segment-sum fold: no [chunk, ncells] one-hot float matrix
+            # (at 10M × 1024 cells that matrix WAS half the build's
+            # memory traffic); masked rows add zeros to cell 0
+            lifted = jnp.where(valid[:, None], _lift(spec, rows), 0.0)
+            seg = jnp.where(valid, a, 0)
+            sums = sums + jax.ops.segment_sum(lifted, seg, ncells)
+            cnts = cnts + jax.ops.segment_sum(
+                valid.astype(jnp.float32), seg, ncells)
             return (sums, cnts), None
 
         (sums, cnts), _ = jax.lax.scan(
@@ -257,6 +316,109 @@ def _lloyd(tpad: jax.Array, cent0: jax.Array, n, *, spec: tuple,
 
     _, assign = jax.lax.scan(final_chunk, None, jnp.arange(nchunks))
     return cent, assign.reshape(-1)
+
+
+# --- host-streamed build (HOST_BUILD_ROWS and up / HostEmbedTable) ------------
+
+
+def _src_rows(table) -> tuple[int, int]:
+    """(rows, width) of an ndarray or HostEmbedTable source."""
+    from hyperspace_tpu.parallel.host_table import HostEmbedTable
+
+    if isinstance(table, HostEmbedTable):
+        return table.num_rows, table.width
+    return int(table.shape[0]), int(table.shape[1])
+
+
+def _src_iter(table, chunk: int):
+    """Yield ``(start, np block)`` host views, <= ``chunk`` rows each."""
+    from hyperspace_tpu.parallel.host_table import HostEmbedTable
+
+    if isinstance(table, HostEmbedTable):
+        yield from table.iter_chunks(chunk)
+        return
+    for lo in range(0, table.shape[0], chunk):
+        yield lo, table[lo:lo + chunk]
+
+
+def _src_gather(table, ids: np.ndarray) -> np.ndarray:
+    from hyperspace_tpu.parallel.host_table import HostEmbedTable
+
+    if isinstance(table, HostEmbedTable):
+        return table.gather(ids)
+    return table[ids]
+
+
+def _device_block(block: np.ndarray, chunk: int) -> tuple[jax.Array, int]:
+    """One streamed [chunk, D] device block (zero-padded tail) — the
+    ONLY shape the streamed build ever puts on device; its row count
+    feeds the ``index/build_device_rows_peak`` gauge."""
+    from hyperspace_tpu.telemetry import registry as _telem
+
+    rows = block.shape[0]
+    if rows < chunk:
+        block = np.concatenate(
+            [block, np.zeros((chunk - rows, block.shape[1]),
+                             block.dtype)], axis=0)
+    _telem.set_gauge("index/build_device_rows_peak", chunk)
+    return jnp.asarray(block), rows
+
+
+@partial(jax.jit, static_argnames=("spec", "ncells"))
+def _accum_chunk(cent, rows, nvalid, sums, cnts, *, spec: tuple,
+                 ncells: int):
+    """One streamed Lloyd chunk: assign + fold the lifted per-cell sums
+    into the running accumulators (same segment-sum fold as the
+    resident loop's scan body)."""
+    a = _nearest_centroid(cent, rows, spec=spec, ncells=ncells)
+    valid = jnp.arange(rows.shape[0]) < nvalid
+    lifted = jnp.where(valid[:, None], _lift(spec, rows), 0.0)
+    seg = jnp.where(valid, a, 0)
+    return (sums + jax.ops.segment_sum(lifted, seg, ncells),
+            cnts + jax.ops.segment_sum(valid.astype(jnp.float32), seg,
+                                       ncells))
+
+
+@partial(jax.jit, static_argnames=("spec", "ncells"))
+def _assign_chunk_stream(cent, rows, nvalid, *, spec: tuple, ncells: int):
+    a = _nearest_centroid(cent, rows, spec=spec, ncells=ncells)
+    return jnp.where(jnp.arange(rows.shape[0]) < nvalid, a, -1)
+
+
+def _lloyd_stream(table, cent0: jax.Array, *, spec: tuple, chunk: int,
+                  iters: int, ncells: int):
+    """Host-streamed Lloyd: same fixed-iteration update as
+    :func:`_lloyd`, but the table stays on host — each pass walks it in
+    [chunk, D] device blocks (one executable), accumulating the lifted
+    per-cell sums on device.  Same per-chunk arithmetic in the same
+    fold order as the resident scan — from equal seeds the two paths
+    produce IDENTICAL assignments and float-tolerance-equal centroids
+    (XLA schedules the jitted scan's accumulates differently than the
+    eager chunk loop, so bitwise is not promised; regression-tested on
+    a ~200k table)."""
+    n, dim = _src_rows(table)
+    dl = _lift_dim(spec, dim)
+    cent = cent0
+    for _ in range(int(iters)):
+        sums = jnp.zeros((ncells, dl), jnp.float32)
+        cnts = jnp.zeros((ncells,), jnp.float32)
+        for _start, blk in _src_iter(table, chunk):
+            rows, nvalid = _device_block(blk, chunk)
+            sums, cnts = _accum_chunk(cent, rows, jnp.int32(nvalid),
+                                      sums, cnts, spec=spec, ncells=ncells)
+        new = _unlift(spec, sums, cnts)
+        cent = jnp.where(cnts[:, None] > 0, new, cent)
+    parts = []
+    for _start, blk in _src_iter(table, chunk):
+        rows, nvalid = _device_block(blk, chunk)
+        parts.append(np.asarray(_assign_chunk_stream(
+            cent, rows, jnp.int32(nvalid), spec=spec, ncells=ncells)))
+    assign = np.concatenate(parts)
+    assign = assign[assign >= 0]  # per-block padding tails drop out
+    if len(assign) != n:
+        raise AssertionError(
+            f"streamed assignment covered {len(assign)} of {n} rows")
+    return cent, assign
 
 
 @partial(jax.jit, static_argnames=("spec",))
@@ -296,8 +458,15 @@ def _spill_balance(table: np.ndarray, centroids: np.ndarray,
     if counts.max() <= cap:
         return assign
     cdev = jnp.asarray(centroids)
-    d_own = np.asarray(_own_dist(
-        jnp.asarray(table), cdev[jnp.asarray(assign)], spec=spec))
+    # own-centroid distances STREAMED per host chunk ([chunk] device
+    # working set — at 10M rows the old one-shot put of the whole table
+    # was itself the materialization this builder exists to avoid)
+    parts = []
+    for start, blk in _src_iter(table, _BUILD_CHUNK):
+        ca = cdev[jnp.asarray(assign[start:start + blk.shape[0]])]
+        parts.append(np.asarray(_own_dist(
+            jnp.asarray(np.ascontiguousarray(blk)), ca, spec=spec)))
+    d_own = np.concatenate(parts)
     assign = assign.copy()
     spilled = []
     for c in np.flatnonzero(counts > cap):
@@ -310,7 +479,7 @@ def _spill_balance(table: np.ndarray, centroids: np.ndarray,
     for s in range(0, len(spilled), bs):
         rows = spilled[s:s + bs]
         pd = np.asarray(_all_cell_dist(
-            jnp.asarray(table[rows]), cdev, spec=spec))
+            jnp.asarray(_src_gather(table, rows)), cdev, spec=spec))
         pref = np.argsort(pd, axis=1, kind="stable")
         left = np.arange(len(rows))
         for j in range(ncells):
@@ -335,7 +504,9 @@ def _spill_balance(table: np.ndarray, centroids: np.ndarray,
 def build_index(table, manifold_spec: tuple, ncells: int, *,
                 iters: int = 8, seed: int = 0,
                 chunk: int = _BUILD_CHUNK,
-                balance: float = 2.0) -> ServingIndex:
+                balance: float = 2.0,
+                seed_sample: int = 0,
+                host_resident: bool | None = None) -> ServingIndex:
     """Offline IVF build: hyperbolic k-means + dense cell layout.
 
     Deterministic for a fixed ``(table, spec, ncells, iters, seed)`` on
@@ -358,11 +529,31 @@ def build_index(table, manifold_spec: tuple, ncells: int, *,
     which multi-cell probes still find (the recall cost is measured,
     not assumed: ``bench_serve``'s recall leg).  ``balance=0`` disables
     the cap.
+
+    **Scaling past HBM** (``host_resident`` — auto at
+    ``HOST_BUILD_ROWS`` rows or for a
+    :class:`~hyperspace_tpu.parallel.host_table.HostEmbedTable`
+    source): the streamed build keeps the table on host — k-means++
+    seeding runs on a bounded uniform subsample (``seed_sample``, auto
+    ``min(n, 2^17)``; D² sampling over the full 10M-row table would be
+    O(ncells·N) distance passes), Lloyd iterations and the final
+    assignment walk [chunk, D] device blocks
+    (``index/build_device_rows_peak`` gauge), and the spill pass
+    gathers only the spilled rows.  Below the threshold the
+    fully-resident build keeps its structure and full-table seeding
+    stream (r15's shared assignment/fold speedups apply to BOTH paths
+    — rebuilt indexes can shift vs pre-r15 artifacts at fp near-ties;
+    determinism per build is unchanged).
     """
-    table = np.ascontiguousarray(np.asarray(table, np.float32))
-    if table.ndim != 2:
-        raise ValueError(f"index table must be [N, D]; got {table.shape}")
-    n, dim = (int(s) for s in table.shape)
+    from hyperspace_tpu.parallel.host_table import HostEmbedTable
+
+    is_host_tab = isinstance(table, HostEmbedTable)
+    if not is_host_tab:
+        table = np.ascontiguousarray(np.asarray(table, np.float32))
+        if table.ndim != 2:
+            raise ValueError(
+                f"index table must be [N, D]; got {table.shape}")
+    n, dim = _src_rows(table)
     ncells = int(ncells)
     if not 2 <= ncells <= n:
         raise ValueError(
@@ -375,34 +566,70 @@ def build_index(table, manifold_spec: tuple, ncells: int, *,
             f"balance must be 0 (disabled) or >= 1.0; got {balance}")
     spec = tuple(manifold_spec)
     m = manifold_from_spec(spec)
-    tdev = jnp.asarray(table)
+    stream = (host_resident if host_resident is not None
+              else is_host_tab or n >= HOST_BUILD_ROWS)
+    if is_host_tab and not stream:
+        raise ValueError(
+            "a HostEmbedTable source builds host-resident — drop "
+            "host_resident=False (densifying it on device is the "
+            "materialization this path exists to avoid)")
 
     # k-means++ seeding: D² sampling under the geodesic metric — each
     # new seed is drawn ∝ squared distance to the nearest chosen seed
     rng = np.random.default_rng(seed)
     dist_to = jax.jit(lambda t, c: m.dist(t, c[None, :]))  # hyperlint: disable=jit-cache-defeat — offline builder: one trace per build_index call, amortized over the whole k-means++/Lloyd loop
-    chosen = [int(rng.integers(n))]
-    d2 = np.square(np.asarray(dist_to(tdev, tdev[chosen[0]])), dtype=np.float64)
-    for _ in range(ncells - 1):
-        total = d2.sum()
-        if total > 0:
-            pick = int(rng.choice(n, p=d2 / total))
-        else:  # all remaining mass at distance 0 (duplicate points)
-            pick = int(rng.integers(n))
-        chosen.append(pick)
-        d2 = np.minimum(
-            d2, np.square(np.asarray(dist_to(tdev, tdev[pick])),
-                          dtype=np.float64))
-    cent0 = jnp.asarray(table[np.asarray(chosen)])
+    use_sample = stream or (seed_sample and int(seed_sample) < n)
+    if use_sample:
+        ssize = min(int(seed_sample) or SEED_SAMPLE_DEFAULT, n)
+        if ssize < ncells:
+            raise ValueError(
+                f"seed_sample={ssize} must hold at least ncells="
+                f"{ncells} candidate rows")
+        sample_ids = np.sort(rng.choice(n, size=ssize, replace=False))
+        sdev = jnp.asarray(_src_gather(table, sample_ids))
+        chosen = [int(rng.integers(ssize))]
+        d2 = np.square(np.asarray(dist_to(sdev, sdev[chosen[0]])),
+                       dtype=np.float64)
+        for _ in range(ncells - 1):
+            total = d2.sum()
+            pick = (int(rng.choice(ssize, p=d2 / total)) if total > 0
+                    else int(rng.integers(ssize)))
+            chosen.append(pick)
+            d2 = np.minimum(d2, np.square(
+                np.asarray(dist_to(sdev, sdev[pick])), dtype=np.float64))
+        cent0 = sdev[np.asarray(chosen)]
+    else:
+        tdev = jnp.asarray(table)
+        chosen = [int(rng.integers(n))]
+        d2 = np.square(np.asarray(dist_to(tdev, tdev[chosen[0]])),
+                       dtype=np.float64)
+        for _ in range(ncells - 1):
+            total = d2.sum()
+            if total > 0:
+                pick = int(rng.choice(n, p=d2 / total))
+            else:  # all remaining mass at distance 0 (duplicate points)
+                pick = int(rng.integers(n))
+            chosen.append(pick)
+            d2 = np.minimum(
+                d2, np.square(np.asarray(dist_to(tdev, tdev[pick])),
+                              dtype=np.float64))
+        cent0 = jnp.asarray(table[np.asarray(chosen)])
 
-    npad = -(-n // chunk) * chunk
-    tpad = (jnp.concatenate(
-        [tdev, jnp.zeros((npad - n, dim), jnp.float32)]) if npad > n
-        else tdev)
-    cent, assign = _lloyd(tpad, cent0, jnp.int32(n), spec=spec, chunk=chunk,
-                          iters=int(iters), ncells=ncells)
-    centroids = np.asarray(cent, np.float32)
-    assign = np.asarray(assign)[:n]
+    if stream:
+        cent, assign = _lloyd_stream(table, cent0, spec=spec, chunk=chunk,
+                                     iters=int(iters), ncells=ncells)
+        centroids = np.asarray(cent, np.float32)
+        assign = np.asarray(assign)
+    else:
+        tdev = jnp.asarray(table)  # no-op if the seeding already put it
+        npad = -(-n // chunk) * chunk
+        tpad = (jnp.concatenate(
+            [tdev, jnp.zeros((npad - n, dim), jnp.float32)]) if npad > n
+            else tdev)
+        cent, assign = _lloyd(tpad, cent0, jnp.int32(n), spec=spec,
+                              chunk=chunk, iters=int(iters), ncells=ncells)
+        centroids = np.asarray(cent, np.float32)
+        assign = np.asarray(assign)[:n]
 
     if balance and balance > 0:
         assign = _spill_balance(table, centroids, assign, spec,

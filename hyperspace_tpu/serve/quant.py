@@ -1,0 +1,71 @@
+"""Per-row symmetric int8 quantization for the serve table's coarse-scan
+lane (docs/serving.md "Quantized scan lane").
+
+The bf16 scan-then-f32-rescore pattern (PR 5) and the fused kernel's
+half-byte bf16 slab streaming (PR 10) both rest on one property: a
+LOW-PRECISION coarse pass only has to keep the true top-k inside its
+over-fetched candidate set — the exact f32 rescore picks the answer.
+int8 is the same trick at 4× the capacity and bandwidth win: the scan
+copy stores one signed byte per element plus one f32 scale per row,
+
+    scale[i] = max(|table[i, :]|) / 127        (0 for an all-zero row)
+    q[i, :]  = round(table[i, :] / scale[i])   clipped to [-127, 127]
+
+and every consumer dequantizes **in-register** (``q.astype(f32) *
+scale``) right before the distance math, so the arithmetic of the
+coarse pass is still f32 — the int8 cost is the table quantization
+error only, and it never reaches a returned distance (those come from
+the f32 rescore against the f32 master table).
+
+Per-ROW scaling matters for the hyperbolic families: a Lorentz row's
+time coordinate (~1/√c + ‖x_s‖²-ish) dwarfs its spatial coordinates,
+and a single per-table scale would crush the spatial lanes to a couple
+of quantization levels.  Per-row, each row spends its 8 bits on its own
+dynamic range.
+
+Symmetric (zero-point-free) quantization keeps the dequantize a single
+multiply — no add riding into the kernel's Gram matmuls — and maps
+0 → 0 exactly, which the engine's zero-row padding relies on.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# int8 levels per side: symmetric, so -128 is never produced and the
+# dequantized range is exactly [-max|row|, +max|row|]
+QLEVELS = 127
+
+
+def quantize_rows(table: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Per-row symmetric int8 quantization.
+
+    ``table`` [N, D] float → ``(q [N, D] int8, scale [N, 1] float32)``
+    with ``q * scale ≈ table`` (max abs error ``scale/2`` per element).
+    All-zero rows get scale 0 and q 0, so they dequantize to exactly 0
+    (the engine's padding rows stay inert).
+    """
+    table = np.asarray(table, np.float32)
+    if table.ndim != 2:
+        raise ValueError(f"table must be [N, D]; got {table.shape}")
+    amax = np.max(np.abs(table), axis=1, keepdims=True)     # [N, 1]
+    scale = (amax / QLEVELS).astype(np.float32)
+    # guard the divide only — a zero scale still lands in the output so
+    # dequantize(q, 0) == 0 without a special case anywhere downstream
+    safe = np.where(scale > 0, scale, 1.0)
+    q = np.clip(np.rint(table / safe), -QLEVELS, QLEVELS).astype(np.int8)
+    return q, scale
+
+
+def dequantize_rows(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """The exact inverse the device paths apply in-register:
+    ``q.astype(f32) * scale`` — host-side twin for tests/tools."""
+    return q.astype(np.float32) * np.asarray(scale, np.float32)
+
+
+def quant_error_bound(scale: np.ndarray) -> float:
+    """Max per-element reconstruction error: half a quantization step
+    of the worst row (``max(scale)/2``) — what the engine's over-fetch
+    margin is sized against (docs/serving.md)."""
+    s = np.asarray(scale, np.float32)
+    return float(s.max() / 2.0) if s.size else 0.0

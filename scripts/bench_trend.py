@@ -67,6 +67,18 @@ _LOWER_TOKENS = ("time", "stall", "waste", "recompile", "epoch_s",
 # never an improvement (latency itself — http_p99_ms and every
 # latency_ms leaf — is already lower-better via the _ms suffix)
 _LOWER_PRIORITY_TOKENS = ("waste", "shed", "deadline")
+# size tokens, matched per dotted-path SEGMENT (word-boundary style: the
+# segment is the token, or carries it as a ``_``-separated word) so the
+# r15 big-table leg's capacity metrics — ``table_mb.int8``,
+# ``table_bytes``, ``hbm_gb`` — gate lower-is-better: a table growing
+# is never an improvement.  Segment matching keeps substrings inert
+# ("poincare_embed..." contains "mb" but carries no ``mb`` word; plain
+# substring matching would have re-directioned every *embed* metric).
+# Checked AFTER the higher-better tokens: a size word does not demote a
+# metric that is explicitly a quality/throughput reading — the roofline
+# FRACTION ``frac_hbm_roofline`` carries the hbm word but measures how
+# close to the hbm roofline the step runs (higher is better)
+_LOWER_SIZE_TOKENS = ("bytes", "mb", "hbm")
 _LOWER_SUFFIXES = ("_s", "_ms", "_bytes")
 # leaves that are the size of a measurement's basis, not a measurement
 # — fewer samples is not an improvement
@@ -76,6 +88,17 @@ _NEUTRAL_LEAVES = {"n", "count"}
 _CONFIG_LEAVES = {"devices", "num_nodes", "num_edges", "num_edges_padded",
                   "num_pairs", "batch_size", "steps", "steps_per_epoch",
                   "dim", "k"}
+
+
+def _size_token(key: str) -> bool:
+    """True when any dotted segment carries a ``_LOWER_SIZE_TOKENS``
+    word: the segment IS the token, or holds it as an underscore-
+    separated word (``table_mb``, ``hbm_gb``, ``bytes_f32``)."""
+    for seg in key.split("."):
+        words = seg.split("_")
+        if any(t in words for t in _LOWER_SIZE_TOKENS):
+            return True
+    return False
 
 
 def direction(key: str) -> Optional[str]:
@@ -93,6 +116,11 @@ def direction(key: str) -> Optional[str]:
         return "lower"
     if any(t in k for t in _HIGHER_TOKENS):
         return "higher"
+    if _size_token(k):
+        # table-capacity metrics (the r15 beyond-HBM leg): bytes / mb /
+        # hbm gate lower-is-better — ``table_mb`` growing can never
+        # read as an improvement
+        return "lower"
     if (any(seg.endswith(_LOWER_SUFFIXES) for seg in k.split("."))
             or any(t in k for t in _LOWER_TOKENS)):
         return "lower"

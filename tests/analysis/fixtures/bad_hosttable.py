@@ -1,0 +1,29 @@
+"""Fixture: full-table-materialization MUST fire on every pattern here."""
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.parallel.host_table import HostEmbedTable
+
+
+def whole_master_to_device(arr):
+    master = HostEmbedTable.from_array(arr, shards=4)
+    return jnp.asarray(master)           # the master object itself
+
+
+def to_array_then_transfer(master):
+    full = master.to_array()             # sanctioned host materializer…
+    return jax.device_put(full)          # …shipped whole to device
+
+
+def direct_to_array_transfer(master):
+    return jnp.asarray(master.to_array())
+
+
+def constructed_then_put(shards):
+    t = HostEmbedTable(shards)
+    return jax.device_put(t)
+
+
+def loaded_then_transfer(path):
+    t = HostEmbedTable.load_sharded(path, shards=2)
+    return jnp.asarray(t)

@@ -1,0 +1,37 @@
+"""Fixture: full-table-materialization stays CLEAN on the bounded forms."""
+import jax.numpy as jnp
+
+from hyperspace_tpu.parallel.host_table import DeviceHotCache, HostEmbedTable
+
+
+def streamed_build(master, chunk):
+    """iter_chunks blocks are bounded by construction — the streamed
+    index builder's read path."""
+    total = 0.0
+    for _start, blk in master.iter_chunks(chunk):
+        total += float(jnp.asarray(blk).sum())
+    return total
+
+
+def gathered_rows(master, ids):
+    """A gathered row BATCH is the hot-row protocol's working set, not
+    the table."""
+    rows = master.gather(ids)
+    return jnp.asarray(rows)
+
+
+def through_the_cache(master, ids):
+    cache = DeviceHotCache(master, 1024)
+    return cache.ensure(ids)
+
+
+def rebind_clears_taint(arr):
+    t = HostEmbedTable.from_array(arr)
+    t = t.gather([0, 1, 2])              # rebound to a bounded batch
+    return jnp.asarray(t)
+
+
+def host_only_round_trip(arr, path):
+    t = HostEmbedTable.from_array(arr, shards=4)
+    t.save_sharded(path, shards=2)       # host I/O never touches device
+    return HostEmbedTable.load_sharded(path).num_rows

@@ -19,6 +19,8 @@ from hyperspace_tpu.analysis.rules.donation import DonationHazardRule
 from hyperspace_tpu.analysis.rules.exceptions import SwallowBaseExceptionRule
 from hyperspace_tpu.analysis.rules.flags import FlagDocDriftRule
 from hyperspace_tpu.analysis.rules.hostsync import HostSyncRule
+from hyperspace_tpu.analysis.rules.hosttable import (
+    FullTableMaterializationRule)
 from hyperspace_tpu.analysis.rules.jitcache import JitCacheDefeatRule
 from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
@@ -45,6 +47,7 @@ _PER_FILE = [
     ("bad_retry.py", UnboundedRetryRule, None),
     ("bad_asyncblock.py", BlockingCallInAsyncRule, None),
     ("bad_distmat.py", MaterializedDistmatRule, None),
+    ("bad_hosttable.py", FullTableMaterializationRule, None),
     ("bad_precision.py", PrecisionLiteralRule,
      "hyperspace_tpu/models/bad_precision.py"),
 ]
@@ -305,6 +308,41 @@ def test_distmat_kernels_dir_is_out_of_scope(tmp_path):
                      rules=[MaterializedDistmatRule()]).findings == []
     assert lint_file(str(p), rel="hyperspace_tpu/kernels/deep/x.py",
                      rules=[MaterializedDistmatRule()]).findings == []
+
+
+# --- full-table-materialization ----------------------------------------------
+
+
+def test_hosttable_bad_fixture_fires_on_every_pattern():
+    """Master-object transfer, to_array-then-put (named and direct),
+    constructor-then-put, and load_sharded-then-asarray all fire."""
+    report = _lint("bad_hosttable.py", FullTableMaterializationRule)
+    assert report.exit_code() == 1 and len(report.findings) == 5
+
+
+def test_hosttable_good_fixture_is_clean():
+    """Streamed iter_chunks blocks, gathered row batches, the hot-row
+    cache, rebound names and host-only save/load all pass."""
+    assert _lint("good_hosttable.py",
+                 FullTableMaterializationRule).findings == []
+
+
+def test_hosttable_hot_cache_module_is_out_of_scope(tmp_path):
+    """parallel/host_table.py is the ONE sanctioned home of
+    master→device transfers — the same source that fires elsewhere is
+    clean under its rel path."""
+    src = ("import jax.numpy as jnp\n"
+           "from hyperspace_tpu.parallel.host_table import HostEmbedTable\n"
+           "def f(arr):\n"
+           "    t = HostEmbedTable.from_array(arr)\n"
+           "    return jnp.asarray(t.to_array())\n")
+    p = tmp_path / "x.py"
+    p.write_text(src)
+    assert lint_file(str(p), rel="hyperspace_tpu/train/x.py",
+                     rules=[FullTableMaterializationRule()]).findings
+    assert lint_file(
+        str(p), rel="hyperspace_tpu/parallel/host_table.py",
+        rules=[FullTableMaterializationRule()]).findings == []
 
 
 # --- precision-literal --------------------------------------------------------

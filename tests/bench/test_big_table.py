@@ -1,0 +1,73 @@
+"""`bench_big_table` (r15, docs/benchmarks.md): a miniature end-to-end
+leg — sharded generation, host-streamed index build, all three serve
+lanes with recall-gated qps, the host-vs-in-HBM train pair — plus the
+compact-line field wiring."""
+
+import json
+
+import numpy as np
+import pytest
+
+import bench
+
+
+@pytest.fixture(scope="module")
+def result():
+    return bench.bench_big_table(repeats=1, rows=6000, dim=16, ncells=24,
+                                 train_rows=2000, queries=16)
+
+
+def test_record_shape_and_headline(result):
+    assert result["metric"] == "big_table_qps_at_recall99"
+    assert result["unit"] == "queries/s"
+    d = result["detail"]
+    assert d["rows"] == 6000 and d["ncells"] == 24
+    assert d["build_s"] >= 0 and d["gen_s"] >= 0
+    # the headline value IS the int8 lane's recall-gated qps
+    assert result["value"] == d["lanes"]["int8"]["qps_at_recall99"]
+    assert result["value"] > 0  # the ladder reached recall >= 0.99
+    # the whole record serializes (the emit contract)
+    json.dumps(result, default=bench._json_default)
+
+
+def test_all_three_lanes_report_recall_gated_qps(result):
+    lanes = result["detail"]["lanes"]
+    for lane in ("f32", "bf16", "int8"):
+        assert lane in lanes, lanes.keys()
+        out = lanes[lane]
+        assert out["qps_at_recall99"] > 0
+        # the qualifying probe actually held the recall bar
+        best = max(v["recall10"] for v in out["probes"].values()
+                   if "recall10" in v)
+        assert best >= 0.99
+
+
+def test_table_bytes_order_is_the_capacity_story(result):
+    mb = result["detail"]["table_mb"]
+    # int8 (code + per-row scale) < bf16 < f32 — the 4× lever
+    assert mb["int8"] < mb["bf16"] < mb["f32"]
+
+
+def test_train_pair_present_and_finite(result):
+    tr = result["detail"].get("train")
+    assert tr, result["detail"].get("train_error")
+    assert tr["host_step_ms"] > 0 and tr["inhbm_step_ms"] > 0
+    assert np.isfinite(tr["host_vs_inhbm"])
+    # rows > train_rows: the full-size host-only reading rides along
+    assert tr["host_step_ms_full"] > 0
+
+
+def test_compact_fields_fire_in_both_modes(result):
+    # headline mode (--metric big_table): flat detail paths
+    line = bench.compact_headline(result)
+    rec = json.loads(line)
+    assert rec["detail"]["big_qps_r99_int8"] == result["value"]
+    assert rec["detail"]["big_table_mb_int8"] == \
+        result["detail"]["table_mb"]["int8"]
+    assert "big_build_s" in rec["detail"]
+    assert "big_host_step_ms" in rec["detail"]
+    # auto mode: the nested leg paths
+    nested = {"metric": "x", "value": 1, "unit": "", "vs_baseline": None,
+              "detail": {"big_table": result["detail"]}}
+    rec = json.loads(bench.compact_headline(nested))
+    assert rec["detail"]["big_qps_r99_int8"] == result["value"]

@@ -247,6 +247,33 @@ def test_direction_speedup_ratio_are_higher_better():
     assert mod.direction("detail.serve.cache.padded_waste_ratio") == "lower"
 
 
+def test_direction_table_size_tokens_are_lower_better():
+    """The r15 big-table leg's capacity metrics — bytes / mb / hbm
+    word-tokens per dotted segment — gate lower-is-better: a table
+    growing must never read as regressions-are-good.  Matching is
+    word-boundary per segment, so substrings stay inert: every *embed*
+    metric contains the letters "mb" and must keep its own direction."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_trend", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for name in ("table_bytes", "detail.big_table.table_mb.int8",
+                 "detail.big_table.table_mb.f32", "hbm_gb",
+                 "detail.big_table.lanes.bf16.table_mb",
+                 "detail.big_table.hbm_bytes"):
+        assert mod.direction(name) == "lower", name
+    # substring immunity: "embed" carries no mb *word*
+    assert mod.direction("poincare_embed_epoch_time") == "lower"  # time
+    assert mod.direction("detail.poincare.embed_samples_per_s") == "higher"
+    # and the size tokens never capture unrelated neighbors — nor
+    # demote explicit quality/throughput readings that carry a size
+    # word: the roofline FRACTION stays higher-better
+    assert mod.direction("detail.big_table.qps_at_recall99.int8") == "higher"
+    assert mod.direction("frac_hbm_roofline") == "higher"
+    assert mod.direction("detail.big_table.lanes.int8.n") is None
+
+
 def test_budget_exhausted_primary_never_gates(tmp_path):
     """A record whose metric is real but whose detail carries
     budget_exhausted (the watchdog's partial artifact — the checked-in

@@ -151,6 +151,71 @@ def test_bf16_slab_scans_in_f32_registers(rng, monkeypatch):
 
 
 @pytest.mark.parametrize("kind", ["poincare", "lorentz", "euclidean"])
+def test_int8_slab_dequantizes_in_register(rng, monkeypatch, kind):
+    """An int8 slab + per-row scale (the serve int8 lane,
+    serve/quant.py): twin == interpreter bitwise, and results are
+    BITWISE those of scanning the pre-dequantized f32 table — the
+    in-register ``astype(f32) * scale`` is the only int8 effect."""
+    from hyperspace_tpu.serve.quant import dequantize_rows, quantize_rows
+
+    table, spec, man = _table(rng, kind, 300, 6)
+    q8, sc = quantize_rows(table)
+    deq = dequantize_rows(q8, sc)
+    qidx = np.asarray([0, 50, 299], np.int32)
+    qf = jnp.asarray(deq[qidx])
+
+    def run():
+        return F.scan_topk(jnp.asarray(q8), qf, jnp.asarray(qidx), 0,
+                           spec=spec, k=6, n=300, exclude_self=True,
+                           tile_rows=128, scale=jnp.asarray(sc))
+
+    (td, ti), (kd, ki) = _run_both(monkeypatch, run)
+    assert td.dtype == np.float32
+    assert np.array_equal(ti, ki)
+    assert np.array_equal(td.view(np.uint32), kd.view(np.uint32))
+
+    def run_deq():
+        return F.scan_topk(jnp.asarray(deq), qf, jnp.asarray(qidx), 0,
+                           spec=spec, k=6, n=300, exclude_self=True,
+                           tile_rows=128)
+
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "xla")
+    dd, di = (np.asarray(a) for a in run_deq())
+    assert np.array_equal(ti, di)
+    assert np.array_equal(td.view(np.uint32), dd.view(np.uint32))
+
+
+def test_int8_cand_variant_gathers_scales(rng, monkeypatch):
+    """The candidate variant's int8 path: per-candidate scale gather,
+    twin == interpreter bitwise == the dequantized-table run."""
+    from hyperspace_tpu.serve.quant import dequantize_rows, quantize_rows
+
+    table, spec, _ = _table(rng, "poincare", 400, 6)
+    q8, sc = quantize_rows(table)
+    deq = dequantize_rows(q8, sc)
+    cand = rng.integers(0, 400, (5, 257)).astype(np.int32)
+    cand[:, 250:] = -1  # in-range padding slots
+    qidx = np.arange(5, dtype=np.int32)
+    qf = jnp.asarray(deq[qidx])
+
+    def run():
+        return F.scan_topk_cand(jnp.asarray(q8), jnp.asarray(cand), qf,
+                                jnp.asarray(qidx), spec=spec, k=6,
+                                exclude_self=True,
+                                scale=jnp.asarray(sc))
+
+    (td, ti), (kd, ki) = _run_both(monkeypatch, run)
+    assert np.array_equal(ti, ki)
+    assert np.array_equal(td.view(np.uint32), kd.view(np.uint32))
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "xla")
+    dd, di = (np.asarray(a) for a in F.scan_topk_cand(
+        jnp.asarray(deq), jnp.asarray(cand), qf, jnp.asarray(qidx),
+        spec=spec, k=6, exclude_self=True))
+    assert np.array_equal(ti, di)
+    assert np.array_equal(td.view(np.uint32), dd.view(np.uint32))
+
+
+@pytest.mark.parametrize("kind", ["poincare", "lorentz", "euclidean"])
 def test_cand_variant_matches_interpreter_and_oracle(rng, monkeypatch,
                                                      kind):
     """The per-query candidate variant (the IVF probing scorer): twin ==

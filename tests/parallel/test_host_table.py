@@ -1,0 +1,140 @@
+"""Host-resident master table + device hot-row cache
+(parallel/host_table.py): host access semantics, the sharded Orbax
+round trip's no-full-materialization invariant, and the cache's
+hit/evict/write-back protocol."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.parallel import host_table as ht
+from hyperspace_tpu.telemetry import registry as telem
+
+
+@pytest.fixture
+def arr():
+    return np.random.default_rng(0).standard_normal(
+        (1003, 7)).astype(np.float32)
+
+
+def test_gather_write_back_match_dense_semantics(arr):
+    t = ht.HostEmbedTable.from_array(arr.copy(), shards=4)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 1003, 64)
+    assert np.array_equal(t.gather(ids), arr[ids])
+    rows = rng.standard_normal((64, 7)).astype(np.float32)
+    t.write_back(ids, rows)
+    ref = arr.copy()
+    ref[ids] = rows  # duplicate ids: last write wins in both
+    assert np.array_equal(t.to_array(), ref)
+
+
+def test_iter_chunks_covers_in_order_without_shard_crossing(arr):
+    t = ht.HostEmbedTable.from_array(arr.copy(), shards=3)
+    blocks = list(t.iter_chunks(100))
+    assert all(b.shape[0] <= 100 for _, b in blocks)
+    assert np.array_equal(np.concatenate([b for _, b in blocks]), arr)
+    starts = [s for s, _ in blocks]
+    assert starts == sorted(starts)
+
+
+def test_build_generates_shard_by_shard():
+    fill = lambda start, rows: np.full((rows, 3), start, np.float32)
+    t = ht.HostEmbedTable.build(1000, 3, fill, shard_rows=256)
+    assert t.num_shards == 4 and t.num_rows == 1000
+    # each row carries its shard's start offset — fill saw shard ranges
+    assert t.gather([0])[0, 0] == 0.0
+    assert t.gather([999])[0, 0] == t._starts[-2]
+
+
+def test_gather_rejects_out_of_range(arr):
+    t = ht.HostEmbedTable.from_array(arr.copy())
+    with pytest.raises(ValueError, match="out of range"):
+        t.gather([0, 1003])
+
+
+# --- sharded Orbax round trip (the satellite contract) ------------------------
+
+
+@pytest.mark.parametrize("save_shards,load_shards", [(4, 4), (4, 3),
+                                                     (4, 7), (3, 1)])
+def test_sharded_roundtrip_bounded_io(arr, tmp_path, save_shards,
+                                      load_shards):
+    """Save ``save_shards``-way, restore into ``load_shards`` ranges:
+    content identical, and the LARGEST single array the I/O path ever
+    touched stays <= N/min(shards) + pad — no full-table
+    materialization on one host, whatever the two shard counts."""
+    t = ht.HostEmbedTable.from_array(arr.copy(), shards=4)
+    ht.reset_io_peak()
+    t.save_sharded(str(tmp_path / "tab"), shards=save_shards)
+    t2 = ht.HostEmbedTable.load_sharded(str(tmp_path / "tab"),
+                                        shards=load_shards)
+    assert t2.num_shards == load_shards
+    assert np.array_equal(t2.to_array(), arr)
+    bound = -(-1003 // min(save_shards, load_shards)) + 1
+    assert 0 < ht.io_rows_peak() <= bound
+    # the per-host invariant holds for the RESTORED layout too
+    assert max(s.shape[0] for s in t2._shards) <= -(-1003 // load_shards)
+    # and it is surfaced as the documented gauge
+    assert telem.default_registry().snapshot()[
+        "host_table/io_rows_peak"] == ht.io_rows_peak()
+
+
+def test_load_rejects_unknown_format(arr, tmp_path):
+    t = ht.HostEmbedTable.from_array(arr.copy())
+    t.save_sharded(str(tmp_path / "tab"), shards=2)
+    import json
+    mpath = tmp_path / "tab" / ht.MANIFEST
+    meta = json.loads(mpath.read_text())
+    meta["version"] = 99
+    mpath.write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="format"):
+        ht.HostEmbedTable.load_sharded(str(tmp_path / "tab"))
+
+
+# --- device hot-row cache -----------------------------------------------------
+
+
+def test_cache_hits_skip_upload_and_evictions_are_lru(arr):
+    t = ht.HostEmbedTable.from_array(arr.copy(), shards=2)
+    c = ht.DeviceHotCache(t, 128)
+    reg = telem.default_registry()
+    base = reg.mark()
+    s1 = c.ensure(np.arange(100))
+    assert np.array_equal(c.fetch(s1), arr[:100])
+    d = reg.snapshot(baseline=base)
+    assert d.get("host_table/cache_misses") == 100
+    assert d.get("host_table/upload_rows") == 100
+    # 50 hits, 60 misses, eviction of the least-recent non-requested
+    base = reg.mark()
+    s2 = c.ensure(np.arange(50, 160))
+    assert np.array_equal(c.fetch(s2), arr[50:160])
+    d = reg.snapshot(baseline=base)
+    assert d.get("host_table/cache_hits") == 50
+    assert d.get("host_table/cache_misses") == 60
+    assert d.get("host_table/cache_evictions") == 32  # 128-cap overflow
+    # the hit rows kept their slots
+    assert np.array_equal(s1[50:], s2[:50])
+
+
+def test_cache_rejects_oversized_working_set(arr):
+    t = ht.HostEmbedTable.from_array(arr.copy())
+    c = ht.DeviceHotCache(t, 16)
+    with pytest.raises(ValueError, match="exceeds the hot-row cache"):
+        c.ensure(np.arange(17))
+
+
+def test_cache_ensure_with_rows_drops_stale_for_resident_ids(arr):
+    """The gather_ahead staleness bound: a prefetched row whose id
+    became resident since the gather must NOT overwrite the (at least
+    as fresh) cached value."""
+    t = ht.HostEmbedTable.from_array(arr.copy())
+    c = ht.DeviceHotCache(t, 64)
+    ids = np.arange(10)
+    slots = c.ensure(ids)
+    fresh = np.full((10, 7), 42.0, np.float32)
+    # simulate the chunk program updating the cache in place
+    c.array = c.array.at[np.asarray(slots)].set(fresh)
+    stale = t.gather(ids)  # gathered BEFORE the update landed
+    slots2 = c.ensure_with_rows(ids, stale, np.ones(10, bool))
+    assert np.array_equal(slots, slots2)
+    assert np.array_equal(c.fetch(slots2), fresh)  # stale rows dropped

@@ -1,0 +1,145 @@
+"""Host-resident planned-sparse training (train/host_embed.py): the
+bitwise contract vs the in-HBM packed trainer, eviction-pressure and
+sharded-master invariance, the gather_ahead overlap mode's bounded-
+staleness behavior, and the CLI wiring."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.data import wordnet
+from hyperspace_tpu.models import poincare_embed as pe
+from hyperspace_tpu.train import host_embed as he
+from hyperspace_tpu.telemetry import registry as telem
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return wordnet.synthetic_tree(depth=4, branching=3)
+
+
+def _cfg(ds, **kw):
+    kw.setdefault("dim", 8)
+    kw.setdefault("batch_size", 32)
+    kw.setdefault("neg_samples", 5)
+    return pe.PoincareEmbedConfig(num_nodes=ds.num_nodes, **kw)
+
+
+def _run_both(cfg, ds, steps, *, chunk_steps=4, seed=7, **trainer_kw):
+    state, opt = pe.init_state(cfg, 0)
+    tr = he.HostPlannedTrainer.from_state(cfg, opt, state,
+                                          chunk_steps=chunk_steps,
+                                          seed=seed, **trainer_kw)
+    losses_h = tr.run(ds.pairs, steps)
+    state2, opt2 = pe.init_state(cfg, 0)
+    st_i, losses_i = he.run_planned_inhbm(cfg, opt2, state2, ds.pairs,
+                                          steps, chunk_steps=chunk_steps,
+                                          seed=seed)
+    return tr, losses_h, st_i, losses_i
+
+
+@pytest.mark.parametrize("optname", ["rsgd", "radam"])
+def test_host_path_bitwise_matches_inhbm(ds, optname):
+    """The headline contract: sharded master + hot-row cache + remap-
+    to-slots + chunk write-back produce BITWISE the in-HBM packed
+    trajectory — losses, table, and (radam) both moment tables —
+    including a ragged tail chunk."""
+    cfg = _cfg(ds, optimizer=optname)
+    tr, lh, st_i, li = _run_both(cfg, ds, 19, shards=3)
+    assert np.array_equal(lh, li)
+    st_h = tr.to_state()
+    assert np.array_equal(np.asarray(st_h.table), np.asarray(st_i.table))
+    assert int(st_h.step) == int(st_i.step) == 19
+    if optname == "radam":
+        assert np.array_equal(np.asarray(st_h.opt_state.mu),
+                              np.asarray(st_i.opt_state.mu))
+        assert np.array_equal(np.asarray(st_h.opt_state.nu),
+                              np.asarray(st_i.opt_state.nu))
+
+
+def test_bitwise_survives_eviction_pressure():
+    """A cache much smaller than the table forces evictions and slot
+    reuse (unsorted remaps) — values must not move: the sync-gather
+    write-back protocol keeps every read current."""
+    big = wordnet.synthetic_tree(depth=5, branching=4)
+    cfg = pe.PoincareEmbedConfig(num_nodes=big.num_nodes, dim=8,
+                                 batch_size=16, neg_samples=5,
+                                 optimizer="radam")
+    reg = telem.default_registry()
+    base = reg.mark()
+    tr, lh, st_i, li = _run_both(cfg, big, 12, chunk_steps=2,
+                                 seed=3, shards=2, hot_rows=300)
+    d = reg.snapshot(baseline=base)
+    assert d.get("host_table/cache_evictions", 0) > 0, \
+        "the test must actually exercise eviction to prove anything"
+    assert d.get("host_table/cache_hits", 0) > 0
+    assert np.array_equal(lh, li)
+    assert np.array_equal(np.asarray(tr.to_state().table),
+                          np.asarray(st_i.table))
+
+
+def test_gather_ahead_trains_and_is_exact_at_full_capacity(ds):
+    """The overlap mode's contract: always finite and training; and at
+    ``hot_rows >= N`` (nothing ever evicted — every cached row is
+    current in place) it is EXACT again, prefetched gathers or not."""
+    cfg = _cfg(ds)
+    state, opt = pe.init_state(cfg, 0)
+    tr = he.HostPlannedTrainer.from_state(
+        cfg, opt, state, chunk_steps=4, seed=7,
+        hot_rows=ds.num_nodes, gather_ahead=True)
+    lh = tr.run(ds.pairs, 16)
+    assert np.all(np.isfinite(lh))
+    state2, opt2 = pe.init_state(cfg, 0)
+    _, li = he.run_planned_inhbm(cfg, opt2, state2, ds.pairs, 16,
+                                 chunk_steps=4, seed=7)
+    assert np.array_equal(lh, li)
+
+
+def test_chunk_plans_are_deterministic(ds):
+    cfg = _cfg(ds)
+    a = he.chunk_plan_np(cfg, np.asarray(ds.pairs), 4, seed=9,
+                         chunk_index=2)
+    b = he.chunk_plan_np(cfg, np.asarray(ds.pairs), 4, seed=9,
+                         chunk_index=2)
+    for x, y in zip(a, b):
+        assert np.array_equal(x, y)
+    c = he.chunk_plan_np(cfg, np.asarray(ds.pairs), 4, seed=9,
+                         chunk_index=3)
+    assert not np.array_equal(a[0], c[0])
+
+
+def test_trainer_validates_config(ds):
+    cfg = _cfg(ds)
+    state, opt = pe.init_state(cfg, 0)
+    with pytest.raises(ValueError, match="chunk_steps"):
+        he.HostPlannedTrainer.from_state(cfg, opt, state, chunk_steps=0)
+    bad = pe.PoincareEmbedConfig(num_nodes=ds.num_nodes + 1, dim=8)
+    master = None
+    p = pe.pack_state(cfg, pe.init_state(cfg, 0)[0])
+    from hyperspace_tpu.parallel.host_table import HostEmbedTable
+    master = HostEmbedTable.from_array(np.asarray(p.packed))
+    with pytest.raises(ValueError, match="num_nodes"):
+        he.HostPlannedTrainer(bad, opt, master, p.aux, p.key)
+    with pytest.raises(ValueError, match="mined"):
+        he.HostPlannedTrainer.from_state(
+            pe.PoincareEmbedConfig(num_nodes=ds.num_nodes, dim=8,
+                                   neg_mode="mined"),
+            opt, state)
+
+
+def test_cli_host_table_branch(ds, tmp_path):
+    """run_poincare's host branch: trains, evals, saves the sharded
+    master under ckpt_dir, and rejects the incompatible flags."""
+    from hyperspace_tpu.cli.train import RunConfig, run_poincare
+    from hyperspace_tpu.parallel.host_table import HostEmbedTable
+
+    run = RunConfig(steps=8, host_table=True, host_chunk_steps=4,
+                    ckpt_dir=str(tmp_path / "ck"))
+    res = run_poincare(run, {"dim": "8", "batch_size": "16"})
+    assert res["host_table"] and res["steps"] == 8
+    assert "map" in res and np.isfinite(res["map"])
+    restored = HostEmbedTable.load_sharded(
+        str(tmp_path / "ck" / "host_table"))
+    assert restored.num_rows > 0 and restored.width == 8  # rsgd: table
+    with pytest.raises(SystemExit, match="host_table"):
+        run_poincare(RunConfig(steps=4, host_table=True, scan_chunk=2),
+                     {"dim": "8"})
