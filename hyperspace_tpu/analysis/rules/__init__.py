@@ -18,6 +18,7 @@ from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
 from hyperspace_tpu.analysis.rules.retry import UnboundedRetryRule
 from hyperspace_tpu.analysis.rules.tracerleak import TracerLeakRule
+from hyperspace_tpu.analysis.rules.units import MetricUnitSuffixRule
 
 ALL_RULES = (
     RecompileHazardRule,
@@ -31,6 +32,7 @@ ALL_RULES = (
     MaterializedDistmatRule,
     FullTableMaterializationRule,
     PrecisionLiteralRule,
+    MetricUnitSuffixRule,
     TelemetryCatalogRule,
     FlagDocDriftRule,
 )

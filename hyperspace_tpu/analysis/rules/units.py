@@ -1,0 +1,96 @@
+"""metric-unit-suffix: duration/size metric names end in a unit suffix.
+
+Historical incident: the PR 7 histogram layer fixed a convention —
+values are MILLISECONDS — purely by call-site discipline, and the PR 2
+counter catalog already carries both ``jax/compile_s`` (seconds) and
+``serve/dispatch_ms`` (milliseconds).  A metric named ``serve/dispatch``
+or ``ckpt/save_time`` is a latent dashboard bug: the unit drift is
+invisible in code and only surfaces when a panel mixes seconds into a
+milliseconds axis (or bytes into rows) and misreads by 1000×.
+
+What fires (warning): an ``observe(`` / ``inc(`` / ``set_gauge(`` call
+whose literal name carries a **duration or size token** as an
+underscore-separated segment — durations: ``ms``/``msec``/``sec``/
+``secs``/``seconds``/``latency``/``duration``/``elapsed``/``wait``/
+``time``; sizes: ``bytes``/``byte``/``kb``/``mb``/``gb``/``rows``/
+``row`` — but does NOT end in one of the sanctioned unit suffixes
+``_ms`` / ``_s`` / ``_bytes`` / ``_rows`` (a bare final segment of
+``ms``/``s``/``bytes``/``rows`` after the last ``/`` also counts:
+``ckpt/bytes`` is fine).
+
+Names with no unit-smelling token never fire (``serve/requests``,
+``prefetch/queue_depth`` are counts and levels — unitless by nature);
+a unit-bearing name whose suffix names a STATISTIC instead
+(``host_table/io_rows_peak``) is suppressed at its line with a reason,
+the same accepted-hazard visibility contract as every other rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+
+_WRITE_FNS = {"inc", "set_gauge", "observe"}
+_UNIT_SUFFIXES = ("_ms", "_s", "_bytes", "_rows")
+# a bare unit as the final path segment (``ckpt/bytes``) is as good as
+# a suffixed one
+_UNIT_SEGMENTS = {"ms", "s", "bytes", "rows"}
+_DURATION_TOKENS = {"ms", "msec", "sec", "secs", "seconds", "latency",
+                    "duration", "elapsed", "wait", "time"}
+_SIZE_TOKENS = {"bytes", "byte", "kb", "mb", "gb", "rows", "row"}
+
+
+def _unit_smell(name: str):
+    """The (kind, token) this name smells of, or None."""
+    for seg in name.replace("/", "_").split("_"):
+        if seg in _DURATION_TOKENS:
+            return "duration", seg
+        if seg in _SIZE_TOKENS:
+            return "size", seg
+    return None
+
+
+def _has_unit_suffix(name: str) -> bool:
+    if name.endswith(_UNIT_SUFFIXES):
+        return True
+    return name.rsplit("/", 1)[-1] in _UNIT_SEGMENTS
+
+
+def _call_fn_name(node: ast.Call):
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+class MetricUnitSuffixRule(Rule):
+    id = "metric-unit-suffix"
+    severity = "warning"
+    summary = ("duration/size metric names missing a _ms/_s/_bytes/"
+               "_rows unit suffix — unit drift is invisible until a "
+               "dashboard misreads it")
+
+    def check_file(self, ctx: FileContext):
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and node.args
+                    and _call_fn_name(node) in _WRITE_FNS):
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                continue
+            name = first.value
+            smell = _unit_smell(name)
+            if smell is None or _has_unit_suffix(name):
+                continue
+            kind, token = smell
+            findings.append(self.finding(
+                ctx, node,
+                f"metric name {name!r} carries the {kind} token "
+                f"{token!r} but does not end in a unit suffix "
+                "(_ms/_s/_bytes/_rows) — name the unit or a dashboard "
+                "will misread it"))
+        return findings

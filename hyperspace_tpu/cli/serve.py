@@ -50,6 +50,7 @@ alongside every ``stats`` response.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import io
 import json
@@ -148,6 +149,29 @@ class ServeConfig:
     # 0 (default) = off; 1 = warm k= (the config's k); a comma list
     # ("5,10") warms those k values.
     prewarm: str = "0"
+    # --- observability plane (docs/observability.md "Live metrics,
+    # access log, and the flight recorder") ----------------------------
+    # serve-session JSONL (train-CLI record shapes): a run_manifest
+    # first record and a closing telemetry_summary, so read_jsonl
+    # tooling works on serve sessions too
+    log: str | None = None
+    # structured JSONL access log: one line per serve request —
+    # request_id, route, buckets, collator flush id, queue-wait/
+    # dispatch/e2e ms, cache hits, degrade level, taxonomy outcome
+    access_log: str | None = None
+    # rolling SLO window (telemetry/window.py): p50/p95/p99 + shed/
+    # deadline/error rates over the last N seconds from histogram ring
+    # deltas, surfaced in stats responses, /metrics, and the exit
+    # summary.  0 disables.
+    window_s: float = 60.0
+    # latency-aware degradation signal: with queue_max>0 and a window,
+    # a windowed e2e p99 past this many ms drives the ladder down even
+    # without queue pressure.  0 (default) = queue-depth-only.
+    slo_ms: float = 0.0
+    # flight recorder (serve/access.py): keep a bounded ring of recent
+    # access records and dump a timestamped incident JSONL here on
+    # typed-error bursts, degrade transitions, and SIGTERM drain
+    incident_dir: str | None = None
 
 
 def _ids(s: str, name: str) -> list[int]:
@@ -183,14 +207,40 @@ def _build(cfg: ServeConfig):
                                         nprobe=cfg.nprobe)
     except ValueError as e:  # bad scan_mode/chunk_rows/precision/nprobe
         raise SystemExit(str(e)) from None
+    # --- observability plane (ServeConfig docstrings): window, access
+    # log, flight recorder — all optional, wired into the batcher so
+    # every serving surface (stdin loop, one-shot query, front door)
+    # carries the same records
+    window = recorder = alog = sink = None
+    if cfg.window_s < 0:
+        raise SystemExit(f"window_s must be >= 0; got {cfg.window_s}")
+    if cfg.window_s:
+        from hyperspace_tpu.telemetry.window import SloWindow
+
+        window = SloWindow(cfg.window_s)
+    try:
+        if cfg.incident_dir:
+            from hyperspace_tpu.serve.access import FlightRecorder
+
+            recorder = FlightRecorder(cfg.incident_dir)
+        if cfg.access_log or recorder is not None:
+            from hyperspace_tpu.serve.access import AccessLog
+
+            alog = AccessLog(cfg.access_log, recorder=recorder)
+            sink = alog.emit
+    except OSError as e:  # uncreatable/unwritable path is a usage error
+        raise SystemExit(f"observability path: {e}") from None
     try:
         batcher = RequestBatcher(eng, min_bucket=cfg.min_bucket,
                                  max_bucket=cfg.max_bucket,
                                  cache_size=cfg.cache_size,
                                  queue_max=cfg.queue_max,
-                                 deadline_ms=cfg.deadline_ms)
-    except ValueError as e:  # bad queue_max/deadline_ms
+                                 deadline_ms=cfg.deadline_ms,
+                                 window=window, slo_ms=cfg.slo_ms,
+                                 access_sink=sink, recorder=recorder)
+    except ValueError as e:  # bad queue_max/deadline_ms/slo_ms
         raise SystemExit(str(e)) from None
+    batcher.access_log = alog  # closed by the serve-session bracket
     return eng, batcher
 
 
@@ -330,6 +380,74 @@ def _print_latency_stderr(baseline: dict | None = None) -> None:
         pass
 
 
+def _window_line(batcher) -> str | None:
+    """One-line rolling-window SLO summary (telemetry/window.py) — the
+    'latency NOW' complement of the cumulative ``_latency_line``; None
+    when no window is armed."""
+    w = getattr(batcher, "window", None)
+    if w is None:
+        return None
+    rep = w.report()
+    e = rep.get("e2e_ms")
+    if not e:
+        return "[serve] window: no requests in the current window"
+    return ("[serve] window %.1fs e2e_ms count=%d p50=%.3f p95=%.3f "
+            "p99=%.3f qps=%.2f shed/s=%.2f err/s=%.2f"
+            % (rep["window_s"], e["count"], e["p50"], e["p95"],
+               e["p99"], rep["rate_qps"], rep["shed_rate"],
+               rep["error_rate"]))
+
+
+def _print_window_stderr(batcher) -> None:
+    line = _window_line(batcher)
+    if line is None:
+        return
+    try:
+        print(line, file=sys.stderr, flush=True)
+    except (OSError, ValueError):
+        pass
+
+
+@contextlib.contextmanager
+def _serve_session(cfg: ServeConfig, batcher):
+    """The serve modes' observability bracket: with ``log=``, write the
+    train-CLI record shapes — a ``run_manifest`` FIRST record (the full
+    ServeConfig as executed + device/backend identity) and a closing
+    ``telemetry_summary`` scoped to this session by a registry mark —
+    so ``read_jsonl`` tooling reads serve sessions exactly like train
+    runs; always closes the access log on the way out.  Yields the
+    session mark (the latency one-liners' baseline)."""
+    from hyperspace_tpu.telemetry import registry as telem
+
+    mark = telem.default_registry().mark()
+    logger = None
+    try:
+        if cfg.log:
+            from hyperspace_tpu.train.logging import MetricsLogger
+            from hyperspace_tpu.train.loop import run_manifest
+
+            try:
+                logger = MetricsLogger(cfg.log, stdout=False)
+            except OSError as e:
+                # same usage-error mapping as access_log=/incident_dir=
+                # (and the access log opened by _build still closes —
+                # this raise unwinds through the finally below)
+                raise SystemExit(f"log={cfg.log}: {e}") from None
+            logger.event("run_manifest", **run_manifest(cfg))
+        yield mark
+    finally:
+        if logger is not None:
+            # summary must land even when the loop died — the session's
+            # counters matter most in a post-mortem (train-loop rule)
+            logger.event("telemetry_summary",
+                         **telem.default_registry().snapshot(
+                             "ctr/", baseline=mark))
+            logger.close()
+        alog = getattr(batcher, "access_log", None)
+        if alog is not None:
+            alog.close()
+
+
 def _json_bool(req: dict, key: str, default: bool) -> bool:
     """Strict JSON boolean: the string \"false\" must be an error, not
     truthy — same reject-don't-coerce policy as the id/k validation."""
@@ -353,26 +471,86 @@ def _req_deadline(req: dict):
     return float(v)
 
 
-def _handle(batcher, req: dict) -> dict:
+def _req_id(req: dict) -> str | None:
+    """The optional per-request ``request_id`` (strict: a string) —
+    the stdin loop's analog of the HTTP ``X-Request-Id`` header.  When
+    present it is threaded into the lifecycle/access log AND echoed in
+    the response line, so a client can join its requests to answers
+    over the one shared stdout stream."""
+    v = req.get("request_id")
+    if v is None:
+        return None
+    if not isinstance(v, str) or not v:
+        raise ValueError(
+            f"request_id must be a non-empty string, got {v!r}")
+    return v
+
+
+def _handle(batcher, req: dict, entered=None) -> dict:
+    """One request; ``entered`` (a 1-element list) is set True the
+    moment a batcher entry is invoked — past that point the batcher
+    owns the access log, before it the loop's error path must emit the
+    record itself (the HTTP server's ``entered`` contract)."""
     op = req.get("op")
+    rid = _req_id(req)
+    echo = {} if rid is None else {"request_id": rid}
     if op == "topk":
         # k passes through raw: the batcher rejects non-integers rather
         # than truncating (a float k must be a client error, not k-1)
-        idx, dist = batcher.topk(
-            req["ids"], req.get("k", 10),
-            exclude_self=_json_bool(req, "exclude_self", True),
-            deadline_ms=_req_deadline(req))
-        return {"neighbors": idx.tolist(), "dists": dist.tolist()}
+        ids, k = req["ids"], req.get("k", 10)
+        exclude_self = _json_bool(req, "exclude_self", True)
+        deadline_ms = _req_deadline(req)
+        if entered is not None:
+            entered[0] = True
+        idx, dist = batcher.topk(ids, k, exclude_self=exclude_self,
+                                 deadline_ms=deadline_ms, request_id=rid)
+        return {"neighbors": idx.tolist(), "dists": dist.tolist(), **echo}
     if op == "score":
-        scores = batcher.score(req["u"], req["v"],
-                               prob=_json_bool(req, "prob", False),
-                               fd_r=float(req.get("fd_r", 2.0)),
-                               fd_t=float(req.get("fd_t", 1.0)),
-                               deadline_ms=_req_deadline(req))
-        return {"scores": scores.tolist()}
+        u, v = req["u"], req["v"]
+        prob = _json_bool(req, "prob", False)
+        fd_r = float(req.get("fd_r", 2.0))
+        fd_t = float(req.get("fd_t", 1.0))
+        deadline_ms = _req_deadline(req)
+        if entered is not None:
+            entered[0] = True
+        scores = batcher.score(u, v, prob=prob, fd_r=fd_r, fd_t=fd_t,
+                               deadline_ms=deadline_ms, request_id=rid)
+        return {"scores": scores.tolist(), **echo}
     if op == "stats":
-        return batcher.stats()
+        # stats echoes too: a pipelined client must be able to join
+        # EVERY answered line, scrape ops included
+        return {**batcher.stats(), **echo}
     raise ValueError(f"unknown op {op!r} (want topk|score|stats)")
+
+
+def _loop_access(batcher, req, outcome: str) -> None:
+    """Access-log a loop failure that never reached the batcher — the
+    HTTP server's ``_serve_access`` analog for the stdin surface
+    (parse errors, non-object lines, unknown ops, missing/malformed
+    pre-dispatch fields).  The batcher emits for everything past its
+    entry, so this covers exactly the complement: no double lines,
+    and a malformed-line storm still feeds ``serve/errors``, the
+    window's error rate, and the flight recorder's burst detector."""
+    op = "none"
+    rid = None
+    if isinstance(req, dict):
+        if isinstance(req.get("op"), str):
+            op = req["op"]
+        v = req.get("request_id")
+        if isinstance(v, str) and v:
+            rid = v
+    batcher.emit_synthetic_access(op, request_id=rid, outcome=outcome)
+
+
+def _echo_error_rid(resp: dict, req) -> dict:
+    """Echo a well-formed ``request_id`` on ERROR responses too — a
+    client pipelining requests over the one stdout stream must be able
+    to join failures to requests, not only successes."""
+    if isinstance(req, dict):
+        rid = req.get("request_id")
+        if isinstance(rid, str) and rid:
+            return {**resp, "request_id": rid}
+    return resp
 
 
 def run_serve(cfg: ServeConfig, *, stdin=None, stdout=None) -> dict:
@@ -418,9 +596,11 @@ def run_serve(cfg: ServeConfig, *, stdin=None, stdout=None) -> dict:
                                      lambda _s, _f: draining.set())
     except ValueError:
         pass  # not the main thread: no drain hook, loop still serves
-    # session baseline: the latency one-liners report the distribution
-    # of THIS serve loop, not the whole process (library/test reuse)
-    session_mark = telem.default_registry().mark()
+    # session bracket: log= parity records + access-log close; the
+    # yielded mark is the latency one-liners' baseline (the
+    # distribution of THIS serve loop, not the whole process)
+    session = _serve_session(cfg, batcher)
+    session_mark = session.__enter__()
     try:
         for line in _line_source(stdin, draining):
             if draining.is_set():
@@ -429,6 +609,8 @@ def run_serve(cfg: ServeConfig, *, stdin=None, stdout=None) -> dict:
             if not line:
                 continue
             is_stats = False
+            req = None
+            entered = [False]  # past a batcher entry, it owns the log
             try:
                 try:
                     req = json.loads(line)
@@ -438,11 +620,12 @@ def run_serve(cfg: ServeConfig, *, stdin=None, stdout=None) -> dict:
                     raise ValueError(
                         f"request must be a JSON object, "
                         f"got {type(req).__name__}")
-                resp = _handle(batcher, req)
+                resp = _handle(batcher, req, entered)
                 served += 1
                 is_stats = req.get("op") == "stats"
             except _ParseError as e:
                 resp = {"error": {"kind": "parse", "message": str(e)}}
+                _loop_access(batcher, req, "parse")
             except (ServeError, ValueError, KeyError, TypeError,
                     OverflowError, OSError) as e:
                 # OverflowError: numpy raises it for ints past the cast
@@ -456,11 +639,18 @@ def run_serve(cfg: ServeConfig, *, stdin=None, stdout=None) -> dict:
                 # classes, and everything else (-> internal) onto the
                 # taxonomy
                 resp = error_response(e)
+                if not entered[0]:
+                    # the failure never reached the batcher: the loop
+                    # must write the access record itself
+                    _loop_access(batcher, req, resp["error"]["kind"])
+            if "error" in resp:
+                resp = _echo_error_rid(resp, req)
             print(json.dumps(_json_safe(resp)), file=stdout, flush=True)
             if is_stats:
                 # the latency one-liner rides on stderr beside the stats
                 # response — stdout stays one response per line
                 _print_latency_stderr(session_mark)
+                _print_window_stderr(batcher)
     finally:
         if prev_handler is not None:
             signal.signal(signal.SIGTERM, prev_handler)
@@ -471,9 +661,17 @@ def run_serve(cfg: ServeConfig, *, stdin=None, stdout=None) -> dict:
                       flush=True)
             except (OSError, ValueError):
                 pass  # diagnostics never sink the drain
+            if batcher.recorder is not None:
+                # SIGTERM is a flight-recorder trigger on the stdin
+                # path too — shutdown leaves the same evidence the
+                # front door's drain does (wait: the process exits next)
+                batcher.recorder.dump("sigterm_drain", _cls="drain",
+                                      wait=True)
         # the closing summary must survive an engine-level crash — the
         # accumulated distribution matters most in a post-mortem
         _print_latency_stderr(session_mark)
+        _print_window_stderr(batcher)
+        session.__exit__(None, None, None)
     return {"mode": "serve", "served": served,
             "drained": draining.is_set(), **batcher.stats()}
 
@@ -506,17 +704,19 @@ def run_serve_http(cfg: ServeConfig, *, ready=None) -> dict:
         if ready is not None:
             ready(host, port)
 
-    try:
-        result = asyncio.run(run_front_door(
-            batcher, host=cfg.host, port=cfg.port,
-            max_wait_us=cfg.max_wait_us, ready=announce,
-            prewarm_ks=prewarm_ks))
-    except ValueError as e:  # prewarm k out of range for this table
-        raise SystemExit(f"prewarm: {e}") from None
-    except OSError as e:  # bind failure (port in use, bad host): usage
-        raise SystemExit(
-            f"serve-http: cannot bind {cfg.host}:{cfg.port} — {e}"
-        ) from None
+    with _serve_session(cfg, batcher):
+        try:
+            result = asyncio.run(run_front_door(
+                batcher, host=cfg.host, port=cfg.port,
+                max_wait_us=cfg.max_wait_us, ready=announce,
+                prewarm_ks=prewarm_ks))
+        except ValueError as e:  # prewarm k out of range for this table
+            raise SystemExit(f"prewarm: {e}") from None
+        except OSError as e:  # bind failure (port in use, bad host): usage
+            raise SystemExit(
+                f"serve-http: cannot bind {cfg.host}:{cfg.port} — {e}"
+            ) from None
+        _print_window_stderr(batcher)
     return {"mode": "serve_http", **result, **batcher.stats()}
 
 

@@ -99,6 +99,13 @@ class RunConfig:
     # write a Chrome/Perfetto trace_events JSON of the host spans here
     # (implies span recording even without telemetry=1)
     trace_out: str | None = None
+    # write the counter registry as a Prometheus text-format snapshot
+    # here every metrics_every= seconds (telemetry/exposition.py,
+    # docs/observability.md "Live metrics"): atomic write-then-rename,
+    # so a node exporter's textfile collector makes the training job
+    # scrapeable with no port open.  Off (default) constructs nothing.
+    metrics_out: str | None = None
+    metrics_every: float = 30.0
     # >0: sample the on-device numerical-health stats every N chunks
     # (telemetry/health.py): ball boundary margin, hyperboloid
     # constraint residual, nonfinite counts — logged as health/* records
@@ -765,6 +772,10 @@ def main(argv: list[str] | None = None) -> int:
         precision_mod.get_policy(run.precision)
     except ValueError as e:  # a typo'd preset is a usage error
         raise SystemExit(str(e)) from None
+    if run.metrics_out and run.metrics_every <= 0:
+        raise SystemExit(
+            f"metrics_every={run.metrics_every}: want a positive "
+            "snapshot cadence in seconds")
     try:
         # BEFORE any workload compile: every executable this run builds
         # should land in (or come from) the persistent cache

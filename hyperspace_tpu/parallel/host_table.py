@@ -80,14 +80,14 @@ def io_rows_peak() -> int:
 def reset_io_peak() -> None:
     global _io_rows_peak
     _io_rows_peak = 0
-    _telem.set_gauge("host_table/io_rows_peak", 0)
+    _telem.set_gauge("host_table/io_rows_peak", 0)  # hyperlint: disable=metric-unit-suffix — a peak ROW COUNT: the unit segment is mid-name, the suffix names the statistic
 
 
 def _track_io_rows(rows: int) -> None:
     global _io_rows_peak
     if rows > _io_rows_peak:
         _io_rows_peak = rows
-        _telem.set_gauge("host_table/io_rows_peak", rows)
+        _telem.set_gauge("host_table/io_rows_peak", rows)  # hyperlint: disable=metric-unit-suffix — a peak ROW COUNT: the unit segment is mid-name, the suffix names the statistic
 
 
 def _shard_bounds(num_rows: int, shards: int) -> np.ndarray:

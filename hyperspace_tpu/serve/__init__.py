@@ -18,6 +18,10 @@ answering retrieval queries (docs/serving.md):
                hysteresis degradation ladder (docs/resilience.md)
   errors.py    the typed error taxonomy (`error.kind`: parse /
                validation / deadline_exceeded / overloaded / internal)
+  access.py    request-addressable observability: request ids, the
+               structured JSONL access log, and the flight recorder
+               (bounded ring + incident dumps on error bursts /
+               degrade transitions / drain)
   collator.py  continuous-batching collator: fill a power-of-two bucket
                or flush at the max-wait deadline, one shared dispatch
                per flush through a single dispatch executor
@@ -29,6 +33,11 @@ answering retrieval queries (docs/serving.md):
                points
 """
 
+from hyperspace_tpu.serve.access import (  # noqa: F401
+    AccessLog,
+    FlightRecorder,
+    new_request_id,
+)
 from hyperspace_tpu.serve.artifact import (  # noqa: F401
     ServingArtifact,
     export_artifact,

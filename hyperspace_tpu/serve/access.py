@@ -1,0 +1,239 @@
+"""Access log + flight recorder: per-request records that survive.
+
+PR 11's front door answers requests; nothing ties one HTTP request to
+the collator flush, engine dispatch, and taxonomy outcome that served
+it — a 504 is a counter tick, not an attributable event.  This module
+is the request-addressable half of the observability plane:
+
+- **Request ids** (:func:`new_request_id`): accept-or-generate per
+  request (the HTTP server reads ``X-Request-Id``; the stdin loop a
+  ``request_id`` field), threaded through the batcher/collator
+  lifecycles into span args, echoed in the response, and stamped on
+  the access record — the Dapper-style join key.
+- :class:`AccessLog` — one structured JSONL line per request
+  (``access_log=`` on the serve CLIs): request id, route, buckets
+  dispatched, collator flush id, queue-wait/dispatch/e2e ms, cache
+  hits/misses, degrade level, taxonomy outcome.  Thread-safe,
+  line-buffered appends (the crashed-run prefix survives, same as the
+  train JSONL); ``train/logging.read_jsonl`` reads it.
+- :class:`FlightRecorder` — a bounded in-memory ring of the most
+  recent access records.  On a **typed-error burst** (``burst_n``
+  errors within ``burst_s`` seconds), a **degrade transition**, or
+  **SIGTERM drain**, the ring plus a full counter snapshot dump to a
+  timestamped incident JSONL under ``incident_dir=`` — a 429 storm or
+  a rollback leaves evidence, not just monotone counters.  Dumps are
+  cooldown-limited (one per ``cooldown_s`` per reason class) so a
+  sustained storm writes one incident, not one per request.
+
+Both are **off by default** and cost nothing when off: the batcher
+holds a ``None`` sink and skips record assembly entirely
+(``serve/batcher.py``).  ``serve/incidents`` counts dumps;
+``serve/errors`` (bumped by the serving surfaces per error answer)
+feeds the window's error rate.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Optional
+
+from hyperspace_tpu.telemetry import registry as telem
+
+DEFAULT_RING = 512
+DEFAULT_BURST_N = 10
+DEFAULT_BURST_S = 5.0
+DEFAULT_COOLDOWN_S = 30.0
+
+
+def new_request_id() -> str:
+    """A fresh 16-hex request id (uuid4-derived — unique enough to join
+    a response, an access-log line, and a flush id across hosts)."""
+    return uuid.uuid4().hex[:16]
+
+
+class AccessLog:
+    """Append-only JSONL access log, thread-safe.
+
+    ``emit(record)`` stamps ``ts`` (wall clock — log lines are joined
+    with external systems, unlike the perf_counter lifecycle stamps),
+    writes one line, and feeds the optional :class:`FlightRecorder`.
+    Non-serializable values degrade per-record to ``repr`` — an odd
+    field must never cost the request or the line."""
+
+    def __init__(self, path: Optional[str] = None, *,
+                 recorder: Optional["FlightRecorder"] = None):
+        self._f = None
+        self.path = path
+        self.recorder = recorder
+        self._lock = threading.Lock()
+        self.lines = 0
+        if path:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            self._f = open(path, "a", buffering=1, encoding="utf-8")
+
+    def emit(self, record: dict) -> None:
+        record = dict(record)
+        record.setdefault("ts", time.time())
+        try:
+            line = json.dumps(record)
+        except (TypeError, ValueError):
+            line = json.dumps({k: v if _jsonable(v) else repr(v)
+                               for k, v in record.items()})
+        if self._f is not None:
+            with self._lock:
+                # re-checked INSIDE the lock: a concurrent close() may
+                # have nulled the handle between the fast-path check
+                # and acquiring the lock — a shutdown race must drop
+                # the line, never raise into a live request
+                if self._f is not None:
+                    self._f.write(line + "\n")
+                    self.lines += 1
+        if self.recorder is not None:
+            self.recorder.record(record)
+
+    def close(self) -> None:
+        if self._f is not None:
+            with self._lock:
+                self._f.close()
+                self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _jsonable(v) -> bool:
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+class FlightRecorder:
+    """Bounded ring of recent access records + incident dumps.
+
+    Triggers (module docstring): :meth:`record` feeds the ring and the
+    error-burst detector (any record whose ``outcome`` is not ``ok``);
+    :meth:`note_degrade` fires on ladder transitions;
+    callers invoke :meth:`dump` directly for drain/SIGTERM.  A dump
+    writes ``incident_<utc-stamp>_<reason>.jsonl``: one header line
+    (``event: incident``, the reason, and a full counter/gauge
+    snapshot — the counter marks) followed by the ring's records,
+    oldest first."""
+
+    def __init__(self, incident_dir: str, *, capacity: int = DEFAULT_RING,
+                 burst_n: int = DEFAULT_BURST_N,
+                 burst_s: float = DEFAULT_BURST_S,
+                 cooldown_s: float = DEFAULT_COOLDOWN_S):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1; got {capacity}")
+        if burst_n < 1 or burst_s <= 0:
+            raise ValueError(
+                f"bad burst spec n={burst_n} within {burst_s}s")
+        self.incident_dir = incident_dir
+        os.makedirs(incident_dir, exist_ok=True)
+        self.burst_n = int(burst_n)
+        self.burst_s = float(burst_s)
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(
+            maxlen=int(capacity))
+        self._error_ts: collections.deque = collections.deque(
+            maxlen=int(burst_n))
+        self._last_dump: dict[str, float] = {}  # reason class -> t
+        self._writers: list[threading.Thread] = []
+        self.dumps: list[str] = []
+
+    def record(self, record: dict) -> None:
+        outcome = record.get("outcome", "ok")
+        now = time.monotonic()
+        with self._lock:
+            self._ring.append(dict(record))
+            if outcome == "ok":
+                return
+            self._error_ts.append(now)
+            burst = (len(self._error_ts) == self.burst_n
+                     and now - self._error_ts[0] <= self.burst_s)
+        if burst:
+            self.dump(f"error_burst_{outcome}", _cls="error_burst")
+
+    def note_degrade(self, old: int, new: int) -> None:
+        """Ladder transition hook (both directions — a recovery's ring
+        shows what the degraded interval looked like)."""
+        self.dump(f"degrade_{old}_to_{new}", _cls="degrade")
+
+    def dump(self, reason: str, _cls: Optional[str] = None,
+             wait: bool = False) -> Optional[str]:
+        """Snapshot the ring and hand the file write to a background
+        thread; returns the incident path (None when the reason class
+        is inside its cooldown).  The triggers fire on the SERVING
+        path — burst detection inside a request coroutine on the
+        asyncio event loop, degrade transitions inside ``_admit`` —
+        and a synchronous multi-hundred-line write to a contended disk
+        there would stall every in-flight request (the exact hazard
+        the ``blocking-call-in-async`` lint documents).  Only the
+        in-memory snapshot + thread handoff happen in the caller;
+        ``wait=True`` (the drain paths — the process is about to exit)
+        joins the write.  Write failures drop the file silently —
+        evidence loss only, never a serving failure; the path lands in
+        :attr:`dumps` (and ``serve/incidents`` ticks) only once the
+        write succeeded."""
+        cls = _cls or reason
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_dump.get(cls)
+            if last is not None and now - last < self.cooldown_s:
+                return None
+            self._last_dump[cls] = now
+            records = list(self._ring)
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in reason)
+        path = os.path.join(self.incident_dir,
+                            f"incident_{stamp}_{safe}.jsonl")
+        header = {"event": "incident", "reason": reason,
+                  "ts": time.time(), "ring_len": len(records),
+                  "counters": telem.default_registry().snapshot("ctr/")}
+        t = threading.Thread(target=self._write_dump,
+                             args=(path, header, records),
+                             name="flightrec-dump", daemon=True)
+        with self._lock:
+            self._writers = [w for w in self._writers if w.is_alive()]
+            self._writers.append(t)
+        t.start()
+        if wait:
+            t.join()
+        return path
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait for outstanding incident writes (tests; shutdown)."""
+        with self._lock:
+            writers = list(self._writers)
+        for t in writers:
+            t.join(timeout)
+
+    def _write_dump(self, path: str, header: dict,
+                    records: list) -> None:
+        try:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(header) + "\n")
+                for rec in records:
+                    try:
+                        f.write(json.dumps(rec) + "\n")
+                    except (TypeError, ValueError):
+                        f.write(json.dumps(
+                            {k: v if _jsonable(v) else repr(v)
+                             for k, v in rec.items()}) + "\n")
+        except OSError:
+            return  # evidence loss only, never a serving failure
+        telem.inc("serve/incidents")
+        self.dumps.append(path)

@@ -98,9 +98,11 @@ from typing import Optional, Sequence
 import numpy as np
 
 from hyperspace_tpu.resilience import faults
+from hyperspace_tpu.serve.access import new_request_id
 from hyperspace_tpu.serve.engine import QueryEngine
 from hyperspace_tpu.serve.errors import (DeadlineExceededError,
-                                         OverloadedError)
+                                         OverloadedError, ServeError,
+                                         kind_of)
 from hyperspace_tpu.telemetry import registry as telem
 from hyperspace_tpu.telemetry.trace import span, tracing
 
@@ -219,13 +221,27 @@ class _Lifecycle:
     """
 
     __slots__ = ("t_enq", "t_form", "info", "buckets_used",
-                 "dispatch_s", "t_deadline")
+                 "dispatch_s", "t_deadline", "op", "request_id",
+                 "flush_id", "cache_hits", "cache_misses", "t_done")
 
     def __init__(self, op: str, deadline_ms: Optional[float] = None,
-                 t_enq: Optional[float] = None):
+                 t_enq: Optional[float] = None,
+                 request_id: Optional[str] = None):
         self.t_enq = time.perf_counter() if t_enq is None else t_enq
         self.t_form = self.t_enq
+        self.op = op
+        # request-tracing fields (docs/observability.md "Live metrics,
+        # access log, and the flight recorder"): the id joins the
+        # response, the access-log line, the span args, and the
+        # collator flush that served the request
+        self.request_id = request_id
+        self.flush_id: Optional[int] = None
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.t_done: Optional[float] = None
         self.info: Optional[dict] = {"op": op} if tracing() else None
+        if self.info is not None and request_id is not None:
+            self.info["request_id"] = request_id
         self.buckets_used: list = []
         self.dispatch_s = 0.0
         # absolute expiry on the same monotonic clock as the stamps;
@@ -258,11 +274,33 @@ class _Lifecycle:
     def finish(self) -> None:
         if self.info is not None:
             self.info["buckets"] = self.buckets_used
+        self.t_done = time.perf_counter()
         telem.observe("serve/queue_wait_ms", (self.t_form - self.t_enq) * 1e3)
         if self.buckets_used:
             telem.observe("serve/dispatch_ms", self.dispatch_s * 1e3)
-        telem.observe("serve/e2e_ms",
-                      (time.perf_counter() - self.t_enq) * 1e3)
+        telem.observe("serve/e2e_ms", (self.t_done - self.t_enq) * 1e3)
+
+    def access_record(self, outcome: str, degrade_level: int) -> dict:
+        """One structured access-log line's payload (serve/access.py):
+        the request id joined to its route, buckets, flush id, latency
+        decomposition, cache outcome, degrade level, and taxonomy
+        outcome.  Failed requests (no ``finish()``) still carry their
+        elapsed time — a 504 must be attributable to the flush that
+        missed its deadline."""
+        end = self.t_done if self.t_done is not None else time.perf_counter()
+        return {
+            "request_id": self.request_id,
+            "route": self.op,
+            "outcome": outcome,
+            "bucket": list(self.buckets_used),
+            "flush_id": self.flush_id,
+            "queue_wait_ms": round((self.t_form - self.t_enq) * 1e3, 3),
+            "dispatch_ms": round(self.dispatch_s * 1e3, 3),
+            "e2e_ms": round((end - self.t_enq) * 1e3, 3),
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+            "degrade_level": degrade_level,
+        }
 
 
 class _Admission:
@@ -324,7 +362,9 @@ class RequestBatcher:
                  queue_max: int = 0,
                  deadline_ms: float = 0.0,
                  ladder_high: float = 0.75, ladder_low: float = 0.25,
-                 ladder_down_after: int = 1, ladder_up_after: int = 8):
+                 ladder_down_after: int = 1, ladder_up_after: int = 8,
+                 window=None, slo_ms: float = 0.0,
+                 access_sink=None, recorder=None):
         self.engine = engine
         self.buckets = bucket_sizes(min_bucket, max_bucket)
         self.cache = _LRU(cache_size)
@@ -333,7 +373,20 @@ class RequestBatcher:
         if deadline_ms < 0:
             raise ValueError(
                 f"deadline_ms must be >= 0; got {deadline_ms}")
+        if slo_ms < 0:
+            raise ValueError(f"slo_ms must be >= 0; got {slo_ms}")
         self.default_deadline_ms = float(deadline_ms) or None
+        # --- observability plane (docs/observability.md "Live metrics,
+        # access log, and the flight recorder"), all None/0 = off at
+        # zero cost: `window` is a telemetry.window.SloWindow (ticked
+        # per completed request; surfaces in stats()), `slo_ms` arms
+        # the ladder's latency-aware pressure signal, `access_sink` is
+        # a callable taking one access record (serve.access.AccessLog.
+        # emit), `recorder` a FlightRecorder fed degrade transitions
+        self.window = window
+        self.slo_ms = float(slo_ms)
+        self.access_sink = access_sink
+        self.recorder = recorder
         self._admission = None
         self._ladder = None
         self._modes: list = [None]
@@ -347,31 +400,86 @@ class RequestBatcher:
                 down_after=ladder_down_after, up_after=ladder_up_after,
                 on_change=self._on_ladder_change)
 
-    @staticmethod
-    def _on_ladder_change(old: int, new: int) -> None:
+    def _on_ladder_change(self, old: int, new: int) -> None:
         if new > old:
             telem.inc("serve/degraded")
         else:
             telem.inc("serve/degrade_recovered")
         telem.set_gauge("serve/degrade_level", new)
+        if self.recorder is not None:
+            # a degrade transition is an incident trigger: the flight
+            # recorder dumps the ring so the storm that caused it (or
+            # the interval a recovery closes) leaves evidence
+            self.recorder.note_degrade(old, new)
 
     def _admit(self) -> None:
         """Admission gate: shed with ``overloaded`` when the bounded
-        queue is full; feed the ladder the post-admit occupancy."""
+        queue is full; feed the ladder the post-admit occupancy — or,
+        with ``slo_ms`` + a window armed, the latency pressure when it
+        is the worse signal (a server slow without queueing must still
+        walk the ladder down)."""
         if self._admission is None:
             return
         occ = self._admission.try_admit()
         if occ is None:
-            telem.inc("serve/shed")
+            # serve/shed ticks in emit_access (every overloaded answer
+            # is a shed — admission, cache-only, drain alike; counting
+            # here too would double-count this path)
             self._ladder.observe(1.0)
             raise OverloadedError(
                 "admission queue full "
                 f"(queue_max={self._admission.queue_max})")
+        if self.window is not None and self.slo_ms > 0:
+            occ = max(occ, self.window.latency_pressure(self.slo_ms))
         self._ladder.observe(occ)
 
     def _release(self) -> None:
         if self._admission is not None:
             self._admission.release()
+
+    def emit_access(self, life: _Lifecycle, outcome: str = "ok") -> None:
+        """One request is DONE (any outcome): tick the SLO window,
+        count taxonomy errors (parse/validation/internal — shed and
+        deadline keep their own counters, so the window's three rates
+        never double-count), and emit the access record when a sink is
+        armed.  Shared by the sync paths here and the collator — the
+        record-assembly contract lives once."""
+        if self.window is not None:
+            self.window.tick()
+        if outcome == "overloaded":
+            # EVERY overloaded answer is a shed — the admission queue,
+            # cache-only degradation misses, drain refusals, degraded
+            # under-filled probes.  Counting only the admission site
+            # left the window's shed_rate reading 0.0 during exactly
+            # the cache-only state degradation exists to expose; every
+            # overloaded outcome funnels through here exactly once.
+            telem.inc("serve/shed")
+        elif outcome not in ("ok", "deadline_exceeded"):
+            telem.inc("serve/errors")
+        if self.access_sink is None:
+            return
+        level = self._ladder.level if self._ladder is not None else 0
+        try:
+            self.access_sink(life.access_record(outcome, level))
+        except OSError:
+            pass  # a full disk is evidence loss, never a request failure
+
+    def emit_synthetic_access(self, op: str, *,
+                              request_id: Optional[str] = None,
+                              outcome: str = "ok",
+                              t_enq: Optional[float] = None) -> None:
+        """Access-account a request that never got a real lifecycle —
+        the serving surfaces' entry point for failures upstream of the
+        batcher (HTTP framing/parse/route errors, stdin pre-dispatch
+        failures).  With a sink armed and no id, one is generated (a
+        record is never anonymous).  Keeping this here — rather than
+        having both surfaces construct bare ``_Lifecycle`` objects —
+        pins the synthetic-record contract to the class that owns the
+        real one."""
+        if request_id is None and self.access_sink is not None:
+            request_id = new_request_id()
+        self.emit_access(_Lifecycle(op, t_enq=t_enq,
+                                    request_id=request_id), outcome)
 
     def _mode(self):
         """Current quality mode: ``None`` (full), an int nprobe
@@ -379,6 +487,12 @@ class RequestBatcher:
         if self._ladder is None:
             return None
         return self._modes[self._ladder.level]
+
+    @property
+    def degrade_level(self) -> int:
+        """Current degradation-ladder level (0 = full quality, also
+        when no ladder is armed) — the healthz/access-log field."""
+        return self._ladder.level if self._ladder is not None else 0
 
     # --- startup prewarm (docs/serving.md "Warm starts") ----------------------
 
@@ -596,25 +710,40 @@ class RequestBatcher:
 
     def topk(self, ids, k: int, *, exclude_self: bool = True,
              deadline_ms: Optional[float] = None,
-             t_enq: Optional[float] = None
+             t_enq: Optional[float] = None,
+             request_id: Optional[str] = None
              ) -> tuple[np.ndarray, np.ndarray]:
         """``(neighbors [B, k] int32, dists [B, k] float)`` in request
         order; cache-aware, bucket-padded.  ``deadline_ms`` overrides
         the batcher default for this request (None = the default;
         module docstring, "Overload safety"); ``t_enq`` backdates the
         enqueue stamp to an earlier ``time.perf_counter()`` reading
-        (socket-accept time — queue time counts against the deadline)."""
+        (socket-accept time — queue time counts against the deadline).
+        ``request_id`` threads the caller's trace id into the span args
+        and the access log; with a sink armed and no id given, one is
+        generated — an access-log line is never anonymous."""
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
-        life = _Lifecycle("topk", deadline_ms, t_enq=t_enq)
+        if request_id is None and self.access_sink is not None:
+            request_id = new_request_id()
+        life = _Lifecycle("topk", deadline_ms, t_enq=t_enq,
+                          request_id=request_id)
         telem.inc("serve/requests")
-        self._admit()
+        try:
+            self._admit()
+        except OverloadedError:
+            # shed at admission: not admitted, so no _release — but the
+            # shed IS a taxonomy outcome the access log must carry
+            self.emit_access(life, "overloaded")
+            raise
         try:
             with span("query", args=life.info):
                 ids, k = self.validate_topk_request(ids, k)
                 keyf, nprobe_ov, cache_only = self.plan_topk(
                     k, exclude_self)
                 rows, misses = self.cache_pass(ids, keyf, cache_only)
+                life.cache_hits = len(rows)
+                life.cache_misses = len(misses)
                 # batch-form stamp: validation + cache pass done, device
                 # work (if any) starts now
                 life.formed()
@@ -634,7 +763,15 @@ class RequestBatcher:
                 # rows stay cached — the work is not wasted)
                 life.check_deadline("at completion")
                 life.finish()
+                self.emit_access(life)
                 return out_i, out_d
+        except (ServeError, ValueError, KeyError, TypeError,
+                OverflowError, OSError) as e:
+            # kind_of is the one exception->taxonomy classification
+            # (serve/errors.py): the access-log outcome can never
+            # diverge from the wire response's kind
+            self.emit_access(life, kind_of(e))
+            raise
         finally:
             self._release()
 
@@ -689,17 +826,26 @@ class RequestBatcher:
     def score(self, u_ids, v_ids, *, prob: bool = False,
               fd_r: float = 2.0, fd_t: float = 1.0,
               deadline_ms: Optional[float] = None,
-              t_enq: Optional[float] = None) -> np.ndarray:
+              t_enq: Optional[float] = None,
+              request_id: Optional[str] = None) -> np.ndarray:
         """Bucket-padded ``engine.score_edges`` ([B] in request order).
 
-        Same admission/deadline contract as :meth:`topk`; edge scoring
-        is uncached, so the cache-only degradation level sheds every
-        score request (an uncached op has nothing cheaper to serve)."""
+        Same admission/deadline/request-id contract as :meth:`topk`;
+        edge scoring is uncached, so the cache-only degradation level
+        sheds every score request (an uncached op has nothing cheaper
+        to serve)."""
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
-        life = _Lifecycle("score", deadline_ms, t_enq=t_enq)
+        if request_id is None and self.access_sink is not None:
+            request_id = new_request_id()
+        life = _Lifecycle("score", deadline_ms, t_enq=t_enq,
+                          request_id=request_id)
         telem.inc("serve/requests")
-        self._admit()
+        try:
+            self._admit()
+        except OverloadedError:
+            self.emit_access(life, "overloaded")
+            raise
         try:
             with span("query", args=life.info):
                 if self._mode() == _CACHE_ONLY:
@@ -716,7 +862,15 @@ class RequestBatcher:
                                           deadline_life=life)
                 life.check_deadline("at completion")
                 life.finish()
+                self.emit_access(life)
                 return out
+        except (ServeError, ValueError, KeyError, TypeError,
+                OverflowError, OSError) as e:
+            # kind_of is the one exception->taxonomy classification
+            # (serve/errors.py): the access-log outcome can never
+            # diverge from the wire response's kind
+            self.emit_access(life, kind_of(e))
+            raise
         finally:
             self._release()
 
@@ -776,7 +930,14 @@ class RequestBatcher:
                           if self._admission else 0),
             "shed": reg.get("serve/shed"),
             "deadline_exceeded": reg.get("serve/deadline_exceeded"),
+            "errors": reg.get("serve/errors"),
             "degrade_level": (self._ladder.level if self._ladder else 0),
             "degrade_mode": ("full" if self._mode() is None
                              else str(self._mode())),
+            # rolling-window SLO view (docs/observability.md "Windowed
+            # SLOs"): p50/p95/p99 + rates from ring DELTAS, None when
+            # no window is armed — a stats consumer can tell "no
+            # window" from "no traffic"
+            "window": (self.window.report()
+                       if self.window is not None else None),
         }

@@ -58,7 +58,9 @@ import numpy as np
 
 from hyperspace_tpu.serve.batcher import (RequestBatcher, _CACHE_ONLY,
                                           _Lifecycle, bucket_for)
-from hyperspace_tpu.serve.errors import DeadlineExceededError, OverloadedError
+from hyperspace_tpu.serve.errors import (DeadlineExceededError,
+                                         OverloadedError, ServeError,
+                                         kind_of)
 from hyperspace_tpu.telemetry import registry as telem
 
 # default max-wait before a non-full pending bucket flushes (µs).  Small
@@ -110,26 +112,44 @@ class Collator:
         self._exec = ThreadPoolExecutor(max_workers=1,
                                         thread_name_prefix="serve-dispatch")
         self._closed = False
+        # monotone flush id, stamped on every member lifecycle a flush
+        # examines (expired ones included — a 504 must name the flush
+        # that missed its deadline); rides the access log and stats
+        self._flush_seq = 0
 
     # --- public ops -----------------------------------------------------------
 
     async def topk(self, ids, k: int, *, exclude_self: bool = True,
                    deadline_ms: Optional[float] = None,
-                   t_enq: Optional[float] = None
+                   t_enq: Optional[float] = None,
+                   request_id: Optional[str] = None
                    ) -> tuple[np.ndarray, np.ndarray]:
         """The batcher's ``topk`` contract, collated: same validation,
-        cache, admission, deadline, and telemetry semantics — but cold
-        ids ride a shared flush with whatever else is pending."""
+        cache, admission, deadline, telemetry, and access-log semantics
+        — but cold ids ride a shared flush with whatever else is
+        pending (``request_id`` joins the response to its flush via
+        the lifecycle's ``flush_id``)."""
         b = self.batcher
         if deadline_ms is None:
             deadline_ms = b.default_deadline_ms
-        life = _Lifecycle("topk", deadline_ms, t_enq=t_enq)
+        if request_id is None and b.access_sink is not None:
+            from hyperspace_tpu.serve.access import new_request_id
+
+            request_id = new_request_id()
+        life = _Lifecycle("topk", deadline_ms, t_enq=t_enq,
+                          request_id=request_id)
         telem.inc("serve/requests")
-        b._admit()
+        try:
+            b._admit()
+        except OverloadedError:
+            b.emit_access(life, "overloaded")
+            raise
         try:
             ids, k = b.validate_topk_request(ids, k)
             keyf, nprobe_ov, cache_only = b.plan_topk(k, exclude_self)
             rows, misses = b.cache_pass(ids, keyf, cache_only)
+            life.cache_hits = len(rows)
+            life.cache_misses = len(misses)
             life.check_deadline("after the cache pass")
             if misses:
                 computed = await self._enqueue(misses, k, exclude_self,
@@ -147,14 +167,22 @@ class Collator:
             # rows stay cached — the work is not wasted)
             life.check_deadline("at completion")
             life.finish()
+            b.emit_access(life)
             return out_i, out_d
+        except (ServeError, ValueError, KeyError, TypeError,
+                OverflowError, OSError) as e:
+            # the shared exception->taxonomy classification: access-log
+            # outcomes track wire kinds by construction
+            b.emit_access(life, kind_of(e))
+            raise
         finally:
             b._release()
 
     async def score(self, u_ids, v_ids, *, prob: bool = False,
                     fd_r: float = 2.0, fd_t: float = 1.0,
                     deadline_ms: Optional[float] = None,
-                    t_enq: Optional[float] = None) -> np.ndarray:
+                    t_enq: Optional[float] = None,
+                    request_id: Optional[str] = None) -> np.ndarray:
         """The batcher's ``score`` contract through the dispatch
         executor.  Edge scoring is uncached and pairs rarely repeat, so
         scores are not collated across requests — but they ARE admitted
@@ -164,9 +192,18 @@ class Collator:
         b = self.batcher
         if deadline_ms is None:
             deadline_ms = b.default_deadline_ms
-        life = _Lifecycle("score", deadline_ms, t_enq=t_enq)
+        if request_id is None and b.access_sink is not None:
+            from hyperspace_tpu.serve.access import new_request_id
+
+            request_id = new_request_id()
+        life = _Lifecycle("score", deadline_ms, t_enq=t_enq,
+                          request_id=request_id)
         telem.inc("serve/requests")
-        b._admit()
+        try:
+            b._admit()
+        except OverloadedError:
+            b.emit_access(life, "overloaded")
+            raise
         try:
             if b._mode() == _CACHE_ONLY:
                 raise OverloadedError(
@@ -181,7 +218,14 @@ class Collator:
                                   deadline_life=life))
             life.check_deadline("at completion")
             life.finish()
+            b.emit_access(life)
             return out
+        except (ServeError, ValueError, KeyError, TypeError,
+                OverflowError, OSError) as e:
+            # the shared exception->taxonomy classification: access-log
+            # outcomes track wire kinds by construction
+            b.emit_access(life, kind_of(e))
+            raise
         finally:
             b._release()
 
@@ -218,10 +262,15 @@ class Collator:
         if g is None:
             return  # already flushed by the other trigger
         g.timer.cancel()
+        self._flush_seq += 1
+        flush_id = self._flush_seq
         alive: list[_Member] = []
         ids: list[int] = []
         seen: set = set()
         for m in g.members:
+            # stamped BEFORE the deadline check: an expired member's
+            # 504 access record names the flush that missed it
+            m.life.flush_id = flush_id
             try:
                 # expired while queued: answered deadline_exceeded,
                 # never dispatched — and never fails the rest

@@ -56,13 +56,23 @@ class DeadlineExceededError(ServeError):
     kind = "deadline_exceeded"
 
 
+def kind_of(exc: BaseException) -> str:
+    """The taxonomy kind an exception answers with — the ONE
+    exception→kind classification, shared by the wire responses
+    (:func:`error_response`) and the access-log outcomes
+    (``RequestBatcher.emit_access`` call sites): the two surfaces can
+    never diverge when the taxonomy grows."""
+    if isinstance(exc, ServeError):
+        return exc.kind
+    if isinstance(exc, (ValueError, KeyError, TypeError, OverflowError)):
+        return "validation"
+    return "internal"
+
+
 def error_response(exc: BaseException) -> dict:
     """Map an exception to the one wire shape every failed request
     answers with: ``{"error": {"kind": ..., "message": ...}}``."""
     if isinstance(exc, ServeError):
         return {"error": exc.payload()}
-    if isinstance(exc, (ValueError, KeyError, TypeError, OverflowError)):
-        return {"error": {"kind": "validation",
-                          "message": f"{type(exc).__name__}: {exc}"}}
-    return {"error": {"kind": "internal",
+    return {"error": {"kind": kind_of(exc),
                       "message": f"{type(exc).__name__}: {exc}"}}

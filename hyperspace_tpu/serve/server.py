@@ -17,9 +17,23 @@ route                 body / answer
 ``POST /v1/score``    ``{"u": [...], "v": [...], "prob"?: bool, "fd_r"?,
                       "fd_t"?, "deadline_ms"?}`` → ``{"scores": [...]}``
 ``GET|POST /v1/stats``  ``batcher.stats()`` + a ``server`` block
-                      (served/inflight/draining) + ``recompiles``
-``GET /healthz``      ``{"ok": true}`` (503 + ``ok: false`` once draining)
+                      (served/inflight/draining) + ``recompiles`` +
+                      the windowed SLO block when a window is armed
+``GET /healthz``      liveness + identity JSON: ok/draining, uptime_s,
+                      package version, artifact fingerprint, engine
+                      scan_signature, precision lane, degrade level
+                      (503 + ``ok: false`` once draining)
+``GET /metrics``      Prometheus text exposition of the registry
+                      (telemetry/exposition.py) — counters, gauges,
+                      histograms with cumulative buckets
 ====================  ======================================================
+
+**Request tracing**: every parsed request gets a request id
+(``X-Request-Id`` accepted from the client, sanitized; generated
+otherwise), echoed as a response header, threaded through the collator
+into the lifecycle (span args, collator flush id) and the structured
+JSONL access log when one is armed (``access_log=`` —
+serve/access.py).
 
 Failed requests answer the SAME typed body as the stdin loop
 (``{"error": {"kind": ..., "message": ...}}`` — docs/serving.md "Error
@@ -60,10 +74,13 @@ from typing import Optional
 
 import numpy as np
 
+import hyperspace_tpu
+from hyperspace_tpu.serve.access import new_request_id
 from hyperspace_tpu.serve.batcher import RequestBatcher
 from hyperspace_tpu.serve.collator import DEFAULT_MAX_WAIT_US, Collator
 from hyperspace_tpu.serve.errors import ServeError, error_response
 from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry.exposition import render_prometheus
 
 MAX_BODY_BYTES = 8 << 20  # one request's JSON; far past any bucket
 MAX_HEADERS = 128         # header-count cap: no unbounded dict growth
@@ -113,8 +130,16 @@ def _req_number(req: dict, key: str, default: float) -> float:
     return float(v)
 
 
+class _TextPayload(str):
+    """A non-JSON response body (the ``/metrics`` exposition): written
+    verbatim with the given content type instead of json.dumps."""
+
+    content_type = "text/plain; version=0.0.4; charset=utf-8"
+
+
 class _Request:
-    __slots__ = ("method", "target", "headers", "body", "t_in", "close")
+    __slots__ = ("method", "target", "headers", "body", "t_in", "close",
+                 "request_id")
 
     def __init__(self, method, target, headers, body, t_in, close):
         self.method = method
@@ -123,6 +148,18 @@ class _Request:
         self.body = body
         self.t_in = t_in       # socket-in stamp: deadline origin
         self.close = close     # client asked Connection: close / HTTP/1.0
+        # accept-or-generate (docs/observability.md "Request tracing"):
+        # the client's X-Request-Id wins; otherwise a fresh id — either
+        # way it is echoed back and stamped on the access-log line.
+        # Sanitized to [A-Za-z0-9._-] and capped: the id is echoed into
+        # a response HEADER, so a hostile value must not be able to
+        # smuggle CR/LF (header injection) or megabytes
+        rid = headers.get("x-request-id", "")
+        # ASCII-explicit: str.isalnum alone admits latin-1 letters
+        # ('µ'), which would ride the echoed header as non-ASCII bytes
+        rid = "".join(c for c in rid
+                      if c.isascii() and (c.isalnum() or c in "-_."))[:64]
+        self.request_id = rid or new_request_id()
 
 
 class _BadRequest(Exception):
@@ -152,6 +189,7 @@ class HttpFrontDoor:
         self.served = 0          # responses written (errors included)
         self.inflight = 0        # requests currently being handled
         self.aborted_connections = 0  # abandoned at the drain timeout
+        self.t_start = time.monotonic()  # healthz uptime origin
         self._server: Optional[asyncio.base_events.Server] = None
         self._conn_tasks: set = set()
         self._draining: Optional[asyncio.Event] = None
@@ -211,6 +249,14 @@ class HttpFrontDoor:
         # hazard this PR's own lint rule polices) — the executor thread
         # finishes on its own and is joined at interpreter exit
         self.collator.close(wait=False)
+        if self.batcher.recorder is not None:
+            # SIGTERM/drain is a flight-recorder trigger: the last
+            # requests before shutdown are exactly the evidence a
+            # rollback post-mortem wants (docs/observability.md)
+            # wait=True: the process is about to exit — the evidence
+            # must be on disk before the drain completes
+            self.batcher.recorder.dump("sigterm_drain", _cls="drain",
+                                       wait=True)
         self._drained.set()
 
     @property
@@ -244,6 +290,11 @@ class HttpFrontDoor:
                 try:
                     req = read.result()
                 except _TooLarge as e:
+                    # framing failures feed the same error accounting
+                    # as body-level ones: a storm of oversized/garbled
+                    # HTTP must tick serve/errors, the window's error
+                    # rate, and the flight recorder's burst detector
+                    self._framing_access("validation")
                     await self._write_response(
                         writer, 413,
                         {"error": {"kind": "validation",
@@ -251,6 +302,7 @@ class HttpFrontDoor:
                         close=True)
                     break
                 except _BadRequest as e:
+                    self._framing_access("parse")
                     await self._write_response(
                         writer, 400,
                         {"error": {"kind": "parse", "message": str(e)}},
@@ -267,7 +319,8 @@ class HttpFrontDoor:
                     self.inflight -= 1
                 close = req.close or self._draining.is_set()
                 await self._write_response(writer, status, payload,
-                                           close=close)
+                                           close=close,
+                                           request_id=req.request_id)
                 if close:
                     break
         except (ConnectionError, asyncio.IncompleteReadError):
@@ -331,15 +384,40 @@ class HttpFrontDoor:
 
     # --- routing --------------------------------------------------------------
 
+    def _framing_access(self, outcome: str) -> None:
+        """Error-account an HTTP framing failure (bad request line,
+        over-limit headers, oversized body) — no parsed request
+        exists, so the record carries a generated id and the ``none``
+        route, but the counters/window/recorder still see the storm."""
+        self.batcher.emit_synthetic_access("none", outcome=outcome)
+
+    def _serve_access(self, req: _Request, route: str,
+                      outcome: str) -> None:
+        """Access-log a serve-op failure that never reached the
+        collator (body parse, pre-dispatch validation) — the collator
+        and batcher emit for everything past their entry, so this
+        covers exactly the complement (no double lines).  Scrape/admin
+        routes (healthz/stats/metrics) are deliberately not logged:
+        a 15 s scrape cadence would drown the request records."""
+        self.batcher.emit_synthetic_access(
+            route, request_id=req.request_id, outcome=outcome,
+            t_enq=req.t_in)
+
     async def _route(self, req: _Request) -> tuple[int, dict]:
         target = req.target.split("?", 1)[0]
         if target == "/healthz":
             if req.method != "GET":
                 return 405, {"error": {"kind": "validation",
                                        "message": "/healthz wants GET"}}
-            ok = not self._draining.is_set()
-            return (200 if ok else 503), {"ok": ok,
-                                          "draining": not ok}
+            return self._healthz()
+        if target == "/metrics":
+            # Prometheus text exposition of the whole registry
+            # (telemetry/exposition.py; docs/observability.md "Live
+            # metrics") — GET only, text/plain, scraper-ready
+            if req.method != "GET":
+                return 405, {"error": {"kind": "validation",
+                                       "message": "/metrics wants GET"}}
+            return 200, _TextPayload(render_prometheus())
         if target == "/v1/stats":
             if req.method not in ("GET", "POST"):
                 return 405, {"error": {"kind": "validation",
@@ -347,15 +425,20 @@ class HttpFrontDoor:
                                        "/v1/stats wants GET or POST"}}
             return 200, self._stats()
         if target not in ("/v1/topk", "/v1/score"):
+            self._serve_access(req, "none", "validation")
             return 404, {"error": {"kind": "validation",
                                    "message": f"no route {target!r}"}}
+        route = target.rsplit("/", 1)[-1]
         if req.method != "POST":
+            self._serve_access(req, route, "validation")
             return 405, {"error": {"kind": "validation",
                                    "message": f"{target} wants POST"}}
+        entered = False  # past this flag, the collator owns the access log
         try:
             try:
                 body = json.loads(req.body.decode("utf-8"))
             except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                self._serve_access(req, route, "parse")
                 return 400, {"error": {"kind": "parse",
                                        "message": str(e)}}
             if not isinstance(body, dict):
@@ -363,19 +446,27 @@ class HttpFrontDoor:
                     f"request body must be a JSON object, got "
                     f"{type(body).__name__}")
             if target == "/v1/topk":
+                exclude_self = _json_bool(body, "exclude_self", True)
+                deadline_ms = _req_deadline(body)
+                entered = True
                 idx, dist = await self.collator.topk(
                     body.get("ids"), body.get("k", 10),
-                    exclude_self=_json_bool(body, "exclude_self", True),
-                    deadline_ms=_req_deadline(body), t_enq=req.t_in)
+                    exclude_self=exclude_self,
+                    deadline_ms=deadline_ms, t_enq=req.t_in,
+                    request_id=req.request_id)
                 resp = {"neighbors": idx.tolist(),
                         "dists": dist.tolist()}
             else:
+                prob = _json_bool(body, "prob", False)
+                fd_r = _req_number(body, "fd_r", 2.0)
+                fd_t = _req_number(body, "fd_t", 1.0)
+                deadline_ms = _req_deadline(body)
+                entered = True
                 scores = await self.collator.score(
-                    body.get("u"), body.get("v"),
-                    prob=_json_bool(body, "prob", False),
-                    fd_r=_req_number(body, "fd_r", 2.0),
-                    fd_t=_req_number(body, "fd_t", 1.0),
-                    deadline_ms=_req_deadline(body), t_enq=req.t_in)
+                    body.get("u"), body.get("v"), prob=prob,
+                    fd_r=fd_r, fd_t=fd_t,
+                    deadline_ms=deadline_ms, t_enq=req.t_in,
+                    request_id=req.request_id)
                 resp = {"scores": scores.tolist()}
         except (ServeError, ValueError, KeyError, TypeError,
                 OverflowError, OSError) as e:
@@ -383,8 +474,31 @@ class HttpFrontDoor:
             # status codes; an IO fault (incl. the serve.dispatch
             # ioerror chaos site) answers 500 and the server survives
             err = error_response(e)
+            if not entered:
+                # validation failed before the collator saw the
+                # request — it could not have emitted the record
+                self._serve_access(req, route, err["error"]["kind"])
             return _STATUS_BY_KIND[err["error"]["kind"]], err
         return 200, resp
+
+    def _healthz(self) -> tuple[int, dict]:
+        """The load-balancer body (docs/serving.md "HTTP front door"):
+        bare ok plus the fields a blue-green flip checks before routing
+        traffic — uptime, package version, which artifact (fingerprint)
+        and which program (scan signature, precision lane) this server
+        answers with, and whether it is currently degraded."""
+        ok = not self._draining.is_set()
+        eng = self.batcher.engine
+        return (200 if ok else 503), {
+            "ok": ok,
+            "draining": not ok,
+            "uptime_s": round(time.monotonic() - self.t_start, 3),
+            "version": hyperspace_tpu.__version__,
+            "fingerprint": eng.fingerprint,
+            "scan_signature": list(eng.scan_signature),
+            "precision": eng.precision,
+            "degrade_level": self.batcher.degrade_level,
+        }
 
     def _stats(self) -> dict:
         out = dict(self.batcher.stats())
@@ -402,12 +516,21 @@ class HttpFrontDoor:
 
     # --- response write -------------------------------------------------------
 
-    async def _write_response(self, writer, status: int, payload: dict,
-                              *, close: bool) -> None:
-        body = json.dumps(payload, default=_json_default).encode("utf-8")
+    async def _write_response(self, writer, status: int, payload,
+                              *, close: bool,
+                              request_id: Optional[str] = None) -> None:
+        if isinstance(payload, _TextPayload):
+            body = str(payload).encode("utf-8")
+            ctype = payload.content_type
+        else:
+            body = json.dumps(payload,
+                              default=_json_default).encode("utf-8")
+            ctype = "application/json"
+        rid = (f"X-Request-Id: {request_id}\r\n"
+               if request_id is not None else "")
         head = (f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}\r\n"
-                f"Content-Type: application/json\r\n"
-                f"Content-Length: {len(body)}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n{rid}"
                 f"Connection: {'close' if close else 'keep-alive'}\r\n"
                 "\r\n").encode("latin-1")
         writer.write(head + body)

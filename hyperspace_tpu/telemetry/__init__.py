@@ -67,10 +67,16 @@ def cli_session(telemetry: bool, trace_out, *, stream=None):
             from hyperspace_tpu.telemetry import trace as _trace
 
             _trace.disable()
+from hyperspace_tpu.telemetry.exposition import (  # noqa: F401
+    MetricsFileWriter,
+    render_prometheus,
+    sanitize_name,
+)
 from hyperspace_tpu.telemetry.histogram import (  # noqa: F401
     Histogram,
     HistogramSnapshot,
 )
+from hyperspace_tpu.telemetry.window import SloWindow  # noqa: F401
 from hyperspace_tpu.telemetry.registry import (  # noqa: F401
     Registry,
     default_registry,

@@ -1,0 +1,218 @@
+"""Prometheus text exposition of the registry — the live scrape plane.
+
+Everything the telemetry layer knows (PRs 2, 7) is post-hoc: counters
+and histograms surface in JSONL records, bench artifacts, and stderr
+summaries — but a *running* server exposes nothing a scraper can poll.
+This module renders one :class:`~hyperspace_tpu.telemetry.registry.
+Registry` in the Prometheus text format (v0.0.4), the lingua franca of
+every scrape stack, so
+
+- the HTTP front door serves it at ``GET /metrics``
+  (``serve/server.py``), and
+- a training run writes it periodically to a file
+  (``metrics_out=``/``metrics_every=`` on the train CLI — a node
+  exporter's textfile collector makes a training job scrapeable with
+  no port open).
+
+Format rules (pinned by the golden test in
+``tests/telemetry/test_exposition.py``):
+
+- **Names sanitize** as ``hyperspace_`` + the registry name with every
+  non-``[a-zA-Z0-9_:]`` rune replaced by ``_`` — ``serve/e2e_ms`` →
+  ``hyperspace_serve_e2e_ms``.  The ORIGINAL registry name rides the
+  ``# HELP`` line, so a scrape maps back onto the catalog rows in
+  docs/observability.md (``scripts/check_metrics_endpoint.py`` checks
+  the round trip both directions).
+- **Every sample carries** a ``process_index`` label (plus any caller
+  extras), so multi-host scrapes merge instead of colliding.
+- **Counters** render as ``counter``, **gauges** as ``gauge``,
+  **histograms** as real Prometheus histograms: cumulative
+  ``_bucket{le=...}`` lines + ``_sum`` + ``_count``.  The log-bucket
+  scheme has ~283 finite edges; only edges where the cumulative count
+  CHANGES are emitted (plus ``le="+Inf"``) — information-lossless
+  (cumulative counts stay monotone and complete) and ~10 lines per
+  live histogram instead of ~285.
+- **Escaping**: HELP text escapes ``\\`` and newlines; label values
+  escape ``\\``, ``\"``, and newlines.
+
+:class:`MetricsFileWriter` is the train-side snapshotter: atomic
+write-then-rename every ``every_s`` seconds, checked with one clock
+read per call (``maybe_write`` sits on the chunk boundary — the
+disabled default constructs nothing).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Optional
+
+from hyperspace_tpu.telemetry.histogram import HistogramSnapshot
+from hyperspace_tpu.telemetry.registry import Registry, default_registry
+
+PREFIX = "hyperspace_"
+_BAD_RUNE_RX = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_name(name: str) -> str:
+    """Registry name → Prometheus metric family name.
+
+    ``serve/e2e_ms`` → ``hyperspace_serve_e2e_ms``; a leading digit
+    after the prefix is fine (the prefix itself starts the name)."""
+    return PREFIX + _BAD_RUNE_RX.sub("_", name)
+
+
+def escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(text: str) -> str:
+    return (text.replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt(v) -> str:
+    """Sample values: integers render bare (counters stay readable),
+    floats via repr at full precision.  Non-finite values render as
+    the format's ``NaN``/``+Inf``/``-Inf`` literals — one poisoned
+    gauge (or an inf observation's histogram sum) must break that one
+    sample's usefulness, never every future scrape."""
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 2**53:
+        return str(int(f))
+    return repr(f)
+
+
+def _labels_str(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{escape_label_value(str(v))}"'
+                     for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001  # hyperlint: disable=swallow-base-exception — jax absent/uninitialized: exposition must render anyway (label degrades to 0)
+        return 0
+
+
+def _hist_lines(san: str, labels: dict, snap: HistogramSnapshot) -> list:
+    """Cumulative-bucket lines for one histogram snapshot.
+
+    Bucket ``i`` (1-based within the finite range) spans
+    ``[lo*g^(i-1), lo*g^i)``, so the cumulative count at
+    ``le = lo*g^i`` includes the underflow bucket plus buckets
+    ``1..i``.  Runs of edges where the cumulative count does not
+    change are compressed to their LAST edge — the one immediately
+    below the next populated bucket — so every emitted bucket keeps
+    its true lower bound (PromQL's ``histogram_quantile`` interpolates
+    linearly inside a bucket: dropping the lower-bound edge would
+    stretch the bucket down to the previously emitted edge and pull
+    quantile estimates far below the scheme's ~4.9 % error bound).
+    Cumulative monotonicity and totals are preserved exactly; a live
+    histogram emits ≤ 2 lines per populated run instead of ~285."""
+    out = []
+
+    def emit(i: int, c: int) -> None:
+        edge = snap.lo * snap.growth ** i
+        lab = dict(labels, le=f"{edge:.6g}")
+        out.append(f"{san}_bucket{_labels_str(lab)} {c}")
+
+    n = len(snap.counts) - 2
+    cum = snap.counts[0]
+    last_emitted = 0  # bucket-edge index of the last emitted line
+    for i in range(1, n + 1):
+        new_cum = cum + snap.counts[i]
+        if new_cum != cum:
+            if i - 1 >= 1 and last_emitted != i - 1:
+                emit(i - 1, cum)  # the populated bucket's lower bound
+            emit(i, new_cum)
+            last_emitted = i
+        cum = new_cum
+    lab = dict(labels, le="+Inf")
+    out.append(f"{san}_bucket{_labels_str(lab)} {snap.count}")
+    out.append(f"{san}_sum{_labels_str(labels)} {_fmt(snap.sum)}")
+    out.append(f"{san}_count{_labels_str(labels)} {snap.count}")
+    return out
+
+
+def render_prometheus(registry: Optional[Registry] = None,
+                      labels: Optional[dict] = None) -> str:
+    """The whole registry as Prometheus text (module docstring).
+
+    ``labels`` are extra labels on every sample; ``process_index`` is
+    always present (caller's value wins — a multi-host aggregator can
+    re-stamp).  Families render in sorted registry-name order, so two
+    scrapes of an idle process are byte-identical (the monotone-scrape
+    check in ``check_metrics_endpoint.py`` depends on stable order
+    only for readability — the parser is order-free)."""
+    reg = default_registry() if registry is None else registry
+    counters, gauges, hists = reg.export()
+    base = {"process_index": str(_process_index())}
+    if labels:
+        base.update({str(k): str(v) for k, v in labels.items()})
+    lines: list[str] = []
+    for name in sorted(counters):
+        san = sanitize_name(name)
+        lines.append(f"# HELP {san} {escape_help(name)}")
+        lines.append(f"# TYPE {san} counter")
+        lines.append(f"{san}{_labels_str(base)} {_fmt(counters[name])}")
+    for name in sorted(gauges):
+        san = sanitize_name(name)
+        lines.append(f"# HELP {san} {escape_help(name)}")
+        lines.append(f"# TYPE {san} gauge")
+        lines.append(f"{san}{_labels_str(base)} {_fmt(gauges[name])}")
+    for name in sorted(hists):
+        san = sanitize_name(name)
+        lines.append(f"# HELP {san} {escape_help(name)}")
+        lines.append(f"# TYPE {san} histogram")
+        lines.extend(_hist_lines(san, base, hists[name]))
+    return "\n".join(lines) + "\n"
+
+
+class MetricsFileWriter:
+    """Periodic exposition-to-file snapshotter (``metrics_out=``).
+
+    ``maybe_write()`` costs one ``time.monotonic`` read until the
+    cadence expires, then renders and writes ATOMICALLY (temp file +
+    rename in the target directory) — a scraper's textfile collector
+    never reads a torn snapshot.  ``write()`` forces one (run end —
+    the final counters must land whatever the cadence)."""
+
+    def __init__(self, path: str, every_s: float = 30.0, *,
+                 registry: Optional[Registry] = None,
+                 labels: Optional[dict] = None):
+        if every_s <= 0:
+            raise ValueError(f"metrics_every must be > 0; got {every_s}")
+        self.path = path
+        self.every_s = float(every_s)
+        self._registry = registry
+        self._labels = labels
+        self.writes = 0
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._next = time.monotonic()  # first maybe_write() emits
+
+    def maybe_write(self) -> bool:
+        if time.monotonic() < self._next:
+            return False
+        self.write()
+        return True
+
+    def write(self) -> None:
+        self._next = time.monotonic() + self.every_s
+        text = render_prometheus(self._registry, labels=self._labels)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(text)
+        os.replace(tmp, self.path)
+        self.writes += 1

@@ -146,6 +146,29 @@ class Registry:
             out["hist/" + name] = snap.fields()
         return out
 
+    def export(self, hist_names=None) -> tuple[dict, dict, dict]:
+        """``(counters, gauges, hist_snapshots)`` — the raw state the
+        Prometheus exposition (:mod:`hyperspace_tpu.telemetry.
+        exposition`) and the SLO window (:mod:`~.window`) render from.
+        Unlike :meth:`snapshot`, histograms come back as
+        :class:`~hyperspace_tpu.telemetry.histogram.HistogramSnapshot`
+        objects (bucket counts included — cumulative ``le`` buckets and
+        ring-delta subtraction both need the vector, not the summary
+        fields) and gauges lose their write-seq bookkeeping.
+        ``hist_names`` (a container) limits which histograms are
+        snapshotted — the SLO window captures one histogram per 5 s
+        slot and per stats read, and snapshotting every ~285-bucket
+        vector only to discard them would tax the admission path."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = {k: v for k, (v, _s) in self._gauges.items()}
+            hists = dict(self._hists)
+        if hist_names is not None:
+            hists = {k: h for k, h in hists.items() if k in hist_names}
+        # snapshots OUTSIDE the registry lock (each histogram has its
+        # own) — the same ordering rule as mark()
+        return counters, gauges, {k: h.snapshot() for k, h in hists.items()}
+
     def reset(self) -> None:
         """Drop every counter/gauge/histogram (tests; a new run
         in-process)."""

@@ -306,6 +306,16 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
 
     tracer, reg, fresh_tracer = _telemetry_setup(run)
     monitor, health_every = _health_monitor(run, health_fn)
+    mwriter = None
+    metrics_out = getattr(run, "metrics_out", None)
+    if metrics_out:
+        # Prometheus-text file snapshotter (telemetry/exposition.py):
+        # a training job becomes scrapeable-by-file; checked at chunk
+        # boundaries (one clock read each), final write on exit
+        from hyperspace_tpu.telemetry.exposition import MetricsFileWriter
+
+        mwriter = MetricsFileWriter(
+            metrics_out, float(getattr(run, "metrics_every", 30.0)))
     ck = None
     start = 0
     loss = jnp.nan
@@ -392,6 +402,11 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
                 telem.observe("train/dispatch_ms",
                               (time.perf_counter() - t_disp) * 1e3)
                 telem.inc("train/dispatches")
+                if mwriter is not None:
+                    try:
+                        mwriter.maybe_write()
+                    except OSError:
+                        pass  # scrape-file loss never sinks the run
                 if faults.active() and faults.poison("train.step_nan"):
                     # chaos: the device-side shape one poisoned batch
                     # leaves after its step (docs/resilience.md)
@@ -511,4 +526,12 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
             if tracer is not None:
                 summary.update(tracer.total_fields())
             log.event("telemetry_summary", steps=int(done), **summary)
+        if mwriter is not None:
+            try:
+                # the run's final counters must land whatever the
+                # cadence — the last scrape a collector sees is the
+                # run's closing state
+                mwriter.write()
+            except OSError:
+                pass
     return state, loss

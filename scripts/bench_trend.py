@@ -65,8 +65,10 @@ _LOWER_TOKENS = ("time", "stall", "waste", "recompile", "epoch_s",
 # (cache_hit_rate, qps_at_recall...), but the r13 HTTP front door's
 # shed_rate / deadline_rate are failure fractions — shedding MORE is
 # never an improvement (latency itself — http_p99_ms and every
-# latency_ms leaf — is already lower-better via the _ms suffix)
-_LOWER_PRIORITY_TOKENS = ("waste", "shed", "deadline")
+# latency_ms leaf — is already lower-better via the _ms suffix);
+# "overhead" likewise (the r16 observability overhead_ratio is a cost
+# fraction — a bigger ratio is a slower instrumented server)
+_LOWER_PRIORITY_TOKENS = ("waste", "shed", "deadline", "overhead")
 # size tokens, matched per dotted-path SEGMENT (word-boundary style: the
 # segment is the token, or carries it as a ``_``-separated word) so the
 # r15 big-table leg's capacity metrics — ``table_mb.int8``,

@@ -1,0 +1,329 @@
+#!/usr/bin/env python
+"""Smoke lint: the live observability plane, as a real subprocess.
+
+export → ``serve-http`` with ``access_log=`` + ``window_s=`` on an
+ephemeral port → scrape ``GET /metrics`` twice around real traffic →
+SIGTERM drain.  Asserted (exit 1 on any miss):
+
+- the exposition parses as Prometheus text (v0.0.4): HELP/TYPE per
+  family, every sample labeled with ``process_index``;
+- **catalog round trip, both directions**: every family's HELP line
+  carries the ORIGINAL registry name, which must be a backticked token
+  in docs/observability.md's catalogs (an exposed-but-undocumented
+  metric is exactly what the telemetry-catalog lint exists to stop),
+  and re-sanitizing that original reproduces the family name (no
+  collisions across families);
+- **counters are monotone** between the two scrapes;
+- a topk request carrying ``X-Request-Id`` gets the SAME id echoed in
+  the response header, ``/v1/stats`` reports the windowed SLO block
+  with a populated distribution, and after drain the access log holds
+  one line for that id with its route, flush id, and e2e latency —
+  the Dapper-style join this plane exists for.
+
+Run by ``tests/serve/test_check_metrics_script.py`` inside tier-1,
+mirroring ``check_serve_http.py``, so an observability regression
+fails the build.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+N, D, C = 123, 8, 1.1
+K = 5
+LISTEN_DEADLINE_S = 120.0
+_SAMPLE_RX = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+
+
+def build_table():
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.manifolds import PoincareBall
+
+    v = 0.5 * jax.random.normal(jax.random.PRNGKey(7), (N, D), jnp.float32)
+    return PoincareBall(C).expmap0(v)
+
+
+def parse_exposition(text: str) -> dict:
+    """{family: {"help": str, "type": str, "samples": {(name, labels):
+    float}}} — a minimal, order-free parser of the text format."""
+    fams: dict = {}
+    cur = None
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            cur = fams.setdefault(name, {"help": None, "type": None,
+                                         "samples": {}})
+            cur["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            fams.setdefault(name, {"help": None, "type": None,
+                                   "samples": {}})["type"] = kind.strip()
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RX.match(line)
+        if m is None:
+            raise ValueError(f"unparseable exposition line {lineno}: "
+                             f"{line!r}")
+        sample, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        # histogram samples (_bucket/_sum/_count) attach to their family
+        fam = sample
+        for suffix in ("_bucket", "_sum", "_count"):
+            if sample.endswith(suffix) and sample[: -len(suffix)] in fams:
+                fam = sample[: -len(suffix)]
+                break
+        if fam not in fams:
+            raise ValueError(
+                f"sample {sample!r} before any HELP/TYPE (line {lineno})")
+        fams[fam]["samples"][(sample, labels)] = float(value)
+    return fams
+
+
+def _get(host, port, path, headers=None):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("GET", path, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def _post(host, port, path, payload, headers=None):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        body = json.dumps(payload).encode()
+        hs = {"Content-Type": "application/json"}
+        hs.update(headers or {})
+        conn.request("POST", path, body=body, headers=hs)
+        resp = conn.getresponse()
+        return (resp.status, json.loads(resp.read().decode()),
+                dict(resp.getheaders()))
+    finally:
+        conn.close()
+
+
+def _wait_for_port(proc, err_path: str) -> tuple[str, int]:
+    """Poll the file-backed stderr for the 'listening on HOST:PORT'
+    line, HARD-bounded — file-backed (not a pipe) so a wedged-but-
+    silent server can neither block a readline nor deadlock the drain
+    wait on a full pipe (the check_serve_http pump, without the
+    thread)."""
+    deadline = time.monotonic() + LISTEN_DEADLINE_S
+    while time.monotonic() < deadline:
+        with open(err_path, encoding="utf-8") as f:
+            for line in f:
+                if "listening on" in line:
+                    hostport = line.strip().rsplit(" ", 1)[-1]
+                    host, _, port = hostport.rpartition(":")
+                    return host, int(port)
+        if proc.poll() is not None:
+            with open(err_path, encoding="utf-8") as f:
+                tail = f.read()[-800:]
+            raise RuntimeError(
+                f"server died rc={proc.returncode} before listening:\n"
+                f"{tail}")
+        time.sleep(0.25)
+    raise RuntimeError("no listening line within the deadline")
+
+
+def main(out_dir: str | None = None) -> int:
+    from hyperspace_tpu.serve import export_artifact
+    from hyperspace_tpu.telemetry.exposition import sanitize_name
+
+    with open(os.path.join(ROOT, "docs", "observability.md"),
+              encoding="utf-8") as f:
+        documented = set(re.findall(r"`([^`\s]+)`", f.read()))
+
+    table = build_table()
+    import numpy as np
+
+    table = np.asarray(table)
+    tmp = None
+    if out_dir is None:
+        tmp = tempfile.TemporaryDirectory()
+        out_dir = tmp.name
+    art_dir = os.path.join(out_dir, "artifact")
+    access_path = os.path.join(out_dir, "access.jsonl")
+    proc = None
+    try:
+        export_artifact(art_dir, table, ("poincare", C),
+                        model_config={"c": C}, overwrite=True)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        err_path = os.path.join(out_dir, "server.stderr")
+        with open(err_path, "w", encoding="utf-8") as errf:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "hyperspace_tpu.cli.serve",
+                 "serve-http", f"artifact={art_dir}", "port=0",
+                 "host=127.0.0.1", "max_wait_us=1000", "prewarm=1",
+                 f"access_log={access_path}", "window_s=30", f"k={K}"],
+                cwd=ROOT, env=env, stdout=subprocess.DEVNULL,
+                stderr=errf, text=True)
+        host, port = _wait_for_port(proc, err_path)
+
+        # --- scrape 1: before traffic ---------------------------------
+        status, text1, _hdr = _get(host, port, "/metrics")
+        if status != 200:
+            print(f"/metrics SCRAPE 1 FAILED: {status}")
+            return 1
+        fams1 = parse_exposition(text1)
+        if not fams1:
+            print("/metrics EMPTY on scrape 1")
+            return 1
+
+        # --- request with a traced id ---------------------------------
+        rid = "smoke-req-0001"
+        status, resp, hdrs = _post(host, port, "/v1/topk",
+                                   {"ids": [0, 1, 2], "k": K},
+                                   headers={"X-Request-Id": rid})
+        if status != 200 or len(resp.get("neighbors", [])) != 3:
+            print(f"TOPK FAILED: {status} {resp}")
+            return 1
+        echoed = {k.lower(): v for k, v in hdrs.items()}.get(
+            "x-request-id")
+        if echoed != rid:
+            print(f"REQUEST ID NOT ECHOED: sent {rid!r}, got {echoed!r}")
+            return 1
+        # an anonymous request still gets a generated id echoed
+        status, _resp, hdrs = _post(host, port, "/v1/topk",
+                                    {"ids": [5, 6], "k": K})
+        gen = {k.lower(): v for k, v in hdrs.items()}.get("x-request-id")
+        if status != 200 or not gen:
+            print(f"GENERATED ID MISSING: {status} {gen!r}")
+            return 1
+
+        # --- windowed SLO block in stats ------------------------------
+        status, stats, _ = _post(host, port, "/v1/stats", {})
+        win = stats.get("window")
+        if status != 200 or not isinstance(win, dict):
+            print(f"NO WINDOW BLOCK in stats: {status} {win}")
+            return 1
+        e2e = win.get("e2e_ms")
+        if not e2e or e2e.get("count", 0) < 1 or not e2e.get("p99"):
+            print(f"WINDOW DISTRIBUTION EMPTY after traffic: {win}")
+            return 1
+
+        # --- scrape 2: after traffic ----------------------------------
+        status, text2, _ = _get(host, port, "/metrics")
+        if status != 200:
+            print(f"/metrics SCRAPE 2 FAILED: {status}")
+            return 1
+        fams2 = parse_exposition(text2)
+
+        # catalog round trip, both directions
+        seen_original = {}
+        for fam, info in fams2.items():
+            original = info["help"]
+            if not original:
+                print(f"FAMILY {fam} HAS NO HELP LINE")
+                return 1
+            if original not in documented:
+                print(f"EXPOSED-BUT-UNDOCUMENTED metric: {fam} "
+                      f"(registry name {original!r} has no backticked "
+                      "row in docs/observability.md)")
+                return 1
+            if sanitize_name(original) != fam:
+                print(f"SANITIZE ROUND TRIP BROKEN: {original!r} -> "
+                      f"{sanitize_name(original)!r} != {fam!r}")
+                return 1
+            if original in seen_original:
+                print(f"FAMILY COLLISION: {original!r} renders as both "
+                      f"{seen_original[original]!r} and {fam!r}")
+                return 1
+            seen_original[original] = fam
+        # counters monotone between scrapes
+        for fam, info in fams1.items():
+            if info["type"] != "counter":
+                continue
+            for key, v1 in info["samples"].items():
+                v2 = fams2.get(fam, {}).get("samples", {}).get(key)
+                if v2 is not None and v2 < v1:
+                    print(f"COUNTER WENT BACKWARDS: {key} {v1} -> {v2}")
+                    return 1
+        # the serve traffic must be visible in the delta
+        req_fam = sanitize_name("serve/requests")
+        n1 = sum(fams1.get(req_fam, {}).get("samples", {}).values())
+        n2 = sum(fams2.get(req_fam, {}).get("samples", {}).values())
+        if not n2 > n1:
+            print(f"serve/requests NOT MONOTONE-INCREASING: {n1} -> {n2}")
+            return 1
+        # the e2e histogram must expose cumulative buckets
+        e2e_fam = sanitize_name("serve/e2e_ms")
+        f2 = fams2.get(e2e_fam)
+        if (f2 is None or f2["type"] != "histogram"
+                or not any(s.endswith("_bucket")
+                           for s, _l in f2["samples"])):
+            print(f"serve/e2e_ms NOT EXPOSED AS HISTOGRAM: {f2}")
+            return 1
+
+        # --- drain, then join the access log --------------------------
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            print("DRAIN HUNG")
+            return 1
+        if proc.returncode != 0:
+            with open(err_path, encoding="utf-8") as f:
+                tail = f.read()[-800:]
+            print(f"DRAIN EXIT CODE {proc.returncode}:\n{tail}")
+            return 1
+        with open(access_path, encoding="utf-8") as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        mine = [r for r in records if r.get("request_id") == rid]
+        if len(mine) != 1:
+            print(f"ACCESS LOG LINES for {rid!r}: {len(mine)} (want 1); "
+                  f"log holds {len(records)} records")
+            return 1
+        rec = mine[0]
+        bad = [field for field in ("route", "outcome", "e2e_ms",
+                                   "queue_wait_ms", "bucket",
+                                   "cache_hits", "cache_misses",
+                                   "degrade_level")
+               if field not in rec]
+        if bad or rec["route"] != "topk" or rec["outcome"] != "ok":
+            print(f"ACCESS RECORD MALFORMED (missing {bad}): {rec}")
+            return 1
+        if rec.get("flush_id") is None:
+            print(f"ACCESS RECORD HAS NO FLUSH ID (cold topk must ride "
+                  f"a collator flush): {rec}")
+            return 1
+        print(f"metrics endpoint OK: {len(fams2)} families, "
+              f"{len(records)} access record(s), request {rid} joined "
+              f"to flush {rec['flush_id']} at e2e {rec['e2e_ms']} ms, "
+              f"windowed p99 {e2e['p99']} ms")
+        return 0
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        if tmp is not None:
+            tmp.cleanup()
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))

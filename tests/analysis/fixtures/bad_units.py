@@ -1,0 +1,18 @@
+"""metric-unit-suffix BAD fixture: unit-smelling names, no unit suffix.
+
+Never imported — parsed by the lint only.
+"""
+
+from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry.registry import inc, observe, set_gauge
+
+
+def durations():
+    observe("serve/dispatch_latency", 1.2)   # duration token, no suffix
+    inc("ckpt/save_time", 0.5)               # "time" smells duration
+    telem.inc("train/step_seconds", 1.0)     # seconds spelled out
+
+
+def sizes():
+    set_gauge("cache/resident_mb", 12)       # size token, wrong suffix
+    telem.set_gauge("table/upload_byte", 4)  # singular "byte"

@@ -1,0 +1,29 @@
+"""metric-unit-suffix GOOD fixture: proper suffixes, unitless names,
+and shapes the rule must not touch.  Never imported — parsed only."""
+
+from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry.registry import inc, observe, set_gauge
+
+
+def suffixed():
+    observe("serve/e2e_ms", 1.2)        # milliseconds, suffixed
+    inc("jax/compile_s", 0.5)           # seconds, suffixed
+    set_gauge("ckpt/bytes", 100)        # bare unit as final segment
+    telem.inc("host_table/upload_rows", 8)
+    telem.observe("serve/queue_wait_ms", 0.1)  # "wait" token + suffix
+
+
+def unitless():
+    inc("serve/requests")               # a count: no unit to name
+    set_gauge("prefetch/queue_depth", 3)
+    telem.inc("serve/cache_hit")
+
+
+def out_of_scope():
+    h = object()
+    # instance observe with a NUMBER first arg (the histogram kind's
+    # value call) has no name literal — never scanned
+    getattr(h, "observe", lambda v: None)(1.25)
+    name = "serve/" + "dispatch_latency"
+    # dynamically-built names cannot be judged — out of scope
+    telem.inc(name)

@@ -26,6 +26,7 @@ from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
 from hyperspace_tpu.analysis.rules.retry import UnboundedRetryRule
 from hyperspace_tpu.analysis.rules.tracerleak import TracerLeakRule
+from hyperspace_tpu.analysis.rules.units import MetricUnitSuffixRule
 
 FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "fixtures")
@@ -50,6 +51,7 @@ _PER_FILE = [
     ("bad_hosttable.py", FullTableMaterializationRule, None),
     ("bad_precision.py", PrecisionLiteralRule,
      "hyperspace_tpu/models/bad_precision.py"),
+    ("bad_units.py", MetricUnitSuffixRule, None),
 ]
 
 
@@ -227,6 +229,30 @@ def test_retry_sleepless_while_true_is_fine(tmp_path):
     p = tmp_path / "loop.py"
     p.write_text("def f(q):\n    while True:\n        q.get()\n")
     assert lint_file(str(p), rules=[UnboundedRetryRule()]).findings == []
+
+
+# --- metric-unit-suffix -------------------------------------------------------
+
+
+def test_units_bad_fixture_fires_every_shape():
+    report = _lint("bad_units.py", MetricUnitSuffixRule)
+    msgs = [f.message for f in report.findings]
+    assert report.exit_code() == 1 and len(report.findings) == 5
+    assert sum("duration token" in m for m in msgs) == 3
+    assert sum("size token" in m for m in msgs) == 2
+    assert any("'serve/dispatch_latency'" in m for m in msgs)
+    assert any("'cache/resident_mb'" in m for m in msgs)
+
+
+def test_units_good_fixture_is_clean():
+    """Suffixed names, bare-unit final segments (ckpt/bytes), unitless
+    counts, instance observes, and dynamic names all pass."""
+    assert _lint("good_units.py", MetricUnitSuffixRule).findings == []
+
+
+def test_units_severity_is_warning():
+    report = _lint("bad_units.py", MetricUnitSuffixRule)
+    assert all(f.severity == "warning" for f in report.findings)
 
 
 # --- blocking-call-in-async ---------------------------------------------------

@@ -349,3 +349,23 @@ def test_direction_http_front_door_fields_are_lower_better():
     assert mod.direction("detail.serve.ivf.qps_at_recall99") == "higher"
     # sample-count leaves stay direction-free
     assert mod.direction("detail.serve_http.latency_ms.b8.n") is None
+
+
+def test_direction_observability_overhead_is_lower_better():
+    """The r16 observability pair: overhead_ratio is a COST fraction —
+    'overhead' outranks the generic higher-better ratio token — and the
+    paired p99 leaves keep their _ms lower-better direction."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_trend", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.direction(
+        "detail.serve_http.observability.overhead_ratio") == "lower"
+    assert mod.direction(
+        "detail.serve_http.observability.p99_on_ms") == "lower"
+    assert mod.direction(
+        "detail.serve_http.observability.p99_off_ms") == "lower"
+    # the generic speedup ratio direction is untouched
+    assert mod.direction(
+        "detail.serve.fused_vs_unfused.buckets.b64.ratio") == "higher"

@@ -1,0 +1,49 @@
+"""The live-observability smoke lint, run inside the suite: export →
+serve-http subprocess with access log + window → /metrics scraped
+twice (catalog round trip both directions, counters monotone) →
+request-id echo joined to its access-log line and collator flush →
+SIGTERM drain (scripts/check_metrics_endpoint.py is the one
+implementation — this test fails the build when it fails, mirroring
+test_check_http_script.py)."""
+
+import importlib.util
+import os
+
+import pytest
+
+
+def _load_checker():
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, "scripts", "check_metrics_endpoint.py")
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_endpoint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.flaky  # a loaded CI host can starve the subprocess launch
+def test_metrics_endpoint_lint_passes(tmp_path, capsys):
+    mod = _load_checker()
+    rc = mod.main(str(tmp_path))
+    out = capsys.readouterr().out
+    assert rc == 0, f"metrics-endpoint lint failed:\n{out}"
+    assert "metrics endpoint OK" in out
+    assert "joined to flush" in out
+
+
+def test_exposition_parser_rejects_garbage():
+    """The script's parser is itself a contract: unparseable sample
+    lines and orphan samples fail loudly (a silently-skipped line
+    would let a malformed exposition 'pass' the round trip)."""
+    mod = _load_checker()
+    with pytest.raises(ValueError, match="unparseable"):
+        mod.parse_exposition("# HELP x y\n# TYPE x counter\n{bad\n")
+    with pytest.raises(ValueError, match="before any HELP"):
+        mod.parse_exposition("orphan_sample 1\n")
+    fams = mod.parse_exposition(
+        "# HELP hyperspace_a a\n# TYPE hyperspace_a counter\n"
+        'hyperspace_a{process_index="0"} 3\n')
+    assert fams["hyperspace_a"]["type"] == "counter"
+    assert list(fams["hyperspace_a"]["samples"].values()) == [3.0]

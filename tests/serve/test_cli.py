@@ -175,11 +175,11 @@ def test_crash_still_prints_latency_summary(trained, capsys, monkeypatch):
     real_handle = S._handle
     calls = []
 
-    def _dying_handle(batcher, req):
+    def _dying_handle(batcher, req, entered=None):
         if len(calls) >= 1:
             raise RuntimeError("device fell over")
         calls.append(req)
-        return real_handle(batcher, req)
+        return real_handle(batcher, req, entered)
 
     monkeypatch.setattr(S, "_handle", _dying_handle)
     lines = "\n".join([
@@ -261,3 +261,125 @@ def test_export_requires_explicit_curvature(trained, tmp_path):
     with pytest.raises(SystemExit, match="want JSON"):
         S.main(["export", f"ckpt={ckpt}", f"out={tmp_path / 'a'}",
                 "workload=product", "factors=[[poincare,5]]"])
+
+
+def test_serve_log_parity_with_train_records(trained, tmp_path):
+    """log= on the serve loop writes the TRAIN CLI's record shapes:
+    a run_manifest FIRST record (full ServeConfig + device identity)
+    and a closing telemetry_summary — read_jsonl reads both."""
+    from hyperspace_tpu.train.logging import read_jsonl
+
+    _cfg, _state, _ckpt, art = trained
+    log = str(tmp_path / "serve.jsonl")
+    cfg = S.apply_overrides(S.ServeConfig(),
+                            {"artifact": art, "log": log})
+    lines = "\n".join([
+        json.dumps({"op": "topk", "ids": [0, 1], "k": 2}),
+        json.dumps({"op": "stats"}),
+    ]) + "\n"
+    out = io.StringIO()
+    S.run_serve(cfg, stdin=io.StringIO(lines), stdout=out)
+    recs = read_jsonl(log)
+    assert recs[0]["event"] == "run_manifest"
+    assert recs[0]["config"]["artifact"] == art
+    for key in ("backend", "device_kind", "version", "process_index"):
+        assert key in recs[0], key
+    assert recs[-1]["event"] == "telemetry_summary"
+    # session-scoped counters: this loop served one topk request
+    assert recs[-1]["ctr/serve/requests"] >= 1
+
+
+def test_serve_loop_request_id_echo_and_access_log(trained, tmp_path):
+    """A stdin request carrying request_id gets it echoed in the
+    response line and stamped on its access-log record; anonymous
+    requests stay echo-free (schema-stable)."""
+    _cfg, _state, _ckpt, art = trained
+    access = str(tmp_path / "access.jsonl")
+    cfg = S.apply_overrides(S.ServeConfig(),
+                            {"artifact": art, "access_log": access})
+    lines = "\n".join([
+        json.dumps({"op": "topk", "ids": [0, 1], "k": 2,
+                    "request_id": "cli-req-7"}),
+        json.dumps({"op": "topk", "ids": [2], "k": 2}),
+        json.dumps({"op": "topk", "ids": [0.5], "k": 2,
+                    "request_id": "cli-bad-1"}),  # validation error
+    ]) + "\n"
+    out = io.StringIO()
+    S.run_serve(cfg, stdin=io.StringIO(lines), stdout=out)
+    resp = [json.loads(l) for l in out.getvalue().strip().splitlines()]
+    assert resp[0]["request_id"] == "cli-req-7"
+    assert "request_id" not in resp[1]
+    assert "error" in resp[2]
+    recs = [json.loads(l) for l in open(access) if l.strip()]
+    by_id = {r["request_id"]: r for r in recs}
+    assert by_id["cli-req-7"]["outcome"] == "ok"
+    assert by_id["cli-req-7"]["route"] == "topk"
+    assert by_id["cli-bad-1"]["outcome"] == "validation"
+    # the anonymous request got a generated id — never a null line
+    assert all(r["request_id"] for r in recs)
+
+
+def test_serve_stats_op_carries_window_block(trained):
+    """window_s= (the default) surfaces the rolling SLO block in the
+    stdin loop's stats response — the /v1/stats parity."""
+    _cfg, _state, _ckpt, art = trained
+    cfg = S.apply_overrides(S.ServeConfig(), {"artifact": art})
+    lines = "\n".join([
+        json.dumps({"op": "topk", "ids": [0, 1, 2], "k": 2}),
+        json.dumps({"op": "stats"}),
+    ]) + "\n"
+    out = io.StringIO()
+    S.run_serve(cfg, stdin=io.StringIO(lines), stdout=out)
+    resp = [json.loads(l) for l in out.getvalue().strip().splitlines()]
+    win = resp[1]["window"]
+    assert win is not None and win["e2e_ms"] is not None
+    assert win["e2e_ms"]["count"] >= 1
+    # window_s=0 disables: stats says so explicitly
+    cfg0 = S.apply_overrides(S.ServeConfig(),
+                             {"artifact": art, "window_s": "0"})
+    out0 = io.StringIO()
+    S.run_serve(cfg0, stdin=io.StringIO(
+        json.dumps({"op": "stats"}) + "\n"), stdout=out0)
+    assert json.loads(out0.getvalue().strip())["window"] is None
+
+
+def test_serve_loop_pre_batcher_failures_are_logged(trained, tmp_path):
+    """Failures that never reach the batcher (parse, non-object line,
+    unknown op, missing ids) still write access records and echo the
+    request_id on the error response — the HTTP _serve_access parity."""
+    _cfg, _state, _ckpt, art = trained
+    access = str(tmp_path / "pre.jsonl")
+    cfg = S.apply_overrides(S.ServeConfig(),
+                            {"artifact": art, "access_log": access})
+    lines = "\n".join([
+        "this is not json",
+        json.dumps([1, 2]),                      # non-object line
+        json.dumps({"op": "nope", "request_id": "pre-1"}),
+        json.dumps({"op": "topk", "k": 2, "request_id": "pre-2"}),
+    ]) + "\n"
+    out = io.StringIO()
+    S.run_serve(cfg, stdin=io.StringIO(lines), stdout=out)
+    resp = [json.loads(l) for l in out.getvalue().strip().splitlines()]
+    assert all("error" in r for r in resp)
+    # error responses echo a well-formed request_id (join-ability)
+    assert resp[2]["request_id"] == "pre-1"
+    assert resp[3]["request_id"] == "pre-2"
+    assert "request_id" not in resp[0]  # unparseable: no id to echo
+    recs = [json.loads(l) for l in open(access) if l.strip()]
+    assert [r["outcome"] for r in recs] == [
+        "parse", "validation", "validation", "validation"]
+    by_id = {r["request_id"]: r for r in recs if r["request_id"]}
+    assert by_id["pre-1"]["route"] == "nope"
+    assert by_id["pre-2"]["route"] == "topk"
+    assert all(r["request_id"] for r in recs)  # parse line: generated
+
+
+def test_serve_stats_op_echoes_request_id(trained):
+    """Every answered line is joinable — the stats op echoes too."""
+    _cfg, _state, _ckpt, art = trained
+    cfg = S.apply_overrides(S.ServeConfig(), {"artifact": art})
+    out = io.StringIO()
+    S.run_serve(cfg, stdin=io.StringIO(
+        json.dumps({"op": "stats", "request_id": "st-1"}) + "\n"),
+        stdout=out)
+    assert json.loads(out.getvalue().strip())["request_id"] == "st-1"

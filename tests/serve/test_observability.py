@@ -1,0 +1,375 @@
+"""The live observability plane through the serve surfaces: access log
++ flight recorder (serve/access.py), request-id tracing (batcher,
+collator, HTTP front door), /metrics over HTTP, the enriched /healthz
+body, and the windowed SLO block in stats."""
+
+import asyncio
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hyperspace_tpu
+from hyperspace_tpu.manifolds import PoincareBall
+from hyperspace_tpu.serve.access import AccessLog, FlightRecorder
+from hyperspace_tpu.serve.batcher import RequestBatcher
+from hyperspace_tpu.serve.engine import QueryEngine
+from hyperspace_tpu.serve.errors import OverloadedError
+from hyperspace_tpu.serve.server import HttpFrontDoor
+from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry.window import SloWindow
+
+
+@pytest.fixture(scope="module")
+def engine():
+    rng = np.random.default_rng(3)
+    table = np.asarray(PoincareBall(1.0).expmap0(
+        jnp.asarray(rng.standard_normal((200, 4)) * 0.3, jnp.float32)))
+    eng = QueryEngine(table, ("poincare", 1.0))
+    eng.topk_neighbors(np.zeros(8, np.int32), 4)
+    return eng
+
+
+def _records(path):
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# --- access log through the sync batcher -------------------------------------
+
+
+def test_topk_writes_one_access_record(engine, tmp_path):
+    alog = AccessLog(str(tmp_path / "access.jsonl"))
+    bat = RequestBatcher(engine, min_bucket=8, max_bucket=64,
+                         cache_size=64, access_sink=alog.emit)
+    bat.topk([1, 2, 3], 4)
+    recs = _records(tmp_path / "access.jsonl")
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["route"] == "topk" and r["outcome"] == "ok"
+    assert r["request_id"]  # generated: never anonymous with a sink
+    assert r["cache_misses"] == 3 and r["cache_hits"] == 0
+    assert r["bucket"] == [8]
+    assert r["e2e_ms"] > 0 and r["queue_wait_ms"] >= 0
+    assert r["dispatch_ms"] > 0 and r["degrade_level"] == 0
+    assert "ts" in r
+    # warm repeat: hits recorded, caller id echoed into the record
+    bat.topk([1, 2, 3], 4, request_id="my-id-1")
+    alog.close()
+    recs = _records(tmp_path / "access.jsonl")
+    assert recs[1]["request_id"] == "my-id-1"
+    assert recs[1]["cache_hits"] == 3 and recs[1]["cache_misses"] == 0
+
+
+def test_failed_requests_carry_taxonomy_outcome(engine, tmp_path):
+    alog = AccessLog(str(tmp_path / "a.jsonl"))
+    bat = RequestBatcher(engine, min_bucket=8, max_bucket=64,
+                         cache_size=0, access_sink=alog.emit)
+    errs0 = telem.default_registry().get("serve/errors")
+    with pytest.raises(ValueError):
+        bat.topk([1.5], 4)  # float id: validation
+    with pytest.raises(ValueError):
+        bat.score([0], [1, 2])  # mismatched: validation
+    alog.close()
+    recs = _records(tmp_path / "a.jsonl")
+    assert [r["outcome"] for r in recs] == ["validation", "validation"]
+    assert [r["route"] for r in recs] == ["topk", "score"]
+    # taxonomy errors tick serve/errors (shed/deadline keep their own)
+    assert telem.default_registry().get("serve/errors") == errs0 + 2
+
+
+def test_no_sink_means_no_records_and_no_ids(engine):
+    bat = RequestBatcher(engine, min_bucket=8, max_bucket=64,
+                         cache_size=0)
+    assert bat.access_sink is None and bat.window is None
+    bat.topk([0], 4)  # no sink: nothing to write, nothing raises
+
+
+# --- flight recorder ----------------------------------------------------------
+
+
+def test_error_burst_dumps_incident(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "inc"), capacity=16,
+                         burst_n=3, burst_s=60.0, cooldown_s=0.0)
+    inc0 = telem.default_registry().get("serve/incidents")
+    for i in range(2):
+        rec.record({"request_id": f"ok{i}", "outcome": "ok"})
+    for i in range(3):
+        rec.record({"request_id": f"bad{i}", "outcome": "overloaded"})
+    rec.join()  # the write rides a background thread (event-loop safety)
+    assert len(rec.dumps) == 1
+    lines = _records(rec.dumps[0])
+    assert lines[0]["event"] == "incident"
+    assert lines[0]["reason"] == "error_burst_overloaded"
+    assert "counters" in lines[0]  # the counter marks ride the header
+    # the ring rides behind the header, oldest first, ok rows included
+    assert [ln["request_id"] for ln in lines[1:]] == [
+        "ok0", "ok1", "bad0", "bad1", "bad2"]
+    assert telem.default_registry().get("serve/incidents") == inc0 + 1
+
+
+def test_burst_cooldown_limits_dumps(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "inc"), burst_n=2,
+                         burst_s=60.0, cooldown_s=3600.0)
+    for i in range(10):
+        rec.record({"outcome": "internal", "i": i})
+    rec.join()
+    assert len(rec.dumps) == 1  # one incident per storm, not per request
+
+
+def test_ring_is_bounded(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "inc"), capacity=5,
+                         cooldown_s=0.0)
+    for i in range(100):
+        rec.record({"outcome": "ok", "i": i})
+    path = rec.dump("manual", wait=True)
+    lines = _records(path)
+    assert lines[0]["ring_len"] == 5
+    assert [ln["i"] for ln in lines[1:]] == [95, 96, 97, 98, 99]
+
+
+def test_degrade_transition_dumps(engine, tmp_path):
+    rec = FlightRecorder(str(tmp_path / "inc"), cooldown_s=0.0)
+    bat = RequestBatcher(engine, min_bucket=8, max_bucket=64,
+                         cache_size=0, queue_max=1,
+                         ladder_down_after=1, recorder=rec)
+    # force pressure: the second concurrent admit sheds → ladder down
+    bat._admission.inflight = 1
+    with pytest.raises(OverloadedError):
+        bat.topk([0], 4)
+    bat._admission.inflight = 0
+    rec.join()
+    assert any("degrade" in p for p in rec.dumps)
+
+
+def test_validation(tmp_path):
+    with pytest.raises(ValueError, match="capacity"):
+        FlightRecorder(str(tmp_path / "i"), capacity=0)
+    with pytest.raises(ValueError, match="burst"):
+        FlightRecorder(str(tmp_path / "i2"), burst_n=0)
+
+
+# --- windowed SLOs through the batcher ---------------------------------------
+
+
+def test_stats_carries_window_block(engine):
+    w = SloWindow(30.0, registry=telem.default_registry())
+    bat = RequestBatcher(engine, min_bucket=8, max_bucket=64,
+                         cache_size=0, window=w)
+    for _ in range(3):
+        bat.topk([1, 2], 4)
+    stats = bat.stats()
+    win = stats["window"]
+    assert win is not None and win["e2e_ms"] is not None
+    assert win["e2e_ms"]["count"] >= 3
+    assert win["e2e_ms"]["p99"] > 0
+    # no window armed → stats says so explicitly
+    bat2 = RequestBatcher(engine, min_bucket=8, max_bucket=64,
+                          cache_size=0)
+    assert bat2.stats()["window"] is None
+
+
+# --- the HTTP surface ---------------------------------------------------------
+
+
+async def _raw_request(host, port, method, path, payload=None,
+                       headers=None):
+    """(status, headers dict, body bytes) — header-aware variant of the
+    test_server helper (the echo assertions need response headers)."""
+    reader, writer = await asyncio.open_connection(host, port)
+    body = b"" if payload is None else json.dumps(payload).encode()
+    extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
+    writer.write((f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+                  f"Content-Length: {len(body)}\r\n{extra}"
+                  "Connection: close\r\n\r\n").encode() + body)
+    await writer.drain()
+    status = int((await reader.readline()).split()[1])
+    hdrs = {}
+    clen = 0
+    while True:
+        h = await reader.readline()
+        if h in (b"\r\n", b"\n", b""):
+            break
+        name, _, val = h.decode().partition(":")
+        hdrs[name.strip().lower()] = val.strip()
+        if name.strip().lower() == "content-length":
+            clen = int(val)
+    data = await reader.readexactly(clen)
+    writer.close()
+    return status, hdrs, data
+
+
+def _run_door(engine, coro_fn, **bat_kw):
+    bat = RequestBatcher(engine, min_bucket=8, max_bucket=64,
+                         cache_size=0, **bat_kw)
+    door = HttpFrontDoor(bat)
+
+    async def main():
+        await door.start()
+        try:
+            return await coro_fn(door)
+        finally:
+            await door.drain()
+
+    return asyncio.run(main()), bat
+
+
+def test_request_id_accept_and_generate(engine, tmp_path):
+    alog = AccessLog(str(tmp_path / "http.jsonl"))
+
+    async def go(door):
+        h, p = door.host, door.port
+        out = {}
+        out["echo"] = await _raw_request(
+            h, p, "POST", "/v1/topk", {"ids": [1], "k": 3},
+            headers={"X-Request-Id": "trace-42"})
+        out["gen"] = await _raw_request(h, p, "POST", "/v1/topk",
+                                        {"ids": [2], "k": 3})
+        # hostile id: header-injection runes are stripped, not echoed
+        out["evil"] = await _raw_request(
+            h, p, "POST", "/v1/topk", {"ids": [3], "k": 3},
+            headers={"X-Request-Id": "a b\tc"})
+        return out
+
+    out, _bat = _run_door(engine, go, access_sink=alog.emit)
+    alog.close()
+    status, hdrs, _ = out["echo"]
+    assert status == 200 and hdrs["x-request-id"] == "trace-42"
+    status, hdrs, _ = out["gen"]
+    assert status == 200 and len(hdrs["x-request-id"]) == 16
+    status, hdrs, _ = out["evil"]
+    assert status == 200 and hdrs["x-request-id"] == "abc"
+    recs = _records(tmp_path / "http.jsonl")
+    by_id = {r["request_id"]: r for r in recs}
+    assert "trace-42" in by_id
+    assert by_id["trace-42"]["flush_id"] is not None  # joined to a flush
+    assert by_id["trace-42"]["outcome"] == "ok"
+
+
+def test_parse_and_route_failures_are_logged(engine, tmp_path):
+    alog = AccessLog(str(tmp_path / "err.jsonl"))
+
+    async def go(door):
+        h, p = door.host, door.port
+        await _raw_request(h, p, "POST", "/v1/topk", None)  # empty body
+        await _raw_request(h, p, "POST", "/no/route", {"x": 1})
+        await _raw_request(h, p, "GET", "/healthz")  # scrape: not logged
+        return None
+
+    _out, _bat = _run_door(engine, go, access_sink=alog.emit)
+    alog.close()
+    recs = _records(tmp_path / "err.jsonl")
+    assert [r["outcome"] for r in recs] == ["parse", "validation"]
+    assert recs[0]["route"] == "topk" and recs[1]["route"] == "none"
+
+
+def test_metrics_endpoint_over_http(engine):
+    async def go(door):
+        h, p = door.host, door.port
+        await _raw_request(h, p, "POST", "/v1/topk",
+                           {"ids": [1, 2], "k": 3})
+        return await _raw_request(h, p, "GET", "/metrics")
+
+    out, _bat = _run_door(engine, go)
+    status, hdrs, body = out
+    assert status == 200
+    assert hdrs["content-type"].startswith("text/plain")
+    text = body.decode()
+    assert "# TYPE hyperspace_serve_requests counter" in text
+    assert "# HELP hyperspace_serve_e2e_ms serve/e2e_ms" in text
+    assert 'process_index="0"' in text
+    # POST is not a scrape
+    (_out2, _bat2) = _run_door(
+        engine, lambda door: _raw_request(door.host, door.port, "POST",
+                                          "/metrics", {}))
+    assert _out2[0] == 405
+
+
+def test_healthz_enriched_body(engine):
+    async def go(door):
+        return await _raw_request(door.host, door.port, "GET",
+                                  "/healthz")
+
+    out, bat = _run_door(engine, go)
+    status, _hdrs, body = out
+    health = json.loads(body)
+    assert status == 200 and health["ok"] is True
+    assert health["uptime_s"] >= 0
+    assert health["version"] == hyperspace_tpu.__version__
+    assert health["fingerprint"] == bat.engine.fingerprint
+    assert health["scan_signature"] == list(bat.engine.scan_signature)
+    assert health["precision"] == "f32"
+    assert health["degrade_level"] == 0
+
+
+def test_sigterm_drain_dumps_flight_recorder(engine, tmp_path):
+    rec = FlightRecorder(str(tmp_path / "inc"), cooldown_s=0.0)
+
+    async def go(door):
+        await _raw_request(door.host, door.port, "POST", "/v1/topk",
+                           {"ids": [1], "k": 3})
+        return None
+
+    _out, _bat = _run_door(engine, go, recorder=rec)
+    # _run_door drains in its finally — the drain IS the trigger
+    assert any("drain" in os.path.basename(p) for p in rec.dumps)
+
+
+def test_http_framing_errors_feed_error_accounting(engine, tmp_path):
+    """A storm of malformed HTTP (garbled request lines) must tick
+    serve/errors and write access records — the framing level joins
+    the same accounting as body-level failures, so the flight
+    recorder's burst detector sees hostile traffic."""
+    alog = AccessLog(str(tmp_path / "framing.jsonl"))
+    errs0 = telem.default_registry().get("serve/errors")
+
+    async def go(door):
+        reader, writer = await asyncio.open_connection(door.host,
+                                                       door.port)
+        writer.write(b"utter garbage\r\n\r\n")
+        await writer.drain()
+        await reader.read()  # 400 + close
+        writer.close()
+        return None
+
+    _out, _bat = _run_door(engine, go, access_sink=alog.emit)
+    alog.close()
+    recs = _records(tmp_path / "framing.jsonl")
+    assert [r["outcome"] for r in recs] == ["parse"]
+    assert recs[0]["route"] == "none" and recs[0]["request_id"]
+    assert telem.default_registry().get("serve/errors") == errs0 + 1
+
+
+def test_access_log_emit_after_close_is_safe(tmp_path):
+    """The close/emit shutdown race: an emit landing after close()
+    drops the line (and still feeds the recorder) instead of raising
+    into a live request."""
+    rec = FlightRecorder(str(tmp_path / "inc"), cooldown_s=0.0)
+    alog = AccessLog(str(tmp_path / "late.jsonl"), recorder=rec)
+    alog.emit({"request_id": "a", "outcome": "ok"})
+    alog.close()
+    alog.emit({"request_id": "b", "outcome": "ok"})  # must not raise
+    assert alog.lines == 1
+    assert len(rec._ring) == 2  # the ring still sees the late record
+
+
+def test_cache_only_shed_counts_in_serve_shed(engine):
+    """EVERY overloaded answer ticks serve/shed — counting only the
+    admission-queue site left the window's shed_rate reading 0 during
+    exactly the cache-only degradation state this plane must expose."""
+    bat = RequestBatcher(engine, min_bucket=8, max_bucket=64,
+                         cache_size=64, queue_max=4)
+    # force the terminal ladder level (cache-only)
+    bat._ladder._level = len(bat._modes) - 1
+    shed0 = telem.default_registry().get("serve/shed")
+    errs0 = telem.default_registry().get("serve/errors")
+    with pytest.raises(OverloadedError, match="cache-only"):
+        bat.topk([7], 4)  # cold id under cache-only: shed
+    with pytest.raises(OverloadedError, match="uncached"):
+        bat.score([0], [1])  # scoring under cache-only: shed
+    assert telem.default_registry().get("serve/shed") == shed0 + 2
+    # sheds are NOT taxonomy errors: the window's rates never
+    # double-count one refusal as both shed and error
+    assert telem.default_registry().get("serve/errors") == errs0

@@ -1,0 +1,147 @@
+"""Prometheus exposition: name sanitation, escaping, format goldens,
+cumulative-bucket correctness, and the file snapshotter."""
+
+import math
+import os
+
+import pytest
+
+from hyperspace_tpu.telemetry.exposition import (MetricsFileWriter,
+                                                 escape_help,
+                                                 escape_label_value,
+                                                 render_prometheus,
+                                                 sanitize_name)
+from hyperspace_tpu.telemetry.registry import Registry
+
+
+def test_sanitize_name_golden():
+    # the ISSUE's canonical example, pinned
+    assert sanitize_name("serve/e2e_ms") == "hyperspace_serve_e2e_ms"
+    assert sanitize_name("jax/recompiles") == "hyperspace_jax_recompiles"
+    assert sanitize_name("a.b-c/d e") == "hyperspace_a_b_c_d_e"
+    # already-valid runes (incl. colon) pass through
+    assert sanitize_name("ok_name:x9") == "hyperspace_ok_name:x9"
+
+
+def test_escaping_golden():
+    assert escape_help("a\\b\nc") == "a\\\\b\\nc"
+    assert escape_label_value('say "hi"\n\\') == 'say \\"hi\\"\\n\\\\'
+
+
+def test_render_counters_gauges_golden():
+    reg = Registry()
+    reg.inc("serve/requests", 3)
+    reg.inc("jax/compile_s", 1.5)
+    reg.set_gauge("serve/degrade_level", 2)
+    text = render_prometheus(reg, labels={"process_index": 0})
+    lines = text.splitlines()
+    # families sorted, HELP carries the ORIGINAL registry name (the
+    # catalog round-trip key), TYPE is right, samples labeled
+    assert lines[0] == ("# HELP hyperspace_jax_compile_s jax/compile_s")
+    assert lines[1] == "# TYPE hyperspace_jax_compile_s counter"
+    assert lines[2] == 'hyperspace_jax_compile_s{process_index="0"} 1.5'
+    assert ("# TYPE hyperspace_serve_requests counter" in lines)
+    assert ('hyperspace_serve_requests{process_index="0"} 3' in lines)
+    assert ("# TYPE hyperspace_serve_degrade_level gauge" in lines)
+    assert ('hyperspace_serve_degrade_level{process_index="0"} 2'
+            in lines)
+    assert text.endswith("\n")
+
+
+def test_render_histogram_cumulative_buckets():
+    reg = Registry()
+    values = [0.5, 0.5, 2.0, 40.0, 40.0, 40.0, 1e9]  # 1e9 overflows
+    for v in values:
+        reg.observe("serve/e2e_ms", v)
+    text = render_prometheus(reg, labels={"process_index": 0})
+    bucket_lines = [ln for ln in text.splitlines()
+                    if ln.startswith("hyperspace_serve_e2e_ms_bucket")]
+    # cumulative counts are monotone and end at the full count
+    counts = [float(ln.rsplit(" ", 1)[1]) for ln in bucket_lines]
+    assert counts == sorted(counts)
+    assert 'le="+Inf"} 7' in bucket_lines[-1]
+    # every finite le covers exactly the values at/below it
+    for ln in bucket_lines[:-1]:
+        le = float(ln.split('le="')[1].split('"')[0])
+        cum = float(ln.rsplit(" ", 1)[1])
+        expect = sum(1 for v in values if v < le)
+        # bucket edges are geometric; the le reported is an upper bound
+        # so the cumulative count can never undercount values below it
+        assert cum >= expect - 1  # one-bucket boundary slack
+    # sum and count samples present and correct
+    assert f"hyperspace_serve_e2e_ms_count{{process_index=\"0\"}} 7" in text
+    sum_line = [ln for ln in text.splitlines()
+                if ln.startswith("hyperspace_serve_e2e_ms_sum")][0]
+    assert math.isclose(float(sum_line.rsplit(" ", 1)[1]), sum(values),
+                        rel_tol=1e-9)
+    assert "# TYPE hyperspace_serve_e2e_ms histogram" in text
+
+
+def test_render_compresses_edges_but_keeps_lower_bounds():
+    """The ~283-edge scheme compresses unchanged runs — a one-value
+    histogram is a handful of lines, not hundreds — but every
+    populated bucket keeps its TRUE lower-bound edge: PromQL's
+    histogram_quantile interpolates linearly inside a bucket, and a
+    missing lower bound would stretch the bucket down to the last
+    emitted edge and wreck the quantile estimate."""
+    reg = Registry()
+    reg.observe("serve/e2e_ms", 3.0)
+    text = render_prometheus(reg)
+    bucket_lines = [ln for ln in text.splitlines() if "_bucket" in ln]
+    # lower-bound edge (cum 0) + populated edge (cum 1) + +Inf
+    assert len(bucket_lines) == 3
+    les = [ln.split('le="')[1].split('"')[0] for ln in bucket_lines]
+    cums = [int(ln.rsplit(" ", 1)[1]) for ln in bucket_lines]
+    assert cums == [0, 1, 1]
+    lo_edge, hi_edge = float(les[0]), float(les[1])
+    # adjacent scheme edges: the populated bucket is ONE bucket wide,
+    # and the value sits inside it — linear interpolation inside
+    # [lo_edge, hi_edge] stays within the scheme's ~5% error bound
+    assert hi_edge / lo_edge == pytest.approx(1.1, rel=1e-4)  # %.6g edges
+    assert lo_edge < 3.0 <= hi_edge * 1.1
+
+
+def test_label_injection_is_escaped():
+    reg = Registry()
+    reg.inc("x", 1)
+    text = render_prometheus(reg, labels={"job": 'a"b\nc'})
+    assert 'job="a\\"b\\nc"' in text
+    assert "\nc\"" not in text.split("hyperspace_x", 1)[1].split("\n")[0]
+
+
+def test_file_writer_atomic_and_cadenced(tmp_path):
+    reg = Registry()
+    reg.inc("serve/requests", 1)
+    path = str(tmp_path / "metrics.prom")
+    w = MetricsFileWriter(path, 3600.0, registry=reg)
+    assert w.maybe_write() is True  # first call always lands
+    assert w.maybe_write() is False  # inside the cadence: no write
+    assert w.writes == 1
+    text = open(path).read()
+    assert "hyperspace_serve_requests" in text
+    reg.inc("serve/requests", 41)
+    w.write()  # forced (the run-end path)
+    assert "} 42" in open(path).read()
+    # no temp debris left behind
+    assert os.listdir(tmp_path) == ["metrics.prom"]
+
+
+def test_file_writer_rejects_bad_cadence(tmp_path):
+    with pytest.raises(ValueError, match="metrics_every"):
+        MetricsFileWriter(str(tmp_path / "m.prom"), 0.0)
+
+
+def test_non_finite_values_render_as_format_literals():
+    """One poisoned gauge (or an inf observation's histogram sum) must
+    not take down every future scrape: non-finite samples render as
+    the text format's NaN/+Inf/-Inf literals."""
+    reg = Registry()
+    reg.set_gauge("poisoned", float("nan"))
+    reg.set_gauge("hot", float("inf"))
+    reg.inc("cold", float("-inf"))
+    reg.observe("x_ms", float("inf"))  # poisons the histogram sum
+    text = render_prometheus(reg)
+    assert "hyperspace_poisoned{" in text and "} NaN" in text
+    assert "hyperspace_hot{" in text and "} +Inf" in text
+    assert "} -Inf" in text
+    assert "hyperspace_x_ms_sum" in text  # histogram still renders

@@ -1,0 +1,107 @@
+"""Windowed SLOs: ring-delta percentiles (never run-cumulative), the
+4.9% accuracy contract against exact percentiles, rates, and the
+latency-pressure ladder signal."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.telemetry.registry import Registry
+from hyperspace_tpu.telemetry.window import SloWindow
+
+
+def _mk(window_s=60.0, slots=12, reg=None, now=0.0):
+    """Window primed at a pinned fake clock (the ring's baseline is
+    the construction-time capture — traffic in the first slot is
+    already a delta against it)."""
+    reg = reg or Registry()
+    return reg, SloWindow(window_s, slots=slots, registry=reg, now=now)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="window_s"):
+        SloWindow(0.0)
+    with pytest.raises(ValueError, match="slots"):
+        SloWindow(10.0, slots=1)
+
+
+def test_empty_window_reports_none_distribution():
+    _reg, w = _mk()
+    rep = w.report(now=100.0)
+    assert rep["e2e_ms"] is None
+    assert rep["rate_qps"] == 0.0 and rep["shed_rate"] == 0.0
+
+
+def test_percentiles_from_ring_deltas_not_cumulative():
+    """A pre-window burst of HUGE latencies must not drag the window's
+    percentiles: the report subtracts the ring baseline, so only
+    in-window observations count — the acceptance contract."""
+    reg = Registry()
+    for _ in range(500):
+        reg.observe("serve/e2e_ms", 5000.0)  # ancient horror
+    # the window opens AFTER the burst: its construction-time capture
+    # is the baseline every report subtracts
+    w = SloWindow(60.0, slots=12, registry=reg, now=0.0)
+    rng = np.random.default_rng(0)
+    recent = np.exp(rng.uniform(np.log(0.5), np.log(50.0), size=4000))
+    for v in recent:
+        reg.observe("serve/e2e_ms", float(v))
+    rep = w.report(now=20.0)
+    e = rep["e2e_ms"]
+    assert e is not None and e["count"] == len(recent)
+    # ring-delta percentiles track the EXACT percentiles of the recent
+    # sample within the histogram's ~4.9% bound (+ tiny sampling slack)
+    for q, key in ((50, "p50"), (95, "p95"), (99, "p99")):
+        exact = float(np.percentile(recent, q))
+        assert e[key] == pytest.approx(exact, rel=0.06), (key, exact)
+    # cumulative would have been dominated by the 5000 ms burst
+    assert e["p99"] < 100.0
+
+
+def test_rates_are_per_second_deltas():
+    reg = Registry()
+    reg.inc("serve/requests", 100)   # pre-window traffic
+    reg.inc("serve/shed", 7)
+    w = SloWindow(10.0, slots=5, registry=reg, now=0.0)
+    reg.inc("serve/requests", 50)
+    reg.inc("serve/shed", 5)
+    reg.inc("serve/deadline_exceeded", 2)
+    reg.inc("serve/errors", 1)
+    rep = w.report(now=10.0)
+    assert rep["rate_qps"] == pytest.approx(5.0)
+    assert rep["shed_rate"] == pytest.approx(0.5)
+    assert rep["deadline_rate"] == pytest.approx(0.2)
+    assert rep["error_rate"] == pytest.approx(0.1)
+
+
+def test_ring_is_bounded_and_old_entries_age_out():
+    reg, w = _mk(window_s=10.0, slots=5)
+    for t in range(0, 100, 2):
+        w.tick(now=float(t))
+    # deque maxlen = slots+1: memory bounded however long the run
+    assert len(w._ring) <= 6
+    reg.inc("serve/requests", 10)
+    rep = w.report(now=100.0)
+    # baseline is at most window+slot old: the span can never grow
+    # unboundedly even after a long quiet stretch
+    assert rep["window_s"] <= 10.0 + w.slot_s + 1e-6
+
+
+def test_latency_pressure_signal():
+    reg, w = _mk(window_s=10.0, slots=5)
+    assert w.latency_pressure(50.0, now=0.0) == 0.0  # empty = calm
+    w.tick(now=0.0)
+    for _ in range(50):
+        reg.observe("serve/e2e_ms", 500.0)  # way past the SLO
+    # cache holds one slot: advance past it
+    assert w.latency_pressure(50.0, now=5.0) == 1.0
+    assert w.latency_pressure(0.0, now=5.0) == 0.0  # slo_ms=0 = off
+
+
+def test_tick_is_slot_gated():
+    reg, w = _mk(window_s=60.0, slots=12)  # slot = 5s
+    w.tick(now=0.0)
+    w.tick(now=1.0)
+    w.tick(now=2.0)
+    assert len(w._ring) == 1  # inside one slot: one capture
+    w.tick(now=5.1)
+    assert len(w._ring) == 2

@@ -264,3 +264,43 @@ def test_ckpt_counters_and_summary_bytes(tmp_path):
     assert summary["event"] == "telemetry_summary"
     assert summary["ctr/ckpt/bytes"] > 0  # async saves landed first
     assert summary["span/ckpt_save_n"] >= 2
+
+
+def test_metrics_out_writes_prometheus_snapshots(tmp_path):
+    """metrics_out= makes a training run scrapeable-by-file: the loop
+    writes Prometheus text at the cadence (first chunk always lands)
+    and forces a final write at run end, atomically (no temp debris)."""
+    import os
+
+    state, base = _stepper()
+    prom = str(tmp_path / "m" / "metrics.prom")
+    run = RunConfig(steps=8, eval_every=4, telemetry=True,
+                    metrics_out=prom, metrics_every=3600.0)
+    loop.run_loop(run, state, loop.make_chunked_stepper(base, 4),
+                  steps_per_call=4)
+    text = open(prom).read()
+    assert "# TYPE hyperspace_train_dispatches counter" in text
+    # HELP carries the original registry name (the catalog join key)
+    assert "# HELP hyperspace_train_dispatches train/dispatches" in text
+    assert "# TYPE hyperspace_train_dispatch_ms histogram" in text
+    assert os.listdir(tmp_path / "m") == ["metrics.prom"]
+    # the final forced write carries the run's closing dispatch count
+    line = [l for l in text.splitlines()
+            if l.startswith("hyperspace_train_dispatches{")][0]
+    assert float(line.rsplit(" ", 1)[1]) == 2.0  # 8 steps / chunk 4
+
+
+def test_metrics_out_off_constructs_nothing(monkeypatch):
+    """The default (no metrics_out) never constructs the writer — the
+    zero-cost-when-off contract, proven by making construction fatal."""
+    from hyperspace_tpu.telemetry import exposition
+
+    def _boom(*_a, **_kw):
+        raise AssertionError(
+            "MetricsFileWriter constructed without metrics_out")
+
+    monkeypatch.setattr(exposition, "MetricsFileWriter", _boom)
+    state, base = _stepper()
+    run = RunConfig(steps=4, eval_every=4, telemetry=False)
+    loop.run_loop(run, state, loop.make_chunked_stepper(base, 4),
+                  steps_per_call=4)
