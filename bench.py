@@ -1174,10 +1174,13 @@ def bench_big_table(repeats: int = 1, *, rows: int = 10_000_000,
     - **build_s**: the host-streamed IVF build (``serve/index.py``
       ``host_resident`` path — sampled k-means++ seeding, chunked
       Lloyd, spill on gathered rows only);
-    - **lanes** f32 / bf16 / int8: measured per-lane scan-copy bytes
-      (``table_mb`` — the capacity story: int8 is ~4× f32) and
-      ``qps_at_recall99`` — warm probing queries/s at the smallest
-      nprobe keeping recall@10 >= 0.99 vs the exact f32 scan;
+    - **lanes** f32 / bf16 / int8 / int4 / pq: measured per-lane
+      scan-copy bytes (``table_mb`` — the capacity story: int8 is ~4×
+      f32, int4 ~6×, pq ~10× at the default subspace count; pq counts
+      its codebooks) and ``qps_at_recall99`` — warm probing queries/s
+      at the smallest nprobe keeping recall@10 >= 0.99 vs the exact
+      f32 scan (a lane whose quantization error never reaches 0.99
+      reports 0.0 — the pq row is the honest one to watch);
     - **train**: host-resident planned-sparse step time
       (``train/host_embed.py`` — hot-row cache + chunk write-back) vs
       the in-HBM packed trainer at ``train_rows`` (a size both fit),
@@ -1251,7 +1254,7 @@ def bench_big_table(repeats: int = 1, *, rows: int = 10_000_000,
     del exact
     value = 0.0
     widths = [npb for npb in (1, 2, 4, 8, 16) if npb < ncells]
-    for lane in ("f32", "bf16", "int8"):
+    for lane in ("f32", "bf16", "int8", "int4", "pq"):
         try:
             out = {"probes": {}, "qps_at_recall99": 0.0}
             # ONE engine per lane at the widest probe; each ladder step
@@ -1263,6 +1266,8 @@ def bench_big_table(repeats: int = 1, *, rows: int = 10_000_000,
             mb = e.scan_table.nbytes
             if e.scan_scale is not None:
                 mb += e.scan_scale.nbytes
+            if getattr(e, "pq_codebooks", None) is not None:
+                mb += e.pq_codebooks.nbytes  # trained centers ride along
             out["table_mb"] = round(mb / 2**20, 1)
             detail["table_mb"][lane] = out["table_mb"]
             qps_at = 0.0
@@ -1412,6 +1417,13 @@ _COMPACT_FIELDS = (
     ("big_qps_r99_int8", ("detail", "lanes", "int8", "qps_at_recall99")),
     ("big_table_mb_int8", ("detail", "big_table", "table_mb", "int8")),
     ("big_table_mb_int8", ("detail", "table_mb", "int8")),
+    # r16 sub-int8 lanes: the capacity ladder below int8 (int4 packed
+    # nibbles + f16 scales; pq codes + codebooks) — same lower-is-
+    # better mb gating via bench_trend's size tokens
+    ("big_table_mb_int4", ("detail", "big_table", "table_mb", "int4")),
+    ("big_table_mb_int4", ("detail", "table_mb", "int4")),
+    ("big_table_mb_pq", ("detail", "big_table", "table_mb", "pq")),
+    ("big_table_mb_pq", ("detail", "table_mb", "pq")),
     ("big_build_s", ("detail", "big_table", "build_s")),
     ("big_build_s", ("detail", "build_s")),
     ("big_host_step_ms",

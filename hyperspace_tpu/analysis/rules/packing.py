@@ -1,0 +1,91 @@
+"""packing-literal: nibble pack/unpack idioms stay in quant.py/kernels/.
+
+Historical incident: ISSUE 16's int4 lane stores two's-complement
+nibbles in a PLANAR layout (byte ``j`` = element ``j`` low, element
+``ceil(D/2)+j`` high — serve/quant.py).  During development the traced
+unpack was hand-copied into the engine's two-stage scan with raw
+``& 15`` / ``>> 4`` literals; any third copy that drifts (interleaved
+order, missed sign extension, ``0xF0`` mask without the shift) decodes
+a VALID-looking table into garbage coordinates — no crash, just wrong
+neighbors.  The layout is load-bearing and must have exactly two
+implementations: ``serve/quant.py`` (host + traced twins) and
+``kernels/scan_topk.py`` (in-register tile unpack).
+
+Flagged in any other package file:
+
+- a ``&`` whose either operand is the literal ``0xF`` (15) or ``0xF0``
+  (240) — the nibble masks.  ``0xFF`` (255) is a BYTE mask and never
+  fires (``data/mnist.py``'s IDX-header ``magic & 0xFF`` is legitimate);
+- ``x >> 4`` / ``x << 4`` where ``x`` is not itself a constant — the
+  nibble shifts (pure constant arithmetic like ``1 << 4`` never fires).
+
+Escape: ``# hyperlint: disable=packing-literal — reason``; the fix is
+usually to call ``serve/quant.py``'s ``pack_int4_rows`` /
+``unpack_int4_rows`` / ``unpack_int4_jnp`` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+
+ALLOWED_FILE = "hyperspace_tpu/serve/quant.py"
+ALLOWED_DIR = "hyperspace_tpu/kernels/"
+
+# the two nibble masks; 0xFF deliberately absent
+_NIBBLE_MASKS = (0xF, 0xF0)
+
+
+def in_scope(rel: str) -> bool:
+    """Package-scoped like precision-literal: the analysis package is
+    self-exempt (lint code names the tokens it hunts)."""
+    rel = rel.replace("\\", "/")
+    if not rel.startswith("hyperspace_tpu/"):
+        return False
+    if rel.startswith("hyperspace_tpu/analysis/"):
+        return False
+    return rel != ALLOWED_FILE and not rel.startswith(ALLOWED_DIR)
+
+
+def _is_const_int(node: ast.AST, values=None) -> bool:
+    return (isinstance(node, ast.Constant)
+            and type(node.value) is int
+            and (values is None or node.value in values))
+
+
+def _packing_nodes(ctx: FileContext):
+    """(node, what) per nibble pack/unpack idiom in the tree."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.BinOp):
+            continue
+        if isinstance(node.op, ast.BitAnd):
+            for side in (node.left, node.right):
+                if _is_const_int(side, _NIBBLE_MASKS):
+                    yield node, f"nibble mask `& {side.value:#x}`"
+                    break
+        elif isinstance(node.op, (ast.RShift, ast.LShift)):
+            if (_is_const_int(node.right, (4,))
+                    and not isinstance(node.left, ast.Constant)):
+                tok = ">>" if isinstance(node.op, ast.RShift) else "<<"
+                yield node, f"nibble shift `{tok} 4`"
+
+
+class PackingLiteralRule(Rule):
+    id = "packing-literal"
+    severity = "error"
+    summary = ("raw int4 nibble pack/unpack idiom outside "
+               "serve/quant.py/kernels/ — the planar layout must not fork")
+
+    def check_file(self, ctx: FileContext):
+        if not in_scope(ctx.rel):
+            return []
+        findings = []
+        for node, what in _packing_nodes(ctx):
+            findings.append(self.finding(
+                ctx, node,
+                f"{what} outside the int4 packing boundary — call "
+                "serve/quant.py's pack_int4_rows/unpack_int4_rows/"
+                "unpack_int4_jnp (or the kernel's tile unpack) instead "
+                "of re-deriving the planar nibble layout"))
+        return findings

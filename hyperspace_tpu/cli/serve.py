@@ -83,6 +83,10 @@ class ServeConfig:
     # ncells=K alone also implies index=1.
     index: bool = False
     ncells: int = 0
+    # export: also ship a packed scan lane (int4 | pq) in the artifact
+    # (serve/artifact.py QuantPayload — pq freezes the trained
+    # codebooks so every replica ranks through the same centers)
+    quant: str = ""
     # query / serve
     k: int = 10
     ids: str = ""                 # comma-separated query ids (one-shot topk)
@@ -106,7 +110,11 @@ class ServeConfig:
     # bf16 table copy, rescore candidates in f32 — docs/precision.md) |
     # int8 (per-row symmetric quantized scan copy at a quarter of the
     # table bytes, same f32 rescore — docs/serving.md "Quantized scan
-    # lane")
+    # lane") | int4 (two nibbles per byte + f16 scale, ~1/6 the bytes) |
+    # pq (product-quantized codes + hyperbolic-aware codebooks, wider
+    # over-fetch — docs/serving.md "Sub-int8 lanes"; an artifact
+    # exported with a matching quant payload serves its shipped
+    # codes/codebooks instead of re-packing)
     precision: str = "f32"
     # IVF probing (query/serve): cells probed per query.  0 = exact
     # scan; needs an artifact exported with an index.  nprobe >= ncells
@@ -312,12 +320,15 @@ def run_export(cfg: ServeConfig) -> dict:
         if cfg.ncells < 0:
             raise SystemExit(f"ncells={cfg.ncells}: want 0 (auto) or >= 2")
         index_ncells = cfg.ncells or -1  # <= 0 = auto (~sqrt(N))
+    if cfg.quant and cfg.quant not in ("int4", "pq"):
+        raise SystemExit(f"quant={cfg.quant!r}: want int4 or pq")
     try:
         art = export_from_checkpoint(
             cfg.ckpt, cfg.out, workload=cfg.workload,
             model_config=model_config,
             step=None if cfg.step < 0 else cfg.step,
-            overwrite=cfg.overwrite, index_ncells=index_ncells)
+            overwrite=cfg.overwrite, index_ncells=index_ncells,
+            quant_lane=cfg.quant or None)
     except ValueError as e:  # bad ncells for the table size: usage
         raise SystemExit(str(e)) from None
     out = {"mode": "export", "out": cfg.out, "workload": cfg.workload,
@@ -327,6 +338,9 @@ def run_export(cfg: ServeConfig) -> dict:
         out["index"] = {"ncells": art.index.ncells,
                         "max_cell": art.index.max_cell,
                         "fingerprint": art.index.fingerprint}
+    if art.quant is not None:
+        out["quant"] = {"lane": art.quant.lane,
+                        "fingerprint": art.quant.fingerprint}
     return out
 
 

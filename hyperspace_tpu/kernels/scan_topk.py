@@ -49,6 +49,33 @@ in VMEM) to distance-scan-top-k:
   identical distance math — same f32 arithmetic, same carry, same twin
   contract; only the table bytes shrink.  ``scale=None`` (default) is
   byte-for-byte the pre-int8 program.
+- **int4 tables** (``packed=True`` + ``scale=``) stream at an EIGHTH:
+  the slab is the planar two-nibble packing of ``serve/quant.py``
+  (byte column j = element j low nibble, element hw+j high nibble,
+  hw = ceil(D/2)), and the in-register unpack is two shifts, a
+  sign-extend and a lane concatenate — element 0 stays in lane 0, so
+  the Lorentz time flip and every Gram closed form run unchanged on
+  the ``[bm, 2*hwp]`` unpacked tile.  Queries are re-laid to the same
+  split-lane layout by :func:`int4_query_layout` (zero lanes between
+  the halves are exact no-ops — sums of products).
+- **PQ tables** (:func:`scan_topk_pq`) replace the Gram matmul with
+  ADC: the slab is one uint8 centroid code per subspace ([M, m]), the
+  per-query input is a lookup table of subspace partial sums
+  (:func:`pq_lut`), and the tile math is a one-hot matmul
+  ``dotT(lut, onehot(codes))`` whose row sums ARE the Lorentz inner
+  product (hyperbolic lanes) or the squared distance (euclidean) of
+  the RECONSTRUCTED rows — one arcosh/sqrt at the end, same carry,
+  same twin contract.
+- **Explicit double-buffered DMA pipeline** (ISSUE 16): the slab-side
+  variants keep the grid over query blocks only and walk the table
+  tiles in-kernel — two VMEM tile slots, the async HBM→VMEM copy of
+  tile i+1 issued BEFORE tile i's Gram/fold math, one DMA semaphore
+  per slot (the slab and its scale/code companions live in
+  ``pltpu.ANY`` memory space).  The tile ORDER and math are exactly
+  the implicit-grid schedule's, so the twin (and results) are
+  unchanged; only the copy/compute overlap is now explicit.  The
+  candidate variant keeps the implicit grid pipeline (its stream is a
+  pre-gathered per-query block, already double-buffered by Pallas).
 
 **Twin contract** (the ``kernels/distmat.py`` convention, tightened):
 the XLA twin is not merely value-close — it executes the *same padded
@@ -100,6 +127,9 @@ FUSED_MAX_DIM = 1024
 # always answer through the same path whatever batch it rode in on
 CAND_GATHER_BUDGET = 256 * 1024 * 1024
 NOMINAL_CAND_BATCH = 1024  # the batcher's default max bucket
+# PQ subspace cap: the per-query LUT block is [bq, m*256] f32 — past
+# this m it stops fitting the VMEM schedule
+FUSED_MAX_PQ_M = 8
 
 _KINDS = ("poincare", "lorentz", "euclidean")
 _SLAB_BQ = 256   # query rows per block (slab variant)
@@ -118,6 +148,15 @@ def supports(spec: tuple, *, k: int, dim: int) -> bool:
             and int(dim) <= FUSED_MAX_DIM)
 
 
+def supports_pq(spec: tuple, *, k: int, m: int) -> bool:
+    """Can :func:`scan_topk_pq` serve this (spec, k, m)?  Callers gate
+    on this and fall back to the two-stage decode-and-scan (the engine's
+    PQ path) when False — product specs always fall back (their distance
+    is not additive across a uniform subspace grid)."""
+    return (kind_supported(spec) and 1 <= int(k) <= FUSED_MAX_K
+            and 1 <= int(m) <= FUSED_MAX_PQ_M)
+
+
 def supports_cand(spec: tuple, *, k: int, dim: int, cand: int) -> bool:
     """Can :func:`scan_topk_cand` serve this shape?  Adds the gathered
     candidate-row footprint cap to the :func:`supports` rules — judged
@@ -134,7 +173,8 @@ def supports_cand(spec: tuple, *, k: int, dim: int, cand: int) -> bool:
 
 def fused_tile_rows(dim: int, dtype, k: int, *,
                     tile_budget: int = S.VMEM_BUDGET,
-                    bq: int = _SLAB_BQ, allow_tuned: bool = True) -> int:
+                    bq: int = _SLAB_BQ, allow_tuned: bool = True,
+                    lane: str = "dense", pq_m: int = 0) -> int:
     """Table-tile rows for the slab kernel.
 
     A **tuned entry** for this (dim, dtype, k) on the current device
@@ -153,9 +193,18 @@ def fused_tile_rows(dim: int, dtype, k: int, *,
     bound a real chip's Mosaic enforces, so a stale table (tuned under
     a looser footprint) can never hand the kernel a tile that only the
     CPU twin would accept.  The engine's ``auto_chunk_rows`` delegates
-    here for ``scan_mode="fused"``."""
+    here for ``scan_mode="fused"``.
+
+    ``lane`` extends the model to the packed lanes (ISSUE 16) without
+    touching the dense answers: ``"int4"`` counts the half-width packed
+    byte tile PLUS its full-width f32 unpack temporary and the scale
+    block; ``"pq"`` (with ``pq_m`` subspaces) counts the [bm, 128] code
+    tile, the per-query [bq, m*256] LUT block and the one-hot matmul
+    temporaries.  Packed lanes never consult the tuned table (its keys
+    are element dtypes; the static model is the only authority)."""
     tuned = None
-    if allow_tuned and tile_budget == S.VMEM_BUDGET and bq == _SLAB_BQ:
+    if (lane == "dense" and allow_tuned and tile_budget == S.VMEM_BUDGET
+            and bq == _SLAB_BQ):
         from hyperspace_tpu.kernels import autotune
 
         tuned = autotune.lookup("slab", dim, dtype, k)
@@ -171,6 +220,23 @@ def fused_tile_rows(dim: int, dtype, k: int, *,
     scale_bytes = (2 * 128 * 4) if dt.kind == "i" else 0
 
     def footprint(bm: int) -> int:
+        if lane == "int4":
+            wp = S.round_up((int(dim) + 1) // 2, 128)  # packed byte lanes
+            return (2 * bm * wp               # double-buffered packed tile
+                    + 2 * bm * 128 * 4        # streamed f32 scale block
+                    + bm * 2 * wp * 4         # unpacked f32 tile temporary
+                    + bq * 2 * wp * 4         # query block (split-lane)
+                    + bq * 128 * 4
+                    + 2 * bq * kp * 4
+                    + 3 * bq * (kp + bm) * 4)
+        if lane == "pq":
+            mlut = max(int(pq_m), 1) * 256
+            return (2 * bm * 128              # double-buffered code tile
+                    + bq * mlut * 4           # per-query LUT block
+                    + 2 * bm * mlut * 4       # one-hot + compare temporaries
+                    + bq * 128 * 4
+                    + 2 * bq * kp * 4
+                    + 3 * bq * (kp + bm) * 4)
         return (2 * bm * dp * it          # double-buffered table tile
                 + bm * scale_bytes        # int8: streamed scale block
                 + bq * dp * 4             # query block (f32 compute copy)
@@ -267,6 +333,105 @@ def _pair_dist_b(kind: str, c, q: jax.Array, rows: jax.Array) -> jax.Array:
     den = jnp.maximum((1.0 - c * xx) * (1.0 - c * yy), S.EPS_F32)
     u = 2.0 * c * d2 / den
     return S.karcosh1p(u) / jnp.maximum(S.ksafe_sqrt(c), S.MIN_NORM_F32)
+
+
+def _unpack_int4_tile(raw: jax.Array) -> jax.Array:
+    """Shared in-register int4 unpack (kernel body AND twin): a packed
+    [r, wp] uint8 tile → f32 [r, 2*wp] codes in the planar split-lane
+    layout (low nibbles first, sign-extended two's complement).  Zero
+    pad bytes unpack to zero codes — exact no-ops downstream."""
+    t = raw.astype(jnp.int32)
+    lo = t & 15
+    hi = t >> 4
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    return jnp.concatenate([lo, hi], axis=-1).astype(jnp.float32)
+
+
+def int4_query_layout(q: jax.Array, dim: int) -> jax.Array:
+    """Re-lay f32 queries [B, dim] to the unpacked int4 tile's
+    split-lane layout [B, 2*wp] (``wp = round_up(ceil(dim/2), 128)``):
+    elements 0..hw-1 in lanes 0.., elements hw..dim-1 starting at lane
+    wp.  The zero lanes between the halves match the tile's unpacked
+    pad bytes, so every Gram closed form is exact; element 0 stays in
+    lane 0 (the Lorentz time flip).  Shared by the launcher and the
+    twin — ONE layout recipe."""
+    b = q.shape[0]
+    hw = (int(dim) + 1) // 2
+    wp = S.round_up(hw, 128)
+    out = jnp.zeros((b, 2 * wp), jnp.float32)
+    out = out.at[:, :hw].set(q[:, :hw].astype(jnp.float32))
+    out = out.at[:, wp:wp + (dim - hw)].set(
+        q[:, hw:dim].astype(jnp.float32))
+    return out
+
+
+def pq_lut(q_lift: jax.Array, codebooks: jax.Array, *,
+           kind: str) -> jax.Array:
+    """Per-query ADC lookup table [B, m*256] f32 from LIFTED queries
+    [B, >=m*ds] and codebooks [m, 256, ds] (serve/quant.py).
+
+    For the lorentz-gram families the scan distance depends on a
+    candidate row only through ``⟨q_L, y_L⟩_L``, which is additive over
+    subspaces once the GLOBAL time lane's sign is folded into the query
+    — so ``LUT[b, s*256+j] = <q_s ⊙ flip_s, cb[s, j]>`` and the tile's
+    row sum IS the Lorentz inner product of q with the reconstruction.
+    For euclidean, ``LUT[b, s*256+j] = ‖q_s − cb[s, j]‖²`` and the row
+    sum is the squared distance.  :func:`_pq_dist_from_sum` applies the
+    one closing transform."""
+    m, ncent, ds = codebooks.shape
+    b = q_lift.shape[0]
+    if q_lift.shape[1] < m * ds:
+        # the codebooks' pad lanes are exactly zero (trained on
+        # zero-padded lifts), so zero query pad lanes are exact no-ops
+        q_lift = jnp.concatenate(
+            [q_lift, jnp.zeros((b, m * ds - q_lift.shape[1]),
+                               q_lift.dtype)], axis=1)
+    qs = q_lift[:, :m * ds].reshape(b, m, ds).astype(jnp.float32)
+    cb = codebooks.astype(jnp.float32)
+    if kind == "euclidean":
+        diff = qs[:, :, None, :] - cb[None]              # [B, m, 256, ds]
+        lut = jnp.sum(diff * diff, axis=-1)
+    else:
+        # global lane 0 = the lift's time coordinate = subspace 0 lane 0
+        sign = jnp.ones((m, ds), jnp.float32).at[0, 0].set(-1.0)
+        lut = jnp.einsum("bmd,mjd->bmj", qs * sign[None], cb,
+                         precision=jax.lax.Precision.HIGHEST)
+    return lut.reshape(b, m * ncent)
+
+
+def _pq_dist_from_sum(kind: str, c, ssum: jax.Array) -> jax.Array:
+    """Close the ADC partial sums into distances (same clamping policy
+    as :func:`_pair_dist`, applied to the RECONSTRUCTED rows)."""
+    if kind == "euclidean":
+        return S.ksafe_sqrt(ssum)
+    u = jnp.maximum(-c * ssum - 1.0, 0.0)
+    return S.karcosh1p(u) / jnp.maximum(S.ksafe_sqrt(c), S.MIN_NORM_F32)
+
+
+def _pq_tile(kind: str, exclude_self: bool, c, n, nloc, col0, loc_base,
+             m: int, lut: jax.Array, qi: jax.Array, codes: jax.Array):
+    """One PQ slab tile → masked distances + global column ids, the
+    ``_slab_tile`` contract via ADC: ``codes`` [r, 128] int32 (the
+    uint8 code tile widened; lanes >= m are pad), ``lut`` [bq, m*256].
+    The per-subspace one-hot matmul sums LUT entries row-wise — MXU
+    work in the kernel, the identical dot in the twin (bitwise: 0/1
+    weights select exact copies)."""
+    parts = []
+    for s in range(m):
+        cent = jax.lax.broadcasted_iota(
+            jnp.int32, (codes.shape[0], 256), dimension=1)
+        parts.append((codes[:, s:s + 1] == cent).astype(jnp.float32))
+    oh = jnp.concatenate(parts, axis=-1)                 # [r, m*256]
+    ssum = S.dotT(lut, oh)                               # [bq, r]
+    d = _pq_dist_from_sum(kind, c, ssum)
+    lcol = jax.lax.broadcasted_iota(jnp.int32, d.shape, dimension=1)
+    loc = loc_base + lcol
+    gcol = (col0 + loc).astype(jnp.int32)
+    mask = (loc >= nloc) | (gcol >= n)
+    if exclude_self:
+        mask = mask | (gcol == qi)
+    return jnp.where(mask, jnp.inf, d), gcol
 
 
 def _slab_tile(kind: str, exclude_self: bool, c, n, nloc, col0, loc_base,
@@ -385,108 +550,160 @@ def _scale_pad(scale, bm):
     return S.pad_rows_lanes(s, rows_to=bm)
 
 
-def _slab_body(kind: str, k: int, bm: int, exclude_self: bool,
-               quant: bool = False):
-    def body(c_ref, col0_ref, n_ref, nloc_ref, q_ref, qi_ref, y_ref,
+def _tile_rows_f32(lane: str, raw: jax.Array, sblk) -> jax.Array:
+    """The ONE tile-dequantize recipe (kernel body AND twin consume it
+    on identically shaped blocks): dense/bf16 tiles cast to f32, scaled
+    lanes multiply the per-row scale in-register, int4 tiles unpack
+    first (serve/quant.py's planar layout)."""
+    if lane == "int4":
+        return _unpack_int4_tile(raw) * sblk[:, :1]
+    rows = raw.astype(jnp.float32)
+    if lane == "int8":
+        rows = rows * sblk[:, :1]
+    return rows
+
+
+def _slab_body(kind: str, k: int, bm: int, ntiles: int, exclude_self: bool,
+               lane: str = "dense"):
+    """The double-buffered slab kernel body (module docstring "Explicit
+    double-buffered DMA pipeline"): grid over query blocks only, table
+    tiles walked in-kernel — tile i+1's HBM→VMEM copy starts before
+    tile i's distance/fold math, alternating two VMEM slots."""
+    quant = lane in ("int8", "int4")
+
+    def body(c_ref, col0_ref, n_ref, nloc_ref, q_ref, qi_ref, y_hbm,
              *rest):
-        if quant:  # int8 slab: the per-row scale block rides after it
-            s_ref, od_ref, oi_ref, cd_scr, ci_scr = rest
+        if quant:  # scaled slab: the per-row scale rides beside it
+            s_hbm = rest[0]
+            rest = rest[1:]
+        od_ref, oi_ref = rest[:2]
+        if quant:
+            cd_scr, ci_scr, ybuf, ysem, sbuf, ssem = rest[2:]
         else:
-            od_ref, oi_ref, cd_scr, ci_scr = rest
-        jt = pl.program_id(1)
-
-        @pl.when(jt == 0)
-        def _init():
-            cd_scr[:] = jnp.full_like(cd_scr, jnp.inf)
-            ci_scr[:] = jnp.full_like(ci_scr, -1)
-
+            cd_scr, ci_scr, ybuf, ysem = rest[2:]
+        cd_scr[:] = jnp.full_like(cd_scr, jnp.inf)
+        ci_scr[:] = jnp.full_like(ci_scr, -1)
         c = c_ref[0, 0]
         col0 = col0_ref[0, 0]
         n = n_ref[0, 0]
         nloc = nloc_ref[0, 0]
         q = q_ref[:].astype(jnp.float32)
         qi = qi_ref[:, :1]
-        rows = y_ref[:].astype(jnp.float32)
+
+        def copy_y(slot, i):
+            return pltpu.make_async_copy(
+                y_hbm.at[pl.ds(i * bm, bm), :], ybuf.at[slot],
+                ysem.at[slot])
+
+        def copy_s(slot, i):
+            return pltpu.make_async_copy(
+                s_hbm.at[pl.ds(i * bm, bm), :], sbuf.at[slot],
+                ssem.at[slot])
+
+        copy_y(0, 0).start()
         if quant:
-            # in-register dequantize: the ONLY int8-vs-float difference
-            # on the whole path (serve/quant.py) — one multiply before
-            # the shared tile math
-            rows = rows * s_ref[:, :1]
-        d, gids = _slab_tile(kind, exclude_self, c, n, nloc, col0,
-                             jt * bm, q, qi, rows)
-        skip = _prune(cd_scr[:], d, k)
+            copy_s(0, 0).start()
 
-        @pl.when(jnp.logical_not(skip))
-        def _merge_tile():
-            ncd, nci = _merge(cd_scr[:], ci_scr[:], d, gids, k)
-            cd_scr[:] = ncd
-            ci_scr[:] = nci
+        def tile(jt, _):
+            slot = jax.lax.rem(jt, 2)
 
-        @pl.when(jt == pl.num_programs(1) - 1)
-        def _write():
-            od_ref[:] = cd_scr[:]
-            oi_ref[:] = ci_scr[:]
+            @pl.when(jt + 1 < ntiles)
+            def _prefetch():
+                nxt = jax.lax.rem(jt + 1, 2)
+                copy_y(nxt, jt + 1).start()
+                if quant:
+                    copy_s(nxt, jt + 1).start()
+
+            copy_y(slot, jt).wait()
+            sblk = None
+            if quant:
+                copy_s(slot, jt).wait()
+                sblk = sbuf[slot]
+            rows = _tile_rows_f32(lane, ybuf[slot], sblk)
+            d, gids = _slab_tile(kind, exclude_self, c, n, nloc, col0,
+                                 jt * bm, q, qi, rows)
+            skip = _prune(cd_scr[:], d, k)
+
+            @pl.when(jnp.logical_not(skip))
+            def _merge_tile():
+                ncd, nci = _merge(cd_scr[:], ci_scr[:], d, gids, k)
+                cd_scr[:] = ncd
+                ci_scr[:] = nci
+
+            return 0
+
+        jax.lax.fori_loop(0, ntiles, tile, 0)
+        od_ref[:] = cd_scr[:]
+        oi_ref[:] = ci_scr[:]
 
     return body
 
 
 def _launch_slab(slab, q, q_idx, col0, *, kind, c, k, n, bm, exclude_self,
-                 mode_, scale=None):
+                 mode_, scale=None, lane="dense"):
     b = q.shape[0]
     bq, dp, kp, bm = _slab_schedule(b, q.shape[1], k, bm)
     nloc = slab.shape[0]
     yp, qp, qip = _slab_pad(slab, q, q_idx, bq, bm)
     bp, mp_ = qp.shape[0], yp.shape[0]
-    grid = (bp // bq, mp_ // bm)
-    smem = lambda: pl.BlockSpec((1, 1), lambda iq, jt: (0, 0),
+    ntiles = mp_ // bm
+    wp = yp.shape[1]  # packed byte lanes (int4) or dp
+    grid = (bp // bq,)
+    smem = lambda: pl.BlockSpec((1, 1), lambda iq: (0, 0),
                                 memory_space=pltpu.SMEM)
     i32 = lambda v: jnp.asarray(v, jnp.int32).reshape(1, 1)
     in_specs = [
         smem(), smem(), smem(), smem(),
-        pl.BlockSpec((bq, dp), lambda iq, jt: (iq, 0),
+        pl.BlockSpec((bq, dp), lambda iq: (iq, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((bq, 128), lambda iq, jt: (iq, 0),
+        pl.BlockSpec((bq, 128), lambda iq: (iq, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((bm, dp), lambda iq, jt: (jt, 0),
-                     memory_space=pltpu.VMEM),
+        # the slab stays in HBM: the body's DMA pipeline streams it
+        pl.BlockSpec(memory_space=pltpu.ANY),
     ]
     operands = [S.c_smem(c), i32(col0), i32(n), i32(nloc), qp, qip, yp]
+    scratch = [
+        pltpu.VMEM((bq, kp), jnp.float32),
+        pltpu.VMEM((bq, kp), jnp.int32),
+        # two tile slots + one DMA semaphore per slot
+        pltpu.VMEM((2, bm, wp), yp.dtype),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
     if scale is not None:
-        # the per-row scale streams tile-aligned with the slab
-        in_specs.append(pl.BlockSpec((bm, 128), lambda iq, jt: (jt, 0),
-                                     memory_space=pltpu.VMEM))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
         operands.append(_scale_pad(scale, bm))
+        scratch += [pltpu.VMEM((2, bm, 128), jnp.float32),
+                    pltpu.SemaphoreType.DMA((2,))]
     od, oi = pl.pallas_call(
-        _slab_body(kind, k, bm, exclude_self, quant=scale is not None),
+        _slab_body(kind, k, bm, ntiles, exclude_self, lane=lane),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((bq, kp), lambda iq, jt: (iq, 0),
+            pl.BlockSpec((bq, kp), lambda iq: (iq, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((bq, kp), lambda iq, jt: (iq, 0),
+            pl.BlockSpec((bq, kp), lambda iq: (iq, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bp, kp), jnp.float32),
             jax.ShapeDtypeStruct((bp, kp), jnp.int32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, kp), jnp.float32),
-            pltpu.VMEM((bq, kp), jnp.int32),
-        ],
+        scratch_shapes=scratch,
         compiler_params=S.tpu_compiler_params(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel",)),
         interpret=S.interpret_flag(mode_),
     )(*operands)
     return od[:b, :k], oi[:b, :k]
 
 
 def _t_scan_topk(slab, q, q_idx, col0, *, kind, c, k, n, bm, exclude_self,
-                 scale=None):
+                 scale=None, lane="dense"):
     """XLA twin: the SAME padded block schedule as the Pallas launcher,
-    folded with the same shared tile/merge functions — bitwise-identical
-    to interpreter mode on CPU (tested).  Runs the per-query-block walk
-    as a ``fori_loop`` over tiles with the carry as loop state."""
+    folded with the same shared tile/merge/dequantize functions —
+    bitwise-identical to interpreter mode on CPU (tested).  Runs the
+    per-query-block walk as a ``fori_loop`` over tiles with the carry
+    as loop state (the kernel's DMA pipeline reorders COPIES only, so
+    the twin needs no pipeline model)."""
     b = q.shape[0]
     bq, dp, kp, bm = _slab_schedule(b, q.shape[1], k, bm)
     nloc = jnp.int32(slab.shape[0])
@@ -503,12 +720,10 @@ def _t_scan_topk(slab, q, q_idx, col0, *, kind, c, k, n, bm, exclude_self,
 
         def tile_body(jt, carry, qb=qb, qib=qib):
             cd, ci = carry
-            rows = jax.lax.dynamic_slice_in_dim(
-                yp, jt * bm, bm).astype(jnp.float32)
-            if sp is not None:
-                # the kernel body's in-register dequantize, same op
-                rows = rows * jax.lax.dynamic_slice_in_dim(
-                    sp, jt * bm, bm)[:, :1]
+            raw = jax.lax.dynamic_slice_in_dim(yp, jt * bm, bm)
+            sblk = None if sp is None else jax.lax.dynamic_slice_in_dim(
+                sp, jt * bm, bm)
+            rows = _tile_rows_f32(lane, raw, sblk)
             d, gids = _slab_tile(kind, exclude_self, c32, n_, nloc, col0_,
                                  jt * bm, qb, qib, rows)
             return _fold(cd, ci, d, gids, k)
@@ -525,7 +740,8 @@ def _t_scan_topk(slab, q, q_idx, col0, *, kind, c, k, n, bm, exclude_self,
 
 
 def scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, n: int,
-              exclude_self: bool = False, tile_rows: int = 0, scale=None):
+              exclude_self: bool = False, tile_rows: int = 0, scale=None,
+              packed: bool = False):
     """Streaming top-k of ``q`` [B, D] against the shared row block
     ``slab`` [M, D] → ``(dists ascending f32 [B, k], ids int32 [B, k])``.
 
@@ -536,31 +752,228 @@ def scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, n: int,
     reachable candidates are ``(+inf, −1)``.  ``tile_rows`` (multiple of
     128; 0 = :func:`fused_tile_rows`) is the streamed tile height.
 
-    ``scale`` (the int8 lane, serve/quant.py): per-row f32 dequant
-    scales ([M] or [M, 1]) for an int8 ``slab`` — each streamed tile is
+    ``scale`` (the int8 lane, serve/quant.py): per-row dequant scales
+    ([M] or [M, 1]) for an int8 ``slab`` — each streamed tile is
     dequantized in-register (``rows.astype(f32) * scale``) before the
     shared distance math, so results are those of the DEQUANTIZED table
     at f32 arithmetic, at a quarter of the table bytes.
 
+    ``packed=True`` (the int4 lane): ``slab`` is the planar two-nibble
+    packing [M, ceil(D/2)] uint8 of ``serve/quant.py:pack_int4_rows``
+    and ``scale`` is REQUIRED; queries stay [B, D] f32 — the split-lane
+    relayout (:func:`int4_query_layout`) and the in-register unpack are
+    internal and identical in kernel and twin.
+
     Dispatch follows ``kernels._support.mode()``: the Pallas kernel on
     TPU, the bitwise-identical XLA twin elsewhere.  Callers gate shapes
     with :func:`supports` — unsupported ones raise here."""
-    if not supports(spec, k=k, dim=slab.shape[1]):
+    dim = q.shape[1]
+    if packed:
+        if scale is None:
+            raise ValueError("scan_topk: packed=True (int4) requires scale=")
+        hw = (int(dim) + 1) // 2
+        if slab.shape[1] != hw:
+            raise ValueError(
+                f"scan_topk: packed slab width {slab.shape[1]} != "
+                f"ceil(dim/2) = {hw} for dim={dim}")
+    elif slab.shape[1] != dim:
+        raise ValueError(
+            f"scan_topk: slab dim {slab.shape[1]} != query dim {dim}")
+    if not supports(spec, k=k, dim=dim):
         raise ValueError(
             f"scan_topk: unsupported (spec={spec[0]!r}, k={k}, "
-            f"dim={slab.shape[1]}) — gate on scan_topk.supports() and "
+            f"dim={dim}) — gate on scan_topk.supports() and "
             "fall back to the two-stage scan")
     kind = spec[0]
     c = 0.0 if kind == "euclidean" else spec[1]
-    bm = int(tile_rows) or fused_tile_rows(slab.shape[1], slab.dtype, k)
+    lane = "int4" if packed else ("int8" if scale is not None else "dense")
+    bm = int(tile_rows) or fused_tile_rows(
+        dim, slab.dtype, k, lane=("int4" if packed else "dense"))
+    if packed:
+        # ONE relayout recipe feeds both implementations
+        q = int4_query_layout(q, dim)
     m_ = S.mode()
     if m_ == "xla":
         return _t_scan_topk(slab, q, q_idx, col0, kind=kind, c=c, k=int(k),
                             n=int(n), bm=bm, exclude_self=bool(exclude_self),
-                            scale=scale)
+                            scale=scale, lane=lane)
     return _launch_slab(slab, q, q_idx, col0, kind=kind, c=c, k=int(k),
                         n=int(n), bm=bm, exclude_self=bool(exclude_self),
-                        mode_=m_, scale=scale)
+                        mode_=m_, scale=scale, lane=lane)
+
+
+# --- PQ slab variant (ADC over coded tiles) -----------------------------------
+
+
+def _pq_body(kind: str, k: int, bm: int, ntiles: int, m: int,
+             exclude_self: bool):
+    """Double-buffered DMA pipeline over the [M, m] code slab — the
+    ``_slab_body`` structure with the ADC tile math."""
+
+    def body(c_ref, col0_ref, n_ref, nloc_ref, lut_ref, qi_ref, y_hbm,
+             od_ref, oi_ref, cd_scr, ci_scr, ybuf, ysem):
+        cd_scr[:] = jnp.full_like(cd_scr, jnp.inf)
+        ci_scr[:] = jnp.full_like(ci_scr, -1)
+        c = c_ref[0, 0]
+        col0 = col0_ref[0, 0]
+        n = n_ref[0, 0]
+        nloc = nloc_ref[0, 0]
+        lut = lut_ref[:].astype(jnp.float32)
+        qi = qi_ref[:, :1]
+
+        def copy_y(slot, i):
+            return pltpu.make_async_copy(
+                y_hbm.at[pl.ds(i * bm, bm), :], ybuf.at[slot],
+                ysem.at[slot])
+
+        copy_y(0, 0).start()
+
+        def tile(jt, _):
+            slot = jax.lax.rem(jt, 2)
+
+            @pl.when(jt + 1 < ntiles)
+            def _prefetch():
+                copy_y(jax.lax.rem(jt + 1, 2), jt + 1).start()
+
+            copy_y(slot, jt).wait()
+            codes = ybuf[slot].astype(jnp.int32)
+            d, gids = _pq_tile(kind, exclude_self, c, n, nloc, col0,
+                               jt * bm, m, lut, qi, codes)
+            skip = _prune(cd_scr[:], d, k)
+
+            @pl.when(jnp.logical_not(skip))
+            def _merge_tile():
+                ncd, nci = _merge(cd_scr[:], ci_scr[:], d, gids, k)
+                cd_scr[:] = ncd
+                ci_scr[:] = nci
+
+            return 0
+
+        jax.lax.fori_loop(0, ntiles, tile, 0)
+        od_ref[:] = cd_scr[:]
+        oi_ref[:] = ci_scr[:]
+
+    return body
+
+
+def _launch_pq(codes, lut, q_idx, col0, *, kind, c, k, n, m, bm,
+               exclude_self, mode_):
+    b = lut.shape[0]
+    bq, _, kp, bm = _slab_schedule(b, lut.shape[1], k, bm)
+    nloc = codes.shape[0]
+    # the shared slab padding recipe, with the LUT as the query block
+    yp, lutp, qip = _slab_pad(codes, lut, q_idx, bq, bm)
+    bp, mp_ = lutp.shape[0], yp.shape[0]
+    ntiles = mp_ // bm
+    grid = (bp // bq,)
+    smem = lambda: pl.BlockSpec((1, 1), lambda iq: (0, 0),
+                                memory_space=pltpu.SMEM)
+    i32 = lambda v: jnp.asarray(v, jnp.int32).reshape(1, 1)
+    od, oi = pl.pallas_call(
+        _pq_body(kind, k, bm, ntiles, m, exclude_self),
+        grid=grid,
+        in_specs=[
+            smem(), smem(), smem(), smem(),
+            pl.BlockSpec((bq, lutp.shape[1]), lambda iq: (iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bq, 128), lambda iq: (iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((bq, kp), lambda iq: (iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bq, kp), lambda iq: (iq, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, kp), jnp.float32),
+            jax.ShapeDtypeStruct((bp, kp), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, kp), jnp.float32),
+            pltpu.VMEM((bq, kp), jnp.int32),
+            pltpu.VMEM((2, bm, yp.shape[1]), yp.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=S.tpu_compiler_params(
+            dimension_semantics=("parallel",)),
+        interpret=S.interpret_flag(mode_),
+    )(S.c_smem(c), i32(col0), i32(n), i32(nloc), lutp, qip, yp)
+    return od[:b, :k], oi[:b, :k]
+
+
+def _t_scan_topk_pq(codes, lut, q_idx, col0, *, kind, c, k, n, m, bm,
+                    exclude_self):
+    """XLA twin of the PQ kernel: same padded blocks, same shared
+    ``_pq_tile`` (the one-hot dot selects exact LUT copies, so the twin
+    matches the interpreter bitwise like every other lane)."""
+    b = lut.shape[0]
+    bq, _, kp, bm = _slab_schedule(b, lut.shape[1], k, bm)
+    nloc = jnp.int32(codes.shape[0])
+    yp, lutp, qip = _slab_pad(codes, lut, q_idx, bq, bm)
+    ntiles = yp.shape[0] // bm
+    c32 = jnp.asarray(c, jnp.float32)
+    col0_ = jnp.asarray(col0, jnp.int32)
+    n_ = jnp.int32(n)
+    outs_d, outs_i = [], []
+    for ib in range(lutp.shape[0] // bq):
+        lutb = lutp[ib * bq:(ib + 1) * bq].astype(jnp.float32)
+        qib = qip[ib * bq:(ib + 1) * bq, :1]
+
+        def tile_body(jt, carry, lutb=lutb, qib=qib):
+            cd, ci = carry
+            ctile = jax.lax.dynamic_slice_in_dim(
+                yp, jt * bm, bm).astype(jnp.int32)
+            d, gids = _pq_tile(kind, exclude_self, c32, n_, nloc, col0_,
+                               jt * bm, m, lutb, qib, ctile)
+            return _fold(cd, ci, d, gids, k)
+
+        cd, ci = jax.lax.fori_loop(
+            0, ntiles, tile_body,
+            (jnp.full((bq, kp), jnp.inf, jnp.float32),
+             jnp.full((bq, kp), -1, jnp.int32)))
+        outs_d.append(cd)
+        outs_i.append(ci)
+    od = jnp.concatenate(outs_d, axis=0)
+    oi = jnp.concatenate(outs_i, axis=0)
+    return od[:b, :k], oi[:b, :k]
+
+
+def scan_topk_pq(codes, lut, q_idx, col0, *, spec: tuple, k: int, n: int,
+                 exclude_self: bool = False, tile_rows: int = 0):
+    """Streaming top-k over a PQ-coded slab via ADC: ``codes`` [M, m]
+    uint8 subspace codes (serve/quant.py), ``lut`` [B, m*256] f32 the
+    per-query lookup tables (:func:`pq_lut`) → the :func:`scan_topk`
+    output contract (global ids via ``col0``, masking by ``n``/local
+    rows/``exclude_self``, ``(+inf, −1)`` beyond reachable).
+
+    Distances are those of the RECONSTRUCTED (decoded) rows — a coarse
+    lane by construction; callers over-fetch and f32-rescore exactly as
+    for int8/int4.  Callers gate with :func:`supports_pq` (product
+    specs and m > ``FUSED_MAX_PQ_M`` fall back to the engine's decode
+    scan).  Dispatch and twin contract as :func:`scan_topk`."""
+    m = int(codes.shape[1])
+    if not supports_pq(spec, k=k, m=m):
+        raise ValueError(
+            f"scan_topk_pq: unsupported (spec={spec[0]!r}, k={k}, m={m}) "
+            "— gate on scan_topk.supports_pq() and fall back to the "
+            "two-stage decode scan")
+    if lut.shape[1] != m * 256:
+        raise ValueError(
+            f"scan_topk_pq: lut width {lut.shape[1]} != m*256 = {m * 256}")
+    kind = spec[0]
+    c = 0.0 if kind == "euclidean" else spec[1]
+    bm = int(tile_rows) or fused_tile_rows(
+        128, jnp.uint8, k, lane="pq", pq_m=m)
+    m_ = S.mode()
+    if m_ == "xla":
+        return _t_scan_topk_pq(codes, lut, q_idx, col0, kind=kind, c=c,
+                               k=int(k), n=int(n), m=m, bm=bm,
+                               exclude_self=bool(exclude_self))
+    return _launch_pq(codes, lut, q_idx, col0, kind=kind, c=c, k=int(k),
+                      n=int(n), m=m, bm=bm,
+                      exclude_self=bool(exclude_self), mode_=m_)
 
 
 # --- per-query candidate variant (the IVF probing scorer) ---------------------

@@ -333,17 +333,45 @@ class DeviceHotCache:
     boundary, so an evicted row never holds the only copy of an update
     — eviction is free, and a cache hit means the device copy IS the
     master's current value.
+
+    ``quant`` ("int8" | "int4") keeps the device copy PACKED: rows are
+    quantized per-row on upload (``serve/quant.py`` — int8 code + f32
+    scale, or two int4 nibbles per byte + f16 scale) and dequantized on
+    ``fetch``, so the same HBM budget caches ~4×/~6× the hot rows — the
+    serve-side read lane of the beyond-HBM story (docs/serving.md).  A
+    packed cache is READ-ONLY from the device's point of view: the
+    in-place training update via :attr:`array` is refused (training
+    math needs f32 rows; re-quantizing per step would accumulate
+    quantization error into the master).  PQ is deliberately NOT a
+    cache lane — its codes only decode through whole-table-trained
+    codebooks, which a row cache cannot retrain per upload.
     """
 
-    def __init__(self, master: HostEmbedTable, capacity: int):
+    def __init__(self, master: HostEmbedTable, capacity: int, *,
+                 quant: Optional[str] = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1; got {capacity}")
+        if quant not in (None, "int8", "int4"):
+            raise ValueError(
+                f"cache quant must be None, 'int8' or 'int4'; got {quant!r}")
         self._master = master
+        self.quant = quant
         self.capacity = int(min(capacity, master.num_rows))
         # sanctioned host→device transfer: the cache starts empty (the
         # zeros block is the cache's own buffer, not the master table)
-        self._arr = jnp.zeros((self.capacity, master.width),
-                              jnp.dtype(master.dtype))
+        if quant == "int8":
+            self._arr = jnp.zeros((self.capacity, master.width), jnp.int8)
+            self._scale = jnp.zeros((self.capacity, 1), jnp.float32)
+        elif quant == "int4":
+            from hyperspace_tpu.serve.quant import int4_packed_width
+
+            self._arr = jnp.zeros(
+                (self.capacity, int4_packed_width(master.width)), jnp.uint8)
+            self._scale = jnp.zeros((self.capacity, 1), jnp.float16)
+        else:
+            self._arr = jnp.zeros((self.capacity, master.width),
+                                  jnp.dtype(master.dtype))
+            self._scale = None
         # vectorized bookkeeping — at 100k-row working sets a per-id
         # Python dict walk WAS the host-resident step time (measured
         # ~20× the in-HBM step before this layout): id → slot (−1 =
@@ -357,16 +385,36 @@ class DeviceHotCache:
 
     @property
     def array(self) -> jax.Array:
-        """The device ``[C, W]`` cache — hand to the chunk program."""
+        """The device cache — ``[C, W]`` rows (or the packed ``[C, ⌈W/2⌉]``
+        nibbles / ``[C, W]`` int8 codes under ``quant``); hand to the
+        chunk program (full-precision caches only)."""
         return self._arr
 
     @array.setter
     def array(self, new: jax.Array) -> None:
+        if self.quant is not None:
+            raise ValueError(
+                f"a {self.quant} hot-row cache is a serve-side read lane; "
+                "in-place training updates need a full-precision cache")
         if new.shape != (self.capacity, self._master.width):
             raise ValueError(
                 f"cache array {new.shape} must be "
                 f"({self.capacity}, {self._master.width})")
         self._arr = new
+
+    @property
+    def scale(self) -> Optional[jax.Array]:
+        """Per-slot dequant scales ``[C, 1]`` (quantized caches only)."""
+        return self._scale
+
+    @property
+    def nbytes(self) -> int:
+        """Device bytes the cache holds resident — the capacity story
+        a packed lane quarters."""
+        n = self._arr.nbytes
+        if self._scale is not None:
+            n += self._scale.nbytes
+        return n
 
     def ensure(self, ids: np.ndarray) -> np.ndarray:
         """Make every id resident; return its slot ([len(ids)] int32).
@@ -445,24 +493,58 @@ class DeviceHotCache:
         self._slot_id[mslots] = miss_ids
         self._last_used[mslots] = self._tick
         slots[miss] = mslots
-        # ONE bucketed upload + scatter (pad slots out of range: drop)
+        # ONE bucketed upload + scatter (pad slots out of range: drop);
+        # packed lanes quantize per-row on host, so the link carries the
+        # packed bytes, never the f32 rows
+        scale_rows = None
+        if self.quant == "int8":
+            from hyperspace_tpu.serve.quant import quantize_rows
+
+            miss_rows, scale_rows = quantize_rows(
+                np.asarray(miss_rows, np.float32))
+        elif self.quant == "int4":
+            from hyperspace_tpu.serve.quant import pack_int4_rows
+
+            miss_rows, scale_rows = pack_int4_rows(
+                np.asarray(miss_rows, np.float32))
         b = _next_bucket(nmiss, self.capacity)
-        rows_b = np.zeros((b, self._master.width), self._master.dtype)
+        rows_b = np.zeros((b,) + miss_rows.shape[1:], miss_rows.dtype)
         rows_b[:nmiss] = miss_rows
         slots_b = np.full(b, self.capacity, np.int32)
         slots_b[:nmiss] = mslots
         self._arr = _cache_insert(self._arr, jnp.asarray(rows_b),
                                   jnp.asarray(slots_b))
+        sent = int(rows_b[:nmiss].nbytes)
+        if scale_rows is not None:
+            sc_b = np.zeros((b, 1), scale_rows.dtype)
+            sc_b[:nmiss] = scale_rows
+            self._scale = _cache_insert(self._scale, jnp.asarray(sc_b),
+                                        jnp.asarray(slots_b))
+            sent += int(sc_b[:nmiss].nbytes)
         _telem.inc("host_table/upload_rows", nmiss)
-        _telem.inc("host_table/upload_bytes", int(rows_b[:nmiss].nbytes))
+        _telem.inc("host_table/upload_bytes", sent)
         return slots
 
     def fetch(self, slots: np.ndarray) -> np.ndarray:
         """Read cache rows back to host (the chunk-boundary write-back
-        read) — one bucketed device gather + one transfer."""
+        read) — one bucketed device gather + one transfer.  Packed
+        caches dequantize on host: the result is the f32 view of the
+        resident codes (lossy vs the master — the read lane's
+        contract, never a write-back source)."""
         slots = np.asarray(slots, np.int32)
         b = _next_bucket(len(slots), self.capacity)
         slots_b = np.zeros(b, np.int32)
         slots_b[:len(slots)] = slots
         out = np.asarray(_cache_gather(self._arr, jnp.asarray(slots_b)))
+        if self.quant is not None:
+            sc = np.asarray(_cache_gather(self._scale, jnp.asarray(slots_b)))
+            if self.quant == "int8":
+                from hyperspace_tpu.serve.quant import dequantize_rows
+
+                out = dequantize_rows(out, sc)
+            else:
+                from hyperspace_tpu.serve.quant import dequantize_int4_rows
+
+                out = dequantize_int4_rows(out, sc, self._master.width)
+            out = out.astype(self._master.dtype)
         return out[:len(slots)]

@@ -39,7 +39,9 @@ from hyperspace_tpu.serve.access import (  # noqa: F401
     new_request_id,
 )
 from hyperspace_tpu.serve.artifact import (  # noqa: F401
+    QuantPayload,
     ServingArtifact,
+    build_quant_payload,
     export_artifact,
     export_from_checkpoint,
     is_committed,

@@ -13,6 +13,9 @@ tensorstore shards.  A *serving artifact* is the frozen inference view:
 - ``index.npz``   — OPTIONAL: the IVF index arrays (centroids, dense
   cell layout, counts — ``serve/index.py``), with its own content hash
   in the meta block and folded into the artifact fingerprint;
+- ``quant.npz``   — OPTIONAL: a packed scan lane (:class:`QuantPayload`
+  — int4 nibbles + scales, or PQ codes + trained codebooks), content-
+  hashed and folded into the artifact fingerprint the same way;
 - ``COMMITTED``   — the commit marker, WRITTEN LAST.
 
 Writes are atomic the same way checkpoints are: everything lands in a
@@ -53,6 +56,7 @@ COMMIT_MARKER = "COMMITTED"
 META_FILE = "artifact.json"
 TABLE_FILE = "table.npy"
 INDEX_FILE = "index.npz"  # optional IVF index (serve/index.py)
+QUANT_FILE = "quant.npz"  # optional packed scan lane (serve/quant.py)
 
 
 # --- manifold specs -----------------------------------------------------------
@@ -134,13 +138,15 @@ def spec_dim(spec: tuple) -> int:
 
 
 def fingerprint_of(table: np.ndarray, spec: tuple,
-                   index_fingerprint: Optional[str] = None) -> str:
+                   index_fingerprint: Optional[str] = None,
+                   quant_fingerprint: Optional[str] = None) -> str:
     """Content identity: sha256 over the table bytes, its shape/dtype,
     and the canonical spec JSON.  Same table + geometry → same
     fingerprint, wherever the artifact lives on disk.  An attached IVF
-    index folds its own content hash in (``index_fingerprint``), so an
-    artifact with an index is a DIFFERENT artifact than the bare table
-    — without one the hash is byte-identical to the pre-index format
+    index or packed quant lane folds its own content hash in
+    (``index_fingerprint`` / ``quant_fingerprint``), so an artifact
+    carrying either is a DIFFERENT artifact than the bare table —
+    without them the hash is byte-identical to the pre-index format
     (existing fingerprints stay valid)."""
     table = np.ascontiguousarray(table)
     doc = {"spec": spec_to_json(spec),
@@ -148,10 +154,96 @@ def fingerprint_of(table: np.ndarray, spec: tuple,
            "dtype": str(table.dtype)}
     if index_fingerprint is not None:
         doc["index"] = index_fingerprint
+    if quant_fingerprint is not None:
+        doc["quant"] = quant_fingerprint
     h = hashlib.sha256()
     h.update(json.dumps(doc, sort_keys=True).encode())
     h.update(table.tobytes())
     return h.hexdigest()
+
+
+# --- quantized scan payloads --------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantPayload:
+    """A packed scan-lane copy shipped INSIDE an artifact.
+
+    The serve-time quantized copy (``serve/quant.py``) is normally
+    derived from the f32 master on engine construction; shipping it in
+    the artifact makes the derivation part of the frozen content — PQ
+    codebooks in particular are TRAINED (subspace k-means), so two
+    engines built from the same master but different codebooks rank
+    candidates differently, and the payload pins which codebooks serve.
+
+    ``lane`` names the precision ("int4" | "pq"), ``arrays`` holds the
+    packed content (int4: ``packed`` uint8 [N, ceil(D/2)] + ``scale``
+    f16 [N, 1]; pq: ``codes`` uint8 [N, m] + ``codebooks`` f32
+    [m, 256, ds]), ``params`` the scalar geometry the engine needs to
+    decode (int4: ``dim``; pq: ``m``/``lift_dim``/``iters``/``seed``),
+    and ``fingerprint`` the content hash ``load_artifact`` re-verifies.
+    """
+
+    lane: str
+    arrays: dict
+    params: dict
+    fingerprint: str
+
+    @property
+    def num_nodes(self) -> int:
+        key = "packed" if "packed" in self.arrays else "codes"
+        return int(self.arrays[key].shape[0])
+
+
+def quant_fingerprint_of(lane: str, arrays: dict, params: dict) -> str:
+    """Content hash of a packed lane: sha256 over the lane tag, the
+    decode params, every array's shape/dtype, and the raw bytes (arrays
+    walked in sorted-key order, so dict insertion order never leaks into
+    the identity)."""
+    doc = {"lane": str(lane),
+           "params": {k: params[k] for k in sorted(params)},
+           "arrays": {k: [list(arrays[k].shape), str(arrays[k].dtype)]
+                      for k in sorted(arrays)}}
+    h = hashlib.sha256()
+    h.update(json.dumps(doc, sort_keys=True).encode())
+    for k in sorted(arrays):
+        h.update(np.ascontiguousarray(arrays[k]).tobytes())
+    return h.hexdigest()
+
+
+def build_quant_payload(table, spec: tuple, lane: str, *,
+                        pq_m: int = 0, pq_iters: int = 6,
+                        pq_seed: int = 0) -> QuantPayload:
+    """Pack ``table`` for ``lane`` exactly as a live engine would.
+
+    int4 packs per-row nibbles + f16 scales; pq trains lifted-subspace
+    codebooks (``serve/quant.py:build_pq`` — deterministic in
+    ``pq_seed``) and encodes every row.  The returned payload plugs into
+    :func:`export_artifact`'s ``quant=`` and the engine's ``quant=``.
+    """
+    table = np.ascontiguousarray(np.asarray(table, np.float32))
+    if table.ndim != 2:
+        raise ValueError(f"table must be [N, D]; got {table.shape}")
+    if lane == "int4":
+        from hyperspace_tpu.serve.quant import pack_int4_rows
+
+        packed, scale = pack_int4_rows(table)
+        arrays = {"packed": packed, "scale": scale}
+        params = {"dim": int(table.shape[1])}
+    elif lane == "pq":
+        from hyperspace_tpu.serve.quant import build_pq
+
+        codes, cb = build_pq(table, spec, m=pq_m, iters=pq_iters,
+                             seed=pq_seed)
+        arrays = {"codes": codes, "codebooks": cb.codebooks}
+        params = {"m": int(cb.m), "lift_dim": int(cb.lift_dim),
+                  "iters": int(cb.iters), "seed": int(cb.seed)}
+    else:
+        raise ValueError(
+            f"quant payloads cover lanes ('int4', 'pq'); got {lane!r}")
+    return QuantPayload(lane=lane, arrays=arrays, params=params,
+                        fingerprint=quant_fingerprint_of(
+                            lane, arrays, params))
 
 
 # --- the artifact -------------------------------------------------------------
@@ -167,6 +259,7 @@ class ServingArtifact:
     fingerprint: str
     step: Optional[int] = None  # source checkpoint step, if any
     index: Optional[object] = None  # ServingIndex (serve/index.py) or None
+    quant: Optional[QuantPayload] = None  # packed scan lane or None
 
     @property
     def num_nodes(self) -> int:
@@ -181,7 +274,7 @@ class ServingArtifact:
 
 
 def _make_artifact(table, spec, model_config, step,
-                   index=None) -> ServingArtifact:
+                   index=None, quant=None) -> ServingArtifact:
     table = np.ascontiguousarray(np.asarray(table))
     if table.ndim != 2:
         raise ValueError(f"serving table must be [N, D]; got {table.shape}")
@@ -198,20 +291,25 @@ def _make_artifact(table, spec, model_config, step,
             raise ValueError(
                 f"index centroid width {index.centroids.shape[1]} != "
                 f"table width {table.shape[1]}")
+    if quant is not None and int(quant.num_nodes) != table.shape[0]:
+        raise ValueError(
+            f"quant payload covers {quant.num_nodes} rows; table has "
+            f"{table.shape[0]} — re-pack for THIS table")
     return ServingArtifact(
         table=table, manifold_spec=spec,
         model_config=dict(model_config or {}),
         fingerprint=fingerprint_of(
-            table, spec, None if index is None else index.fingerprint),
+            table, spec, None if index is None else index.fingerprint,
+            None if quant is None else quant.fingerprint),
         step=None if step is None else int(step),
-        index=index)
+        index=index, quant=quant)
 
 
 def export_artifact(directory: str, table, manifold_spec: tuple, *,
                     model_config: Optional[dict] = None,
                     step: Optional[int] = None,
                     overwrite: bool = False,
-                    index=None) -> ServingArtifact:
+                    index=None, quant=None) -> ServingArtifact:
     """Write a serving artifact atomically; returns the artifact written.
 
     Staging dir + marker-last + one ``os.rename`` (module docstring).
@@ -220,7 +318,8 @@ def export_artifact(directory: str, table, manifold_spec: tuple, *,
     rename-then-delete, so a reader holding the old dir open keeps a
     consistent view).
     """
-    art = _make_artifact(table, manifold_spec, model_config, step, index)
+    art = _make_artifact(table, manifold_spec, model_config, step, index,
+                         quant)
     directory = os.path.abspath(directory)
     parent = os.path.dirname(directory)
     os.makedirs(parent, exist_ok=True)
@@ -254,6 +353,14 @@ def export_artifact(directory: str, table, manifold_spec: tuple, *,
                 "num_nodes": art.index.num_nodes, "iters": art.index.iters,
                 "seed": art.index.seed,
                 "fingerprint": art.index.fingerprint,
+            }
+        if art.quant is not None:
+            np.savez(os.path.join(staging, QUANT_FILE), **art.quant.arrays)
+            meta["quant"] = {
+                "lane": art.quant.lane,
+                "params": dict(art.quant.params),
+                "arrays": sorted(art.quant.arrays),
+                "fingerprint": art.quant.fingerprint,
             }
         with open(os.path.join(staging, META_FILE), "w") as f:
             json.dump(meta, f, indent=2, sort_keys=True)
@@ -345,8 +452,40 @@ def load_artifact(directory: str) -> ServingArtifact:
             centroids=centroids, cells=cells, counts=counts,
             num_nodes=int(imeta["num_nodes"]), iters=int(imeta["iters"]),
             seed=int(imeta["seed"]), fingerprint=ifp)
+    quant = None
+    if meta.get("quant") is not None:
+        qmeta = meta["quant"]
+        qpath = os.path.join(directory, QUANT_FILE)
+        if not os.path.isfile(qpath):
+            raise ValueError(
+                f"artifact meta names a quant lane but {QUANT_FILE} is "
+                f"missing at {directory}")
+        try:
+            lane, params = qmeta["lane"], dict(qmeta["params"])
+            names = list(qmeta["arrays"])
+        except KeyError as e:
+            raise ValueError(
+                f"artifact quant meta at {directory} is missing {e}") \
+                from None
+        with np.load(qpath) as z:
+            missing = sorted(set(names) - set(z.files))
+            if missing:
+                raise ValueError(
+                    f"quant payload at {directory} is missing arrays "
+                    f"{missing}")
+            arrays = {k: np.ascontiguousarray(z[k]) for k in names}
+        # recompute, never trust: a tampered codebook/packed table would
+        # otherwise serve silently-wrong candidate rankings
+        qfp = quant_fingerprint_of(lane, arrays, params)
+        if qfp != qmeta["fingerprint"]:
+            raise ValueError(
+                f"quant fingerprint mismatch at {directory}: meta says "
+                f"{qmeta['fingerprint'][:12]}…, content is {qfp[:12]}…")
+        quant = QuantPayload(lane=lane, arrays=arrays, params=params,
+                             fingerprint=qfp)
     fp = fingerprint_of(table, spec,
-                        None if index is None else index.fingerprint)
+                        None if index is None else index.fingerprint,
+                        None if quant is None else quant.fingerprint)
     if fp != meta["fingerprint"]:
         raise ValueError(
             f"artifact fingerprint mismatch at {directory}: "
@@ -354,7 +493,7 @@ def load_artifact(directory: str) -> ServingArtifact:
     return ServingArtifact(
         table=table, manifold_spec=spec,
         model_config=meta.get("model_config") or {},
-        fingerprint=fp, step=meta.get("step"), index=index)
+        fingerprint=fp, step=meta.get("step"), index=index, quant=quant)
 
 
 # --- checkpoint → artifact ----------------------------------------------------
@@ -365,7 +504,8 @@ def export_from_checkpoint(ckpt_dir: str, out_dir: str, *,
                            model_config: Optional[dict] = None,
                            step: Optional[int] = None,
                            overwrite: bool = False,
-                           index_ncells: Optional[int] = None
+                           index_ncells: Optional[int] = None,
+                           quant_lane: Optional[str] = None
                            ) -> ServingArtifact:
     """Export the newest committed checkpoint step as a serving artifact.
 
@@ -393,6 +533,12 @@ def export_from_checkpoint(ckpt_dir: str, out_dir: str, *,
     (``serve/index.py``; hyperbolic k-means with that many cells —
     ``<= 0`` picks ``auto_ncells`` ≈ √N) and ships it inside the
     artifact — CLI ``export index=1 [ncells=K]``.
+
+    ``quant_lane`` ("int4" | "pq") packs the exported table for that
+    scan lane (:func:`build_quant_payload`) and ships the payload — CLI
+    ``export quant=int4|pq``; a pq export freezes the TRAINED codebooks
+    into the artifact, so every serving replica ranks through the same
+    centers.
     """
     from hyperspace_tpu.train.checkpoint import restore_params_only
 
@@ -451,5 +597,8 @@ def export_from_checkpoint(ckpt_dir: str, out_dir: str, *,
         if ncells <= 0:
             ncells = auto_ncells(int(table.shape[0]))
         index = build_index(table, spec, ncells)
+    quant = (build_quant_payload(table, spec, quant_lane)
+             if quant_lane else None)
     return export_artifact(out_dir, table, spec, model_config=cfg,
-                           step=ck_step, overwrite=overwrite, index=index)
+                           step=ck_step, overwrite=overwrite, index=index,
+                           quant=quant)

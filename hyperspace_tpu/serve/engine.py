@@ -78,6 +78,22 @@ Mechanics:
   the fused kernel (int8 slabs stream at quarter bytes through the
   same carry), and mesh sharding; the scan signature and the batcher
   cache key carry the lane, so f32/bf16/int8 rows never cross.
+- **Sub-int8 lanes** (``precision="int4"|"pq"``; ISSUE 16,
+  docs/serving.md "Sub-int8 lanes") — the same scan-then-rescore shape
+  below a quarter of the bytes.  int4 packs two signed nibbles per
+  byte with a per-row f16 scale (tiles unpack in-register; the fused
+  kernel streams the packed bytes through a double-buffered DMA
+  pipeline).  PQ stores one uint8 code per subspace against codebooks
+  trained by subspace k-means in the tangent/Lorentz lift
+  (``serve/quant.py``); the fused kernel scores coded tiles by ADC
+  (per-query lookup tables), the two-stage path decodes tiles to the
+  lift and scores with the lift's closed forms.  Both keep the int8
+  lane's over-fetch + f32-rescore shape at a wider ``k + max(16k,
+  128)`` window (a 4-bit step / a 256-way codebook is far coarser than
+  int8's per-element step), so final
+  ranks come from full-precision manifold distances; product specs
+  serve PQ through the two-stage decode path (their distance is not
+  subspace-additive).
 - **Optional IVF probing** (``index=`` + ``nprobe=``; docs/serving.md
   "Approximate retrieval", built by ``serve/index.py``).  Queries score
   against the index's hyperbolic-k-means centroids, gather the nearest
@@ -135,9 +151,12 @@ _ROW_ALIGN = 128
 
 SCAN_MODES = ("two_stage", "carry", "fused")
 # the serve table-scan lanes: the precision-policy presets plus the
-# serve-only int8 quantized lane (serve/quant.py — not a training
-# policy, so it lives here rather than in precision.PRESET_NAMES)
-PRECISIONS = precision_mod.PRESET_NAMES + ("int8",)
+# serve-only quantized lanes (serve/quant.py — not training policies,
+# so they live here rather than in precision.PRESET_NAMES): int8
+# (per-row symmetric code), int4 (two nibbles per byte, ISSUE 16) and
+# pq (product-quantized codes + hyperbolic-aware codebooks)
+QUANT_PRECISIONS = ("int8", "int4", "pq")
+PRECISIONS = precision_mod.PRESET_NAMES + QUANT_PRECISIONS
 
 # extra candidates the bf16 scan keeps beyond the requested k, so a
 # near-tie the low-precision pass mis-ranks at the k-th boundary is still
@@ -150,6 +169,21 @@ _RESCORE_PAD = 8
 # and the rescore margin scales with k (k + max(4k, 32) candidates)
 _QUANT_RESCORE_MIN = 32
 _QUANT_RESCORE_MULT = 4
+# the int4 lane's wider-still over-fetch: a 4-bit step is 2^4 = 16×
+# int8's, so the coarse ranking noise swamps neighbor gaps much sooner
+# as table density grows — measured at 200k clustered rows (dim 8,
+# bench_big_table's generator) the int8-width window plateaus at
+# recall@10 ≈ 0.95 while k + max(16k, 128) holds 1.0; same budget as
+# the pq window, so the fused-kernel liveness bound is unchanged
+_INT4_RESCORE_MIN = 128
+_INT4_RESCORE_MULT = 16
+# the PQ lane's even-wider over-fetch: subspace codebooks quantize whole
+# ds-wide blocks to one of 256 centers, so the coarse ADC ranking is far
+# noisier than any per-element lane — the window must absorb coarse
+# ranks a few hundred deep, while k + max(16k, 128) still keeps
+# k_scan <= FUSED_MAX_K for k <= 8 so the fused ADC kernel stays live
+_PQ_RESCORE_MIN = 128
+_PQ_RESCORE_MULT = 16
 
 
 def _round_up(n: int, m: int) -> int:
@@ -159,7 +193,8 @@ def _round_up(n: int, m: int) -> int:
 def auto_chunk_rows(dim: int, spec_kind: str, n: int,
                     tile_budget: int = DEFAULT_TILE_BUDGET, *,
                     scan_mode: str = "two_stage",
-                    dtype=jnp.float32) -> int:
+                    dtype=jnp.float32, lane: str = "dense",
+                    pq_m: int = 0) -> int:
     """Table-chunk rows that keep one distance tile under the budget.
 
     For ``scan_mode="fused"`` on a fused-capable family the chunk IS the
@@ -169,14 +204,18 @@ def auto_chunk_rows(dim: int, spec_kind: str, n: int,
     FUSED_MAX_K``, so every supported per-call k fits), not the fixed
     HBM distance-tile byte budget the two-stage scan uses.  Unsupported
     families keep the default sizing (the engine then IS the default
-    two-stage executable — the bit-identical fallback contract)."""
+    two-stage executable — the bit-identical fallback contract).
+
+    ``lane``/``pq_m`` extend the fused sizing to the packed scan lanes
+    (``"int4"``/``"pq"`` — kernels/scan_topk.py's footprint branches);
+    the default ``"dense"`` covers f32/bf16/int8 unchanged."""
     if scan_mode == "fused":
         from hyperspace_tpu.kernels import scan_topk as fused_kernel
 
         if (fused_kernel.kind_supported((spec_kind,))
                 and dim <= fused_kernel.FUSED_MAX_DIM):
             chunk = fused_kernel.fused_tile_rows(
-                dim, dtype, fused_kernel.FUSED_MAX_K)
+                dim, dtype, fused_kernel.FUSED_MAX_K, lane=lane, pq_m=pq_m)
             return min(chunk, _round_up(max(n, 1), _ROW_ALIGN))
     per_row = 4 * NOMINAL_BATCH * (dim if spec_kind == "product" else 1)
     chunk = max(_ROW_ALIGN, (tile_budget // per_row) // _ROW_ALIGN * _ROW_ALIGN)
@@ -194,8 +233,99 @@ def _tile_dist(spec: tuple, q: jax.Array, rows: jax.Array) -> jax.Array:
     return m.dist(q[:, None, :], rows[None, :, :])
 
 
+def _int4_rows_f32(packed: jax.Array, scale: jax.Array,
+                   dim: int) -> jax.Array:
+    """Packed planar int4 rows [..., ceil(dim/2)] uint8 + per-row scale
+    [..., 1] → dequantized f32 rows [..., dim] (serve/quant.py's layout:
+    byte j = element j in the LOW nibble, element ceil(dim/2)+j in the
+    HIGH one, two's complement) — the two-stage scan's in-register
+    unpack; the fused kernel carries its own identical recipe
+    (kernels/scan_topk.py ``_tile_rows_f32``)."""
+    from hyperspace_tpu.serve.quant import unpack_int4_jnp
+
+    rows = unpack_int4_jnp(packed, dim)
+    return rows.astype(jnp.float32) * scale.astype(jnp.float32)
+
+
+def _pq_decode_rows(cb: jax.Array, codes: jax.Array,
+                    lift_dim: int) -> jax.Array:
+    """PQ codes [..., m] uint8 + codebooks [m, 256, ds] f32 → the
+    reconstructed LIFTED rows [..., lift_dim] (serve/quant.py trains the
+    codebooks in the tangent/Lorentz lift; pad lanes beyond the lift
+    width are exactly zero and are sliced off)."""
+    m = int(cb.shape[0])
+    sel = cb[jnp.arange(m), codes.astype(jnp.int32)]      # [..., m, ds]
+    out = sel.reshape(codes.shape[:-1] + (m * int(cb.shape[2]),))
+    return out[..., :lift_dim]
+
+
+def _pq_lift_dist(spec: tuple, q_lift: jax.Array,
+                  rows_lift: jax.Array) -> jax.Array:
+    """Coarse scan distances in the LIFT space: lifted f32 queries
+    [B, DL] × reconstructed lifted rows ([M, DL] shared, or [B, C, DL]
+    per-query) → [B, M] / [B, C].
+
+    The lift of a poincare/lorentz family is Lorentz coordinates at the
+    same curvature, so the distance closed form is the Lorentz one —
+    exactly what the fused PQ kernel's ADC sum closes over
+    (kernels/scan_topk.py ``_pq_dist_from_sum``); euclidean lifts are
+    the identity.  Product specs recurse per factor and combine like
+    ``Product.dist`` (root of summed squares).  Reconstructions sit
+    slightly off the manifold — the same clamps the kernel tiles use
+    keep the math finite, and the f32 rescore against the master table
+    picks the final ranks anyway."""
+    from hyperspace_tpu.manifolds import smath
+
+    kind = spec[0]
+    prec = jax.lax.Precision.HIGHEST
+    shared = rows_lift.ndim == 2
+    if kind == "product":
+        from hyperspace_tpu.serve.index import _lift_dim
+
+        o, acc = 0, 0.0
+        for fk, d, c in spec[1]:
+            dl = _lift_dim((fk, c), d)
+            df = _pq_lift_dist((fk, c), q_lift[:, o:o + dl],
+                               rows_lift[..., o:o + dl])
+            acc = acc + jnp.square(df)
+            o += dl
+        return smath.safe_sqrt(acc)
+    if kind in ("poincare", "lorentz"):
+        c = jnp.asarray(spec[1], q_lift.dtype)
+        if shared:
+            gram = (jnp.einsum("bd,md->bm", q_lift[:, 1:], rows_lift[:, 1:],
+                               precision=prec)
+                    - q_lift[:, :1] * rows_lift[None, :, 0])
+        else:
+            gram = (jnp.einsum("bd,bcd->bc", q_lift[:, 1:],
+                               rows_lift[..., 1:], precision=prec)
+                    - q_lift[:, :1] * rows_lift[..., 0])
+        u = smath.clamp_min(-c * gram - 1.0, 0.0)
+        return smath.arcosh1p(u) / smath.clamp_min(
+            smath.sqrt_c(c), smath.min_norm(q_lift.dtype))
+    if kind == "euclidean":
+        if shared:
+            gram = jnp.einsum("bd,md->bm", q_lift, rows_lift,
+                              precision=prec)
+            yy = jnp.sum(rows_lift * rows_lift, axis=-1)[None, :]
+        else:
+            gram = jnp.einsum("bd,bcd->bc", q_lift, rows_lift,
+                              precision=prec)
+            yy = jnp.sum(rows_lift * rows_lift, axis=-1)
+        xx = jnp.sum(q_lift * q_lift, axis=-1, keepdims=True)
+        return smath.safe_sqrt(smath.clamp_min(xx - 2.0 * gram + yy, 0.0))
+    # sphere (lift = identity): project the reconstruction back onto
+    # the sphere and use the factor manifold's own distance
+    m = manifold_from_spec(spec)
+    rows = m.proj(rows_lift)
+    if shared:
+        return m.dist(q_lift[:, None, :], rows[None, :, :])
+    return m.dist(q_lift[:, None, :], rows)
+
+
 def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
-               n: int, exclude_self: bool, mode: str, scale=None):
+               n: int, exclude_self: bool, mode: str, scale=None,
+               lane: str = "dense"):
     """Chunked top-k over ``slab`` rows → ``(dists ascending, ids int32)``,
     each ``[B, min(k, slab_rows)]`` (a shard narrower than k contributes
     everything it has; the cross-shard merge restores the full k).
@@ -207,12 +337,18 @@ def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
     masked to +inf by index, as is each query's own row under
     ``exclude_self``.
 
-    ``scale`` (the int8 lane): per-row [rows, 1] f32 dequant scales for
-    an int8 ``slab`` — each tile dequantizes in-register before the
-    distance math, so the scan's arithmetic stays f32 and only the
-    table bytes shrink (serve/quant.py).
+    ``scale``/``lane`` (the quantized lanes, serve/quant.py): ``"int8"``
+    — per-row [rows, 1] f32 dequant scales for an int8 ``slab``, tiles
+    dequantize in-register before the distance math; ``"int4"`` — the
+    slab is the planar packed [rows, ceil(D/2)] uint8 and ``scale`` its
+    per-row (f16) scales, tiles unpack + dequantize in-register;
+    ``"pq"`` — the slab is the [rows, m] uint8 code table and ``scale``
+    carries the [m, 256, ds] codebooks, tiles decode to the LIFT space
+    and score against the lifted query.  Every lane's scan arithmetic
+    stays f32; only the table bytes shrink.
     """
     b = q.shape[0]
+    dim = q.shape[1]
     nchunks = slab.shape[0] // chunk
     # per-chunk candidate count: a chunk narrower than k keeps ALL its
     # rows (lax.top_k needs k <= the sorted width)
@@ -222,12 +358,30 @@ def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
     ko = min(k, nchunks * chunk)
     # distances of a quantized scan are f32 (dequantize-then-f32-math);
     # float slabs keep their own dtype (the bf16 scan's tiles are bf16)
-    ddt = jnp.float32 if scale is not None else slab.dtype
+    ddt = jnp.float32 if lane != "dense" or scale is not None \
+        else slab.dtype
+    q_lift = None
+    if lane == "pq":
+        from hyperspace_tpu.serve.index import _lift, _lift_dim
+
+        lift_dim = _lift_dim(spec, dim)
+        q_lift = _lift(spec, q).astype(jnp.float32)
 
     if mode == "fused":
         from hyperspace_tpu.kernels import scan_topk as fused_kernel
 
-        if (fused_kernel.supports(spec, k=k, dim=slab.shape[1])
+        if (lane == "pq"
+                and fused_kernel.supports_pq(spec, k=k, m=slab.shape[1])
+                and chunk % 128 == 0):
+            # ADC in the kernel: per-query LUTs over the codebooks, the
+            # coded tiles never decode to full rows (kernels/scan_topk)
+            lut = fused_kernel.pq_lut(q_lift, scale, kind=spec[0])
+            d, i = fused_kernel.scan_topk_pq(
+                slab, lut, q_idx, col0, spec=spec, k=k, n=n,
+                exclude_self=exclude_self, tile_rows=chunk)
+            return d[:, :ko], i[:, :ko]
+        if (lane != "pq"
+                and fused_kernel.supports(spec, k=k, dim=dim)
                 and chunk % 128 == 0):
             # the fused Pallas kernel (XLA twin on CPU): distance tiles
             # stay in-register, the running top-k lives in the kernel
@@ -235,18 +389,26 @@ def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
             # no post-scan merge (kernels/scan_topk.py)
             d, i = fused_kernel.scan_topk(
                 slab, q, q_idx, col0, spec=spec, k=k, n=n,
-                exclude_self=exclude_self, tile_rows=chunk, scale=scale)
+                exclude_self=exclude_self, tile_rows=chunk, scale=scale,
+                packed=(lane == "int4"))
             return d[:, :ko], i[:, :ko]
-        # capability fallback (product spec, oversized k/dim): the
+        # capability fallback (product spec, oversized k/dim/m): the
         # two-stage path below, bit-identical to scan_mode="two_stage"
         mode = "two_stage"
 
     def masked_tile(i):
         rows = jax.lax.dynamic_slice_in_dim(slab, i * chunk, chunk)
-        if scale is not None:
+        if lane == "int4":
+            s = jax.lax.dynamic_slice_in_dim(scale, i * chunk, chunk)
+            rows = _int4_rows_f32(rows, s, dim)
+        elif scale is not None and lane != "pq":
             rows = rows.astype(jnp.float32) * jax.lax.dynamic_slice_in_dim(
                 scale, i * chunk, chunk)
-        d = _tile_dist(spec, q, rows)                     # [B, chunk]
+        if lane == "pq":
+            recon = _pq_decode_rows(scale, rows, lift_dim)
+            d = _pq_lift_dist(spec, q_lift, recon)        # [B, chunk]
+        else:
+            d = _tile_dist(spec, q, rows)                 # [B, chunk]
         # pin int32: under x64 the traced chunk offset would promote the
         # index dtype and break the scan carry/stack contract
         cols = (col0 + i * chunk + jnp.arange(chunk)).astype(jnp.int32)
@@ -385,42 +547,48 @@ def _merge_rescored(d32: jax.Array, idx: jax.Array, k: int):
 
 
 @partial(jax.jit, static_argnames=("spec", "k", "k_scan", "chunk", "n",
-                                   "exclude_self", "mode"))
+                                   "exclude_self", "mode", "lane"))
 def _topk_chunked_mixed(table: jax.Array, scan_table: jax.Array,
-                        scan_scale, q_idx: jax.Array, *, spec: tuple,
+                        scan_aux, q_idx: jax.Array, *, spec: tuple,
                         k: int, k_scan: int, chunk: int, n: int,
-                        exclude_self: bool, mode: str):
+                        exclude_self: bool, mode: str,
+                        lane: str = "dense"):
     """Low-precision table-scan variant of :func:`_topk_chunked`: the
-    chunked scan runs over ``scan_table`` (the bf16 copy, or the int8
-    code when ``scan_scale`` is its per-row dequant scale — half /
-    a quarter of the HBM traffic of the dominant pass) keeping
-    ``k_scan >= k`` candidates, then the candidates are gathered from
-    the f32 ``table`` and rescored with full-precision manifold
-    distances before the final top-k — so returned distances carry f32
-    accuracy and the boundary-sensitive math never runs in low
-    precision on anything that reaches the caller."""
+    chunked scan runs over ``scan_table`` (the bf16 copy, the int8/int4
+    code, or the PQ code table — half / a quarter / an eighth-and-below
+    of the HBM traffic of the dominant pass; ``scan_aux`` is the lane's
+    companion: per-row dequant scales for int8/int4, the codebooks for
+    pq, ``None`` for bf16) keeping ``k_scan >= k`` candidates, then the
+    candidates are gathered from the f32 ``table`` and rescored with
+    full-precision manifold distances before the final top-k — so
+    returned distances carry f32 accuracy and the boundary-sensitive
+    math never runs in low precision on anything that reaches the
+    caller."""
     q = table[q_idx]                                      # [B, D] f32
-    # int8 scans keep f32 queries (the table is quantized, not the
+    # quantized scans keep f32 queries (the table is quantized, not the
     # query rows); the bf16 scan casts them to the scan dtype
-    q_scan = q if scan_scale is not None else q.astype(scan_table.dtype)
+    q_scan = q.astype(scan_table.dtype) if lane == "dense" else q
     sd, sidx = _scan_topk(scan_table, q_scan, q_idx, 0, spec=spec,
                           k=k_scan, chunk=chunk, n=n,
                           exclude_self=exclude_self, mode=mode,
-                          scale=scan_scale)
+                          scale=scan_aux, lane=lane)
     rows = table[jnp.maximum(sidx, 0)]                    # [B, K, D] f32
     d32 = _rescore_f32(spec, rows, q, sidx, sd)
     return _merge_rescored(d32, sidx, k)
 
 
 @partial(jax.jit, static_argnames=("spec", "k", "k_scan", "chunk", "n",
-                                   "exclude_self", "mode", "mesh", "axis"))
+                                   "exclude_self", "mode", "mesh", "axis",
+                                   "lane"))
 def _topk_sharded_mixed(table: jax.Array, scan_table: jax.Array,
-                        scan_scale, q_idx: jax.Array, *, spec: tuple,
+                        scan_aux, q_idx: jax.Array, *, spec: tuple,
                         k: int, k_scan: int, chunk: int, n: int,
-                        exclude_self: bool, mode: str, mesh, axis: str):
+                        exclude_self: bool, mode: str, mesh, axis: str,
+                        lane: str = "dense"):
     """Mesh-sharded twin of :func:`_topk_chunked_mixed`: per-shard
-    low-precision scan over the local slab (bf16 copy, or int8 code +
-    per-row scale — both laid out ``P(axis, None)`` like the master),
+    low-precision scan over the local slab (bf16 copy, int8/int4 code +
+    per-row scale, or PQ code table — all laid out ``P(axis, None)``
+    like the master; PQ codebooks are replicated, they are KB-scale),
     all-gather + merge of the per-shard candidates, then an f32 rescore
     of the merged ``k_scan`` winners (candidate rows assembled from the
     f32 shards by the same psum gather the query rows use) before the
@@ -430,10 +598,11 @@ def _topk_sharded_mixed(table: jax.Array, scan_table: jax.Array,
     def local_body(tloc, sloc, scl, qi):
         q = local_gather(tloc, qi, npad, axis)            # [B, D] f32
         lo = (jax.lax.axis_index(axis) * tloc.shape[0]).astype(jnp.int32)
-        qs = q if scl is not None else q.astype(sloc.dtype)
+        qs = q.astype(sloc.dtype) if lane == "dense" else q
         d, i = _scan_topk(sloc, qs, qi, lo, spec=spec,
                           k=k_scan, chunk=chunk, n=n,
-                          exclude_self=exclude_self, mode=mode, scale=scl)
+                          exclude_self=exclude_self, mode=mode, scale=scl,
+                          lane=lane)
         gd = jax.lax.all_gather(d, axis)                  # [S, B, <=k_scan]
         gi = jax.lax.all_gather(i, axis)
         b = qi.shape[0]
@@ -448,17 +617,20 @@ def _topk_sharded_mixed(table: jax.Array, scan_table: jax.Array,
         idx, dist = _merge_rescored(d32, sidx, k)
         return idx, dist
 
-    if scan_scale is None:
+    if scan_aux is None:
         run = shard_map(lambda t, s, qi: local_body(t, s, None, qi),
                         mesh=mesh,
                         in_specs=(P(axis, None), P(axis, None), P()),
                         out_specs=(P(), P()), check_vma=False)
         return run(table, scan_table, q_idx)
+    # the aux rides row-sharded beside the code table (per-row scales)
+    # — except PQ codebooks, which every shard needs whole
+    aux_spec = P() if lane == "pq" else P(axis, None)
     run = shard_map(local_body, mesh=mesh,
                     in_specs=(P(axis, None), P(axis, None),
-                              P(axis, None), P()),
+                              aux_spec, P()),
                     out_specs=(P(), P()), check_vma=False)
-    return run(table, scan_table, scan_scale, q_idx)
+    return run(table, scan_table, scan_aux, q_idx)
 
 
 def _cand_dist(spec: tuple, q: jax.Array, rows: jax.Array) -> jax.Array:
@@ -500,7 +672,7 @@ def _cand_dist(spec: tuple, q: jax.Array, rows: jax.Array) -> jax.Array:
 def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
                     q_idx: jax.Array, *, spec: tuple, k: int, chunk: int,
                     exclude_self: bool, mode: str = "two_stage",
-                    scale=None):
+                    scale=None, lane: str = "dense"):
     """Chunked top-k over per-query candidate ids — the IVF in-cell
     scorer.  The two-stage machinery of :func:`_scan_topk` (per-chunk
     ``lax.top_k`` over the tile only, one post-scan merge, the running
@@ -514,8 +686,16 @@ def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
     """
     b, ctot = cand.shape
     nchunks = ctot // chunk
+    q_lift = None
+    if lane == "pq":
+        from hyperspace_tpu.serve.index import _lift, _lift_dim
 
-    if mode == "fused":
+        lift_dim = _lift_dim(spec, q.shape[1])
+        q_lift = _lift(spec, q).astype(jnp.float32)
+
+    # the packed lanes have no fused candidate variant (the per-query
+    # gather dominates; unpack/decode rides the two-stage scorer)
+    if mode == "fused" and lane in ("dense", "int8"):
         from hyperspace_tpu.kernels import scan_topk as fused_kernel
 
         if fused_kernel.supports_cand(spec, k=k, dim=scan_table.shape[1],
@@ -525,16 +705,23 @@ def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
                 exclude_self=exclude_self, scale=scale)
             ko = min(k, ctot)
             return d[:, :ko], i[:, :ko]
+    if mode == "fused":
         mode = "two_stage"  # capability fallback — bit-identical path
 
     def masked_tile(i):
         ids = jax.lax.dynamic_slice_in_dim(cand, i * chunk, chunk, axis=1)
         safe = jnp.maximum(ids, 0)
-        rows = scan_table[safe]                           # [B, chunk, D]
-        if scale is not None:
-            # int8 lane: gather each candidate's dequant scale with it
-            rows = rows.astype(jnp.float32) * scale[safe]
-        d = _cand_dist(spec, q, rows)                     # [B, chunk]
+        rows = scan_table[safe]                 # [B, chunk, D|hw|m]
+        if lane == "pq":
+            recon = _pq_decode_rows(scale, rows, lift_dim)
+            d = _pq_lift_dist(spec, q_lift, recon)        # [B, chunk]
+        else:
+            if lane == "int4":
+                rows = _int4_rows_f32(rows, scale[safe], q.shape[1])
+            elif scale is not None:
+                # int8 lane: gather each candidate's dequant scale too
+                rows = rows.astype(jnp.float32) * scale[safe]
+            d = _cand_dist(spec, q, rows)                 # [B, chunk]
         mask = ids < 0
         if exclude_self:
             mask = mask | (ids == q_idx[:, None])
@@ -542,17 +729,19 @@ def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
 
     return _two_stage_core(masked_tile, b=b, nchunks=nchunks, k=k,
                            kc=min(k, chunk), ko=min(k, ctot),
-                           dtype=(jnp.float32 if scale is not None
+                           dtype=(jnp.float32
+                                  if lane != "dense" or scale is not None
                                   else scan_table.dtype))
 
 
 @partial(jax.jit, static_argnames=("spec", "k", "k_scan", "nprobe", "chunk",
-                                   "exclude_self", "mixed", "mode"))
+                                   "exclude_self", "mixed", "mode", "lane"))
 def _topk_ivf(table: jax.Array, scan_table: jax.Array,
               centroids: jax.Array,
               cells: jax.Array, q_idx: jax.Array, *, spec: tuple, k: int,
               k_scan: int, nprobe: int, chunk: int, exclude_self: bool,
-              mixed: bool, mode: str = "two_stage", scan_scale=None):
+              mixed: bool, mode: str = "two_stage", scan_scale=None,
+              lane: str = "dense"):
     """IVF probing top-k: centroid scoring → nearest-``nprobe`` cell
     gather → two-stage candidate scan (docs/serving.md "Approximate
     retrieval").  One executable per (batch, k, nprobe, spec) — same
@@ -575,12 +764,12 @@ def _topk_ivf(table: jax.Array, scan_table: jax.Array,
     pad = -cand.shape[1] % chunk
     if pad:
         cand = jnp.pad(cand, ((0, 0), (0, pad)), constant_values=-1)
-    # int8 scans keep f32 queries (rows dequantize in the scorer)
-    qs = q if scan_scale is not None else q.astype(scan_table.dtype)
+    # quantized scans keep f32 queries (rows dequantize in the scorer)
+    qs = q.astype(scan_table.dtype) if lane == "dense" else q
     sd, sidx = _scan_topk_cand(scan_table, qs, cand, q_idx, spec=spec,
                                k=(k_scan if mixed else k), chunk=chunk,
                                exclude_self=exclude_self, mode=mode,
-                               scale=scan_scale)
+                               scale=scan_scale, lane=lane)
     if not mixed:
         return sidx, sd
     rows = table[jnp.maximum(sidx, 0)]                    # [B, K, D] f32
@@ -660,7 +849,16 @@ class QueryEngine:
     per-row symmetric int8 code + per-row f32 scale (``serve/quant.py``)
     replace the scan copy, tiles dequantize in-register, and the coarse
     pass keeps ``k + max(4k, 32)`` candidates for the f32 rescore
-    (docs/serving.md "Quantized scan lane").  Edge scoring
+    (docs/serving.md "Quantized scan lane").  ``"int4"`` packs two
+    signed nibbles per byte beside a per-row f16 scale (~an eighth of
+    f32), and ``"pq"`` stores one byte per subspace against
+    hyperbolic-aware codebooks trained in the tangent/Lorentz lift
+    (serve/quant.py; ``quant=`` accepts a precomputed payload, e.g.
+    from an artifact) — both serve through the same over-fetch +
+    f32-rescore machinery at the wider ``k + max(16k, 128)``
+    window, so returned ranks and distances
+    always come from full-precision manifold math (docs/serving.md
+    "Sub-int8 lanes").  Edge scoring
     (``score_edges``) is always f32: it is two cheap
     gathers plus one distance per pair, with no table scan to save.
 
@@ -687,7 +885,8 @@ class QueryEngine:
                  mesh=None, mesh_axis: str = "model",
                  scan_mode: str = "two_stage",
                  precision: str = "f32",
-                 index=None, nprobe: int = 0):
+                 index=None, nprobe: int = 0,
+                 quant=None, pq_m: int = 0):
         table = np.ascontiguousarray(np.asarray(table))
         if table.ndim != 2:
             raise ValueError(f"table must be [N, D]; got {table.shape}")
@@ -701,12 +900,26 @@ class QueryEngine:
         self.spec = tuple(manifold_spec)
         self.scan_mode = scan_mode
         self.precision = precision
-        # int8 is a serve-only scan lane (serve/quant.py), not a
-        # precision-policy preset: the policy object stays f32 (master
+        # int8/int4/pq are serve-only scan lanes (serve/quant.py), not
+        # precision-policy presets: the policy object stays f32 (master
         # table, rescore math) and the quantized copy rides beside it
-        self._quant = precision == "int8"
+        self._quant = precision in QUANT_PRECISIONS
         self._policy = precision_mod.get_policy(
             "f32" if self._quant else precision)
+        # the static lane tag the jitted programs key on ("dense" covers
+        # f32 AND bf16 — the slab dtype distinguishes those)
+        self._lane = precision if self._quant else "dense"
+        # quant= accepts a serve/artifact.py QuantPayload (precomputed
+        # codes, e.g. shipped inside an artifact); it is consulted only
+        # when its lane matches the requested precision — an artifact
+        # may carry an int4 payload while this engine serves f32
+        self._payload = None
+        if quant is not None and getattr(quant, "lane", None) == precision:
+            if int(quant.num_nodes) != self.num_nodes:
+                raise ValueError(
+                    f"quant payload covers {quant.num_nodes} rows; table "
+                    f"has {self.num_nodes} — re-export for THIS table")
+            self._payload = quant
         self.fingerprint = fingerprint or fingerprint_of(table, self.spec)
         self.mesh, self.mesh_axis = mesh, mesh_axis
         shards = 1
@@ -725,6 +938,19 @@ class QueryEngine:
                              f"got {chunk_rows}")
         from hyperspace_tpu.kernels import scan_topk as fused_kernel
 
+        # PQ geometry is fixed before chunk sizing: the fused gate and
+        # the VMEM footprint depend on the subspace count m
+        self._pq_m = 0
+        if precision == "pq":
+            from hyperspace_tpu.serve.index import _lift_dim
+            from hyperspace_tpu.serve.quant import default_pq_m
+
+            # a payload's trained geometry wins; pq_m= retunes the
+            # bytes/fidelity trade only when the engine trains fresh
+            self._pq_m = (int(self._payload.params["m"])
+                          if self._payload is not None
+                          else int(pq_m)
+                          or default_pq_m(_lift_dim(self.spec, self.dim)))
         # fused-capable = the family/dim the fused kernel can serve; k-
         # level fallback (oversized k per call) is decided per dispatch.
         # An engine whose spec is NOT fused-capable keeps the default
@@ -732,18 +958,26 @@ class QueryEngine:
         self._fused_kind = (scan_mode == "fused"
                             and fused_kernel.kind_supported(self.spec)
                             and self.dim <= fused_kernel.FUSED_MAX_DIM)
-        scan_dtype = (jnp.int8 if self._quant
+        if precision == "pq" and self._fused_kind:
+            # the PQ kernel is gated on the subspace count, not the dim
+            # (its tiles are [bm, m] codes, never [bm, D] rows)
+            self._fused_kind = self._pq_m <= fused_kernel.FUSED_MAX_PQ_M
+        scan_dtype = (jnp.uint8 if precision in ("int4", "pq")
+                      else jnp.int8 if self._quant
                       else self._policy.compute if self._policy.mixed
                       else jnp.float32)
+        # the packed lanes size their fused tiles off their own VMEM
+        # footprint branches (packed width / code+LUT blocks)
+        sizing_dim = 128 if precision == "pq" else self.dim
         self.chunk_rows = chunk_rows or auto_chunk_rows(
-            self.dim, self.spec[0], self.num_nodes, tile_budget,
+            sizing_dim, self.spec[0], self.num_nodes, tile_budget,
             scan_mode=("fused" if self._fused_kind else "two_stage"),
-            dtype=scan_dtype)
+            dtype=scan_dtype, lane=self._lane, pq_m=self._pq_m)
         if self._fused_kind and (
                 self.chunk_rows % 128
                 or self.chunk_rows > fused_kernel.fused_tile_rows(
-                    self.dim, scan_dtype, fused_kernel.FUSED_MAX_K,
-                    allow_tuned=False)):
+                    sizing_dim, scan_dtype, fused_kernel.FUSED_MAX_K,
+                    allow_tuned=False, lane=self._lane, pq_m=self._pq_m)):
             # allow_tuned=False: this check is the VMEM-FIT bound (what
             # a real chip's Mosaic would accept), not the autotuner's
             # speed preference — a tuned table picking a SMALLER tile
@@ -780,19 +1014,64 @@ class QueryEngine:
         # layout/sharding) — built ONCE here, not per query; the f32
         # policy aliases the table so the default path holds one array
         self.scan_scale = None
+        self.pq_codebooks = None
+        self._pq_fp = None
         if self._quant:
-            from hyperspace_tpu.serve.quant import quantize_rows
+            put = ((lambda a: jax.device_put(
+                a, table_sharding(mesh, mesh_axis)))
+                if shards > 1 else jnp.asarray)
+            pad_rows = padded - self.num_nodes
 
-            # quantize the PADDED table: zero padding rows get scale 0
-            # and dequantize to exact zeros, like the f32 padding
-            q8, sc = quantize_rows(table)
-            if shards > 1:
-                put = lambda a: jax.device_put(
-                    a, table_sharding(mesh, mesh_axis))
+            def _pad0(a):
+                # payload arrays cover the UNPADDED table; grow them
+                # with zero rows (zero codes/scales dequantize to exact
+                # zeros — and padded rows are masked by index anyway)
+                if not pad_rows:
+                    return np.ascontiguousarray(a)
+                return np.concatenate(
+                    [a, np.zeros((pad_rows,) + a.shape[1:], a.dtype)],
+                    axis=0)
+
+            if precision == "int8":
+                from hyperspace_tpu.serve.quant import quantize_rows
+
+                # quantize the PADDED table: zero padding rows get scale
+                # 0 and dequantize to exact zeros, like the f32 padding
+                q8, sc = quantize_rows(table)
                 self.scan_table, self.scan_scale = put(q8), put(sc)
-            else:
-                self.scan_table = jnp.asarray(q8)
-                self.scan_scale = jnp.asarray(sc)
+            elif precision == "int4":
+                from hyperspace_tpu.serve.quant import pack_int4_rows
+
+                if self._payload is not None:
+                    pk = _pad0(self._payload.arrays["packed"])
+                    sc = _pad0(self._payload.arrays["scale"])
+                else:
+                    pk, sc = pack_int4_rows(table)
+                # the scale stays f16 resident (the lane's byte budget);
+                # both scan paths cast to f32 at the point of use
+                self.scan_table, self.scan_scale = put(pk), put(sc)
+            else:  # pq
+                from hyperspace_tpu.serve.quant import (build_pq,
+                                                        pq_fingerprint_of)
+
+                if self._payload is not None:
+                    codes = _pad0(self._payload.arrays["codes"])
+                    cb = np.asarray(self._payload.arrays["codebooks"],
+                                    np.float32)
+                    pp = self._payload.params
+                    self._pq_fp = pq_fingerprint_of(
+                        cb, lift_dim=int(pp["lift_dim"]),
+                        iters=int(pp["iters"]), seed=int(pp["seed"]))
+                else:
+                    # train on the UNPADDED rows (pad rows would skew
+                    # the subspace k-means), pad the codes after
+                    codes, cbk = build_pq(table[:self.num_nodes],
+                                          self.spec, m=self._pq_m)
+                    codes, cb = _pad0(codes), cbk.codebooks
+                    self._pq_fp = cbk.fingerprint
+                self.scan_table = put(codes)
+                # codebooks are KB-scale: replicated, never sharded
+                self.pq_codebooks = jnp.asarray(cb, jnp.float32)
         elif self._policy.mixed:
             scan_np = table.astype(self._policy.compute)
             self.scan_table = (
@@ -868,17 +1147,31 @@ class QueryEngine:
     def _lane_markers(self) -> tuple:
         """Result-identity suffixes shared by every signature variant:
         ``"fused"`` (rank-identical but only ulp-close distances) and
-        the ``"int8"`` scan lane (different candidate sets than the f32
-        or bf16 scans — quantized rows must never be served back as
+        the quantized scan lane (``"int8"``/``"int4"``, or ``("pq",
+        codebook fingerprint)`` — different candidate sets than the f32
+        or bf16 scans, and two PQ engines with different codebooks
+        produce different candidate sets, so the fingerprint rides in
+        the key; quantized rows must never be served back as
         full-precision rows, whatever else the cache key carries)."""
-        return ((("fused",) if self._fused_kind else ())
-                + (("int8",) if self._quant else ()))
+        lane = ()
+        if self._quant:
+            lane = (("pq", self._pq_fp) if self.precision == "pq"
+                    else (self.precision,))
+        return (("fused",) if self._fused_kind else ()) + lane
 
     def _k_scan(self, k: int, cap: int) -> int:
         """Over-fetch width of the low-precision coarse scan: the f32
         rescore can only repair a k-th-boundary mis-rank that is IN the
-        candidate set.  int8 gets a wider margin than bf16 — its
-        quantization step is coarser (docs/serving.md)."""
+        candidate set.  int8 gets a wider margin than bf16 (coarser
+        quantization step), int4/pq wider still — a 4-bit step / a
+        per-subspace codebook error dominates neighbor gaps at serve
+        densities (docs/serving.md)."""
+        if self.precision == "pq":
+            return min(k + max(_PQ_RESCORE_MULT * k,
+                               _PQ_RESCORE_MIN), cap)
+        if self.precision == "int4":
+            return min(k + max(_INT4_RESCORE_MULT * k,
+                               _INT4_RESCORE_MIN), cap)
         if self._quant:
             return min(k + max(_QUANT_RESCORE_MULT * k,
                                _QUANT_RESCORE_MIN), cap)
@@ -887,8 +1180,16 @@ class QueryEngine:
     @classmethod
     def from_artifact(cls, art: ServingArtifact, **kw) -> "QueryEngine":
         kw.setdefault("index", art.index)
+        kw.setdefault("quant", getattr(art, "quant", None))
         return cls(art.table, art.manifold_spec,
                    fingerprint=art.fingerprint, **kw)
+
+    @property
+    def _scan_aux(self):
+        """The scan lane's traced companion operand: per-row dequant
+        scales (int8/int4), the PQ codebooks, or None (f32/bf16)."""
+        return (self.pq_codebooks if self.precision == "pq"
+                else self.scan_scale)
 
     # --- queries --------------------------------------------------------------
 
@@ -932,17 +1233,18 @@ class QueryEngine:
             k_scan = self._k_scan(k, self.num_nodes)
             if self.shards > 1:
                 return _topk_sharded_mixed(
-                    self.table, self.scan_table, self.scan_scale, q_idx,
+                    self.table, self.scan_table, self._scan_aux, q_idx,
                     spec=self.spec, k=k, k_scan=k_scan,
                     chunk=self.chunk_rows,
                     n=self.num_nodes, exclude_self=exclude_self,
                     mode=self._scan_mode_eff, mesh=self.mesh,
-                    axis=self.mesh_axis)
+                    axis=self.mesh_axis, lane=self._lane)
             return _topk_chunked_mixed(
-                self.table, self.scan_table, self.scan_scale, q_idx,
+                self.table, self.scan_table, self._scan_aux, q_idx,
                 spec=self.spec, k=k,
                 k_scan=k_scan, chunk=self.chunk_rows, n=self.num_nodes,
-                exclude_self=exclude_self, mode=self._scan_mode_eff)
+                exclude_self=exclude_self, mode=self._scan_mode_eff,
+                lane=self._lane)
         if self.shards > 1:
             return _topk_sharded(
                 self.table, q_idx, spec=self.spec, k=k,
@@ -986,7 +1288,8 @@ class QueryEngine:
             q_idx, spec=self.spec, k=k, k_scan=k_scan, nprobe=p,
             chunk=self._cand_chunk, exclude_self=exclude_self,
             mixed=self._policy.mixed or self._quant,
-            mode=self._scan_mode_eff, scan_scale=self.scan_scale)
+            mode=self._scan_mode_eff, scan_scale=self._scan_aux,
+            lane=self._lane)
         telem.observe("serve/index_probe_ms",
                       (time.perf_counter() - t0) * 1e3)
         telem.inc("serve/recall_candidates", int(q_idx.shape[0]) * capacity)

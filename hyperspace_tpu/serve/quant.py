@@ -1,5 +1,6 @@
-"""Per-row symmetric int8 quantization for the serve table's coarse-scan
-lane (docs/serving.md "Quantized scan lane").
+"""Sub-f32 quantization for the serve table's coarse-scan lanes
+(docs/serving.md "Quantized scan lane"): per-row symmetric int8 and
+int4, and hyperbolic-aware product quantization (PQ).
 
 The bf16 scan-then-f32-rescore pattern (PR 5) and the fused kernel's
 half-byte bf16 slab streaming (PR 10) both rest on one property: a
@@ -26,9 +27,42 @@ dynamic range.
 Symmetric (zero-point-free) quantization keeps the dequantize a single
 multiply — no add riding into the kernel's Gram matmuls — and maps
 0 → 0 exactly, which the engine's zero-row padding relies on.
+
+The two quarter-precision lanes (ISSUE 16) push below int8:
+
+- **int4** packs two signed nibbles per byte in a *planar* layout:
+  byte column ``j`` of a row holds element ``j`` in its low nibble and
+  element ``hw + j`` (``hw = ceil(D/2)``) in its high nibble.  The
+  unpacked element order is therefore ``concat(low_nibbles,
+  high_nibbles)`` — a static lane permutation, never an interleave, so
+  the kernel's in-register unpack is two shifts and a concatenate and
+  element 0 stays in lane 0 (the Lorentz time flip keeps working on
+  lane-padded tiles).  The per-row symmetric scale is stored
+  **float16** (cast to f32 at use): at 10M×8 that is 4 B codes + 2 B
+  scale per row = 60 MB vs int8's 114 MB.
+- **PQ** splits the row into ``m`` subspaces of ``ds`` coordinates and
+  stores one uint8 centroid code per subspace.  Codebooks are trained
+  in the **lift** of ``serve/index.py``'s Lloyd loop (poincare rows
+  lift to the Lorentz hyperboloid, product specs lift per factor), so
+  the Euclidean per-subspace k-means respects the geometry the scan
+  distance is computed in, and the ADC trick applies: for the
+  lorentz-gram families the scan distance depends on a candidate only
+  through the *additive* ``⟨q_L, y_L⟩_L``, so one per-query lookup
+  table of subspace partial inner products replaces the Gram matmul.
+
+Both lanes keep the int8 contract shape — the coarse pass only has to
+keep the true top-k inside the over-fetch window, final ranks and
+distances always come from the f32 rescore — at a wider ``k +
+max(16k, 128)`` window: a 4-bit step (or a 256-way subspace codebook)
+is far coarser than int8's per-element step, so the coarse ranking
+noise swamps neighbor gaps much sooner as table density grows.
 """
 
 from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
 
 import numpy as np
 
@@ -69,3 +103,220 @@ def quant_error_bound(scale: np.ndarray) -> float:
     margin is sized against (docs/serving.md)."""
     s = np.asarray(scale, np.float32)
     return float(s.max() / 2.0) if s.size else 0.0
+
+
+# --- int4 lane ----------------------------------------------------------------
+
+# int4 levels per side: symmetric two's-complement nibbles in [-7, 7]
+# (-8 is never produced, mirroring the int8 lane's -128 rule)
+QLEVELS4 = 7
+
+
+def int4_packed_width(dim: int) -> int:
+    """Packed byte columns per row: two elements per byte, planar."""
+    return (int(dim) + 1) // 2
+
+
+def pack_int4_rows(table: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Per-row symmetric int4 quantization, two nibbles per byte.
+
+    ``table`` [N, D] float → ``(packed [N, ceil(D/2)] uint8,
+    scale [N, 1] float16)``.  Byte ``j`` holds element ``j`` (low
+    nibble) and element ``hw + j`` (high nibble, ``hw = ceil(D/2)``;
+    zero when past D).  The scale is quantized to float16 FIRST and the
+    codes are fitted against the stored value, so the host twin
+    (:func:`unpack_int4_rows` × ``scale``) and the device's in-register
+    unpack reconstruct bit-identically.  All-zero rows get scale 0 and
+    codes 0.
+    """
+    table = np.asarray(table, np.float32)
+    if table.ndim != 2:
+        raise ValueError(f"table must be [N, D]; got {table.shape}")
+    n, d = table.shape
+    amax = np.max(np.abs(table), axis=1, keepdims=True)          # [N, 1]
+    scale = (amax / QLEVELS4).astype(np.float16)                 # stored
+    s32 = scale.astype(np.float32)
+    safe = np.where(s32 > 0, s32, 1.0)
+    q = np.clip(np.rint(table / safe), -QLEVELS4, QLEVELS4).astype(np.int8)
+    hw = int4_packed_width(d)
+    planar = np.zeros((n, 2 * hw), np.int8)
+    planar[:, :d] = q
+    lo = planar[:, :hw].astype(np.uint8) & 0xF
+    hi = planar[:, hw:].astype(np.uint8) & 0xF
+    return (lo | (hi << 4)).astype(np.uint8), scale
+
+
+def unpack_int4_rows(packed: np.ndarray, dim: int) -> np.ndarray:
+    """Host twin of the device unpack: ``packed`` [N, hw] uint8 →
+    signed int8 codes [N, dim] (low nibbles first, then high)."""
+    packed = np.asarray(packed, np.uint8)
+    lo = (packed & 0xF).astype(np.int8)
+    hi = (packed >> 4).astype(np.int8)
+    lo = np.where(lo >= 8, lo - 16, lo)
+    hi = np.where(hi >= 8, hi - 16, hi)
+    return np.concatenate([lo, hi], axis=-1)[..., :int(dim)]
+
+
+def unpack_int4_jnp(packed, dim: int):
+    """Traced (jax.numpy) twin of :func:`unpack_int4_rows`: ``packed``
+    [..., hw] uint8 → signed int32 codes [..., dim].  The ONE in-trace
+    nibble-unpack recipe serve code may use — the ``packing-literal``
+    lint fences the raw ``& 0xF`` / ``>> 4`` idiom into this module and
+    ``kernels/`` so the planar layout can never fork silently."""
+    import jax.numpy as jnp
+
+    t = packed.astype(jnp.int32)
+    lo = t & 0xF
+    hi = t >> 4
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    return jnp.concatenate([lo, hi], axis=-1)[..., :int(dim)]
+
+
+def dequantize_int4_rows(packed: np.ndarray, scale: np.ndarray,
+                         dim: int) -> np.ndarray:
+    """``unpack × scale`` in f32 — exactly what the scan paths apply."""
+    codes = unpack_int4_rows(packed, dim).astype(np.float32)
+    return codes * np.asarray(scale, np.float32)
+
+
+# --- PQ lane ------------------------------------------------------------------
+
+PQ_VERSION = 1
+# centroids per subspace — one uint8 code
+PQ_CENTERS = 256
+
+
+def default_pq_m(lift_dim: int) -> int:
+    """Default subspace count: ~4 lifted coordinates per byte of code
+    (10M rows at the bench's poincare dim 8 → lift 9 → m 3 → 30 MB)."""
+    return max(1, (int(lift_dim) + 3) // 4)
+
+
+@dataclasses.dataclass(frozen=True)
+class PQCodebook:
+    """Per-subspace centroid tables, trained in the manifold lift."""
+
+    codebooks: np.ndarray  # [m, PQ_CENTERS, ds] f32, lifted coords
+    lift_dim: int          # true lifted width (m*ds - lift_dim pad lanes)
+    iters: int             # Lloyd iterations used
+    seed: int              # k-means++ seeding RNG seed
+    fingerprint: str       # content hash (arrays + train params)
+
+    @property
+    def m(self) -> int:
+        return int(self.codebooks.shape[0])
+
+    @property
+    def ds(self) -> int:
+        return int(self.codebooks.shape[2])
+
+
+def pq_fingerprint_of(codebooks: np.ndarray, *, lift_dim: int, iters: int,
+                      seed: int) -> str:
+    """Content identity of a codebook set (mirrors
+    ``serve/index.py:index_fingerprint_of``): sha256 over the arrays
+    and the train parameters — the lane marker / cache-key ingredient,
+    so engines decoding through DIFFERENT codebooks can never serve
+    each other's rows."""
+    codebooks = np.ascontiguousarray(codebooks)
+    h = hashlib.sha256()
+    h.update(json.dumps({
+        "version": PQ_VERSION, "lift_dim": int(lift_dim),
+        "iters": int(iters), "seed": int(seed),
+        "codebooks": [list(codebooks.shape), str(codebooks.dtype)],
+    }, sort_keys=True).encode())
+    h.update(codebooks.tobytes())
+    return h.hexdigest()
+
+
+def _sq_dists(x: np.ndarray, cent: np.ndarray) -> np.ndarray:
+    """[n, ds] × [k, ds] → [n, k] squared distances (matmul form)."""
+    xx = np.einsum("nd,nd->n", x, x)[:, None]
+    cc = np.einsum("kd,kd->k", cent, cent)[None, :]
+    return np.maximum(xx - 2.0 * (x @ cent.T) + cc, 0.0)
+
+
+def _kmeans_subspace(data: np.ndarray, rng, iters: int) -> np.ndarray:
+    """256-center Euclidean k-means on one lifted subspace: k-means++
+    D² seeding + fixed-iteration Lloyd (empty cells keep their seed,
+    like the IVF builder's rule)."""
+    n = data.shape[0]
+    k = PQ_CENTERS
+    cent = np.empty((k, data.shape[1]), np.float32)
+    cent[0] = data[int(rng.integers(n))]
+    d2 = _sq_dists(data, cent[:1])[:, 0]
+    for j in range(1, k):
+        tot = float(d2.sum())
+        if tot <= 0.0:
+            # fewer distinct points than centers: duplicate uniformly
+            cent[j:] = data[rng.integers(0, n, size=k - j)]
+            break
+        cent[j] = data[int(rng.choice(n, p=d2 / tot))]
+        d2 = np.minimum(d2, _sq_dists(data, cent[j:j + 1])[:, 0])
+    for _ in range(int(iters)):
+        assign = np.argmin(_sq_dists(data, cent), axis=1)
+        sums = np.zeros_like(cent)
+        np.add.at(sums, assign, data)
+        cnt = np.bincount(assign, minlength=k)
+        nz = cnt > 0
+        cent[nz] = sums[nz] / cnt[nz, None]
+    return cent
+
+
+def build_pq(table: np.ndarray, spec: tuple, *, m: int = 0,
+             iters: int = 6, seed: int = 0,
+             sample: int = 1 << 16) -> tuple[np.ndarray, PQCodebook]:
+    """Train lifted-subspace codebooks and encode the whole table.
+
+    ``table`` [N, D] rows on the manifold → ``(codes [N, m] uint8,
+    :class:`PQCodebook`)``.  Rows are lifted exactly as the IVF
+    builder's Lloyd loop lifts them (``serve/index.py:_lift``), the
+    lift is zero-padded to ``m*ds`` lanes, and each ``ds``-wide
+    subspace trains its own 256-center Euclidean k-means on a bounded
+    ``sample`` (D² seeding, ``seed``-deterministic).  Encoding assigns
+    every row to its nearest centroid per subspace, chunked so the
+    [chunk, 256] distance tile stays bounded at any N.
+    """
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.serve.index import _lift, _lift_dim
+
+    table = np.asarray(table, np.float32)
+    if table.ndim != 2:
+        raise ValueError(f"table must be [N, D]; got {table.shape}")
+    n, d = table.shape
+    dl = _lift_dim(spec, d)
+    m = int(m) if m else default_pq_m(dl)
+    if not 1 <= m <= dl:
+        raise ValueError(f"pq m={m} must be in [1, lift_dim={dl}]")
+    ds = (dl + m - 1) // m
+    lifted = np.asarray(_lift(spec, jnp.asarray(table)), np.float32)
+    if m * ds > dl:
+        lifted = np.concatenate(
+            [lifted, np.zeros((n, m * ds - dl), np.float32)], axis=1)
+    rng = np.random.default_rng(seed)
+    train = lifted if n <= sample else \
+        lifted[rng.choice(n, size=sample, replace=False)]
+    cbs = np.stack([
+        _kmeans_subspace(train[:, s * ds:(s + 1) * ds], rng, iters)
+        for s in range(m)])
+    codes = np.empty((n, m), np.uint8)
+    chunk = 4096
+    for lo in range(0, n, chunk):
+        block = lifted[lo:lo + chunk]
+        for s in range(m):
+            codes[lo:lo + chunk, s] = np.argmin(
+                _sq_dists(block[:, s * ds:(s + 1) * ds], cbs[s]),
+                axis=1).astype(np.uint8)
+    fp = pq_fingerprint_of(cbs, lift_dim=dl, iters=iters, seed=seed)
+    return codes, PQCodebook(codebooks=cbs, lift_dim=dl, iters=int(iters),
+                             seed=int(seed), fingerprint=fp)
+
+
+def pq_decode(cb: PQCodebook, codes: np.ndarray) -> np.ndarray:
+    """Host twin of the device decode: codes [N, m] → lifted
+    reconstructions [N, m*ds] f32 (pad lanes included)."""
+    codes = np.asarray(codes)
+    parts = [cb.codebooks[s][codes[:, s]] for s in range(cb.m)]
+    return np.concatenate(parts, axis=-1).astype(np.float32)

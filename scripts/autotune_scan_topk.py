@@ -62,6 +62,14 @@ def main(argv=None) -> int:
                     help="table path (default: the consulted table — "
                          "HYPERSPACE_AUTOTUNE_TABLE or "
                          "configs/scan_topk_tiles.json)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="walk the grid and emit a schema-complete table "
+                         "WITHOUT timing anything on a device: each entry "
+                         "takes the static model's tile cap, ms=0.0, and "
+                         "device_kind='dry-run' (inert — real lookups are "
+                         "keyed by the actual device kind, so a dry table "
+                         "never matches).  Prints to stdout unless --out "
+                         "is given, so it can never clobber a real table.")
     args = ap.parse_args(argv)
 
     from hyperspace_tpu.kernels import autotune
@@ -77,6 +85,40 @@ def main(argv=None) -> int:
     except ValueError as e:
         raise SystemExit(f"bad grid list: {e}") from None
     dtypes = [t.strip() for t in args.dtypes.split(",") if t.strip()]
+
+    if args.dry_run:
+        from hyperspace_tpu.kernels import scan_topk as K
+
+        entries = autotune.load_table(args.out) if args.out else {}
+        for variant in variants:
+            for dim in dims:
+                for dtype in dtypes:
+                    for k in ks:
+                        # the static footprint cap — the largest tile a
+                        # real tune would be allowed to time
+                        cap = (K.fused_tile_rows(dim, dtype, k,
+                                                 allow_tuned=False)
+                               if variant == "slab"
+                               else K.fused_cand_tile_rows(
+                                   dim, dtype, k, allow_tuned=False))
+                        key = autotune.entry_key(variant, dim, dtype, k,
+                                                 "dry-run")
+                        entries[key] = {
+                            "variant": variant, "dim": int(dim),
+                            "dtype": dtype, "k": int(k),
+                            "device_kind": "dry-run", "bm": int(cap),
+                            "ms": 0.0, "timings": {},
+                        }
+        doc = {"version": autotune.TABLE_VERSION, "entries": entries}
+        if args.out:
+            autotune.save_table(entries, args.out)
+            print(f"[autotune] dry-run: {len(entries)} entr"
+                  f"{'y' if len(entries) == 1 else 'ies'} -> {args.out}")
+        else:
+            import json
+
+            print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0
 
     entries = autotune.autotune(
         dims, dtypes, ks, variants=variants, rows=args.rows,

@@ -10,7 +10,12 @@ the loaded artifact; any bit difference in neighbors or distances — or
 a fingerprint drift — fails (exit 1).  A second artifact ships an IVF
 index (serve/index.py) and must reproduce its fingerprints, keep
 assignment totality, and answer ``nprobe=ncells`` (the degenerate
-probe) bitwise-identically to the exact engine.  Run by
+probe) bitwise-identically to the exact engine.  A third pair of
+artifacts ship the sub-int8 quant payloads (int4 packed nibbles, PQ
+codes + codebooks — serve/quant.py): each must reproduce its payload
+and artifact fingerprints, rank queries exactly like the live f32
+engine through the over-fetch + f32-rescore contract, and REJECT a
+tampered codebook/scale byte at load.  Run by
 ``tests/serve/test_check_script.py`` inside the suite, mirroring the
 telemetry-catalog lint, so a serialization regression fails the build.
 """
@@ -101,6 +106,81 @@ def _check_index_round_trip(table, spec, out_dir: str, live) -> int:
     return 0
 
 
+def _check_quant_round_trip(table, spec, out_dir: str, live) -> int:
+    """Export-with-quant-payload → load → serve-lane rank agreement.
+
+    For each sub-int8 lane (``int4``, ``pq``): build the payload, ship
+    it inside an artifact, load it back, and verify (a) the payload and
+    artifact fingerprints reproduce (and differ from the bare table's),
+    (b) an engine served from the loaded payload returns EXACTLY the
+    live f32 engine's neighbor ids — at this table size every lane's
+    over-fetch window absorbs the coarse pass's quantization error, and
+    the distances come from the f32 rescore, equal to the exact scan up
+    to a few ULPs — and (c) a flipped codebook/scale byte is rejected
+    at load (the payload hash covers the array bytes: docs/serving.md
+    "Sub-int8 lanes").
+    """
+    import numpy as np
+
+    from hyperspace_tpu.serve import (QueryEngine, build_quant_payload,
+                                      export_artifact, load_artifact)
+    from hyperspace_tpu.serve.artifact import QUANT_FILE
+
+    for lane in ("int4", "pq"):
+        d = f"{out_dir}.{lane}"
+        payload = build_quant_payload(np.asarray(table), spec, lane)
+        exported = export_artifact(d, table, spec, quant=payload,
+                                   overwrite=True)
+        loaded = load_artifact(d)
+        if loaded.quant is None or \
+                loaded.quant.fingerprint != payload.fingerprint:
+            print(f"{lane}: QUANT DRIFT: loaded payload fingerprint "
+                  f"!= built payload")
+            return 1
+        if loaded.fingerprint != exported.fingerprint:
+            print(f"{lane}: FINGERPRINT DRIFT: exported-with-quant != loaded")
+            return 1
+        if loaded.fingerprint == live.fingerprint:
+            print(f"{lane}: FINGERPRINT BUG: quant artifact hashes like "
+                  f"the bare table")
+            return 1
+        served = QueryEngine.from_artifact(loaded, precision=lane)
+        if served.precision != lane:
+            print(f"{lane}: loaded engine serves {served.precision!r}")
+            return 1
+        for qi, (ids, k) in enumerate(QUERIES):
+            q = np.asarray(ids, np.int32)
+            li, ld = (np.asarray(a) for a in live.topk_neighbors(q, k))
+            si, sd = (np.asarray(a) for a in served.topk_neighbors(q, k))
+            if not np.array_equal(li, si):
+                print(f"{lane} query {qi}: neighbor ranks differ from "
+                      f"the live f32 engine\n{li}\nvs\n{si}")
+                return 1
+            if not np.allclose(ld, sd, rtol=5e-6, atol=1e-8):
+                print(f"{lane} query {qi}: rescored distances drift "
+                      f"beyond ULP noise\n{ld}\nvs\n{sd}")
+                return 1
+        # tamper detection: flip one byte of the trained arrays on disk
+        qpath = os.path.join(d, QUANT_FILE)
+        with np.load(qpath) as z:
+            arrays = {name: np.array(z[name]) for name in z.files}
+        key = "codebooks" if lane == "pq" else "scale"
+        raw = arrays[key].view(np.uint8).reshape(-1).copy()
+        raw[0] ^= 0xFF
+        arrays[key] = raw.view(arrays[key].dtype).reshape(
+            arrays[key].shape)
+        np.savez(qpath, **arrays)
+        try:
+            load_artifact(d)
+        except ValueError:
+            pass
+        else:
+            print(f"{lane}: TAMPER NOT DETECTED: a flipped {key} byte "
+                  f"loaded cleanly")
+            return 1
+    return 0
+
+
 def main(out_dir: str | None = None) -> int:
     import numpy as np
 
@@ -137,6 +217,9 @@ def main(out_dir: str | None = None) -> int:
                 print(f"query {qi}: distances differ bitwise\n{ld}\nvs\n{sd}")
                 return 1
         rc = _check_index_round_trip(table, spec, out_dir + ".ivf", live)
+        if rc:
+            return rc
+        rc = _check_quant_round_trip(table, spec, out_dir + ".q", live)
         if rc:
             return rc
         print(f"serve artifact round-trip OK: {len(QUERIES)} queries "

@@ -1,0 +1,17 @@
+"""Bad fixture: hand-rolled nibble pack/unpack outside the int4 packing
+boundary (never imported; linted under a pretend hyperspace_tpu/ rel
+path)."""
+import numpy as np
+
+
+def unpack(packed):
+    lo = packed & 0xF          # nibble mask (hex spelling)
+    hi = packed >> 4           # nibble shift, non-constant operand
+    lo2 = packed & 15          # nibble mask (decimal spelling)
+    return np.concatenate([lo, hi, lo2], axis=-1)
+
+
+def pack(lo, hi):
+    top = hi << 4              # nibble shift (pack direction)
+    top = top & 0xF0           # high-nibble mask
+    return top | lo

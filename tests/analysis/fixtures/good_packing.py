@@ -1,0 +1,16 @@
+"""Good fixture: byte masks, constant arithmetic, and non-4 shifts are
+all legitimate — only the NIBBLE idiom is fenced."""
+
+
+def header(magic):
+    ndim = magic & 0xFF        # byte mask — data/mnist.py's IDX header
+    dtype_code = (magic >> 8) & 0xFF
+    return ndim, dtype_code
+
+
+SIXTEEN = 1 << 4               # pure constant arithmetic never fires
+PAGE = 1024 >> 4
+
+
+def halve(n):
+    return n >> 1              # shift by non-4 constant

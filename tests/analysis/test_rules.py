@@ -22,6 +22,7 @@ from hyperspace_tpu.analysis.rules.hostsync import HostSyncRule
 from hyperspace_tpu.analysis.rules.hosttable import (
     FullTableMaterializationRule)
 from hyperspace_tpu.analysis.rules.jitcache import JitCacheDefeatRule
+from hyperspace_tpu.analysis.rules.packing import PackingLiteralRule
 from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
 from hyperspace_tpu.analysis.rules.retry import UnboundedRetryRule
@@ -51,6 +52,8 @@ _PER_FILE = [
     ("bad_hosttable.py", FullTableMaterializationRule, None),
     ("bad_precision.py", PrecisionLiteralRule,
      "hyperspace_tpu/models/bad_precision.py"),
+    ("bad_packing.py", PackingLiteralRule,
+     "hyperspace_tpu/serve/bad_packing.py"),
     ("bad_units.py", MetricUnitSuffixRule, None),
 ]
 
@@ -407,6 +410,49 @@ def test_precision_hyperlint_suppression(tmp_path):
                  "# hyperlint: disable=precision-literal — fixture\n")
     report = lint_file(str(p), rel="hyperspace_tpu/models/m.py",
                        rules=[PrecisionLiteralRule()])
+    assert report.findings == []
+
+
+# --- packing-literal ----------------------------------------------------------
+
+
+def test_packing_bad_fixture_fires_every_shape():
+    report = _lint("bad_packing.py", PackingLiteralRule,
+                   rel="hyperspace_tpu/serve/bad_packing.py")
+    msgs = [f.message for f in report.findings]
+    assert report.exit_code() == 1 and len(report.findings) == 5
+    assert sum("`& 0xf`" in m for m in msgs) == 2   # hex AND decimal 15
+    assert any("`& 0xf0`" in m for m in msgs)
+    assert any("`>> 4`" in m for m in msgs)
+    assert any("`<< 4`" in m for m in msgs)
+
+
+def test_packing_good_fixture_is_clean():
+    """Byte masks (`& 0xFF` — data/mnist.py's IDX header), pure-constant
+    shifts (`1 << 4`), and non-4 shifts never fire."""
+    report = _lint("good_packing.py", PackingLiteralRule,
+                   rel="hyperspace_tpu/data/good_packing.py")
+    assert report.findings == []
+
+
+def test_packing_mnist_header_mask_is_clean():
+    """The REAL data/mnist.py (`magic & 0xFF`) stays clean — the rule
+    fences nibble masks, not byte masks."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, "hyperspace_tpu", "data", "mnist.py")
+    report = lint_file(path, rel="hyperspace_tpu/data/mnist.py",
+                       rules=[PackingLiteralRule()])
+    assert report.findings == []
+
+
+@pytest.mark.parametrize("rel", [
+    "hyperspace_tpu/serve/quant.py",        # the packing boundary itself
+    "hyperspace_tpu/kernels/scan_topk.py",  # kernels unpack in-register
+    "scripts/bad_packing.py",               # outside the package
+])
+def test_packing_scope_exemptions(rel):
+    report = _lint("bad_packing.py", PackingLiteralRule, rel=rel)
     assert report.findings == []
 
 

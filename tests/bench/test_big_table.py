@@ -1,7 +1,7 @@
-"""`bench_big_table` (r15, docs/benchmarks.md): a miniature end-to-end
-leg — sharded generation, host-streamed index build, all three serve
-lanes with recall-gated qps, the host-vs-in-HBM train pair — plus the
-compact-line field wiring."""
+"""`bench_big_table` (r15/r16, docs/benchmarks.md): a miniature
+end-to-end leg — sharded generation, host-streamed index build, the
+five serve lanes (f32/bf16/int8/int4/pq) with recall-gated qps, the
+host-vs-in-HBM train pair — plus the compact-line field wiring."""
 
 import json
 
@@ -46,6 +46,24 @@ def test_table_bytes_order_is_the_capacity_story(result):
     mb = result["detail"]["table_mb"]
     # int8 (code + per-row scale) < bf16 < f32 — the 4× lever
     assert mb["int8"] < mb["bf16"] < mb["f32"]
+    # the r16 quarter lanes keep shrinking (rounded to 0.1 MB, so the
+    # sub-int8 steps are <= at this miniature size, never >)
+    assert mb["int4"] <= mb["int8"]
+    assert mb["pq"] <= mb["int4"]
+
+
+def test_quarter_lanes_report(result):
+    """int4 rides the same rescore contract as int8 (recall-gated qps >
+    0); pq reports bytes + honest per-probe recall, qualifying or not."""
+    lanes = result["detail"]["lanes"]
+    assert lanes["int4"]["qps_at_recall99"] > 0
+    best = max(v["recall10"] for v in lanes["int4"]["probes"].values())
+    assert best >= 0.99
+    pq = lanes["pq"]
+    assert pq["table_mb"] <= lanes["int4"]["table_mb"]
+    assert pq["probes"], "pq must walk the probe ladder"
+    for v in pq["probes"].values():
+        assert 0.0 <= v["recall10"] <= 1.0 and v["qps"] > 0
 
 
 def test_train_pair_present_and_finite(result):

@@ -261,8 +261,17 @@ def test_direction_table_size_tokens_are_lower_better():
     for name in ("table_bytes", "detail.big_table.table_mb.int8",
                  "detail.big_table.table_mb.f32", "hbm_gb",
                  "detail.big_table.lanes.bf16.table_mb",
-                 "detail.big_table.hbm_bytes"):
+                 "detail.big_table.hbm_bytes",
+                 # the r16 sub-int8 lanes' compact fields and nested
+                 # paths gate the same way — smaller tables only
+                 "big_table_mb_int4", "big_table_mb_pq",
+                 "detail.big_table.table_mb.pq",
+                 "detail.big_table.lanes.int4.table_mb",
+                 "detail.big_table.lanes.pq.table_mb"):
         assert mod.direction(name) == "lower", name
+    # …while the lanes' per-probe recall stays a quality reading
+    assert mod.direction(
+        "detail.big_table.lanes.pq.probes.np8.recall10") == "higher"
     # substring immunity: "embed" carries no mb *word*
     assert mod.direction("poincare_embed_epoch_time") == "lower"  # time
     assert mod.direction("detail.poincare.embed_samples_per_s") == "higher"

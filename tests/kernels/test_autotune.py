@@ -211,6 +211,73 @@ def test_measure_and_autotune_roundtrip(tmp_path, monkeypatch):
     assert any("TPU v9" in k for k in entries2)
 
 
+def test_checked_in_table_parses_and_validates():
+    """The SHIPPED table (configs/scan_topk_tiles.json — tuned offline,
+    checked in so a deployment checkout starts tuned) parses at the
+    current schema version and every entry is self-consistent: the flat
+    key reproduces from the entry's own fields, the tile is on the 128
+    grid, and the timing is a non-negative number.  Guards the file
+    against hand-edits and schema drift (ISSUE 16)."""
+    path = autotune.default_table_path()
+    assert os.path.exists(path), path
+    doc = json.loads(open(path, encoding="utf-8").read())
+    assert doc["version"] == autotune.TABLE_VERSION
+    entries = doc["entries"]
+    assert entries, "the checked-in table must not be empty"
+    # load_table accepts it wholesale (no silent fallback-to-empty)
+    assert autotune.load_table(path) == entries
+    for key, e in entries.items():
+        assert e["variant"] in autotune.VARIANTS, key
+        assert autotune.entry_key(e["variant"], e["dim"], e["dtype"],
+                                  e["k"], e["device_kind"]) == key
+        assert autotune._valid_bm(e["bm"]) == e["bm"], key
+        assert isinstance(e["ms"], (int, float)) and e["ms"] >= 0, key
+
+
+def _load_script():
+    import importlib.util
+
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "scripts",
+        "autotune_scan_topk.py")
+    spec = importlib.util.spec_from_file_location("autotune_script", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_autotune_script_dry_run(tmp_path, capsys):
+    """--dry-run walks the grid and emits a schema-complete table
+    without timing on a device: static-model tiles, ms=0.0, and the
+    inert 'dry-run' device kind (a real lookup keyed by the actual
+    backend can never match it)."""
+    mod = _load_script()
+    out = str(tmp_path / "dry.json")
+    rc = mod.main(["--dry-run", "--dims", "8,16", "--ks", "4",
+                   "--dtypes", "float32", "--variants", "slab,cand",
+                   "--out", out])
+    assert rc == 0
+    doc = json.loads(open(out).read())
+    assert doc["version"] == autotune.TABLE_VERSION
+    assert len(doc["entries"]) == 4  # 2 dims x 1 k x 1 dtype x 2 variants
+    for key, e in doc["entries"].items():
+        assert e["device_kind"] == "dry-run" and e["ms"] == 0.0, key
+        assert autotune._valid_bm(e["bm"]) == e["bm"], key
+        assert autotune.entry_key(e["variant"], e["dim"], e["dtype"],
+                                  e["k"], "dry-run") == key
+    # dry entries are inert: the real device kind never matches them
+    monkey_free_lookup = autotune.load_table(out)
+    assert all("dry-run" in k for k in monkey_free_lookup)
+    # without --out the doc goes to stdout and nothing is written
+    capsys.readouterr()  # drain the first call's log line
+    rc = mod.main(["--dry-run", "--dims", "8", "--ks", "4",
+                   "--dtypes", "float32", "--variants", "slab"])
+    assert rc == 0
+    printed = json.loads(capsys.readouterr().out)
+    assert printed["version"] == autotune.TABLE_VERSION
+    assert len(printed["entries"]) == 1
+
+
 def test_autotune_script_smoke(tmp_path):
     """The offline driver end-to-end on a tiny grid (in-process: jax is
     already loaded; the script is import-safe)."""

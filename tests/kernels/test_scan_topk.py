@@ -185,6 +185,95 @@ def test_int8_slab_dequantizes_in_register(rng, monkeypatch, kind):
     assert np.array_equal(td.view(np.uint32), dd.view(np.uint32))
 
 
+@pytest.mark.parametrize("kind", ["poincare", "lorentz", "euclidean"])
+@pytest.mark.parametrize("exclude_self", [True, False])
+def test_int4_packed_slab_unpacks_in_register(rng, monkeypatch, kind,
+                                              exclude_self):
+    """The int4 lane (ISSUE 16): a planar two-nibble slab + per-row f16
+    scale — twin == interpreter bitwise, results RANK-identical to
+    scanning the pre-dequantized f32 table with distances ULP-tight
+    (the split-lane relayout reorders the coordinate reduction, so the
+    sums can differ in the last bit), and bitwise-invariant across the
+    double-buffered tile heights."""
+    from hyperspace_tpu.serve.quant import (dequantize_int4_rows,
+                                            pack_int4_rows)
+
+    table, spec, man = _table(rng, kind, 300, 6)
+    d_ = table.shape[1]
+    pk, sc = pack_int4_rows(table)
+    deq = dequantize_int4_rows(pk, sc, d_)
+    qidx = np.asarray([0, 50, 299], np.int32)
+    qf = jnp.asarray(deq[qidx])
+
+    def run(bm=128):
+        return F.scan_topk(jnp.asarray(pk), qf, jnp.asarray(qidx), 0,
+                           spec=spec, k=6, n=300,
+                           exclude_self=exclude_self, tile_rows=bm,
+                           scale=jnp.asarray(sc), packed=True)
+
+    (td, ti), (kd, ki) = _run_both(monkeypatch, run)
+    assert td.dtype == np.float32
+    assert np.array_equal(ti, ki)
+    assert np.array_equal(td.view(np.uint32), kd.view(np.uint32))
+
+    monkeypatch.setenv("HYPERSPACE_KERNELS", "xla")
+    dd, di = (np.asarray(a) for a in F.scan_topk(
+        jnp.asarray(deq), qf, jnp.asarray(qidx), 0, spec=spec, k=6,
+        n=300, exclude_self=exclude_self, tile_rows=128))
+    assert np.array_equal(ti, di)
+    assert np.allclose(td, dd, rtol=1e-6, atol=1e-7)
+    # the pipelined tile loop is result-invisible across tile heights
+    for bm in (256, 512):
+        bd, bi = (np.asarray(a) for a in run(bm))
+        assert np.array_equal(bi, ti), bm
+        assert np.array_equal(bd.view(np.uint32), td.view(np.uint32)), bm
+
+
+@pytest.mark.parametrize("kind", ["poincare", "lorentz", "euclidean"])
+@pytest.mark.parametrize("exclude_self", [True, False])
+def test_pq_coded_slab_scores_by_adc(rng, monkeypatch, kind,
+                                     exclude_self):
+    """The PQ lane (ISSUE 16): coded tiles scored via per-query LUTs —
+    twin == interpreter bitwise, invariant across tile heights, and
+    rank-matched against an argsort over the engine's decode-and-score
+    closed form on the reconstructed lifted rows (the fallback path the
+    ADC sum must agree with)."""
+    from hyperspace_tpu.serve.engine import _pq_lift_dist
+    from hyperspace_tpu.serve.index import _lift
+    from hyperspace_tpu.serve.quant import build_pq, pq_decode
+
+    table, spec, man = _table(rng, kind, 300, 6)
+    codes, cb = build_pq(table, spec, seed=0)
+    qidx = np.asarray([0, 50, 299], np.int32)
+    q_lift = jnp.asarray(np.asarray(
+        _lift(spec, jnp.asarray(table[qidx])), np.float32))
+    m = cb.m
+    assert F.supports_pq(spec, k=6, m=m)
+    lut = F.pq_lut(q_lift, jnp.asarray(cb.codebooks), kind=spec[0])
+
+    def run(bm=128):
+        return F.scan_topk_pq(jnp.asarray(codes), lut,
+                              jnp.asarray(qidx), 0, spec=spec, k=6, n=300,
+                              exclude_self=exclude_self, tile_rows=bm)
+
+    (td, ti), (kd, ki) = _run_both(monkeypatch, run)
+    assert td.dtype == np.float32
+    assert np.array_equal(ti, ki)
+    assert np.array_equal(td.view(np.uint32), kd.view(np.uint32))
+    for bm in (256, 512):
+        monkeypatch.setenv("HYPERSPACE_KERNELS", "xla")
+        bd, bi = (np.asarray(a) for a in run(bm))
+        assert np.array_equal(bi, ti), bm
+        assert np.array_equal(bd.view(np.uint32), td.view(np.uint32)), bm
+    # decode-and-score oracle: distances of the reconstructed rows
+    recon = jnp.asarray(pq_decode(cb, codes)[:, :cb.lift_dim])
+    ref = np.asarray(_pq_lift_dist(spec, q_lift, recon), np.float64)
+    if exclude_self:
+        ref[np.arange(len(qidx)), qidx] = np.inf
+    order = np.argsort(ref, axis=1, kind="stable")[:, :6]
+    assert np.array_equal(ti, order)
+
+
 def test_int8_cand_variant_gathers_scales(rng, monkeypatch):
     """The candidate variant's int8 path: per-candidate scale gather,
     twin == interpreter bitwise == the dequantized-table run."""

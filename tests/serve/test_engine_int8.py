@@ -219,7 +219,7 @@ def test_int8_prewarm(rng):
 def test_bad_precision_rejected(rng):
     with pytest.raises(ValueError, match="precision"):
         QueryEngine(_poincare_table(rng), ("poincare", 1.0),
-                    precision="int4")
+                    precision="int2")
 
 
 def test_serve_cli_accepts_int8(tmp_path, rng):
