@@ -324,6 +324,47 @@ def bench_sampled(repeats: int = 2) -> dict:
     return run_sampled_bench(repeats=repeats)
 
 
+# the serve pipeline's stage taxonomy (docs/observability.md "Span-level
+# tracing"): the first four are boundary stages — differences of
+# consecutive lifecycle stamps that sum to e2e exactly by construction —
+# the last two are nested engine windows inside `dispatch`
+STAGE_BOUNDARY = ("queue_wait", "collate_wait", "dispatch", "serialize")
+STAGE_NAMES = STAGE_BOUNDARY + ("device_compute", "rescore")
+
+
+def _stage_breakdown(delta, leg: str, e2e_mean=None) -> dict:
+    """Per-stage mean + p99 table from a snapshot delta's
+    ``hist/serve/stage/<name>_ms`` families, with the decomposition
+    invariant CHECKED: the boundary stages' means must sum to the e2e
+    mean within 5 % (``e2e_mean`` overrides the delta's own e2e
+    histogram when the delta window saw spans-off traffic too).  Raises
+    — a silently-drifting decomposition would report a breakdown that
+    no longer explains the headline latency."""
+    stages: dict = {}
+    for name in STAGE_NAMES:
+        h = delta.get(f"hist/serve/stage/{name}_ms")
+        if h and h["count"]:
+            stages[name] = {"n": h["count"],
+                            "mean_ms": round(h["sum"] / h["count"], 4),
+                            "p99_ms": h["p99"]}
+    if e2e_mean is None:
+        e2e = delta.get("hist/serve/e2e_ms")
+        if e2e and e2e["count"]:
+            e2e_mean = e2e["sum"] / e2e["count"]
+    if e2e_mean:
+        total = sum(stages[s]["mean_ms"] for s in STAGE_BOUNDARY
+                    if s in stages)
+        ratio = total / e2e_mean
+        if not 0.95 <= ratio <= 1.05:
+            raise RuntimeError(
+                f"{leg}: stage decomposition broke — boundary stages sum "
+                f"to {total:.3f} ms vs e2e mean {e2e_mean:.3f} ms "
+                f"(ratio {ratio:.3f}, want within 5%)")
+        stages["e2e_mean_ms"] = round(e2e_mean, 4)
+        stages["sum_vs_e2e"] = round(ratio, 4)
+    return stages
+
+
 def bench_serve(repeats: int = 2) -> dict:
     """Serving throughput: warm ``topk_neighbors`` queries/s per bucket.
 
@@ -426,6 +467,25 @@ def bench_serve(repeats: int = 2) -> dict:
         "padded_waste_ratio": round(
             delta.get("serve/padded_waste", 0) / max(slots, 1), 4),
     }
+
+    # --- per-stage latency decomposition (ISSUE 17): spans on for a
+    # dedicated pass, mean + p99 per stage from the stage histograms
+    # (``detail.stages``), and the construction invariant CHECKED at
+    # bench load — the four boundary stages are differences of
+    # consecutive lifecycle stamps, so their means must sum to the e2e
+    # mean within 5 % (a drift means a stage boundary stopped being
+    # stamped — exactly the regression this leg exists to catch)
+    from hyperspace_tpu.telemetry import spans as _spans
+
+    stage_base = reg.mark()
+    _spans.enable()
+    try:
+        for _ in range(max(2, repeats)):
+            bat.topk(rng.integers(0, n, size=64).tolist(), k)
+    finally:
+        _spans.disable()
+    detail["stages"] = _stage_breakdown(
+        reg.snapshot(baseline=stage_base), "serve_qps")
 
     # --- fused_vs_unfused (r12): the Pallas scan-top-k kernel
     # (scan_mode=fused, kernels/scan_topk.py — distance tiles in
@@ -853,8 +913,9 @@ def bench_serve_http(repeats: int = 2, *, qps: float = 120.0,
         detail["recompiles_steady"] = reg.get("jax/recompiles") - c1
 
         # observability-overhead pairs: the SAME shapes with the access
-        # log + SLO window armed vs off — the "~free when on" contract
-        # (docs/observability.md).  Order is BALANCED (off,on,on,off)
+        # log + SLO window + SPAN LAYER armed vs off — the "~free when
+        # on" contract (docs/observability.md; the span layer's budget
+        # is <= 1.05x, ISSUE 17).  Order is BALANCED (off,on,on,off)
         # and each mode takes its min-of-N p99: on a noisy CPU host
         # whichever pass runs first in a pair reads slower for reasons
         # that have nothing to do with instrumentation (measured 0.4–
@@ -863,26 +924,42 @@ def bench_serve_http(repeats: int = 2, *, qps: float = 120.0,
         import tempfile
 
         from hyperspace_tpu.serve.access import AccessLog
+        from hyperspace_tpu.telemetry import spans as _spans
         from hyperspace_tpu.telemetry.window import SloWindow
 
         obs_n = max(8, n_req // 2)
         obs_dir = tempfile.mkdtemp(prefix="bench_obs_")
         alog = AccessLog(os.path.join(obs_dir, "access.jsonl"))
         p99s: dict = {"off": [], "on": []}
+        stage_base = reg.mark()  # only on-passes feed stage histograms
+        on_e2e_sum = 0.0
+        on_e2e_n = 0
         try:
             for i, mode in enumerate(("off", "on", "on", "off")):
                 if mode == "on":
                     bat.access_sink = alog.emit
                     bat.window = SloWindow(30.0)
+                    _spans.enable()
                 pass_base = reg.mark()
                 await _open_loop(door.host, door.port, 16, qps, obs_n,
                                  40 + i)
-                row = _percentiles(reg.snapshot(baseline=pass_base))
+                pass_delta = reg.snapshot(baseline=pass_base)
+                row = _percentiles(pass_delta)
+                _spans.disable()
                 bat.access_sink = None
                 bat.window = None
+                if mode == "on":
+                    # the on-passes' own e2e basis for the stage-sum
+                    # check (the stage window below spans off-passes
+                    # whose e2e carries no stage samples)
+                    e2e = pass_delta.get("hist/serve/e2e_ms")
+                    if e2e and e2e["count"]:
+                        on_e2e_sum += e2e["sum"]
+                        on_e2e_n += e2e["count"]
                 if row:
                     p99s[mode].append(row["p99"])
         finally:
+            _spans.disable()
             bat.access_sink = None
             bat.window = None
             alog.close()
@@ -901,6 +978,12 @@ def bench_serve_http(repeats: int = 2, *, qps: float = 120.0,
         else:
             detail["observability"] = {"error": "paired pass empty",
                                        "pairs": p99s}
+        # the per-stage breakdown beside http_p99_ms (ISSUE 17): mean +
+        # p99 per stage over the spans-on passes, with the boundary-sum
+        # == e2e invariant checked against those passes' own e2e mean
+        detail["stages"] = _stage_breakdown(
+            reg.snapshot(baseline=stage_base), "serve_http",
+            e2e_mean=(on_e2e_sum / on_e2e_n if on_e2e_n else None))
         await door.drain()
 
         # overload pass: offered load far past capacity into a small

@@ -14,6 +14,7 @@ from hyperspace_tpu.analysis.rules.hostsync import HostSyncRule
 from hyperspace_tpu.analysis.rules.hosttable import (
     FullTableMaterializationRule)
 from hyperspace_tpu.analysis.rules.jitcache import JitCacheDefeatRule
+from hyperspace_tpu.analysis.rules.monoclock import MonotonicClockRule
 from hyperspace_tpu.analysis.rules.packing import PackingLiteralRule
 from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
@@ -35,6 +36,7 @@ ALL_RULES = (
     PrecisionLiteralRule,
     PackingLiteralRule,
     MetricUnitSuffixRule,
+    MonotonicClockRule,
     TelemetryCatalogRule,
     FlagDocDriftRule,
 )

@@ -1,0 +1,107 @@
+"""monotonic-clock: wall-clock subtraction in latency-bearing code.
+
+Historical incident: ISSUE 17's span layer decomposes every request into
+stage durations whose sum must equal end-to-end latency within 5 %.  A
+single ``time.time()`` in that chain breaks the invariant invisibly —
+NTP slews the wall clock by milliseconds (exactly the magnitude of the
+stages being measured), and a step backwards yields a *negative* stage
+duration that poisons a histogram forever.  ``time.time()`` is correct
+for TIMESTAMPS (access-log ``ts`` fields, incident headers); it is never
+correct for DURATIONS.
+
+Flagged, in ``serve/``, ``telemetry/``, and ``train/`` only (the
+latency-bearing planes; elsewhere wall-clock arithmetic can be
+legitimate, e.g. deadline math against external epochs):
+
+- ``time.time() - t0`` / ``t1 - time.time()`` — a resolved
+  ``time.time`` call as either operand of a subtraction (aliased
+  imports included: ``from time import time``);
+- ``t = time.time()`` ... ``t2 - t`` — a name assigned from
+  ``time.time()`` used as a subtraction operand anywhere in the file.
+
+Not flagged: bare ``time.time()`` stamps (stored, logged, compared with
+``<``), and any ``time.perf_counter()`` / ``time.monotonic()`` math —
+those are the fix.
+
+Escape: ``# hyperlint: disable=monotonic-clock — reason`` on the
+subtraction line, for the rare deliberate wall-clock delta (e.g.
+cross-process skew estimation, where wall clock IS the subject).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+
+SCOPES = (
+    "hyperspace_tpu/serve/",
+    "hyperspace_tpu/telemetry/",
+    "hyperspace_tpu/train/",
+)
+
+
+def in_scope(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    return any(rel.startswith(p) for p in SCOPES)
+
+
+def _is_walltime_call(ctx: FileContext, node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and ctx.resolve(node.func) == "time.time")
+
+
+def _tainted_names(ctx: FileContext) -> set:
+    """Names assigned (anywhere in the file) from a bare ``time.time()``
+    call — simple single-target assignments only; anything fancier
+    already fires as a direct-call operand or is out of reach."""
+    names: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and _is_walltime_call(ctx, node.value)):
+            names.add(node.targets[0].id)
+        elif (isinstance(node, ast.AnnAssign)
+                and isinstance(node.target, ast.Name)
+                and node.value is not None
+                and _is_walltime_call(ctx, node.value)):
+            names.add(node.target.id)
+    return names
+
+
+class MonotonicClockRule(Rule):
+    id = "monotonic-clock"
+    severity = "error"
+    summary = ("time.time() used for a duration in serve/telemetry/train "
+               "— NTP slew corrupts latency math; use time.perf_counter()")
+
+    def check_file(self, ctx: FileContext):
+        if not in_scope(ctx.rel):
+            return []
+        tainted = _tainted_names(ctx)
+
+        def bad_operand(op: ast.AST) -> bool:
+            if _is_walltime_call(ctx, op):
+                return True
+            return (isinstance(op, ast.Name)
+                    and isinstance(op.ctx, ast.Load)
+                    and op.id in tainted)
+
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                operands = (node.left, node.right)
+            elif (isinstance(node, ast.AugAssign)
+                    and isinstance(node.op, ast.Sub)):
+                operands = (node.value,)
+            else:
+                continue
+            if any(bad_operand(op) for op in operands):
+                findings.append(self.finding(
+                    ctx, node,
+                    "wall-clock subtraction: time.time() measures the "
+                    "NTP-slewed wall clock, not elapsed time — use "
+                    "time.perf_counter() (or time.monotonic()) for "
+                    "durations; time.time() is for timestamps only"))
+        return findings

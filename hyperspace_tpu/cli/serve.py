@@ -180,6 +180,16 @@ class ServeConfig:
     # access records and dump a timestamped incident JSONL here on
     # typed-error bursts, degrade transitions, and SIGTERM drain
     incident_dir: str | None = None
+    # span-level pipeline tracing (telemetry/spans.py): every request
+    # decomposes into queue_wait / collate_wait / dispatch /
+    # device_compute / rescore / serialize stages — per-stage
+    # histograms on /metrics, stage breakdowns in the access log, and
+    # full span trees on failed/slow requests and incident dumps.
+    # Adds a device sync per dispatch (docs/observability.md "Spans").
+    trace: bool = False
+    # slow-query JSONL: with slo_ms>0, a request past the SLO writes
+    # its full access record + span tree here (implies trace=1)
+    slow_log: str | None = None
 
 
 def _ids(s: str, name: str) -> list[int]:
@@ -219,13 +229,18 @@ def _build(cfg: ServeConfig):
     # log, flight recorder — all optional, wired into the batcher so
     # every serving surface (stdin loop, one-shot query, front door)
     # carries the same records
-    window = recorder = alog = sink = None
+    window = recorder = alog = sink = slow = slow_sink = None
     if cfg.window_s < 0:
         raise SystemExit(f"window_s must be >= 0; got {cfg.window_s}")
     if cfg.window_s:
         from hyperspace_tpu.telemetry.window import SloWindow
 
         window = SloWindow(cfg.window_s)
+    if cfg.trace or cfg.slow_log:
+        # slow_log= needs span trees to attach, so it implies trace=
+        from hyperspace_tpu.telemetry import spans
+
+        spans.enable()
     try:
         if cfg.incident_dir:
             from hyperspace_tpu.serve.access import FlightRecorder
@@ -236,6 +251,11 @@ def _build(cfg: ServeConfig):
 
             alog = AccessLog(cfg.access_log, recorder=recorder)
             sink = alog.emit
+        if cfg.slow_log:
+            from hyperspace_tpu.serve.access import AccessLog
+
+            slow = AccessLog(cfg.slow_log)
+            slow_sink = slow.emit
     except OSError as e:  # uncreatable/unwritable path is a usage error
         raise SystemExit(f"observability path: {e}") from None
     try:
@@ -245,10 +265,12 @@ def _build(cfg: ServeConfig):
                                  queue_max=cfg.queue_max,
                                  deadline_ms=cfg.deadline_ms,
                                  window=window, slo_ms=cfg.slo_ms,
-                                 access_sink=sink, recorder=recorder)
+                                 access_sink=sink, recorder=recorder,
+                                 slow_sink=slow_sink)
     except ValueError as e:  # bad queue_max/deadline_ms/slo_ms
         raise SystemExit(str(e)) from None
     batcher.access_log = alog  # closed by the serve-session bracket
+    batcher.slow_log = slow
     return eng, batcher
 
 
@@ -460,6 +482,15 @@ def _serve_session(cfg: ServeConfig, batcher):
         alog = getattr(batcher, "access_log", None)
         if alog is not None:
             alog.close()
+        slow = getattr(batcher, "slow_log", None)
+        if slow is not None:
+            slow.close()
+        if cfg.trace or cfg.slow_log:
+            # span enablement is process-global (_build turned it on):
+            # an in-process caller (tests) must not inherit it
+            from hyperspace_tpu.telemetry import spans
+
+            spans.disable()
 
 
 def _json_bool(req: dict, key: str, default: bool) -> bool:

@@ -106,6 +106,13 @@ class RunConfig:
     # scrapeable with no port open.  Off (default) constructs nothing.
     metrics_out: str | None = None
     metrics_every: float = 30.0
+    # >0: per-step phase decomposition for the first N dispatches
+    # (train/telemetry.py): block_until_ready at the phase boundary so
+    # data_wait / host_gather / device_step / write_back histograms
+    # read real durations (the sync costs pipelining — bounded to the
+    # profile window), plus jax.profiler trace annotations and the
+    # compile-event hook.  0 (default) = free-running.
+    profile_steps: int = 0
     # >0: sample the on-device numerical-health stats every N chunks
     # (telemetry/health.py): ball boundary margin, hyperboloid
     # constraint residual, nonfinite counts — logged as health/* records
@@ -280,7 +287,8 @@ def run_poincare(run: RunConfig, overrides: dict):
         trainer = he.HostPlannedTrainer.from_state(
             cfg, opt, state, chunk_steps=run.host_chunk_steps,
             hot_rows=run.hot_rows, seed=run.seed,
-            gather_ahead=run.host_gather_ahead)
+            gather_ahead=run.host_gather_ahead,
+            profile=bool(getattr(run, "profile_steps", 0)))
         trainer.run(ds.pairs, run.steps)
         if run.ckpt_dir:
             # sharded master save: one bounded block per shard, never

@@ -468,6 +468,16 @@ class DeviceHotCache:
         nmiss = int(miss.sum())
         _telem.inc("host_table/cache_hits", len(ids) - nmiss)
         _telem.inc("host_table/cache_misses", nmiss)
+        # cumulative hit-rate gauge (the serve cache_hit_rate idiom):
+        # the level a dashboard — and the train plane's /metrics file —
+        # reads directly without differencing the counters
+        reg = _telem.default_registry()
+        lookups = (reg.get("host_table/cache_hits")
+                   + reg.get("host_table/cache_misses"))
+        if lookups:
+            _telem.set_gauge(
+                "host_table/cache_hit_rate",
+                round(reg.get("host_table/cache_hits") / lookups, 4))
         if not nmiss:
             return slots
         if miss_rows is None or len(miss_rows) != nmiss:

@@ -97,3 +97,32 @@ def assert_equal_across_hosts(x, msg: str = "") -> None:
     """Debug guard: all hosts must hold identical values (e.g. params
     after a DP step) — the multi-host analogue of a determinism check."""
     multihost_utils.assert_equal(x, fail_message=msg)
+
+
+def gather_metric_exports(registry=None) -> list:
+    """Every process's raw metric export, on every process.
+
+    The multihost half of ``telemetry/aggregate.py``: each process
+    JSON-encodes its ``Registry.export()`` tuple, the encoded payloads
+    ride one ``process_allgather`` (zero-padded uint8 rows — allgather
+    needs equal shapes, so a length field travels alongside), and every
+    process decodes all of them.  ``merge_exports`` of the result is the
+    fleet view; on one process this degenerates to ``[export_state()]``
+    with no collective issued, so the serve/train wiring is identical
+    for world_size 1 and N (the ISSUE 17 shape contract).
+    """
+    from hyperspace_tpu.telemetry import aggregate
+
+    if jax.process_count() == 1:
+        return [aggregate.export_state(registry)]
+    payload = aggregate.encode_bytes(aggregate.export_state(registry))
+    n = np.int32(len(payload))
+    lens = np.asarray(multihost_utils.process_allgather(n))
+    width = int(lens.max())
+    row = np.zeros((width,), dtype=np.uint8)
+    row[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    rows = np.asarray(multihost_utils.process_allgather(row))
+    return [
+        aggregate.decode_bytes(rows[i, : int(lens[i])].tobytes())
+        for i in range(rows.shape[0])
+    ]

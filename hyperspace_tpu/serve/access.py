@@ -164,7 +164,11 @@ class FlightRecorder:
             burst = (len(self._error_ts) == self.burst_n
                      and now - self._error_ts[0] <= self.burst_s)
         if burst:
-            self.dump(f"error_burst_{outcome}", _cls="error_burst")
+            # the triggering record rides the header: with spans on it
+            # carries its full span tree, so the incident names WHICH
+            # stage blew the budget, not just the flush id
+            self.dump(f"error_burst_{outcome}", _cls="error_burst",
+                      trigger=record)
 
     def note_degrade(self, old: int, new: int) -> None:
         """Ladder transition hook (both directions — a recovery's ring
@@ -172,7 +176,8 @@ class FlightRecorder:
         self.dump(f"degrade_{old}_to_{new}", _cls="degrade")
 
     def dump(self, reason: str, _cls: Optional[str] = None,
-             wait: bool = False) -> Optional[str]:
+             wait: bool = False,
+             trigger: Optional[dict] = None) -> Optional[str]:
         """Snapshot the ring and hand the file write to a background
         thread; returns the incident path (None when the reason class
         is inside its cooldown).  The triggers fire on the SERVING
@@ -203,6 +208,13 @@ class FlightRecorder:
         header = {"event": "incident", "reason": reason,
                   "ts": time.time(), "ring_len": len(records),
                   "counters": telem.default_registry().snapshot("ctr/")}
+        if trigger is not None:
+            # attribution: the request that tripped the trigger, and —
+            # when the span layer is on — its full span tree (the
+            # batcher attaches "span" to every non-ok record)
+            header["trigger_request_id"] = trigger.get("request_id")
+            if "span" in trigger:
+                header["trigger_span"] = trigger["span"]
         t = threading.Thread(target=self._write_dump,
                              args=(path, header, records),
                              name="flightrec-dump", daemon=True)

@@ -104,6 +104,7 @@ from hyperspace_tpu.serve.errors import (DeadlineExceededError,
                                          OverloadedError, ServeError,
                                          kind_of)
 from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry import spans
 from hyperspace_tpu.telemetry.trace import span, tracing
 
 DEFAULT_MIN_BUCKET = 8
@@ -222,7 +223,8 @@ class _Lifecycle:
 
     __slots__ = ("t_enq", "t_form", "info", "buckets_used",
                  "dispatch_s", "t_deadline", "op", "request_id",
-                 "flush_id", "cache_hits", "cache_misses", "t_done")
+                 "flush_id", "cache_hits", "cache_misses", "t_done",
+                 "t_coll", "t_result", "span")
 
     def __init__(self, op: str, deadline_ms: Optional[float] = None,
                  t_enq: Optional[float] = None,
@@ -244,6 +246,20 @@ class _Lifecycle:
             self.info["request_id"] = request_id
         self.buckets_used: list = []
         self.dispatch_s = 0.0
+        # stage boundary stamps (docs/observability.md "Span-level
+        # tracing"): t_coll marks collator hand-off (None on the sync
+        # path — collate_wait collapses to zero), t_result marks
+        # results materialized (serialize = the remainder).  Stages are
+        # DIFFERENCES of consecutive stamps, so they sum to e2e exactly
+        # by construction.
+        self.t_coll: Optional[float] = None
+        self.t_result: Optional[float] = None
+        # the request's span tree root (None when spans are disabled —
+        # the zero-cost default); the serve front door's request
+        # envelope, if any, adopts it
+        self.span = spans.root(op, request_id)
+        if self.span is not None:
+            self.span.t0 = self.t_enq  # align the tree to enqueue time
         # absolute expiry on the same monotonic clock as the stamps;
         # None = no deadline (the zero-cost default)
         self.t_deadline = (self.t_enq + deadline_ms / 1e3
@@ -251,6 +267,17 @@ class _Lifecycle:
 
     def formed(self) -> None:
         self.t_form = time.perf_counter()
+
+    def collated(self) -> None:
+        """Stamp collator hand-off: host-side work (validation + cache
+        pass) done, the request is about to wait for its flush group —
+        everything between this and ``formed()`` is collate wait."""
+        self.t_coll = time.perf_counter()
+
+    def result_ready(self) -> None:
+        """Stamp results materialized: device work (or the collated
+        flush) delivered; the remainder to completion is serialize."""
+        self.t_result = time.perf_counter()
 
     def check_deadline(self, where: str) -> None:
         """Raise ``deadline_exceeded`` when the request's budget is
@@ -279,6 +306,36 @@ class _Lifecycle:
         if self.buckets_used:
             telem.observe("serve/dispatch_ms", self.dispatch_s * 1e3)
         telem.observe("serve/e2e_ms", (self.t_done - self.t_enq) * 1e3)
+        if self.span is not None:
+            st = self.stages_ms()
+            telem.observe("serve/stage/queue_wait_ms", st["queue_wait"])
+            telem.observe("serve/stage/collate_wait_ms", st["collate_wait"])
+            telem.observe("serve/stage/dispatch_ms", st["dispatch"])
+            telem.observe("serve/stage/serialize_ms", st["serialize"])
+            t_coll = self.t_coll if self.t_coll is not None else self.t_form
+            t_res = (self.t_result if self.t_result is not None
+                     else self.t_done)
+            self.span.add("queue_wait", self.t_enq, t_coll)
+            self.span.add("collate_wait", t_coll, self.t_form)
+            self.span.add("dispatch", self.t_form, t_res)
+            self.span.add("serialize", t_res, self.t_done)
+            self.span.t1 = self.t_done  # exact close, not close()'s now
+
+    def stages_ms(self) -> dict:
+        """The per-stage latency decomposition, in ms: consecutive-
+        boundary differences that sum to ``e2e_ms`` exactly.  Computed
+        from the stamps with defaults (a sync request has no collate
+        wait; a failed request's serialize runs to its error time), so
+        the access log carries it for every outcome."""
+        end = self.t_done if self.t_done is not None else time.perf_counter()
+        t_coll = self.t_coll if self.t_coll is not None else self.t_form
+        t_res = self.t_result if self.t_result is not None else end
+        return {
+            "queue_wait": round((t_coll - self.t_enq) * 1e3, 3),
+            "collate_wait": round((self.t_form - t_coll) * 1e3, 3),
+            "dispatch": round((t_res - self.t_form) * 1e3, 3),
+            "serialize": round((end - t_res) * 1e3, 3),
+        }
 
     def access_record(self, outcome: str, degrade_level: int) -> dict:
         """One structured access-log line's payload (serve/access.py):
@@ -300,6 +357,9 @@ class _Lifecycle:
             "cache_hits": self.cache_hits,
             "cache_misses": self.cache_misses,
             "degrade_level": degrade_level,
+            # the per-stage decomposition (sums to e2e_ms exactly) —
+            # what scripts/trace_report.py aggregates
+            "stages": self.stages_ms(),
         }
 
 
@@ -364,7 +424,7 @@ class RequestBatcher:
                  ladder_high: float = 0.75, ladder_low: float = 0.25,
                  ladder_down_after: int = 1, ladder_up_after: int = 8,
                  window=None, slo_ms: float = 0.0,
-                 access_sink=None, recorder=None):
+                 access_sink=None, recorder=None, slow_sink=None):
         self.engine = engine
         self.buckets = bucket_sizes(min_bucket, max_bucket)
         self.cache = _LRU(cache_size)
@@ -382,11 +442,15 @@ class RequestBatcher:
         # per completed request; surfaces in stats()), `slo_ms` arms
         # the ladder's latency-aware pressure signal, `access_sink` is
         # a callable taking one access record (serve.access.AccessLog.
-        # emit), `recorder` a FlightRecorder fed degrade transitions
+        # emit), `recorder` a FlightRecorder fed degrade transitions,
+        # `slow_sink` the slow-query log — a second record sink fed
+        # only by requests breaching slo_ms, each carrying its span
+        # tree when spans are enabled
         self.window = window
         self.slo_ms = float(slo_ms)
         self.access_sink = access_sink
         self.recorder = recorder
+        self.slow_sink = slow_sink
         self._admission = None
         self._ladder = None
         self._modes: list = [None]
@@ -456,13 +520,34 @@ class RequestBatcher:
             telem.inc("serve/shed")
         elif outcome not in ("ok", "deadline_exceeded"):
             telem.inc("serve/errors")
-        if self.access_sink is None:
+        if life.span is not None:
+            life.span.close()  # failed requests: stamp end at emit time
+        breach = False
+        if self.slo_ms > 0:
+            end = (life.t_done if life.t_done is not None
+                   else time.perf_counter())
+            breach = (end - life.t_enq) * 1e3 > self.slo_ms
+            if breach:
+                telem.inc("serve/slow_queries")
+        if self.access_sink is None and self.slow_sink is None:
             return
         level = self._ladder.level if self._ladder is not None else 0
-        try:
-            self.access_sink(life.access_record(outcome, level))
-        except OSError:
-            pass  # a full disk is evidence loss, never a request failure
+        rec = life.access_record(outcome, level)
+        if life.span is not None and (outcome != "ok" or breach):
+            # incident/slow evidence: the full span tree rides the
+            # record — the flight recorder's trigger and the slow-query
+            # log read it; healthy fast requests stay one flat line
+            rec["span"] = life.span.to_dict()
+        if self.access_sink is not None:
+            try:
+                self.access_sink(rec)
+            except OSError:
+                pass  # a full disk is evidence loss, never a request failure
+        if breach and self.slow_sink is not None:
+            try:
+                self.slow_sink(rec)
+            except OSError:
+                pass  # same policy as the access sink
 
     def emit_synthetic_access(self, op: str, *,
                               request_id: Optional[str] = None,
@@ -652,7 +737,8 @@ class RequestBatcher:
     def dispatch_topk(self, misses: Sequence[int], k: int, *,
                       exclude_self: bool, nprobe_ov, keyf,
                       lives: Sequence[_Lifecycle],
-                      deadline_life: Optional[_Lifecycle] = None) -> dict:
+                      deadline_life: Optional[_Lifecycle] = None,
+                      span_parent=None) -> dict:
         """Dispatch ``misses`` through the engine in bucket-padded
         slabs; returns ``{qid: (idx row, dist row)}`` (rows also land
         in the LRU).  The one device dispatch is attributed to EVERY
@@ -662,7 +748,21 @@ class RequestBatcher:
         the before-dispatch deadline check per slab — an expired
         request is never dispatched late; a collated flush checks
         expiry per member at flush time instead, so one member's
-        deadline cannot fail the whole batch."""
+        deadline cannot fail the whole batch.  ``span_parent`` scopes
+        the engine's ``device_compute``/``rescore`` stages under the
+        caller's span (the sync path passes its lifecycle span; the
+        collator passes the shared flush span — contextvars don't
+        cross its executor boundary on their own)."""
+        rows: dict[int, tuple] = {}
+        with spans.use(span_parent):
+            rows.update(self._dispatch_topk_slabs(
+                misses, k, exclude_self=exclude_self, nprobe_ov=nprobe_ov,
+                keyf=keyf, lives=lives, deadline_life=deadline_life))
+        self._update_gauges()
+        return rows
+
+    def _dispatch_topk_slabs(self, misses, k, *, exclude_self, nprobe_ov,
+                             keyf, lives, deadline_life):
         rows: dict[int, tuple] = {}
         for s in range(0, len(misses), self.buckets[-1]):
             if deadline_life is not None:
@@ -694,8 +794,13 @@ class RequestBatcher:
                         f"under-filled for k={k}; retry later"
                     ) from e
                 raise
-            idx = np.asarray(idx)
-            dist = np.asarray(dist)
+            # the "rescore" stage: forcing the dispatched program's
+            # results to host arrays — on the fused lanes the f32
+            # rescore itself runs inside the device_compute program,
+            # so this window is the completion wait + materialization
+            with spans.stage("rescore", metric="serve/stage/rescore_ms"):
+                idx = np.asarray(idx)
+                dist = np.asarray(dist)
             dt = time.perf_counter() - t0
             for life in lives:
                 life.add_dispatch(dt)
@@ -703,7 +808,6 @@ class RequestBatcher:
                 val = (idx[j].copy(), dist[j].copy())
                 rows[qid] = val
                 self.cache.put(keyf(qid), val)
-        self._update_gauges()
         return rows
 
     # --- top-k ----------------------------------------------------------------
@@ -755,7 +859,8 @@ class RequestBatcher:
                 rows.update(self.dispatch_topk(
                     misses, k, exclude_self=exclude_self,
                     nprobe_ov=nprobe_ov, keyf=keyf, lives=(life,),
-                    deadline_life=life))
+                    deadline_life=life, span_parent=life.span))
+                life.result_ready()
                 out_i = np.stack([rows[qid][0] for qid in ids])
                 out_d = np.stack([rows[qid][1] for qid in ids])
                 # a result computed past the deadline is answered
@@ -792,34 +897,37 @@ class RequestBatcher:
     def dispatch_score(self, u: np.ndarray, v: np.ndarray, *,
                        prob: bool, fd_r: float, fd_t: float,
                        lives: Sequence[_Lifecycle],
-                       deadline_life: Optional[_Lifecycle] = None
-                       ) -> np.ndarray:
+                       deadline_life: Optional[_Lifecycle] = None,
+                       span_parent=None) -> np.ndarray:
         """Slab-dispatch validated edge pairs (the score analog of
-        :meth:`dispatch_topk`; same slot-counting and lifecycle-
-        attribution contract)."""
+        :meth:`dispatch_topk`; same slot-counting, lifecycle-
+        attribution, and span-scoping contract)."""
         out = np.empty((u.size,), np.float64)
         top = self.buckets[-1]
-        for s in range(0, u.size, top):
-            if deadline_life is not None:
-                deadline_life.check_deadline("before dispatch")
-            su, sv = u[s : s + top], v[s : s + top]
-            b = bucket_for(su.size, self.buckets)
-            telem.inc("serve/slots", b)
-            telem.inc("serve/padded_waste", b - su.size)
-            for life in lives:
-                life.slab(b)
-            pu = np.concatenate([su, np.full(b - su.size, su[-1])])
-            pv = np.concatenate([sv, np.full(b - sv.size, sv[-1])])
-            if faults.active():
-                faults.hit("serve.dispatch")  # chaos site
-            t0 = time.perf_counter()
-            d = self.engine.score_edges(
-                pu.astype(np.int32), pv.astype(np.int32),
-                prob=prob, fd_r=fd_r, fd_t=fd_t)
-            out[s : s + su.size] = np.asarray(d)[: su.size]
-            dt = time.perf_counter() - t0
-            for life in lives:
-                life.add_dispatch(dt)
+        with spans.use(span_parent):
+            for s in range(0, u.size, top):
+                if deadline_life is not None:
+                    deadline_life.check_deadline("before dispatch")
+                su, sv = u[s : s + top], v[s : s + top]
+                b = bucket_for(su.size, self.buckets)
+                telem.inc("serve/slots", b)
+                telem.inc("serve/padded_waste", b - su.size)
+                for life in lives:
+                    life.slab(b)
+                pu = np.concatenate([su, np.full(b - su.size, su[-1])])
+                pv = np.concatenate([sv, np.full(b - sv.size, sv[-1])])
+                if faults.active():
+                    faults.hit("serve.dispatch")  # chaos site
+                t0 = time.perf_counter()
+                d = self.engine.score_edges(
+                    pu.astype(np.int32), pv.astype(np.int32),
+                    prob=prob, fd_r=fd_r, fd_t=fd_t)
+                with spans.stage("rescore",
+                                 metric="serve/stage/rescore_ms"):
+                    out[s : s + su.size] = np.asarray(d)[: su.size]
+                dt = time.perf_counter() - t0
+                for life in lives:
+                    life.add_dispatch(dt)
         self._update_gauges()
         return out
 
@@ -859,7 +967,9 @@ class RequestBatcher:
                     life.info["requests"] = int(u.size)
                 out = self.dispatch_score(u, v, prob=prob, fd_r=fd_r,
                                           fd_t=fd_t, lives=(life,),
-                                          deadline_life=life)
+                                          deadline_life=life,
+                                          span_parent=life.span)
+                life.result_ready()
                 life.check_deadline("at completion")
                 life.finish()
                 self.emit_access(life)

@@ -42,9 +42,14 @@ semantics, through the collated path.
 Thread-model: every structure here is touched ONLY on the event loop
 (coroutines + ``call_later`` callbacks) — no locks; the batcher's
 admission counter/ladder/LRU carry their own locks and are shared with
-any sync callers.  Trace spans are NOT opened on this path: spans nest
-per-thread, and interleaved coroutines would corrupt the nesting — the
-latency histograms carry the per-request story instead.
+any sync callers.  Legacy ``telemetry/trace.py`` spans are NOT opened
+on this path: they nest per-thread, and interleaved coroutines would
+corrupt the nesting.  The contextvar span layer (``telemetry/spans.py``)
+IS threaded through: each member lifecycle owns a span tree, and a
+flush builds ONE shared ``flush`` span adopted into every member's
+tree (N requests → 1 flush → the same subtree in N trees), carried
+across the executor boundary explicitly with ``spans.use`` — the
+run_in_executor hop does not propagate contextvars on its own.
 """
 
 from __future__ import annotations
@@ -62,6 +67,7 @@ from hyperspace_tpu.serve.errors import (DeadlineExceededError,
                                          OverloadedError, ServeError,
                                          kind_of)
 from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry import spans
 
 # default max-wait before a non-full pending bucket flushes (µs).  Small
 # on purpose: T bounds the latency floor every collated request pays;
@@ -152,13 +158,18 @@ class Collator:
             life.cache_misses = len(misses)
             life.check_deadline("after the cache pass")
             if misses:
+                # collator hand-off stamp: host work done, about to
+                # wait for the flush group — the collate_wait stage
+                life.collated()
                 computed = await self._enqueue(misses, k, exclude_self,
                                                nprobe_ov, keyf, life)
+                life.result_ready()
                 for qid in misses:
                     rows[qid] = computed[qid]
             else:
                 # all-hit: the request never queues; batch-form is now
                 life.formed()
+                life.result_ready()
                 b._update_gauges()
             out_i = np.stack([rows[qid][0] for qid in ids])
             out_d = np.stack([rows[qid][1] for qid in ids])
@@ -215,7 +226,9 @@ class Collator:
                 self._exec,
                 functools.partial(b.dispatch_score, u, v, prob=prob,
                                   fd_r=fd_r, fd_t=fd_t, lives=(life,),
-                                  deadline_life=life))
+                                  deadline_life=life,
+                                  span_parent=life.span))
+            life.result_ready()
             life.check_deadline("at completion")
             life.finish()
             b.emit_access(life)
@@ -299,16 +312,31 @@ class Collator:
         telem.inc("serve/collator_flushes")
         k, exclude_self, nprobe_ov = key
         lives = [m.life for m in alive]
+        # one shared flush span adopted into EVERY member's tree (the
+        # batching boundary: N requests → 1 flush → the same subtree in
+        # N trees); the dispatch thread scopes it via span_parent, so
+        # the engine's device_compute/rescore stages land under it
+        fspan = None
+        if spans.enabled():
+            fspan = spans.Span("flush", meta={
+                "flush_id": flush_id, "members": len(alive),
+                "ids": len(ids)})
+            for m in alive:
+                if m.life.span is not None:
+                    m.life.span.adopt(fspan)
         fut = asyncio.get_running_loop().run_in_executor(
             self._exec,
             functools.partial(self.batcher.dispatch_topk, ids, k,
                               exclude_self=exclude_self,
                               nprobe_ov=nprobe_ov, keyf=g.keyf,
-                              lives=lives))
-        fut.add_done_callback(functools.partial(self._deliver, alive))
+                              lives=lives, span_parent=fspan))
+        fut.add_done_callback(
+            functools.partial(self._deliver, alive, fspan))
 
     @staticmethod
-    def _deliver(members: list, fut) -> None:
+    def _deliver(members: list, fspan, fut) -> None:
+        if fspan is not None:
+            fspan.close()
         exc = None if fut.cancelled() else fut.exception()
         for m in members:
             if m.fut.done():

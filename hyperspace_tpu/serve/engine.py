@@ -140,6 +140,7 @@ from hyperspace_tpu.parallel.sharded_embed import local_gather, table_sharding
 from hyperspace_tpu.serve.artifact import (ServingArtifact, fingerprint_of,
                                            manifold_from_spec)
 from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry import spans
 
 # f32 bytes a distance tile may occupy ([B, chunk] on the kernel path,
 # [B, chunk, D] on the product path), per the nominal batch below.
@@ -1223,39 +1224,53 @@ class QueryEngine:
             raise ValueError(
                 "nprobe override needs a probing engine (this one "
                 "answers by exact scan)")
-        if self._ivf:
-            return self._probe_topk(q_idx, k, exclude_self=exclude_self,
-                                    nprobe=nprobe)
-        if self._policy.mixed or self._quant:
-            # over-fetch margin: the low-precision scan keeps k_scan
-            # candidates so the f32 rescore can repair k-th-boundary
-            # near-ties (wider for int8 — coarser quantization)
-            k_scan = self._k_scan(k, self.num_nodes)
-            if self.shards > 1:
-                return _topk_sharded_mixed(
-                    self.table, self.scan_table, self._scan_aux, q_idx,
-                    spec=self.spec, k=k, k_scan=k_scan,
+        # the "device_compute" span stage: the whole fused program —
+        # scan + f32 rescore + merge run inside ONE jit executable, so
+        # this window is the engine's full device dispatch; inside a
+        # span scope the results are forced before the stage closes, so
+        # the window times execution, not async enqueue (spans off:
+        # a shared no-op context manager, nothing blocks)
+        with spans.stage("device_compute",
+                         metric="serve/stage/device_compute_ms"):
+            if self._ivf:
+                out = self._probe_topk(q_idx, k, exclude_self=exclude_self,
+                                       nprobe=nprobe)
+            elif self._policy.mixed or self._quant:
+                # over-fetch margin: the low-precision scan keeps k_scan
+                # candidates so the f32 rescore can repair k-th-boundary
+                # near-ties (wider for int8 — coarser quantization)
+                k_scan = self._k_scan(k, self.num_nodes)
+                if self.shards > 1:
+                    out = _topk_sharded_mixed(
+                        self.table, self.scan_table, self._scan_aux, q_idx,
+                        spec=self.spec, k=k, k_scan=k_scan,
+                        chunk=self.chunk_rows,
+                        n=self.num_nodes, exclude_self=exclude_self,
+                        mode=self._scan_mode_eff, mesh=self.mesh,
+                        axis=self.mesh_axis, lane=self._lane)
+                else:
+                    out = _topk_chunked_mixed(
+                        self.table, self.scan_table, self._scan_aux, q_idx,
+                        spec=self.spec, k=k,
+                        k_scan=k_scan, chunk=self.chunk_rows,
+                        n=self.num_nodes,
+                        exclude_self=exclude_self, mode=self._scan_mode_eff,
+                        lane=self._lane)
+            elif self.shards > 1:
+                out = _topk_sharded(
+                    self.table, q_idx, spec=self.spec, k=k,
+                    chunk=self.chunk_rows, n=self.num_nodes,
+                    exclude_self=exclude_self, mode=self._scan_mode_eff,
+                    mesh=self.mesh, axis=self.mesh_axis)
+            else:
+                out = _topk_chunked(
+                    self.table, q_idx, spec=self.spec, k=k,
                     chunk=self.chunk_rows,
                     n=self.num_nodes, exclude_self=exclude_self,
-                    mode=self._scan_mode_eff, mesh=self.mesh,
-                    axis=self.mesh_axis, lane=self._lane)
-            return _topk_chunked_mixed(
-                self.table, self.scan_table, self._scan_aux, q_idx,
-                spec=self.spec, k=k,
-                k_scan=k_scan, chunk=self.chunk_rows, n=self.num_nodes,
-                exclude_self=exclude_self, mode=self._scan_mode_eff,
-                lane=self._lane)
-        if self.shards > 1:
-            return _topk_sharded(
-                self.table, q_idx, spec=self.spec, k=k,
-                chunk=self.chunk_rows, n=self.num_nodes,
-                exclude_self=exclude_self, mode=self._scan_mode_eff,
-                mesh=self.mesh, axis=self.mesh_axis)
-        idx, dist = _topk_chunked(
-            self.table, q_idx, spec=self.spec, k=k, chunk=self.chunk_rows,
-            n=self.num_nodes, exclude_self=exclude_self,
-            mode=self._scan_mode_eff)
-        return idx, dist
+                    mode=self._scan_mode_eff)
+            if spans.active():
+                jax.block_until_ready(out)
+        return out
 
     def _probe_topk(self, q_idx: jax.Array, k: int, *, exclude_self: bool,
                     nprobe: int | None = None):
@@ -1322,12 +1337,19 @@ class QueryEngine:
         if u_idx.shape != v_idx.shape:
             raise ValueError(
                 f"u_idx {u_idx.shape} and v_idx {v_idx.shape} must match")
-        if self.shards > 1:
-            return _edge_dist_sharded(self.table, u_idx, v_idx, fd_r, fd_t,
-                                      spec=self.spec, prob=bool(prob),
-                                      mesh=self.mesh, axis=self.mesh_axis)
-        return _edge_dist(self.table, u_idx, v_idx, fd_r, fd_t,
-                          spec=self.spec, prob=bool(prob))
+        with spans.stage("device_compute",
+                         metric="serve/stage/device_compute_ms"):
+            if self.shards > 1:
+                out = _edge_dist_sharded(
+                    self.table, u_idx, v_idx, fd_r, fd_t,
+                    spec=self.spec, prob=bool(prob),
+                    mesh=self.mesh, axis=self.mesh_axis)
+            else:
+                out = _edge_dist(self.table, u_idx, v_idx, fd_r, fd_t,
+                                 spec=self.spec, prob=bool(prob))
+            if spans.active():
+                jax.block_until_ready(out)
+        return out
 
     def _check_ids(self, ids, name: str) -> jax.Array:
         arr = np.asarray(ids)

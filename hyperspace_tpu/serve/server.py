@@ -80,6 +80,7 @@ from hyperspace_tpu.serve.batcher import RequestBatcher
 from hyperspace_tpu.serve.collator import DEFAULT_MAX_WAIT_US, Collator
 from hyperspace_tpu.serve.errors import ServeError, error_response
 from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry import spans
 from hyperspace_tpu.telemetry.exposition import render_prometheus
 
 MAX_BODY_BYTES = 8 << 20  # one request's JSON; far past any bucket
@@ -449,25 +450,30 @@ class HttpFrontDoor:
                 exclude_self = _json_bool(body, "exclude_self", True)
                 deadline_ms = _req_deadline(body)
                 entered = True
-                idx, dist = await self.collator.topk(
-                    body.get("ids"), body.get("k", 10),
-                    exclude_self=exclude_self,
-                    deadline_ms=deadline_ms, t_enq=req.t_in,
-                    request_id=req.request_id)
-                resp = {"neighbors": idx.tolist(),
-                        "dists": dist.tolist()}
+                # the request envelope: the front door's root span scope,
+                # keyed by the X-Request-Id — the collator's lifecycle
+                # span becomes its child (spans off: a no-op)
+                with spans.request(route, req.request_id):
+                    idx, dist = await self.collator.topk(
+                        body.get("ids"), body.get("k", 10),
+                        exclude_self=exclude_self,
+                        deadline_ms=deadline_ms, t_enq=req.t_in,
+                        request_id=req.request_id)
+                    resp = {"neighbors": idx.tolist(),
+                            "dists": dist.tolist()}
             else:
                 prob = _json_bool(body, "prob", False)
                 fd_r = _req_number(body, "fd_r", 2.0)
                 fd_t = _req_number(body, "fd_t", 1.0)
                 deadline_ms = _req_deadline(body)
                 entered = True
-                scores = await self.collator.score(
-                    body.get("u"), body.get("v"), prob=prob,
-                    fd_r=fd_r, fd_t=fd_t,
-                    deadline_ms=deadline_ms, t_enq=req.t_in,
-                    request_id=req.request_id)
-                resp = {"scores": scores.tolist()}
+                with spans.request(route, req.request_id):
+                    scores = await self.collator.score(
+                        body.get("u"), body.get("v"), prob=prob,
+                        fd_r=fd_r, fd_t=fd_t,
+                        deadline_ms=deadline_ms, t_enq=req.t_in,
+                        request_id=req.request_id)
+                    resp = {"scores": scores.tolist()}
         except (ServeError, ValueError, KeyError, TypeError,
                 OverflowError, OSError) as e:
             # the stdin loop's per-line error classes, mapped onto

@@ -1,0 +1,102 @@
+"""Multihost metric aggregation: merge per-process registry exports.
+
+ROADMAP item 1's multi-process mesh will run one registry per process;
+a fleet-level view needs the processes' series REDUCED, not relabeled.
+This module defines that reduction over the raw
+``Registry.export()`` tuple — ``(counters, gauges, hists)`` — so the
+same metrics work unchanged on one process or many:
+
+- **counters sum** (events happened per process; the fleet total is
+  their sum — ``serve/requests``, ``host_table/cache_misses``,
+  ``jax/recompiles``),
+- **gauges max** (levels; max is the conservative fleet reduction —
+  a degraded process's ``serve/degrade_level`` or the worst
+  ``serve/padded_waste_ratio`` must not be averaged away),
+- **histograms merge** element-wise
+  (:meth:`~hyperspace_tpu.telemetry.histogram.HistogramSnapshot.merge`
+  is associative and commutative, so the fleet histogram's quantiles
+  are exact, not quantile-of-quantiles).
+
+**Shape contract** (tested): ``merge_exports([e])`` has exactly the
+series names and kinds of ``e`` — aggregation never invents or drops a
+family, so dashboards built against one process read a fleet scrape
+unchanged (the ISSUE 17 acceptance criterion).
+
+The JSON codec (:func:`encode` / :func:`decode`) round-trips an export
+through bytes for the cross-process hop —
+``parallel/multihost.gather_metric_exports`` allgathers encoded
+exports and decodes per process.  Histogram snapshots serialize as
+their full bucket scheme + counts, reconstructed exactly.
+
+Render a merged export with
+``telemetry.exposition.render_export(*merged, labels=...)`` — the same
+format path a single process's ``/metrics`` scrape takes.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from hyperspace_tpu.telemetry.histogram import HistogramSnapshot
+from hyperspace_tpu.telemetry.registry import Registry, default_registry
+
+
+def export_state(registry: Optional[Registry] = None) -> tuple:
+    """This process's raw ``(counters, gauges, hists)`` export."""
+    reg = default_registry() if registry is None else registry
+    return reg.export()
+
+
+def merge_exports(exports: list) -> tuple:
+    """Reduce per-process export tuples into one fleet export
+    (module docstring: counters sum, gauges max, histograms merge).
+    One export passes through with identical series shapes; an empty
+    list is an empty export."""
+    counters: dict = {}
+    gauges: dict = {}
+    hists: dict = {}
+    for ctrs, gs, hs in exports:
+        for name, v in ctrs.items():
+            counters[name] = counters.get(name, 0) + v
+        for name, v in gs.items():
+            gauges[name] = v if name not in gauges else max(gauges[name], v)
+        for name, snap in hs.items():
+            hists[name] = (snap if name not in hists
+                           else hists[name].merge(snap))
+    return counters, gauges, hists
+
+
+def _encode_hist(snap: HistogramSnapshot) -> dict:
+    return {"counts": list(snap.counts), "count": snap.count,
+            "sum": snap.sum, "vmin": snap.vmin, "vmax": snap.vmax,
+            "lo": snap.lo, "hi": snap.hi, "growth": snap.growth}
+
+
+def _decode_hist(d: dict) -> HistogramSnapshot:
+    return HistogramSnapshot(d["counts"], d["count"], d["sum"],
+                             d["vmin"], d["vmax"],
+                             d["lo"], d["hi"], d["growth"])
+
+
+def encode(export: tuple) -> dict:
+    """One export tuple as a JSON-able dict (the wire form)."""
+    counters, gauges, hists = export
+    return {"counters": dict(counters), "gauges": dict(gauges),
+            "hists": {k: _encode_hist(v) for k, v in hists.items()}}
+
+
+def decode(d: dict) -> tuple:
+    """Inverse of :func:`encode` — exact reconstruction."""
+    return (dict(d["counters"]), dict(d["gauges"]),
+            {k: _decode_hist(v) for k, v in d["hists"].items()})
+
+
+def encode_bytes(export: tuple) -> bytes:
+    """The allgather payload: compact JSON, utf-8."""
+    return json.dumps(encode(export),
+                      separators=(",", ":")).encode("utf-8")
+
+
+def decode_bytes(data: bytes) -> tuple:
+    return decode(json.loads(data.decode("utf-8")))

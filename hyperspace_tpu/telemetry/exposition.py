@@ -156,7 +156,16 @@ def render_prometheus(registry: Optional[Registry] = None,
     check in ``check_metrics_endpoint.py`` depends on stable order
     only for readability — the parser is order-free)."""
     reg = default_registry() if registry is None else registry
-    counters, gauges, hists = reg.export()
+    return render_export(*reg.export(), labels=labels)
+
+
+def render_export(counters: dict, gauges: dict, hists: dict,
+                  labels: Optional[dict] = None) -> str:
+    """Render one raw ``Registry.export()`` tuple as Prometheus text —
+    the registry-free half of :func:`render_prometheus`, so a multihost
+    aggregator can render a MERGED export
+    (``telemetry.aggregate.merge_exports``) through exactly the same
+    format path a single process's scrape takes."""
     base = {"process_index": str(_process_index())}
     if labels:
         base.update({str(k): str(v) for k, v in labels.items()})

@@ -54,6 +54,7 @@ from hyperspace_tpu.models import poincare_embed as pe
 from hyperspace_tpu.parallel.host_table import DeviceHotCache, HostEmbedTable
 from hyperspace_tpu.telemetry import registry as _telem
 from hyperspace_tpu.telemetry.trace import span as _span
+from hyperspace_tpu.train.telemetry import StepPhases
 
 DEFAULT_CHUNK_STEPS = 8
 
@@ -106,7 +107,8 @@ class HostPlannedTrainer:
                  master: HostEmbedTable, aux, key, step=0, *,
                  chunk_steps: int = DEFAULT_CHUNK_STEPS,
                  hot_rows: int = 0, seed: int = 0,
-                 gather_ahead: bool = False, prefetch_depth: int = 2):
+                 gather_ahead: bool = False, prefetch_depth: int = 2,
+                 profile: bool = False, phases: StepPhases = None):
         if master.num_rows != cfg.num_nodes:
             raise ValueError(
                 f"master has {master.num_rows} rows; cfg.num_nodes is "
@@ -122,6 +124,12 @@ class HostPlannedTrainer:
         self.seed = int(seed)
         self.gather_ahead = bool(gather_ahead)
         self.prefetch_depth = int(prefetch_depth)
+        # per-chunk phase timers (train/telemetry.py): data_wait /
+        # host_gather / device_step / write_back histograms; profile=
+        # makes device_step block on the chunk's output (honest
+        # execution window — the CLI's profile_steps= flag)
+        self.phases = phases or StepPhases(profile=profile,
+                                           annotate=profile)
         self.cache = DeviceHotCache(master, self.hot_rows)
         # ONE local config per capacity: the chunk program's static
         # num_nodes is the cache size C (remapped sentinel = C), so
@@ -156,11 +164,12 @@ class HostPlannedTrainer:
     def _run_chunk(self, item) -> np.ndarray:
         plan, chunk_ids, pre_rows = item
         cap = self.cache.capacity
-        if pre_rows is None:
-            slots = self.cache.ensure(chunk_ids)
-        else:
-            slots = self.cache.ensure_with_rows(
-                chunk_ids, pre_rows, np.ones(len(chunk_ids), bool))
+        with self.phases.phase("host_gather"):
+            if pre_rows is None:
+                slots = self.cache.ensure(chunk_ids)
+            else:
+                slots = self.cache.ensure_with_rows(
+                    chunk_ids, pre_rows, np.ones(len(chunk_ids), bool))
         u_idx, v_idx, neg_idx, uniq, inv_map, order, seg = plan
         # remap global rows -> cache slots; the sentinel (num_nodes)
         # becomes the local sentinel C (gather clamps, scatter drops)
@@ -172,15 +181,20 @@ class HostPlannedTrainer:
             u_idx, v_idx, neg_idx, local_uniq, inv_map, order, seg)))
         pstate = pe.PackedState(self.cache.array, self.aux, self.key,
                                 self.step)
-        with _span("host_chunk_dispatch"):
-            out, losses = pe.train_epoch_planned_hosted(
-                self._cfg_local, self.opt, pstate, dev_plan)
+        # device_step: in profile mode the phase blocks on the updated
+        # cache (the chunk's donated output) before closing, so the
+        # window is execution, not enqueue; default mode adds no sync
+        with self.phases.phase("device_step", lambda: out.packed):
+            with _span("host_chunk_dispatch"):
+                out, losses = pe.train_epoch_planned_hosted(
+                    self._cfg_local, self.opt, pstate, dev_plan)
         self.cache.array = out.packed
         self.aux, self.key, self.step = out.aux, out.key, out.step
         # chunk-boundary write-back: the master is current before the
         # next chunk's gather (and before any eviction could drop the
         # only fresh copy)
-        self.master.write_back(chunk_ids, self.cache.fetch(slots))
+        with self.phases.phase("write_back"):
+            self.master.write_back(chunk_ids, self.cache.fetch(slots))
         _telem.inc("host_table/chunks")
         return np.asarray(losses)
 
@@ -199,7 +213,11 @@ class HostPlannedTrainer:
                 lambda i: self._make_chunk(i, sizes[i]),
                 depth=self.prefetch_depth) as pf:
             for _ in sizes:
-                losses.append(self._run_chunk(pf.next()))
+                # data_wait: blocking on the prefetcher — near zero
+                # while the planner keeps ahead of the device
+                with self.phases.phase("data_wait"):
+                    item = pf.next()
+                losses.append(self._run_chunk(item))
         return np.concatenate(losses)
 
     def to_state(self) -> pe.TrainState:

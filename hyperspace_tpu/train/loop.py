@@ -305,6 +305,18 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
     from hyperspace_tpu.telemetry.trace import span, tracing
 
     tracer, reg, fresh_tracer = _telemetry_setup(run)
+    # profile_steps=N: for the first N steps, block on each chunk's
+    # result inside the dispatch window (the phase reads execution, not
+    # async enqueue) and observe it as the device_step phase — the
+    # train-plane mirror of the serve stage histograms; compile events
+    # are armed too, so the profiled window attributes compile time.
+    # N steps only: a permanent block would re-serialize host and
+    # device, the exact overlap the chunked loop exists to buy.
+    profile_steps = int(getattr(run, "profile_steps", 0) or 0)
+    if profile_steps > 0:
+        from hyperspace_tpu.train.telemetry import install_hooks
+
+        install_hooks()
     monitor, health_every = _health_monitor(run, health_fn)
     mwriter = None
     metrics_out = getattr(run, "metrics_out", None)
@@ -388,6 +400,7 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
         every = run.eval_every or 50
         done = start
         chunk_i = 0
+        prof_until = start + profile_steps
         while True:
             while done < run.steps:
                 t_disp = time.perf_counter()
@@ -397,10 +410,18 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
                 # path stays allocation-free)
                 args = ({"step": done, "chunk": steps_per_call}
                         if tracing() else None)
+                prof = profile_steps > 0 and done < prof_until
                 with span("dispatch", args=args):
                     state, loss = stepper(state)
-                telem.observe("train/dispatch_ms",
-                              (time.perf_counter() - t_disp) * 1e3)
+                    if prof:
+                        # profiled window: the dispatch time must read
+                        # execution, not enqueue (block_until_ready is
+                        # not a host fetch — no value crosses the link)
+                        jax.block_until_ready(loss)
+                disp_ms = (time.perf_counter() - t_disp) * 1e3
+                telem.observe("train/dispatch_ms", disp_ms)
+                if prof:
+                    telem.observe("train/phase/device_step_ms", disp_ms)
                 telem.inc("train/dispatches")
                 if mwriter is not None:
                     try:

@@ -1,0 +1,116 @@
+"""Train-plane step-phase telemetry: where a train step's time goes.
+
+The serve plane decomposes a request into stages
+(``telemetry/spans.py``); this module is the train-plane mirror for
+the host-resident chunk protocol (``train/host_embed.py``) and the
+chunked loop (``train/loop.py``).  Each chunk decomposes into the
+:data:`PHASES`:
+
+- ``data_wait`` — blocking on the :class:`~hyperspace_tpu.data.
+  prefetch.HostPrefetcher` for the next chunk's plans (near zero when
+  the prefetcher keeps ahead; the planner is the bottleneck when not),
+- ``host_gather`` — ``DeviceHotCache.ensure``: the host→device
+  transfer of the chunk's cold rows,
+- ``device_step`` — the chunk's one fused dispatch.  Dispatch is async
+  enqueue; in ``profile`` mode the phase blocks on the chunk's output
+  (``jax.block_until_ready``) before closing, so the window times
+  EXECUTION.  Off (the default), it times enqueue only and the wait
+  surfaces in the next write_back/fetch — the production loop never
+  pays an extra sync for telemetry,
+- ``write_back`` — fetching the touched cache rows and scattering them
+  into the host master.
+
+Each phase observes a ``train/phase/<name>_ms`` registry histogram
+(docs/observability.md "Train-plane phases"), so a training job with
+``metrics_out=`` exposes its phase decomposition in the same
+Prometheus families the serve plane does — and the multihost
+aggregation hook (``parallel/multihost.gather_metric_exports``) merges
+them across processes unchanged.
+
+``annotate=True`` additionally wraps each phase in a
+``jax.profiler.TraceAnnotation`` so the phases appear as named ranges
+in a captured device profile; the import is lazy and degrades to a
+no-op where the profiler is unavailable.
+
+Host-table cache effectiveness (hit/miss/evict counters and the
+``host_table/cache_hit_rate`` gauge) ticks inside
+``parallel/host_table.py`` itself; compile events come from
+``telemetry.registry.install_jax_monitoring_hook`` (``jax/recompiles``,
+``jax/compile_s``) — :func:`install_hooks` arms it idempotently.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Optional
+
+from hyperspace_tpu.telemetry import registry as telem
+
+# chunk-phase order: consecutive phases of one chunk never overlap, so
+# their bounds are monotone in this order (tested)
+PHASES = ("data_wait", "host_gather", "device_step", "write_back")
+
+
+def install_hooks() -> None:
+    """Arm the compile-event counters (idempotent): ``jax/recompiles``
+    and ``jax/compile_s`` tick for every fresh XLA compile."""
+    telem.install_jax_monitoring_hook()
+
+
+def _annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation`` for ``name``, or a no-op
+    where the profiler API is unavailable (stripped builds)."""
+    try:
+        from jax.profiler import TraceAnnotation
+    except (ImportError, AttributeError):
+        return contextlib.nullcontext()
+    return TraceAnnotation(name)
+
+
+class StepPhases:
+    """Per-chunk phase timers (module docstring).
+
+    ``profile=True`` makes the ``device_step`` phase block on its
+    output before closing (honest execution window — the bench/debug
+    mode the CLI's ``profile_steps=`` flag arms); ``annotate=True``
+    adds profiler trace annotations.  The last chunk's readings stay
+    on :attr:`last` (ms) and :attr:`last_bounds` (raw perf_counter
+    pairs) for assertions and log records."""
+
+    def __init__(self, profile: bool = False,
+                 annotate: bool = False):
+        self.profile = bool(profile)
+        self.annotate = bool(annotate)
+        self.last: dict[str, float] = {}
+        self.last_bounds: dict[str, tuple] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str, block: Optional[Callable] = None):
+        """Time one phase.  ``block`` is a thunk returning the device
+        value(s) the phase produced — called (and blocked on) only in
+        ``profile`` mode, AFTER the body, so late-bound locals are
+        fine: ``with phases.phase("device_step", lambda: out.packed):``
+        """
+        ann = _annotation(name) if self.annotate else None
+        t0 = time.perf_counter()
+        try:
+            if ann is not None:
+                with ann:
+                    yield
+            else:
+                yield
+            if self.profile and block is not None:
+                import jax
+
+                jax.block_until_ready(block())
+        finally:
+            t1 = time.perf_counter()
+            self.last[name] = (t1 - t0) * 1e3
+            self.last_bounds[name] = (t0, t1)
+            # the phase histogram family (one per PHASES member):
+            # telemetry-catalog: train/phase/data_wait_ms
+            # telemetry-catalog: train/phase/host_gather_ms
+            # telemetry-catalog: train/phase/device_step_ms
+            # telemetry-catalog: train/phase/write_back_ms
+            telem.observe(f"train/phase/{name}_ms", (t1 - t0) * 1e3)

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Summarize a span/access-log JSONL into per-stage latency tables.
+
+Input: a JSONL written by the serve access log (``access_log=``), the
+slow-query log (``slow_log=``), or a flight-recorder incident dump —
+any mix of records is fine; lines without the relevant fields are
+skipped (a trace report must summarize whatever evidence exists, not
+demand a pristine capture).
+
+Two views:
+
+1. **Stage table** — every record's ``stages`` dict (the batcher's
+   boundary decomposition: queue_wait / collate_wait / dispatch /
+   serialize, which sum to ``e2e_ms`` exactly) aggregated into one row
+   per stage: count, mean, p99, and share of total time.  This is the
+   "where does the latency GO" answer over a whole capture.
+
+2. **Span rollup** — every record's ``span`` tree (attached to failed/
+   slow requests and incident dumps when ``trace=1``) walked
+   depth-first into a flamegraph-style indented table: one row per
+   span PATH (``request/dispatch/device_compute``), with count and
+   total/mean self-time — nested stages (device_compute, rescore
+   inside dispatch) show up here even though the boundary table can't
+   carry them.
+
+Usage::
+
+    python scripts/trace_report.py runs/access.jsonl [more.jsonl ...]
+
+Exit codes: 0 with at least one summarizable record, 1 when the input
+held none (a report silently rendered from nothing would read as "no
+latency anywhere").
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def read_records(paths: list) -> list:
+    """Every JSON object line across the inputs; non-JSON lines skip
+    (incident dumps open with a header line — that header is itself
+    JSON and simply carries no stages, so it falls through later)."""
+    records = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(obj, dict):
+                    records.append(obj)
+    return records
+
+
+def _p99(values: list) -> float:
+    vs = sorted(values)
+    return vs[min(len(vs) - 1, int(0.99 * (len(vs) - 1) + 0.999999))]
+
+
+def stage_table(records: list) -> list:
+    """[(stage, count, mean_ms, p99_ms, share), ...] from the records'
+    ``stages`` dicts, in pipeline order (unknown stages append in
+    first-seen order — forward-compatible with new stages)."""
+    order = ["queue_wait", "collate_wait", "dispatch", "serialize"]
+    per: dict = {}
+    for rec in records:
+        st = rec.get("stages")
+        if not isinstance(st, dict):
+            continue
+        for name, ms in st.items():
+            if isinstance(ms, (int, float)) and not isinstance(ms, bool):
+                per.setdefault(name, []).append(float(ms))
+                if name not in order:
+                    order.append(name)
+    total = sum(sum(v) for v in per.values()) or 1.0
+    out = []
+    for name in order:
+        vs = per.get(name)
+        if not vs:
+            continue
+        out.append((name, len(vs), sum(vs) / len(vs), _p99(vs),
+                    sum(vs) / total))
+    return out
+
+
+def _walk(span: dict, prefix: str, acc: dict) -> None:
+    name = span.get("name", "?")
+    path = f"{prefix}/{name}" if prefix else name
+    dur = span.get("dur_ms")
+    kids = span.get("children") or []
+    child_ms = sum(k.get("dur_ms") or 0.0 for k in kids)
+    if isinstance(dur, (int, float)):
+        # self time: the span minus its children — a flamegraph's
+        # "where is the time actually spent" column (floored at 0: a
+        # thread-adopted child can straddle its parent's close)
+        acc.setdefault(path, []).append(max(0.0, float(dur) - child_ms))
+    for k in kids:
+        if isinstance(k, dict):
+            _walk(k, path, acc)
+
+
+def span_rollup(records: list) -> list:
+    """[(path, depth, count, total_self_ms, mean_self_ms), ...] over
+    every ``span`` tree in the records, paths in depth-first order of
+    first appearance."""
+    acc: dict = {}
+    for rec in records:
+        span = rec.get("span") or rec.get("trigger_span")
+        if isinstance(span, dict):
+            _walk(span, "", acc)
+    out = []
+    for path in acc:
+        vs = acc[path]
+        depth = path.count("/")
+        out.append((path, depth, len(vs), sum(vs), sum(vs) / len(vs)))
+    return out
+
+
+def render(records: list) -> str:
+    lines = []
+    stages = stage_table(records)
+    if stages:
+        lines.append(f"stage breakdown over {max(n for _, n, *_ in stages)}"
+                     " record(s):")
+        lines.append(f"  {'stage':<16} {'count':>7} {'mean_ms':>10} "
+                     f"{'p99_ms':>10} {'share':>7}")
+        for name, n, mean, p99, share in stages:
+            lines.append(f"  {name:<16} {n:>7} {mean:>10.3f} "
+                         f"{p99:>10.3f} {share:>6.1%}")
+    rollup = span_rollup(records)
+    if rollup:
+        if lines:
+            lines.append("")
+        lines.append(f"span rollup over "
+                     f"{sum(1 for r in records if r.get('span') or r.get('trigger_span'))}"
+                     " tree(s) (self time):")
+        lines.append(f"  {'span':<40} {'count':>7} {'total_ms':>10} "
+                     f"{'mean_ms':>10}")
+        for path, depth, n, total, mean in rollup:
+            label = "  " * depth + path.rsplit("/", 1)[-1]
+            lines.append(f"  {label:<40} {n:>7} {total:>10.3f} "
+                         f"{mean:>10.3f}")
+    return "\n".join(lines)
+
+
+def main(argv: list) -> int:
+    if not argv:
+        print("usage: trace_report.py ACCESS_OR_SLOW_OR_INCIDENT.jsonl "
+              "[...]", file=sys.stderr)
+        return 1
+    records = read_records(argv)
+    text = render(records)
+    if not text:
+        print("no stage/span records found in "
+              + ", ".join(argv), file=sys.stderr)
+        return 1
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,33 @@
+"""Bad fixture: wall-clock durations in latency-bearing code (linted
+under a pretend hyperspace_tpu/serve/ rel path; never imported)."""
+import time
+from time import time as now
+
+
+def e2e_latency(t_enq):
+    return (time.time() - t_enq) * 1e3  # direct call as left operand
+
+
+def remaining(deadline):
+    return deadline - time.time()  # direct call as right operand
+
+
+def stage():
+    t0 = time.time()  # the taint source — fires at the subtraction
+    do_work()
+    return time.perf_counter() - t0  # tainted name as operand
+
+
+def aliased():
+    start = now()  # from-import alias resolves to time.time
+    do_work()
+    return now() - start
+
+
+def augmented(total):
+    total -= time.time()  # AugAssign subtraction
+    return total
+
+
+def do_work():
+    pass

@@ -1,0 +1,30 @@
+"""Good fixture: monotonic-clock durations and legitimate wall-clock
+TIMESTAMPS — none of these are findings."""
+import time
+
+
+def duration():
+    t0 = time.perf_counter()
+    do_work()
+    return (time.perf_counter() - t0) * 1e3  # the fix
+
+
+def cadence(next_at):
+    return time.monotonic() - next_at  # monotonic math is fine
+
+
+def stamp_record():
+    return {"ts": time.time()}  # a timestamp, never subtracted
+
+
+def expired(deadline_epoch):
+    return time.time() > deadline_epoch  # comparison, not arithmetic
+
+
+def window_start():
+    ts = time.time()  # stored as a stamp; no subtraction uses it
+    return ts + 60.0  # addition (epoch deadline math) is not a duration
+
+
+def do_work():
+    pass

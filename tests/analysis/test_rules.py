@@ -22,6 +22,7 @@ from hyperspace_tpu.analysis.rules.hostsync import HostSyncRule
 from hyperspace_tpu.analysis.rules.hosttable import (
     FullTableMaterializationRule)
 from hyperspace_tpu.analysis.rules.jitcache import JitCacheDefeatRule
+from hyperspace_tpu.analysis.rules.monoclock import MonotonicClockRule
 from hyperspace_tpu.analysis.rules.packing import PackingLiteralRule
 from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
@@ -55,6 +56,8 @@ _PER_FILE = [
     ("bad_packing.py", PackingLiteralRule,
      "hyperspace_tpu/serve/bad_packing.py"),
     ("bad_units.py", MetricUnitSuffixRule, None),
+    ("bad_monoclock.py", MonotonicClockRule,
+     "hyperspace_tpu/serve/bad_monoclock.py"),
 ]
 
 
@@ -232,6 +235,55 @@ def test_retry_sleepless_while_true_is_fine(tmp_path):
     p = tmp_path / "loop.py"
     p.write_text("def f(q):\n    while True:\n        q.get()\n")
     assert lint_file(str(p), rules=[UnboundedRetryRule()]).findings == []
+
+
+# --- monotonic-clock ----------------------------------------------------------
+
+
+def test_monoclock_bad_fixture_fires_every_shape():
+    report = _lint("bad_monoclock.py", MonotonicClockRule,
+                   rel="hyperspace_tpu/serve/bad_monoclock.py")
+    assert report.exit_code() == 1 and len(report.findings) == 5
+    lines = {f.line for f in report.findings}
+    texts = [_fixture_line("bad_monoclock.py", ln) for ln in sorted(lines)]
+    # both operand positions, the tainted-name flow, the from-import
+    # alias, and the AugAssign shape each land on their own line
+    assert any("time.time() - t_enq" in t for t in texts)
+    assert any("deadline - time.time()" in t for t in texts)
+    assert any("time.perf_counter() - t0" in t for t in texts)
+    assert any("now() - start" in t for t in texts)
+    assert any("total -= time.time()" in t for t in texts)
+
+
+def _fixture_line(name, lineno):
+    with open(os.path.join(FIXTURES, name), encoding="utf-8") as f:
+        return f.read().splitlines()[lineno - 1]
+
+
+def test_monoclock_good_fixture_is_clean():
+    report = _lint("good_monoclock.py", MonotonicClockRule,
+                   rel="hyperspace_tpu/telemetry/good_monoclock.py")
+    assert report.findings == []
+
+
+@pytest.mark.parametrize("rel", [
+    "hyperspace_tpu/serve/x.py",
+    "hyperspace_tpu/telemetry/x.py",
+    "hyperspace_tpu/train/x.py",
+])
+def test_monoclock_fires_in_every_latency_plane(rel):
+    report = _lint("bad_monoclock.py", MonotonicClockRule, rel=rel)
+    assert report.findings
+
+
+@pytest.mark.parametrize("rel", [
+    "hyperspace_tpu/parallel/bad_monoclock.py",  # outside latency planes
+    "scripts/bad_monoclock.py",                  # outside the package
+    "bench.py",
+])
+def test_monoclock_out_of_scope_is_clean(rel):
+    report = _lint("bad_monoclock.py", MonotonicClockRule, rel=rel)
+    assert report.findings == []
 
 
 # --- metric-unit-suffix -------------------------------------------------------

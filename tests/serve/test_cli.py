@@ -319,6 +319,44 @@ def test_serve_loop_request_id_echo_and_access_log(trained, tmp_path):
     assert all(r["request_id"] for r in recs)
 
 
+def test_serve_loop_trace_and_slow_log_flags(trained, tmp_path):
+    """trace=1 arms the span layer for the session: access records
+    carry the per-stage decomposition, breaching requests (slo_ms
+    microscopic here) get their span tree attached AND teed to the
+    slow_log= file, and the session bracket disarms the process-global
+    span state on the way out (ISSUE 17 flags)."""
+    from hyperspace_tpu.telemetry import spans
+
+    _cfg, _state, _ckpt, art = trained
+    access = str(tmp_path / "tr_access.jsonl")
+    slow = str(tmp_path / "tr_slow.jsonl")
+    cfg = S.apply_overrides(S.ServeConfig(), {
+        "artifact": art, "access_log": access, "slow_log": slow,
+        "trace": "1", "slo_ms": "0.000001"})
+    lines = json.dumps({"op": "topk", "ids": [0, 1], "k": 2,
+                        "request_id": "tr-1"}) + "\n"
+    out = io.StringIO()
+    S.run_serve(cfg, stdin=io.StringIO(lines), stdout=out)
+    assert not spans.enabled()  # the session's finally disarmed it
+    recs = [json.loads(l) for l in open(access) if l.strip()]
+    rec = {r["request_id"]: r for r in recs}["tr-1"]
+    assert set(rec["stages"]) == {"queue_wait", "collate_wait",
+                                 "dispatch", "serialize"}
+    assert sum(rec["stages"].values()) == pytest.approx(
+        rec["e2e_ms"], abs=0.01)
+    assert rec["span"]["request_id"] == "tr-1"  # breached: tree rides
+    slows = [json.loads(l) for l in open(slow) if l.strip()]
+    assert [r["request_id"] for r in slows] == ["tr-1"]
+    assert "span" in slows[0]
+    # without trace/slow_log no tree rides (the boundary ``stages``
+    # block is stamp arithmetic and stays on every record regardless)
+    cfg_off = S.apply_overrides(S.ServeConfig(),
+                                {"artifact": art, "access_log": access})
+    S.run_serve(cfg_off, stdin=io.StringIO(lines), stdout=io.StringIO())
+    flat = [json.loads(l) for l in open(access) if l.strip()][-1]
+    assert "span" not in flat and "stages" in flat
+
+
 def test_serve_stats_op_carries_window_block(trained):
     """window_s= (the default) surfaces the rolling SLO block in the
     stdin loop's stats response — the /v1/stats parity."""

@@ -1,0 +1,208 @@
+"""Span-level pipeline tracing through the serve plane (ISSUE 17): the
+per-stage decomposition sums to e2e exactly, stage histograms land on
+the registry, spans survive the collator's batching boundary (N
+requests → 1 flush → the shared subtree in N trees), slow requests hit
+the slow-query log with their tree attached, and the flight recorder's
+incident header carries the triggering request's tree."""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.manifolds import PoincareBall
+from hyperspace_tpu.serve.access import FlightRecorder
+from hyperspace_tpu.serve.batcher import RequestBatcher
+from hyperspace_tpu.serve.collator import Collator
+from hyperspace_tpu.serve.engine import QueryEngine
+from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry import spans
+
+STAGE_KEYS = ("queue_wait", "collate_wait", "dispatch", "serialize")
+
+
+@pytest.fixture(autouse=True)
+def _span_state():
+    spans.disable()
+    yield
+    spans.disable()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    rng = np.random.default_rng(0)
+    table = np.asarray(PoincareBall(1.0).expmap0(
+        jnp.asarray(rng.standard_normal((256, 4)) * 0.3, jnp.float32)))
+    eng = QueryEngine(table, ("poincare", 1.0))
+    eng.topk_neighbors(np.zeros(8, np.int32), 4)  # warm the executable
+    return eng
+
+
+def _names(tree: dict) -> list:
+    return [c["name"] for c in tree.get("children", ())]
+
+
+def test_sync_topk_decomposes_and_fills_stage_histograms(engine):
+    spans.enable()
+    records = []
+    bat = RequestBatcher(engine, min_bucket=8, max_bucket=64,
+                         cache_size=0, access_sink=records.append)
+    reg = telem.default_registry()
+    base = reg.mark()
+    bat.topk([1, 2, 3], 4, request_id="req-sync")
+    (rec,) = records
+    # the boundary decomposition sums to e2e EXACTLY (stages are
+    # differences of consecutive stamps; only rounding separates them)
+    assert set(rec["stages"]) == set(STAGE_KEYS)
+    assert rec["stages"]["collate_wait"] == 0.0  # sync path never waits
+    assert sum(rec["stages"].values()) == pytest.approx(
+        rec["e2e_ms"], abs=0.01)
+    # every stage feeds its per-stage histogram, plus the engine's
+    # device_compute and the result-forcing rescore window
+    snap = reg.snapshot(baseline=base)
+    for name in ("queue_wait", "collate_wait", "dispatch", "serialize",
+                 "device_compute", "rescore"):
+        h = snap.get(f"hist/serve/stage/{name}_ms")
+        assert h and h["count"] == 1, f"missing stage histogram {name}"
+
+
+def test_disabled_spans_cost_no_stage_histograms(engine):
+    bat = RequestBatcher(engine, min_bucket=8, max_bucket=64, cache_size=0)
+    reg = telem.default_registry()
+    base = reg.mark()
+    bat.topk([1, 2], 4)
+    snap = reg.snapshot(baseline=base)
+    assert not any(k.startswith("hist/serve/stage/") for k in snap)
+    assert snap.get("hist/serve/e2e_ms")  # the flat latency still lands
+
+
+def test_spans_survive_the_batching_boundary(engine):
+    """8 concurrent single-id requests exactly fill the 8-rung: ONE
+    flush serves all — and every request's span tree holds the SAME
+    shared flush subtree, with device_compute/rescore under it."""
+    spans.enable()
+    records = []
+    # slo_ms microscopically low: every record breaches, so the span
+    # tree rides every access record (the slow-evidence path)
+    bat = RequestBatcher(engine, min_bucket=8, max_bucket=64,
+                         cache_size=0, access_sink=records.append,
+                         slo_ms=1e-6)
+    col = Collator(bat, max_wait_us=30_000_000)  # flush on fill only
+
+    async def run():
+        return await asyncio.gather(
+            *[col.topk([i], 4, request_id=f"req-{i}") for i in range(8)])
+
+    asyncio.run(run())
+    col.close()
+    assert len(records) == 8
+    flush_metas = []
+    for rec in records:
+        tree = rec["span"]
+        assert tree["request_id"] == rec["request_id"]
+        # boundary children + the adopted flush subtree
+        kids = _names(tree)
+        for k in STAGE_KEYS:
+            assert k in kids
+        (flush,) = [c for c in tree["children"] if c["name"] == "flush"]
+        assert flush["meta"]["members"] == 8
+        flush_metas.append(flush["meta"]["flush_id"])
+        inner = [c["name"] for c in flush["children"]]
+        assert "device_compute" in inner and "rescore" in inner
+        # collated requests actually waited for their flush group
+        assert rec["stages"]["collate_wait"] >= 0.0
+        assert sum(rec["stages"].values()) == pytest.approx(
+            rec["e2e_ms"], abs=0.01)
+    # one flush, shared: every tree names the same flush id
+    assert len(set(flush_metas)) == 1
+
+
+def test_concurrent_collated_trees_do_not_cross_contaminate(engine):
+    """Two flush groups (different k → different pending buckets):
+    every request's tree references ITS flush only."""
+    spans.enable()
+    records = []
+    bat = RequestBatcher(engine, min_bucket=8, max_bucket=64,
+                         cache_size=0, access_sink=records.append,
+                         slo_ms=1e-6)
+    col = Collator(bat, max_wait_us=50_000)
+
+    async def run():
+        return await asyncio.gather(
+            *[col.topk([i], 4, request_id=f"a{i}") for i in range(4)],
+            *[col.topk([i], 6, request_id=f"b{i}") for i in range(4)])
+
+    asyncio.run(run())
+    col.close()
+    by_id = {r["request_id"]: r for r in records}
+    assert len(by_id) == 8
+    flush_of = {}
+    for rid, rec in by_id.items():
+        (flush,) = [c for c in rec["span"]["children"]
+                    if c["name"] == "flush"]
+        flush_of[rid] = flush["meta"]["flush_id"]
+        assert rec["flush_id"] == flush["meta"]["flush_id"]
+    # k=4 members share one flush, k=6 members another — never mixed
+    assert len({flush_of[f"a{i}"] for i in range(4)}) == 1
+    assert len({flush_of[f"b{i}"] for i in range(4)}) == 1
+    assert flush_of["a0"] != flush_of["b0"]
+
+
+def test_slow_query_log_gets_breaching_records_with_trees(engine):
+    spans.enable()
+    slow = []
+    bat = RequestBatcher(engine, min_bucket=8, max_bucket=64,
+                         cache_size=0, slow_sink=slow.append,
+                         slo_ms=1e-6)
+    reg = telem.default_registry()
+    base = reg.mark()
+    bat.topk([1], 4, request_id="slow-1")
+    (rec,) = slow  # breached (slo is microscopic) → slow log, tree on
+    assert rec["request_id"] == "slow-1" and "span" in rec
+    assert reg.snapshot(baseline=base).get("serve/slow_queries") == 1
+
+
+def test_fast_requests_skip_the_slow_log(engine):
+    spans.enable()
+    slow = []
+    records = []
+    bat = RequestBatcher(engine, min_bucket=8, max_bucket=64,
+                         cache_size=0, access_sink=records.append,
+                         slow_sink=slow.append, slo_ms=60_000.0)
+    bat.topk([1], 4)
+    assert slow == []  # a minute of budget: nothing breaches
+    (rec,) = records
+    assert "span" in rec or rec["outcome"] == "ok"  # ok+fast: flat line
+    assert "span" not in rec
+
+
+def test_incident_header_carries_trigger_span(engine, tmp_path):
+    """An error burst's incident dump names the triggering request AND
+    its span tree — the ISSUE 17 flight-recorder satellite."""
+    import json
+
+    spans.enable()
+    rec_dir = str(tmp_path / "incidents")
+    recorder = FlightRecorder(rec_dir, burst_n=3, burst_s=60.0)
+    sink_records = []
+
+    def sink(rec):
+        sink_records.append(rec)
+        recorder.record(rec)
+
+    bat = RequestBatcher(engine, min_bucket=8, max_bucket=64,
+                         cache_size=0, access_sink=sink,
+                         recorder=recorder)
+    for i in range(3):  # three validation errors inside the window
+        with pytest.raises(ValueError):
+            bat.topk([10_000_000 + i], 4, request_id=f"boom-{i}")
+    recorder.join(5.0)
+    assert recorder.dumps, "an error burst must dump an incident"
+    with open(recorder.dumps[0], encoding="utf-8") as f:
+        header = json.loads(f.readline())
+    assert header["event"] == "incident"
+    assert header["trigger_request_id"] == "boom-2"
+    tree = header["trigger_span"]
+    assert tree["request_id"] == "boom-2"
+    assert tree["name"] == "topk"

@@ -1,0 +1,61 @@
+"""scripts/trace_report.py smoke: the per-stage table and span rollup
+render from a RECORDED access-log fixture (captured from the real
+collated pipeline with spans + a microscopic SLO, so every record
+carries both ``stages`` and a ``span`` tree), and the edge contracts
+(empty input, garbage lines) hold."""
+
+import importlib.util
+import os
+
+import pytest
+
+
+def _load():
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, "scripts", "trace_report.py")
+    spec = importlib.util.spec_from_file_location("trace_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "trace_access.jsonl")
+
+
+def test_report_renders_stage_table_and_rollup(capsys):
+    mod = _load()
+    rc = mod.main([FIXTURE])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "stage breakdown" in out and "span rollup" in out
+    # the four boundary stages in pipeline order, then the span paths
+    pos = [out.index(s) for s in
+           ("queue_wait", "collate_wait", "dispatch", "serialize")]
+    assert pos == sorted(pos)
+    assert "device_compute" in out and "flush" in out
+
+
+def test_stage_table_aggregates_correctly():
+    mod = _load()
+    records = mod.read_records([FIXTURE])
+    assert len(records) == 9  # 8 collated + 1 sync, as recorded
+    table = {row[0]: row for row in mod.stage_table(records)}
+    for name in ("queue_wait", "collate_wait", "dispatch", "serialize"):
+        _, n, mean, p99, share = table[name]
+        assert n == 9 and mean >= 0 and p99 >= mean >= 0
+    assert sum(row[4] for row in table.values()) == pytest.approx(1.0)
+    # the rollup walks nested stages the boundary table can't carry
+    paths = {p for p, *_ in mod.span_rollup(records)}
+    assert "topk/flush/device_compute" in paths
+    assert "topk/flush/rescore" in paths
+
+
+def test_empty_and_garbage_inputs(tmp_path, capsys):
+    mod = _load()
+    p = tmp_path / "junk.jsonl"
+    p.write_text("not json\n{\"event\": \"incident\"}\n\n")
+    assert mod.main([str(p)]) == 1  # nothing summarizable: loud exit
+    err = capsys.readouterr().err
+    assert "no stage/span records" in err

@@ -1,0 +1,154 @@
+"""Span layer contracts (telemetry/spans.py): the disabled path is a
+shared no-op, trees nest and serialize, concurrent asyncio tasks never
+cross-contaminate, and ``use`` carries a span across a thread hop —
+the exact propagation surfaces the serve pipeline leans on."""
+
+import asyncio
+import concurrent.futures
+import time
+
+import pytest
+
+from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry import spans
+
+
+@pytest.fixture(autouse=True)
+def _span_state():
+    """Span enablement is process-global: every test starts and leaves
+    disabled, whatever it does in between."""
+    spans.disable()
+    yield
+    spans.disable()
+
+
+def test_disabled_is_a_shared_noop():
+    assert spans.root("x") is None
+    assert not spans.active()
+    # the stage fast path returns ONE shared null context (zero
+    # allocation on the serving hot path) and records nothing
+    a, b = spans.stage("s"), spans.stage("t", metric="serve/e2e_ms")
+    assert a is b is spans._NULL
+    with spans.request("query") as env:
+        assert env is None
+        assert spans.current() is None
+
+
+def test_tree_nests_and_serializes():
+    spans.enable()
+    with spans.request("query", request_id="r1") as env:
+        assert spans.current() is env
+        with spans.stage("outer") as outer:
+            assert spans.current() is outer  # stages re-scope
+            with spans.stage("inner", meta={"k": 4}):
+                time.sleep(0.001)
+        assert spans.current() is env  # scope restored
+    d = env.to_dict()
+    assert d["name"] == "query" and d["request_id"] == "r1"
+    (o,) = d["children"]
+    assert o["name"] == "outer"
+    (i,) = o["children"]
+    assert i["name"] == "inner" and i["meta"] == {"k": 4}
+    # offsets are relative to the TREE root and nested stages sit
+    # inside their parents' extent
+    assert 0 <= o["t_off_ms"] <= i["t_off_ms"]
+    assert i["dur_ms"] >= 1.0  # the sleep is in there
+    assert o["dur_ms"] >= i["dur_ms"]
+    assert d["dur_ms"] >= o["dur_ms"]
+
+
+def test_stage_observes_metric_histogram():
+    spans.enable()
+    reg = telem.default_registry()
+    base = reg.mark()
+    with spans.request("query"):
+        with spans.stage("dev", metric="serve/stage/device_compute_ms"):
+            time.sleep(0.001)
+    h = reg.snapshot(baseline=base).get("hist/serve/stage/device_compute_ms")
+    assert h and h["count"] == 1 and h["p50"] >= 1.0
+
+
+def test_stage_outside_any_scope_is_noop():
+    spans.enable()
+    reg = telem.default_registry()
+    base = reg.mark()
+    with spans.stage("dev", metric="serve/stage/device_compute_ms"):
+        pass  # no current span (prewarm / direct engine call): no-op
+    snap = reg.snapshot(baseline=base)
+    assert "hist/serve/stage/device_compute_ms" not in snap
+
+
+def test_concurrent_tasks_never_cross_contaminate():
+    """N interleaved coroutines on ONE event loop, each opening its own
+    request envelope and stages with forced interleaving points: every
+    tree must hold exactly its own stages (the contextvar contract the
+    per-thread tracer cannot give)."""
+    spans.enable()
+
+    async def one(i):
+        with spans.request("query", request_id=f"r{i}") as env:
+            await asyncio.sleep(0.001 * (i % 3))  # interleave
+            with spans.stage(f"stage_a_{i}"):
+                await asyncio.sleep(0.001)
+                assert spans.current().name == f"stage_a_{i}"
+            with spans.stage(f"stage_b_{i}"):
+                await asyncio.sleep(0.001 * ((i + 1) % 3))
+        return env
+
+    async def run():
+        return await asyncio.gather(*[one(i) for i in range(16)])
+
+    envs = asyncio.run(run())
+    for i, env in enumerate(envs):
+        assert env.request_id == f"r{i}"
+        assert [c.name for c in env.children] == [
+            f"stage_a_{i}", f"stage_b_{i}"]
+
+
+def test_use_carries_span_across_thread_hop():
+    """run_in_executor does NOT propagate contextvars — ``use`` is the
+    explicit hand-off: a stage opened inside the worker thread lands in
+    the handed span, and the submitting task's own scope is intact."""
+    spans.enable()
+    flush = spans.Span("flush")
+
+    def worker():
+        assert spans.current() is None  # fresh thread: no inherited scope
+        with spans.use(flush):
+            with spans.stage("device_compute"):
+                time.sleep(0.001)
+        assert spans.current() is None
+
+    with spans.request("query") as env:
+        with concurrent.futures.ThreadPoolExecutor(1) as pool:
+            pool.submit(worker).result()
+        assert spans.current() is env  # the hop never touched this task
+    flush.close()
+    assert [c.name for c in flush.children] == ["device_compute"]
+
+
+def test_adopt_shares_one_child_across_parents():
+    """The batching boundary: one flush span adopted into N parents —
+    every tree serializes the SAME shared subtree."""
+    spans.enable()
+    parents = [spans.Span("query", request_id=f"r{i}") for i in range(3)]
+    flush = spans.Span("flush", meta={"members": 3})
+    for p in parents:
+        p.adopt(flush)
+    flush.add("device_compute", flush.t0, flush.t0 + 0.002)
+    flush.close()
+    for p in parents:
+        p.close()
+        (f,) = p.to_dict()["children"]
+        assert f["name"] == "flush" and f["meta"] == {"members": 3}
+        assert [c["name"] for c in f["children"]] == ["device_compute"]
+
+
+def test_unclosed_span_serializes_with_null_duration():
+    spans.enable()
+    s = spans.Span("query")
+    assert s.to_dict()["dur_ms"] is None  # evidence, not a crash
+    s.close()
+    t1 = s.t1
+    s.close()
+    assert s.t1 == t1  # idempotent: first close wins
