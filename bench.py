@@ -1018,6 +1018,332 @@ def bench_serve_http(repeats: int = 2, *, qps: float = 120.0,
             "unit": "ms", "vs_baseline": None, "detail": detail}
 
 
+def bench_live_index(repeats: int = 1, *, qps: float = 80.0,
+                     duration_s: float = 3.0,
+                     table_rows: int = 6_000) -> dict:
+    """Live mutable index under sustained load (docs/serving.md "Live
+    index and rollover", ISSUE 18).
+
+    One in-process HTTP front door over a :class:`LiveQueryEngine`
+    (serve/delta.py) with the rollover coordinator armed
+    (serve/rollover.py), driven through three phases:
+
+    - **freshness**: serialized insert → query-by-the-new-id probes
+      (each inserted vector is a near-duplicate of a known anchor row,
+      so the probe's top-1 is checkable), then deletes with
+      must-not-return probes, then one explicit compaction —
+      ``upsert_visible_ms`` is the enqueue→applied histogram the
+      batcher's mutation envelope observes (PR 15 machinery);
+    - **steady + rollover**: an open-loop query stream at fixed offered
+      qps CONCURRENT with a continuous upsert stream and sequential
+      staleness probes (upsert a near-duplicate, immediately query it
+      through the result cache — the generation-folded scan signature
+      must make the pre-mutation cache rows unreachable), with a full
+      blue-green rollover fired mid-stream; ``p99_during_rollover_ms``
+      is the e2e delta over the rollover span, and the steady-state
+      recompile counters are split pre-roll / rollover / post-flip
+      (the contract: 0 outside the rollover's own standby build);
+    - **oracle**: final live answers vs a frozen engine rebuilt from
+      scratch over the final master table (deleted ids host-filtered
+      from an overfetched oracle top-k) — ``recall_vs_oracle``.
+
+    Value = the aggregate e2e p99 (ms) over the concurrent phase.  The
+    contract columns are ``errors`` / ``stale_results`` /
+    ``recompiles_steady`` — all must be 0 (``live_ok``).
+    """
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hyperspace_tpu.manifolds import PoincareBall
+    from hyperspace_tpu.parallel.host_table import HostEmbedTable
+    from hyperspace_tpu.serve.batcher import RequestBatcher
+    from hyperspace_tpu.serve.delta import LiveQueryEngine
+    from hyperspace_tpu.serve.engine import QueryEngine
+    from hyperspace_tpu.serve.rollover import RolloverCoordinator
+    from hyperspace_tpu.serve.server import HttpFrontDoor
+    from hyperspace_tpu.telemetry import registry as telem
+
+    telem.install_jax_monitoring_hook()
+    rng = np.random.default_rng(7)
+    n, dim, k, cap = table_rows, 16, 10, 512
+    spec = ("poincare", 1.0)
+    base_arr = np.asarray(PoincareBall(1.0).expmap0(
+        jnp.asarray(rng.standard_normal((n, dim)) * 0.3, jnp.float32)))
+
+    def _make_batcher(arr):
+        live = LiveQueryEngine(
+            QueryEngine(np.array(arr), spec),
+            HostEmbedTable.from_array(np.array(arr)),
+            capacity=cap, auto_compact=False)
+        # cache ON on purpose: the staleness probes below are only a
+        # proof if a stale cache row COULD have answered them
+        return live, RequestBatcher(live, min_bucket=8, max_bucket=64,
+                                    cache_size=4096, queue_max=256)
+
+    live, bat = _make_batcher(base_arr)
+    reg = telem.default_registry()
+    deleted_ids: set = set()
+    # disjoint id pools so concurrent writers never collide: the random
+    # update stream, the probe ids (rewritten to near-duplicates of...)
+    # and the probe TARGET anchors (...which must stay untouched)
+    update_pool = rng.permutation(n)[:128].tolist()
+    probe_pool = [int(i) for i in range(n) if i not in set(update_pool)]
+    probe_ids, anchor_ids = probe_pool[:200], probe_pool[200:400]
+
+    async def _http(host, port, method, path, payload=None):
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            body = (b"" if payload is None
+                    else json.dumps(payload).encode("utf-8"))
+            writer.write(
+                (f"{method} {path} HTTP/1.1\r\nHost: bench\r\n"
+                 f"Content-Length: {len(body)}\r\n"
+                 "Connection: close\r\n\r\n").encode("latin-1") + body)
+            await writer.drain()
+            data = await reader.read()
+        finally:
+            writer.close()
+        head, _, rbody = data.partition(b"\r\n\r\n")
+        try:
+            parsed = json.loads(rbody.decode("utf-8"))
+        except ValueError:
+            parsed = None
+        return int(head.split(None, 2)[1]), parsed
+
+    def _percentiles(delta, name="hist/serve/e2e_ms"):
+        h = delta.get(name)
+        if not h:
+            return None
+        return {"n": h["count"], **{q: h[q] for q in ("p50", "p95", "p99")}}
+
+    async def _run():
+        detail = {
+            "num_nodes": n, "dim": dim, "k": k, "delta_cap": cap,
+            "offered_qps": qps, "duration_s": duration_s,
+            "backend": jax.default_backend(),
+        }
+        door = HttpFrontDoor(bat, max_wait_us=2000)
+
+        def standby_builder(target):
+            # in-process blue-green: the standby is rebuilt from the
+            # CURRENT live master (write-through makes it the truth) and
+            # the known tombstones are re-applied before the flip gate
+            cur = door.batcher.engine
+            live2, bat2 = _make_batcher(cur.master.to_array())
+            if deleted_ids:
+                live2.delete(sorted(deleted_ids))
+            return bat2
+
+        door.rollover = RolloverCoordinator(door, standby_builder,
+                                            prewarm_ks=(k,))
+        await door.start()
+        host, port = door.host, door.port
+        c0 = reg.get("jax/recompiles")
+        # warm the whole ladder through the LIVE path (base scan with
+        # the traced drop mask + the delta-segment scan per bucket)
+        for b in bat.buckets:
+            await _http(host, port, "POST", "/v1/topk",
+                        {"ids": rng.integers(0, n, size=b).tolist(),
+                         "k": k})
+        detail["recompiles_warmup"] = reg.get("jax/recompiles") - c0
+
+        stale = errors = 0
+        next_id = n
+
+        # --- phase 1: freshness (serialized insert/delete probes) -----
+        ins_n, del_m = 8 * max(1, repeats), 4 * max(1, repeats)
+        fresh_base = reg.mark()
+        inserted = []
+        for i in range(ins_n):
+            anchor = int(anchor_ids[-(i + 1)])
+            vec = base_arr[anchor] + rng.normal(0, 1e-4, dim)
+            s, _r = await _http(host, port, "POST", "/v1/upsert",
+                                {"ids": [next_id],
+                                 "rows": [vec.tolist()]})
+            errors += s != 200
+            s, r = await _http(host, port, "POST", "/v1/topk",
+                               {"ids": [next_id], "k": k})
+            if s != 200:
+                errors += 1
+            elif r["neighbors"][0][0] != anchor:
+                stale += 1  # the new row's nearest MUST be its anchor
+            inserted.append(next_id)
+            next_id += 1
+        for di, gone in enumerate(inserted[:del_m]):
+            s, _r = await _http(host, port, "POST", "/v1/delete",
+                                {"ids": [gone]})
+            errors += s != 200
+            # query the tombstone's OWN anchor: the near-duplicate
+            # would be its top-1 if any stale row could still answer
+            s, r = await _http(host, port, "POST", "/v1/topk",
+                               {"ids": [int(anchor_ids[-(di + 1)])],
+                                "k": k})
+            if s != 200:
+                errors += 1
+            elif gone in r["neighbors"][0]:
+                stale += 1
+            deleted_ids.add(gone)
+        detail["freshness"] = {
+            "inserted": ins_n, "deleted": del_m,
+            "upsert_visible_ms": _percentiles(
+                reg.snapshot(baseline=fresh_base),
+                "hist/serve/upsert_visible_ms"),
+        }
+        # one explicit compaction (auto_compact stays off so the timed
+        # phase below cannot hide a compile in a background thread);
+        # the re-clustered base is a NEW table shape — re-warm it and
+        # book those compiles to the compaction, not to steady state
+        c_pre = reg.get("jax/recompiles")
+        detail["compaction"] = live.compact()
+        for b in bat.buckets:
+            await _http(host, port, "POST", "/v1/topk",
+                        {"ids": rng.integers(0, n, size=b).tolist(),
+                         "k": k})
+        detail["recompiles_compaction"] = reg.get("jax/recompiles") - c_pre
+
+        # --- phase 2: steady load + mid-stream blue-green rollover ----
+        h0 = (await _http(host, port, "GET", "/healthz"))[1]
+        stop = asyncio.Event()
+        pause = asyncio.Event()
+        probe_lock = asyncio.Lock()
+        statuses: dict = {}
+
+        async def query_stream():
+            n_req = max(16, int(qps * duration_s))
+            offsets = open_loop_arrivals(n_req, qps, "poisson", 3)
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            tasks = []
+            for off in offsets:
+                delay = t0 + float(off) - loop.time()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                ids = rng.integers(0, n, size=4).tolist()
+                tasks.append(asyncio.ensure_future(
+                    _http(host, port, "POST", "/v1/topk",
+                          {"ids": ids, "k": k})))
+            for s, _r in await asyncio.gather(*tasks):
+                statuses[str(s)] = statuses.get(str(s), 0) + 1
+
+        async def update_stream():
+            i = 0
+            while not stop.is_set():
+                uid = int(update_pool[i % len(update_pool)])
+                # pure-numpy ball point: the steady phase must not run
+                # ANY fresh jax op (its tiny one-time compiles would
+                # read as steady-state recompile pollution)
+                g = rng.standard_normal(dim) * 0.3
+                vec = g / (1.0 + float(np.linalg.norm(g)))
+                s, _r = await _http(host, port, "POST", "/v1/upsert",
+                                    {"ids": [uid],
+                                     "rows": [vec.tolist()]})
+                statuses[str(s)] = statuses.get(str(s), 0) + 1
+                i += 1
+                await asyncio.sleep(1.0 / max(qps / 5.0, 1.0))
+
+        probe_stats = {"probes": 0}
+
+        async def probe_stream():
+            nonlocal stale, errors
+            i = 0
+            while not stop.is_set():
+                if pause.is_set():
+                    await asyncio.sleep(0.05)
+                    continue
+                async with probe_lock:
+                    p = int(probe_ids[i % len(probe_ids)])
+                    q = int(anchor_ids[i % (len(anchor_ids) - ins_n)])
+                    vec = base_arr[q] + rng.normal(0, 1e-4, dim)
+                    s1, _r = await _http(host, port, "POST", "/v1/upsert",
+                                         {"ids": [p],
+                                          "rows": [vec.tolist()]})
+                    s2, r = await _http(host, port, "POST", "/v1/topk",
+                                        {"ids": [p], "k": k})
+                    if s1 != 200 or s2 != 200:
+                        errors += 1
+                    elif r["neighbors"][0][0] != q:
+                        stale += 1  # a cached pre-mutation row answered
+                    probe_stats["probes"] += 1
+                i += 1
+                await asyncio.sleep(0.1)
+
+        steady_base = reg.mark()
+        c_steady0 = reg.get("jax/recompiles")
+        qtask = asyncio.ensure_future(query_stream())
+        utask = asyncio.ensure_future(update_stream())
+        ptask = asyncio.ensure_future(probe_stream())
+        await asyncio.sleep(duration_s * 0.35)
+        # quiesce the probes (an upsert→verify pair must not straddle
+        # the flip: its write would land on the outgoing engine), then
+        # roll over mid-stream with queries + updates still flowing
+        async with probe_lock:
+            pause.set()
+        c_roll0 = reg.get("jax/recompiles")
+        roll_base = reg.mark()
+        t_roll = time.perf_counter()
+        s, flip = await _http(host, port, "POST", "/admin/rollover",
+                              {"target": "inproc-standby"})
+        roll_s = time.perf_counter() - t_roll
+        errors += s != 200
+        detail["p99_during_rollover_ms"] = (_percentiles(
+            reg.snapshot(baseline=roll_base)) or {}).get("p99")
+        c_flip = reg.get("jax/recompiles")
+        pause.clear()
+        await qtask
+        stop.set()
+        await asyncio.gather(utask, ptask)
+        h1 = (await _http(host, port, "GET", "/healthz"))[1]
+        agg = _percentiles(reg.snapshot(baseline=steady_base))
+        if agg is None:
+            await door.drain()
+            raise RuntimeError(
+                f"live_index: no successful timed request — {statuses}")
+        detail["aggregate_ms"] = agg
+        detail["live_p99_ms"] = agg["p99"]
+        detail["achieved_qps"] = round(agg["n"] / duration_s, 1)
+        detail["statuses"] = statuses
+        detail["staleness_probes"] = probe_stats["probes"]
+        errors += sum(v for key, v in statuses.items() if key != "200")
+        detail["rollover"] = {
+            "flip": flip, "seconds": round(roll_s, 3),
+            "fingerprint_changed": h0["fingerprint"] != h1["fingerprint"],
+        }
+        detail["recompiles_preroll"] = c_roll0 - c_steady0
+        detail["recompiles_rollover"] = c_flip - c_roll0
+        detail["recompiles_steady"] = (reg.get("jax/recompiles") - c_flip
+                                       + detail["recompiles_preroll"])
+        await door.drain()
+
+        # --- phase 3: recall vs a rebuilt-from-scratch frozen oracle --
+        cur = door.batcher.engine
+        arr = cur.master.to_array()
+        oracle = QueryEngine(np.array(arr), spec)
+        probe = rng.permutation(n)[:48].astype(np.int64)
+        li, _ld = cur.topk_neighbors(probe, k)
+        oi, _od = oracle.topk_neighbors(
+            probe, k + len(deleted_ids), exclude_self=True)
+        oi = np.asarray(oi)
+        hits = 0
+        for row in range(probe.size):
+            want = [j for j in oi[row].tolist()
+                    if j not in deleted_ids][:k]
+            hits += len(set(np.asarray(li)[row].tolist()) & set(want))
+        detail["recall_vs_oracle"] = round(hits / (probe.size * k), 4)
+        detail["errors"] = errors
+        detail["stale_results"] = stale
+        detail["live_ok"] = (errors == 0 and stale == 0
+                             and detail["recompiles_steady"] == 0
+                             and detail["recall_vs_oracle"] >= 0.99)
+        return detail
+
+    detail = asyncio.run(_run())
+    return {"metric": "live_index_p99_ms", "value": detail["live_p99_ms"],
+            "unit": "ms", "vs_baseline": None, "detail": detail}
+
+
 def bench_resilience(repeats: int = 1) -> dict:
     """Chaos recovery + overload shedding (docs/resilience.md).
 
@@ -1481,6 +1807,26 @@ _COMPACT_FIELDS = (
     ("http_p99_ms", ("detail", "http_p99_ms")),
     ("http_shed_rate", ("detail", "serve_http", "shed_rate")),
     ("http_shed_rate", ("detail", "shed_rate")),
+    # live mutable index leg (r18): steady p99 under a concurrent
+    # upsert stream, p99 across the blue-green flip, upsert-to-visible
+    # latency and the three zero-contract columns (errors, stale
+    # results, post-prewarm recompiles roll up into live_ok).  First
+    # path is auto mode's nested leg, second fires when
+    # bench_live_index IS the headline (--metric live_index).
+    ("live_p99_ms", ("detail", "live_index", "live_p99_ms")),
+    ("live_p99_ms", ("detail", "live_p99_ms")),
+    ("p99_during_rollover_ms",
+     ("detail", "live_index", "p99_during_rollover_ms")),
+    ("p99_during_rollover_ms", ("detail", "p99_during_rollover_ms")),
+    ("upsert_visible_ms",
+     ("detail", "live_index", "freshness", "upsert_visible_ms", "p99")),
+    ("upsert_visible_ms",
+     ("detail", "freshness", "upsert_visible_ms", "p99")),
+    ("live_ok", ("detail", "live_index", "live_ok")),
+    ("live_ok", ("detail", "live_ok")),
+    ("live_recall_vs_oracle",
+     ("detail", "live_index", "recall_vs_oracle")),
+    ("live_recall_vs_oracle", ("detail", "recall_vs_oracle")),
     # cold-start time-to-first-query at warm cache + prewarm (r14) and
     # its recompile contract: first path pair for auto mode's nested
     # leg, second when bench_cold_start IS the headline
@@ -1646,7 +1992,8 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--metric",
                    choices=["auto", "hgcn", "poincare", "serve",
-                            "serve_http", "cold_start", "big_table"],
+                            "serve_http", "live_index", "cold_start",
+                            "big_table"],
                    default="auto")
     p.add_argument("--big-rows", type=int, default=10_000_000,
                    help="--metric big_table: synthetic table rows "
@@ -1702,6 +2049,7 @@ def main() -> None:
     primary = {"poincare": bench_poincare,
                "serve": bench_serve,
                "serve_http": bench_serve_http,
+               "live_index": bench_live_index,
                "cold_start": bench_cold_start,
                "big_table": functools.partial(
                    bench_big_table, rows=args.big_rows,
@@ -1795,6 +2143,10 @@ def main() -> None:
                 r = bench_serve_http(repeats=max(1, args.repeats - 1))
                 d["serve_http"] = {"p99_ms": r["value"], **r["detail"]}
 
+            def live_index_leg(d):  # live upserts + rollover (r18)
+                r = bench_live_index()
+                d["live_index"] = r["detail"]
+
             def cold_start_leg(d):  # restart TTFQ + cache regimes (r14)
                 r = bench_cold_start()
                 d["cold_start"] = r["detail"]
@@ -1845,6 +2197,7 @@ def main() -> None:
             leg("hgcn_sampled", 45, sampled_leg)
             leg("serve_qps", 40, serve_leg)
             leg("serve_http", 35, serve_http_leg)
+            leg("live_index", 40, live_index_leg)
             leg("cold_start", 60, cold_start_leg)
             leg("big_table", 75, big_table_leg)
             leg("precision", 40, precision_leg)

@@ -10,6 +10,7 @@ from hyperspace_tpu.analysis.rules.distmat import MaterializedDistmatRule
 from hyperspace_tpu.analysis.rules.donation import DonationHazardRule
 from hyperspace_tpu.analysis.rules.exceptions import SwallowBaseExceptionRule
 from hyperspace_tpu.analysis.rules.flags import FlagDocDriftRule
+from hyperspace_tpu.analysis.rules.frozen import FrozenTableMutationRule
 from hyperspace_tpu.analysis.rules.hostsync import HostSyncRule
 from hyperspace_tpu.analysis.rules.hosttable import (
     FullTableMaterializationRule)
@@ -33,6 +34,7 @@ ALL_RULES = (
     BlockingCallInAsyncRule,
     MaterializedDistmatRule,
     FullTableMaterializationRule,
+    FrozenTableMutationRule,
     PrecisionLiteralRule,
     PackingLiteralRule,
     MetricUnitSuffixRule,

@@ -1,0 +1,127 @@
+"""frozen-table-mutation: in-place write to a frozen engine array.
+
+Incident class the live-index PR (r18) makes structural: a
+``QueryEngine``'s arrays — the embedding ``table``, the quantized
+``scan_table``/``scan_scale``/``pq_codebooks`` lanes, the coarse
+index's ``centroids``/``cells`` — are FROZEN after construction.
+Every cache key, artifact fingerprint, and ``scan_signature`` is
+derived from them once; an in-place write (``eng.table[i] = row``)
+silently desynchronizes all three: queries race a half-applied table,
+the batcher keeps serving cached results for rows that no longer
+exist, and the artifact fingerprint attests to bytes that are gone.
+It compiles, it runs, and small tests pass — visibility is the only
+casualty, which is exactly the hazard class this suite catches at
+lint time.
+
+The sanctioned mutation paths are the ones that keep the invariants:
+``LiveQueryEngine.upsert``/``delete`` (``serve/delta.py``) stage
+writes in a delta segment behind a generation-folded scan signature,
+and ``HostEmbedTable`` (``parallel/host_table.py``) owns the host
+master's storage including ``write_back``/``append_rows``.  Those two
+modules are the exempt homes of the writes; everywhere else a write
+is a bug.
+
+What fires (error): an ``ast.Assign`` / ``ast.AugAssign`` whose
+target is
+
+- a subscript over a frozen attribute — ``eng.table[i] = row``,
+  ``idx.cells[c] += 1``, ``live._pen[slot] = INF`` — the classic
+  in-place poke; or
+- a rebind of a frozen attribute on an object OTHER than ``self`` /
+  ``cls`` — ``eng.scan_table = requantize(...)`` swaps an engine's
+  lane out from under its fingerprint (a class initializing its OWN
+  attribute in ``__init__`` stays clean).
+
+What stays clean: ``serve/delta.py`` and ``parallel/host_table.py``
+(the sanctioned homes), ``self.table = ...`` construction, reads,
+and writes to local arrays that merely share a name.
+
+Fix: route point mutations through ``LiveQueryEngine.upsert`` /
+``delete`` and bulk rebuilds through compaction or a blue-green
+rollover; a deliberate surgical write documents itself with the
+per-line suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+
+# the frozen array surface: engine lanes (engine.py), quantization
+# payloads (quant.py), the coarse index (index.py), and the delta
+# segment's own internals (writable only inside serve/delta.py)
+_FROZEN_ATTRS = frozenset({
+    "table", "scan_table", "scan_scale", "pq_codebooks",
+    "codes", "codebooks",
+    "centroids", "cells",
+    "_rows", "_ids", "_pen", "_drop", "_seq",
+})
+
+# the two sanctioned homes of table mutation: the delta segment layer
+# and the host master's storage (write_back / append_rows live there)
+_EXEMPT_SUFFIXES = ("serve/delta.py", "parallel/host_table.py")
+
+
+def _flatten_targets(targets: Iterable[ast.AST]) -> Iterable[ast.AST]:
+    for tgt in targets:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            yield from _flatten_targets(tgt.elts)
+        else:
+            yield tgt
+
+
+def _own_attribute(node: ast.Attribute) -> bool:
+    """``self.x`` / ``cls.x`` — the owning class's own slot."""
+    return (isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls"))
+
+
+class FrozenTableMutationRule(Rule):
+    id = "frozen-table-mutation"
+    severity = "error"
+    summary = ("in-place write to a frozen engine/index array "
+               "(table / scan lanes / codes / centroids / cells) "
+               "outside serve/delta.py and parallel/host_table.py — "
+               "mutations go through LiveQueryEngine.upsert/delete "
+               "or HostEmbedTable")
+
+    def check_file(self, ctx: FileContext) -> List:
+        rel = ctx.rel.replace("\\", "/")
+        if rel.endswith(_EXEMPT_SUFFIXES):
+            return []
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            else:
+                continue
+            for tgt in _flatten_targets(targets):
+                if (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Attribute)
+                        and tgt.value.attr in _FROZEN_ATTRS):
+                    findings.append(self.finding(
+                        ctx, node,
+                        f"in-place write to frozen array "
+                        f"'.{tgt.value.attr}[...]' — cache keys, the "
+                        f"artifact fingerprint, and scan_signature "
+                        f"all go stale; route the mutation through "
+                        f"LiveQueryEngine.upsert/delete "
+                        f"(serve/delta.py) or HostEmbedTable "
+                        f"(parallel/host_table.py)"))
+                    break
+                if (isinstance(tgt, ast.Attribute)
+                        and tgt.attr in _FROZEN_ATTRS
+                        and not _own_attribute(tgt)):
+                    findings.append(self.finding(
+                        ctx, node,
+                        f"rebinding frozen array '.{tgt.attr}' on a "
+                        f"foreign object swaps an engine lane out "
+                        f"from under its fingerprint — rebuild via "
+                        f"compaction or a blue-green rollover instead "
+                        f"(serve/rollover.py)"))
+                    break
+        return findings

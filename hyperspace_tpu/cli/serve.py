@@ -25,9 +25,17 @@ Three modes, same ``key=value`` override grammar as the train CLI:
 
 Loop-mode requests:
 
-    {"op": "topk",  "ids": [0, 1, 2], "k": 5}
-    {"op": "score", "u": [0, 1], "v": [2, 3], "prob": true}
+    {"op": "topk",   "ids": [0, 1, 2], "k": 5}
+    {"op": "score",  "u": [0, 1], "v": [2, 3], "prob": true}
+    {"op": "upsert", "ids": [7, 120], "rows": [[...], [...]]}
+    {"op": "delete", "ids": [3]}
     {"op": "stats"}
+
+``upsert``/``delete`` need ``live=1`` (the artifact's engine is wrapped
+in a :class:`~hyperspace_tpu.serve.delta.LiveQueryEngine`; ``delta_cap=``
+/ ``compact_at=`` size the delta segment — docs/serving.md "Live index
+and rollover"); against a frozen engine they answer a ``validation``
+error.
 
 Responses mirror the request (``neighbors``/``dists``, ``scores``, or
 the counter snapshot); a failed line yields ``{"error": {"kind": ...,
@@ -121,6 +129,20 @@ class ServeConfig:
     # or a sub-threshold table fall back to the exact program
     # (docs/serving.md "Approximate retrieval").
     nprobe: int = 0
+    # --- live mutable index (serve/delta.py; docs/serving.md "Live
+    # index and rollover") ----------------------------------------------
+    # live=1 wraps the engine in a LiveQueryEngine: upsert/delete ops
+    # (stdin loop) and POST /v1/upsert | /v1/delete (front door) mutate
+    # through a delta segment with tombstone masking; frozen serving
+    # (the default) rejects mutations with a validation error.
+    # Incompatible with scan_mode=fused (no tombstone lane).
+    live: bool = False
+    # delta-segment capacity in rows (static shape — the merged query
+    # path compiles once per bucket whatever the mutation rate)
+    delta_cap: int = 1024
+    # background-compaction trigger: occupancy fraction of delta_cap at
+    # which a compaction thread folds the segment into a rebuilt base
+    compact_at: float = 0.75
     # --- overload safety (docs/resilience.md) --------------------------
     # default per-request deadline in ms (0 = none); a request's own
     # "deadline_ms" field overrides.  Expired requests answer
@@ -223,6 +245,18 @@ def _build(cfg: ServeConfig):
                                         mesh=mesh, scan_mode=cfg.scan_mode,
                                         precision=cfg.precision,
                                         nprobe=cfg.nprobe)
+        if cfg.live:
+            # mutable serving: the artifact table becomes the host
+            # master (a writable copy — the mmapped artifact stays
+            # pristine) and the frozen engine becomes the base under a
+            # delta segment (serve/delta.py)
+            from hyperspace_tpu.parallel.host_table import HostEmbedTable
+            from hyperspace_tpu.serve.delta import LiveQueryEngine
+
+            master = HostEmbedTable.from_array(
+                np.array(art.table, np.float32))
+            eng = LiveQueryEngine(eng, master, capacity=cfg.delta_cap,
+                                  compact_at=cfg.compact_at)
     except ValueError as e:  # bad scan_mode/chunk_rows/precision/nprobe
         raise SystemExit(str(e)) from None
     # --- observability plane (ServeConfig docstrings): window, access
@@ -561,11 +595,25 @@ def _handle(batcher, req: dict, entered=None) -> dict:
         scores = batcher.score(u, v, prob=prob, fd_r=fd_r, fd_t=fd_t,
                                deadline_ms=deadline_ms, request_id=rid)
         return {"scores": scores.tolist(), **echo}
+    if op == "upsert":
+        ids, rows = req.get("ids"), req.get("rows")
+        deadline_ms = _req_deadline(req)
+        if entered is not None:
+            entered[0] = True
+        return {**batcher.upsert(ids, rows, deadline_ms=deadline_ms,
+                                 request_id=rid), **echo}
+    if op == "delete":
+        deadline_ms = _req_deadline(req)
+        if entered is not None:
+            entered[0] = True
+        return {**batcher.delete(req.get("ids"), deadline_ms=deadline_ms,
+                                 request_id=rid), **echo}
     if op == "stats":
         # stats echoes too: a pipelined client must be able to join
         # EVERY answered line, scrape ops included
         return {**batcher.stats(), **echo}
-    raise ValueError(f"unknown op {op!r} (want topk|score|stats)")
+    raise ValueError(
+        f"unknown op {op!r} (want topk|score|upsert|delete|stats)")
 
 
 def _loop_access(batcher, req, outcome: str) -> None:
@@ -723,13 +771,17 @@ def run_serve(cfg: ServeConfig, *, stdin=None, stdout=None) -> dict:
 
 def run_serve_http(cfg: ServeConfig, *, ready=None) -> dict:
     """The asyncio HTTP front door (serve/server.py): concurrent
-    ``POST /v1/topk`` / ``/v1/score`` / ``/v1/stats`` + ``GET
+    ``POST /v1/topk`` / ``/v1/score`` / ``/v1/upsert`` /
+    ``/v1/delete`` / ``/v1/stats`` + ``POST /admin/rollover`` + ``GET
     /healthz`` over the continuous-batching collator; SIGTERM drains
     exactly like the stdin loop (in-flight answered, new connections
     refused, latency summary on stderr).  ``ready(host, port)`` is
     called once the listener is bound — the default announces the port
     on stderr as a parseable ``[serve-http] listening on HOST:PORT``
-    line (port=0 binds an ephemeral port)."""
+    line (port=0 binds an ephemeral port).  ``/admin/rollover`` is
+    armed with a builder that replays this config against the posted
+    ``target`` artifact path (serve/rollover.py: the standby is built
+    and prewarmed off-loop, the flip is health-gated and atomic)."""
     import asyncio
 
     from hyperspace_tpu.serve.server import run_front_door
@@ -739,6 +791,15 @@ def run_serve_http(cfg: ServeConfig, *, ready=None) -> dict:
             f"max_wait_us must be >= 0; got {cfg.max_wait_us}")
     prewarm_ks = _prewarm_ks(cfg)  # parse errors before the build pays
     _eng, batcher = _build(cfg)
+
+    def rebuild(target: str):
+        # SystemExit (how _build reports a bad artifact) would escape the
+        # connection task uncaught — re-raise as the ValueError the front
+        # door's error taxonomy maps to a 400 validation response.
+        try:
+            return _build(dataclasses.replace(cfg, artifact=target))[1]
+        except SystemExit as e:
+            raise ValueError(str(e)) from None
 
     def announce(host, port):
         try:
@@ -754,7 +815,7 @@ def run_serve_http(cfg: ServeConfig, *, ready=None) -> dict:
             result = asyncio.run(run_front_door(
                 batcher, host=cfg.host, port=cfg.port,
                 max_wait_us=cfg.max_wait_us, ready=announce,
-                prewarm_ks=prewarm_ks))
+                prewarm_ks=prewarm_ks, rollover_builder=rebuild))
         except ValueError as e:  # prewarm k out of range for this table
             raise SystemExit(f"prewarm: {e}") from None
         except OSError as e:  # bind failure (port in use, bad host): usage

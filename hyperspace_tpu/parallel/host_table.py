@@ -205,6 +205,31 @@ class HostEmbedTable:
                 self._shards[s][local[m]] = rows[m]
         _telem.inc("host_table/writeback_rows", int(len(ids)))
 
+    def append_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Grow the table by ``rows`` ([M, W]) as a NEW trailing shard;
+        returns the assigned ids ``[num_rows, num_rows + M)`` (int64).
+
+        The live-index insert path (serve/delta.py): ids are row
+        indices everywhere downstream, so new rows must land at the
+        contiguous tail — existing shards, starts, and every id already
+        handed out stay valid.  One appended shard per call keeps this
+        O(M); compaction's full rebuild re-shards if fragmentation ever
+        matters."""
+        rows = np.asarray(rows, self.dtype)
+        if rows.ndim != 2 or rows.shape[1] != self.width:
+            raise ValueError(
+                f"rows {rows.shape} must be [M, {self.width}]")
+        if rows.shape[0] == 0:
+            return np.empty((0,), np.int64)
+        with self._lock:
+            lo = self.num_rows
+            self._shards.append(np.array(rows))
+            self._starts = np.append(
+                self._starts, lo + rows.shape[0]).astype(np.int64)
+            self.num_rows = lo + rows.shape[0]
+        _telem.inc("host_table/writeback_rows", int(rows.shape[0]))
+        return np.arange(lo, lo + rows.shape[0], dtype=np.int64)
+
     def iter_chunks(self, chunk: int) -> Iterator[tuple[int, np.ndarray]]:
         """Yield ``(row_start, block)`` host views covering the table in
         order, each at most ``chunk`` rows and never crossing a shard

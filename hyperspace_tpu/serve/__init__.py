@@ -25,10 +25,19 @@ answering retrieval queries (docs/serving.md):
   collator.py  continuous-batching collator: fill a power-of-two bucket
                or flush at the max-wait deadline, one shared dispatch
                per flush through a single dispatch executor
+  delta.py     live mutable index: LSM-style delta-segment upserts /
+               tombstone deletes over a frozen base, write-through to
+               the host master, background compaction, generation-
+               folded scan signatures (stale cache rows structurally
+               unreachable)
+  rollover.py  blue-green rollover: prewarmed standby engine, health-
+               gated atomic flip, old-stack drain — zero-downtime
+               artifact replacement behind the front door
   server.py    asyncio HTTP/1.1 front door (stdlib only): concurrent
-               POST /v1/topk | /v1/score | /v1/stats + /healthz,
-               deadline propagation from socket accept, 429/504 typed
-               errors, SIGTERM drain
+               POST /v1/topk | /v1/score | /v1/upsert | /v1/delete |
+               /v1/stats + /admin/rollover + /healthz, deadline
+               propagation from socket accept, 429/504 typed errors,
+               SIGTERM drain
   cli/serve.py the `export` / `query` / `serve` / `serve-http` entry
                points
 """
@@ -51,6 +60,7 @@ from hyperspace_tpu.serve.artifact import (  # noqa: F401
 )
 from hyperspace_tpu.serve.batcher import RequestBatcher  # noqa: F401
 from hyperspace_tpu.serve.collator import Collator  # noqa: F401
+from hyperspace_tpu.serve.delta import LiveQueryEngine  # noqa: F401
 from hyperspace_tpu.serve.engine import QueryEngine  # noqa: F401
 from hyperspace_tpu.serve.errors import (  # noqa: F401
     DeadlineExceededError,
@@ -62,4 +72,9 @@ from hyperspace_tpu.serve.index import (  # noqa: F401
     ServingIndex,
     auto_ncells,
     build_index,
+)
+from hyperspace_tpu.serve.rollover import (  # noqa: F401
+    RolloverCoordinator,
+    gate_flip,
+    standby_health,
 )

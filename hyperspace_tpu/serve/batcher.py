@@ -984,6 +984,88 @@ class RequestBatcher:
         finally:
             self._release()
 
+    # --- mutations (live engines only — serve/delta.py) -----------------------
+
+    def _live_engine(self):
+        """The engine, checked mutable: a frozen engine answering an
+        upsert with an AttributeError deep in the stack would classify
+        ``internal`` — it is a validation failure (fix your request /
+        serve with ``live=true``), and must say so."""
+        if not hasattr(self.engine, "upsert"):
+            raise ValueError(
+                "engine is frozen: mutations need a live engine "
+                "(serve with live=true, or wrap the base in "
+                "serve.delta.LiveQueryEngine)")
+        return self.engine
+
+    def _mutate(self, op: str, apply, *, deadline_ms: Optional[float],
+                t_enq: Optional[float],
+                request_id: Optional[str]) -> dict:
+        """The shared mutation envelope: same admission / deadline /
+        access-log contract as :meth:`topk`; ``apply(engine)`` runs the
+        validated mutation and returns the response dict.  On success
+        the event→servable freshness (``serve/upsert_visible_ms``:
+        enqueue stamp → generation bumped, mask uploaded on next sync)
+        is observed — THE latency a live index is judged by."""
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        if request_id is None and self.access_sink is not None:
+            request_id = new_request_id()
+        life = _Lifecycle(op, deadline_ms, t_enq=t_enq,
+                          request_id=request_id)
+        telem.inc("serve/requests")
+        try:
+            self._admit()
+        except OverloadedError:
+            self.emit_access(life, "overloaded")
+            raise
+        try:
+            with span("query", args=life.info):
+                eng = self._live_engine()
+                life.formed()
+                life.check_deadline("before the mutation")
+                out = apply(eng)
+                life.result_ready()
+                telem.observe("serve/upsert_visible_ms",
+                              (time.perf_counter() - life.t_enq) * 1e3)
+                # a mutation is never rolled back by its deadline: once
+                # applied it is visible (the generation already moved),
+                # so the late answer reports deadline_exceeded WITH the
+                # mutation durable — like a cached row computed late
+                life.check_deadline("at completion")
+                life.finish()
+                self.emit_access(life)
+                return out
+        except (ServeError, ValueError, KeyError, TypeError,
+                OverflowError, OSError) as e:
+            self.emit_access(life, kind_of(e))
+            raise
+        finally:
+            self._release()
+
+    def upsert(self, ids, rows, *,
+               deadline_ms: Optional[float] = None,
+               t_enq: Optional[float] = None,
+               request_id: Optional[str] = None) -> dict:
+        """Insert/update rows through the live engine's delta segment
+        (``{"upserted", "inserted", "generation", "segment_rows"}``).
+        Validation (id contiguity for inserts, row shapes,
+        last-write-wins dedup) lives in
+        :meth:`~hyperspace_tpu.serve.delta.LiveQueryEngine.upsert`."""
+        return self._mutate(
+            "upsert", lambda eng: eng.upsert(ids, rows),
+            deadline_ms=deadline_ms, t_enq=t_enq, request_id=request_id)
+
+    def delete(self, ids, *,
+               deadline_ms: Optional[float] = None,
+               t_enq: Optional[float] = None,
+               request_id: Optional[str] = None) -> dict:
+        """Tombstone rows (``{"deleted", "generation"}``) — the id
+        space never shrinks; the rows become unreachable."""
+        return self._mutate(
+            "delete", lambda eng: eng.delete(ids),
+            deadline_ms=deadline_ms, t_enq=t_enq, request_id=request_id)
+
     # --- introspection --------------------------------------------------------
 
     def _update_gauges(self) -> None:
@@ -1033,6 +1115,12 @@ class RequestBatcher:
             "scan_strategy": self.engine.scan_strategy,
             "scan_mode": self.engine.scan_mode,
             "nprobe": self.engine.nprobe,
+            # live-index identity (serve/delta.py): the segment
+            # generation and current delta occupancy — None on a
+            # frozen engine, so a stats consumer can tell the worlds
+            # apart at a glance
+            "generation": getattr(self.engine, "generation", None),
+            "segment_rows": getattr(self.engine, "segment_rows", None),
             # overload safety (docs/resilience.md): queue bound, shed /
             # deadline counts, and the ladder's current level+mode —
             # a stats consumer must see a degraded server AS degraded

@@ -242,6 +242,37 @@ class Collator:
         finally:
             b._release()
 
+    # --- mutations (live engines — serve/delta.py) ----------------------------
+
+    async def upsert(self, ids, rows, *,
+                     deadline_ms: Optional[float] = None,
+                     t_enq: Optional[float] = None,
+                     request_id: Optional[str] = None) -> dict:
+        """The batcher's ``upsert`` through the dispatch executor:
+        mutations are serialized with the topk/score device work (one
+        worker), so a flush never scans a half-applied generation —
+        the delta swap it observes is whole, before or after."""
+        if self._closed:
+            raise OverloadedError("server draining: dispatch closed")
+        return await asyncio.get_running_loop().run_in_executor(
+            self._exec,
+            functools.partial(self.batcher.upsert, ids, rows,
+                              deadline_ms=deadline_ms, t_enq=t_enq,
+                              request_id=request_id))
+
+    async def delete(self, ids, *,
+                     deadline_ms: Optional[float] = None,
+                     t_enq: Optional[float] = None,
+                     request_id: Optional[str] = None) -> dict:
+        """The batcher's ``delete``, same executor serialization."""
+        if self._closed:
+            raise OverloadedError("server draining: dispatch closed")
+        return await asyncio.get_running_loop().run_in_executor(
+            self._exec,
+            functools.partial(self.batcher.delete, ids,
+                              deadline_ms=deadline_ms, t_enq=t_enq,
+                              request_id=request_id))
+
     # --- pending-bucket machinery ---------------------------------------------
 
     def _enqueue(self, misses: list, k: int, exclude_self: bool,

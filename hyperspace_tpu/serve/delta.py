@@ -1,0 +1,519 @@
+"""Live mutable index: an LSM-style delta segment over a frozen engine.
+
+The serving stack up to here is frozen-world: one immutable artifact,
+one immutable IVF index, a cache keyed by fingerprint.  This module
+adds the write path (ROADMAP item 2) with the classic LSM shape
+(O'Neil et al. 1996; FreshDiskANN's fresh-list + merge): a small,
+exact-scanned **delta segment** in front of the frozen base absorbs
+insert / update / delete, and a **background compaction** folds the
+accumulated mutations into a rebuilt base, atomically swapped.
+
+Design invariants (docs/serving.md "Live index and rollover"):
+
+- **Ids are row indices, forever.**  The whole stack (batcher cache,
+  exclude-self masks, artifact layout) treats an id as a row number, so
+  compaction may never renumber.  Inserts therefore land at the
+  contiguous tail (``HostEmbedTable.append_rows``) and a deleted id's
+  row is never reclaimed — it is *tombstoned*.
+- **Tombstones live on device, as a traced penalty row.**  ``_drop``
+  is an ``[npad] f32`` operand (0 = live, +inf = deleted or superseded
+  by a delta write) added to every scan tile before top-k inside the
+  frozen engine's jitted programs (``engine.topk_neighbors(drop=...)``)
+  — so a dead base row can never win, the executable count never grows
+  (the mask is traced, not static), and the f32 rescore preserves the
+  +inf.  Unbounded tombstone counts would break any over-fetch scheme;
+  the penalty row makes the cost O(1) per tile whatever the count.
+- **Queries score FRESH vectors.**  The query rows are gathered from
+  the mutable host master (``q_rows=``), not the frozen device table —
+  a query *by* an updated id must rank against its post-upsert vector.
+- **The generation makes staleness structural.**  Every mutation bumps
+  a monotone ``generation`` which :attr:`scan_signature` folds into
+  the batcher's cache key — a cached row from generation g can never
+  answer a generation-g+1 request, by key inequality rather than by
+  invalidation bookkeeping.
+
+Write path per :meth:`LiveQueryEngine.upsert` (under the engine lock):
+write-through to the host master (``write_back`` / ``append_rows``),
+copy into a free delta slot (last-write-wins on re-upsert), tombstone
+the superseded base row, bump the generation.  The delta segment is a
+FIXED-capacity ``[cap, D]`` array — static shapes, so the merged query
+path compiles once per bucket and ``recompiles_steady == 0`` holds
+under a sustained upsert stream (the acceptance gate of
+``bench.py bench_live_index``).
+
+Compaction (:meth:`compact`, auto-triggered at ``compact_at``
+occupancy) snapshots the master, re-clusters via the streaming
+:func:`~hyperspace_tpu.serve.index.build_index` (beyond-HBM capable),
+builds a fresh frozen engine, and swaps it in atomically — entries
+written *after* the snapshot stay in the delta (per-entry sequence
+numbers), deleted ids stay tombstoned (rows are never renumbered), and
+the base fingerprint changes so fingerprint-keyed caches roll over.
+
+This module and ``parallel/host_table.py`` are the ONE sanctioned home
+of in-place writes to serving table state — the ``frozen-table-
+mutation`` hyperlint rule errors on such writes anywhere else.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hyperspace_tpu.parallel.host_table import HostEmbedTable
+from hyperspace_tpu.serve.engine import (QueryEngine, _edge_dist_rows,
+                                         _tile_dist)
+from hyperspace_tpu.telemetry import registry as telem
+
+DEFAULT_DELTA_CAP = 1024
+DEFAULT_COMPACT_AT = 0.75
+
+
+@partial(jax.jit, static_argnames=("spec", "exclude_self"))
+def _delta_scan(q: jax.Array, rows: jax.Array, penalty: jax.Array,
+                q_idx: jax.Array, ids: jax.Array, *, spec: tuple,
+                exclude_self: bool) -> jax.Array:
+    """Exact distances of ``q`` [B, D] against the delta segment
+    ``rows`` [cap, D] → [B, cap].  ``penalty`` (+inf on free slots)
+    and the optional self-mask ride inside the one jitted program;
+    all operands are traced, so mutation never recompiles."""
+    d = _tile_dist(spec, q, rows) + penalty[None, :]
+    if exclude_self:
+        d = jnp.where(ids[None, :] == q_idx[:, None], jnp.inf, d)
+    return d
+
+
+class LiveQueryEngine:
+    """A mutable engine: frozen :class:`QueryEngine` base + host master
+    + fixed-capacity delta segment.  Duck-types the ``QueryEngine``
+    query surface (``topk_neighbors`` / ``score_edges`` / the batcher's
+    attribute set), so ``RequestBatcher`` serves it unchanged.
+
+    ``base`` must not be a fused-scan engine: the fused kernel has no
+    tombstone lane, and an engine advertising ``"fused"`` in its
+    signature while silently dispatching the two-stage fallback would
+    lie to the cache key.  Construct the base with
+    ``scan_mode="two_stage"`` (or ``"carry"``).
+    """
+
+    def __init__(self, base: QueryEngine, master: HostEmbedTable, *,
+                 capacity: int = DEFAULT_DELTA_CAP,
+                 compact_at: float = DEFAULT_COMPACT_AT,
+                 auto_compact: bool = True):
+        if base.scan_mode == "fused":
+            raise ValueError(
+                "LiveQueryEngine needs a two_stage/carry base: the fused "
+                "kernel has no tombstone lane, and a silent fallback "
+                "would desync the engine's scan_signature from the "
+                "program that answers")
+        if int(master.num_rows) != base.num_nodes:
+            raise ValueError(
+                f"master has {master.num_rows} rows; base engine was "
+                f"built over {base.num_nodes} — they must start aligned")
+        if int(master.width) != base.dim:
+            raise ValueError(
+                f"master width {master.width} != engine dim {base.dim}")
+        capacity = int(capacity)
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1; got {capacity}")
+        if not 0.0 < float(compact_at) <= 1.0:
+            raise ValueError(
+                f"compact_at must be in (0, 1]; got {compact_at}")
+        self.base = base
+        self.master = master
+        self.capacity = capacity
+        self.compact_at = float(compact_at)
+        self.auto_compact = bool(auto_compact)
+        # rebuild recipe for compaction: the swapped-in engine must be
+        # the SAME serving configuration over the merged table
+        self._ncells = int(base.index.ncells) if base.index is not None \
+            else 0
+        # delta state (host mirrors; device copies sync on mutation).
+        # pen: 0 = live entry, +inf = free OR freed slot — free slots
+        # can never win a top-k, so the scan needs no occupancy mask
+        dim = base.dim
+        self._rows = np.zeros((capacity, dim), np.float32)
+        self._ids = np.full((capacity,), -1, np.int32)
+        self._pen = np.full((capacity,), np.inf, np.float32)
+        self._seq = np.zeros((capacity,), np.int64)  # write stamps
+        self._slot_of: dict[int, int] = {}
+        self._free = list(range(capacity - 1, -1, -1))
+        self._deleted: set[int] = set()
+        self._drop = np.zeros((base.table.shape[0],), np.float32)
+        self._gen = 0
+        self._next_seq = 1
+        self._lock = threading.RLock()
+        self._compact_lock = threading.Lock()
+        self._dirty = True
+        self._dev = None  # (rows, ids, pen, drop) jnp mirrors
+
+    # --- QueryEngine duck-type surface ---------------------------------------
+
+    @property
+    def fingerprint(self) -> str:
+        return self.base.fingerprint
+
+    @property
+    def precision(self) -> str:
+        return self.base.precision
+
+    @property
+    def scan_mode(self) -> str:
+        return self.base.scan_mode
+
+    @property
+    def scan_strategy(self) -> str:
+        return self.base.scan_strategy
+
+    @property
+    def nprobe(self) -> int:
+        return self.base.nprobe
+
+    @property
+    def index(self):
+        return self.base.index
+
+    @property
+    def spec(self) -> tuple:
+        return self.base.spec
+
+    @property
+    def dim(self) -> int:
+        return self.base.dim
+
+    @property
+    def num_nodes(self) -> int:
+        """Total id space [0, N) — tombstoned rows INCLUDED (ids are
+        row indices; a deleted id stays addressable-and-rejected)."""
+        return int(self.master.num_rows)
+
+    @property
+    def num_live(self) -> int:
+        return int(self.master.num_rows) - len(self._deleted)
+
+    @property
+    def generation(self) -> int:
+        return self._gen
+
+    @property
+    def segment_rows(self) -> int:
+        return len(self._slot_of)
+
+    @property
+    def scan_signature(self) -> tuple:
+        """The base signature + the segment generation: the batcher's
+        fingerprint-keyed LRU then CANNOT serve a pre-mutation row to a
+        post-mutation request — the keys differ structurally."""
+        return self.base.scan_signature + ("gen", self._gen)
+
+    def scan_signature_for(self, nprobe: int) -> tuple:
+        return self.base.scan_signature_for(nprobe) + ("gen", self._gen)
+
+    # --- queries --------------------------------------------------------------
+
+    def _sync_device(self):
+        with self._lock:
+            if self._dirty or self._dev is None:
+                self._dev = (jnp.asarray(self._rows),
+                             jnp.asarray(self._ids),
+                             jnp.asarray(self._pen),
+                             jnp.asarray(self._drop))
+                self._dirty = False
+            return self._dev, self.base
+
+    def _check_live_ids(self, ids, name: str) -> np.ndarray:
+        arr = np.asarray(ids)
+        if arr.ndim != 1 or arr.size == 0:
+            raise ValueError(f"{name} must be a non-empty 1-D id array")
+        if not np.issubdtype(arr.dtype, np.integer):
+            raise ValueError(f"{name} must be integer ids; got {arr.dtype}")
+        if arr.size and (arr.min() < 0 or arr.max() >= self.num_nodes):
+            raise ValueError(
+                f"{name} out of range [0, {self.num_nodes}): "
+                f"min={arr.min()}, max={arr.max()}")
+        dead = [int(i) for i in arr if int(i) in self._deleted]
+        if dead:
+            raise ValueError(
+                f"{name} refers to deleted id(s) {sorted(set(dead))[:8]} "
+                "— tombstoned rows cannot be queried")
+        return arr.astype(np.int64)
+
+    def topk_neighbors(self, q_idx, k: int, *, exclude_self: bool = True,
+                       nprobe: Optional[int] = None):
+        """``(neighbors [B, k] int32, dists [B, k] f32)`` over the LIVE
+        view: base scan with the tombstone mask, merged with the exact
+        delta-segment scan, both scoring the query's FRESH master row.
+        Sorted ascending; a tombstoned or superseded row can never
+        appear.  Raises the under-filled ``ValueError`` when fewer than
+        ``k`` live rows are reachable (k > live-row-count included) —
+        never serves a tombstone as filler."""
+        arr = self._check_live_ids(q_idx, "q_idx")
+        k = int(k)
+        limit = self.num_nodes - (1 if exclude_self else 0)
+        if not 1 <= k <= limit:
+            raise ValueError(
+                f"k={k} out of range [1, {limit}] for a {self.num_nodes}-"
+                f"row table (exclude_self={exclude_self})")
+        # snapshot the device mirrors + base under the lock (an upsert
+        # mid-query must not hand us gen-g rows with a gen-g+1 mask)
+        (d_rows, d_ids, d_pen, d_drop), base = self._sync_device()
+        q_rows = self.master.gather(arr)  # FRESH post-upsert vectors
+        base_k = min(k, base.num_nodes - (1 if exclude_self else 0))
+        if base.scan_strategy == "ivf":
+            base_k = min(base_k, base.nprobe * base.index.max_cell)
+        base_k = max(base_k, 1)
+        bi, bd = base.topk_neighbors(
+            arr.astype(np.int32), base_k, exclude_self=exclude_self,
+            nprobe=nprobe, q_rows=q_rows, drop=d_drop,
+            allow_underfill=True)
+        dd = _delta_scan(jnp.asarray(q_rows), d_rows, d_pen,
+                         jnp.asarray(arr, jnp.int32), d_ids,
+                         spec=base.spec, exclude_self=exclude_self)
+        # host merge: [B, base_k + cap] candidates; tombstoned base rows
+        # carry +inf (the drop penalty survives the rescore), free delta
+        # slots carry +inf, and a delta-resident id's base copy is
+        # tombstoned — so no id can appear twice at finite distance
+        cand_d = np.concatenate([np.asarray(bd), np.asarray(dd)], axis=1)
+        cand_i = np.concatenate(
+            [np.asarray(bi),
+             np.broadcast_to(np.asarray(d_ids)[None, :],
+                             (arr.size, self.capacity))], axis=1)
+        if k > cand_d.shape[1]:
+            raise ValueError(
+                f"live top-k under-filled: k={k} exceeds the "
+                f"{cand_d.shape[1]} reachable candidate slots "
+                f"({self.num_live} live of {self.num_nodes} rows) — "
+                "lower k, raise nprobe=, or compact")
+        part = np.argpartition(cand_d, k - 1, axis=1)[:, :k]
+        rowix = np.arange(arr.size)[:, None]
+        sel_d = cand_d[rowix, part]
+        order = np.argsort(sel_d, axis=1, kind="stable")
+        top = part[rowix, order]
+        out_d = cand_d[rowix, top]
+        out_i = cand_i[rowix, top].astype(np.int32)
+        if np.isinf(out_d).any():
+            raise ValueError(
+                f"live top-k under-filled: k={k} exceeds the reachable "
+                f"live rows ({self.num_live} live of {self.num_nodes}; "
+                "tombstones are excluded, never served) — lower k or "
+                "compact after fewer deletes")
+        return out_i, out_d
+
+    def score_edges(self, u_idx, v_idx, *, prob: bool = False,
+                    fd_r: float = 2.0, fd_t: float = 1.0):
+        """Per-pair distances over FRESH master rows (a scored endpoint
+        updated one generation ago must score its new vector)."""
+        u = self._check_live_ids(u_idx, "u_idx")
+        v = self._check_live_ids(v_idx, "v_idx")
+        if u.shape != v.shape:
+            raise ValueError(
+                f"u_idx {u.shape} and v_idx {v.shape} must match")
+        xu = jnp.asarray(self.master.gather(u))
+        xv = jnp.asarray(self.master.gather(v))
+        return _edge_dist_rows(xu, xv, fd_r, fd_t, spec=self.base.spec,
+                               prob=bool(prob))
+
+    # --- mutations ------------------------------------------------------------
+
+    def _validate_upsert(self, ids, rows):
+        arr = np.asarray(ids)
+        if arr.ndim != 1 or arr.size == 0:
+            raise ValueError("ids must be a non-empty 1-D id array")
+        if not np.issubdtype(arr.dtype, np.integer):
+            raise ValueError(f"ids must be integer ids; got {arr.dtype}")
+        rows = np.asarray(rows, np.float32)
+        if rows.shape != (arr.size, self.dim):
+            raise ValueError(
+                f"rows {rows.shape} must be ({arr.size}, {self.dim})")
+        if arr.size and arr.min() < 0:
+            raise ValueError(f"ids must be >= 0; got min={arr.min()}")
+        return arr.astype(np.int64), rows
+
+    def upsert(self, ids, rows) -> dict:
+        """Insert or update rows; returns ``{"upserted", "inserted",
+        "generation", "segment_rows"}``.
+
+        Updates target existing (possibly deleted — that's a
+        reinsert) ids; inserts must extend the id space CONTIGUOUSLY
+        from ``num_nodes`` (ids are row indices — a gap would be an
+        unaddressable hole forever).  Duplicate ids in one batch
+        resolve last-write-wins, like a re-upsert across batches.
+        Write order: master first (write-through), then the delta slot,
+        then the tombstone on the superseded base row, then the
+        generation bump — a concurrent query holds the previous
+        generation's consistent view throughout."""
+        arr, rows = self._validate_upsert(ids, rows)
+        with self._lock:
+            n0 = self.num_nodes
+            new = np.unique(arr[arr >= n0])
+            want = np.arange(n0, n0 + new.size, dtype=np.int64)
+            if new.size and not np.array_equal(np.sort(new), want):
+                raise ValueError(
+                    f"insert ids must be contiguous from {n0} (ids are "
+                    f"row indices); got new ids {sorted(new.tolist())[:8]}")
+            # last-write-wins within the batch: keep the final
+            # occurrence of each id, in id order of final writes
+            last = {}
+            for j, i in enumerate(arr.tolist()):
+                last[i] = j
+            uniq = np.fromiter(last.keys(), np.int64, len(last))
+            take = np.fromiter(last.values(), np.int64, len(last))
+            urows = rows[take]
+            need = sum(1 for i in uniq.tolist()
+                       if int(i) not in self._slot_of)
+            if need > len(self._free):
+                # segment full: fold it into the base, then retry —
+                # compaction empties every slot at or before its seq
+                self._compact_locked()
+                if need > len(self._free):
+                    raise ValueError(
+                        f"upsert batch needs {need} delta slots; "
+                        f"capacity is {self.capacity} — raise "
+                        "delta_cap or split the batch")
+            # write-through to the beyond-HBM master
+            ins = uniq >= n0
+            if ins.any():
+                order = np.argsort(uniq[ins])
+                got = self.master.append_rows(urows[ins][order])
+                assert np.array_equal(got, np.sort(uniq[ins]))
+            if (~ins).any():
+                self.master.write_back(uniq[~ins], urows[~ins])
+            inserted = int(ins.sum())
+            seq = self._next_seq
+            self._next_seq += 1
+            for i, r in zip(uniq.tolist(), urows):
+                i = int(i)
+                slot = self._slot_of.get(i)
+                if slot is None:
+                    slot = self._free.pop()
+                    self._slot_of[i] = slot
+                self._rows[slot] = r
+                self._ids[slot] = i
+                self._pen[slot] = 0.0
+                self._seq[slot] = seq
+                self._deleted.discard(i)
+                if i < self.base.num_nodes:
+                    # the frozen base row is now stale — tombstone it
+                    self._drop[i] = np.inf
+            self._gen += 1
+            self._dirty = True
+            telem.inc("serve/upserts", len(uniq))
+            telem.set_gauge("serve/segment_rows", self.segment_rows)
+            out = {"upserted": int(len(uniq)), "inserted": inserted,
+                   "generation": self._gen,
+                   "segment_rows": self.segment_rows}
+        self._maybe_compact_async()
+        return out
+
+    def delete(self, ids) -> dict:
+        """Tombstone rows; returns ``{"deleted", "generation"}``.  The
+        id stays allocated (rows are never renumbered) but can no
+        longer be queried or returned; re-upserting it later revives
+        it (delete-then-reinsert works across compactions)."""
+        arr = self._check_live_ids(ids, "ids")
+        uniq = np.unique(arr)
+        with self._lock:
+            for i in uniq.tolist():
+                i = int(i)
+                self._deleted.add(i)
+                slot = self._slot_of.pop(i, None)
+                if slot is not None:
+                    self._ids[slot] = -1
+                    self._pen[slot] = np.inf
+                    self._seq[slot] = 0
+                    self._free.append(slot)
+                if i < self.base.num_nodes:
+                    self._drop[i] = np.inf
+            self._gen += 1
+            self._dirty = True
+            telem.inc("serve/tombstones", len(uniq))
+            telem.set_gauge("serve/segment_rows", self.segment_rows)
+            return {"deleted": int(len(uniq)), "generation": self._gen}
+
+    # --- compaction -----------------------------------------------------------
+
+    def _maybe_compact_async(self):
+        if not self.auto_compact:
+            return
+        if self.segment_rows < self.compact_at * self.capacity:
+            return
+        if not self._compact_lock.acquire(blocking=False):
+            return  # one compaction at a time; the running one covers us
+        t = threading.Thread(
+            target=self._compact_bg, name="delta-compact", daemon=True)
+        t.start()
+
+    def _compact_bg(self):
+        try:
+            self._compact_inner()
+        finally:
+            self._compact_lock.release()
+
+    def compact(self) -> dict:
+        """Synchronous compaction: fold the delta into a rebuilt frozen
+        base and swap atomically.  Returns ``{"generation",
+        "fingerprint", "segment_rows"}``."""
+        with self._compact_lock:
+            return self._compact_inner()
+
+    def _compact_locked(self):
+        """Compact while already holding ``self._lock`` (the full-
+        segment upsert path).  RLock re-entry keeps the snapshot and
+        swap atomic with the caller's batch."""
+        if self._compact_lock.acquire(blocking=False):
+            try:
+                self._compact_inner()
+            finally:
+                self._compact_lock.release()
+
+    def _compact_inner(self) -> dict:
+        base = self.base
+        with self._lock:
+            # mutations hold self._lock, so this snapshot is a
+            # consistent point-in-time copy; entries written after it
+            # (seq > mark) stay in the delta
+            mark = self._next_seq - 1
+            arr = self.master.to_array()
+        index = None
+        if self._ncells:
+            # streaming hyperbolic-k-means rebuild over the merged
+            # table (host-resident capable — build_index chunks it)
+            from hyperspace_tpu.serve.index import build_index
+            snap = HostEmbedTable.from_array(arr)
+            index = build_index(snap, base.spec, self._ncells)
+        new_base = QueryEngine(
+            arr, base.spec, chunk_rows=base.chunk_rows,
+            mesh=base.mesh, mesh_axis=base.mesh_axis,
+            scan_mode=base.scan_mode, precision=base.precision,
+            index=index, nprobe=base.nprobe if index is not None else 0)
+        with self._lock:
+            self.base = new_base
+            # purge every slot the snapshot covered; keep post-mark
+            # writers (their master rows are newer than the snapshot,
+            # so their NEW base copies are stale and stay tombstoned)
+            for i, slot in list(self._slot_of.items()):
+                if self._seq[slot] <= mark:
+                    del self._slot_of[i]
+                    self._ids[slot] = -1
+                    self._pen[slot] = np.inf
+                    self._seq[slot] = 0
+                    self._free.append(slot)
+            drop = np.zeros((new_base.table.shape[0],), np.float32)
+            for i in self._deleted:
+                if i < new_base.num_nodes:
+                    drop[i] = np.inf
+            for i in self._slot_of:
+                if i < new_base.num_nodes:
+                    drop[i] = np.inf
+            self._drop = drop
+            self._gen += 1
+            self._dirty = True
+            telem.inc("serve/compactions", 1)
+            telem.set_gauge("serve/segment_rows", self.segment_rows)
+            return {"generation": self._gen,
+                    "fingerprint": new_base.fingerprint,
+                    "segment_rows": self.segment_rows}

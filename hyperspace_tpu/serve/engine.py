@@ -326,7 +326,7 @@ def _pq_lift_dist(spec: tuple, q_lift: jax.Array,
 
 def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
                n: int, exclude_self: bool, mode: str, scale=None,
-               lane: str = "dense"):
+               lane: str = "dense", drop=None):
     """Chunked top-k over ``slab`` rows → ``(dists ascending, ids int32)``,
     each ``[B, min(k, slab_rows)]`` (a shard narrower than k contributes
     everything it has; the cross-shard merge restores the full k).
@@ -347,7 +347,18 @@ def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
     carries the [m, 256, ds] codebooks, tiles decode to the LIFT space
     and score against the lifted query.  Every lane's scan arithmetic
     stays f32; only the table bytes shrink.
+
+    ``drop`` (the live-index tombstone mask, serve/delta.py) is an
+    optional ``[n_pad]`` f32 penalty row — 0 for live rows, ``+inf``
+    for deleted or delta-superseded ones — ADDED to every tile's
+    distances before the top-k, so a masked master row can never win a
+    slot whatever its geometry.  The mask is a traced operand: its
+    VALUES change per mutation generation without recompiling (the
+    compile contract's shapes stay static).  The fused kernel has no
+    mask lane, so a masked scan dispatches the two-stage path.
     """
+    if drop is not None and mode == "fused":
+        mode = "two_stage"  # the fused carry has no tombstone lane
     b = q.shape[0]
     dim = q.shape[1]
     nchunks = slab.shape[0] // chunk
@@ -416,6 +427,10 @@ def _scan_topk(slab, q, q_idx, col0, *, spec: tuple, k: int, chunk: int,
         mask = cols[None, :] >= n                         # zero-padded rows
         if exclude_self:
             mask = mask | (cols[None, :] == q_idx[:, None])
+        if drop is not None:
+            # tombstone/supersede penalty for this tile's global rows
+            d = d + jax.lax.dynamic_slice_in_dim(
+                drop, col0 + i * chunk, chunk).astype(d.dtype)[None, :]
         return jnp.where(mask, jnp.inf, d), cols
 
     if mode == "carry":
@@ -483,20 +498,26 @@ def _two_stage_core(masked_tile, *, b: int, nchunks: int, k: int, kc: int,
 
 @partial(jax.jit, static_argnames=("spec", "k", "chunk", "n", "exclude_self",
                                    "mode"))
-def _topk_chunked(table: jax.Array, q_idx: jax.Array, *, spec: tuple,
+def _topk_chunked(table: jax.Array, q_idx: jax.Array, drop=None,
+                  q_rows=None, *, spec: tuple,
                   k: int, chunk: int, n: int, exclude_self: bool,
                   mode: str = "two_stage"):
     """Single-device chunked top-k; one fixed program per
-    (batch, k, chunk, n, spec, mode)."""
-    q = table[q_idx]  # [B, D]
+    (batch, k, chunk, n, spec, mode).  ``drop``/``q_rows`` are the live
+    subsystem's traced hooks (serve/delta.py): the tombstone penalty
+    row, and explicit f32 query rows gathered from the MUTABLE master
+    (a superseded id's frozen device row must never be the query)."""
+    q = table[q_idx] if q_rows is None else q_rows        # [B, D]
     dist, idx = _scan_topk(table, q, q_idx, 0, spec=spec, k=k, chunk=chunk,
-                           n=n, exclude_self=exclude_self, mode=mode)
+                           n=n, exclude_self=exclude_self, mode=mode,
+                           drop=drop)
     return idx, dist
 
 
 @partial(jax.jit, static_argnames=("spec", "k", "chunk", "n", "exclude_self",
                                    "mode", "mesh", "axis"))
-def _topk_sharded(table: jax.Array, q_idx: jax.Array, *, spec: tuple,
+def _topk_sharded(table: jax.Array, q_idx: jax.Array, drop=None,
+                  q_rows=None, *, spec: tuple,
                   k: int, chunk: int, n: int, exclude_self: bool,
                   mode: str, mesh, axis: str):
     """Mesh-sharded top-k: per-shard chunked scan + one merge.
@@ -511,12 +532,16 @@ def _topk_sharded(table: jax.Array, q_idx: jax.Array, *, spec: tuple,
     the output is replicated.
     """
     npad = table.shape[0]
+    has_drop, has_q = drop is not None, q_rows is not None
 
-    def local(tloc, qi):
-        q = local_gather(tloc, qi, npad, axis)            # [B, D]
+    def local(tloc, qi, *extra):
+        dr = extra[0] if has_drop else None
+        q = (extra[-1] if has_q
+             else local_gather(tloc, qi, npad, axis))     # [B, D]
         lo = (jax.lax.axis_index(axis) * tloc.shape[0]).astype(jnp.int32)
         d, i = _scan_topk(tloc, q, qi, lo, spec=spec, k=k, chunk=chunk,
-                          n=n, exclude_self=exclude_self, mode=mode)
+                          n=n, exclude_self=exclude_self, mode=mode,
+                          drop=dr)
         gd = jax.lax.all_gather(d, axis)                  # [S, B, k]
         gi = jax.lax.all_gather(i, axis)
         b = qi.shape[0]
@@ -525,9 +550,13 @@ def _topk_sharded(table: jax.Array, q_idx: jax.Array, *, spec: tuple,
         top_negd, sel = jax.lax.top_k(-cat_d, k)
         return jnp.take_along_axis(cat_i, sel, axis=1), -top_negd
 
-    run = shard_map(local, mesh=mesh, in_specs=(P(axis, None), P()),
+    # the live hooks ride replicated (the drop row and query rows are
+    # B/N-scale vectors, tiny next to the sharded table)
+    extras = ([drop] if has_drop else []) + ([q_rows] if has_q else [])
+    run = shard_map(local, mesh=mesh,
+                    in_specs=(P(axis, None), P()) + (P(),) * len(extras),
                     out_specs=(P(), P()), check_vma=False)
-    return run(table, q_idx)
+    return run(table, q_idx, *extras)
 
 
 def _rescore_f32(spec: tuple, rows: jax.Array, q: jax.Array,
@@ -550,7 +579,8 @@ def _merge_rescored(d32: jax.Array, idx: jax.Array, k: int):
 @partial(jax.jit, static_argnames=("spec", "k", "k_scan", "chunk", "n",
                                    "exclude_self", "mode", "lane"))
 def _topk_chunked_mixed(table: jax.Array, scan_table: jax.Array,
-                        scan_aux, q_idx: jax.Array, *, spec: tuple,
+                        scan_aux, q_idx: jax.Array, drop=None,
+                        q_rows=None, *, spec: tuple,
                         k: int, k_scan: int, chunk: int, n: int,
                         exclude_self: bool, mode: str,
                         lane: str = "dense"):
@@ -564,15 +594,17 @@ def _topk_chunked_mixed(table: jax.Array, scan_table: jax.Array,
     full-precision manifold distances before the final top-k — so
     returned distances carry f32 accuracy and the boundary-sensitive
     math never runs in low precision on anything that reaches the
-    caller."""
-    q = table[q_idx]                                      # [B, D] f32
+    caller.  A ``drop``-masked candidate's scan distance is ``+inf``,
+    which :func:`_rescore_f32` preserves — a tombstoned row can never
+    re-enter through the rescore."""
+    q = table[q_idx] if q_rows is None else q_rows        # [B, D] f32
     # quantized scans keep f32 queries (the table is quantized, not the
     # query rows); the bf16 scan casts them to the scan dtype
     q_scan = q.astype(scan_table.dtype) if lane == "dense" else q
     sd, sidx = _scan_topk(scan_table, q_scan, q_idx, 0, spec=spec,
                           k=k_scan, chunk=chunk, n=n,
                           exclude_self=exclude_self, mode=mode,
-                          scale=scan_aux, lane=lane)
+                          scale=scan_aux, lane=lane, drop=drop)
     rows = table[jnp.maximum(sidx, 0)]                    # [B, K, D] f32
     d32 = _rescore_f32(spec, rows, q, sidx, sd)
     return _merge_rescored(d32, sidx, k)
@@ -582,7 +614,8 @@ def _topk_chunked_mixed(table: jax.Array, scan_table: jax.Array,
                                    "exclude_self", "mode", "mesh", "axis",
                                    "lane"))
 def _topk_sharded_mixed(table: jax.Array, scan_table: jax.Array,
-                        scan_aux, q_idx: jax.Array, *, spec: tuple,
+                        scan_aux, q_idx: jax.Array, drop=None,
+                        q_rows=None, *, spec: tuple,
                         k: int, k_scan: int, chunk: int, n: int,
                         exclude_self: bool, mode: str, mesh, axis: str,
                         lane: str = "dense"):
@@ -595,15 +628,18 @@ def _topk_sharded_mixed(table: jax.Array, scan_table: jax.Array,
     f32 shards by the same psum gather the query rows use) before the
     final top-k."""
     npad = table.shape[0]
+    has_drop, has_q = drop is not None, q_rows is not None
 
-    def local_body(tloc, sloc, scl, qi):
-        q = local_gather(tloc, qi, npad, axis)            # [B, D] f32
+    def local_body(tloc, sloc, scl, qi, *extra):
+        dr = extra[0] if has_drop else None
+        q = (extra[-1] if has_q
+             else local_gather(tloc, qi, npad, axis))     # [B, D] f32
         lo = (jax.lax.axis_index(axis) * tloc.shape[0]).astype(jnp.int32)
         qs = q.astype(sloc.dtype) if lane == "dense" else q
         d, i = _scan_topk(sloc, qs, qi, lo, spec=spec,
                           k=k_scan, chunk=chunk, n=n,
                           exclude_self=exclude_self, mode=mode, scale=scl,
-                          lane=lane)
+                          lane=lane, drop=dr)
         gd = jax.lax.all_gather(d, axis)                  # [S, B, <=k_scan]
         gi = jax.lax.all_gather(i, axis)
         b = qi.shape[0]
@@ -618,20 +654,24 @@ def _topk_sharded_mixed(table: jax.Array, scan_table: jax.Array,
         idx, dist = _merge_rescored(d32, sidx, k)
         return idx, dist
 
+    # the live hooks ride replicated, like the query ids
+    extras = ([drop] if has_drop else []) + ([q_rows] if has_q else [])
+    especs = (P(),) * len(extras)
     if scan_aux is None:
-        run = shard_map(lambda t, s, qi: local_body(t, s, None, qi),
-                        mesh=mesh,
-                        in_specs=(P(axis, None), P(axis, None), P()),
-                        out_specs=(P(), P()), check_vma=False)
-        return run(table, scan_table, q_idx)
+        run = shard_map(
+            lambda t, s, qi, *ex: local_body(t, s, None, qi, *ex),
+            mesh=mesh,
+            in_specs=(P(axis, None), P(axis, None), P()) + especs,
+            out_specs=(P(), P()), check_vma=False)
+        return run(table, scan_table, q_idx, *extras)
     # the aux rides row-sharded beside the code table (per-row scales)
     # — except PQ codebooks, which every shard needs whole
     aux_spec = P() if lane == "pq" else P(axis, None)
     run = shard_map(local_body, mesh=mesh,
                     in_specs=(P(axis, None), P(axis, None),
-                              aux_spec, P()),
+                              aux_spec, P()) + especs,
                     out_specs=(P(), P()), check_vma=False)
-    return run(table, scan_table, scan_aux, q_idx)
+    return run(table, scan_table, scan_aux, q_idx, *extras)
 
 
 def _cand_dist(spec: tuple, q: jax.Array, rows: jax.Array) -> jax.Array:
@@ -673,7 +713,7 @@ def _cand_dist(spec: tuple, q: jax.Array, rows: jax.Array) -> jax.Array:
 def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
                     q_idx: jax.Array, *, spec: tuple, k: int, chunk: int,
                     exclude_self: bool, mode: str = "two_stage",
-                    scale=None, lane: str = "dense"):
+                    scale=None, lane: str = "dense", drop=None):
     """Chunked top-k over per-query candidate ids — the IVF in-cell
     scorer.  The two-stage machinery of :func:`_scan_topk` (per-chunk
     ``lax.top_k`` over the tile only, one post-scan merge, the running
@@ -695,8 +735,9 @@ def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
         q_lift = _lift(spec, q).astype(jnp.float32)
 
     # the packed lanes have no fused candidate variant (the per-query
-    # gather dominates; unpack/decode rides the two-stage scorer)
-    if mode == "fused" and lane in ("dense", "int8"):
+    # gather dominates; unpack/decode rides the two-stage scorer); a
+    # tombstone-masked scan likewise rides the two-stage scorer
+    if mode == "fused" and lane in ("dense", "int8") and drop is None:
         from hyperspace_tpu.kernels import scan_topk as fused_kernel
 
         if fused_kernel.supports_cand(spec, k=k, dim=scan_table.shape[1],
@@ -726,6 +767,9 @@ def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
         mask = ids < 0
         if exclude_self:
             mask = mask | (ids == q_idx[:, None])
+        if drop is not None:
+            # tombstone/supersede penalty, gathered per candidate id
+            d = d + drop[safe].astype(d.dtype)
         return jnp.where(mask, jnp.inf, d), ids
 
     return _two_stage_core(masked_tile, b=b, nchunks=nchunks, k=k,
@@ -739,7 +783,8 @@ def _scan_topk_cand(scan_table: jax.Array, q: jax.Array, cand: jax.Array,
                                    "exclude_self", "mixed", "mode", "lane"))
 def _topk_ivf(table: jax.Array, scan_table: jax.Array,
               centroids: jax.Array,
-              cells: jax.Array, q_idx: jax.Array, *, spec: tuple, k: int,
+              cells: jax.Array, q_idx: jax.Array, drop=None, q_rows=None,
+              *, spec: tuple, k: int,
               k_scan: int, nprobe: int, chunk: int, exclude_self: bool,
               mixed: bool, mode: str = "two_stage", scan_scale=None,
               lane: str = "dense"):
@@ -758,7 +803,7 @@ def _topk_ivf(table: jax.Array, scan_table: jax.Array,
     the engine wrapper (:meth:`QueryEngine._probe_topk`) turns those
     into a loud ValueError, never a served answer.
     """
-    q = table[q_idx]                                      # [B, D] f32
+    q = table[q_idx] if q_rows is None else q_rows        # [B, D] f32
     dc = _tile_dist(spec, q, centroids)                   # [B, ncells]
     _, cell_sel = jax.lax.top_k(-dc, nprobe)              # [B, nprobe]
     cand = cells[cell_sel].reshape(q_idx.shape[0], -1)    # [B, nprobe*mc]
@@ -770,7 +815,7 @@ def _topk_ivf(table: jax.Array, scan_table: jax.Array,
     sd, sidx = _scan_topk_cand(scan_table, qs, cand, q_idx, spec=spec,
                                k=(k_scan if mixed else k), chunk=chunk,
                                exclude_self=exclude_self, mode=mode,
-                               scale=scan_scale, lane=lane)
+                               scale=scan_scale, lane=lane, drop=drop)
     if not mixed:
         return sidx, sd
     rows = table[jnp.maximum(sidx, 0)]                    # [B, K, D] f32
@@ -794,6 +839,19 @@ def _edge_dist(table: jax.Array, u_idx: jax.Array, v_idx: jax.Array,
         # Fermi–Dirac decoder INSIDE the jitted program: one dispatch
         # per scoring request, not one per arithmetic op (fd_r/fd_t are
         # traced scalars — changing them never recompiles)
+        d = _fermi_dirac(d, fd_r, fd_t)
+    return d
+
+
+@partial(jax.jit, static_argnames=("spec", "prob"))
+def _edge_dist_rows(xu: jax.Array, xv: jax.Array, fd_r, fd_t, *,
+                    spec: tuple, prob: bool) -> jax.Array:
+    """Edge scoring over explicit endpoint rows (the live-index path:
+    serve/delta.py gathers FRESH rows from the mutable master instead of
+    the frozen device table, so post-upsert scores are current)."""
+    m = manifold_from_spec(spec)
+    d = m.dist(xu, xv)
+    if prob:
         d = _fermi_dirac(d, fd_r, fd_t)
     return d
 
@@ -1195,7 +1253,8 @@ class QueryEngine:
     # --- queries --------------------------------------------------------------
 
     def topk_neighbors(self, q_idx, k: int, *, exclude_self: bool = True,
-                       nprobe: int | None = None):
+                       nprobe: int | None = None, q_rows=None, drop=None,
+                       allow_underfill: bool = False):
         """``(neighbors [B, k] int32, dists [B, k])`` for query row ids.
 
         Results are sorted ascending by distance.  ``k`` must leave room
@@ -1212,8 +1271,42 @@ class QueryEngine:
         carries the effective width so they never mix with full-width
         rows.  Exact engines reject an override — a silent ignore would
         misreport the quality served.
+
+        ``q_rows`` / ``drop`` / ``allow_underfill`` are the live-index
+        hooks (serve/delta.py).  ``q_rows`` ([B, D] f32) supplies the
+        query vectors explicitly — fresh post-upsert rows from the
+        mutable master — instead of gathering the (possibly stale)
+        frozen device rows by id; ids are then used only for the
+        exclude-self mask and may exceed this engine's row range.
+        ``drop`` ([npad] f32, 0 = live / +inf = tombstoned) is a TRACED
+        penalty row added to every scan tile before top-k so a deleted
+        or superseded master row can never win — values change per
+        mutation generation without recompiling.  ``allow_underfill``
+        lets a probing engine return +inf filler rows instead of
+        raising, so the caller's merge with a delta segment can repair
+        them (and raise only if the MERGED top-k is still under-filled).
         """
-        q_idx = self._check_ids(q_idx, "q_idx")
+        if q_rows is None:
+            q_idx = self._check_ids(q_idx, "q_idx")
+        else:
+            arr = np.asarray(q_idx)
+            if arr.ndim != 1 or arr.size == 0:
+                raise ValueError("q_idx must be a non-empty 1-D id array")
+            if not np.issubdtype(arr.dtype, np.integer):
+                raise ValueError(
+                    f"q_idx must be integer ids; got {arr.dtype}")
+            q_rows = jnp.asarray(q_rows, self.table.dtype)
+            if q_rows.ndim != 2 or q_rows.shape[0] != arr.size:
+                raise ValueError(
+                    f"q_rows {q_rows.shape} must be [B, D] aligned with "
+                    f"q_idx (B={arr.size})")
+            q_idx = jnp.asarray(arr, jnp.int32)
+        if drop is not None:
+            drop = jnp.asarray(drop, self.table.dtype)
+            if drop.shape != (self.table.shape[0],):
+                raise ValueError(
+                    f"drop mask shape {drop.shape} must match the padded "
+                    f"table rows ({self.table.shape[0]},)")
         k = int(k)
         limit = self.num_nodes - (1 if exclude_self else 0)
         if not 1 <= k <= limit:
@@ -1234,7 +1327,9 @@ class QueryEngine:
                          metric="serve/stage/device_compute_ms"):
             if self._ivf:
                 out = self._probe_topk(q_idx, k, exclude_self=exclude_self,
-                                       nprobe=nprobe)
+                                       nprobe=nprobe, drop=drop,
+                                       q_rows=q_rows,
+                                       allow_underfill=allow_underfill)
             elif self._policy.mixed or self._quant:
                 # over-fetch margin: the low-precision scan keeps k_scan
                 # candidates so the f32 rescore can repair k-th-boundary
@@ -1243,6 +1338,7 @@ class QueryEngine:
                 if self.shards > 1:
                     out = _topk_sharded_mixed(
                         self.table, self.scan_table, self._scan_aux, q_idx,
+                        drop, q_rows,
                         spec=self.spec, k=k, k_scan=k_scan,
                         chunk=self.chunk_rows,
                         n=self.num_nodes, exclude_self=exclude_self,
@@ -1251,6 +1347,7 @@ class QueryEngine:
                 else:
                     out = _topk_chunked_mixed(
                         self.table, self.scan_table, self._scan_aux, q_idx,
+                        drop, q_rows,
                         spec=self.spec, k=k,
                         k_scan=k_scan, chunk=self.chunk_rows,
                         n=self.num_nodes,
@@ -1258,13 +1355,13 @@ class QueryEngine:
                         lane=self._lane)
             elif self.shards > 1:
                 out = _topk_sharded(
-                    self.table, q_idx, spec=self.spec, k=k,
+                    self.table, q_idx, drop, q_rows, spec=self.spec, k=k,
                     chunk=self.chunk_rows, n=self.num_nodes,
                     exclude_self=exclude_self, mode=self._scan_mode_eff,
                     mesh=self.mesh, axis=self.mesh_axis)
             else:
                 out = _topk_chunked(
-                    self.table, q_idx, spec=self.spec, k=k,
+                    self.table, q_idx, drop, q_rows, spec=self.spec, k=k,
                     chunk=self.chunk_rows,
                     n=self.num_nodes, exclude_self=exclude_self,
                     mode=self._scan_mode_eff)
@@ -1273,7 +1370,8 @@ class QueryEngine:
         return out
 
     def _probe_topk(self, q_idx: jax.Array, k: int, *, exclude_self: bool,
-                    nprobe: int | None = None):
+                    nprobe: int | None = None, drop=None, q_rows=None,
+                    allow_underfill: bool = False):
         """The probing path: validate capacity, dispatch
         :func:`_topk_ivf`, record the probe telemetry
         (``serve/index_probe_ms``: host wall-clock around the dispatch —
@@ -1300,7 +1398,8 @@ class QueryEngine:
         idx, dist = _topk_ivf(
             self.table, self.scan_table,
             self._centroids, self._cells,
-            q_idx, spec=self.spec, k=k, k_scan=k_scan, nprobe=p,
+            q_idx, drop, q_rows, spec=self.spec, k=k, k_scan=k_scan,
+            nprobe=p,
             chunk=self._cand_chunk, exclude_self=exclude_self,
             mixed=self._policy.mixed or self._quant,
             mode=self._scan_mode_eff, scan_scale=self._scan_aux,
@@ -1316,7 +1415,8 @@ class QueryEngine:
         # +inf).  Fail loudly like the capacity check (a scalar fetch;
         # callers fetch these results next anyway, and the serve loop
         # isolates it per request)
-        if bool(jax.device_get(jnp.any(jnp.isinf(dist)))):
+        if not allow_underfill and \
+                bool(jax.device_get(jnp.any(jnp.isinf(dist)))):
             raise ValueError(
                 f"IVF probe under-filled: some query's {p} "
                 f"nearest cell(s) hold fewer than k={k} reachable rows "

@@ -1,0 +1,160 @@
+"""Blue-green rollover: warm a standby engine, gate, flip, drain.
+
+The zero-downtime half of the live-index subsystem (serve/delta.py is
+the in-place half; docs/serving.md "Live index and rollover").  A
+rollover replaces the WHOLE serving stack behind the front door — new
+artifact, new engine, new batcher, new collator — without dropping or
+slowing a single in-flight request:
+
+1. **Prepare (blocking, off-loop).**  Build the standby engine +
+   batcher from the target artifact and run the full
+   :meth:`RequestBatcher.prewarm` ladder — every bucket × k ×
+   exclude_self × degradation width is compiled BEFORE the standby can
+   take traffic, so the first post-flip request lands on a warm
+   executable (``recompiles_steady == 0`` across the flip is the
+   ``bench_live_index`` acceptance gate).
+2. **Gate.**  The flip is refused unless the standby's enriched
+   health body — the same shape ``GET /healthz`` serves: ``ok`` /
+   ``fingerprint`` / ``scan_signature`` / ``precision`` /
+   ``degrade_level`` — is green: present, ok, and undegraded
+   (:func:`gate_flip`).  A standby that would answer with a different
+   precision lane than requested, or come up already shedding, must
+   never take traffic silently.
+3. **Flip (atomic, on-loop).**  The front door's ``batcher`` /
+   ``collator`` attributes are reassigned in one event-loop step — a
+   request routed before the step uses the old stack end-to-end, one
+   routed after uses the new; there is no torn state to observe.  The
+   batcher caches are keyed by fingerprint + scan signature, so the
+   old engine's cached rows are unreachable by construction.
+4. **Drain the old stack.**  Pending old-collator buckets are force-
+   flushed (their requests answer from the OLD engine — consistent
+   with the prefix they were admitted under) and its dispatch executor
+   is released without blocking the loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Optional, Sequence
+
+from hyperspace_tpu.serve.batcher import RequestBatcher
+from hyperspace_tpu.serve.collator import Collator
+from hyperspace_tpu.telemetry import registry as telem
+
+# the enriched-healthz fields a flip inspects; all must be present —
+# a builder handing back a batcher that cannot report one of these is
+# a batcher whose identity the cache key cannot express
+GATE_FIELDS = ("ok", "fingerprint", "scan_signature", "precision",
+               "degrade_level")
+
+DEFAULT_PREWARM_KS = (10,)
+
+
+def standby_health(batcher: RequestBatcher) -> dict:
+    """The enriched health body of a NOT-yet-serving batcher — the
+    same identity fields ``GET /healthz`` exposes, minus the uptime
+    (it has none): what :func:`gate_flip` inspects."""
+    eng = batcher.engine
+    return {
+        "ok": True,
+        "fingerprint": eng.fingerprint,
+        "scan_signature": list(eng.scan_signature),
+        "precision": eng.precision,
+        "degrade_level": batcher.degrade_level,
+    }
+
+
+def gate_flip(body: dict) -> None:
+    """Refuse a flip unless the standby's health body is green:
+    every :data:`GATE_FIELDS` entry present, ``ok`` true, and
+    ``degrade_level == 0`` (a standby that comes up already degraded
+    would silently downgrade every post-flip answer)."""
+    missing = [f for f in GATE_FIELDS if body.get(f) is None]
+    if missing:
+        raise ValueError(
+            f"rollover gate: standby health body is missing {missing} "
+            "— refusing to flip onto an engine whose identity the "
+            "cache key cannot express")
+    if body["ok"] is not True:
+        raise ValueError("rollover gate: standby reports ok=false")
+    if int(body["degrade_level"]) != 0:
+        raise ValueError(
+            f"rollover gate: standby is degraded "
+            f"(level {body['degrade_level']}) — it must come up at "
+            "full quality before taking traffic")
+
+
+class RolloverCoordinator:
+    """Drives blue-green flips for one :class:`~hyperspace_tpu.serve.
+    server.HttpFrontDoor`.
+
+    ``builder(target)`` constructs the standby ``RequestBatcher`` for a
+    rollover target (the CLI passes its artifact loader; tests pass a
+    closure).  It runs on the default executor — it is expected to
+    block (artifact IO, device upload, prewarm compilation)."""
+
+    def __init__(self, door, builder: Callable[[str], RequestBatcher], *,
+                 prewarm_ks: Optional[Sequence[int]] = None):
+        self.door = door
+        self.builder = builder
+        self.prewarm_ks = list(prewarm_ks or DEFAULT_PREWARM_KS)
+        self.flips = 0
+        self._busy = False  # one rollover at a time (loop-affine flag)
+
+    def _prepare(self, target: str) -> tuple[RequestBatcher, dict]:
+        """Blocking half: build + prewarm the standby, return it with
+        its prewarm report.  Runs off-loop."""
+        standby = self.builder(target)
+        info = standby.prewarm(self.prewarm_ks)
+        return standby, info
+
+    async def rollover(self, target: str) -> dict:
+        """Prepare → gate → flip → drain; returns the flip report.
+        Raises ``ValueError`` when the gate refuses (the old stack
+        keeps serving, untouched)."""
+        if self._busy:
+            raise ValueError(
+                "rollover already in progress — one at a time (the "
+                "standby build owns the device build bandwidth)")
+        self._busy = True
+        try:
+            t0 = time.perf_counter()
+            loop = asyncio.get_running_loop()
+            old = self.door.batcher
+            standby, info = await loop.run_in_executor(
+                None, self._prepare, target)
+            health = standby_health(standby)
+            gate_flip(health)
+            self.flip(standby)
+            self.flips += 1
+            telem.inc("serve/rollover_flips", 1)
+            return {
+                "flipped": True,
+                "old_fingerprint": old.engine.fingerprint,
+                "new_fingerprint": standby.engine.fingerprint,
+                "scan_signature": health["scan_signature"],
+                "prewarmed_programs": info["programs"],
+                "seconds": round(time.perf_counter() - t0, 3),
+            }
+        finally:
+            self._busy = False
+
+    def flip(self, standby: RequestBatcher) -> None:
+        """The atomic swap: one event-loop step reassigns the door's
+        batcher + collator, then drains the old stack.  Also usable
+        directly (tests, in-process benches) with a pre-built warmed
+        standby."""
+        door = self.door
+        old_collator = door.collator
+        new_collator = Collator(
+            standby, max_wait_us=old_collator.max_wait_s * 1e6)
+        # the swap itself: two attribute writes in one loop step — a
+        # routed request observes either (old, old) or (new, new)
+        door.batcher = standby
+        door.collator = new_collator
+        # old stack drains: queued buckets answer from the OLD engine
+        # (consistent with the prefix they were admitted under), then
+        # the executor is released without blocking the loop
+        old_collator.flush_all()
+        old_collator.close(wait=False)

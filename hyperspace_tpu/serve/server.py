@@ -16,6 +16,16 @@ route                 body / answer
                       "dists": [[...]]}``
 ``POST /v1/score``    ``{"u": [...], "v": [...], "prob"?: bool, "fd_r"?,
                       "fd_t"?, "deadline_ms"?}`` → ``{"scores": [...]}``
+``POST /v1/upsert``   ``{"ids": [...], "rows": [[...]], "deadline_ms"?}``
+                      → ``{"upserted", "inserted", "generation",
+                      "segment_rows"}`` (live engines —
+                      serve/delta.py; frozen engines answer 400)
+``POST /v1/delete``   ``{"ids": [...], "deadline_ms"?}`` →
+                      ``{"deleted", "generation"}``
+``POST /admin/rollover``  ``{"target": "<artifact path>"}`` → the flip
+                      report (serve/rollover.py) — 400 when no
+                      rollover coordinator is armed or the gate
+                      refuses; the old stack keeps serving either way
 ``GET|POST /v1/stats``  ``batcher.stats()`` + a ``server`` block
                       (served/inflight/draining) + ``recompiles`` +
                       the windowed SLO block when a window is armed
@@ -185,6 +195,10 @@ class HttpFrontDoor:
         self.batcher = batcher
         self.collator = collator or Collator(batcher,
                                              max_wait_us=max_wait_us)
+        # blue-green flips (serve/rollover.py): armed by the CLI /
+        # embedder AFTER construction (the coordinator needs the door);
+        # None = /admin/rollover answers 400
+        self.rollover: Optional[object] = None
         self.host = host
         self.port = int(port)
         self.served = 0          # responses written (errors included)
@@ -425,7 +439,8 @@ class HttpFrontDoor:
                                        "message":
                                        "/v1/stats wants GET or POST"}}
             return 200, self._stats()
-        if target not in ("/v1/topk", "/v1/score"):
+        if target not in ("/v1/topk", "/v1/score", "/v1/upsert",
+                          "/v1/delete", "/admin/rollover"):
             self._serve_access(req, "none", "validation")
             return 404, {"error": {"kind": "validation",
                                    "message": f"no route {target!r}"}}
@@ -461,7 +476,7 @@ class HttpFrontDoor:
                         request_id=req.request_id)
                     resp = {"neighbors": idx.tolist(),
                             "dists": dist.tolist()}
-            else:
+            elif target == "/v1/score":
                 prob = _json_bool(body, "prob", False)
                 fd_r = _req_number(body, "fd_r", 2.0)
                 fd_t = _req_number(body, "fd_t", 1.0)
@@ -474,6 +489,37 @@ class HttpFrontDoor:
                         deadline_ms=deadline_ms, t_enq=req.t_in,
                         request_id=req.request_id)
                     resp = {"scores": scores.tolist()}
+            elif target == "/v1/upsert":
+                deadline_ms = _req_deadline(body)
+                entered = True
+                with spans.request(route, req.request_id):
+                    resp = await self.collator.upsert(
+                        body.get("ids"), body.get("rows"),
+                        deadline_ms=deadline_ms, t_enq=req.t_in,
+                        request_id=req.request_id)
+            elif target == "/v1/delete":
+                deadline_ms = _req_deadline(body)
+                entered = True
+                with spans.request(route, req.request_id):
+                    resp = await self.collator.delete(
+                        body.get("ids"),
+                        deadline_ms=deadline_ms, t_enq=req.t_in,
+                        request_id=req.request_id)
+            else:  # /admin/rollover
+                if self.rollover is None:
+                    raise ValueError(
+                        "no rollover coordinator armed on this server "
+                        "(serve-http arms one when it can rebuild from "
+                        "an artifact)")
+                dest = body.get("target")
+                if not isinstance(dest, str) or not dest:
+                    raise ValueError(
+                        "rollover needs \"target\": a non-empty "
+                        "artifact path string")
+                # prepare runs off-loop inside the coordinator; the
+                # flip lands in one loop step — in-flight requests on
+                # the old stack answer from the old engine
+                resp = await self.rollover.rollover(dest)
         except (ServeError, ValueError, KeyError, TypeError,
                 OverflowError, OSError) as e:
             # the stdin loop's per-line error classes, mapped onto
@@ -504,6 +550,9 @@ class HttpFrontDoor:
             "scan_signature": list(eng.scan_signature),
             "precision": eng.precision,
             "degrade_level": self.batcher.degrade_level,
+            # live engines only (serve/delta.py): the segment
+            # generation a zero-staleness client can pin; None = frozen
+            "generation": getattr(eng, "generation", None),
         }
 
     def _stats(self) -> dict:
@@ -559,7 +608,8 @@ def latency_summary_line(baseline: Optional[dict] = None) -> str:
 
 async def run_front_door(batcher: RequestBatcher, *, host: str, port: int,
                          max_wait_us: float,
-                         ready=None, prewarm_ks=None) -> dict:
+                         ready=None, prewarm_ks=None,
+                         rollover_builder=None) -> dict:
     """Start, announce, serve until drained (SIGTERM), summarize.
 
     ``ready(host, port)`` is called once the listener is bound (the CLI
@@ -569,9 +619,18 @@ async def run_front_door(batcher: RequestBatcher, *, host: str, port: int,
     :meth:`RequestBatcher.prewarm`, docs/serving.md "Warm starts" — so
     the first request a client can possibly land on any bucket is warm
     (and ``/healthz`` cannot answer ok while the ladder is still cold).
+    ``rollover_builder(target)`` (a blocking callable returning a
+    standby :class:`RequestBatcher`) arms ``POST /admin/rollover``
+    (serve/rollover.py) — the standby is prewarmed over the same
+    ``prewarm_ks`` before the gate-checked flip.
     Returns the closing stats dict."""
     door = HttpFrontDoor(batcher, host=host, port=port,
                          max_wait_us=max_wait_us)
+    if rollover_builder is not None:
+        from hyperspace_tpu.serve.rollover import RolloverCoordinator
+
+        door.rollover = RolloverCoordinator(
+            door, rollover_builder, prewarm_ks=prewarm_ks or None)
     session_mark = telem.default_registry().mark()
     if prewarm_ks:
         # deliberately blocking: nothing is listening yet, and a warm

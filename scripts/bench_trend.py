@@ -67,8 +67,12 @@ _LOWER_TOKENS = ("time", "stall", "waste", "recompile", "epoch_s",
 # never an improvement (latency itself — http_p99_ms and every
 # latency_ms leaf — is already lower-better via the _ms suffix);
 # "overhead" likewise (the r16 observability overhead_ratio is a cost
-# fraction — a bigger ratio is a slower instrumented server)
-_LOWER_PRIORITY_TOKENS = ("waste", "shed", "deadline", "overhead")
+# fraction — a bigger ratio is a slower instrumented server); the r18
+# live-index freshness/staleness family is a cost too — time-to-visible
+# (``upsert_visible_ms``), stale answers served (``stale_results``) —
+# growing fresher-slower or staler is never an improvement
+_LOWER_PRIORITY_TOKENS = ("waste", "shed", "deadline", "overhead",
+                          "fresh", "stale", "visible")
 # size tokens, matched per dotted-path SEGMENT (word-boundary style: the
 # segment is the token, or carries it as a ``_``-separated word) so the
 # r15 big-table leg's capacity metrics — ``table_mb.int8``,
@@ -114,6 +118,12 @@ def direction(key: str) -> Optional[str]:
     k = key.lower()
     if k.rsplit(".", 1)[-1] in _NEUTRAL_LEAVES:
         return None
+    if "during_rollover" in k:
+        # a ``*_during_rollover`` reading inherits its base metric's
+        # direction (r18 live-index leg): ``p99_during_rollover_ms``
+        # is still a latency, a ``qps_during_rollover`` would still be
+        # a throughput — the window qualifier carries no direction
+        return direction(re.sub(r"_?during_rollover", "", k))
     if any(t in k for t in _LOWER_PRIORITY_TOKENS):
         return "lower"
     if any(t in k for t in _HIGHER_TOKENS):

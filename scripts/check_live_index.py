@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Smoke lint: the live mutable index over the wire, as a subprocess.
+
+export → ``serve-http`` with ``live=1`` on an ephemeral port → healthz
+reports a generation → upsert a new row through the socket → an
+immediate query BY THE NEW ID sees it (and ranks its planted anchor
+top-1) → delete it → the tombstone is refused as a query anchor and
+never returned as a neighbor → the generation advanced once per
+mutation → SIGTERM drain exits 0 with the drain notice.  Asserted
+(exit 1 on any miss):
+
+- ``/healthz`` carries ``generation`` (a live engine identity, not the
+  frozen ``null``) and folds it into ``scan_signature``;
+- ``POST /v1/upsert`` answers ``{"inserted": 1}`` and the row is
+  queryable the moment the response lands (write-through visibility —
+  docs/serving.md "Live index and rollover");
+- ``POST /v1/delete`` tombstones it: querying the deleted id answers a
+  typed 400 validation error, and the anchor's top-k no longer
+  contains it;
+- recompiles stay FLAT across the mutations (the delta scan and the
+  tombstone mask are traced operands, never fresh executables);
+- SIGTERM drains rc=0 — mutations never break the drain contract.
+
+Run by ``tests/serve/test_check_live_script.py`` inside the suite,
+mirroring ``check_serve_http.py``, so a live-index regression fails
+the build.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable as a plain script from anywhere (the package is not installed)
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from scripts.check_serve_http import (  # noqa: E402
+    _StderrPump,
+    _get,
+    _post,
+    _wait_for_port,
+)
+
+N, D, C = 101, 8, 1.2
+K = 5
+
+
+def build_table():
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.manifolds import PoincareBall
+
+    v = 0.5 * jax.random.normal(jax.random.PRNGKey(11), (N, D), jnp.float32)
+    return PoincareBall(C).expmap0(v)
+
+
+def main(out_dir: str | None = None) -> int:
+    import numpy as np
+
+    from hyperspace_tpu.serve import export_artifact
+
+    table = np.asarray(build_table())
+    spec = ("poincare", C)
+    tmp = None
+    if out_dir is None:
+        tmp = tempfile.TemporaryDirectory()
+        out_dir = os.path.join(tmp.name, "artifact")
+    proc = None
+    try:
+        export_artifact(out_dir, table, spec, model_config={"c": C},
+                        overwrite=True)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "hyperspace_tpu.cli.serve",
+             "serve-http", f"artifact={out_dir}", "port=0",
+             "host=127.0.0.1", "max_wait_us=1000", "telemetry=1",
+             "prewarm=1", f"k={K}", "live=1", "delta_cap=32"],
+            cwd=ROOT, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        pump = _StderrPump(proc)
+        host, port = _wait_for_port(proc, pump)
+
+        status, health = _get(host, port, "/healthz")
+        if status != 200 or health.get("ok") is not True:
+            print(f"HEALTHZ BROKEN: {status} {health}")
+            return 1
+        if health.get("generation") != 0:
+            print(f"LIVE ENGINE NOT ARMED: live=1 but /healthz "
+                  f"generation is {health.get('generation')!r}")
+            return 1
+        if "gen" not in health.get("scan_signature", []):
+            print(f"SCAN SIGNATURE does not fold the generation: "
+                  f"{health.get('scan_signature')}")
+            return 1
+
+        status, stats0 = _post(host, port, "/v1/stats", {})
+        if status != 200:
+            print(f"STATS FAILED: {status} {stats0}")
+            return 1
+
+        # upsert one new row, a near-duplicate of a known anchor: the
+        # response landing means the write is applied (synchronous
+        # write-through), so the very next query must see it
+        anchor, new_id = 17, N
+        vec = (table[anchor]
+               + 1e-4 * np.random.default_rng(0).standard_normal(D))
+        status, r = _post(host, port, "/v1/upsert",
+                          {"ids": [new_id], "rows": [vec.tolist()]})
+        if status != 200 or r.get("inserted") != 1:
+            print(f"UPSERT FAILED: {status} {r}")
+            return 1
+        status, q = _post(host, port, "/v1/topk",
+                          {"ids": [new_id], "k": K})
+        if status != 200:
+            print(f"QUERY BY THE NEW ID FAILED: {status} {q}")
+            return 1
+        if q["neighbors"][0][0] != anchor:
+            print(f"UPSERT NOT VISIBLE: the new row's top-1 should be "
+                  f"its anchor {anchor}; got {q['neighbors'][0]}")
+            return 1
+
+        status, r = _post(host, port, "/v1/delete", {"ids": [new_id]})
+        if status != 200 or r.get("deleted") != 1:
+            print(f"DELETE FAILED: {status} {r}")
+            return 1
+        status, r = _post(host, port, "/v1/topk",
+                          {"ids": [new_id], "k": K})
+        if status != 400 or r["error"]["kind"] != "validation":
+            print(f"TOMBSTONE STILL QUERYABLE: {status} {r}")
+            return 1
+        status, q = _post(host, port, "/v1/topk",
+                          {"ids": [anchor], "k": K})
+        if status != 200 or new_id in q["neighbors"][0]:
+            print(f"TOMBSTONE RETURNED AS NEIGHBOR: {status} "
+                  f"{q.get('neighbors')}")
+            return 1
+
+        status, health2 = _get(host, port, "/healthz")
+        if status != 200 or health2.get("generation") != 2:
+            print(f"GENERATION DID NOT ADVANCE once per mutation: "
+                  f"{health2.get('generation')!r} (want 2)")
+            return 1
+        status, stats1 = _post(host, port, "/v1/stats", {})
+        if status != 200 or stats1["recompiles"] != stats0["recompiles"]:
+            print(f"RECOMPILES NOT FLAT across mutations: "
+                  f"{stats0.get('recompiles')} -> "
+                  f"{stats1.get('recompiles')}")
+            return 1
+
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            print("DRAIN HUNG: SIGTERM did not stop the server in 60 s")
+            return 1
+        err = pump.text()
+        if proc.returncode != 0:
+            print(f"DRAIN EXIT CODE {proc.returncode}; stderr:\n{err}")
+            return 1
+        if "drained" not in err:
+            print(f"DRAIN NOTICE missing; stderr:\n{err}")
+            return 1
+        print(f"live index round trip OK: upsert visible, tombstone "
+              f"refused, generation {health2['generation']}, recompiles "
+              f"flat at {stats1['recompiles']}, drained clean")
+        return 0
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        if tmp is not None:
+            tmp.cleanup()
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))

@@ -15,7 +15,11 @@ artifacts ship the sub-int8 quant payloads (int4 packed nibbles, PQ
 codes + codebooks — serve/quant.py): each must reproduce its payload
 and artifact fingerprints, rank queries exactly like the live f32
 engine through the over-fetch + f32-rescore contract, and REJECT a
-tampered codebook/scale byte at load.  Run by
+tampered codebook/scale byte at load.  A fourth leg exercises the
+MUTABLE round trip (serve/delta.py): load → upsert + delete → compact,
+recall vs a rebuilt-from-scratch oracle, plus the cache-isolation
+proof — a result cached before a mutation must be unreachable after
+it, and the pre-compaction fingerprint must no longer answer.  Run by
 ``tests/serve/test_check_script.py`` inside the suite, mirroring the
 telemetry-catalog lint, so a serialization regression fails the build.
 """
@@ -181,6 +185,131 @@ def _check_quant_round_trip(table, spec, out_dir: str, live) -> int:
     return 0
 
 
+def _check_mutable_round_trip(table, spec, out_dir: str) -> int:
+    """Export → load → live mutations → compact → oracle agreement.
+
+    Loads the exported artifact into a :class:`LiveQueryEngine`
+    (serve/delta.py), applies upserts (new contiguous rows near known
+    anchors + in-place updates) and deletes, compacts, and verifies:
+    (a) recall@k against an oracle engine REBUILT FROM SCRATCH over the
+    final master table (deleted ids host-filtered from an overfetched
+    frozen top-k) is exact, before and after compaction; (b) the
+    pre-mutation result cache can no longer answer — the generation-
+    folded scan signature keys every mutation into a fresh cache row,
+    so a batcher primed before the upsert MUST miss after it
+    (cache-isolation proof), and the pre-compaction fingerprint is gone
+    from the engine's identity after the swap; (c) tombstoned ids are
+    rejected as query anchors and never returned as neighbors.
+    """
+    import numpy as np
+
+    from hyperspace_tpu.parallel.host_table import HostEmbedTable
+    from hyperspace_tpu.serve import (LiveQueryEngine, QueryEngine,
+                                      RequestBatcher, export_artifact,
+                                      load_artifact)
+    from hyperspace_tpu.telemetry import registry as telem
+
+    export_artifact(out_dir, table, spec, overwrite=True)
+    loaded = load_artifact(out_dir)
+    arr0 = np.array(loaded.table, np.float32)
+    live = LiveQueryEngine(QueryEngine.from_artifact(loaded),
+                           HostEmbedTable.from_array(np.array(arr0)),
+                           capacity=64, auto_compact=False)
+    k, rng = 5, np.random.default_rng(3)
+
+    def oracle_recall(eng, deleted) -> float:
+        """recall@k of ``eng`` vs a frozen engine rebuilt from the
+        final master (overfetch + host-side tombstone filter)."""
+        probe = np.asarray(
+            [i for i in range(eng.num_nodes) if i not in deleted][:32],
+            np.int64)
+        oracle = QueryEngine(np.array(eng.master.to_array()), spec)
+        li, _ = eng.topk_neighbors(probe, k)
+        oi, _ = oracle.topk_neighbors(probe, k + len(deleted))
+        hits = 0
+        for r in range(probe.size):
+            want = [j for j in np.asarray(oi)[r].tolist()
+                    if j not in deleted][:k]
+            hits += len(set(np.asarray(li)[r].tolist()) & set(want))
+        return hits / (probe.size * k)
+
+    # --- cache-isolation proof: prime, mutate, MUST miss --------------
+    bat = RequestBatcher(live, cache_size=256)
+    reg = telem.default_registry()
+    bat.topk([3], k)                      # prime
+    h0 = reg.get("serve/cache_hit")
+    bat.topk([3], k)                      # same key: a hit
+    if reg.get("serve/cache_hit") != h0 + 1:
+        print("MUTABLE: cache prime did not hit on the unchanged engine")
+        return 1
+    anchor = 7
+    vec = arr0[anchor] + 1e-4 * rng.standard_normal(D).astype(np.float32)
+    live.upsert([N], vec[None, :])        # first insert: generation bump
+    h1, m1 = reg.get("serve/cache_hit"), reg.get("serve/cache_miss")
+    ni, _ = bat.topk([3], k)              # same request, NEW generation
+    if reg.get("serve/cache_hit") != h1 or \
+            reg.get("serve/cache_miss") <= m1:
+        print("MUTABLE: STALE CACHE — a pre-mutation result answered "
+              "after the upsert (scan_signature must fold the segment "
+              "generation)")
+        return 1
+    qi, _ = bat.topk([anchor], k)
+    if int(np.asarray(qi)[0, 0]) != N:
+        print("MUTABLE: the anchor's near-duplicate insert is not its "
+              "top-1 — upsert not visible through the batcher")
+        return 1
+
+    # --- upsert N + delete M, recall vs oracle, compact, again --------
+    new_ids = list(range(N + 1, N + 9))
+    anchors = list(range(20, 20 + len(new_ids)))
+    rows = np.stack([arr0[a] for a in anchors]) \
+        + 1e-4 * rng.standard_normal((len(new_ids), D)).astype(np.float32)
+    live.upsert(new_ids, rows)
+    live.upsert([11, 13], np.stack([arr0[50], arr0[51]])
+                + np.float32(1e-4))      # in-place updates write through
+    deleted = {new_ids[0], new_ids[1], 13}
+    live.delete(sorted(deleted))
+    r_pre = oracle_recall(live, deleted)
+    if r_pre < 0.999:
+        print(f"MUTABLE: pre-compaction recall vs rebuilt oracle "
+              f"{r_pre:.4f} < 1.0")
+        return 1
+    fp_pre, gen_pre = live.fingerprint, live.generation
+    live.compact()
+    if live.fingerprint == fp_pre or live.generation <= gen_pre:
+        print("MUTABLE: compaction kept the pre-compaction fingerprint/"
+              "generation — stale cache rows would stay addressable")
+        return 1
+    if live.segment_rows != 0:
+        print(f"MUTABLE: {live.segment_rows} delta rows survived "
+              f"compaction")
+        return 1
+    r_post = oracle_recall(live, deleted)
+    if r_post < 0.999:
+        print(f"MUTABLE: post-compaction recall vs rebuilt oracle "
+              f"{r_post:.4f} < 1.0")
+        return 1
+    # the old fingerprint no longer answers: the batcher's plan keys on
+    # the live engine identity, and the swapped-in base reports the new
+    # one everywhere a cache key could be built from
+    if fp_pre in (live.fingerprint, live.base.fingerprint):
+        print("MUTABLE: pre-compaction fingerprint still answers")
+        return 1
+    # tombstones: rejected as anchors, never returned as neighbors
+    try:
+        live.topk_neighbors([13], k)
+    except ValueError:
+        pass
+    else:
+        print("MUTABLE: querying a tombstoned id did not raise")
+        return 1
+    ti, _ = live.topk_neighbors([20], k)
+    if deleted & set(np.asarray(ti)[0].tolist()):
+        print("MUTABLE: a tombstoned id came back as a neighbor")
+        return 1
+    return 0
+
+
 def main(out_dir: str | None = None) -> int:
     import numpy as np
 
@@ -220,6 +349,9 @@ def main(out_dir: str | None = None) -> int:
         if rc:
             return rc
         rc = _check_quant_round_trip(table, spec, out_dir + ".q", live)
+        if rc:
+            return rc
+        rc = _check_mutable_round_trip(table, spec, out_dir + ".live")
         if rc:
             return rc
         print(f"serve artifact round-trip OK: {len(QUERIES)} queries "

@@ -1,0 +1,39 @@
+"""Fixture: frozen-table-mutation MUST fire on every pattern here."""
+import numpy as np
+
+
+def poke_embedding_row(eng, row):
+    eng.table[17] = row                  # in-place write to the table
+
+
+def scale_a_lane_in_place(eng):
+    eng.scan_scale[3] *= 2.0             # aug-assign subscript write
+
+
+def patch_quant_codes(payload, new_codes):
+    payload.codes[0:4] = new_codes       # slice write, same poke
+
+
+def clobber_a_centroid(index, c):
+    index.centroids[c] = np.zeros(8)     # coarse index mutated in place
+
+
+def grow_a_cell(index, c):
+    index.cells[c] += 1                  # postings mutated in place
+
+
+def reach_into_delta_internals(live, slot):
+    live._pen[slot] = float("inf")       # delta internals from outside
+
+
+def tuple_target_hides_the_poke(eng, row):
+    i, eng.table[5] = 0, row             # write hidden in an unpacking
+
+
+def swap_a_lane_on_a_foreign_engine(eng, lane):
+    eng.scan_table = lane                # rebind out from under the
+    return eng                           # engine's fingerprint
+
+
+def requantize_someone_elses_codebooks(quantizer, cb):
+    quantizer.codebooks = cb             # foreign rebind, same hazard

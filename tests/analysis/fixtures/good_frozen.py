@@ -1,0 +1,40 @@
+"""Fixture: frozen-table-mutation must stay SILENT on all of this."""
+import numpy as np
+
+
+class OwnsItsArrays:
+    def __init__(self, table, centroids):
+        # a class initializing its OWN slots is construction, not
+        # mutation of a foreign engine
+        self.table = table
+        self.centroids = centroids
+        self.cells = [[] for _ in range(8)]
+
+    def rebuild(self, table):
+        self.table = table               # self-rebind stays sanctioned
+        self.scan_scale = np.abs(table).max(axis=0)
+
+
+def reads_are_fine(eng, i):
+    row = eng.table[i]                   # subscript READ, not a write
+    return row + eng.scan_scale[0]
+
+
+def local_names_merely_shadow(rows):
+    table = np.asarray(rows)
+    table[0] = table[1]                  # a local array named "table"
+    cells = {0: []}
+    cells[0] = [1, 2]                    # plain dict, no attribute base
+    return table, cells
+
+
+def sanctioned_api_calls(live, ids, rows):
+    live.upsert(ids, rows)               # the blessed mutation path
+    live.delete(ids[:1])
+    return live.master.write_back(ids, rows)
+
+
+def unrelated_attributes_are_untouched(eng, stats):
+    eng.generation_hint = 3              # not a frozen array attr
+    stats["table"] = 1                   # dict key sharing the name
+    return eng

@@ -18,6 +18,7 @@ from hyperspace_tpu.analysis.rules.distmat import MaterializedDistmatRule
 from hyperspace_tpu.analysis.rules.donation import DonationHazardRule
 from hyperspace_tpu.analysis.rules.exceptions import SwallowBaseExceptionRule
 from hyperspace_tpu.analysis.rules.flags import FlagDocDriftRule
+from hyperspace_tpu.analysis.rules.frozen import FrozenTableMutationRule
 from hyperspace_tpu.analysis.rules.hostsync import HostSyncRule
 from hyperspace_tpu.analysis.rules.hosttable import (
     FullTableMaterializationRule)
@@ -51,6 +52,7 @@ _PER_FILE = [
     ("bad_asyncblock.py", BlockingCallInAsyncRule, None),
     ("bad_distmat.py", MaterializedDistmatRule, None),
     ("bad_hosttable.py", FullTableMaterializationRule, None),
+    ("bad_frozen.py", FrozenTableMutationRule, None),
     ("bad_precision.py", PrecisionLiteralRule,
      "hyperspace_tpu/models/bad_precision.py"),
     ("bad_packing.py", PackingLiteralRule,
@@ -424,6 +426,51 @@ def test_hosttable_hot_cache_module_is_out_of_scope(tmp_path):
     assert lint_file(
         str(p), rel="hyperspace_tpu/parallel/host_table.py",
         rules=[FullTableMaterializationRule()]).findings == []
+
+
+# --- frozen-table-mutation ----------------------------------------------------
+
+
+def test_frozen_bad_fixture_fires_on_every_pattern():
+    """Subscript pokes (plain, aug-assign, slice, tuple-hidden),
+    delta-internal reach-ins, and foreign-attribute rebinds all
+    fire."""
+    report = _lint("bad_frozen.py", FrozenTableMutationRule)
+    assert report.exit_code() == 1 and len(report.findings) == 9
+    msgs = " ".join(f.message for f in report.findings)
+    assert "'.table[...]'" in msgs
+    assert "'._pen[...]'" in msgs
+    assert "rebinding frozen array '.scan_table'" in msgs
+
+
+def test_frozen_good_fixture_is_clean():
+    """Own-slot construction, self-rebinds, reads, shadowing locals,
+    dict keys, and the sanctioned upsert/delete/write_back API are all
+    silent."""
+    assert _lint("good_frozen.py", FrozenTableMutationRule).findings == []
+
+
+def test_frozen_sanctioned_homes_are_out_of_scope(tmp_path):
+    """serve/delta.py and parallel/host_table.py own the writes — the
+    same source that fires elsewhere is clean under their rel
+    paths."""
+    src = ("def apply(self, slot, row):\n"
+           "    self._rows[slot] = row\n"
+           "    self._pen[slot] = 0.0\n")
+    p = tmp_path / "x.py"
+    p.write_text(src)
+    assert lint_file(str(p), rel="hyperspace_tpu/serve/x.py",
+                     rules=[FrozenTableMutationRule()]).findings
+    for home in ("hyperspace_tpu/serve/delta.py",
+                 "hyperspace_tpu/parallel/host_table.py"):
+        assert lint_file(str(p), rel=home,
+                         rules=[FrozenTableMutationRule()]).findings == []
+
+
+def test_frozen_severity_is_error():
+    """A stale-visibility hazard is never advisory."""
+    report = _lint("bad_frozen.py", FrozenTableMutationRule)
+    assert {f.severity for f in report.findings} == {"error"}
 
 
 # --- precision-literal --------------------------------------------------------

@@ -283,6 +283,45 @@ def test_direction_table_size_tokens_are_lower_better():
     assert mod.direction("detail.big_table.lanes.int8.n") is None
 
 
+def test_direction_freshness_staleness_are_lower_better():
+    """The r18 live-index leg's freshness/staleness family is a cost:
+    time-to-visible after an upsert, stale answers served, tombstones
+    outstanding — growing any of them is never an improvement.  The
+    tokens outrank the generic higher-better list the same way shed /
+    deadline do (a stale *rate* is still staleness)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_trend", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for name in ("upsert_visible_ms",
+                 "detail.live_index.freshness.upsert_visible_ms.p99",
+                 "detail.live_index.stale_results", "stale_rate",
+                 "detail.live_index.staleness_ms"):
+        assert mod.direction(name) == "lower", name
+
+
+def test_direction_during_rollover_inherits_base_metric():
+    """``*_during_rollover`` readings (r18) inherit the base metric's
+    direction: the window qualifier carries none of its own.  A p99
+    latency across the flip stays lower-better, a throughput measured
+    across the flip would stay higher-better — and the bare qualifier
+    resolves to no direction at all (shown, never gated)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_trend", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for name in ("p99_during_rollover_ms",
+                 "detail.live_index.p99_during_rollover_ms",
+                 "recompiles_during_rollover"):
+        assert mod.direction(name) == "lower", name
+    assert mod.direction("qps_during_rollover") == "higher"
+    assert mod.direction(
+        "detail.live_index.recall_during_rollover") == "higher"
+    assert mod.direction("during_rollover") is None
+
+
 def test_budget_exhausted_primary_never_gates(tmp_path):
     """A record whose metric is real but whose detail carries
     budget_exhausted (the watchdog's partial artifact — the checked-in

@@ -1,0 +1,31 @@
+"""The live-index smoke lint, run inside the suite: export →
+``serve-http live=1`` subprocess → upsert/query/delete round trip over
+the socket → SIGTERM drain (scripts/check_live_index.py is the one
+implementation — this test fails the build when it fails, mirroring
+test_check_http_script.py)."""
+
+import importlib.util
+import os
+
+import pytest
+
+
+def _load_checker():
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, "scripts", "check_live_index.py")
+    spec = importlib.util.spec_from_file_location("check_live_index",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.flaky  # a loaded CI host can starve the subprocess launch
+def test_live_index_round_trip_lint_passes(tmp_path, capsys):
+    mod = _load_checker()
+    rc = mod.main(str(tmp_path / "artifact"))
+    out = capsys.readouterr().out
+    assert rc == 0, f"live-index round-trip lint failed:\n{out}"
+    assert "live index round trip OK" in out
+    assert "recompiles flat" in out

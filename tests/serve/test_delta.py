@@ -1,0 +1,241 @@
+"""Live-index delta-segment edge cases (ISSUE 18 satellite): the LSM
+semantics that are easy to get subtly wrong — re-upsert last-write-wins,
+delete-then-reinsert across a compaction boundary, tombstones under
+``exclude_self`` and sharded meshes, and the under-filled error when
+``k`` exceeds the live-row count.  Every top-k is cross-checked against
+an f64 oracle over the mutable master with tombstones masked out."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.parallel.host_table import HostEmbedTable
+from hyperspace_tpu.parallel.mesh import model_mesh
+from hyperspace_tpu.serve.artifact import spec_from_manifold
+from hyperspace_tpu.serve.delta import LiveQueryEngine
+from hyperspace_tpu.serve.engine import QueryEngine
+
+from .test_engine import _poincare_table
+
+
+def _live(rng, n=60, d=5, c=1.0, cap=8, mesh=None, **kw):
+    table, man = _poincare_table(rng, n, d, c)
+    eng = QueryEngine(table, spec_from_manifold(man), chunk_rows=32,
+                      mesh=mesh)
+    live = LiveQueryEngine(eng, HostEmbedTable.from_array(table),
+                           capacity=cap, auto_compact=False, **kw)
+    return live, man
+
+
+def _near(master_row, rng, eps=1e-4):
+    return np.asarray(master_row, np.float32) + eps * rng.standard_normal(
+        master_row.shape[-1]).astype(np.float32)
+
+
+def _oracle_topk(live, man, q_idx, k, *, exclude_self=True):
+    """f64 exact top-k over the CURRENT master with tombstones +inf."""
+    arr = jnp.asarray(live.master.to_array(), jnp.float64)
+    d = np.array(jax.vmap(lambda x: man.dist(x, arr))(arr[np.asarray(
+        q_idx)]))
+    for t in live._deleted:
+        d[:, t] = np.inf
+    if exclude_self:
+        d[np.arange(len(q_idx)), q_idx] = np.inf
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return idx
+
+
+# --- re-upsert: last write wins ----------------------------------------------
+
+
+def test_reupsert_of_delta_resident_id_last_write_wins(rng):
+    """Upserting an id ALREADY in the delta overwrites its slot in
+    place (no second slot, no ghost of the first write): the query
+    answers the newest vector, and segment occupancy stays flat."""
+    live, man = _live(rng)
+    master = live.master.to_array()
+    vid, a1, a2 = 5, 40, 41
+    live.upsert([vid], [_near(master[a1], rng)])
+    assert live.segment_rows == 1
+    idx, _ = live.topk_neighbors([vid], 3)
+    assert idx[0][0] == a1
+    g1 = live.generation
+    live.upsert([vid], [_near(master[a2], rng)])
+    assert live.segment_rows == 1  # same slot, not a second one
+    assert live.generation == g1 + 1
+    idx, _ = live.topk_neighbors([vid], 3)
+    assert idx[0][0] == a2 and a1 not in idx[0][:1]
+    np.testing.assert_array_equal(
+        idx, _oracle_topk(live, man, [vid], 3))
+
+
+def test_duplicate_ids_within_one_batch_last_write_wins(rng):
+    """Duplicates inside ONE upsert batch resolve like sequential
+    re-upserts: the final occurrence is the one that lands."""
+    live, man = _live(rng)
+    master = live.master.to_array()
+    vid, a1, a2 = 9, 30, 31
+    out = live.upsert([vid, 12, vid],
+                      [_near(master[a1], rng),
+                       _near(master[22], rng),
+                       _near(master[a2], rng)])
+    assert out["upserted"] == 2 and out["inserted"] == 0
+    idx, _ = live.topk_neighbors([vid], 2)
+    assert idx[0][0] == a2
+
+
+# --- delete-then-reinsert across a compaction boundary ------------------------
+
+
+def test_delete_then_reinsert_across_compaction(rng):
+    """A tombstone survives compaction (rows are never renumbered, so
+    the dead row rides into the rebuilt base and must stay masked);
+    a later re-upsert of the same id revives it, and THAT survives the
+    next compaction too."""
+    live, man = _live(rng)
+    master = live.master.to_array()
+    victim, anchor = 7, 33
+    live.delete([victim])
+    fp0 = live.fingerprint
+    gen0 = live.generation
+    rep = live.compact()
+    # delete-only compaction rebuilds from IDENTICAL master bytes, so
+    # the content-derived fingerprint may not move — the generation is
+    # what rolls the cache key
+    assert rep["segment_rows"] == 0 and live.generation > gen0
+    # still dead after the rebuild: refused as an anchor, never a
+    # neighbor, and absent from a table-draining query
+    with pytest.raises(ValueError, match="deleted"):
+        live.topk_neighbors([victim], 3)
+    idx, _ = live.topk_neighbors([anchor], live.num_live - 1)
+    assert victim not in idx[0]
+    np.testing.assert_array_equal(
+        idx, _oracle_topk(live, man, [anchor], live.num_live - 1))
+    # reinsert: the id comes back to life with its NEW vector
+    live.upsert([victim], [_near(master[anchor], rng)])
+    idx, _ = live.topk_neighbors([victim], 3)
+    assert idx[0][0] == anchor
+    live.compact()
+    assert live.fingerprint != fp0  # the folded WRITE moves the bytes
+    idx, _ = live.topk_neighbors([victim], 3)
+    assert idx[0][0] == anchor  # revival survives the next rebuild
+    idx, _ = live.topk_neighbors([anchor], 3)
+    assert victim in idx[0]
+
+
+# --- tombstones under exclude_self and sharded meshes -------------------------
+
+
+@pytest.mark.parametrize("exclude_self", [True, False])
+def test_tombstoned_row_never_surfaces(rng, exclude_self):
+    """Delete the anchor's nearest neighbor: it must vanish from the
+    anchor's top-k under BOTH self-exclusion settings (the drop
+    penalty and the self mask are independent lanes)."""
+    live, man = _live(rng)
+    anchor = 11
+    idx, _ = live.topk_neighbors([anchor], 1)
+    victim = int(idx[0][0])
+    live.delete([victim])
+    k = live.num_live - (1 if exclude_self else 0)
+    idx, dist = live.topk_neighbors([anchor], k,
+                                    exclude_self=exclude_self)
+    assert victim not in idx[0]
+    assert np.isfinite(dist).all()
+    if not exclude_self:
+        assert idx[0][0] == anchor  # self at distance ~0 still wins
+    np.testing.assert_array_equal(
+        idx, _oracle_topk(live, man, [anchor], k,
+                          exclude_self=exclude_self))
+
+
+def test_tombstoned_row_excluded_on_sharded_mesh(rng):
+    """The same contract on a 4-way model-sharded base: the drop
+    penalty rides the per-shard scans and the merge, so a tombstone
+    can never win on ANY shard (conftest's 8 fake CPU devices)."""
+    live, man = _live(rng, n=120, mesh=model_mesh(4))
+    assert live.base.shards == 4
+    anchor = 17
+    idx, _ = live.topk_neighbors([anchor], 2)
+    victims = [int(i) for i in idx[0]]
+    live.delete(victims)
+    idx, dist = live.topk_neighbors([anchor], live.num_live - 1)
+    assert not set(victims) & set(idx[0].tolist())
+    assert np.isfinite(dist).all()
+    np.testing.assert_array_equal(
+        idx, _oracle_topk(live, man, [anchor], live.num_live - 1))
+
+
+# --- under-filled: k beyond the live rows -------------------------------------
+
+
+def test_k_beyond_live_rows_raises_underfilled(rng):
+    """Tombstones are never served as filler: once deletes shrink the
+    live set below ``k``, the existing under-filled ``ValueError``
+    fires instead of padding with +inf rows."""
+    live, _ = _live(rng, n=12)
+    live.delete([2, 3, 4, 5])
+    assert live.num_live == 8
+    # k == live-1 still fills (anchor 0 excluded from its own answer)
+    idx, dist = live.topk_neighbors([0], 7)
+    assert np.isfinite(dist).all() and len(set(idx[0].tolist())) == 7
+    with pytest.raises(ValueError, match="under-filled"):
+        live.topk_neighbors([0], 8)  # 8 > the 7 reachable live rows
+
+
+def test_k_beyond_id_space_is_still_a_range_error(rng):
+    """The pre-existing k-range validation is unchanged: k past the
+    whole id space fails fast, before any scan."""
+    live, _ = _live(rng, n=12)
+    with pytest.raises(ValueError, match="out of range"):
+        live.topk_neighbors([0], 12)
+
+
+# --- invariants ---------------------------------------------------------------
+
+
+def test_generation_folds_into_scan_signature(rng):
+    """Every mutation (upsert, delete, compact) bumps the generation
+    the batcher's cache key folds in — staleness is structural."""
+    live, _ = _live(rng)
+    sigs = {live.scan_signature}
+    master = live.master.to_array()
+    live.upsert([3], [_near(master[20], rng)])
+    sigs.add(live.scan_signature)
+    live.delete([3])
+    sigs.add(live.scan_signature)
+    live.compact()
+    sigs.add(live.scan_signature)
+    assert len(sigs) == 4  # four distinct cache-key suffixes
+    assert ("gen", live.generation) == live.scan_signature[-2:]
+
+
+def test_queries_score_fresh_post_upsert_vectors(rng):
+    """A query BY an updated id ranks against its post-upsert vector
+    (q_rows gathers from the mutable master, not the frozen table)."""
+    live, _ = _live(rng)
+    master = live.master.to_array()
+    moved, anchor = 2, 50
+    live.upsert([moved], [_near(master[anchor], rng)])
+    idx, dist = live.topk_neighbors([moved], 1)
+    assert idx[0][0] == anchor and dist[0][0] < 0.01
+
+
+def test_inserts_must_be_contiguous(rng):
+    """Ids are row indices: a gapped insert would be an unaddressable
+    hole forever, so it is refused up front."""
+    live, _ = _live(rng, n=12)
+    with pytest.raises(ValueError, match="contiguous"):
+        live.upsert([14], [np.zeros(5, np.float32)])
+
+
+def test_fused_base_rejected(rng):
+    """The fused kernel has no tombstone lane — a LiveQueryEngine over
+    it would silently serve the two-stage fallback under a signature
+    that says 'fused'."""
+    table, man = _poincare_table(rng, 40, 5, 1.0)
+    eng = QueryEngine(table, spec_from_manifold(man), chunk_rows=32,
+                      scan_mode="fused")
+    with pytest.raises(ValueError, match="fused"):
+        LiveQueryEngine(eng, HostEmbedTable.from_array(table),
+                        capacity=4)

@@ -1,0 +1,165 @@
+"""Blue-green rollover contracts (ISSUE 18): the gate refuses standbys
+whose identity is incomplete or degraded (and a refusal leaves the old
+stack serving untouched), the flip atomically swaps batcher + collator
+and drains the old stack, and a coordinator runs one rollover at a
+time."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.parallel.host_table import HostEmbedTable
+from hyperspace_tpu.serve.artifact import spec_from_manifold
+from hyperspace_tpu.serve.batcher import RequestBatcher
+from hyperspace_tpu.serve.delta import LiveQueryEngine
+from hyperspace_tpu.serve.engine import QueryEngine
+from hyperspace_tpu.serve.rollover import (GATE_FIELDS,
+                                           RolloverCoordinator, gate_flip,
+                                           standby_health)
+from hyperspace_tpu.serve.server import HttpFrontDoor
+
+from .test_engine import _poincare_table
+
+
+def _batcher(rng, n=40, seed_shift=0.0):
+    table, man = _poincare_table(rng, n, 5, 1.0)
+    if seed_shift:
+        table = np.asarray(table) * (1.0 - seed_shift)
+    eng = QueryEngine(table, spec_from_manifold(man), chunk_rows=32)
+    return RequestBatcher(eng, min_bucket=4, max_bucket=8, cache_size=64)
+
+
+def _door(batcher):
+    # construction binds nothing — the door is drivable without a
+    # socket (the collator + attribute surface is what flip touches)
+    return HttpFrontDoor(batcher, max_wait_us=500)
+
+
+# --- the gate -----------------------------------------------------------------
+
+
+def test_standby_health_carries_every_gate_field(rng):
+    body = standby_health(_batcher(rng))
+    assert all(body.get(f) is not None for f in GATE_FIELDS)
+    gate_flip(body)  # a healthy standby passes
+
+
+def test_gate_refuses_missing_identity_fields(rng):
+    body = standby_health(_batcher(rng))
+    for field in GATE_FIELDS:
+        broken = dict(body)
+        del broken[field]
+        with pytest.raises(ValueError, match="missing"):
+            gate_flip(broken)
+
+
+def test_gate_refuses_not_ok_and_degraded(rng):
+    body = standby_health(_batcher(rng))
+    with pytest.raises(ValueError, match="ok=false"):
+        gate_flip(dict(body, ok=False))
+    with pytest.raises(ValueError, match="degraded"):
+        gate_flip(dict(body, degrade_level=2))
+
+
+def test_gate_refusal_leaves_old_stack_serving(rng, monkeypatch):
+    """A standby that gates red is discarded WITHOUT touching the live
+    stack: same batcher, same collator, zero flips recorded."""
+    old = _batcher(rng)
+    door = _door(old)
+    coord = RolloverCoordinator(door, lambda t: _batcher(rng, 40, 0.1),
+                                prewarm_ks=(3,))
+    monkeypatch.setattr("hyperspace_tpu.serve.rollover.standby_health",
+                        lambda b: dict(standby_health(b),
+                                       degrade_level=1))
+    old_collator = door.collator
+    with pytest.raises(ValueError, match="degraded"):
+        asyncio.run(coord.rollover("v2"))
+    assert door.batcher is old and door.collator is old_collator
+    assert coord.flips == 0 and not old_collator._closed
+    assert coord._busy is False  # a refused rollover releases the slot
+
+
+# --- the flip -----------------------------------------------------------------
+
+
+def test_rollover_flips_atomically_and_drains_old_stack(rng):
+    """The full prepare → gate → flip → drain path: the door serves
+    the standby afterwards (answers match the new engine directly),
+    the old collator is flushed + closed, and the report names both
+    fingerprints and the prewarm count."""
+    old = _batcher(rng)
+    door = _door(old)
+    standby_box = {}
+
+    def builder(target):
+        assert target == "v2"
+        standby_box["b"] = _batcher(rng, 40, 0.1)
+        return standby_box["b"]
+
+    coord = RolloverCoordinator(door, builder, prewarm_ks=(3,))
+    old_collator = door.collator
+
+    async def drive():
+        report = await coord.rollover("v2")
+        # post-flip traffic answers from the NEW stack, via the new
+        # collator — compare against the standby engine directly
+        idx, _ = await door.collator.topk([2, 7], 3)
+        return report, np.asarray(idx)
+
+    report, idx = asyncio.run(drive())
+    standby = standby_box["b"]
+    assert door.batcher is standby and door.collator is not old_collator
+    assert old_collator._closed  # drained: flushed, executor released
+    assert coord.flips == 1 and report["flipped"] is True
+    assert report["old_fingerprint"] == old.engine.fingerprint
+    assert report["new_fingerprint"] == standby.engine.fingerprint
+    assert report["old_fingerprint"] != report["new_fingerprint"]
+    assert report["prewarmed_programs"] > 0
+    want, _ = standby.engine.topk_neighbors(
+        np.asarray([2, 7], np.int32), 3)
+    np.testing.assert_array_equal(idx, np.asarray(want))
+
+
+def test_flip_onto_live_engine_rolls_the_scan_signature(rng):
+    """A rollover onto a LiveQueryEngine standby (the bench's shape):
+    the new collator serves the generation-folded signature, so no
+    cache key can bridge the flip."""
+    old = _batcher(rng)
+    door = _door(old)
+    table, man = _poincare_table(rng, 40, 5, 1.0)
+    live = LiveQueryEngine(
+        QueryEngine(table, spec_from_manifold(man), chunk_rows=32),
+        HostEmbedTable.from_array(table), capacity=8,
+        auto_compact=False)
+    standby = RequestBatcher(live, min_bucket=4, max_bucket=8,
+                             cache_size=64)
+    coord = RolloverCoordinator(door, lambda t: standby,
+                                prewarm_ks=(3,))
+    report = asyncio.run(coord.rollover("live"))
+    assert ("gen" in report["scan_signature"]
+            and door.batcher.engine is live)
+
+
+def test_one_rollover_at_a_time(rng):
+    """A second rollover launched while the first is still preparing
+    is refused immediately — the standby build owns the build
+    bandwidth; the first completes unaffected."""
+    door = _door(_batcher(rng))
+
+    def slow_builder(target):
+        time.sleep(0.2)  # keep the first rollover in its prepare phase
+        return _batcher(rng, 40, 0.1)
+
+    coord = RolloverCoordinator(door, slow_builder, prewarm_ks=(3,))
+
+    async def drive():
+        first = asyncio.ensure_future(coord.rollover("a"))
+        await asyncio.sleep(0.05)  # first is now blocking in prepare
+        with pytest.raises(ValueError, match="already in progress"):
+            await coord.rollover("b")
+        return await first
+
+    report = asyncio.run(drive())
+    assert report["flipped"] is True and coord.flips == 1
