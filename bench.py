@@ -1496,6 +1496,108 @@ def bench_resilience(repeats: int = 1) -> dict:
             "vs_baseline": None, "detail": detail}
 
 
+def bench_multihost(repeats: int = 1, *, steps: int = 24,
+                    chunk: int = 8) -> dict:
+    """Pod-scaling leg (r19): the SAME chunked HGCN LP workload timed
+    as a 1-process run and as a REAL 2-process × 2-virtual-device
+    ``jax.distributed`` loopback fleet (``benchmarks/mh_worker.py
+    --task bench`` — each process times its replica, process 0
+    aggregates behind a coordination barrier).
+
+    Rows per process count: step time, aggregate fleet throughput
+    (``steps_per_s`` — nprocs replicas × steps / slowest process).
+    Headline value = ``scaling_efficiency`` — 2-proc fleet throughput
+    over 2× the 1-proc throughput (1.0 = perfect linear scaling; CPU
+    loopback runs share cores, so well under 1.0 is expected and the
+    TREND, not the level, is the signal).  ``multihost_ok`` gates the
+    reading: per-chunk loss trajectories at both process counts must
+    be finite and match (the degenerate-DP determinism contract —
+    docs/multihost.md), so a scaling number from diverged replicas can
+    never look green.
+
+    Worker groups are bounded subprocesses, killed on ANY exit from
+    this leg (including the SIGALRM ``_LegTimeout``) — a deadline here
+    must not strand orphans holding the artifact's stdout tail.
+    """
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    root = os.path.dirname(os.path.abspath(__file__))
+
+    def _run_group(nprocs: int, workdir: str, timeout: float) -> dict:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)  # workers set their own device count
+        extra = env.get("PYTHONPATH")
+        env["PYTHONPATH"] = os.pathsep.join(
+            [root] + (extra.split(os.pathsep) if extra else []))
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "hyperspace_tpu.benchmarks.mh_worker",
+             "--pid", str(p), "--nprocs", str(nprocs),
+             "--port", str(port), "--workdir", workdir,
+             "--task", "bench", "--steps", str(steps),
+             "--chunk", str(chunk)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for p in range(nprocs)]
+        outs = []
+        try:
+            for pr in procs:
+                out, _ = pr.communicate(timeout=timeout)
+                outs.append(out)
+        finally:
+            for pr in procs:  # no orphans on timeout or _LegTimeout
+                if pr.poll() is None:
+                    pr.kill()
+                    pr.wait()
+        for pr, out in zip(procs, outs):
+            if pr.returncode != 0:
+                raise RuntimeError(
+                    f"multihost worker rc={pr.returncode}: {out[-400:]}")
+        for out in outs:
+            for line in out.splitlines():
+                if line.startswith("RESULT "):
+                    return json.loads(line[len("RESULT "):])
+        raise RuntimeError("no RESULT line from multihost group")
+
+    detail: dict = {"steps": steps, "chunk": chunk, "procs": {}}
+    with tempfile.TemporaryDirectory() as tmp:
+        for nprocs in (1, 2):
+            best = None
+            for r in range(max(1, repeats)):
+                wd = os.path.join(tmp, f"n{nprocs}_r{r}")
+                os.makedirs(wd, exist_ok=True)
+                res = _run_group(nprocs, wd, timeout=120)
+                if best is None or res["steps_per_s"] > best["steps_per_s"]:
+                    best = res
+            detail["procs"][str(nprocs)] = {
+                "step_time_s": round(best["step_time_s"], 6),
+                "steps_per_s": round(best["steps_per_s"], 1),
+                "elapsed_s": round(best["elapsed_s"], 3),
+                "devices": best["devices"],
+                "losses": [round(l, 6) for l in best["losses"]],
+            }
+    one, two = detail["procs"]["1"], detail["procs"]["2"]
+    eff = two["steps_per_s"] / (2.0 * one["steps_per_s"])
+    detail["scaling_efficiency"] = round(eff, 3)
+    l1 = np.asarray(one["losses"])
+    l2 = np.asarray(two["losses"])
+    detail["multihost_ok"] = bool(
+        np.all(np.isfinite(l1)) and np.all(np.isfinite(l2))
+        and l1.shape == l2.shape and np.allclose(l1, l2, atol=1e-6))
+    return {"metric": "multihost_scaling_efficiency",
+            "value": detail["scaling_efficiency"],
+            "unit": "x (2-proc fleet / 2x 1-proc throughput)",
+            "vs_baseline": None, "detail": detail}
+
+
 def bench_precision(repeats: int = 2) -> dict:
     """f32-vs-bf16 timing pairs on the SAME shapes (docs/precision.md).
 
@@ -1860,6 +1962,17 @@ _COMPACT_FIELDS = (
     ("big_host_step_ms", ("detail", "train", "host_step_ms")),
     ("precision_train_ms", ("detail", "precision", "train_step_ms")),
     ("precision_serve_ms", ("detail", "precision", "serve_scan_ms")),
+    # pod-scale loopback scaling leg (r19): 2-proc fleet throughput
+    # over 2× 1-proc (higher is better — bench_trend's scaling/
+    # efficiency tokens), gated by the cross-process-count loss-match
+    # verdict (multihost_ok — a sentinel, excluded from trend gating).
+    # First path is auto mode's nested leg, second fires when
+    # bench_multihost IS the headline (--metric multihost)
+    ("multihost_scaling_efficiency",
+     ("detail", "multihost", "scaling_efficiency")),
+    ("multihost_scaling_efficiency", ("detail", "scaling_efficiency")),
+    ("multihost_ok", ("detail", "multihost", "multihost_ok")),
+    ("multihost_ok", ("detail", "multihost_ok")),
     # failure-domain leg (PR 9): chaos recovery + the shed-rate column
     ("resilience_ok", ("detail", "resilience", "ok")),
     ("shed_rate", ("detail", "resilience", "overload", "shed_rate")),
@@ -1993,7 +2106,7 @@ def main() -> None:
     p.add_argument("--metric",
                    choices=["auto", "hgcn", "poincare", "serve",
                             "serve_http", "live_index", "cold_start",
-                            "big_table"],
+                            "big_table", "multihost"],
                    default="auto")
     p.add_argument("--big-rows", type=int, default=10_000_000,
                    help="--metric big_table: synthetic table rows "
@@ -2053,7 +2166,8 @@ def main() -> None:
                "cold_start": bench_cold_start,
                "big_table": functools.partial(
                    bench_big_table, rows=args.big_rows,
-                   dim=args.big_dim)}.get(args.metric, hgcn_fn)
+                   dim=args.big_dim),
+               "multihost": bench_multihost}.get(args.metric, hgcn_fn)
     primary_name = args.metric if args.metric != "auto" else "hgcn"
 
     # the headline metric NEVER switches silently: a failure of the
@@ -2171,6 +2285,10 @@ def main() -> None:
                 r = bench_resilience()
                 d["resilience"] = {"ok": r["value"], **r["detail"]}
 
+            def multihost_leg(d):  # pod-scale loopback scaling (r19)
+                r = bench_multihost()
+                d["multihost"] = r["detail"]
+
             def use_att_leg(d):
                 # the attention arm on the same graph/protocol (VERDICT
                 # r3 #1).  Distinct key: detail["use_att"] is the
@@ -2202,6 +2320,7 @@ def main() -> None:
             leg("big_table", 75, big_table_leg)
             leg("precision", 40, precision_leg)
             leg("resilience", 25, resilience_leg)
+            leg("multihost", 90, multihost_leg)
             leg("realistic", 150, realistic_leg)
             leg("workloads", 90, workloads_leg)
             leg("use_att_arm", 0 if args.use_att else 120, use_att_leg)

@@ -16,6 +16,7 @@ from hyperspace_tpu.analysis.rules.hosttable import (
     FullTableMaterializationRule)
 from hyperspace_tpu.analysis.rules.jitcache import JitCacheDefeatRule
 from hyperspace_tpu.analysis.rules.monoclock import MonotonicClockRule
+from hyperspace_tpu.analysis.rules.mpio import MultiprocessUnsafeIORule
 from hyperspace_tpu.analysis.rules.packing import PackingLiteralRule
 from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
@@ -39,6 +40,7 @@ ALL_RULES = (
     PackingLiteralRule,
     MetricUnitSuffixRule,
     MonotonicClockRule,
+    MultiprocessUnsafeIORule,
     TelemetryCatalogRule,
     FlagDocDriftRule,
 )

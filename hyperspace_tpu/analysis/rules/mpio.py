@@ -1,0 +1,171 @@
+"""multiprocess-unsafe-io: unguarded filesystem writes in multihost-
+reachable modules.
+
+Historical incident class this PR (pod-scale multi-host training) makes
+structural: on a pod EVERY process runs the same script, so a plain
+``open(path, "w")`` in the train plane executes N times against one
+shared filesystem — racing writers corrupt trend files, manifests and
+exports in ways that never show single-process (the checkpoint commit
+protocol in ``parallel/host_table.save_owned_rows`` exists precisely
+because of this).  The rule encodes the two sanctioned shapes
+(docs/multihost.md "One writer or one path each"):
+
+- **process-0-gated**: the write sits under (or behind an early-exit
+  of) an ``if`` whose test mentions a process-identity token —
+  ``process_index`` / ``process_count`` / ``process_id`` /
+  ``is_primary`` / ``primary`` / ``pi`` / ``pid`` / ``rank`` — e.g.
+  ``if jax.process_index() == 0:`` or ``if mh.is_primary():``;
+- **per-host-pathed**: the write target carries a process token
+  (``f"shard_{pi:05d}.npy"``, ``f"digest.{pid}.json"``), directly or
+  transitively through local assignments (``idx = process_index()``
+  taints ``idx``; ``path = f"{root}.{idx}"`` then taints ``path``).
+
+What fires (warning): in scoped modules — ``hyperspace_tpu/train/``,
+``hyperspace_tpu/parallel/``, ``hyperspace_tpu/cli/train.py``,
+``hyperspace_tpu/serve/artifact.py`` (the modules a pod run actually
+executes on every process) — a write neither gated nor per-host-pathed:
+
+- ``open(path, mode)`` with a w/a/x/+ mode;
+- ``os.rename`` / ``os.replace`` / ``shutil.move`` / ``shutil.copy*``
+  (the atomic-commit tails of a write);
+- ``Path.write_text`` / ``Path.write_bytes``.
+
+Single-process-only APIs that multihost callers never reach document
+themselves with the per-line suppression and a reason — the grep-able
+record that the multi-writer question was ASKED and answered.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+
+_SCOPE_PREFIXES = ("hyperspace_tpu/train/", "hyperspace_tpu/parallel/")
+_SCOPE_FILES = ("hyperspace_tpu/cli/train.py",
+                "hyperspace_tpu/serve/artifact.py")
+
+_RENAMES = ("os.rename", "os.replace", "shutil.move", "shutil.copy",
+            "shutil.copy2", "shutil.copyfile", "shutil.copytree")
+_WRITE_ATTRS = ("write_text", "write_bytes")
+
+_TOKEN_RX = re.compile(
+    r"\b(process_index|process_count|process_id|is_primary|primary"
+    r"|pi|pid|rank)\b")
+
+
+def _write_mode(node: ast.Call) -> bool:
+    """True when an ``open`` call's mode string writes (w/a/x/+)."""
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:  # bare open(path) reads
+        return False
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return any(c in mode.value for c in "wax+")
+    return True  # dynamic mode: assume the worst, it's a warning
+
+
+def _safe_unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse of odd nodes
+        return ""
+
+
+def _tainted_names(tree: ast.AST) -> set[str]:
+    """Names assigned (transitively) from a process-identity expression:
+    ``idx = jax.process_index()`` taints ``idx``, and then
+    ``path = f"{root}.{idx}"`` taints ``path`` — the per-host-path
+    shape flows through locals.  Flow-insensitive by design (a warning
+    rule errs toward trusting the author's naming)."""
+    assigns = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            assigns.append((node.targets[0].id, _safe_unparse(node.value)))
+    tainted: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, src in assigns:
+            if name in tainted:
+                continue
+            if _TOKEN_RX.search(src) or any(
+                    re.search(rf"\b{re.escape(t)}\b", src)
+                    for t in tainted):
+                tainted.add(name)
+                changed = True
+    return tainted
+
+
+def _has_token(src: str, tainted: set[str]) -> bool:
+    return bool(_TOKEN_RX.search(src)) or any(
+        re.search(rf"\b{re.escape(t)}\b", src) for t in tainted)
+
+
+class MultiprocessUnsafeIORule(Rule):
+    id = "multiprocess-unsafe-io"
+    severity = "warning"
+    summary = ("unguarded filesystem write in a multihost-reachable "
+               "module — gate on process 0 (mh.is_primary) or use a "
+               "per-host path")
+
+    def check_file(self, ctx: FileContext):
+        rel = ctx.rel.replace("\\", "/")
+        if not (rel.startswith(_SCOPE_PREFIXES) or rel in _SCOPE_FILES):
+            return []
+        tainted = _tainted_names(ctx.tree)
+
+        # process-identity ``if`` statements, for both guard shapes:
+        # ancestry (write inside the if) and early-exit (an earlier if
+        # in the same function body gated who gets this far)
+        guard_ifs = {id(n) for n in ast.walk(ctx.tree)
+                     if isinstance(n, ast.If)
+                     and _has_token(_safe_unparse(n.test), tainted)}
+
+        def guarded(node: ast.AST) -> bool:
+            func = None
+            for anc in ctx.ancestors(node):
+                if id(anc) in guard_ifs:
+                    return True
+                if func is None and isinstance(
+                        anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    func = anc
+            if func is not None:  # early-exit guard above the write
+                for stmt in ast.walk(func):
+                    if (id(stmt) in guard_ifs
+                            and stmt.lineno < node.lineno):
+                        return True
+            return False
+
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func) or ""
+            target = what = None
+            if resolved == "open" and node.args and _write_mode(node):
+                target, what = node.args[0], "open(..., 'w')"
+            elif resolved in _RENAMES and len(node.args) >= 2:
+                target, what = node.args[1], resolved
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _WRITE_ATTRS):
+                target, what = node.func.value, f".{node.func.attr}()"
+            if target is None:
+                continue
+            if _has_token(_safe_unparse(target), tainted) or guarded(node):
+                continue
+            findings.append(self.finding(
+                ctx, node,
+                f"{what} in a multihost-reachable module with no "
+                "process gate and no per-host path — on a pod every "
+                "process runs this line against one shared filesystem; "
+                "gate on mh.is_primary() / process_index() == 0, write "
+                "to a per-host path, or suppress with a reason if this "
+                "API is single-process by contract"))
+        return findings

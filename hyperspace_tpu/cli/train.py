@@ -291,10 +291,22 @@ def run_poincare(run: RunConfig, overrides: dict):
             profile=bool(getattr(run, "profile_steps", 0)))
         trainer.run(ds.pairs, run.steps)
         if run.ckpt_dir:
-            # sharded master save: one bounded block per shard, never
-            # the full table in one array (parallel/host_table.py)
-            trainer.master.save_sharded(
-                os.path.join(run.ckpt_dir, "host_table"))
+            from hyperspace_tpu.parallel import multihost as mh
+
+            d = os.path.join(run.ckpt_dir, "host_table")
+            if jax.process_count() > 1:
+                # pod save: each process writes ONLY its owned row range,
+                # process 0 commits the manifest behind a barrier — same
+                # on-disk layout, restorable at any process count
+                # (parallel/host_table.save_owned_rows)
+                from hyperspace_tpu.parallel import host_table as HT
+
+                HT.save_owned_rows(trainer.master, d,
+                                   barrier=lambda: mh.sync("host_table"))
+            else:
+                # sharded master save: one bounded block per shard, never
+                # the full table in one array (parallel/host_table.py)
+                trainer.master.save_sharded(d)
         if cfg.num_nodes > he.EVAL_MAX_ROWS:
             # materializing the table for eval would defeat the
             # beyond-HBM design at exactly the scale it exists for —
@@ -491,7 +503,16 @@ def run_hgcn(run: RunConfig, overrides: dict):
         model, opt, state = hgcn.init_lp(cfg, split.graph, seed=run.seed)
         ga = hgcn._device_graph(split.graph)
         if mesh is not None:
-            train_pos = jnp.asarray(hgcn.round_up_pairs(split.train_pos, mesh))
+            from hyperspace_tpu.parallel import multihost as mh
+
+            # per-host data plane: every process computes the SAME padded
+            # pair batch (round_up_pairs pads to a mesh multiple, so the
+            # rows divide evenly), feeds only its own row range, and
+            # distribute_batch assembles the global batch-sharded array —
+            # host→device supervision traffic scales 1/n_processes
+            # (single-process this is a plain sharded device_put)
+            train_pos = mh.distribute_batch(
+                jnp.asarray(hgcn.round_up_pairs(split.train_pos, mesh)), mesh)
             # default multi-chip path: node-sharded encoder — each device
             # owns N/ndev nodes and their incoming edges (mean AND
             # attention aggregation; the receiver partition keeps the
@@ -800,11 +821,13 @@ def main(argv: list[str] | None = None) -> int:
         chaos_armed = _faults.install_chaos(run.chaos, run.chaos_seed)
     except ValueError as e:  # malformed chaos= grammar is a usage error
         raise SystemExit(str(e)) from None
-    if run.multihost:
-        jax.distributed.initialize(
-            coordinator_address=run.coordinator,
-            num_processes=run.num_processes,
-            process_id=run.process_id)
+    if run.multihost and run.num_processes > 1:
+        # the ONE process-group entry point (parallel/multihost.py) —
+        # shared with the loopback harness, so CLI pods and the 2-process
+        # CPU drills form their groups identically
+        from hyperspace_tpu.parallel import multihost as mh
+
+        mh.initialize(run.coordinator, run.num_processes, run.process_id)
     from hyperspace_tpu.telemetry import cli_session
 
     # enabled BEFORE the workload runs (not inside run_loop) so host

@@ -119,3 +119,38 @@ class HostPrefetcher:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class ShardedHostPrefetcher(HostPrefetcher):
+    """Per-host data plane on top of :class:`HostPrefetcher`.
+
+    ``fn(index)`` must build the HOST-IDENTICAL global batch (every
+    process computes the same pytree deterministically — the runners'
+    existing contract); the worker thread keeps only THIS process's
+    leading-axis row range (``multihost.local_batch_rows``), so host
+    memory and host→device traffic scale with 1/n_processes, and
+    ``next()`` hands back ONE global batch-sharded array per leaf
+    (``multihost.assemble_global_batch``).  On a single process this
+    degenerates to ``device_put`` with batch sharding — the wiring is
+    identical at world size 1 and N.
+
+    The assembly happens on the consumer side because
+    ``host_local_array_to_global_array`` may issue a collective —
+    every process must reach it in the same order, which the consumer
+    loop guarantees and a free-running worker thread would not.
+    """
+
+    def __init__(self, fn: Callable[[int], Any], mesh, *, depth: int = 2,
+                 start: int = 0):
+        from hyperspace_tpu.parallel import multihost as mh
+
+        self._mesh = mesh
+        self._assemble = mh.assemble_global_batch
+
+        def local_only(index: int):
+            return mh.local_batch_shards(fn(index))
+
+        super().__init__(local_only, depth=depth, start=start)
+
+    def next(self) -> Any:
+        return self._assemble(super().next(), self._mesh)

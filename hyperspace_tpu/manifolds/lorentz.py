@@ -29,6 +29,32 @@ def minkowski_dot(x: jax.Array, y: jax.Array, keepdims: bool = True) -> jax.Arra
     return res if keepdims else res[..., 0]
 
 
+def _pad_last(x: jax.Array, lo: int, hi: int) -> jax.Array:
+    """Zero-pad the last axis by (lo, hi) — the time-coordinate
+    assembly primitive.  Every Lorentz lift/split used to be a
+    ``jnp.concatenate``; jax 0.4.37's GSPMD partitioner miscompiles
+    `concatenate` whose operands are sharded over a subset of a
+    multi-axis mesh (the dp×tp trap documented in
+    tests/parallel/test_node_sharded.py), so the lifts are written as
+    pad(+add) instead — `lax.pad` partitions cleanly.  Bitwise-equal to
+    the concat form (x + 0.0 and x - 0.0 are exact), except that a
+    -0.0 operand landing on a zero-padded lane comes out +0.0."""
+    cfg = [(0, 0)] * (x.ndim - 1) + [(lo, hi)]
+    return jnp.pad(x, cfg)
+
+
+def with_time_coordinate(space: jax.Array, c) -> jax.Array:
+    """Hyperboloid point from space coordinates: fix the time lane
+    t = sqrt(1/c + ‖space‖²) and assemble by pad+add (the ONE home of
+    the reconstruction — LorentzLinear and the attention heads route
+    through it, so no Lorentz lift ever re-grows a `concatenate`)."""
+    c = jnp.asarray(c, space.dtype)
+    t = smath.safe_sqrt(
+        1.0 / smath.clamp_min(c, smath.min_norm(space.dtype))
+        + smath.sq_norm(space))
+    return _pad_last(t, 0, space.shape[-1]) + _pad_last(space, 1, 0)
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class Lorentz(Manifold):
@@ -52,10 +78,7 @@ class Lorentz(Manifold):
 
     def proj(self, x: jax.Array) -> jax.Array:
         """Fix the time coordinate from the space coordinates."""
-        c = self._c(x.dtype)
-        sp = x[..., 1:]
-        t = smath.safe_sqrt(1.0 / smath.clamp_min(c, smath.min_norm(x.dtype)) + smath.sq_norm(sp))
-        return jnp.concatenate([t, sp], axis=-1)
+        return with_time_coordinate(x[..., 1:], self._c(x.dtype))
 
     def proju(self, x: jax.Array, u: jax.Array) -> jax.Array:
         """Tangent projection: u + c ⟨x,u⟩_L x (⟨x,x⟩_L = -1/c)."""
@@ -121,9 +144,8 @@ class Lorentz(Manifold):
 
     def origin(self, shape, dtype=jnp.float32) -> jax.Array:
         c = self._c(dtype)
-        o = jnp.zeros(shape, dtype)
         t = jnp.ones(shape[:-1] + (1,), dtype) / smath.sqrt_c(c)
-        return jnp.concatenate([t, o[..., 1:]], axis=-1)
+        return _pad_last(t, 0, shape[-1] - 1)
 
     # --- transport / metric ---------------------------------------------------
 
@@ -139,7 +161,9 @@ class Lorentz(Manifold):
 
     def egrad2rgrad(self, x: jax.Array, g: jax.Array) -> jax.Array:
         """Flip the time component (Minkowski metric inverse), then proju."""
-        gl = jnp.concatenate([-g[..., :1], g[..., 1:]], axis=-1)
+        # g - 2·pad(g₀): lane 0 is g₀ - 2g₀ = -g₀ (Sterbenz: exact),
+        # space lanes subtract an exact 0 — bitwise the concat form
+        gl = g - 2.0 * _pad_last(g[..., :1], 0, g.shape[-1] - 1)
         return self.proju(x, gl)
 
     def retr(self, x: jax.Array, v: jax.Array) -> jax.Array:
@@ -169,7 +193,7 @@ class Lorentz(Manifold):
         return ambient_dim - 1
 
     def tangent_from_origin_coords(self, v: jax.Array) -> jax.Array:
-        return jnp.concatenate([jnp.zeros_like(v[..., :1]), v], axis=-1)
+        return _pad_last(v, 1, 0)
 
     def origin_coords_from_tangent(self, u: jax.Array) -> jax.Array:
         return u[..., 1:]

@@ -548,7 +548,10 @@ def make_sharded_step_lp(
 
     # batch enters replicated and is constrained *in-program* (like
     # product_embed.make_sharded_step): a partitioned in_sharding would
-    # reject process-local arrays on a multi-host mesh
+    # reject process-local arrays on a multi-host mesh (and segfaults
+    # XLA CPU on jax 0.4.37 when combined with restored+donated state on
+    # a dp×tp mesh).  The per-host data plane feeds the node-sharded
+    # builder below, which takes pairs batch-sharded.
     step = jax.jit(
         partial(_lp_step_impl, model, opt, num_nodes, constrain=constrain,
                 split_pairs=_concat_hazard(mesh)),
@@ -600,7 +603,11 @@ def make_node_sharded_step_lp(
     step = jax.jit(
         partial(_lp_step_impl, model, opt, num_nodes, constrain=constrain,
                 split_pairs=_concat_hazard(mesh)),
-        in_shardings=(state_sh, graph_shardings(nsg), replicated(mesh)),
+        # pairs arrive BATCH-SHARDED (not replicated): the multi-process
+        # data plane feeds a global array each host assembled from only
+        # its own row range (multihost.distribute_batch); uncommitted
+        # single-process arrays get placed the same way
+        in_shardings=(state_sh, graph_shardings(nsg), bsh),
         out_shardings=(state_sh, replicated(mesh)),
         donate_argnums=(0,),
     )

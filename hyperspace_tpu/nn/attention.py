@@ -33,7 +33,7 @@ import jax.numpy as jnp
 
 from hyperspace_tpu.kernels.attention import flash_attention
 from hyperspace_tpu.manifolds import Lorentz
-from hyperspace_tpu.manifolds import smath
+from hyperspace_tpu.manifolds import lorentz, smath
 from hyperspace_tpu.nn.layers import LorentzLinear
 from hyperspace_tpu.precision import compute_matmul
 
@@ -197,10 +197,10 @@ class HypMultiHeadAttention(nn.Module):
             space = compute_matmul(x, kernel, self.compute_dtype)
             space = space.reshape(space.shape[:-1] + (h, dh))
             space = jnp.swapaxes(space, -3, -2)  # [..., h, N, dh]
-            c = jnp.asarray(m.c, x.dtype)
-            t = smath.safe_sqrt(1.0 / smath.clamp_min(c, smath.min_norm(x.dtype))
-                                + smath.sq_norm(space))
-            return jnp.concatenate([t, space], axis=-1)  # [..., h, N, dh+1]
+            # pad+add lift (manifolds/lorentz.with_time_coordinate):
+            # [..., h, N, dh+1]
+            return lorentz.with_time_coordinate(
+                space, jnp.asarray(m.c, x.dtype))
 
         q, k, v = proj("q", x_q), proj("k", x_kv), proj("v", x_kv)
         # per-head score bias/temperature, shaped to broadcast over [h, Nq, Nk]
@@ -219,9 +219,7 @@ class HypMultiHeadAttention(nn.Module):
         # concat head space-coords, reconstruct time on the joint hyperboloid
         o_sp = jnp.swapaxes(o[..., 1:], -3, -2)  # [..., N, h, dh]
         o_sp = o_sp.reshape(o_sp.shape[:-2] + (h * dh,))
-        c = jnp.asarray(m.c, x_q.dtype)
-        t = smath.safe_sqrt(1.0 / smath.clamp_min(c, smath.min_norm(x_q.dtype))
-                            + smath.sq_norm(o_sp))
-        merged = jnp.concatenate([t, o_sp], axis=-1)
+        merged = lorentz.with_time_coordinate(
+            o_sp, jnp.asarray(m.c, x_q.dtype))
         return LorentzLinear(self.dim, m, name="out",
                              compute_dtype=self.compute_dtype)(merged)

@@ -109,7 +109,9 @@ def tangent0_coords(manifold, x: jax.Array) -> jax.Array:
 def from_tangent0_coords(manifold, v: jax.Array) -> jax.Array:
     """Inverse of :func:`tangent0_coords` followed by expmap0."""
     if isinstance(manifold, Lorentz):
-        v = jnp.concatenate([jnp.zeros_like(v[..., :1]), v], axis=-1)
+        # zero-pad time-coordinate lift — pad, not concatenate (the
+        # sharded-path rule; see manifolds/lorentz._pad_last)
+        v = manifold.tangent_from_origin_coords(v)
     return manifold.expmap0(v)
 
 

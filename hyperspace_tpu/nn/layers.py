@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 from hyperspace_tpu.kernels.hyplinear import hyp_linear
 from hyperspace_tpu.manifolds import Lorentz, PoincareBall
-from hyperspace_tpu.manifolds import smath
+from hyperspace_tpu.manifolds import lorentz, smath
 from hyperspace_tpu.precision import compute_matmul
 
 
@@ -95,11 +95,10 @@ class LorentzLinear(nn.Module):
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros, (self.dim,), x.dtype)
             space = space + bias
-        c = jnp.asarray(self.manifold.c, x.dtype)
-        t = smath.safe_sqrt(
-            1.0 / smath.clamp_min(c, smath.min_norm(x.dtype)) + smath.sq_norm(space)
-        )
-        return jnp.concatenate([t, space], axis=-1)
+        # time-coordinate reconstruction: pad+add, never concatenate
+        # (manifolds/lorentz.with_time_coordinate — the sharded-path rule)
+        return lorentz.with_time_coordinate(
+            space, jnp.asarray(self.manifold.c, x.dtype))
 
 
 class HypAct(nn.Module):

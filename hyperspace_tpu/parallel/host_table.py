@@ -261,7 +261,7 @@ class HostEmbedTable:
             raise ValueError(f"shards must be >= 1; got {shards}")
         os.makedirs(directory, exist_ok=True)
         bounds = _shard_bounds(self.num_rows, shards)
-        ck = ocp.StandardCheckpointer()
+        ck = _solo_checkpointer("host_table_save")
         for i in range(shards):
             lo, hi = int(bounds[i]), int(bounds[i + 1])
             blk = self._slice_rows(lo, hi)
@@ -269,7 +269,7 @@ class HostEmbedTable:
             path = os.path.join(os.path.abspath(directory), f"shard_{i:05d}")
             ck.save(path, {"rows": blk}, force=True)
         ck.wait_until_finished()
-        with open(os.path.join(directory, MANIFEST), "w",
+        with open(os.path.join(directory, MANIFEST), "w",  # hyperlint: disable=multiprocess-unsafe-io — single-process API by contract; multihost callers go through save_owned_rows, whose manifest is process-0-gated
                   encoding="utf-8") as f:
             json.dump({
                 "version": FORMAT_VERSION,
@@ -308,11 +308,11 @@ class HostEmbedTable:
         new = _shard_bounds(n, int(shards or meta["shards"]))
         dest = [np.empty((int(new[i + 1] - new[i]), w), dtype)
                 for i in range(len(new) - 1)]
-        ck = ocp.StandardCheckpointer()
+        codec = meta.get("codec", "orbax")
+        ck = None if codec == "npy" else _solo_checkpointer("host_table_load")
         for i in range(len(saved) - 1):
             lo, hi = int(saved[i]), int(saved[i + 1])
-            path = os.path.join(os.path.abspath(directory), f"shard_{i:05d}")
-            blk = ck.restore(path)["rows"]
+            blk = _read_shard(directory, i, codec, ck)
             _track_io_rows(blk.shape[0])
             # copy this saved range into every overlapping new shard
             for j in range(len(dest)):
@@ -322,6 +322,128 @@ class HostEmbedTable:
                         blk[a - lo:b - lo]
             del blk
         return cls(dest)
+
+
+def _read_shard(directory: str, i: int, codec: str, ck=None) -> np.ndarray:
+    """One saved shard's rows, whichever codec wrote it: ``orbax``
+    (``save_sharded``'s single-process item format) or ``npy``
+    (``save_owned_rows``'s per-host format)."""
+    if codec == "npy":
+        return np.load(os.path.join(directory, f"shard_{i:05d}.npy"))
+    if codec != "orbax":
+        raise ValueError(f"unknown host-table codec {codec!r}")
+    ck = ck or _solo_checkpointer("host_table_load")
+    return ck.restore(
+        os.path.join(os.path.abspath(directory), f"shard_{i:05d}"))["rows"]
+
+
+def _solo_checkpointer(prefix: str):
+    """A ``StandardCheckpointer`` whose coordination is scoped to THIS
+    process.  Host-table shard items are per-process-private files —
+    cross-host ordering belongs to the caller's barrier — so Orbax's
+    default all-process barriers are never wanted here (and their
+    device-collective implementation aborts on the CPU loopback
+    backend).  Single-process behavior is unchanged."""
+    import jax as _jax
+    import orbax.checkpoint as ocp
+
+    if _jax.process_count() == 1:
+        return ocp.StandardCheckpointer()
+    pi = _jax.process_index()
+    return ocp.StandardCheckpointer(
+        multiprocessing_options=ocp.options.MultiprocessingOptions(
+            primary_host=pi, active_processes={pi},
+            barrier_sync_key_prefix=f"{prefix}{pi}"))
+
+
+def save_owned_rows(table: "HostEmbedTable", directory: str, *,
+                    process_index: Optional[int] = None,
+                    process_count: Optional[int] = None,
+                    barrier: Optional[Callable[[], None]] = None) -> None:
+    """Multi-process checkpoint of a host table: each process writes
+    ONLY its owned row range (``multihost.process_row_range`` — one
+    shard file per host, so checkpoint traffic scales with 1/n_hosts),
+    then everyone meets at ``barrier()``, and process 0 ALONE writes
+    the manifest.  The manifest is the commit point: a reader that
+    races a crash mid-save finds shard files but no manifest and sees
+    no checkpoint (``load_sharded`` raises), never a torn table.
+
+    Shards are flat ``.npy`` files (fsync + atomic rename), NOT Orbax
+    items: Orbax 0.7's numpy handler writes array data only on GLOBAL
+    process 0 whatever ``MultiprocessingOptions`` scope it is given, so
+    a per-host-private write path needs a per-host-private codec.  The
+    manifest records ``codec: "npy"`` and keeps ``save_sharded``'s
+    bounds contract, so :meth:`HostEmbedTable.load_sharded` restores it
+    at ANY process/shard count — the PR 14 shard-count-elastic restore,
+    lifted to hosts (a 2-host checkpoint restores bit-identically on
+    1 host and vice versa; tested).
+    """
+    import jax as _jax
+
+    pi = _jax.process_index() if process_index is None else int(process_index)
+    pc = _jax.process_count() if process_count is None else int(process_count)
+    if not 0 <= pi < pc:
+        raise ValueError(f"process {pi} out of range [0, {pc})")
+    os.makedirs(directory, exist_ok=True)
+    bounds = _shard_bounds(table.num_rows, pc)
+    lo, hi = int(bounds[pi]), int(bounds[pi + 1])
+    blk = table._slice_rows(lo, hi)
+    _track_io_rows(blk.shape[0])
+    path = os.path.join(directory, f"shard_{pi:05d}.npy")
+    tmp = f"{path}.tmp.{pi}"
+    with open(tmp, "wb") as f:
+        np.save(f, np.ascontiguousarray(blk))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)  # shard durable before it becomes visible
+    if barrier is not None:
+        barrier()  # every host's shard file is durable before commit
+    if pi == 0:
+        # the commit marker: written LAST, by process 0 only
+        with open(os.path.join(directory, MANIFEST), "w",
+                  encoding="utf-8") as f:
+            json.dump({
+                "version": FORMAT_VERSION, "codec": "npy",
+                "num_rows": table.num_rows, "width": table.width,
+                "dtype": str(np.dtype(table.dtype)), "shards": pc,
+                "bounds": [int(b) for b in bounds],
+            }, f)
+    if barrier is not None:
+        barrier()  # no host returns before the checkpoint is committed
+
+
+def load_rows(directory: str, lo: int, hi: int) -> np.ndarray:
+    """Rows ``[lo, hi)`` of a saved table, reading ONLY the overlapping
+    shard items — the per-host restore path (each host re-reads just
+    its owned range, whatever process count wrote the checkpoint)."""
+    with open(os.path.join(directory, MANIFEST), encoding="utf-8") as f:
+        meta = json.load(f)
+    if meta.get("version") != FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported host-table format {meta.get('version')!r}")
+    n, w = int(meta["num_rows"]), int(meta["width"])
+    if not 0 <= lo <= hi <= n:
+        raise ValueError(f"rows [{lo}, {hi}) out of range [0, {n}]")
+    saved = np.asarray(meta["bounds"], np.int64)
+    out = np.empty((hi - lo, w), np.dtype(meta["dtype"]))
+    codec = meta.get("codec", "orbax")
+    ck = None if codec == "npy" else _solo_checkpointer("host_table_load")
+    for i in range(len(saved) - 1):
+        slo, shi = int(saved[i]), int(saved[i + 1])
+        a, b = max(lo, slo), min(hi, shi)
+        if a >= b:
+            continue
+        if codec == "npy":
+            # mmap: only the overlapping rows are ever read off disk
+            blk = np.load(os.path.join(directory, f"shard_{i:05d}.npy"),
+                          mmap_mode="r")
+            _track_io_rows(b - a)
+        else:
+            blk = _read_shard(directory, i, codec, ck)
+            _track_io_rows(blk.shape[0])
+        out[a - lo:b - lo] = blk[a - slo:b - slo]
+        del blk
+    return out
 
 
 def _next_bucket(n: int, cap: int) -> int:

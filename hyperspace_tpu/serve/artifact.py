@@ -305,6 +305,22 @@ def _make_artifact(table, spec, model_config, step,
         index=index, quant=quant)
 
 
+def _process_topology() -> tuple[int, int]:
+    """(process_index, process_count) without importing jax: the serve
+    plane must stay importable (and fast) in jax-free consumers, so the
+    topology is read only when jax is ALREADY loaded and initialized."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return 0, 1
+    import jax
+
+    try:
+        return jax.process_index(), jax.process_count()
+    except RuntimeError:  # backend not initialized yet: single process
+        return 0, 1
+
+
 def export_artifact(directory: str, table, manifold_spec: tuple, *,
                     model_config: Optional[dict] = None,
                     step: Optional[int] = None,
@@ -317,7 +333,20 @@ def export_artifact(directory: str, table, manifold_spec: tuple, *,
     ``overwrite=True`` (then it is replaced; the replace itself is
     rename-then-delete, so a reader holding the old dir open keeps a
     consistent view).
+
+    Multi-process safe: in a ``jax.distributed`` run, process 0 ALONE
+    writes (a pod run yields ONE artifact — N processes racing the
+    staging rename would corrupt nothing but would leave N-1 stranded
+    ``.old`` trees); the other processes wait at a barrier for the
+    commit and return the committed artifact.  Every process must call
+    this (it is a collective).
     """
+    pi, pc = _process_topology()
+    if pc > 1 and pi != 0:
+        from hyperspace_tpu.parallel import multihost as mh
+
+        mh.sync("artifact_export")  # meets process 0's post-commit sync
+        return load_artifact(directory)
     art = _make_artifact(table, manifold_spec, model_config, step, index,
                          quant)
     directory = os.path.abspath(directory)
@@ -385,6 +414,10 @@ def export_artifact(directory: str, table, manifold_spec: tuple, *,
     except BaseException:
         shutil.rmtree(staging, ignore_errors=True)
         raise
+    if pc > 1:
+        from hyperspace_tpu.parallel import multihost as mh
+
+        mh.sync("artifact_export")  # release the waiting processes
     return art
 
 

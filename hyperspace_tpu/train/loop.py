@@ -138,6 +138,12 @@ def resume_chunk(ckpt_dir: Optional[str], resume: bool,
 def _logger(run):
     from hyperspace_tpu.train.logging import MetricsLogger
 
+    if jax.process_index() != 0:
+        # multi-process runs: every process computes IDENTICAL losses
+        # (DP steps end in an all-reduce), so N processes writing the
+        # same JSONL/TB path would race each other for no information —
+        # the run log is a process-0 artifact (docs/multihost.md)
+        return MetricsLogger(None, stdout=False, tensorboard_dir=None)
     return MetricsLogger(run.log, stdout=False,
                          tensorboard_dir=run.tensorboard_dir)
 
@@ -319,7 +325,8 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
         install_hooks()
     monitor, health_every = _health_monitor(run, health_fn)
     mwriter = None
-    metrics_out = getattr(run, "metrics_out", None)
+    metrics_out = (getattr(run, "metrics_out", None)
+                   if jax.process_index() == 0 else None)
     if metrics_out:
         # Prometheus-text file snapshotter (telemetry/exposition.py):
         # a training job becomes scrapeable-by-file; checked at chunk
@@ -546,6 +553,20 @@ def run_loop(run, state, stepper, project=None, steps_per_call=1,
             summary = reg.snapshot("ctr/", baseline=counter_base)
             if tracer is not None:
                 summary.update(tracer.total_fields())
+            if jax.process_count() > 1:
+                # fleet view (docs/observability.md "Multihost metric
+                # aggregation", exercised by real training since this
+                # loop went multi-process): every process contributes
+                # its raw export over ONE allgather; counters sum,
+                # gauges max — logged process-0-side as fleet/* fields
+                from hyperspace_tpu.parallel.multihost import (
+                    gather_metric_exports)
+                from hyperspace_tpu.telemetry.aggregate import merge_exports
+
+                fc, fg, _ = merge_exports(gather_metric_exports(reg))
+                summary["fleet_processes"] = jax.process_count()
+                summary.update({f"fleet/{k}": v for k, v in fc.items()})
+                summary.update({f"fleet/{k}": v for k, v in fg.items()})
             log.event("telemetry_summary", steps=int(done), **summary)
         if mwriter is not None:
             try:

@@ -56,7 +56,12 @@ SENTINEL_METRICS = {"error", "budget_exhausted"}
 _SKIP_DETAIL_KEYS = {"telemetry", "traceback"}
 
 _HIGHER_TOKENS = ("per_s", "per_sec", "qps", "samples", "speedup",
-                  "recall", "rate", "auc", "frac", "roofline", "ratio")
+                  "recall", "rate", "auc", "frac", "roofline", "ratio",
+                  # the r19 pod-scaling leg: scaling_efficiency (fleet
+                  # throughput over N× single-process) — closer to
+                  # linear is better; its multihost_ok verdict is a
+                  # JSON bool and therefore never a gated series at all
+                  "scaling", "efficiency")
 _LOWER_TOKENS = ("time", "stall", "waste", "recompile", "epoch_s",
                  "compile", "latency", "ttfq")
 # lower-better tokens that outrank the higher-better list: "ratio" is

@@ -1,0 +1,30 @@
+"""Bad fixture: unguarded filesystem writes in a multihost-reachable
+module — every process on a pod would race these against one shared
+filesystem (linted under a pretend hyperspace_tpu/parallel/ rel path)."""
+
+import json
+import os
+import shutil
+
+
+def save_manifest(directory, meta):
+    # no process gate, shared path: N writers race the manifest
+    with open(os.path.join(directory, "MANIFEST.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def commit(tmp_path, final_path):
+    os.replace(tmp_path, final_path)  # racing atomic commits
+
+
+def publish(src, dst):
+    shutil.move(src, dst)
+
+
+def note(path):
+    path.write_text("done")
+
+
+def append_row(path, row):
+    with open(path, mode="a") as f:
+        f.write(row)

@@ -1,0 +1,43 @@
+"""Good fixture: every write is process-0-gated or per-host-pathed —
+the two sanctioned shapes (docs/multihost.md), plus reads, which never
+fire."""
+
+import json
+import os
+
+import jax
+
+from hyperspace_tpu.parallel import multihost as mh
+
+
+def save_manifest(directory, meta):
+    if jax.process_index() == 0:  # ONE writer commits shared state
+        with open(os.path.join(directory, "MANIFEST.json"), "w") as f:
+            json.dump(meta, f)
+
+
+def export(directory, payload):
+    if mh.is_primary():
+        with open(os.path.join(directory, "artifact.json"), "w") as f:
+            f.write(payload)
+
+
+def save_shard(directory, block):
+    pi = jax.process_index()
+    path = os.path.join(directory, f"shard_{pi:05d}.npy")  # per-host path
+    tmp = f"{path}.tmp.{pi}"
+    with open(tmp, "wb") as f:
+        f.write(block)
+    os.replace(tmp, path)  # target resolves to the per-host path
+
+
+def append_trend(path, row):
+    if jax.process_index() != 0:
+        return  # early-exit gate: only process 0 reaches the write
+    with open(path, "a") as f:
+        f.write(row)
+
+
+def read_config(path):
+    with open(path) as f:  # reads are always safe
+        return json.load(f)

@@ -24,6 +24,7 @@ from hyperspace_tpu.analysis.rules.hosttable import (
     FullTableMaterializationRule)
 from hyperspace_tpu.analysis.rules.jitcache import JitCacheDefeatRule
 from hyperspace_tpu.analysis.rules.monoclock import MonotonicClockRule
+from hyperspace_tpu.analysis.rules.mpio import MultiprocessUnsafeIORule
 from hyperspace_tpu.analysis.rules.packing import PackingLiteralRule
 from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
@@ -60,6 +61,8 @@ _PER_FILE = [
     ("bad_units.py", MetricUnitSuffixRule, None),
     ("bad_monoclock.py", MonotonicClockRule,
      "hyperspace_tpu/serve/bad_monoclock.py"),
+    ("bad_mpio.py", MultiprocessUnsafeIORule,
+     "hyperspace_tpu/parallel/bad_mpio.py"),
 ]
 
 
@@ -764,3 +767,38 @@ def test_catalog_shim_falls_back_on_unparseable_file(tmp_path):
         '    reg.observe("ns/hist_ms", 1.0)\n')
     found = counters_in_code(str(pkg))
     assert {"ns/good", "ns/broken", "ns/read", "ns/hist_ms"} <= set(found)
+
+
+# --- multiprocess-unsafe-io ---------------------------------------------------
+
+_MPIO_REL = "hyperspace_tpu/parallel/bad_mpio.py"
+
+
+def test_mpio_bad_fixture_fires_every_shape():
+    report = _lint("bad_mpio.py", MultiprocessUnsafeIORule, rel=_MPIO_REL)
+    msgs = [f.message for f in report.findings]
+    assert report.exit_code() == 1 and len(report.findings) == 5
+    assert all("multihost-reachable" in m for m in msgs)
+    assert any("os.replace" in m for m in msgs)
+    assert any("shutil.move" in m for m in msgs)
+    assert any(".write_text()" in m for m in msgs)
+
+
+def test_mpio_good_fixture_is_clean():
+    assert _lint("good_mpio.py", MultiprocessUnsafeIORule,
+                 rel="hyperspace_tpu/parallel/good_mpio.py").findings == []
+
+
+@pytest.mark.parametrize("rel", [
+    "hyperspace_tpu/serve/engine.py",   # serve plane: artifact.py only
+    "hyperspace_tpu/models/hgcn.py",    # model code never does IO
+    "scripts/bench_trend.py",           # driver-side, single process
+    "bad_mpio.py",                      # bare rel: outside the package
+])
+def test_mpio_scope_is_multihost_reachable_modules_only(rel):
+    assert _lint("bad_mpio.py", MultiprocessUnsafeIORule,
+                 rel=rel).findings == []
+
+
+def test_mpio_severity_is_warning():
+    assert MultiprocessUnsafeIORule.severity == "warning"

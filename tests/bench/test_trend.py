@@ -322,6 +322,32 @@ def test_direction_during_rollover_inherits_base_metric():
     assert mod.direction("during_rollover") is None
 
 
+def test_direction_scaling_efficiency_is_higher_better():
+    """The r19 pod-scaling leg: ``scaling_efficiency`` (2-proc fleet
+    throughput over 2× 1-proc) gates higher-is-better — drifting away
+    from linear scaling is the regression.  Its ``multihost_ok``
+    verdict is a JSON bool, and bools are excluded at flatten time
+    (flags are config, not measurements), so the verdict can never
+    become a gated series."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_trend", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for name in ("multihost_scaling_efficiency",
+                 "detail.multihost.scaling_efficiency",
+                 "scaling_efficiency"):
+        assert mod.direction(name) == "higher", name
+    # per-proc-count throughput rows keep their own directions
+    assert mod.direction(
+        "detail.multihost.procs.2.steps_per_s") == "higher"
+    assert mod.direction(
+        "detail.multihost.procs.2.step_time_s") == "lower"
+    flat = mod._flatten_numeric(
+        {"multihost_ok": True, "scaling_efficiency": 0.5})
+    assert "scaling_efficiency" in flat and "multihost_ok" not in flat
+
+
 def test_budget_exhausted_primary_never_gates(tmp_path):
     """A record whose metric is real but whose detail carries
     budget_exhausted (the watchdog's partial artifact — the checked-in

@@ -153,9 +153,13 @@ def run_hgcn(args, mh) -> int:
     model2, opt2, state2 = hgcn.init_lp(cfg, split.graph, seed=1)
     nstep, state2, nsg = hgcn.make_node_sharded_step_lp(
         model2, opt2, 128, mesh, state2, split)
+    # per-host data plane: the node-sharded step takes its supervision
+    # batch SHARDED, so each host contributes only its own row slice
+    # and the global [P, 2] batch is assembled across processes
+    train_pos_g = mh.distribute_batch(train_pos, mesh)
     ns_losses = []
     for _ in range(args.steps):
-        state2, nloss = nstep(state2, nsg, train_pos)
+        state2, nloss = nstep(state2, nsg, train_pos_g)
         ns_losses.append(float(jax.device_get(nloss)))
     if args.pid == 0:
         print("RESULT " + json.dumps({

@@ -53,28 +53,20 @@ def _run_sharded(cfg, split, steps, axes, train_pos):
     # diagnosis; PR 9 bisected the real op-level cause: jax 0.4.37
     # GSPMD MISCOMPILES `concatenate` whose operands/consumers are
     # sharded over a subset of a multi-axis mesh's axes — values
-    # garbled, not reordered (minimal repro: tests/parallel/
+    # garbled, not reordered (minimal repro, KEPT xfailed as the bug's
+    # documentation: tests/parallel/
     # test_node_sharded.py::test_gspmd_concat_constraint_miscompile).
-    # The supervision-pair concat instance is fixed for every mesh
-    # (hgcn.split_pair_logits: no pair concat under multi-axis meshes —
-    # the node-sharded dp×tp twin now gates green, exact).  THIS legacy
-    # pair-sharded path additionally hits the bug through the Lorentz
-    # time-coordinate concatenates (manifolds/lorentz.py, nn/gcn.py:
-    # `concatenate([t, space], -1)`) when tp column-sharding puts the
-    # model axis on the feature dim — the replicated-graph encoder's
-    # whole hidden state rides through them; bisect evidence: poincare
-    # and euclidean kinds (no time-coord concat) are EXACT on this
-    # exact config, lorentz alone returns garbage (~59 vs 0.54 loss at
-    # identical params).  Rewriting every Lorentz lift as pad+add is
-    # the known dodge; parked until the kernel pass that owns that
-    # surface (ROADMAP 1).  Expected to pass on a jax whose partitioner
-    # assembles sharded concats correctly.
-    pytest.param({"data": 4, "model": 2}, marks=pytest.mark.xfail(
-        strict=False,
-        reason="jax 0.4.37 GSPMD concatenate miscompile (values "
-               "garbled) via the Lorentz time-coordinate concats under "
-               "model-axis column sharding — see parametrize comment; "
-               "poincare/euclidean are exact on the same mesh")),
+    # The supervision-pair concat instance was fixed for every mesh by
+    # hgcn.split_pair_logits; this legacy pair-sharded path additionally
+    # hit the bug through the Lorentz time-coordinate concatenates when
+    # tp column-sharding put the model axis on the feature dim — bisect
+    # evidence: poincare/euclidean (no time-coord concat) were EXACT on
+    # this config, lorentz alone returned garbage (~59 vs 0.54 loss at
+    # identical params).  GREEN since every Lorentz lift was rewritten
+    # as pad+add (manifolds/lorentz._pad_last / with_time_coordinate,
+    # bitwise-pinned by tests/manifolds/test_lorentz_padadd.py) — the
+    # xfail that sat here from PR 3 is retired.
+    pytest.param({"data": 4, "model": 2}),
     pytest.param({"host": 2, "data": 4}, marks=pytest.mark.slow),
 ])
 def test_sharded_lp_matches_single_device(axes):

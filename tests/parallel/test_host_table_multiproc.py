@@ -1,0 +1,161 @@
+"""Per-host row ownership of the host table (the pod data plane).
+
+`multihost.process_row_range` carves the global row space into disjoint
+near-equal per-process ranges (the SAME split convention as
+`host_table._shard_bounds`); `host_table.save_owned_rows` has each
+process write only its owned range (one flat .npy file per host — a
+per-host-private codec, since Orbax's numpy handler only writes data on
+global process 0) plus a process-0 manifest commit, keeping
+`save_sharded`'s bounds contract — so a checkpoint written at ANY
+process count restores at any other, bit-identically per row.  These tests exercise the whole surface in one process by
+passing explicit (index, count) pairs — the real 2-process drill lives
+in tests/parallel/test_multihost_smoke.py and scripts/check_multihost.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.parallel import host_table as HT
+from hyperspace_tpu.parallel import multihost as mh
+from hyperspace_tpu.parallel.host_table import HostEmbedTable
+
+
+@pytest.mark.parametrize("num_rows,count", [
+    (10, 1), (10, 3), (7, 7), (8, 3), (1000, 4), (5, 8)])
+def test_process_row_range_disjoint_and_covering(num_rows, count):
+    ranges = [mh.process_row_range(num_rows, i, count) for i in range(count)]
+    # contiguous, ordered, disjoint, covering
+    assert ranges[0][0] == 0 and ranges[-1][1] == num_rows
+    for (alo, ahi), (blo, bhi) in zip(ranges, ranges[1:]):
+        assert ahi == blo and alo <= ahi and blo <= bhi
+    # near-equal: sizes differ by at most one row
+    sizes = [hi - lo for lo, hi in ranges]
+    assert max(sizes) - min(sizes) <= 1
+    # same convention as the table's own shard split
+    assert [lo for lo, _ in ranges] == list(
+        HT._shard_bounds(num_rows, count)[:-1])
+
+
+def test_process_row_range_rejects_bad_index():
+    with pytest.raises(ValueError, match="out of range"):
+        mh.process_row_range(10, 3, 3)
+
+
+@pytest.mark.parametrize("writer_count,reader_shards", [
+    (2, 1), (1, 2), (2, 3), (3, 2), (4, 1)])
+def test_save_owned_restores_elastically(tmp_path, writer_count,
+                                         reader_shards):
+    """A checkpoint written cooperatively by N simulated processes is
+    bit-identical when restored at ANY shard count — and identical to
+    what save_sharded would have written."""
+    rng = np.random.default_rng(3)
+    arr = rng.standard_normal((37, 5)).astype(np.float32)
+    table = HostEmbedTable.from_array(arr, shards=2)
+
+    d = tmp_path / "owned"
+    barriers = []
+    for pi in range(writer_count):  # every "process" runs the same call
+        HT.save_owned_rows(table, str(d), process_index=pi,
+                           process_count=writer_count,
+                           barrier=lambda: barriers.append(1))
+    assert len(barriers) == 2 * writer_count  # pre-commit + post-commit
+
+    back = HostEmbedTable.load_sharded(str(d), shards=reader_shards)
+    assert back.num_shards == reader_shards
+    assert back.to_array().tobytes() == arr.tobytes()
+
+
+def test_save_owned_manifest_written_only_by_process_zero(tmp_path):
+    d = tmp_path / "partial"
+    rng = np.random.default_rng(4)
+    table = HostEmbedTable.from_array(
+        rng.standard_normal((12, 3)).astype(np.float32))
+    # process 1 alone: shard file lands, NO manifest → not committed
+    HT.save_owned_rows(table, str(d), process_index=1, process_count=2)
+    assert (d / "shard_00001.npy").exists()
+    assert not (d / HT.MANIFEST).exists()
+    with pytest.raises(FileNotFoundError):
+        HostEmbedTable.load_sharded(str(d))
+    # process 0 joins: manifest appears, checkpoint is live
+    HT.save_owned_rows(table, str(d), process_index=0, process_count=2)
+    assert (d / HT.MANIFEST).exists()
+
+
+def test_load_rows_reads_only_owned_range(tmp_path):
+    rng = np.random.default_rng(5)
+    arr = rng.standard_normal((31, 4)).astype(np.float32)
+    d = tmp_path / "t"
+    HostEmbedTable.from_array(arr, shards=3).save_sharded(str(d))
+
+    for count in (1, 2, 4):
+        for pi in range(count):
+            lo, hi = mh.process_row_range(31, pi, count)
+            got = HT.load_rows(str(d), lo, hi)
+            assert got.tobytes() == arr[lo:hi].tobytes()
+    with pytest.raises(ValueError, match="out of range"):
+        HT.load_rows(str(d), 5, 40)
+
+
+def test_local_batch_shards_cover_batch():
+    """Simulated per-process batch shards are disjoint rows of the
+    host-identical batch and re-concatenate to it exactly."""
+    batch = {"x": np.arange(24).reshape(12, 2), "y": np.arange(12)}
+    for count in (1, 2, 3, 4):
+        parts = [jax.tree_util.tree_map(
+            lambda a, i=i: mh.local_batch_rows(a, i, count), batch)
+            for i in range(count)]
+        for key in batch:
+            cat = np.concatenate([p[key] for p in parts], axis=0)
+            assert cat.tobytes() == batch[key].tobytes()
+
+
+def test_distribute_batch_single_process_matches_device_put():
+    from hyperspace_tpu.parallel.mesh import batch_sharding, make_mesh
+
+    mesh = make_mesh({"data": -1})
+    x = jnp.arange(32.0).reshape(8, 4)
+    out = mh.distribute_batch({"x": x}, mesh)["x"]
+    assert out.sharding == batch_sharding(mesh, 2)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_distribute_batch_rejects_indivisible(monkeypatch):
+    from hyperspace_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(ValueError, match="pad the batch"):
+        mh.local_batch_shards({"x": np.zeros((7, 3))})
+
+
+def test_sharded_prefetcher_single_process_orders_and_shards():
+    """ShardedHostPrefetcher at world size 1: same ordering contract as
+    HostPrefetcher, leaves land batch-sharded on the mesh."""
+    from hyperspace_tpu.data.prefetch import ShardedHostPrefetcher
+    from hyperspace_tpu.parallel.mesh import batch_sharding, make_mesh
+
+    mesh = make_mesh({"data": -1})
+
+    def make(i):
+        return {"x": np.full((8, 2), float(i), np.float32)}
+
+    with ShardedHostPrefetcher(make, mesh, depth=2) as pf:
+        for i in range(5):
+            b = pf.next()
+            assert b["x"].sharding == batch_sharding(mesh, 2)
+            assert float(np.asarray(b["x"])[0, 0]) == float(i)
+
+
+def test_sharded_prefetcher_propagates_worker_error():
+    from hyperspace_tpu.data.prefetch import ShardedHostPrefetcher
+    from hyperspace_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"data": -1})
+
+    def boom(i):
+        raise IOError("batch source died")
+
+    with ShardedHostPrefetcher(boom, mesh, depth=1) as pf:
+        with pytest.raises(RuntimeError, match="worker failed"):
+            pf.next()
