@@ -1859,6 +1859,279 @@ def bench_big_table(repeats: int = 1, *, rows: int = 10_000_000,
             "unit": "queries/s", "vs_baseline": None, "detail": detail}
 
 
+def bench_multitenant(repeats: int = 1, *, qps: float = 100.0,
+                      duration_s: float = 2.0, table_rows: int = 4_000,
+                      mix=(0.8, 0.15, 0.05)) -> dict:
+    """Multi-tenant front door under a skewed tenant mix (docs/
+    serving.md "Multi-tenant front door", ISSUE 20).
+
+    One in-process HTTP front door over an :class:`EngineRegistry`
+    (serve/registry.py) holding THREE tenant stacks (hot/mid/cold —
+    the offered mix is Zipf-flavored: ``mix`` of the traffic each),
+    driven open-loop through four phases:
+
+    - **steady**: fixed offered load with the tenant sampled per
+      request from ``mix`` — ``aggregate_qps`` (answered/s across all
+      tenants, the headline) plus per-tenant p50/p95/p99 from each
+      tenant's own ``serve/e2e_ms@tenant=`` histogram delta, and
+      ``recompiles_steady`` (0 is the contract: the warmup walked
+      every tenant's bucket ladder, and tenants share no programs
+      beyond their scan signature);
+    - **fairness**: the cold tenant's trickle is measured solo, then
+      again while the hot tenant saturates the shared one-worker
+      dispatch executor — the deficit-round-robin dispatcher bounds
+      the damage, ``fairness`` = starved p99 / solo p99 (lower is
+      better; the verdict allows max(200 ms, 20x solo) on a noisy
+      CPU host);
+    - **isolation**: every tenant's served top-k must be BITWISE the
+      answer of a solo engine over its own table — cross-tenant cache
+      or program leaks cannot fail politely;
+    - **paging storm**: a second registry under a device budget that
+      holds ONE resident engine; round-robin queries force whole-
+      engine evict/re-admit cycles and every post-re-admission answer
+      must stay bitwise (the host-resident artifact is the master
+      copy), with the observed cold-admission latencies reported.
+
+    Value = steady ``aggregate_qps`` (higher is better).
+    ``multitenant_ok`` rolls up recompiles==0 + isolation + fairness +
+    paging-actually-paged.
+    """
+    import asyncio
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hyperspace_tpu.manifolds import PoincareBall
+    from hyperspace_tpu.serve.engine import QueryEngine
+    from hyperspace_tpu.serve.registry import EngineRegistry
+    from hyperspace_tpu.serve.server import HttpFrontDoor
+    from hyperspace_tpu.telemetry import registry as telem
+
+    telem.install_jax_monitoring_hook()
+    rng = np.random.default_rng(0)
+    n, dim, k = table_rows, 16, 10
+    names = ("hot", "mid", "cold")
+    tables = {
+        name: np.asarray(PoincareBall(1.0).expmap0(jnp.asarray(
+            rng.standard_normal((n, dim)) * 0.3, jnp.float32)))
+        for name in names
+    }
+    solo = {name: QueryEngine(tables[name], ("poincare", 1.0))
+            for name in names}
+    probe_ids = [0, 3, 17, 29]
+    expect = {name: solo[name].topk_neighbors(
+        np.asarray(probe_ids, np.int32), k) for name in names}
+    reg = telem.default_registry()
+
+    async def _post(host, port, payload):
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            body = json.dumps(payload).encode("utf-8")
+            writer.write(
+                (f"POST /v1/topk HTTP/1.1\r\nHost: bench\r\n"
+                 f"Content-Length: {len(body)}\r\n"
+                 "Connection: close\r\n\r\n").encode("latin-1") + body)
+            await writer.drain()
+            data = await reader.read()
+        finally:
+            writer.close()
+        head, _, raw = data.partition(b"\r\n\r\n")
+        status = int(head.split(None, 2)[1])
+        try:
+            return status, json.loads(raw.decode())
+        except ValueError:
+            return status, {}
+
+    async def _drive(host, port, tenant_of, size, pass_qps, n_req,
+                     seed):
+        """Open-loop pass: ``tenant_of(i)`` names each request's
+        tenant (clock-scheduled arrivals — a starved tenant queues,
+        it never throttles the offered load)."""
+        offsets = open_loop_arrivals(n_req, pass_qps, "poisson", seed)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        tasks = []
+        for i, off in enumerate(offsets):
+            delay = t0 + float(off) - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            payload = {"ids": rng.integers(0, n, size=size).tolist(),
+                       "k": k, "tenant": tenant_of(i)}
+            tasks.append(asyncio.ensure_future(
+                _post(host, port, payload)))
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        elapsed = loop.time() - t0
+        statuses: dict = {}
+        for r in results:
+            key = (f"error:{type(r).__name__}"
+                   if isinstance(r, BaseException) else str(int(r[0])))
+            statuses[key] = statuses.get(key, 0) + 1
+        return statuses, elapsed
+
+    def _tenant_p(delta, tenant):
+        e2e = delta.get(f"hist/serve/e2e_ms@tenant={tenant}")
+        if not e2e or not e2e.get("count"):
+            return None
+        return {"n": e2e["count"],
+                **{q: e2e[q] for q in ("p50", "p95", "p99")}}
+
+    def _mk_registry(budget_mb, art_dir):
+        r = EngineRegistry(device_budget_mb=budget_mb,
+                           max_wait_us=2000.0)
+        for name in names:
+            r.add_tenant(name, os.path.join(art_dir, name),
+                         weight=1.0, window_s=0.0,
+                         batcher_kw=dict(min_bucket=8, max_bucket=64,
+                                         cache_size=0, queue_max=256))
+        return r
+
+    async def _probe_bitwise(host, port, name):
+        """One tenant's served top-k vs its solo engine, bit for bit
+        — the structural-isolation (and post-re-admission) check."""
+        status, body = await _post(
+            host, port, {"ids": probe_ids, "k": k, "tenant": name})
+        if status != 200:
+            return False
+        li, ld = (np.asarray(a) for a in expect[name])
+        return (np.array_equal(li, np.asarray(body["neighbors"]))
+                and np.array_equal(
+                    ld.astype(np.float32).view(np.uint32),
+                    np.asarray(body["dists"],
+                               np.float32).view(np.uint32)))
+
+    async def _run(art_dir):
+        detail: dict = {
+            "num_nodes": n, "dim": dim, "k": k, "tenants": list(names),
+            "mix": list(mix), "offered_qps": qps,
+            "duration_s": duration_s,
+            "backend": jax.default_backend(),
+        }
+        registry = _mk_registry(0.0, art_dir)
+        door = HttpFrontDoor(registry=registry, max_wait_us=2000)
+        await door.start()
+        c0 = reg.get("jax/recompiles")
+        # closed-loop warmup: every tenant × every bucket rung, so the
+        # mixed-tenant timed phase can never hand the compiler a fresh
+        # shape (collation may pad any tenant's queue to any rung)
+        for name in names:
+            for b in registry.resolve(name).batcher.buckets:
+                await _post(door.host, door.port,
+                            {"ids": rng.integers(0, n, size=b).tolist(),
+                             "k": k, "tenant": name})
+        c1 = reg.get("jax/recompiles")
+        detail["recompiles_warmup"] = c1 - c0
+
+        # --- steady: Zipf-mix offered load, per-tenant percentiles ---
+        n_req = max(16, int(qps * duration_s))
+        picks = rng.choice(len(names), size=n_req, p=list(mix))
+        base = reg.mark()
+        statuses, elapsed = await _drive(
+            door.host, door.port, lambda i: names[picks[i]], 16, qps,
+            n_req, 7)
+        delta = reg.snapshot(baseline=base)
+        answered = sum(v for s, v in statuses.items()
+                       if not s.startswith("error"))
+        detail["steady"] = {
+            "statuses": statuses,
+            "aggregate_qps": round(answered / max(elapsed, 1e-9), 1),
+            "per_tenant_ms": {t: _tenant_p(delta, t) for t in names},
+        }
+        agg = delta.get("hist/serve/e2e_ms")
+        if not agg or not agg.get("count"):
+            await door.drain()
+            raise RuntimeError(
+                f"multitenant: no successful steady request — "
+                f"{statuses}")
+        detail["aggregate_qps"] = detail["steady"]["aggregate_qps"]
+        detail["steady"]["p99_ms"] = agg["p99"]
+        detail["recompiles_steady"] = reg.get("jax/recompiles") - c1
+
+        # --- isolation: every tenant bitwise vs its solo engine ------
+        # (probed BEFORE the fairness flood: the flood legitimately
+        # walks the hot tenant down its degradation ladder, and a
+        # degraded answer is supposed to differ)
+        isolation = {t: await _probe_bitwise(door.host, door.port, t)
+                     for t in names}
+        detail["isolation_bitwise"] = isolation
+
+        # --- fairness: cold trickle solo, then under a hot flood ----
+        trickle_qps, trickle_n = 25.0, 30
+        base = reg.mark()
+        await _drive(door.host, door.port, lambda i: "cold", 16,
+                     trickle_qps, trickle_n, 21)
+        solo_p = _tenant_p(reg.snapshot(baseline=base), "cold")
+        base = reg.mark()
+        flood_n = max(32, int(qps * 6 * 1.2))
+        _, _ = await asyncio.gather(
+            _drive(door.host, door.port, lambda i: "hot", 16, qps * 6,
+                   flood_n, 33),
+            _drive(door.host, door.port, lambda i: "cold", 16,
+                   trickle_qps, trickle_n, 34))
+        starved_p = _tenant_p(reg.snapshot(baseline=base), "cold")
+        if solo_p and starved_p:
+            solo_p99 = max(solo_p["p99"], 0.05)
+            detail["fairness_detail"] = {
+                "solo_p99_ms": solo_p["p99"],
+                "starved_p99_ms": starved_p["p99"],
+                "trickle_qps": trickle_qps, "flood_qps": qps * 6,
+            }
+            detail["starved_p99_ms"] = starved_p["p99"]
+            detail["fairness"] = round(starved_p["p99"] / solo_p99, 3)
+            fairness_ok = starved_p["p99"] <= max(200.0, 20 * solo_p99)
+        else:
+            detail["fairness_detail"] = {"error": "empty fairness pass"}
+            fairness_ok = False
+        detail["fairness_ok"] = fairness_ok
+        await door.drain()
+
+        # --- paging storm: budget holds ONE engine; round-robin ------
+        table_mb = tables["hot"].nbytes / (1 << 20)
+        budget_mb = round(table_mb * 1.5, 3)  # one fits, two never do
+        storm = _mk_registry(budget_mb, art_dir)
+        sdoor = HttpFrontDoor(registry=storm, max_wait_us=2000)
+        await sdoor.start()
+        cold_ms, paged_bitwise = [], True
+        for _round in range(2):
+            for name in names:
+                t0 = time.perf_counter()
+                ok = await _probe_bitwise(sdoor.host, sdoor.port, name)
+                cold_ms.append(round(
+                    (time.perf_counter() - t0) * 1e3, 1))
+                paged_bitwise = paged_bitwise and ok
+        sstats = storm.stats()
+        admits = sum(s["registry"]["admissions"]
+                     for s in sstats.values())
+        evicts = sum(s["registry"]["evictions"]
+                     for s in sstats.values())
+        await sdoor.drain()
+        paging_ok = paged_bitwise and evicts > 0 and admits > len(names)
+        detail["paging"] = {
+            "device_budget_mb": budget_mb,
+            "table_mb": round(table_mb, 3),
+            "admissions": admits, "evictions": evicts,
+            "bitwise_after_readmit": paged_bitwise,
+            "cold_admit_ms": cold_ms,
+        }
+
+        detail["multitenant_ok"] = bool(
+            detail["recompiles_steady"] == 0
+            and all(isolation.values()) and fairness_ok and paging_ok)
+        return detail
+
+    with tempfile.TemporaryDirectory() as tmp:
+        from hyperspace_tpu.serve import export_artifact
+
+        for name in names:
+            export_artifact(os.path.join(tmp, name), tables[name],
+                            ("poincare", 1.0), model_config={"c": 1.0})
+        detail = asyncio.run(_run(tmp))
+    return {"metric": "multitenant_agg_qps",
+            "value": detail["aggregate_qps"], "unit": "queries/s",
+            "vs_baseline": None, "detail": detail}
+
+
 def _get(d, *path):
     """Nested dict lookup returning None on any missing key."""
     for k in path:
@@ -1973,6 +2246,21 @@ _COMPACT_FIELDS = (
     ("multihost_scaling_efficiency", ("detail", "scaling_efficiency")),
     ("multihost_ok", ("detail", "multihost", "multihost_ok")),
     ("multihost_ok", ("detail", "multihost_ok")),
+    # multi-tenant front door leg (r20): steady aggregate qps at the
+    # Zipf mix (higher is better — bench_trend's qps token), the DRR
+    # fairness ratio + the starved tenant's contended p99 (lower is
+    # better — the fairness/starved tokens), gated by the rolled-up
+    # verdict (multitenant_ok — a sentinel, excluded from trend
+    # gating).  First path is auto mode's nested leg, second fires
+    # when bench_multitenant IS the headline (--metric multitenant)
+    ("multitenant_agg_qps", ("detail", "multitenant", "aggregate_qps")),
+    ("multitenant_agg_qps", ("detail", "aggregate_qps")),
+    ("tenant_fairness", ("detail", "multitenant", "fairness")),
+    ("tenant_fairness", ("detail", "fairness")),
+    ("starved_p99_ms", ("detail", "multitenant", "starved_p99_ms")),
+    ("starved_p99_ms", ("detail", "starved_p99_ms")),
+    ("multitenant_ok", ("detail", "multitenant", "multitenant_ok")),
+    ("multitenant_ok", ("detail", "multitenant_ok")),
     # failure-domain leg (PR 9): chaos recovery + the shed-rate column
     ("resilience_ok", ("detail", "resilience", "ok")),
     ("shed_rate", ("detail", "resilience", "overload", "shed_rate")),
@@ -2106,7 +2394,7 @@ def main() -> None:
     p.add_argument("--metric",
                    choices=["auto", "hgcn", "poincare", "serve",
                             "serve_http", "live_index", "cold_start",
-                            "big_table", "multihost"],
+                            "big_table", "multihost", "multitenant"],
                    default="auto")
     p.add_argument("--big-rows", type=int, default=10_000_000,
                    help="--metric big_table: synthetic table rows "
@@ -2167,7 +2455,9 @@ def main() -> None:
                "big_table": functools.partial(
                    bench_big_table, rows=args.big_rows,
                    dim=args.big_dim),
-               "multihost": bench_multihost}.get(args.metric, hgcn_fn)
+               "multihost": bench_multihost,
+               "multitenant": bench_multitenant}.get(args.metric,
+                                                     hgcn_fn)
     primary_name = args.metric if args.metric != "auto" else "hgcn"
 
     # the headline metric NEVER switches silently: a failure of the
@@ -2289,6 +2579,10 @@ def main() -> None:
                 r = bench_multihost()
                 d["multihost"] = r["detail"]
 
+            def multitenant_leg(d):  # engine registry + DRR (r20)
+                r = bench_multitenant()
+                d["multitenant"] = r["detail"]
+
             def use_att_leg(d):
                 # the attention arm on the same graph/protocol (VERDICT
                 # r3 #1).  Distinct key: detail["use_att"] is the
@@ -2321,6 +2615,7 @@ def main() -> None:
             leg("precision", 40, precision_leg)
             leg("resilience", 25, resilience_leg)
             leg("multihost", 90, multihost_leg)
+            leg("multitenant", 45, multitenant_leg)
             leg("realistic", 150, realistic_leg)
             leg("workloads", 90, workloads_leg)
             leg("use_att_arm", 0 if args.use_att else 120, use_att_leg)

@@ -21,6 +21,8 @@ from hyperspace_tpu.analysis.rules.packing import PackingLiteralRule
 from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
 from hyperspace_tpu.analysis.rules.retry import UnboundedRetryRule
+from hyperspace_tpu.analysis.rules.tenantmetric import (
+    TenantUnlabeledMetricRule)
 from hyperspace_tpu.analysis.rules.tracerleak import TracerLeakRule
 from hyperspace_tpu.analysis.rules.units import MetricUnitSuffixRule
 
@@ -39,6 +41,7 @@ ALL_RULES = (
     PrecisionLiteralRule,
     PackingLiteralRule,
     MetricUnitSuffixRule,
+    TenantUnlabeledMetricRule,
     MonotonicClockRule,
     MultiprocessUnsafeIORule,
     TelemetryCatalogRule,

@@ -1,0 +1,87 @@
+"""tenant-unlabeled-metric: registry-scoped serve metrics carry a
+tenant label.
+
+Historical incident: ISSUE 20's engine registry put N tenant stacks
+behind the ONE front door, and the first draft of its admission path
+bumped the plain ``serve/tenant_admissions`` counter.  Every tenant's
+paging traffic folded into one series — the dashboard showed a healthy
+aggregate admission rate while one cold tenant thrashed its whole
+engine in and out of device memory on every request.  The serve plane's
+per-tenant convention (telemetry/exposition.py) is the double-write:
+the base name keeps the aggregate series AND a ``tenant_metric(name,
+tenant)`` twin (``<name>@tenant=<t>``) attributes it, which the
+``/metrics`` exposition folds into one Prometheus family with a
+``tenant`` label.
+
+What fires (warning): an ``inc(`` / ``set_gauge(`` / ``observe(`` call
+in **registry-scoped serve code** (``hyperspace_tpu/serve/registry.py``
+— the one file whose every write happens on behalf of a specific
+tenant stack) whose literal first argument lacks the ``@tenant=``
+label.  Dynamic names built through :func:`tenant_metric` (or any
+non-literal expression) never fire — the double-write helper is the
+fix, not the target.
+
+A write that is GENUINELY registry-global (the resident-count gauge —
+a property of the whole device, not of one tenant's load) is
+suppressed at its line with a reason:
+``# hyperlint: disable=tenant-unlabeled-metric — <why>`` — the same
+accepted-hazard visibility contract as every other rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from hyperspace_tpu.analysis.core import FileContext, Rule
+
+# the serve files whose telemetry writes are always on behalf of one
+# tenant's stack; the rest of the serve plane double-writes through the
+# batcher's lifecycle (already labeled) or predates tenancy
+SCOPE_SUFFIXES = ("hyperspace_tpu/serve/registry.py",)
+
+_WRITE_FNS = {"inc", "set_gauge", "observe"}
+_TENANT_SEP = "@tenant="
+
+
+def in_scope(rel: str) -> bool:
+    return rel.endswith(SCOPE_SUFFIXES)
+
+
+def _call_fn_name(node: ast.Call):
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+class TenantUnlabeledMetricRule(Rule):
+    id = "tenant-unlabeled-metric"
+    severity = "warning"
+    summary = ("registry-scoped serve metrics written without a "
+               "@tenant= label — every tenant folds into one series "
+               "and per-tenant pathologies vanish in the aggregate")
+
+    def check_file(self, ctx: FileContext):
+        if not in_scope(ctx.rel):
+            return []
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and node.args
+                    and _call_fn_name(node) in _WRITE_FNS):
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                continue  # tenant_metric(...) / dynamic names: labeled
+            name = first.value
+            if _TENANT_SEP in name:
+                continue
+            findings.append(self.finding(
+                ctx, node,
+                f"metric {name!r} written from registry-scoped serve "
+                "code without a tenant label — double-write a "
+                "tenant_metric(name, tenant) twin beside the "
+                "aggregate, or suppress with a reason if the reading "
+                "is genuinely registry-global"))
+        return findings

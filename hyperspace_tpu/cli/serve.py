@@ -212,6 +212,20 @@ class ServeConfig:
     # slow-query JSONL: with slo_ms>0, a request past the SLO writes
     # its full access record + span tree here (implies trace=1)
     slow_log: str | None = None
+    # --- multi-tenant serving (serve-http only; serve/registry.py,
+    # docs/serving.md "Multi-tenant front door") -----------------------
+    # tenant roster: a JSON list (inline, or a path to a .json file) of
+    # {"name", "artifact", "weight"?, "queue_max"?, "deadline_ms"?,
+    # "slo_ms"?, "precision"?, "nprobe"?} objects — each tenant gets
+    # its own engine + batcher + degradation ladder + SLO window behind
+    # the ONE front door; unlisted knobs inherit this config's values.
+    # The FIRST tenant is the default route (requests without a
+    # "tenant" field).  Mutually exclusive with artifact= and live=1.
+    tenants: str | None = None
+    # engine-paging budget in MiB of device table bytes (0 = unlimited):
+    # past it, idle tenants' engines are dropped (the artifact stays
+    # the host master) and rebuilt on demand, prewarmed off the hot path
+    device_budget_mb: float = 0.0
 
 
 def _ids(s: str, name: str) -> list[int]:
@@ -306,6 +320,79 @@ def _build(cfg: ServeConfig):
     batcher.access_log = alog  # closed by the serve-session bracket
     batcher.slow_log = slow
     return eng, batcher
+
+
+def _build_registry(cfg: ServeConfig, prewarm_ks: list[int]):
+    """The serve-http multi-tenant path: ``tenants=`` (inline JSON or a
+    path to a JSON file) → a fully-built
+    :class:`~hyperspace_tpu.serve.registry.EngineRegistry`.  Per-tenant
+    fields override the shared config's serving knobs; malformed
+    rosters are usage errors before any engine builds."""
+    from hyperspace_tpu.serve.registry import EngineRegistry
+
+    if cfg.artifact:
+        raise SystemExit("tenants= and artifact= are mutually exclusive "
+                         "(each tenant names its own artifact)")
+    if cfg.live:
+        raise SystemExit("tenants= does not support live=1 yet (the "
+                         "delta segment is per-engine state that "
+                         "engine paging would drop)")
+    text = cfg.tenants
+    if text and os.path.exists(text):
+        try:
+            with open(text, "r", encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            raise SystemExit(f"tenants={cfg.tenants}: {e}") from None
+    try:
+        roster = json.loads(text or "")
+    except json.JSONDecodeError as e:
+        raise SystemExit(
+            f"tenants= wants a JSON list (inline or a file path): {e}"
+        ) from None
+    if (not isinstance(roster, list) or not roster
+            or not all(isinstance(t, dict) for t in roster)):
+        raise SystemExit(
+            "tenants= wants a non-empty JSON list of tenant objects")
+    reg = EngineRegistry(device_budget_mb=cfg.device_budget_mb,
+                         max_wait_us=cfg.max_wait_us,
+                         prewarm_ks=prewarm_ks)
+    try:
+        for t in roster:
+            name, artifact = t.get("name"), t.get("artifact")
+            if not (isinstance(name, str) and name
+                    and isinstance(artifact, str) and artifact):
+                raise SystemExit(
+                    f"tenant entry {t!r}: wants string \"name\" and "
+                    "\"artifact\" fields")
+            unknown = set(t) - {"name", "artifact", "weight",
+                                "queue_max", "deadline_ms", "slo_ms",
+                                "precision", "nprobe"}
+            if unknown:
+                raise SystemExit(
+                    f"tenant {name!r}: unknown field(s) "
+                    f"{sorted(unknown)}")
+            reg.add_tenant(
+                name, artifact,
+                weight=float(t.get("weight", 1.0)),
+                window_s=cfg.window_s,
+                engine_kw=dict(
+                    chunk_rows=cfg.chunk_rows,
+                    scan_mode=cfg.scan_mode,
+                    precision=t.get("precision", cfg.precision),
+                    nprobe=int(t.get("nprobe", cfg.nprobe))),
+                batcher_kw=dict(
+                    min_bucket=cfg.min_bucket,
+                    max_bucket=cfg.max_bucket,
+                    cache_size=cfg.cache_size,
+                    queue_max=int(t.get("queue_max", cfg.queue_max)),
+                    deadline_ms=float(t.get("deadline_ms",
+                                            cfg.deadline_ms)),
+                    slo_ms=float(t.get("slo_ms", cfg.slo_ms))))
+    except (ValueError, TypeError, OSError) as e:
+        # bad artifact / duplicate name / bad knob values: usage errors
+        raise SystemExit(f"tenants=: {e}") from None
+    return reg
 
 
 def _prewarm_ks(cfg: ServeConfig) -> list[int]:
@@ -790,6 +877,35 @@ def run_serve_http(cfg: ServeConfig, *, ready=None) -> dict:
         raise SystemExit(
             f"max_wait_us must be >= 0; got {cfg.max_wait_us}")
     prewarm_ks = _prewarm_ks(cfg)  # parse errors before the build pays
+
+    def announce(host, port):
+        try:
+            print(f"[serve-http] listening on {host}:{port}",
+                  file=sys.stderr, flush=True)
+        except (OSError, ValueError):
+            pass  # hyperlint: disable=swallow-base-exception — closed stderr: announcement loss only
+        if ready is not None:
+            ready(host, port)
+
+    if cfg.tenants:
+        # multi-tenant front door (serve/registry.py): one engine/
+        # batcher/ladder stack per roster entry, weighted-fair dispatch
+        # on the one shared executor, engine paging under the budget
+        registry = _build_registry(cfg, prewarm_ks)
+        with _serve_session(cfg, registry.default.batcher):
+            try:
+                result = asyncio.run(run_front_door(
+                    registry=registry, host=cfg.host, port=cfg.port,
+                    max_wait_us=cfg.max_wait_us, ready=announce,
+                    prewarm_ks=prewarm_ks))
+            except ValueError as e:  # prewarm k out of range
+                raise SystemExit(f"prewarm: {e}") from None
+            except OSError as e:
+                raise SystemExit(
+                    f"serve-http: cannot bind {cfg.host}:{cfg.port} "
+                    f"— {e}") from None
+        return {"mode": "serve_http", **result,
+                "tenants": registry.stats()}
     _eng, batcher = _build(cfg)
 
     def rebuild(target: str):
@@ -800,15 +916,6 @@ def run_serve_http(cfg: ServeConfig, *, ready=None) -> dict:
             return _build(dataclasses.replace(cfg, artifact=target))[1]
         except SystemExit as e:
             raise ValueError(str(e)) from None
-
-    def announce(host, port):
-        try:
-            print(f"[serve-http] listening on {host}:{port}",
-                  file=sys.stderr, flush=True)
-        except (OSError, ValueError):
-            pass  # hyperlint: disable=swallow-base-exception — closed stderr: announcement loss only
-        if ready is not None:
-            ready(host, port)
 
     with _serve_session(cfg, batcher):
         try:

@@ -33,6 +33,11 @@ answering retrieval queries (docs/serving.md):
   rollover.py  blue-green rollover: prewarmed standby engine, health-
                gated atomic flip, old-stack drain — zero-downtime
                artifact replacement behind the front door
+  registry.py  multi-tenant engine registry: per-tenant serving stacks
+               routed by name/fingerprint, weighted-fair (deficit
+               round robin) scheduling of the one shared dispatch
+               executor, whole-engine paging under a device-memory
+               budget (artifact = host master, device tables = cache)
   server.py    asyncio HTTP/1.1 front door (stdlib only): concurrent
                POST /v1/topk | /v1/score | /v1/upsert | /v1/delete |
                /v1/stats + /admin/rollover + /healthz, deadline
@@ -66,12 +71,18 @@ from hyperspace_tpu.serve.errors import (  # noqa: F401
     DeadlineExceededError,
     OverloadedError,
     ServeError,
+    UnknownTenantError,
     error_response,
 )
 from hyperspace_tpu.serve.index import (  # noqa: F401
     ServingIndex,
     auto_ncells,
     build_index,
+)
+from hyperspace_tpu.serve.registry import (  # noqa: F401
+    EngineRegistry,
+    TenantStack,
+    engine_device_bytes,
 )
 from hyperspace_tpu.serve.rollover import (  # noqa: F401
     RolloverCoordinator,

@@ -105,6 +105,7 @@ from hyperspace_tpu.serve.errors import (DeadlineExceededError,
                                          kind_of)
 from hyperspace_tpu.telemetry import registry as telem
 from hyperspace_tpu.telemetry import spans
+from hyperspace_tpu.telemetry.exposition import tenant_metric
 from hyperspace_tpu.telemetry.trace import span, tracing
 
 DEFAULT_MIN_BUCKET = 8
@@ -224,14 +225,19 @@ class _Lifecycle:
     __slots__ = ("t_enq", "t_form", "info", "buckets_used",
                  "dispatch_s", "t_deadline", "op", "request_id",
                  "flush_id", "cache_hits", "cache_misses", "t_done",
-                 "t_coll", "t_result", "span")
+                 "t_coll", "t_result", "span", "tenant")
 
     def __init__(self, op: str, deadline_ms: Optional[float] = None,
                  t_enq: Optional[float] = None,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 tenant: Optional[str] = None):
         self.t_enq = time.perf_counter() if t_enq is None else t_enq
         self.t_form = self.t_enq
         self.op = op
+        # tenant this request belongs to (multi-tenant registry —
+        # serve/registry.py); None on a single-tenant batcher.  Drives
+        # the tenant-labeled metric twins and the access-log field.
+        self.tenant = tenant
         # request-tracing fields (docs/observability.md "Live metrics,
         # access log, and the flight recorder"): the id joins the
         # response, the access-log line, the span args, and the
@@ -287,6 +293,9 @@ class _Lifecycle:
         if (self.t_deadline is not None
                 and time.perf_counter() > self.t_deadline):
             telem.inc("serve/deadline_exceeded")
+            if self.tenant:
+                telem.inc(tenant_metric("serve/deadline_exceeded",
+                                        self.tenant))
             raise DeadlineExceededError(
                 f"deadline_ms expired {where} "
                 f"({(time.perf_counter() - self.t_enq) * 1e3:.1f} ms "
@@ -306,6 +315,13 @@ class _Lifecycle:
         if self.buckets_used:
             telem.observe("serve/dispatch_ms", self.dispatch_s * 1e3)
         telem.observe("serve/e2e_ms", (self.t_done - self.t_enq) * 1e3)
+        if self.tenant:
+            # the tenant-labeled twin (exposition renders it as a
+            # ``tenant=`` label on the same family): per-tenant SLO
+            # windows and the multitenant bench read per-tenant p99
+            # from this series while the base keeps the aggregate
+            telem.observe(tenant_metric("serve/e2e_ms", self.tenant),
+                          (self.t_done - self.t_enq) * 1e3)
         if self.span is not None:
             st = self.stages_ms()
             telem.observe("serve/stage/queue_wait_ms", st["queue_wait"])
@@ -348,6 +364,7 @@ class _Lifecycle:
         return {
             "request_id": self.request_id,
             "route": self.op,
+            "tenant": self.tenant,
             "outcome": outcome,
             "bucket": list(self.buckets_used),
             "flush_id": self.flush_id,
@@ -424,8 +441,15 @@ class RequestBatcher:
                  ladder_high: float = 0.75, ladder_low: float = 0.25,
                  ladder_down_after: int = 1, ladder_up_after: int = 8,
                  window=None, slo_ms: float = 0.0,
-                 access_sink=None, recorder=None, slow_sink=None):
+                 access_sink=None, recorder=None, slow_sink=None,
+                 tenant: Optional[str] = None):
         self.engine = engine
+        # multi-tenant identity (serve/registry.py): when set, the key
+        # serve series (requests/e2e/shed/deadline/errors) double-write
+        # a ``<name>@tenant=<t>`` twin the exposition renders as a
+        # tenant label, and access records carry the tenant field.
+        # None (the single-tenant default) adds nothing to the hot path.
+        self.tenant = tenant
         self.buckets = bucket_sizes(min_bucket, max_bucket)
         self.cache = _LRU(cache_size)
         if queue_max < 0:
@@ -501,6 +525,24 @@ class RequestBatcher:
         if self._admission is not None:
             self._admission.release()
 
+    def count_request(self) -> None:
+        """Bump ``serve/requests`` (+ the tenant twin) — the ONE place
+        a request is counted, shared with the collator's async paths so
+        a multi-tenant batcher's per-tenant rate can never drift from
+        the aggregate."""
+        telem.inc("serve/requests")
+        if self.tenant:
+            telem.inc(tenant_metric("serve/requests", self.tenant))
+
+    def new_lifecycle(self, op: str, deadline_ms: Optional[float] = None,
+                      t_enq: Optional[float] = None,
+                      request_id: Optional[str] = None) -> "_Lifecycle":
+        """A lifecycle stamped with this batcher's tenant (the collator
+        constructs lifecycles for its async members through this, so
+        tenant threading has one home)."""
+        return _Lifecycle(op, deadline_ms, t_enq=t_enq,
+                          request_id=request_id, tenant=self.tenant)
+
     def emit_access(self, life: _Lifecycle, outcome: str = "ok") -> None:
         """One request is DONE (any outcome): tick the SLO window,
         count taxonomy errors (parse/validation/internal — shed and
@@ -518,8 +560,12 @@ class RequestBatcher:
             # the cache-only state degradation exists to expose; every
             # overloaded outcome funnels through here exactly once.
             telem.inc("serve/shed")
+            if self.tenant:
+                telem.inc(tenant_metric("serve/shed", self.tenant))
         elif outcome not in ("ok", "deadline_exceeded"):
             telem.inc("serve/errors")
+            if self.tenant:
+                telem.inc(tenant_metric("serve/errors", self.tenant))
         if life.span is not None:
             life.span.close()  # failed requests: stamp end at emit time
         breach = False
@@ -563,8 +609,9 @@ class RequestBatcher:
         real one."""
         if request_id is None and self.access_sink is not None:
             request_id = new_request_id()
-        self.emit_access(_Lifecycle(op, t_enq=t_enq,
-                                    request_id=request_id), outcome)
+        self.emit_access(self.new_lifecycle(op, t_enq=t_enq,
+                                            request_id=request_id),
+                         outcome)
 
     def _mode(self):
         """Current quality mode: ``None`` (full), an int nprobe
@@ -830,9 +877,9 @@ class RequestBatcher:
             deadline_ms = self.default_deadline_ms
         if request_id is None and self.access_sink is not None:
             request_id = new_request_id()
-        life = _Lifecycle("topk", deadline_ms, t_enq=t_enq,
-                          request_id=request_id)
-        telem.inc("serve/requests")
+        life = self.new_lifecycle("topk", deadline_ms, t_enq=t_enq,
+                                  request_id=request_id)
+        self.count_request()
         try:
             self._admit()
         except OverloadedError:
@@ -946,9 +993,9 @@ class RequestBatcher:
             deadline_ms = self.default_deadline_ms
         if request_id is None and self.access_sink is not None:
             request_id = new_request_id()
-        life = _Lifecycle("score", deadline_ms, t_enq=t_enq,
-                          request_id=request_id)
-        telem.inc("serve/requests")
+        life = self.new_lifecycle("score", deadline_ms, t_enq=t_enq,
+                                  request_id=request_id)
+        self.count_request()
         try:
             self._admit()
         except OverloadedError:
@@ -1011,9 +1058,9 @@ class RequestBatcher:
             deadline_ms = self.default_deadline_ms
         if request_id is None and self.access_sink is not None:
             request_id = new_request_id()
-        life = _Lifecycle(op, deadline_ms, t_enq=t_enq,
-                          request_id=request_id)
-        telem.inc("serve/requests")
+        life = self.new_lifecycle(op, deadline_ms, t_enq=t_enq,
+                                  request_id=request_id)
+        self.count_request()
         try:
             self._admit()
         except OverloadedError:
@@ -1093,6 +1140,7 @@ class RequestBatcher:
         reg = telem.default_registry()
         gauges = reg.snapshot()
         return {
+            "tenant": self.tenant,
             "latency_e2e_ms": gauges.get("hist/serve/e2e_ms"),
             # compile count beside the serve stats (the stdin loop's
             # analog of the HTTP stats field): the contract every smoke

@@ -55,6 +55,7 @@ run_in_executor hop does not propagate contextvars on its own.
 from __future__ import annotations
 
 import asyncio
+import collections
 import functools
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
@@ -68,6 +69,7 @@ from hyperspace_tpu.serve.errors import (DeadlineExceededError,
                                          kind_of)
 from hyperspace_tpu.telemetry import registry as telem
 from hyperspace_tpu.telemetry import spans
+from hyperspace_tpu.telemetry.exposition import tenant_metric
 
 # default max-wait before a non-full pending bucket flushes (µs).  Small
 # on purpose: T bounds the latency floor every collated request pays;
@@ -99,29 +101,163 @@ class _Group:
         self.keyf = keyf
 
 
+class FairDispatcher:
+    """Deficit-round-robin scheduler for the shared dispatch executor.
+
+    A multi-tenant front door (serve/registry.py) runs one collator per
+    tenant but keeps the ONE one-worker dispatch executor — device work
+    stays serialized.  Raw FIFO submission would let a hot tenant's
+    bucket stream occupy every executor slot and starve the others'
+    p99; this dispatcher interposes per-tenant job queues drained by
+    classic deficit round robin (Shreedhar & Varghese): each visit to a
+    tenant's non-empty queue adds ``weight × quantum`` to its deficit
+    counter, and its head job dispatches once the deficit covers the
+    job's COST (the flush's unique id count — the actual device work),
+    paying the cost down.  Weights come from the tenant config; a
+    tenant whose queue empties forfeits its leftover deficit, so idle
+    tenants accrue no credit to burst with later.
+
+    At most ONE job is in flight at a time (the executor has one worker
+    anyway — queueing a second would just reorder inside the pool and
+    bypass this policy); the done-callback re-pumps on the event loop.
+    Every structure is event-loop-only, like the collator's groups —
+    no locks.  Single-tenant collators (no dispatcher passed) keep the
+    direct ``run_in_executor`` path, byte-identical behavior.
+    """
+
+    def __init__(self, executor: ThreadPoolExecutor, *,
+                 weights: Optional[dict] = None, quantum: int = 8):
+        if quantum < 1:
+            raise ValueError(f"quantum must be >= 1; got {quantum}")
+        self._exec = executor
+        self._weights = dict(weights or {})
+        self._quantum = int(quantum)
+        self._queues: dict = {}    # tenant -> deque[(cost, fn, fut)]
+        self._deficit: dict = {}   # tenant -> accumulated credit
+        self._rr: collections.deque = collections.deque()  # visit order
+        self._busy = False
+
+    def weight(self, tenant) -> float:
+        """The tenant's configured share (default 1.0, floor > 0 so a
+        misconfigured zero weight throttles hard instead of halting)."""
+        return max(float(self._weights.get(tenant, 1.0)), 1e-6)
+
+    def set_weight(self, tenant, weight: float) -> None:
+        self._weights[tenant] = float(weight)
+
+    def submit(self, loop: asyncio.AbstractEventLoop, tenant,
+               cost: int, fn) -> asyncio.Future:
+        """Enqueue ``fn`` for ``tenant`` at ``cost`` work units; returns
+        a future resolved with ``fn()``'s result — the drop-in shape of
+        ``loop.run_in_executor`` the collator chains ``_deliver`` onto."""
+        fut = loop.create_future()
+        q = self._queues.get(tenant)
+        if q is None:
+            q = self._queues[tenant] = collections.deque()
+            self._deficit.setdefault(tenant, 0.0)
+            self._rr.append(tenant)
+        q.append((max(1, int(cost)), fn, fut))
+        self._pump(loop)
+        return fut
+
+    def _pump(self, loop) -> None:
+        if self._busy:
+            return
+        # DRR scan: deficits strictly grow on every visit to a
+        # non-empty queue, so this terminates at the first affordable
+        # head job (or when every queue has drained)
+        while self._rr:
+            tenant = self._rr[0]
+            q = self._queues.get(tenant)
+            while q and q[0][2].done():
+                q.popleft()  # caller gave up while queued: never run it
+            if not q:
+                # an emptied queue leaves the rotation and forfeits its
+                # leftover deficit — idle tenants bank no burst credit
+                self._rr.popleft()
+                self._queues.pop(tenant, None)
+                self._deficit[tenant] = 0.0
+                continue
+            self._deficit[tenant] += self.weight(tenant) * self._quantum
+            cost, fn, fut = q[0]
+            if self._deficit[tenant] < cost:
+                self._rr.rotate(-1)
+                continue
+            q.popleft()
+            self._deficit[tenant] -= cost
+            self._rr.rotate(-1)
+            self._busy = True
+            telem.inc("serve/fair_dispatches")
+            if tenant:
+                telem.inc(tenant_metric("serve/fair_dispatches", tenant))
+            efut = loop.run_in_executor(self._exec, fn)
+            efut.add_done_callback(
+                functools.partial(self._done, loop, fut))
+            return
+
+    def _done(self, loop, fut: asyncio.Future, efut) -> None:
+        self._busy = False
+        if not fut.done():
+            if efut.cancelled():
+                fut.cancel()
+            elif efut.exception() is not None:
+                fut.set_exception(efut.exception())
+            else:
+                fut.set_result(efut.result())
+        self._pump(loop)
+
+    def pending(self) -> dict:
+        """{tenant: queued jobs} — introspection for stats/tests."""
+        return {t: len(q) for t, q in self._queues.items() if q}
+
+
 class Collator:
     """Continuous batching over a :class:`RequestBatcher` (module
     docstring).  One collator serves one batcher serves one engine;
-    construct and use it on one event loop."""
+    construct and use it on one event loop.
+
+    ``executor=`` shares a dispatch executor owned by someone else (the
+    multi-tenant registry: one worker serializing EVERY tenant's device
+    work) — ``close()`` then leaves it running.  ``dispatcher=`` routes
+    this collator's dispatch submissions through a
+    :class:`FairDispatcher` under its ``tenant`` identity instead of
+    straight FIFO ``run_in_executor``."""
 
     def __init__(self, batcher: RequestBatcher, *,
-                 max_wait_us: float = DEFAULT_MAX_WAIT_US):
+                 max_wait_us: float = DEFAULT_MAX_WAIT_US,
+                 executor: Optional[ThreadPoolExecutor] = None,
+                 dispatcher: Optional[FairDispatcher] = None,
+                 tenant: Optional[str] = None):
         if max_wait_us < 0:
             raise ValueError(
                 f"max_wait_us must be >= 0; got {max_wait_us}")
         self.batcher = batcher
+        self.tenant = tenant if tenant is not None else batcher.tenant
         self.max_wait_s = float(max_wait_us) / 1e6
         self._groups: dict[tuple, _Group] = {}
         # the single dispatch executor: device work serialized, flushes
         # from independent groups queue here while their member
-        # coroutines stay concurrent
-        self._exec = ThreadPoolExecutor(max_workers=1,
-                                        thread_name_prefix="serve-dispatch")
+        # coroutines stay concurrent.  Shared (registry-owned) when
+        # passed in; otherwise this collator owns one.
+        self._owns_exec = executor is None
+        self._exec = executor if executor is not None else (
+            ThreadPoolExecutor(max_workers=1,
+                               thread_name_prefix="serve-dispatch"))
+        self._dispatcher = dispatcher
         self._closed = False
         # monotone flush id, stamped on every member lifecycle a flush
         # examines (expired ones included — a 504 must name the flush
         # that missed its deadline); rides the access log and stats
         self._flush_seq = 0
+
+    def _submit(self, cost: int, fn) -> asyncio.Future:
+        """One dispatch submission: through the fair dispatcher under
+        this collator's tenant when armed, else straight to the
+        executor — the single seam the weighted-fair policy hangs on."""
+        loop = asyncio.get_running_loop()
+        if self._dispatcher is not None:
+            return self._dispatcher.submit(loop, self.tenant, cost, fn)
+        return loop.run_in_executor(self._exec, fn)
 
     # --- public ops -----------------------------------------------------------
 
@@ -142,9 +278,9 @@ class Collator:
             from hyperspace_tpu.serve.access import new_request_id
 
             request_id = new_request_id()
-        life = _Lifecycle("topk", deadline_ms, t_enq=t_enq,
-                          request_id=request_id)
-        telem.inc("serve/requests")
+        life = b.new_lifecycle("topk", deadline_ms, t_enq=t_enq,
+                               request_id=request_id)
+        b.count_request()
         try:
             b._admit()
         except OverloadedError:
@@ -207,9 +343,9 @@ class Collator:
             from hyperspace_tpu.serve.access import new_request_id
 
             request_id = new_request_id()
-        life = _Lifecycle("score", deadline_ms, t_enq=t_enq,
-                          request_id=request_id)
-        telem.inc("serve/requests")
+        life = b.new_lifecycle("score", deadline_ms, t_enq=t_enq,
+                               request_id=request_id)
+        b.count_request()
         try:
             b._admit()
         except OverloadedError:
@@ -222,8 +358,8 @@ class Collator:
             u, v = b.validate_score_request(u_ids, v_ids)
             life.formed()
             life.check_deadline("after validation")
-            out = await asyncio.get_running_loop().run_in_executor(
-                self._exec,
+            out = await self._submit(
+                len(u),
                 functools.partial(b.dispatch_score, u, v, prob=prob,
                                   fd_r=fd_r, fd_t=fd_t, lives=(life,),
                                   deadline_life=life,
@@ -254,8 +390,8 @@ class Collator:
         the delta swap it observes is whole, before or after."""
         if self._closed:
             raise OverloadedError("server draining: dispatch closed")
-        return await asyncio.get_running_loop().run_in_executor(
-            self._exec,
+        return await self._submit(
+            len(ids),
             functools.partial(self.batcher.upsert, ids, rows,
                               deadline_ms=deadline_ms, t_enq=t_enq,
                               request_id=request_id))
@@ -267,8 +403,8 @@ class Collator:
         """The batcher's ``delete``, same executor serialization."""
         if self._closed:
             raise OverloadedError("server draining: dispatch closed")
-        return await asyncio.get_running_loop().run_in_executor(
-            self._exec,
+        return await self._submit(
+            len(ids),
             functools.partial(self.batcher.delete, ids,
                               deadline_ms=deadline_ms, t_enq=t_enq,
                               request_id=request_id))
@@ -355,8 +491,8 @@ class Collator:
             for m in alive:
                 if m.life.span is not None:
                     m.life.span.adopt(fspan)
-        fut = asyncio.get_running_loop().run_in_executor(
-            self._exec,
+        fut = self._submit(
+            len(ids),
             functools.partial(self.batcher.dispatch_topk, ids, k,
                               exclude_self=exclude_self,
                               nprobe_ov=nprobe_ov, keyf=g.keyf,
@@ -392,7 +528,11 @@ class Collator:
         (tests, the bench) keep the default ``wait=True``; the front
         door's drain passes ``wait=False`` — joining a running dispatch
         thread from inside the event loop would block every remaining
-        in-flight response for its duration."""
+        in-flight response for its duration.  A SHARED executor
+        (``executor=`` at construction) is the owner's to shut down —
+        closing one tenant's collator must not kill every tenant's
+        dispatch."""
         if not self._closed:
             self._closed = True
-            self._exec.shutdown(wait=wait)
+            if self._owns_exec:
+                self._exec.shutdown(wait=wait)

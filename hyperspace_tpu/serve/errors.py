@@ -20,6 +20,11 @@ kind                meaning
 ``overloaded``      admission control shed the request (bounded queue
                     full), or the degradation ladder is answering
                     cache-only and the request missed
+``unknown_tenant``  the request named a tenant / artifact fingerprint
+                    the engine registry does not hold — a routing miss,
+                    not a malformed request (HTTP answers 404, never a
+                    generic 400: the client's payload was fine, the
+                    NAME doesn't resolve)
 ``internal``        anything else — a server-side bug
 ==================  ====================================================
 
@@ -31,7 +36,7 @@ onto ``validation`` and JSON decode failures onto ``parse``.
 from __future__ import annotations
 
 ERROR_KINDS = ("parse", "validation", "deadline_exceeded", "overloaded",
-               "internal")
+               "unknown_tenant", "internal")
 
 
 class ServeError(Exception):
@@ -54,6 +59,22 @@ class DeadlineExceededError(ServeError):
     """The request's deadline expired before an honest answer existed."""
 
     kind = "deadline_exceeded"
+
+
+class UnknownTenantError(ServeError):
+    """The named tenant / fingerprint is not in the engine registry.
+
+    Typed separately from ``validation`` so the HTTP path can answer
+    404 (the resource doesn't exist) instead of 400 (the request is
+    malformed) — a client retrying a 400 forever would never learn the
+    difference between a typo'd payload and a tenant that was simply
+    never registered (or already retired)."""
+
+    kind = "unknown_tenant"
+
+    def __init__(self, tenant):
+        super().__init__(f"unknown tenant or fingerprint: {tenant!r}")
+        self.tenant = tenant
 
 
 def kind_of(exc: BaseException) -> str:

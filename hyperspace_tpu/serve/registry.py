@@ -1,0 +1,412 @@
+"""Multi-tenant engine registry: one front door, many artifacts.
+
+A production serving host rarely owns one embedding table.  Multiple
+models (per language, per surface, per A/B arm) each freeze their own
+artifact, and giving every artifact its own process wastes the accel
+(one table's traffic leaves the device idle while another process
+queues) and multiplies the operational surface.  This module lets ONE
+HTTP front door (``serve/server.py``) serve N artifacts:
+
+- :class:`TenantStack` — one tenant's full serving stack: the frozen
+  artifact (the host-resident master copy, mmapped), the
+  :class:`~hyperspace_tpu.serve.engine.QueryEngine` (device tables —
+  possibly paged out), a persistent
+  :class:`~hyperspace_tpu.serve.batcher.RequestBatcher` (tenant-tagged
+  LRU + admission + degradation ladder + per-tenant
+  :class:`~hyperspace_tpu.telemetry.window.SloWindow`), and a
+  :class:`~hyperspace_tpu.serve.collator.Collator` wired onto the
+  registry's SHARED dispatch executor.
+- :class:`EngineRegistry` — routes a request's ``tenant`` field (a
+  tenant name OR an artifact fingerprint; absent = the default tenant,
+  so every pre-existing client keeps working) to its stack, schedules
+  the shared one-worker dispatch executor through a
+  :class:`~hyperspace_tpu.serve.collator.FairDispatcher` (weighted
+  deficit round robin — a hot tenant cannot starve the others), and
+  **pages whole engines** under a device-memory budget.
+
+**Engine paging** (``device_budget_mb=``): the artifact on disk is the
+master copy — the device tables are a cache.  When resident engines
+exceed the budget, the least-recently-used idle tenant's engine is
+dropped (``batcher.engine = None``; JAX frees the device arrays by
+refcount) and rebuilt on demand from its artifact on a dedicated
+one-worker **paging executor**, so an admission storm on a cold tenant
+never occupies the dispatch executor the hot tenants are answering on.
+Re-admission re-runs the bucket-ladder prewarm (with the persistent
+compilation cache armed this is deserialization, not compilation) and
+is **coalesced**: concurrent requests for the same cold tenant await
+one shared admit, not N rebuilds.  The batcher PERSISTS across paging —
+its result cache is keyed by the artifact fingerprint + scan signature
+(the cross-tenant-safety keys), so a re-admitted engine built from the
+same artifact serves the cached rows bitwise-unchanged, and the
+tenant's SLO window / ladder state survive the round trip.
+
+Cross-tenant isolation is structural, not policed: every cache row is
+keyed by the owning engine's fingerprint, every compiled program by the
+engine's ``scan_signature``, and every metric/access record carries the
+tenant label (``telemetry/exposition.py``) — tested bitwise against
+solo engines in ``tests/serve/test_registry.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import functools
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from hyperspace_tpu.serve.batcher import RequestBatcher
+from hyperspace_tpu.serve.collator import (DEFAULT_MAX_WAIT_US, Collator,
+                                           FairDispatcher)
+from hyperspace_tpu.serve.errors import UnknownTenantError
+from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry.exposition import tenant_metric
+
+
+def engine_device_bytes(engine) -> int:
+    """Device bytes an engine's resident tables hold — the paging
+    budget's unit.  Sums the table + scan-lane arrays (deduplicated:
+    ``scan_table`` aliases ``table`` on the f32 lane); the IVF index
+    payloads ride along when device-resident."""
+    total = 0
+    seen: set = set()
+    arrays = [getattr(engine, name, None)
+              for name in ("table", "scan_table", "scan_scale",
+                           "_scan_aux")]
+    for a in arrays:
+        if a is None or id(a) in seen:
+            continue
+        seen.add(id(a))
+        total += int(getattr(a, "nbytes", 0))
+    return total
+
+
+def _twrite(write, name: str, tenant, value) -> None:
+    """One base + tenant-twin registry write through a DYNAMIC name —
+    the per-tenant series the exposition folds into a ``tenant`` label.
+    Names written through here are declared to the telemetry-catalog
+    lint below (they are not literal call arguments)."""
+    # telemetry-catalog: serve/tenant_admissions
+    # telemetry-catalog: serve/tenant_evictions
+    # telemetry-catalog: serve/tenant_admit_s
+    write(name, value)
+    if tenant:
+        write(tenant_metric(name, tenant), value)
+
+
+class TenantStack:
+    """One tenant's serving stack (module docstring).  Built and owned
+    by :class:`EngineRegistry`; everything mutable on it (residency,
+    inflight, last_use) is touched on the event loop only."""
+
+    __slots__ = ("name", "artifact", "art", "weight", "batcher",
+                 "collator", "engine_kw", "fingerprint", "scan_signature",
+                 "precision", "device_bytes", "resident", "last_use",
+                 "inflight", "admit_future", "admissions", "evictions")
+
+    def __init__(self, name: str, artifact: str, art, weight: float,
+                 engine_kw: dict):
+        self.name = name
+        self.artifact = artifact      # path: the host-resident master
+        self.art = art                # loaded (mmapped) ServingArtifact
+        self.weight = float(weight)
+        self.engine_kw = dict(engine_kw)
+        self.batcher: Optional[RequestBatcher] = None
+        self.collator: Optional[Collator] = None
+        # identity captured at first build — /healthz for a paged-out
+        # tenant still answers fingerprint/signature without a rebuild
+        self.fingerprint: Optional[str] = None
+        self.scan_signature: Optional[tuple] = None
+        self.precision: Optional[str] = None
+        self.device_bytes = 0         # last-known resident footprint
+        self.resident = False
+        self.last_use = 0             # registry use-sequence (LRU order)
+        self.inflight = 0             # requests inside using() brackets
+        self.admit_future: Optional[asyncio.Future] = None
+        self.admissions = 0
+        self.evictions = 0
+
+    def summary(self) -> dict:
+        """The per-tenant block /healthz and /v1/stats carry."""
+        return {
+            "tenant": self.name,
+            "resident": self.resident,
+            "weight": self.weight,
+            "fingerprint": self.fingerprint,
+            "scan_signature": (list(self.scan_signature)
+                               if self.scan_signature else None),
+            "precision": self.precision,
+            "device_bytes": self.device_bytes if self.resident else 0,
+            "degrade_level": (self.batcher.degrade_level
+                              if self.batcher is not None else 0),
+            "inflight": self.inflight,
+            "admissions": self.admissions,
+            "evictions": self.evictions,
+        }
+
+
+class EngineRegistry:
+    """Tenant routing + weighted-fair dispatch + engine paging.
+
+    Construct, :meth:`add_tenant` each artifact (the FIRST added tenant
+    is the default — requests without a ``tenant`` field route there),
+    then hand the registry to :class:`~hyperspace_tpu.serve.server.
+    HttpFrontDoor`.  All post-construction mutation happens on the
+    event loop; :meth:`add_tenant`/:meth:`prewarm` are construction-
+    phase (blocking) calls made before the listeners open."""
+
+    def __init__(self, *, device_budget_mb: float = 0.0,
+                 max_wait_us: float = DEFAULT_MAX_WAIT_US,
+                 quantum: int = 8, prewarm_ks=()):
+        if device_budget_mb < 0:
+            raise ValueError(
+                f"device_budget_mb must be >= 0; got {device_budget_mb}")
+        self.device_budget_bytes = int(device_budget_mb * (1 << 20))
+        self.max_wait_us = float(max_wait_us)
+        self.prewarm_ks = tuple(prewarm_ks)
+        self._stacks: dict[str, TenantStack] = {}
+        self._by_fp: dict[str, TenantStack] = {}
+        self._default: Optional[TenantStack] = None
+        # the ONE dispatch executor every tenant's device work rides —
+        # serialization is preserved across tenants by construction
+        self._exec = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serve-dispatch")
+        # paging executor: engine rebuild + prewarm for cold tenants,
+        # OFF the dispatch executor so an admission storm never blocks
+        # the hot tenants' flushes
+        self._pager = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serve-pager")
+        self.dispatcher = FairDispatcher(self._exec, quantum=quantum)
+        self._use_seq = 0
+        self._closed = False
+        # add_tenant runs pre-loop (CLI startup) but tests drive it
+        # from threads; the stack maps get a lock for the build phase
+        self._build_lock = threading.Lock()
+
+    # --- construction ---------------------------------------------------------
+
+    def add_tenant(self, name: str, artifact: str, *,
+                   weight: float = 1.0, window_s: float = 60.0,
+                   engine_kw: Optional[dict] = None,
+                   batcher_kw: Optional[dict] = None) -> TenantStack:
+        """Register one tenant: load its artifact, build the engine
+        (eagerly — the fingerprint must be routable immediately), and
+        assemble the persistent batcher + collator.  ``engine_kw`` goes
+        to :meth:`QueryEngine.from_artifact` (precision/scan_mode/
+        nprobe/chunk_rows), ``batcher_kw`` to :class:`RequestBatcher`
+        (queue_max/deadline_ms/slo_ms/cache_size/buckets).  Raises
+        ``ValueError`` on a duplicate name and on weights <= 0."""
+        from hyperspace_tpu.serve.artifact import load_artifact
+
+        if not name:
+            raise ValueError("tenant name must be non-empty")
+        if weight <= 0:
+            raise ValueError(
+                f"tenant {name!r}: weight must be > 0; got {weight}")
+        with self._build_lock:
+            if name in self._stacks:
+                raise ValueError(f"duplicate tenant {name!r}")
+            art = load_artifact(artifact)
+            stack = TenantStack(name, artifact, art, weight,
+                                engine_kw or {})
+            eng = self._build_engine(stack)
+            window = None
+            if window_s:
+                from hyperspace_tpu.telemetry.window import SloWindow
+
+                window = SloWindow.for_tenant(name, window_s)
+            stack.batcher = RequestBatcher(eng, tenant=name,
+                                           window=window,
+                                           **(batcher_kw or {}))
+            stack.collator = Collator(stack.batcher,
+                                      max_wait_us=self.max_wait_us,
+                                      executor=self._exec,
+                                      dispatcher=self.dispatcher,
+                                      tenant=name)
+            self._note_built(stack, eng)
+            stack.resident = True
+            self.dispatcher.set_weight(name, weight)
+            self._stacks[name] = stack
+            self._by_fp[stack.fingerprint] = stack
+            if self._default is None:
+                self._default = stack
+            self._update_resident_gauge()
+            # a fresh tenant may push the resident set past the budget:
+            # evict idle LRU stacks (never the one just built)
+            self._enforce_budget(keep=stack)
+        return stack
+
+    def _build_engine(self, stack: TenantStack):
+        from hyperspace_tpu.serve.engine import QueryEngine
+
+        return QueryEngine.from_artifact(stack.art, **stack.engine_kw)
+
+    def _note_built(self, stack: TenantStack, eng) -> None:
+        stack.fingerprint = eng.fingerprint
+        stack.scan_signature = tuple(eng.scan_signature)
+        stack.precision = eng.precision
+        stack.device_bytes = engine_device_bytes(eng)
+
+    # --- routing --------------------------------------------------------------
+
+    @property
+    def default(self) -> TenantStack:
+        if self._default is None:
+            raise UnknownTenantError(None)
+        return self._default
+
+    def tenants(self) -> list[TenantStack]:
+        return list(self._stacks.values())
+
+    def resolve(self, key=None) -> TenantStack:
+        """The stack a request's ``tenant`` field routes to: ``None`` →
+        the default tenant (back-compat — single-tenant clients send no
+        field), else a tenant name or an artifact fingerprint.  An
+        unresolvable key raises :class:`UnknownTenantError` (→ HTTP
+        404, docs/serving.md "Error taxonomy")."""
+        if key is None:
+            return self.default
+        if not isinstance(key, str) or not key:
+            raise ValueError(
+                f"tenant must be a non-empty string, got {key!r}")
+        stack = self._stacks.get(key) or self._by_fp.get(key)
+        if stack is None:
+            raise UnknownTenantError(key)
+        return stack
+
+    @contextlib.asynccontextmanager
+    async def using(self, stack: TenantStack):
+        """Request-scope bracket: marks the stack busy (an in-use stack
+        is never an eviction victim) and bumps its LRU stamp."""
+        self._use_seq += 1
+        stack.last_use = self._use_seq
+        stack.inflight += 1
+        try:
+            yield stack
+        finally:
+            stack.inflight -= 1
+
+    # --- engine paging --------------------------------------------------------
+
+    async def ensure_resident(self, stack: TenantStack) -> None:
+        """Make the stack's engine device-resident, rebuilding from the
+        artifact if it was paged out.  Coalesced: every concurrent
+        caller for one cold tenant awaits the SAME admit; the rebuild +
+        prewarm run on the paging executor, so the dispatch executor
+        keeps draining hot tenants meanwhile."""
+        self._use_seq += 1
+        stack.last_use = self._use_seq
+        if stack.resident:
+            return
+        fut = stack.admit_future
+        if fut is None:
+            loop = asyncio.get_running_loop()
+            fut = stack.admit_future = loop.create_future()
+            asyncio.ensure_future(self._admit(stack, fut))
+        await fut
+
+    async def _admit(self, stack: TenantStack,
+                     fut: asyncio.Future) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            t0 = time.perf_counter()
+            eng = await loop.run_in_executor(
+                self._pager, functools.partial(self._build_engine, stack))
+            stack.batcher.engine = eng
+            self._note_built(stack, eng)
+            stack.resident = True
+            stack.admissions += 1
+            if self.prewarm_ks:
+                # re-warm the ladder OFF the hot path: with the
+                # persistent compile cache this is deserialization
+                await loop.run_in_executor(
+                    self._pager, functools.partial(stack.batcher.prewarm,
+                                                   self.prewarm_ks))
+            _twrite(telem.inc, "serve/tenant_admissions", stack.name, 1)
+            _twrite(telem.inc, "serve/tenant_admit_s", stack.name,
+                    time.perf_counter() - t0)
+            self._update_resident_gauge()
+            # admitting this tenant may displace another idle one
+            self._enforce_budget(keep=stack)
+            fut.set_result(True)
+        except (ValueError, KeyError, TypeError, OSError,
+                RuntimeError) as e:
+            # artifact unreadable / engine kwargs now invalid: every
+            # coalesced awaiter gets the typed failure (→ the error
+            # taxonomy), and the NEXT request retries a fresh admit
+            fut.set_exception(e)
+        finally:
+            stack.admit_future = None
+
+    def _evict(self, stack: TenantStack) -> None:
+        """Drop the stack's device arrays; the artifact stays the
+        master and the batcher (cache/ladder/window) persists — same
+        artifact → same fingerprint → the cached rows stay valid."""
+        stack.batcher.engine = None
+        stack.resident = False
+        stack.evictions += 1
+        _twrite(telem.inc, "serve/tenant_evictions", stack.name, 1)
+        self._update_resident_gauge()
+
+    def _enforce_budget(self, keep: Optional[TenantStack] = None) -> None:
+        """Evict idle LRU stacks until the resident set fits the
+        budget.  A stack with requests in flight (or flushes queued in
+        the fair dispatcher) is never a victim — over-budget with no
+        safe victim simply stays over until the traffic passes."""
+        if not self.device_budget_bytes:
+            return
+        while True:
+            resident = [s for s in self._stacks.values() if s.resident]
+            if sum(s.device_bytes
+                   for s in resident) <= self.device_budget_bytes:
+                return
+            queued = self.dispatcher.pending()
+            victims = [s for s in resident
+                       if s is not keep and s.inflight == 0
+                       and not queued.get(s.name)]
+            if not victims:
+                return
+            self._evict(min(victims, key=lambda s: s.last_use))
+
+    def _update_resident_gauge(self) -> None:
+        telem.set_gauge(  # hyperlint: disable=tenant-unlabeled-metric — registry-global residency level, not per-tenant load
+            "serve/tenants_resident",
+            sum(1 for s in self._stacks.values() if s.resident))
+
+    # --- lifecycle / observability --------------------------------------------
+
+    def prewarm(self, ks) -> dict:
+        """Warm every RESIDENT tenant's bucket ladder (startup, before
+        the listeners open); returns {tenant: prewarm info}."""
+        out = {}
+        for stack in self._stacks.values():
+            if stack.resident:
+                out[stack.name] = stack.batcher.prewarm(list(ks))
+        return out
+
+    def stats(self) -> dict:
+        """{tenant: full batcher stats + registry block} — the
+        /v1/stats per-tenant payload.  A paged-out tenant carries only
+        the registry block (its batcher stats dereference the engine,
+        and rebuilding one for a stats scrape would defeat paging)."""
+        out = {}
+        for stack in self._stacks.values():
+            s = (dict(stack.batcher.stats())
+                 if stack.resident else {"tenant": stack.name})
+            s["registry"] = stack.summary()
+            out[stack.name] = s
+        return out
+
+    def close(self, wait: bool = True) -> None:
+        """Shut down the shared executors; tenant collators only mark
+        themselves closed (they never owned the executor)."""
+        if self._closed:
+            return
+        self._closed = True
+        for stack in self._stacks.values():
+            if stack.collator is not None:
+                stack.collator.close(wait=wait)
+        self._exec.shutdown(wait=wait)
+        self._pager.shutdown(wait=wait)

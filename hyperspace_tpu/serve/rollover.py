@@ -147,8 +147,17 @@ class RolloverCoordinator:
         standby."""
         door = self.door
         old_collator = door.collator
+        # mirror the old collator's dispatch wiring: under a
+        # multi-tenant registry the executor is SHARED (and closing the
+        # old collator leaves it running), so the standby must keep
+        # dispatching through the same executor + fair dispatcher —
+        # two one-worker executors would race on the device
         new_collator = Collator(
-            standby, max_wait_us=old_collator.max_wait_s * 1e6)
+            standby, max_wait_us=old_collator.max_wait_s * 1e6,
+            executor=(None if old_collator._owns_exec
+                      else old_collator._exec),
+            dispatcher=old_collator._dispatcher,
+            tenant=old_collator.tenant)
         # the swap itself: two attribute writes in one loop step — a
         # routed request observes either (old, old) or (new, new)
         door.batcher = standby

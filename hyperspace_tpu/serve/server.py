@@ -80,6 +80,7 @@ import json
 import signal
 import sys
 import time
+import urllib.parse
 from typing import Optional
 
 import numpy as np
@@ -96,7 +97,8 @@ from hyperspace_tpu.telemetry.exposition import render_prometheus
 MAX_BODY_BYTES = 8 << 20  # one request's JSON; far past any bucket
 MAX_HEADERS = 128         # header-count cap: no unbounded dict growth
 _STATUS_BY_KIND = {"parse": 400, "validation": 400, "overloaded": 429,
-                   "deadline_exceeded": 504, "internal": 500}
+                   "deadline_exceeded": 504, "unknown_tenant": 404,
+                   "internal": 500}
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
             405: "Method Not Allowed", 413: "Payload Too Large",
             429: "Too Many Requests", 500: "Internal Server Error",
@@ -188,13 +190,28 @@ class HttpFrontDoor:
     handler and blocks until a drain completes, or drive ``drain()``
     directly (tests, embedded use)."""
 
-    def __init__(self, batcher: RequestBatcher, *,
+    def __init__(self, batcher: Optional[RequestBatcher] = None, *,
                  host: str = "127.0.0.1", port: int = 0,
                  max_wait_us: float = DEFAULT_MAX_WAIT_US,
-                 collator: Optional[Collator] = None):
-        self.batcher = batcher
-        self.collator = collator or Collator(batcher,
-                                             max_wait_us=max_wait_us)
+                 collator: Optional[Collator] = None,
+                 registry=None):
+        # multi-tenant mode (serve/registry.py): the EngineRegistry
+        # owns every stack; `batcher`/`collator` become views onto the
+        # DEFAULT tenant's (property below), so single-tenant callers —
+        # the rollover coordinator included — keep working unchanged
+        self._registry = registry
+        if registry is not None:
+            if batcher is not None or collator is not None:
+                raise ValueError(
+                    "registry= and batcher=/collator= are mutually "
+                    "exclusive — the registry owns the tenant stacks")
+        else:
+            if batcher is None:
+                raise ValueError("HttpFrontDoor needs a batcher "
+                                 "or a registry")
+            self._batcher = batcher
+            self._collator = collator or Collator(
+                batcher, max_wait_us=max_wait_us)
         # blue-green flips (serve/rollover.py): armed by the CLI /
         # embedder AFTER construction (the coordinator needs the door);
         # None = /admin/rollover answers 400
@@ -209,6 +226,42 @@ class HttpFrontDoor:
         self._conn_tasks: set = set()
         self._draining: Optional[asyncio.Event] = None
         self._drained: Optional[asyncio.Event] = None
+
+    # --- default-tenant views -------------------------------------------------
+    # With a registry armed, `door.batcher` / `door.collator` read AND
+    # write the default tenant's stack — the rollover coordinator's
+    # atomic flip (`door.batcher = standby`) keeps flipping the default
+    # tenant, and every single-tenant code path stays source-compatible.
+
+    @property
+    def batcher(self) -> RequestBatcher:
+        if self._registry is not None:
+            return self._registry.default.batcher
+        return self._batcher
+
+    @batcher.setter
+    def batcher(self, b: RequestBatcher) -> None:
+        if self._registry is not None:
+            self._registry.default.batcher = b
+        else:
+            self._batcher = b
+
+    @property
+    def collator(self) -> Collator:
+        if self._registry is not None:
+            return self._registry.default.collator
+        return self._collator
+
+    @collator.setter
+    def collator(self, c: Collator) -> None:
+        if self._registry is not None:
+            self._registry.default.collator = c
+        else:
+            self._collator = c
+
+    @property
+    def registry(self):
+        return self._registry
 
     # --- lifecycle ------------------------------------------------------------
 
@@ -249,8 +302,12 @@ class HttpFrontDoor:
         self._server.close()
         await self._server.wait_closed()
         # queued batches must not wait out their max-wait timers while
-        # the listeners are already closed
-        self.collator.flush_all()
+        # the listeners are already closed — every tenant's
+        if self._registry is not None:
+            for stack in self._registry.tenants():
+                stack.collator.flush_all()
+        else:
+            self.collator.flush_all()
         if self._conn_tasks:
             # in-flight requests answer; idle keep-alive readers cancel
             # immediately (the read/drain race in _on_connection).
@@ -263,7 +320,10 @@ class HttpFrontDoor:
         # event loop from inside this async def (the blocking-call
         # hazard this PR's own lint rule polices) — the executor thread
         # finishes on its own and is joined at interpreter exit
-        self.collator.close(wait=False)
+        if self._registry is not None:
+            self._registry.close(wait=False)
+        else:
+            self.collator.close(wait=False)
         if self.batcher.recorder is not None:
             # SIGTERM/drain is a flight-recorder trigger: the last
             # requests before shutdown are exactly the evidence a
@@ -418,13 +478,26 @@ class HttpFrontDoor:
             route, request_id=req.request_id, outcome=outcome,
             t_enq=req.t_in)
 
+    @staticmethod
+    def _query_tenant(query: str) -> Optional[str]:
+        """The ``?tenant=`` selector on the scrape routes (healthz /
+        stats) — the GET analog of the POST bodies' ``tenant`` field."""
+        if not query:
+            return None
+        vals = urllib.parse.parse_qs(query).get("tenant")
+        return vals[-1] if vals else None
+
     async def _route(self, req: _Request) -> tuple[int, dict]:
-        target = req.target.split("?", 1)[0]
+        target, _, query = req.target.partition("?")
         if target == "/healthz":
             if req.method != "GET":
                 return 405, {"error": {"kind": "validation",
                                        "message": "/healthz wants GET"}}
-            return self._healthz()
+            try:
+                return self._healthz(self._query_tenant(query))
+            except ServeError as e:  # unknown ?tenant= → 404, typed
+                err = error_response(e)
+                return _STATUS_BY_KIND[err["error"]["kind"]], err
         if target == "/metrics":
             # Prometheus text exposition of the whole registry
             # (telemetry/exposition.py; docs/observability.md "Live
@@ -438,7 +511,11 @@ class HttpFrontDoor:
                 return 405, {"error": {"kind": "validation",
                                        "message":
                                        "/v1/stats wants GET or POST"}}
-            return 200, self._stats()
+            try:
+                return 200, self._stats(self._query_tenant(query))
+            except ServeError as e:  # unknown ?tenant= → 404, typed
+                err = error_response(e)
+                return _STATUS_BY_KIND[err["error"]["kind"]], err
         if target not in ("/v1/topk", "/v1/score", "/v1/upsert",
                           "/v1/delete", "/admin/rollover"):
             self._serve_access(req, "none", "validation")
@@ -449,7 +526,7 @@ class HttpFrontDoor:
             self._serve_access(req, route, "validation")
             return 405, {"error": {"kind": "validation",
                                    "message": f"{target} wants POST"}}
-        entered = False  # past this flag, the collator owns the access log
+        entered = [False]  # past this flag, the collator owns the access log
         try:
             try:
                 body = json.loads(req.body.decode("utf-8"))
@@ -461,51 +538,7 @@ class HttpFrontDoor:
                 raise ValueError(
                     f"request body must be a JSON object, got "
                     f"{type(body).__name__}")
-            if target == "/v1/topk":
-                exclude_self = _json_bool(body, "exclude_self", True)
-                deadline_ms = _req_deadline(body)
-                entered = True
-                # the request envelope: the front door's root span scope,
-                # keyed by the X-Request-Id — the collator's lifecycle
-                # span becomes its child (spans off: a no-op)
-                with spans.request(route, req.request_id):
-                    idx, dist = await self.collator.topk(
-                        body.get("ids"), body.get("k", 10),
-                        exclude_self=exclude_self,
-                        deadline_ms=deadline_ms, t_enq=req.t_in,
-                        request_id=req.request_id)
-                    resp = {"neighbors": idx.tolist(),
-                            "dists": dist.tolist()}
-            elif target == "/v1/score":
-                prob = _json_bool(body, "prob", False)
-                fd_r = _req_number(body, "fd_r", 2.0)
-                fd_t = _req_number(body, "fd_t", 1.0)
-                deadline_ms = _req_deadline(body)
-                entered = True
-                with spans.request(route, req.request_id):
-                    scores = await self.collator.score(
-                        body.get("u"), body.get("v"), prob=prob,
-                        fd_r=fd_r, fd_t=fd_t,
-                        deadline_ms=deadline_ms, t_enq=req.t_in,
-                        request_id=req.request_id)
-                    resp = {"scores": scores.tolist()}
-            elif target == "/v1/upsert":
-                deadline_ms = _req_deadline(body)
-                entered = True
-                with spans.request(route, req.request_id):
-                    resp = await self.collator.upsert(
-                        body.get("ids"), body.get("rows"),
-                        deadline_ms=deadline_ms, t_enq=req.t_in,
-                        request_id=req.request_id)
-            elif target == "/v1/delete":
-                deadline_ms = _req_deadline(body)
-                entered = True
-                with spans.request(route, req.request_id):
-                    resp = await self.collator.delete(
-                        body.get("ids"),
-                        deadline_ms=deadline_ms, t_enq=req.t_in,
-                        request_id=req.request_id)
-            else:  # /admin/rollover
+            if target == "/admin/rollover":
                 if self.rollover is None:
                     raise ValueError(
                         "no rollover coordinator armed on this server "
@@ -520,26 +553,135 @@ class HttpFrontDoor:
                 # flip lands in one loop step — in-flight requests on
                 # the old stack answer from the old engine
                 resp = await self.rollover.rollover(dest)
+            else:
+                # multi-tenant routing (serve/registry.py): the body's
+                # optional "tenant" field — a tenant name or an
+                # artifact fingerprint — picks the serving stack;
+                # absent routes to the default tenant (back-compat).
+                # Unknown names answer the typed 404 (unknown_tenant).
+                tenant_key = body.get("tenant")
+                if self._registry is not None:
+                    stack = self._registry.resolve(tenant_key)
+                    # a paged-out tenant's engine rebuilds (coalesced,
+                    # on the paging executor) before its dispatch
+                    await self._registry.ensure_resident(stack)
+                    async with self._registry.using(stack):
+                        resp = await self._serve_op(
+                            target, route, body, req,
+                            stack.collator, entered)
+                else:
+                    if tenant_key is not None:
+                        # single-tenant servers still honor fingerprint
+                        # routing: the one engine's fingerprint resolves,
+                        # anything else is the same typed 404 a registry
+                        # would answer
+                        from hyperspace_tpu.serve.errors import \
+                            UnknownTenantError
+
+                        if not isinstance(tenant_key, str) or not tenant_key:
+                            raise ValueError(
+                                "tenant must be a non-empty string, "
+                                f"got {tenant_key!r}")
+                        if tenant_key != self.batcher.engine.fingerprint:
+                            raise UnknownTenantError(tenant_key)
+                    resp = await self._serve_op(
+                        target, route, body, req, self.collator, entered)
         except (ServeError, ValueError, KeyError, TypeError,
                 OverflowError, OSError) as e:
             # the stdin loop's per-line error classes, mapped onto
             # status codes; an IO fault (incl. the serve.dispatch
             # ioerror chaos site) answers 500 and the server survives
             err = error_response(e)
-            if not entered:
+            if not entered[0]:
                 # validation failed before the collator saw the
                 # request — it could not have emitted the record
                 self._serve_access(req, route, err["error"]["kind"])
             return _STATUS_BY_KIND[err["error"]["kind"]], err
         return 200, resp
 
-    def _healthz(self) -> tuple[int, dict]:
+    async def _serve_op(self, target: str, route: str, body: dict,
+                        req: _Request, coll: Collator,
+                        entered: list) -> dict:
+        """One serve op against the RESOLVED tenant's collator —
+        the four /v1 dispatch bodies, factored so single- and
+        multi-tenant routing share them verbatim."""
+        if target == "/v1/topk":
+            exclude_self = _json_bool(body, "exclude_self", True)
+            deadline_ms = _req_deadline(body)
+            entered[0] = True
+            # the request envelope: the front door's root span scope,
+            # keyed by the X-Request-Id — the collator's lifecycle
+            # span becomes its child (spans off: a no-op)
+            with spans.request(route, req.request_id):
+                idx, dist = await coll.topk(
+                    body.get("ids"), body.get("k", 10),
+                    exclude_self=exclude_self,
+                    deadline_ms=deadline_ms, t_enq=req.t_in,
+                    request_id=req.request_id)
+                return {"neighbors": idx.tolist(),
+                        "dists": dist.tolist()}
+        if target == "/v1/score":
+            prob = _json_bool(body, "prob", False)
+            fd_r = _req_number(body, "fd_r", 2.0)
+            fd_t = _req_number(body, "fd_t", 1.0)
+            deadline_ms = _req_deadline(body)
+            entered[0] = True
+            with spans.request(route, req.request_id):
+                scores = await coll.score(
+                    body.get("u"), body.get("v"), prob=prob,
+                    fd_r=fd_r, fd_t=fd_t,
+                    deadline_ms=deadline_ms, t_enq=req.t_in,
+                    request_id=req.request_id)
+                return {"scores": scores.tolist()}
+        if target == "/v1/upsert":
+            deadline_ms = _req_deadline(body)
+            entered[0] = True
+            with spans.request(route, req.request_id):
+                return await coll.upsert(
+                    body.get("ids"), body.get("rows"),
+                    deadline_ms=deadline_ms, t_enq=req.t_in,
+                    request_id=req.request_id)
+        # /v1/delete (the route set is closed upstream)
+        deadline_ms = _req_deadline(body)
+        entered[0] = True
+        with spans.request(route, req.request_id):
+            return await coll.delete(
+                body.get("ids"),
+                deadline_ms=deadline_ms, t_enq=req.t_in,
+                request_id=req.request_id)
+
+    def _healthz(self, tenant_key: Optional[str] = None
+                 ) -> tuple[int, dict]:
         """The load-balancer body (docs/serving.md "HTTP front door"):
         bare ok plus the fields a blue-green flip checks before routing
         traffic — uptime, package version, which artifact (fingerprint)
         and which program (scan signature, precision lane) this server
-        answers with, and whether it is currently degraded."""
+        answers with, and whether it is currently degraded.  With a
+        registry armed the body carries a per-tenant summary list;
+        ``?tenant=`` narrows to one tenant (404 on unknown names —
+        the identity fields then come from the stack's captured build
+        identity, so a PAGED-OUT tenant still answers without a
+        rebuild)."""
         ok = not self._draining.is_set()
+        if self._registry is not None:
+            out = {"ok": ok, "draining": not ok,
+                   "uptime_s": round(time.monotonic() - self.t_start, 3),
+                   "version": hyperspace_tpu.__version__}
+            if tenant_key is not None:
+                # raises UnknownTenantError → the caller's 404 path
+                out.update(self._registry.resolve(tenant_key).summary())
+            else:
+                d = self._registry.default
+                out["fingerprint"] = d.fingerprint
+                out["tenant"] = d.name
+                out["tenants"] = [s.summary()
+                                  for s in self._registry.tenants()]
+            return (200 if ok else 503), out
+        if tenant_key is not None and (
+                tenant_key != self.batcher.engine.fingerprint):
+            from hyperspace_tpu.serve.errors import UnknownTenantError
+
+            raise UnknownTenantError(tenant_key)
         eng = self.batcher.engine
         return (200 if ok else 503), {
             "ok": ok,
@@ -555,8 +697,18 @@ class HttpFrontDoor:
             "generation": getattr(eng, "generation", None),
         }
 
-    def _stats(self) -> dict:
-        out = dict(self.batcher.stats())
+    def _stats(self, tenant_key: Optional[str] = None) -> dict:
+        if self._registry is not None:
+            tenants = self._registry.stats()
+            if tenant_key is not None:
+                # raises UnknownTenantError → the caller's 404 path
+                out = dict(tenants[self._registry.resolve(tenant_key)
+                                   .name])
+            else:
+                out = dict(tenants[self._registry.default.name])
+                out["tenants"] = tenants
+        else:
+            out = dict(self.batcher.stats())
         out["server"] = {"served": self.served,
                          "inflight": self.inflight,
                          "draining": self.draining,
@@ -606,10 +758,11 @@ def latency_summary_line(baseline: Optional[dict] = None) -> str:
             % (lat["count"], lat["p50"], lat["p95"], lat["p99"]))
 
 
-async def run_front_door(batcher: RequestBatcher, *, host: str, port: int,
-                         max_wait_us: float,
+async def run_front_door(batcher: Optional[RequestBatcher] = None, *,
+                         host: str, port: int,
+                         max_wait_us: float = DEFAULT_MAX_WAIT_US,
                          ready=None, prewarm_ks=None,
-                         rollover_builder=None) -> dict:
+                         rollover_builder=None, registry=None) -> dict:
     """Start, announce, serve until drained (SIGTERM), summarize.
 
     ``ready(host, port)`` is called once the listener is bound (the CLI
@@ -623,9 +776,12 @@ async def run_front_door(batcher: RequestBatcher, *, host: str, port: int,
     standby :class:`RequestBatcher`) arms ``POST /admin/rollover``
     (serve/rollover.py) — the standby is prewarmed over the same
     ``prewarm_ks`` before the gate-checked flip.
-    Returns the closing stats dict."""
+    ``registry=`` (a :class:`~hyperspace_tpu.serve.registry.
+    EngineRegistry`) serves EVERY registered tenant behind this one
+    door instead of a single batcher — prewarm then warms each
+    resident tenant's ladder.  Returns the closing stats dict."""
     door = HttpFrontDoor(batcher, host=host, port=port,
-                         max_wait_us=max_wait_us)
+                         max_wait_us=max_wait_us, registry=registry)
     if rollover_builder is not None:
         from hyperspace_tpu.serve.rollover import RolloverCoordinator
 
@@ -635,7 +791,13 @@ async def run_front_door(batcher: RequestBatcher, *, host: str, port: int,
     if prewarm_ks:
         # deliberately blocking: nothing is listening yet, and a warm
         # ladder is the precondition for opening the door at all
-        info = batcher.prewarm(prewarm_ks)
+        if registry is not None:
+            infos = registry.prewarm(prewarm_ks)
+            progs = sum(i["programs"] for i in infos.values())
+            secs = sum(i["seconds"] for i in infos.values())
+            info = {"programs": progs, "seconds": secs}
+        else:
+            info = door.batcher.prewarm(prewarm_ks)
         try:
             print(f"[serve-http] prewarmed {info['programs']} "
                   f"program(s) in {info['seconds']:.2f}s",

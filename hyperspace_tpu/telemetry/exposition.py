@@ -54,6 +54,28 @@ from hyperspace_tpu.telemetry.registry import Registry, default_registry
 PREFIX = "hyperspace_"
 _BAD_RUNE_RX = re.compile(r"[^a-zA-Z0-9_:]")
 
+# Per-tenant registry names embed the tenant as a suffix the exposition
+# re-renders as a real Prometheus ``tenant`` label: the registry stays a
+# flat name→value dict (no label machinery on the hot inc path), while a
+# scrape sees one family per BASE name with tenant-labeled samples —
+# ``serve/e2e_ms@tenant=en`` joins the ``serve/e2e_ms`` family as
+# ``hyperspace_serve_e2e_ms{tenant="en",...}``.  The HELP line carries
+# the base name, so the catalog round trip (check_metrics_endpoint.py ↔
+# docs/observability.md) keys on ONE documented row per base metric.
+TENANT_SEP = "@tenant="
+
+
+def split_tenant(name: str) -> tuple:
+    """``(base_name, tenant_or_None)`` for a registry metric name."""
+    base, sep, tenant = name.partition(TENANT_SEP)
+    return (base, tenant) if sep else (name, None)
+
+
+def tenant_metric(name: str, tenant) -> str:
+    """The per-tenant twin of registry metric ``name`` (see
+    :data:`TENANT_SEP`); ``tenant=None`` returns the base name."""
+    return f"{name}{TENANT_SEP}{tenant}" if tenant else name
+
 
 def sanitize_name(name: str) -> str:
     """Registry name → Prometheus metric family name.
@@ -170,21 +192,37 @@ def render_export(counters: dict, gauges: dict, hists: dict,
     if labels:
         base.update({str(k): str(v) for k, v in labels.items()})
     lines: list[str] = []
-    for name in sorted(counters):
+
+    def _families(entries: dict) -> list:
+        """[(base_name, [(labels, value), ...])] — tenant-suffixed names
+        fold into their base family as tenant-labeled samples; within a
+        family the unlabeled sample sorts first, tenants alphabetically
+        (sorted() on the suffixed names gives exactly that order)."""
+        fams: dict = {}
+        for name in sorted(entries):
+            bname, tenant = split_tenant(name)
+            lab = dict(base, tenant=tenant) if tenant else base
+            fams.setdefault(bname, []).append((lab, entries[name]))
+        return sorted(fams.items())
+
+    for name, samples in _families(counters):
         san = sanitize_name(name)
         lines.append(f"# HELP {san} {escape_help(name)}")
         lines.append(f"# TYPE {san} counter")
-        lines.append(f"{san}{_labels_str(base)} {_fmt(counters[name])}")
-    for name in sorted(gauges):
+        for lab, v in samples:
+            lines.append(f"{san}{_labels_str(lab)} {_fmt(v)}")
+    for name, samples in _families(gauges):
         san = sanitize_name(name)
         lines.append(f"# HELP {san} {escape_help(name)}")
         lines.append(f"# TYPE {san} gauge")
-        lines.append(f"{san}{_labels_str(base)} {_fmt(gauges[name])}")
-    for name in sorted(hists):
+        for lab, v in samples:
+            lines.append(f"{san}{_labels_str(lab)} {_fmt(v)}")
+    for name, samples in _families(hists):
         san = sanitize_name(name)
         lines.append(f"# HELP {san} {escape_help(name)}")
         lines.append(f"# TYPE {san} histogram")
-        lines.extend(_hist_lines(san, base, hists[name]))
+        for lab, snap in samples:
+            lines.extend(_hist_lines(san, lab, snap))
     return "\n".join(lines) + "\n"
 
 

@@ -81,6 +81,24 @@ class SloWindow:
         self._next_slot = now + self.slot_s
         self._ring.append(self._capture(now))
 
+    @classmethod
+    def for_tenant(cls, tenant: str, window_s: float = DEFAULT_WINDOW_S,
+                   **kw) -> "SloWindow":
+        """A window over one tenant's metric series: the default hist/
+        counter names with the tenant suffix the batcher double-writes
+        (exposition.tenant_metric), so a multi-tenant process gets one
+        independent SLO view per tenant instead of N windows all
+        reading the shared aggregates."""
+        from hyperspace_tpu.telemetry.exposition import tenant_metric
+
+        return cls(
+            window_s,
+            hist_names=tuple(tenant_metric(n, tenant)
+                             for n in DEFAULT_HISTS),
+            counter_names=tuple(tenant_metric(n, tenant)
+                                for n in DEFAULT_COUNTERS),
+            **kw)
+
     def _reg(self) -> Registry:
         return self._registry or default_registry()
 
@@ -147,7 +165,14 @@ class SloWindow:
         out["e2e_ms"] = e2e
 
         def rate(counter: str) -> float:
-            d = head[2].get(counter, 0) - base[2].get(counter, 0)
+            # resolve by BASE name: a per-tenant window is configured
+            # with tenant-suffixed counter names (``serve/requests@
+            # tenant=en`` — telemetry/exposition.py's label scheme), and
+            # its rates must read those, not the all-tenant aggregates
+            name = next((n for n in self.counter_names
+                         if n == counter or n.startswith(counter + "@")),
+                        counter)
+            d = head[2].get(name, 0) - base[2].get(name, 0)
             return round(max(d, 0) / elapsed, 4)
 
         out["rate_qps"] = rate("serve/requests")

@@ -75,9 +75,14 @@ _LOWER_TOKENS = ("time", "stall", "waste", "recompile", "epoch_s",
 # fraction — a bigger ratio is a slower instrumented server); the r18
 # live-index freshness/staleness family is a cost too — time-to-visible
 # (``upsert_visible_ms``), stale answers served (``stale_results``) —
-# growing fresher-slower or staler is never an improvement
+# growing fresher-slower or staler is never an improvement; the r20
+# multi-tenant leg's ``tenant_fairness`` (starved p99 over solo p99 —
+# a contention-damage RATIO, so it must outrank the generic ratio
+# token) and the ``starved_p99_ms`` reading behind it are both costs —
+# a tenant getting more starved is never an improvement
 _LOWER_PRIORITY_TOKENS = ("waste", "shed", "deadline", "overhead",
-                          "fresh", "stale", "visible")
+                          "fresh", "stale", "visible", "fairness",
+                          "starved")
 # size tokens, matched per dotted-path SEGMENT (word-boundary style: the
 # segment is the token, or carries it as a ``_``-separated word) so the
 # r15 big-table leg's capacity metrics — ``table_mb.int8``,

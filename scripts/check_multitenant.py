@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Smoke lint: the multi-tenant front door over the wire, as a subprocess.
+
+Two artifacts → ONE ``serve-http`` process (``tenants=`` roster) →
+route by tenant name AND by artifact fingerprint → answers bitwise
+against solo engines built from the same artifacts → unknown tenants
+answer the typed 404 → then a SECOND launch under a device budget that
+cannot hold both engines proves the paging round trip (admissions +
+evictions observed via /healthz, answers still bitwise) → SIGTERM
+drain exits 0.  Asserted (exit 1 on any miss):
+
+- ``/healthz`` lists both tenants with DISTINCT fingerprints; the
+  first roster entry is the default route;
+- ``POST /v1/topk`` with ``"tenant": <name>`` and with ``"tenant":
+  <fingerprint>`` both route to the right engine — results bitwise
+  equal (``.view(uint32)``) to a solo engine over the same artifact,
+  and the no-field request answers exactly the default tenant's rows
+  (cross-tenant isolation is structural: fingerprint-keyed caches,
+  signature-keyed programs);
+- an unregistered tenant answers ``404`` + ``error.kind =
+  "unknown_tenant"`` (docs/serving.md "Error taxonomy");
+- ``/v1/stats?tenant=`` answers that tenant's block;
+- recompiles stay FLAT across repeated same-bucket traffic to BOTH
+  resident tenants (steady state compiles nothing);
+- under ``device_budget_mb=`` paging: alternating tenants records
+  admissions AND evictions in the healthz summaries, and every answer
+  stays bitwise-correct across the round trips;
+- SIGTERM drains rc=0 with the drain notice.
+
+Run by ``tests/serve/test_check_multitenant_script.py`` inside the
+suite, mirroring ``check_serve_http.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable as a plain script from anywhere (the package is not installed)
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from scripts.check_serve_http import (  # noqa: E402
+    _StderrPump,
+    _get,
+    _post,
+    _wait_for_port,
+)
+
+D = 16
+K = 5
+TENANTS = (("alpha", 600, 1.1, 3), ("beta", 600, 1.4, 7))
+QUERY_IDS = [0, 3, 11, 29]
+
+
+def build_table(n: int, c: float, seed: int):
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.manifolds import PoincareBall
+
+    v = 0.4 * jax.random.normal(jax.random.PRNGKey(seed), (n, D),
+                                jnp.float32)
+    return PoincareBall(c).expmap0(v)
+
+
+def _bitwise_equal(a, b) -> bool:
+    import numpy as np
+
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    return (a.shape == b.shape
+            and bool((a.view(np.uint32) == b.view(np.uint32)).all()))
+
+
+def _launch(roster_path: str, budget_mb: float):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hyperspace_tpu.cli.serve", "serve-http",
+         f"tenants={roster_path}", "port=0", "host=127.0.0.1",
+         "max_wait_us=1000", "telemetry=1", "prewarm=1", f"k={K}",
+         "min_bucket=8", "max_bucket=16",
+         f"device_budget_mb={budget_mb}"],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    pump = _StderrPump(proc)
+    host, port = _wait_for_port(proc, pump)
+    return proc, pump, host, port
+
+
+def _drain(proc, pump) -> int:
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        print("DRAIN HUNG: SIGTERM did not stop the server in 60 s")
+        return 1
+    err = pump.text()
+    if proc.returncode != 0:
+        print(f"DRAIN EXIT CODE {proc.returncode}; stderr:\n{err}")
+        return 1
+    if "drained" not in err:
+        print(f"DRAIN NOTICE missing; stderr:\n{err}")
+        return 1
+    return 0
+
+
+def main(out_dir: str | None = None) -> int:
+    import numpy as np
+
+    from hyperspace_tpu.serve import QueryEngine, export_artifact, \
+        load_artifact
+
+    tmp = None
+    if out_dir is None:
+        tmp = tempfile.TemporaryDirectory()
+        out_dir = tmp.name
+    os.makedirs(out_dir, exist_ok=True)
+    procs = []
+    try:
+        # --- two artifacts + in-process solo reference engines -------
+        arts, solo = {}, {}
+        for name, n, c, seed in TENANTS:
+            path = os.path.join(out_dir, name)
+            table = np.asarray(build_table(n, c, seed))
+            export_artifact(path, table, ("poincare", c),
+                            model_config={"c": c}, overwrite=True)
+            arts[name] = path
+            solo[name] = QueryEngine.from_artifact(load_artifact(path))
+        expect = {name: solo[name].topk_neighbors(QUERY_IDS, K)
+                  for name in solo}
+        fps = {name: solo[name].fingerprint for name in solo}
+        if fps["alpha"] == fps["beta"]:
+            print("TEST SETUP BROKEN: both artifacts share a fingerprint")
+            return 1
+        roster_path = os.path.join(out_dir, "tenants.json")
+        with open(roster_path, "w", encoding="utf-8") as f:
+            json.dump([{"name": "alpha", "artifact": arts["alpha"],
+                        "weight": 2.0, "queue_max": 64},
+                       {"name": "beta", "artifact": arts["beta"],
+                        "weight": 1.0}], f)
+
+        def check_topk(host, port, payload, name, label) -> int:
+            status, q = _post(host, port, "/v1/topk",
+                              {**payload, "ids": QUERY_IDS, "k": K})
+            if status != 200:
+                print(f"{label}: topk FAILED: {status} {q}")
+                return 1
+            idx, dist = expect[name]
+            if q["neighbors"] != np.asarray(idx).tolist():
+                print(f"{label}: WRONG NEIGHBORS (cross-tenant "
+                      f"leak?): {q['neighbors']} want "
+                      f"{np.asarray(idx).tolist()}")
+                return 1
+            if not _bitwise_equal(q["dists"], dist):
+                print(f"{label}: dists NOT BITWISE vs the solo engine")
+                return 1
+            return 0
+
+        # ============ launch 1: unlimited budget (routing) ============
+        proc, pump, host, port = _launch(roster_path, 0.0)
+        procs.append(proc)
+        status, health = _get(host, port, "/healthz")
+        if status != 200 or health.get("ok") is not True:
+            print(f"HEALTHZ BROKEN: {status} {health}")
+            return 1
+        summaries = {t["tenant"]: t for t in health.get("tenants", [])}
+        if set(summaries) != {"alpha", "beta"}:
+            print(f"HEALTHZ TENANTS wrong: {sorted(summaries)}")
+            return 1
+        if health.get("tenant") != "alpha":
+            print(f"DEFAULT TENANT should be the first roster entry "
+                  f"(alpha); got {health.get('tenant')!r}")
+            return 1
+        for name in summaries:
+            if summaries[name].get("fingerprint") != fps[name]:
+                print(f"FINGERPRINT MISMATCH for {name}: "
+                      f"{summaries[name].get('fingerprint')!r}")
+                return 1
+
+        # route by name, by fingerprint, and by default — all bitwise
+        for payload, name, label in (
+                ({"tenant": "alpha"}, "alpha", "by-name alpha"),
+                ({"tenant": "beta"}, "beta", "by-name beta"),
+                ({"tenant": fps["beta"]}, "beta", "by-fingerprint beta"),
+                ({}, "alpha", "default route")):
+            if check_topk(host, port, payload, name, label):
+                return 1
+
+        status, r = _post(host, port, "/v1/topk",
+                          {"tenant": "nobody", "ids": QUERY_IDS, "k": K})
+        if status != 404 or r.get("error", {}).get("kind") != \
+                "unknown_tenant":
+            print(f"UNKNOWN TENANT should answer 404/unknown_tenant: "
+                  f"{status} {r}")
+            return 1
+
+        status, st = _get(host, port, "/v1/stats?tenant=beta")
+        if status != 200 or st.get("registry", {}).get("tenant") != "beta":
+            print(f"PER-TENANT STATS broken: {status} "
+                  f"{st.get('registry')}")
+            return 1
+
+        # steady state: repeated same-bucket traffic to both resident
+        # tenants compiles nothing
+        status, st0 = _post(host, port, "/v1/stats", {})
+        for _ in range(3):
+            for payload, name in (({"tenant": "alpha"}, "alpha"),
+                                  ({"tenant": "beta"}, "beta")):
+                if check_topk(host, port, payload, name,
+                              f"steady {name}"):
+                    return 1
+        status, st1 = _post(host, port, "/v1/stats", {})
+        if st1["recompiles"] != st0["recompiles"]:
+            print(f"RECOMPILES NOT FLAT in steady state: "
+                  f"{st0['recompiles']} -> {st1['recompiles']}")
+            return 1
+        if _drain(proc, pump):
+            return 1
+
+        # ============ launch 2: budget forces engine paging ===========
+        # each table is 600×16 f32 = 37.5 KiB, so 0.05 MiB (51.2 KiB)
+        # holds one engine but never both — alternating tenants must
+        # page (the artifact stays the host master; answers stay bitwise)
+        proc, pump, host, port = _launch(roster_path, 0.05)
+        procs.append(proc)
+        for round_i in range(2):
+            for payload, name in (({"tenant": "alpha"}, "alpha"),
+                                  ({"tenant": "beta"}, "beta")):
+                if check_topk(host, port, payload, name,
+                              f"paged round {round_i} {name}"):
+                    return 1
+        status, health = _get(host, port, "/healthz")
+        summaries = {t["tenant"]: t for t in health.get("tenants", [])}
+        admits = sum(t.get("admissions", 0) for t in summaries.values())
+        evicts = sum(t.get("evictions", 0) for t in summaries.values())
+        if not (admits > 0 and evicts > 0):
+            print(f"PAGING NEVER HAPPENED under the budget: "
+                  f"admissions={admits} evictions={evicts} {summaries}")
+            return 1
+        if _drain(proc, pump):
+            return 1
+        print(f"multi-tenant front door OK: routed by name+fingerprint "
+              f"(bitwise vs solo), unknown tenant 404, recompiles flat "
+              f"steady, paging round trip ({admits} admits / {evicts} "
+              f"evicts) bitwise, drained clean x2")
+        return 0
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        if tmp is not None:
+            tmp.cleanup()
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))

@@ -1,0 +1,18 @@
+"""Bad fixture: registry-scoped serve code writing unlabeled metrics.
+
+Linted under a pretend ``hyperspace_tpu/serve/registry.py`` rel path
+(the rule is file-scoped); never imported.
+"""
+
+from hyperspace_tpu.telemetry import registry as telem
+
+
+def admit(stack):
+    # aggregate-only counter: every tenant's paging folds into one
+    # series and a thrashing cold tenant vanishes in the average
+    telem.inc("serve/tenant_admissions")
+    telem.observe("serve/tenant_admit_s", 0.25)
+
+
+def residency(level):
+    telem.set_gauge("serve/tenants_resident", level)

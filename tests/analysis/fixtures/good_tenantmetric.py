@@ -1,0 +1,25 @@
+"""Good fixture: the double-write convention plus a suppressed global.
+
+Linted under a pretend ``hyperspace_tpu/serve/registry.py`` rel path;
+never imported.
+"""
+
+from hyperspace_tpu.telemetry import registry as telem
+from hyperspace_tpu.telemetry.exposition import tenant_metric
+
+
+def admit(stack):
+    # the convention: aggregate + labeled twin, both through the
+    # dynamic-name path (non-literal first args never fire)
+    for name in ("serve/tenant_admissions",):
+        telem.inc(name)
+        telem.inc(tenant_metric(name, stack.name))
+    telem.observe(tenant_metric("serve/tenant_admit_s", stack.name),
+                  0.25)
+
+
+def residency(level):
+    # genuinely registry-global: a device-wide residency level, not one
+    # tenant's load — accepted hazard, visible at the line
+    telem.set_gauge(  # hyperlint: disable=tenant-unlabeled-metric — device-wide residency level, not per-tenant load
+        "serve/tenants_resident", level)

@@ -29,6 +29,8 @@ from hyperspace_tpu.analysis.rules.packing import PackingLiteralRule
 from hyperspace_tpu.analysis.rules.precision import PrecisionLiteralRule
 from hyperspace_tpu.analysis.rules.recompile import RecompileHazardRule
 from hyperspace_tpu.analysis.rules.retry import UnboundedRetryRule
+from hyperspace_tpu.analysis.rules.tenantmetric import (
+    TenantUnlabeledMetricRule)
 from hyperspace_tpu.analysis.rules.tracerleak import TracerLeakRule
 from hyperspace_tpu.analysis.rules.units import MetricUnitSuffixRule
 
@@ -59,6 +61,8 @@ _PER_FILE = [
     ("bad_packing.py", PackingLiteralRule,
      "hyperspace_tpu/serve/bad_packing.py"),
     ("bad_units.py", MetricUnitSuffixRule, None),
+    ("bad_tenantmetric.py", TenantUnlabeledMetricRule,
+     "hyperspace_tpu/serve/registry.py"),
     ("bad_monoclock.py", MonotonicClockRule,
      "hyperspace_tpu/serve/bad_monoclock.py"),
     ("bad_mpio.py", MultiprocessUnsafeIORule,
@@ -312,6 +316,48 @@ def test_units_good_fixture_is_clean():
 
 def test_units_severity_is_warning():
     report = _lint("bad_units.py", MetricUnitSuffixRule)
+    assert all(f.severity == "warning" for f in report.findings)
+
+
+# --- tenant-unlabeled-metric --------------------------------------------------
+
+_REGISTRY_REL = "hyperspace_tpu/serve/registry.py"
+
+
+def test_tenantmetric_bad_fixture_fires_every_shape():
+    """Unlabeled inc / observe / set_gauge literals in registry-scoped
+    serve code each fire."""
+    report = _lint("bad_tenantmetric.py", TenantUnlabeledMetricRule,
+                   rel=_REGISTRY_REL)
+    msgs = [f.message for f in report.findings]
+    assert report.exit_code() == 1 and len(report.findings) == 3
+    assert any("'serve/tenant_admissions'" in m for m in msgs)
+    assert any("'serve/tenant_admit_s'" in m for m in msgs)
+    assert any("'serve/tenants_resident'" in m for m in msgs)
+    assert all("tenant label" in m for m in msgs)
+
+
+def test_tenantmetric_good_fixture_is_clean():
+    """tenant_metric twins, dynamic names, and a suppressed genuinely-
+    global gauge all pass."""
+    assert _lint("good_tenantmetric.py", TenantUnlabeledMetricRule,
+                 rel=_REGISTRY_REL).findings == []
+
+
+def test_tenantmetric_out_of_scope_is_clean():
+    """The same writes outside registry-scoped serve code never fire —
+    the batcher's lifecycle double-writes are already labeled and the
+    rest of the package predates tenancy."""
+    for rel in ("hyperspace_tpu/serve/batcher.py",
+                "hyperspace_tpu/telemetry/registry.py", None):
+        report = _lint("bad_tenantmetric.py", TenantUnlabeledMetricRule,
+                       rel=rel)
+        assert report.findings == [], rel
+
+
+def test_tenantmetric_severity_is_warning():
+    report = _lint("bad_tenantmetric.py", TenantUnlabeledMetricRule,
+                   rel=_REGISTRY_REL)
     assert all(f.severity == "warning" for f in report.findings)
 
 

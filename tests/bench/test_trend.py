@@ -301,6 +301,27 @@ def test_direction_freshness_staleness_are_lower_better():
         assert mod.direction(name) == "lower", name
 
 
+def test_direction_fairness_starvation_are_lower_better():
+    """The r20 multi-tenant leg's fairness family is a cost: the
+    ``tenant_fairness`` ratio is starved-p99 over solo-p99 (contention
+    damage — it must outrank the generic higher-better ratio token the
+    same way waste_ratio does) and ``starved_p99_ms`` is the latency
+    behind it.  The aggregate throughput at the tenant mix stays
+    higher-better via the qps token."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_trend", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for name in ("tenant_fairness", "detail.multitenant.fairness",
+                 "fairness_ratio", "starved_p99_ms",
+                 "detail.multitenant.starved_p99_ms"):
+        assert mod.direction(name) == "lower", name
+    assert mod.direction("multitenant_agg_qps") == "higher"
+    assert mod.direction(
+        "detail.multitenant.aggregate_qps") == "higher"
+
+
 def test_direction_during_rollover_inherits_base_metric():
     """``*_during_rollover`` readings (r18) inherit the base metric's
     direction: the window qualifier carries none of its own.  A p99

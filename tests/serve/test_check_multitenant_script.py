@@ -1,0 +1,32 @@
+"""The multi-tenant smoke lint, run inside the suite: two artifacts →
+ONE ``serve-http tenants=`` subprocess → route by name + fingerprint
+(bitwise vs solo engines) → unknown tenant 404 → paging round trip
+under a device budget → SIGTERM drain (scripts/check_multitenant.py is
+the one implementation — this test fails the build when it fails,
+mirroring test_check_live_script.py)."""
+
+import importlib.util
+import os
+
+import pytest
+
+
+def _load_checker():
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, "scripts", "check_multitenant.py")
+    spec = importlib.util.spec_from_file_location("check_multitenant",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.flaky  # a loaded CI host can starve the subprocess launch
+def test_multitenant_front_door_lint_passes(tmp_path, capsys):
+    mod = _load_checker()
+    rc = mod.main(str(tmp_path / "tenants"))
+    out = capsys.readouterr().out
+    assert rc == 0, f"multi-tenant front-door lint failed:\n{out}"
+    assert "multi-tenant front door OK" in out
+    assert "paging round trip" in out

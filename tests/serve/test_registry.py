@@ -1,0 +1,341 @@
+"""Multi-tenant engine registry contracts (ISSUE 20): routing by name
+or fingerprint with a 404-typed miss, whole-engine paging under a
+device budget (LRU victims, in-use/queued protection, coalesced
+admits, bitwise round trips), weighted-fair deficit-round-robin
+dispatch, and per-tenant degradation independence."""
+
+import asyncio
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.manifolds import PoincareBall
+from hyperspace_tpu.serve import (EngineRegistry, UnknownTenantError,
+                                  engine_device_bytes)
+from hyperspace_tpu.serve.artifact import export_artifact, load_artifact
+from hyperspace_tpu.serve.collator import FairDispatcher
+from hyperspace_tpu.serve.engine import QueryEngine
+from hyperspace_tpu.telemetry import registry as telem
+
+N, D, K = 96, 8, 4
+QUERY_IDS = [0, 3, 11, 29]
+
+_BATCHER_KW = dict(min_bucket=4, max_bucket=8, cache_size=0,
+                   queue_max=4, ladder_down_after=1)
+
+
+def _art(tmp_path, name, seed):
+    rng = np.random.default_rng(seed)
+    table = np.asarray(PoincareBall(1.0).expmap0(
+        jnp.asarray(rng.standard_normal((N, D)) * 0.3, jnp.float32)))
+    export_artifact(str(tmp_path / name), table, ("poincare", 1.0))
+    return str(tmp_path / name)
+
+
+def _registry(tmp_path, names, *, budget_mb=0.0, **kw):
+    reg = EngineRegistry(device_budget_mb=budget_mb, max_wait_us=500,
+                         **kw)
+    for i, name in enumerate(names):
+        reg.add_tenant(name, _art(tmp_path, name, seed=i),
+                       window_s=0.0, batcher_kw=dict(_BATCHER_KW))
+    return reg
+
+
+def _solo(path):
+    return QueryEngine.from_artifact(load_artifact(path))
+
+
+def _one_engine_budget_mb(tmp_path):
+    """A budget that provably holds ONE of these engines but never two
+    (1.25x one engine's measured device footprint — multiples of it
+    stay strictly between N and N+1 engines for small N)."""
+    eng = _solo(_art(tmp_path, "probe", seed=99))
+    return engine_device_bytes(eng) * 1.25 / (1 << 20)
+
+
+def _assert_bitwise(stack, solo):
+    nbr, dist = stack.batcher.topk(QUERY_IDS, K)
+    ref_n, ref_d = solo.topk_neighbors(
+        np.asarray(QUERY_IDS, np.int32), K)
+    np.testing.assert_array_equal(np.asarray(nbr), np.asarray(ref_n))
+    np.testing.assert_array_equal(
+        np.asarray(dist, np.float32).view(np.uint32),
+        np.asarray(ref_d, np.float32).view(np.uint32))
+
+
+# --- construction + routing ---------------------------------------------------
+
+
+def test_negative_budget_rejected():
+    with pytest.raises(ValueError, match="device_budget_mb"):
+        EngineRegistry(device_budget_mb=-0.5)
+
+
+def test_add_tenant_validation(tmp_path):
+    reg = EngineRegistry()
+    try:
+        path = _art(tmp_path, "a", seed=0)
+        with pytest.raises(ValueError, match="non-empty"):
+            reg.add_tenant("", path)
+        with pytest.raises(ValueError, match="weight"):
+            reg.add_tenant("a", path, weight=0.0)
+        reg.add_tenant("a", path, window_s=0.0)
+        with pytest.raises(ValueError, match="duplicate"):
+            reg.add_tenant("a", path, window_s=0.0)
+    finally:
+        reg.close()
+
+
+def test_resolve_by_name_fingerprint_and_default(tmp_path):
+    reg = _registry(tmp_path, ("a", "b"))
+    try:
+        a, b = reg.resolve("a"), reg.resolve("b")
+        assert reg.resolve() is a        # first added tenant = default
+        assert reg.resolve(None) is a
+        assert reg.resolve(a.fingerprint) is a
+        assert reg.resolve(b.fingerprint) is b
+        assert a.fingerprint != b.fingerprint
+        with pytest.raises(UnknownTenantError) as ei:
+            reg.resolve("nobody")
+        assert ei.value.tenant == "nobody"
+        assert ei.value.kind == "unknown_tenant"
+        for bad in (7, b"", ""):
+            with pytest.raises(ValueError, match="non-empty string"):
+                reg.resolve(bad)
+    finally:
+        reg.close()
+
+
+def test_empty_registry_has_no_default():
+    reg = EngineRegistry()
+    try:
+        with pytest.raises(UnknownTenantError):
+            reg.default
+    finally:
+        reg.close()
+
+
+# --- engine paging ------------------------------------------------------------
+
+
+def test_budget_pages_out_idle_tenants_on_admission(tmp_path):
+    budget = _one_engine_budget_mb(tmp_path)
+    reg = _registry(tmp_path, ("a", "b", "c"), budget_mb=budget)
+    try:
+        a, b, c = (reg.resolve(n) for n in "abc")
+        # each add_tenant keeps the newcomer and evicts the idle rest
+        assert (a.resident, b.resident, c.resident) == (False, False,
+                                                        True)
+        assert a.evictions == 1 and b.evictions == 1
+        assert reg.stats()["a"]["registry"]["device_bytes"] == 0
+    finally:
+        reg.close()
+
+
+def test_eviction_picks_the_least_recently_used_victim(tmp_path):
+    budget = 2.0 * _one_engine_budget_mb(tmp_path)  # holds two engines
+    reg = _registry(tmp_path, ("a", "b", "c"), budget_mb=budget)
+    try:
+        a, b, c = (reg.resolve(n) for n in "abc")
+
+        async def run():
+            # admitting c evicted the LRU of {a, b} — a (built first)
+            assert (a.resident, b.resident, c.resident) == (False, True,
+                                                            True)
+            async with reg.using(b):   # touch b: c becomes the LRU
+                pass
+            await reg.ensure_resident(a)
+            assert (a.resident, b.resident, c.resident) == (True, True,
+                                                            False)
+
+        asyncio.run(run())
+    finally:
+        reg.close()
+
+
+def test_inflight_tenant_is_never_a_victim(tmp_path):
+    budget = _one_engine_budget_mb(tmp_path)
+    reg = _registry(tmp_path, ("a", "b"), budget_mb=budget)
+    try:
+        a, b = reg.resolve("a"), reg.resolve("b")
+
+        async def run():
+            async with reg.using(b):
+                await reg.ensure_resident(a)
+                # no safe victim: the set stays over budget rather than
+                # yanking the engine out from under b's request
+                assert a.resident and b.resident
+            reg._enforce_budget(keep=a)  # traffic passed: b pages out
+            assert a.resident and not b.resident
+
+        asyncio.run(run())
+    finally:
+        reg.close()
+
+
+def test_concurrent_admits_coalesce_into_one_rebuild(tmp_path):
+    reg = _registry(tmp_path, ("a", "b"))
+    try:
+        b = reg.resolve("b")
+        reg._evict(b)
+
+        async def run():
+            await asyncio.gather(*(reg.ensure_resident(b)
+                                   for _ in range(4)))
+
+        asyncio.run(run())
+        assert b.resident and b.admissions == 1
+        assert b.admit_future is None
+    finally:
+        reg.close()
+
+
+def test_paging_round_trip_is_bitwise(tmp_path):
+    reg = _registry(tmp_path, ("a", "b"))
+    try:
+        b = reg.resolve("b")
+        solo = _solo(b.artifact)
+        _assert_bitwise(b, solo)
+        reg._evict(b)
+        assert b.batcher.engine is None
+
+        async def run():
+            await reg.ensure_resident(b)
+
+        asyncio.run(run())
+        # same artifact -> same fingerprint -> same bits; with the
+        # persistent compile cache the rebuild is deserialization only
+        assert b.fingerprint == solo.fingerprint
+        _assert_bitwise(b, solo)
+        assert (telem.default_registry().get(
+            "serve/tenant_admissions@tenant=b") or 0) >= 1
+    finally:
+        reg.close()
+
+
+def test_stats_shape_for_paged_out_tenants(tmp_path):
+    budget = _one_engine_budget_mb(tmp_path)
+    reg = _registry(tmp_path, ("a", "b"), budget_mb=budget)
+    try:
+        stats = reg.stats()
+        # a was paged out by b's admission: registry block only (its
+        # batcher stats would dereference the evicted engine)
+        assert set(stats["a"]) == {"tenant", "registry"}
+        assert stats["a"]["registry"]["resident"] is False
+        assert stats["b"]["registry"]["resident"] is True
+        assert "degrade_level" in stats["b"]  # full batcher stats
+    finally:
+        reg.close()
+
+
+# --- weighted-fair dispatch ---------------------------------------------------
+
+
+def _drive_drr(weights, jobs, *, cost, quantum=8):
+    """Submit ``jobs`` [(tenant, fn-tag)] while the single worker is
+    held busy, release it, and return the completion order of tags."""
+    order = []
+
+    async def run():
+        loop = asyncio.get_running_loop()
+        exec_ = ThreadPoolExecutor(max_workers=1)
+        disp = FairDispatcher(exec_, weights=weights, quantum=quantum)
+        gate = threading.Event()
+        futs = [disp.submit(loop, jobs[0][0], 1, lambda: gate.wait(10))]
+        for tenant, tag in jobs:
+            futs.append(disp.submit(loop, tenant, cost,
+                                    lambda t=tag: order.append(t)))
+        assert sum(disp.pending().values()) == len(jobs)
+        gate.set()
+        await asyncio.gather(*futs)
+        exec_.shutdown(wait=True)
+        return disp
+
+    disp = asyncio.run(run())
+    return order, disp
+
+
+def test_drr_grants_share_proportional_to_weight():
+    jobs = ([("a", "a")] * 6) + [("b", "b")] * 6
+    # cost 2x quantum: "a" (weight 2) affords every visit, "b" only
+    # every second -> a drains at twice b's rate while both contend
+    order, _ = _drive_drr({"a": 2.0, "b": 1.0}, jobs, cost=16)
+    contended = order[:9]
+    assert contended.count("a") == 6 and contended.count("b") == 3
+    assert order[9:] == ["b", "b", "b"]
+
+
+def test_drr_emptied_queue_forfeits_deficit():
+    # a huge-weight tenant banks nothing while idle: after its queue
+    # drains its deficit resets, so a later burst starts from zero
+    order, disp = _drive_drr({"a": 100.0, "b": 1.0},
+                             [("a", "a"), ("b", "b")], cost=8)
+    assert sorted(order) == ["a", "b"]
+    assert disp.pending() == {}
+    assert all(d == 0.0 for d in disp._deficit.values())
+
+
+def test_drr_skips_cancelled_jobs():
+    ran = []
+
+    async def run():
+        loop = asyncio.get_running_loop()
+        exec_ = ThreadPoolExecutor(max_workers=1)
+        disp = FairDispatcher(exec_)
+        gate = threading.Event()
+        blocker = disp.submit(loop, "a", 1, lambda: gate.wait(10))
+        doomed = disp.submit(loop, "a", 1, lambda: ran.append("doomed"))
+        kept = disp.submit(loop, "b", 1, lambda: ran.append("kept"))
+        doomed.cancel()
+        gate.set()
+        await asyncio.gather(blocker, kept)
+        exec_.shutdown(wait=True)
+
+    asyncio.run(run())
+    assert ran == ["kept"]  # the cancelled job never reached the pool
+
+
+def test_drr_misconfigured_zero_weight_throttles_not_halts():
+    disp = FairDispatcher(ThreadPoolExecutor(max_workers=1),
+                          weights={"z": 0.0})
+    assert disp.weight("z") > 0.0
+    with pytest.raises(ValueError, match="quantum"):
+        FairDispatcher(ThreadPoolExecutor(max_workers=1), quantum=0)
+
+
+# --- isolation ----------------------------------------------------------------
+
+
+def test_tenant_answers_bitwise_match_solo_engines(tmp_path):
+    reg = _registry(tmp_path, ("a", "b"))
+    try:
+        for name in ("a", "b"):
+            stack = reg.resolve(name)
+            _assert_bitwise(stack, _solo(stack.artifact))
+    finally:
+        reg.close()
+
+
+def test_degradation_ladders_are_independent(tmp_path):
+    """Satellite: one tenant walking its ladder down must not move a
+    neighbor's level or its answers — the ladder, window, and cache
+    live in the per-tenant stack, not in any shared middle."""
+    reg = _registry(tmp_path, ("a", "b"))
+    try:
+        a, b = reg.resolve("a"), reg.resolve("b")
+        solo_b = _solo(b.artifact)
+        _assert_bitwise(b, solo_b)
+        assert a.batcher.degrade_level == 0
+        a.batcher._ladder.observe(1.0)  # sustained pressure on a only
+        assert a.batcher.degrade_level >= 1
+        assert b.batcher.degrade_level == 0
+        _assert_bitwise(b, solo_b)  # b's answers untouched, bitwise
+        summaries = {s["tenant"]: s
+                     for s in (t.summary() for t in reg.tenants())}
+        assert summaries["a"]["degrade_level"] >= 1
+        assert summaries["b"]["degrade_level"] == 0
+    finally:
+        reg.close()

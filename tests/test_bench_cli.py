@@ -280,8 +280,8 @@ def test_budget_zero_skips_all_legs_but_emits(bench_mod, monkeypatch, capsys):
     assert set(full["detail"]["skipped_legs"]) == {
         "poincare", "hgcn_sampled", "serve_qps", "serve_http",
         "live_index", "cold_start", "big_table", "precision",
-        "resilience", "multihost", "realistic", "workloads",
-        "use_att_arm"}
+        "resilience", "multihost", "multitenant", "realistic",
+        "workloads", "use_att_arm"}
     assert full["detail"]["budget_s"] == 0
     assert _last_json(captured)["metric"] == "hgcn_samples_per_sec_per_chip"
 
